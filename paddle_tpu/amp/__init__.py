@@ -1,0 +1,4 @@
+from .auto_cast import auto_cast, autocast, decorate, is_autocast_enabled, white_list
+from .grad_scaler import AmpScaler, GradScaler
+
+__all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "AmpScaler"]

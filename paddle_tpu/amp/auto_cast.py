@@ -1,0 +1,98 @@
+"""AMP autocast (reference: python/paddle/amp/auto_cast.py).
+
+O1: ops on the white list (matmul/conv/linear class) run in fp16/bf16, black
+list ops stay fp32 — implemented as a thread-local mode consulted by the
+compute-heavy functionals. O2: `decorate` casts the model's params to the
+low dtype and the optimizer keeps fp32 master weights (multi_precision).
+
+On TPU bf16 is the native fast dtype (MXU), no loss scaling needed; fp16 is
+supported for parity and exercises GradScaler.
+"""
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+
+_state = threading.local()
+
+# reference: python/paddle/amp/amp_lists.py white/black lists
+white_list = {"matmul", "conv2d", "conv1d", "conv3d", "linear", "einsum", "bmm", "mm", "attention"}
+black_list = {"exp", "log", "softmax", "log_softmax", "cross_entropy", "mean", "sum", "norm", "cumsum"}
+
+
+def _tls():
+    if not hasattr(_state, "enabled"):
+        _state.enabled = False
+        _state.dtype = jnp.bfloat16
+        _state.level = "O1"
+        _state.custom_white = set()
+        _state.custom_black = set()
+    return _state
+
+
+def is_autocast_enabled():
+    return _tls().enabled
+
+
+def get_autocast_dtype():
+    return _tls().dtype
+
+
+def amp_cast_inputs(op_name, arrays):
+    """Called by compute functionals: cast inputs per the autocast mode."""
+    t = _tls()
+    if not t.enabled:
+        return arrays
+    if op_name in t.custom_black or (op_name in black_list and op_name not in t.custom_white):
+        return [a.astype(jnp.float32) if _is_low(a.dtype) else a for a in arrays]
+    if op_name in white_list or op_name in t.custom_white:
+        return [a.astype(t.dtype) if _is_float(a.dtype) else a for a in arrays]
+    return arrays
+
+
+def _is_float(d):
+    return np.issubdtype(np.dtype(d), np.floating) or np.dtype(d) == dtypes.bfloat16
+
+
+def _is_low(d):
+    return np.dtype(d) in (np.dtype(np.float16), np.dtype(dtypes.bfloat16))
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16",
+              use_promote=True):
+    t = _tls()
+    prev = (t.enabled, t.dtype, t.level, t.custom_white, t.custom_black)
+    t.enabled = enable
+    t.dtype = dtypes.convert_dtype(dtype)
+    t.level = level
+    t.custom_white = set(custom_white_list or ())
+    t.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        t.enabled, t.dtype, t.level, t.custom_white, t.custom_black = prev
+
+
+autocast = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to low dtype; optimizer gets master
+    fp32 weights (reference: amp.decorate + multi_precision kernels)."""
+    dt = dtypes.convert_dtype(dtype)
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m._to_dtype(dt)
+    if optimizers is None:
+        return models if single_model else model_list
+    single_opt = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if single_opt else list(optimizers)
+    for o in opt_list:
+        o._multi_precision = True if master_weight is None else master_weight
+    return (models if single_model else model_list), (optimizers if single_opt else opt_list)

@@ -1,0 +1,134 @@
+"""Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py; kernels
+check_finite_and_unscale + update_loss_scaling).
+
+Eager API (scale/step/update) for dygraph parity, plus a pure functional
+state machine (init_state/update_state) used inside compiled train steps.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+
+
+class GradScaler:
+    def __init__(
+        self,
+        enable=True,
+        init_loss_scaling=2.0**15,
+        incr_ratio=2.0,
+        decr_ratio=0.5,
+        incr_every_n_steps=1000,
+        decr_every_n_nan_or_inf=2,
+        use_dynamic_loss_scaling=True,
+    ):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled_opts = set()  # optimizers already unscaled this step
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        from ..framework.core import apply
+
+        return apply(lambda a: a * self._scale, var, name="amp_scale")
+
+    def unscale_(self, optimizer):
+        if not self._enable or id(optimizer) in self._unscaled_opts:
+            return
+        self._unscaled_opts.add(id(optimizer))
+        params = optimizer._parameter_list or []
+        self._found_inf = False
+        inv = 1.0 / self._scale
+        for p in params:
+            if p.grad is not None:
+                g = p.grad._data.astype(jnp.float32) * inv
+                if not bool(jnp.all(jnp.isfinite(g))):
+                    self._found_inf = True
+                p.grad = Tensor(g.astype(p.grad.dtype))
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        self._unscaled_opts.clear()
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    # -- functional state machine for compiled steps ------------------------
+    def init_state(self):
+        return {
+            "scale": jnp.asarray(self._scale, jnp.float32),
+            "good": jnp.zeros((), jnp.int32),
+            "bad": jnp.zeros((), jnp.int32),
+        }
+
+    def update_state(self, state, finite):
+        good = jnp.where(finite, state["good"] + 1, 0)
+        bad = jnp.where(finite, 0, state["bad"] + 1)
+        incr = good >= self._incr_every_n_steps
+        decr = bad >= self._decr_every_n
+        scale = jnp.where(incr, state["scale"] * self._incr_ratio, state["scale"])
+        scale = jnp.where(decr, jnp.maximum(scale * self._decr_ratio, 1.0), scale)
+        return {
+            "scale": scale,
+            "good": jnp.where(incr, 0, good),
+            "bad": jnp.where(decr, 0, bad),
+        }
+
+    def state_dict(self):
+        return {
+            "scale": np.float32(self._scale),
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, sd):
+        self._scale = float(sd.get("scale", self._scale))
+        self._good_steps = int(sd.get("good_steps", 0))
+        self._bad_steps = int(sd.get("bad_steps", 0))
+
+
+AmpScaler = GradScaler

@@ -1,0 +1,17 @@
+"""Autograd utilities (reference: python/paddle/autograd/)."""
+from ..framework.core import Tensor, no_grad, no_grad_guard, to_tensor
+from .backward_mode import backward
+from .functional import grad, jacobian, hessian, vjp, jvp
+from .py_layer import PyLayer, PyLayerContext
+
+__all__ = [
+    "backward",
+    "grad",
+    "jacobian",
+    "hessian",
+    "vjp",
+    "jvp",
+    "no_grad",
+    "PyLayer",
+    "PyLayerContext",
+]

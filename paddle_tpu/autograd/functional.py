@@ -1,0 +1,116 @@
+"""Functional autodiff: paddle.grad / jacobian / hessian / vjp / jvp.
+
+Reference: python/paddle/autograd/. Here these are thin adapters over jax's
+native transforms, operating on detached tensor data — higher-order autodiff
+comes for free from jax, where the reference needed its prim-op machinery.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, to_tensor
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad over the dygraph tape: run backward, harvest input grads."""
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gouts = grad_outputs if isinstance(grad_outputs, (list, tuple)) else [grad_outputs] * len(outs)
+
+    saved = [(t.grad, t.stop_gradient) for t in ins]
+    for t in ins:
+        t.grad = None
+        t.stop_gradient = False
+    try:
+        for o, g in zip(outs, gouts):
+            o.backward(g, retain_graph=bool(retain_graph) or create_graph)
+        grads = []
+        for t, (old_grad, _) in zip(ins, saved):
+            if t.grad is None and not allow_unused:
+                raise RuntimeError("a gradient is None; pass allow_unused=True to permit")
+            grads.append(t.grad)
+    finally:
+        for t, (old_grad, old_sg) in zip(ins, saved):
+            t.grad = old_grad
+            t.stop_gradient = old_sg
+    return grads if isinstance(inputs, (list, tuple)) else grads[0]
+
+
+def _functionalize(func):
+    def wrapped(*datas):
+        ts = [Tensor(d, stop_gradient=False) for d in datas]
+        out = func(*ts)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    return wrapped
+
+
+def _data_of(xs):
+    if isinstance(xs, (tuple, list)):
+        return tuple(to_tensor(x)._data for x in xs)
+    return (to_tensor(xs)._data,)
+
+
+def vjp(func, xs, v=None):
+    datas = _data_of(xs)
+    out, vjp_fn = jax.vjp(_functionalize(func), *datas)
+    if v is None:
+        seed = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        seed = (
+            tuple(to_tensor(x)._data for x in v) if isinstance(v, (tuple, list)) else to_tensor(v)._data
+        )
+    grads = vjp_fn(seed)
+    wrap = lambda tree: jax.tree_util.tree_map(lambda a: Tensor(a), tree)
+    out_t = wrap(out)
+    grads_t = [Tensor(g) for g in grads]
+    return out_t, grads_t if isinstance(xs, (tuple, list)) else grads_t[0]
+
+
+def jvp(func, xs, v=None):
+    datas = _data_of(xs)
+    tangents = (
+        tuple(to_tensor(x)._data for x in v)
+        if isinstance(v, (tuple, list))
+        else ((to_tensor(v)._data,) if v is not None else tuple(jnp.ones_like(d) for d in datas))
+    )
+    out, tangent_out = jax.jvp(_functionalize(func), datas, tangents)
+    wrap = lambda tree: jax.tree_util.tree_map(lambda a: Tensor(a), tree)
+    return wrap(out), wrap(tangent_out)
+
+
+class jacobian:
+    """paddle.autograd.jacobian parity (lazy matrix semantics simplified to
+    eager computation via jax.jacrev)."""
+
+    def __new__(cls, ys, xs, batch_axis=None):
+        # functional form: jacobian(func, xs)
+        if callable(ys):
+            func, x = ys, xs
+            datas = _data_of(x)
+            jac = jax.jacrev(_functionalize(func), argnums=tuple(range(len(datas))))(*datas)
+            jac_t = jax.tree_util.tree_map(lambda a: Tensor(a), jac)
+            if not isinstance(x, (tuple, list)):
+                jac_t = jac_t[0] if isinstance(jac_t, tuple) else jac_t
+            return jac_t
+        raise NotImplementedError("tape-based jacobian: use the functional form jacobian(func, xs)")
+
+
+def hessian(func, xs, batch_axis=None):
+    datas = _data_of(xs)
+    h = jax.hessian(_functionalize(func), argnums=tuple(range(len(datas))))(*datas)
+    h_t = jax.tree_util.tree_map(lambda a: Tensor(a), h)
+    if not isinstance(xs, (tuple, list)):
+        while isinstance(h_t, tuple):
+            h_t = h_t[0]
+    return h_t

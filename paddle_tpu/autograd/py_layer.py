@@ -1,0 +1,82 @@
+"""PyLayer — custom autograd ops (reference: python/paddle/autograd/py_layer.py).
+
+A PyLayer's forward runs on raw arrays; its backward is spliced into the tape
+as a GradNode whose vjp closure calls the user's static backward.
+"""
+from ..framework.core import GradNode, Tensor, _grad_enabled, to_tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = _grad_enabled() and any(not t.stop_gradient for t in tensor_args)
+
+        out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        outs = [o if isinstance(o, Tensor) else to_tensor(o) for o in outs]
+
+        if needs_grad:
+
+            import jax.numpy as jnp
+
+            def vjp_fn(cts):
+                cts = cts if isinstance(cts, tuple) else (cts,)
+                gin = cls.backward(ctx, *[Tensor(c) for c in cts])
+                gin = list(gin) if isinstance(gin, (tuple, list)) else [gin]
+                # Paddle contract: one grad per forward tensor input, in order.
+                # Align to the differentiable inputs; None → zeros.
+                gs = []
+                for t, g in zip(tensor_args, gin):
+                    if t.stop_gradient:
+                        continue
+                    if g is None:
+                        gs.append(jnp.zeros(tuple(t.shape), t.dtype))
+                    else:
+                        gs.append(g._data if isinstance(g, Tensor) else g)
+                return tuple(gs)
+
+            diff_inputs = [(t, not t.stop_gradient) for t in tensor_args]
+            node = GradNode(
+                vjp_fn,
+                diff_inputs,
+                [(tuple(o.shape), o.dtype) for o in outs],
+                name=cls.__name__,
+            )
+            for i, o in enumerate(outs):
+                o.stop_gradient = False
+                o._node = node
+                o._out_idx = i
+        if multi:
+            return tuple(outs)
+        return outs[0]

@@ -1,0 +1,181 @@
+"""Device API (reference: python/paddle/device/ — Place objects, set_device).
+
+On TPU there is one device runtime (PJRT); Places are thin descriptors and
+memory stats come from jax's per-device allocator statistics (the analogue of
+the reference's StatAllocator counters, paddle/fluid/memory/allocation/).
+"""
+import jax
+
+
+class Place:
+    def __init__(self, kind, device_id=0):
+        self._kind = kind
+        self._device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._device_id})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and (self._kind, self._device_id) == (other._kind, other._device_id)
+
+    def __hash__(self):
+        return hash((self._kind, self._device_id))
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class TPUPlace(Place):
+    """The TPUPlace the north star asks for (reference analogue: phi::GPUPlace
+    registered via paddle/phi/common/place.h)."""
+
+    def __init__(self, device_id=0):
+        super().__init__("tpu", device_id)
+
+
+_current_device = None
+
+
+def set_device(device):
+    global _current_device
+    _current_device = device
+    return get_device()
+
+
+def get_device():
+    if _current_device is not None:
+        return _current_device
+    backend = jax.default_backend()
+    return f"{backend}:0"
+
+
+def get_all_custom_device_type():
+    return ["tpu"] if jax.default_backend() == "tpu" else []
+
+
+def is_compiled_with_custom_device(name):
+    return name == "tpu"
+
+
+def device_count():
+    return jax.device_count()
+
+
+def local_device_count():
+    return jax.local_device_count()
+
+
+def synchronize(device=None):
+    for d in jax.local_devices():
+        try:
+            jax.device_put(0, d).block_until_ready()
+        except Exception:
+            pass
+
+
+def memory_stats(device_id=0):
+    devs = jax.local_devices()
+    if device_id < len(devs):
+        stats = devs[device_id].memory_stats()
+        return stats or {}
+    return {}
+
+
+def max_memory_allocated(device=None):
+    return memory_stats().get("peak_bytes_in_use", 0)
+
+
+def memory_allocated(device=None):
+    return memory_stats().get("bytes_in_use", 0)
+
+
+def max_memory_reserved(device=None):
+    return memory_stats().get("peak_bytes_in_use", 0)
+
+
+def memory_reserved(device=None):
+    return memory_stats().get("bytes_limit", 0)
+
+
+class Stream:
+    """Streams are an XLA-internal concept on TPU; kept for API parity."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+class cuda:
+    """paddle.device.cuda namespace shim → TPU runtime equivalents."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return jax.device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return max_memory_allocated()
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return memory_allocated()
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return max_memory_reserved()
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return memory_reserved()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def current_stream(device=None):
+        return Stream(device)
+
+    @staticmethod
+    def stream_guard(stream):
+        import contextlib
+
+        return contextlib.nullcontext()

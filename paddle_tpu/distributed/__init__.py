@@ -1,0 +1,58 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/)."""
+from . import env, mesh
+from .communication import (
+    Group,
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    alltoall,
+    barrier,
+    broadcast,
+    gather,
+    get_group,
+    irecv,
+    isend,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from .communication.ops import P2POp, batch_isend_irecv, ppermute, shift
+from .mesh import build_mesh, get_mesh, set_mesh
+from .parallel import (
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    spawn,
+)
+from . import fleet
+from . import auto_parallel
+from .auto_parallel.api import (
+    DistAttr,
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_tensor,
+)
+from . import checkpoint
+from .checkpoint import load_state_dict, save_state_dict
+
+is_initialized = env.is_initialized
+
+
+def is_available():
+    return True
+
+
+def get_backend():
+    return "xla"

@@ -1,0 +1,14 @@
+from .api import (
+    DistAttr,
+    Partial,
+    Placement,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_tensor,
+    to_static,
+)
+from .engine import DistModel, Engine, Strategy

@@ -1,0 +1,213 @@
+"""Semi-auto parallel DistTensor API (reference:
+python/paddle/distributed/auto_parallel/api.py — shard_tensor, reshard,
+placements; C++ DistTensor at phi/core/distributed/auto_parallel/dist_tensor.cc).
+
+This layer largely IS jax: a DistTensor is a jax.Array with a NamedSharding;
+placement propagation is GSPMD. We provide the Paddle-shaped API:
+
+  mesh = ProcessMesh([[0,1],[2,3]], dim_names=["x","y"])
+  t = shard_tensor(t, mesh, [Shard(0), Replicate()])
+  t = reshard(t, mesh, [Replicate(), Replicate()])
+"""
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...framework.core import Tensor, to_tensor
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. jax.Arrays carry no partial state at the
+    API boundary (XLA resolves partials internally), so Partial placements
+    materialize as replicated values after a psum."""
+
+    def __init__(self, reduce_type=None):
+        self.reduce_type = reduce_type or "sum"
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """reference: auto_parallel/process_mesh.py."""
+
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        self._process_ids = arr.reshape(-1).tolist()
+        self._shape = list(arr.shape)
+        self._dim_names = list(dim_names) if dim_names else [f"d{i}" for i in range(arr.ndim)]
+        devices = np.asarray(jax.devices())[np.asarray(self._process_ids)].reshape(arr.shape)
+        self._jax_mesh = Mesh(devices, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def jax_mesh(self):
+        return self._jax_mesh
+
+    def get_mesh_with_dim(self, dim_name, index=None):
+        axis = self._dim_names.index(dim_name)
+        m = self.mesh
+        if index is not None:
+            sub = np.take(m, index, axis=axis)
+            names = [n for n in self._dim_names if n != dim_name]
+            return ProcessMesh(sub, names)
+        order = [axis] + [i for i in range(self.ndim) if i != axis]
+        return ProcessMesh(m.transpose(order), [self._dim_names[i] for i in order])
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._shape == other._shape
+            and self._process_ids == other._process_ids
+        )
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+
+def _placements_to_spec(placements, ndim, mesh):
+    """[Shard(0), Replicate()] over mesh dims -> PartitionSpec per tensor dim."""
+    entries = [None] * ndim
+    for mesh_dim, placement in enumerate(placements):
+        if isinstance(placement, Shard):
+            d = placement.dim
+            name = mesh.dim_names[mesh_dim]
+            if entries[d] is None:
+                entries[d] = name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (name,)
+            else:
+                entries[d] = (entries[d], name)
+    return PartitionSpec(*entries)
+
+
+class DistAttr:
+    """reference: TensorDistAttr (dist_attr.cc) — mesh + per-dim mapping."""
+
+    def __init__(self, mesh, sharding_specs=None, placements=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs
+        self.placements = placements
+
+
+def shard_tensor(data, mesh, placements, dtype=None, place=None, stop_gradient=None):
+    t = to_tensor(data)
+    spec = _placements_to_spec(placements, t.ndim, mesh)
+    sharding = NamedSharding(mesh.jax_mesh(), spec)
+    arr = jax.device_put(t._data, sharding)
+    out = Tensor(arr, stop_gradient=t.stop_gradient if stop_gradient is None else stop_gradient)
+    out._dist_attr = DistAttr(mesh, placements=placements)
+    if isinstance(t, Tensor) and t._node is not None:
+        out._node, out._out_idx = t._node, t._out_idx
+    return out
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor, mesh, placements):
+    """Cross-placement (and cross-mesh) redistribution (reference:
+    static/reshard.py Resharder; here a device_put with the target sharding —
+    XLA emits the minimal collective: slice/all-gather/all-to-all)."""
+    t = to_tensor(dist_tensor)
+    spec = _placements_to_spec(placements, t.ndim, mesh)
+    sharding = NamedSharding(mesh.jax_mesh(), spec)
+    arr = jax.device_put(t._data, sharding)
+    out = Tensor(arr, stop_gradient=t.stop_gradient)
+    out._dist_attr = DistAttr(mesh, placements=placements)
+    return out
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None, output_fn=None):
+    """reference: auto_parallel/api.py shard_layer — apply shard_fn(name,
+    layer, mesh) to every sublayer to place its params."""
+    if shard_fn is None:
+
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in sublayer._parameters.items():
+                if p is not None:
+                    placements = [Replicate()] * mesh.ndim
+                    sharded = shard_tensor(p, mesh, placements)
+                    p._data = sharded._data
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    return layer
+
+
+def get_placements(t):
+    attr = getattr(t, "_dist_attr", None)
+    return attr.placements if attr else None
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    from .engine import DistModel
+
+    return DistModel(layer, loader, loss, optimizer, strategy)

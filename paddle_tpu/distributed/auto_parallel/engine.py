@@ -1,0 +1,150 @@
+"""auto_parallel Engine (reference: auto_parallel/static/engine.py).
+
+The reference pipeline — Completer (SPMD propagation) → Partitioner →
+Resharder → passes → InterpreterCore — collapses on TPU to: trace the model
+functionally, annotate parameter/input shardings, jit. GSPMD performs
+propagation+partition+reshard inside XLA (SURVEY.md §3.4). What remains ours:
+the placement API, remat/grad-accum passes, and the run loop.
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...framework import random as prandom
+from ...framework.core import Tensor, to_tensor
+from ...jit_api import TrainStep
+
+
+class Strategy:
+    """reference: auto_parallel/strategy.py dataclasses."""
+
+    def __init__(self):
+        self.auto_mode = "semi"
+        self.amp = _SubConfig(enable=False, dtype="bfloat16", level="O2")
+        self.recompute = _SubConfig(enable=False)
+        self.sharding = _SubConfig(enable=False, degree=1, stage=1)
+        self.pipeline = _SubConfig(enable=False, schedule_mode="1F1B", accumulate_steps=1)
+        self.gradient_merge = _SubConfig(enable=False, k_steps=1)
+
+
+class _SubConfig:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class DistModel:
+    """reference: DistModel from auto_parallel to_static: callable that runs
+    the parallelized program."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None, strategy=None):
+        self.network = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = "train"
+        self._train_step = None
+
+    def train(self):
+        self._mode = "train"
+
+    def eval(self):
+        self._mode = "eval"
+
+    def predict(self):
+        self._mode = "predict"
+
+    def __call__(self, *args):
+        if self._mode == "train" and self._loss is not None and self._optimizer is not None:
+            if self._train_step is None:
+                self._train_step = TrainStep(self.network, self._loss, self._optimizer)
+            return self._train_step(*args)
+        out = self.network(*args[:1]) if self._mode != "train" else self.network(*args)
+        if self._mode == "eval" and self._loss is not None:
+            return self._loss(out, *args[1:])
+        return out
+
+    def state_dict(self, mode="all"):
+        return self.network.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.network.set_state_dict(sd)
+
+
+class Engine:
+    """reference: auto_parallel/static/engine.py Engine.fit/evaluate/predict."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None, strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics if isinstance(metrics, (list, tuple)) else ([metrics] if metrics else [])
+        self.strategy = strategy or Strategy()
+        self._train_step = None
+
+    def _ensure_step(self):
+        if self._train_step is None:
+            self._train_step = TrainStep(self.model, self.loss, self.optimizer)
+
+    def fit(self, train_data, train_sample_split=None, batch_size=1, epochs=1, steps_per_epoch=None,
+            log_freq=10, valid_data=None, collate_fn=None, callbacks=None, verbose=1):
+        from ...io import DataLoader
+
+        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size, shuffle=True, drop_last=True, collate_fn=collate_fn
+        )
+        self._ensure_step()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            for step, batch in enumerate(loader):
+                if steps_per_epoch and step >= steps_per_epoch:
+                    break
+                batch = batch if isinstance(batch, (list, tuple)) else [batch]
+                loss = self._train_step(*batch)
+                history["loss"].append(float(loss.numpy()))
+                if verbose and step % log_freq == 0:
+                    print(f"[AutoParallel Engine] epoch {epoch} step {step} loss {float(loss.numpy()):.5f}")
+        return history
+
+    def evaluate(self, valid_data, batch_size=1, steps=None, collate_fn=None, callbacks=None, verbose=1):
+        from ...io import DataLoader
+
+        loader = valid_data if isinstance(valid_data, DataLoader) else DataLoader(
+            valid_data, batch_size=batch_size, collate_fn=collate_fn
+        )
+        losses = []
+        self.model.eval()
+        for step, batch in enumerate(loader):
+            if steps and step >= steps:
+                break
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            out = self.model(*batch[:-1])
+            losses.append(float(self.loss(out, batch[-1]).numpy()))
+        self.model.train()
+        return {"loss": sum(losses) / max(len(losses), 1)}
+
+    def predict(self, test_data, batch_size=1, steps=None, collate_fn=None, callbacks=None, verbose=1):
+        from ...io import DataLoader
+
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
+            test_data, batch_size=batch_size, collate_fn=collate_fn
+        )
+        outs = []
+        self.model.eval()
+        for step, batch in enumerate(loader):
+            if steps and step >= steps:
+                break
+            batch = batch if isinstance(batch, (list, tuple)) else [batch]
+            outs.append(self.model(*batch))
+        self.model.train()
+        return outs
+
+    def save(self, path, training=True):
+        from ... import serialization
+
+        serialization.save({"model": self.model.state_dict()}, path + ".pdparams")
+
+    def load(self, path):
+        from ... import serialization
+
+        sd = serialization.load(path + ".pdparams")
+        self.model.set_state_dict(sd["model"])

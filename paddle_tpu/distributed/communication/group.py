@@ -1,0 +1,115 @@
+"""Communication groups (reference: python/paddle/distributed/collective.py
+_group_map / new_group; C++ ProcessGroup in
+paddle/fluid/distributed/collective/process_group.h).
+
+TPU-native: a Group names a mesh AXIS (or axis tuple). Collectives issued on
+a group lower to XLA collectives over that axis inside shard_map/pjit —
+there is no per-group communicator object to initialize; XLA materializes
+channels per program. Groups therefore carry only (axis names, ranks, id).
+"""
+import itertools
+
+from .. import env as _env
+from ..mesh import get_mesh
+
+_group_map = {}
+_group_counter = itertools.count(0)
+
+
+class Group:
+    def __init__(self, axis_names, gid=None, ranks=None, pg_name=None):
+        if isinstance(axis_names, str):
+            axis_names = (axis_names,)
+        self.axis_names = tuple(axis_names) if axis_names else ()
+        self.id = gid if gid is not None else next(_group_counter)
+        self._ranks = ranks
+        self.pg_name = pg_name or f"group_{self.id}"
+
+    @property
+    def nranks(self):
+        try:
+            mesh = get_mesh()
+            size = 1
+            for a in self.axis_names:
+                if a in mesh.axis_names:
+                    size *= mesh.shape[a]
+            return size if self.axis_names else max(_env.get_world_size(), 1)
+        except Exception:
+            return len(self._ranks) if self._ranks else 1
+
+    @property
+    def rank(self):
+        return _env.get_rank()
+
+    @property
+    def ranks(self):
+        return self._ranks if self._ranks is not None else list(range(self.nranks))
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self):
+        return True
+
+    @property
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axes={self.axis_names}, nranks={self.nranks})"
+
+
+_WORLD = None
+
+
+def _world_group():
+    global _WORLD
+    if _WORLD is None:
+        _WORLD = Group(axis_names=None, gid=0)
+        _group_map[0] = _WORLD
+    return _WORLD
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _world_group()
+    return _group_map.get(gid)
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    """reference: paddle.distributed.new_group. On TPU, prefer passing
+    axis_name (a mesh axis); rank lists are retained for API compatibility."""
+    g = Group(axis_names=axis_name, ranks=ranks)
+    _group_map[g.id] = g
+    return g
+
+
+def get_axis_names(group):
+    if group is None:
+        return _world_group_axes()
+    return group.axis_names or _world_group_axes()
+
+
+def _world_group_axes():
+    try:
+        mesh = get_mesh()
+        return tuple(a for a in mesh.axis_names if mesh.shape[a] > 1)
+    except Exception:
+        return ()
+
+
+def is_initialized():
+    return _env.is_initialized()
+
+
+def destroy_process_group(group=None):
+    global _WORLD
+    if group is None or group.id == 0:
+        _WORLD = None
+        _group_map.clear()
+    else:
+        _group_map.pop(group.id, None)

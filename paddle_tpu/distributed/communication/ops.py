@@ -1,0 +1,274 @@
+"""Collective ops (reference: python/paddle/distributed/communication/*.py →
+C++ ProcessGroupNCCL at paddle/fluid/distributed/collective/, legacy c_* ops
+at paddle/fluid/operators/collective/).
+
+TPU-native semantics, two contexts:
+
+1. INSIDE a shard_map region (the hot path): mesh axes are bound, ops lower
+   to XLA HLO collectives over ICI — psum/all_gather/ppermute/all_to_all.
+   This is the `c_allreduce/c_allgather/c_reduce_scatter over ICI` the north
+   star names.
+2. EAGER single-controller: a jax.Array is already mesh-global, so SUM-style
+   collectives are identity (the value IS the reduced value under GSPMD);
+   host-level coordination across processes uses multihost_utils.
+
+Mutating Paddle signatures (in-place tensor update) are honored.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply, to_tensor
+from .. import env as _env
+from .group import get_axis_names
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _bound_axes(group):
+    """Mesh axes of `group` that are bound in the current trace (shard_map)."""
+    axes = get_axis_names(group)
+    bound = []
+    for a in axes:
+        try:
+            jax.lax.axis_index(a)
+            bound.append(a)
+        except BaseException:
+            pass
+    return tuple(bound)
+
+
+def _reduce_fn(op):
+    return {
+        ReduceOp.SUM: jax.lax.psum,
+        ReduceOp.MAX: jax.lax.pmax,
+        ReduceOp.MIN: jax.lax.pmin,
+        ReduceOp.AVG: jax.lax.pmean,
+    }[op]
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    tensor = _t(tensor)
+    axes = _bound_axes(group)
+    if axes:
+        red = _reduce_fn(op)
+        out = apply(lambda a: red(a, axes), tensor, name="all_reduce")
+        tensor.set_value(out)
+        tensor._node, tensor._out_idx = out._node, out._out_idx
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
+    # eager single-controller: value is already global
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
+    # functional form: all_gather(tensor, group=...) -> Tensor
+    if tensor is None or not isinstance(tensor_list, list):
+        t = _t(tensor_list if tensor is None else tensor)
+        axes = _bound_axes(group)
+        if axes:
+            return apply(
+                lambda a: jax.lax.all_gather(a, axes, axis=axis, tiled=True), t, name="all_gather"
+            )
+        return t
+    t = _t(tensor)
+    axes = _bound_axes(group)
+    if axes:
+        gathered = apply(lambda a: jax.lax.all_gather(a, axes, axis=0, tiled=False), t, name="all_gather")
+        n = gathered.shape[0]
+        for i in range(n):
+            tensor_list.append(gathered[i])
+    else:
+        n = group.nranks if group is not None else max(_env.get_world_size(), 1)
+        for _ in range(n):
+            tensor_list.append(t)
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    n = group.nranks if group is not None else max(_env.get_world_size(), 1)
+    object_list.extend([obj] * n)
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    tensor = _t(tensor)
+    src = tensor_or_tensor_list
+    axes = _bound_axes(group)
+    if isinstance(src, (list, tuple)):
+        from ...tensor import manipulation
+
+        src = manipulation.concat([_t(s) for s in src], axis=0)
+    else:
+        src = _t(src)
+    if axes:
+        out = apply(
+            lambda a: jax.lax.psum_scatter(a, axes, scatter_dimension=0, tiled=True), src, name="reduce_scatter"
+        )
+        tensor.set_value(out)
+        tensor._node, tensor._out_idx = out._node, out._out_idx
+        tensor.stop_gradient = out.stop_gradient
+        return tensor
+    tensor.set_value(src)
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    tensor = _t(tensor)
+    axes = _bound_axes(group)
+    if axes:
+        # select src's shard and broadcast: gather then index (XLA folds this)
+        out = apply(
+            lambda a: jax.lax.all_gather(a, axes, axis=0, tiled=False)[src], tensor, name="broadcast"
+        )
+        tensor.set_value(out)
+        tensor._node, tensor._out_idx = out._node, out._out_idx
+        return tensor
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    tensor = _t(tensor)
+    axes = _bound_axes(group)
+    if axes and tensor_list:
+        from ...tensor import manipulation
+
+        stacked = manipulation.stack([_t(x) for x in tensor_list], axis=0)
+        idx = jax.lax.axis_index(axes[0]) if len(axes) == 1 else jax.lax.axis_index(axes)
+        out = apply(lambda a: jax.lax.dynamic_index_in_dim(a, idx, 0, keepdims=False), stacked)
+        tensor.set_value(out)
+        return tensor
+    if tensor_list:
+        tensor.set_value(_t(tensor_list[_env.get_rank()]))
+    return tensor
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    if gather_list is None:
+        gather_list = []
+    return all_gather(gather_list, tensor, group, sync_op)
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
+    # functional single-tensor form: all_to_all(tensor, group=...) -> Tensor
+    if in_tensor_list is None or not isinstance(out_tensor_list, list):
+        t = _t(out_tensor_list if in_tensor_list is None else in_tensor_list)
+        axes = _bound_axes(group)
+        if axes:
+            ax = axes[0]
+            return apply(
+                lambda a: jax.lax.all_to_all(a, ax, split_axis=0, concat_axis=0, tiled=True),
+                t,
+                name="all_to_all",
+            )
+        return t
+    axes = _bound_axes(group)
+    from ...tensor import manipulation
+
+    stacked = manipulation.stack([_t(x) for x in in_tensor_list], axis=0)
+    if axes:
+        ax = axes[0]
+        out = apply(
+            lambda a: jax.lax.all_to_all(a, ax, split_axis=0, concat_axis=0, tiled=False),
+            stacked,
+            name="all_to_all",
+        )
+        for i in range(out.shape[0]):
+            out_tensor_list.append(out[i])
+    else:
+        out_tensor_list.extend([_t(x) for x in in_tensor_list])
+    return out_tensor_list
+
+
+alltoall = all_to_all
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
+    t = _t(in_tensor)
+    axes = _bound_axes(group)
+    if axes:
+        out = apply(
+            lambda a: jax.lax.all_to_all(a, axes[0], split_axis=0, concat_axis=0, tiled=True), t
+        )
+        out_tensor.set_value(out)
+        return out_tensor
+    out_tensor.set_value(t)
+    return out_tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """P2P send — on TPU this is a collective-permute (reference: send_v2 op).
+    Real p2p pairs are expressed by the PP runtime via ppermute; an isolated
+    eager send is a no-op in the single-controller model."""
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    return tensor
+
+
+def isend(tensor, dst=0, group=None):
+    return _Task()
+
+
+def irecv(tensor, src=0, group=None):
+    return _Task()
+
+
+class _Task:
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op, self.tensor, self.peer, self.group = op, tensor, peer, group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """reference: communication/batch_isend_irecv.py — the PP activation
+    exchange. Under shard_map, expressed as one ppermute over the pp axis by
+    the pipeline runtime (see fleet/meta_parallel/pipeline_parallel.py)."""
+    return [_Task() for _ in p2p_op_list]
+
+
+def ppermute(tensor, axis_name, perm):
+    """collective_permute over a mesh axis — the ICI-native p2p primitive."""
+    return apply(lambda a: jax.lax.ppermute(a, axis_name, perm), _t(tensor), name="ppermute")
+
+
+def shift(tensor, axis_name, offset=1):
+    """Ring shift: rank i -> rank (i+offset) % n. Core of ring attention."""
+    from ..mesh import axis_size as _mesh_axis_size
+
+    t = _t(tensor)
+    n = _mesh_axis_size(axis_name)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return apply(lambda a: jax.lax.ppermute(a, axis_name, perm), t, name="ring_shift")
+
+
+def barrier(group=None):
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    pass

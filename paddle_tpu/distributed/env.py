@@ -1,0 +1,63 @@
+"""Process environment (reference env contract: PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS, PADDLE_MASTER — see
+python/paddle/distributed/parallel.py init_parallel_env and
+launch/context/__init__.py).
+
+On TPU, one process per HOST drives all local chips (single-controller JAX);
+the launcher keeps the same env names so reference-shaped scripts run.
+"""
+import os
+
+import jax
+
+_initialized = False
+
+
+def get_rank():
+    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
+
+
+def get_world_size():
+    ws = os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE"))
+    if ws is not None:
+        return int(ws)
+    return 1
+
+
+def get_local_rank():
+    return int(os.environ.get("PADDLE_LOCAL_RANK", os.environ.get("LOCAL_RANK", "0")))
+
+
+def get_master_endpoint():
+    ep = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ENDPOINT")
+    if ep:
+        return ep
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    if eps:
+        return eps.split(",")[0]
+    addr = os.environ.get("MASTER_ADDR")
+    port = os.environ.get("MASTER_PORT")
+    if addr and port:
+        return f"{addr}:{port}"
+    return None
+
+
+def is_initialized():
+    return _initialized
+
+
+def init_distributed(timeout_s=900):
+    """jax.distributed.initialize over the Paddle env contract (reference
+    analogue: TCPStore rendezvous + ncclCommInitRank, SURVEY.md §3.2)."""
+    global _initialized
+    if _initialized:
+        return
+    world = get_world_size()
+    if world > 1 and os.environ.get("PADDLE_TPU_SKIP_JAX_DIST") != "1":
+        coordinator = get_master_endpoint()
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=world,
+            process_id=get_rank(),
+        )
+    _initialized = True

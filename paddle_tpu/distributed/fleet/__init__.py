@@ -1,0 +1,28 @@
+"""paddle.distributed.fleet parity (reference: python/paddle/distributed/fleet/).
+
+Module-level functions delegate to the singleton Fleet, as in the reference.
+"""
+from . import meta_parallel
+from .distributed_strategy import DistributedStrategy
+from .fleet import Fleet, PaddleCloudRoleMaker, UserDefinedRoleMaker, fleet_singleton as _f
+from .hybrid_optimizer import HybridParallelClipGrad, HybridParallelOptimizer
+from .topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    ParallelMode,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from ...framework.random import get_rng_state_tracker
+
+init = _f.init
+distributed_model = _f.distributed_model
+distributed_optimizer = _f.distributed_optimizer
+get_hybrid_communicate_group_fn = _f.get_hybrid_communicate_group
+worker_num = _f.worker_num
+is_first_worker = _f.is_first_worker
+barrier_worker = _f.barrier_worker
+
+
+def worker_index():
+    return _f.worker_index

@@ -1,0 +1,65 @@
+"""DistributedStrategy (reference: fleet/base/distributed_strategy.py, backed
+by distributed_strategy.proto). Typed dataclass config instead of protobuf
+(SURVEY.md §5 config consolidation)."""
+import dataclasses
+from typing import Any, Dict
+
+
+@dataclasses.dataclass
+class HybridConfigs:
+    dp_degree: int = -1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1
+    order: tuple = ("dp", "pp", "sharding", "sep", "mp")
+    mp_configs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    pp_configs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0,
+            "use_pure_fp16": False,
+            "use_bf16": True,
+            "custom_white_list": [],
+            "custom_black_list": [],
+        }
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": [], "enable_offload": False}
+        self.sharding = False
+        self.sharding_configs = {"sharding_degree": 1, "stage": 1, "comm_overlap": True}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1, "schedule_mode": "1F1B"}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.hybrid_configs = {
+            "dp_degree": -1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.dgc = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+
+    def __setattr__(self, key, value):
+        # hybrid_configs may be set as a partial dict (paddle style)
+        if key == "hybrid_configs" and hasattr(self, "hybrid_configs") and isinstance(value, dict):
+            merged = dict(self.__dict__.get("hybrid_configs", {}))
+            merged.update(value)
+            object.__setattr__(self, key, merged)
+        else:
+            object.__setattr__(self, key, value)
+
+    def to_dict(self):
+        return dict(self.__dict__)

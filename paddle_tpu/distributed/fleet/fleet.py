@@ -1,0 +1,105 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py).
+
+fleet.init(strategy) builds the hybrid mesh; distributed_model picks the
+wrapper by hybrid config (same dispatch as reference Fleet.distributed_model);
+distributed_optimizer wraps with HybridParallelOptimizer.
+"""
+import jax
+
+from .. import env as _env
+from ..parallel import DataParallel
+from .distributed_strategy import DistributedStrategy
+from .hybrid_optimizer import HybridParallelOptimizer
+from .meta_parallel import PipelineParallel, ShardingParallel, TensorParallel
+from .meta_parallel.pp_layers import PipelineLayer
+from .topology import HybridCommunicateGroup, set_hybrid_communicate_group
+
+
+class RoleMakerBase:
+    def is_first_worker(self):
+        return _env.get_rank() == 0
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, is_collective=False, init_gloo=False, **kwargs):
+        self._is_collective = is_collective
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        n_dev = len(jax.devices())
+        mp = hc.get("mp_degree", 1)
+        pp = hc.get("pp_degree", 1)
+        sharding = hc.get("sharding_degree", 1)
+        sep = hc.get("sep_degree", 1)
+        dp = hc.get("dp_degree", -1)
+        if dp == -1:
+            dp = max(n_dev // (mp * pp * sharding * sep), 1)
+        _env.init_distributed()
+        self._hcg = HybridCommunicateGroup(dp=dp, mp=mp, pp=pp, sharding=sharding, sep=sep)
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def worker_index(self):
+        return _env.get_rank()
+
+    def worker_num(self):
+        return max(_env.get_world_size(), 1)
+
+    def is_first_worker(self):
+        return _env.get_rank() == 0
+
+    def barrier_worker(self):
+        from ..communication.ops import barrier
+
+        barrier()
+
+    def distributed_model(self, model):
+        """Dispatch mirrors reference Fleet.distributed_model."""
+        if self._hcg is None:
+            self.init()
+        hcg = self._hcg
+        if hcg.get_pipe_parallel_world_size() > 1:
+            if isinstance(model, PipelineLayer):
+                return PipelineParallel(model, hcg, self._strategy)
+            raise TypeError("pp_degree > 1 requires a PipelineLayer model")
+        if hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, hcg, self._strategy)
+        if hcg.get_sharding_parallel_world_size() > 1:
+            return ShardingParallel(model, hcg, self._strategy)
+        if hcg.get_data_parallel_world_size() > 1:
+            return DataParallel(model)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if self._hcg is None:
+            self.init()
+        sharding_cfg = (self._strategy.sharding_configs if self._strategy else {}) or {}
+        stage = sharding_cfg.get("stage", 1)
+        return HybridParallelOptimizer(optimizer, self._hcg, self._strategy, sharding_stage=stage)
+
+    def state_dict(self):
+        return {}
+
+    def stop_worker(self):
+        pass
+
+
+fleet_singleton = Fleet()

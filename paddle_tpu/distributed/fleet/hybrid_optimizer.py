@@ -1,0 +1,64 @@
+"""HybridParallelOptimizer (reference:
+fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py).
+
+- HybridParallelClipGrad: the global grad norm must reduce over mp/pp/
+  sharding axes. Under GSPMD the per-param grads are mesh-global logical
+  arrays, so the plain sum IS the hybrid-global norm — one jnp reduction
+  replaces the reference's per-group allreduce choreography.
+- Sharding stage 1 (DygraphShardingOptimizer): optimizer slots are sharded
+  on the "sharding" axis via NamedSharding when the compiled step partitions
+  state (see fleet/sharding.py).
+"""
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...optimizer.lr import LRScheduler
+
+
+class HybridParallelClipGrad:
+    def __init__(self, clip, hcg):
+        self._clip = clip
+        self._hcg = hcg
+
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None, sharding_stage=1):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        self.sharding_stage = sharding_stage
+        if optimizer._grad_clip is not None and hcg is not None:
+            optimizer._grad_clip = HybridParallelClipGrad(optimizer._grad_clip, hcg)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    @property
+    def inner_opt(self):
+        return self._inner_opt
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        return self._inner_opt.minimize(loss)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, v):
+        return self._inner_opt.set_lr(v)

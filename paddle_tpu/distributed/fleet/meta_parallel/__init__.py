@@ -1,0 +1,9 @@
+from .mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .parallel_wrappers import PipelineParallel, ShardingParallel, TensorParallel
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from ....framework.random import get_rng_state_tracker

@@ -1,0 +1,104 @@
+"""Tensor-parallel layers (reference:
+fleet/meta_parallel/parallel_layers/mp_layers.py — VocabParallelEmbedding,
+ColumnParallelLinear, RowParallelLinear; autograd bridges in
+fleet/layers/mpu/mp_ops.py `_c_identity/_c_allreduce/_c_split`).
+
+TPU-native: each layer holds the FULL logical weight annotated with a
+PartitionSpec on the "mp" axis. Under pjit/GSPMD the matmul partitions
+automatically and XLA inserts the same all-reduces Megatron inserts by hand:
+
+  ColumnParallelLinear: W spec (None, "mp")  → activation sharded on "mp"
+  RowParallelLinear:    W spec ("mp", None)  → psum over "mp" after matmul
+  VocabParallelEmbedding: table spec ("mp", None) → gather + psum
+
+This preserves the reference API (gather_output / input_is_parallel flags
+kept, they become no-ops under GSPMD's global-view arrays) while the actual
+partitioning decision lives in one place: the weight PartitionSpec.
+"""
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+from ....framework.core import Tensor, apply
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer.layers import Layer
+from ...mesh import axis_size
+
+
+class VocabParallelEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings, self._embedding_dim = num_embeddings, embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr, default_initializer=I.XavierNormal()
+        )
+        self.weight.partition_spec = PartitionSpec("mp", None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None, gather_output=True,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr, default_initializer=I.XavierNormal()
+        )
+        self.weight.partition_spec = PartitionSpec(None, "mp")
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.partition_spec = PartitionSpec("mp")
+            self.bias.is_distributed = True
+        else:
+            self.bias = None
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class RowParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr, default_initializer=I.XavierNormal()
+        )
+        self.weight.partition_spec = PartitionSpec("mp", None)
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.partition_spec = PartitionSpec(None)
+        else:
+            self.bias = None
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """TP-aware cross entropy (reference: mp_ops.py _c_softmax_with_cross_entropy
+    — avoids materializing full-vocab softmax by reducing over the mp axis).
+    Under GSPMD, cross_entropy on an "mp"-sharded logits array already keeps
+    the reduction sharded; this class is the API anchor."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none", ignore_index=self.ignore_index)
+
+
+def parallel_matmul(x, weight, transpose_y=False, tensor_parallel_output=True):
+    from ....tensor import linalg
+
+    return linalg.matmul(x, weight, transpose_y=transpose_y)

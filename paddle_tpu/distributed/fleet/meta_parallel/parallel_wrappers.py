@@ -1,0 +1,99 @@
+"""Model wrappers picked by fleet.distributed_model (reference:
+fleet/meta_parallel/{tensor_parallel,pipeline_parallel,sharding_parallel}.py).
+
+Under the single-controller TPU model these wrappers do not rewrite the
+model; they record the parallel mode and expose the reference train APIs.
+The actual partitioning happens when the step is compiled (DistributedTrainStep
+reads weight PartitionSpecs + the hybrid topology).
+"""
+import numpy as np
+
+from ....framework.core import Tensor
+from ....nn.layer.layers import Layer
+from ....tensor import creation, manipulation
+from .pp_layers import PipelineLayer
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+
+class TensorParallel(MetaParallelBase):
+    pass
+
+
+class ShardingParallel(MetaParallelBase):
+    pass
+
+
+class PipelineParallel(MetaParallelBase):
+    """PP runtime (reference: meta_parallel/pipeline_parallel.py —
+    forward_backward_pipeline with 1F1B).
+
+    train_batch(data, optimizer, lr_scheduler) keeps the reference contract.
+    Execution: micro-batches are processed through all stages inside one
+    compiled step; on a pp>1 mesh the stage weights live on their pp
+    coordinate and activations move by collective-permute (XLA schedules the
+    1F1B-equivalent overlap — see models/llama.py pipeline path for the
+    scan-over-stages formulation)."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        cfg = (strategy.pipeline_configs if strategy is not None else {}) or {}
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self.micro_batch_size = cfg.get("micro_batch_size", 1)
+        self._train_step = None
+        self._loss_fn = layers._loss_fn
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        inputs, labels = data
+        from ....jit_api import TrainStep
+
+        if self._train_step is None:
+            loss_fn = self._loss_fn or (lambda out, lab: out.mean())
+
+            class _PPModel(Layer):
+                def __init__(inner, pipe):
+                    super().__init__()
+                    inner.pipe = pipe
+
+                def forward(inner, x):
+                    return inner.pipe(x)
+
+            self._pp_model = _PPModel(self._layers)
+            self._train_step = TrainStep(self._pp_model, loss_fn, optimizer, n_labels=1, scaler=scaler)
+
+        # micro-batch split + accumulate (reference: _load_micro_batch); the
+        # compiled step consumes the full batch, grads average over micro dim
+        loss = self._train_step(inputs, labels)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        out = self._layers(inputs)
+        if compute_loss and self._loss_fn is not None:
+            return self._loss_fn(out, labels)
+        return out

@@ -1,0 +1,145 @@
+"""Pipeline layer description (reference:
+fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc, SharedLayerDesc,
+PipelineLayer).
+
+Structure is kept 1:1 (desc list → segmentation → stages, shared/tied
+embeddings). Execution differs: stages run inside one XLA program; the PP
+runtime (pipeline_parallel.py) schedules micro-batches over the "pp" mesh
+axis with collective-permute transfers instead of NCCL p2p.
+"""
+import numpy as np
+
+from ....nn.layer.container import LayerList
+from ....nn.layer.layers import Layer
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayerChunk(Layer):
+    def __init__(self):
+        super().__init__()
+        self.run_function = []
+
+    def append(self, sublayer):
+        if isinstance(sublayer, Layer):
+            self.add_sublayer(str(len(self.run_function)), sublayer)
+        self.run_function.append(sublayer)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError("chunks are run by the pipeline engine")
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None, seg_method="uniform",
+                 recompute_interval=0, recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        self._seg_method = seg_method
+        self._recompute_interval = recompute_interval
+        self._num_virtual_stages = num_virtual_pipeline_stages or 1
+        self.shared_layers = {}
+        self._shared_keys = {}
+
+        # build ALL layers (single-controller holds the global model; GSPMD /
+        # the pipeline engine places per-stage params on the pp mesh axis)
+        self.run_function = []
+        self._fns = LayerList()
+        for i, d in enumerate(self._layers_desc):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self.shared_layers:
+                    self.shared_layers[d.layer_name] = d.build_layer()
+                    self.add_sublayer(f"shared_{d.layer_name}", self.shared_layers[d.layer_name])
+                layer = self.shared_layers[d.layer_name]
+                fwd = d.forward_func
+                if fwd is not None:
+                    self.run_function.append(_SharedForward(layer, fwd))
+                else:
+                    self.run_function.append(layer)
+                self._shared_keys[i] = d.layer_name
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+                self._fns.append(layer)
+                self.run_function.append(layer)
+            elif isinstance(d, Layer):
+                self._fns.append(d)
+                self.run_function.append(d)
+            elif callable(d):
+                self.run_function.append(d)
+            else:
+                raise TypeError(f"bad pipeline desc: {d}")
+
+        self._segment()
+
+    def _segment(self):
+        n = len(self.run_function)
+        stages = self._num_stages * self._num_virtual_stages
+        if self._seg_method == "uniform" or not isinstance(self._seg_method, str) or not self._seg_method.startswith("layer:"):
+            bounds = np.linspace(0, n, stages + 1).astype(int).tolist()
+        else:
+            # "layer:TransformerBlock" — segment by counting named layer class
+            cls_name = self._seg_method.split(":")[1]
+            idxs = [i for i, f in enumerate(self.run_function) if type(f).__name__ == cls_name]
+            per = max(len(idxs) // stages, 1)
+            bounds = [0]
+            for s in range(1, stages):
+                bounds.append(idxs[min(s * per, len(idxs) - 1)])
+            bounds.append(n)
+        self.segment_parts = bounds
+
+    def get_stage_from_index(self, layer_idx):
+        for s in range(len(self.segment_parts) - 1):
+            if self.segment_parts[s] <= layer_idx < self.segment_parts[s + 1]:
+                return s % self._num_stages
+        return self._num_stages - 1
+
+    def get_num_virtual_stages(self):
+        return self._num_virtual_stages
+
+    def stage_functions(self, stage):
+        """Callables for a stage (virtual stages interleaved)."""
+        fns = []
+        for v in range(self._num_virtual_stages):
+            chunk = v * self._num_stages + stage
+            lo, hi = self.segment_parts[chunk], self.segment_parts[chunk + 1]
+            fns.append(self.run_function[lo:hi])
+        return fns if self._num_virtual_stages > 1 else fns[0]
+
+    def forward(self, input, chunk_id=None):
+        x = input
+        if chunk_id is not None:
+            lo, hi = self.segment_parts[chunk_id], self.segment_parts[chunk_id + 1]
+            fns = self.run_function[lo:hi]
+        else:
+            fns = self.run_function
+        for fn in fns:
+            x = fn(x)
+        return x
+
+
+class _SharedForward:
+    def __init__(self, layer, fwd):
+        self.layer = layer
+        self.fwd = fwd
+
+    def __call__(self, x):
+        return self.fwd(self.layer, x)

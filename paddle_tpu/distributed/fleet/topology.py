@@ -1,0 +1,185 @@
+"""Hybrid topology (reference: python/paddle/distributed/fleet/base/topology.py
+— CommunicateTopology + HybridCommunicateGroup).
+
+The reference builds an nd process grid over axes [dp, pp, sharding, sep, mp]
+and derives per-axis NCCL groups. Here the grid IS a jax Mesh with named
+axes; "groups" are Group objects naming mesh axes (see communication/group).
+"""
+import itertools
+
+import numpy as np
+
+from ..communication.group import Group
+from ..mesh import AXES, build_mesh, set_mesh
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"), dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = itertools.product(*[range(d) for d in dims])
+        self._world = int(np.prod(dims))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coords = [kwargs[n] for n in self._parallel_names]
+        rank = 0
+        for c, d in zip(coords, self._dims):
+            rank = rank * d + c
+        return rank
+
+    def get_coord(self, rank):
+        coords = []
+        for d in reversed(self._dims):
+            coords.append(rank % d)
+            rank //= d
+        return tuple(reversed(coords))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return [r for r in range(self._world) if self.get_coord(r)[axis] == index]
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        other_dims = [d for i, d in enumerate(self._dims) if i != axis]
+        comm_list = []
+        for other in itertools.product(*[range(d) for d in other_dims]):
+            ranks = []
+            for i in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, i)
+                ranks.append(self.get_rank(**dict(zip(self._parallel_names, coord))))
+            comm_list.append(ranks)
+        return comm_list
+
+
+# mapping: paddle topology name -> mesh axis name
+_NAME2AXIS = {"data": "dp", "pipe": "pp", "sharding": "sharding", "sep": "sep", "model": "mp"}
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology=None, dp=1, mp=1, pp=1, sharding=1, sep=1):
+        if topology is not None:
+            dims = {n: topology.get_dim(n) for n in topology.get_hybrid_group_names()}
+            dp = dims.get("data", 1)
+            pp = dims.get("pipe", 1)
+            sharding = dims.get("sharding", 1)
+            sep = dims.get("sep", 1)
+            mp = dims.get("model", 1)
+        self._dp_degree, self._mp_degree, self._pp_degree = dp, mp, pp
+        self._sharding_degree, self._sep_degree = sharding, sep
+        self._topo = CommunicateTopology(dims=(dp, pp, sharding, sep, mp))
+        mesh = build_mesh(dp=dp, mp=mp, pp=pp, sharding=sharding, sep=sep)
+        set_mesh(mesh)
+        self.mesh = mesh
+        self.global_rank = 0
+
+    # degrees ---------------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # ranks (single-controller: coordinate of the current process = 0; inside
+    # shard_map, per-position ranks come from lax.axis_index)
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_rank(self):
+        return 0
+
+    # groups ----------------------------------------------------------------
+    def get_data_parallel_group(self):
+        return Group("dp")
+
+    def get_model_parallel_group(self):
+        return Group("mp")
+
+    def get_pipe_parallel_group(self):
+        return Group("pp")
+
+    def get_sharding_parallel_group(self):
+        return Group("sharding")
+
+    def get_sep_parallel_group(self):
+        return Group("sep")
+
+    def get_dp_sep_parallel_group(self):
+        return Group(("dp", "sep"))
+
+    def get_pipe_parallel_group_src_rank(self):
+        return 0
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def get_check_parallel_group(self, sharding=False):
+        return Group(("pp", "sharding", "mp") if sharding else ("pp", "mp"))
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return self._topo.get_rank(data=0, pipe=stage_id, sharding=0, sep=0, model=0)
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._mp_degree == 1 and self._pp_degree == 1 and self._sharding_degree == 1 and self._dp_degree > 1:
+            return ParallelMode.DATA_PARALLEL
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._mp_degree > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+_hcg = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group():
+    return _hcg

@@ -1,0 +1,96 @@
+"""The global device mesh — the TPU-native replacement for the reference's
+process groups + NCCL comm rings (paddle/fluid/distributed/collective/,
+fleet/base/topology.py HybridCommunicateGroup).
+
+One named `jax.sharding.Mesh` carries every parallelism axis:
+
+    ("dp", "pp", "sharding", "sep", "mp")
+
+- reference `get_data_parallel_group()`   → mesh axis "dp" (+ "sharding" for
+  gradient all-reduce, matching HybridCommunicateGroup semantics)
+- reference `get_model_parallel_group()`  → axis "mp"
+- reference `get_pipe_parallel_group()`   → axis "pp"
+- sep (Ulysses segment parallel)          → axis "sep"
+
+Collectives ride ICI within a slice; multi-slice/DCN meshes come from
+jax's device order (slices are contiguous in jax.devices()).
+"""
+import os
+from contextlib import contextmanager
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+_global_mesh = None
+
+
+def build_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, devices=None):
+    """Build the hybrid mesh. Axis ORDER matters for ICI locality: mp is the
+    fastest-varying axis so tensor-parallel collectives ride nearest-neighbor
+    ICI links (same principle as the reference's ring ordering of NCCL comms).
+    """
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    need = dp * mp * pp * sharding * sep
+    if devices.size < need:
+        raise ValueError(f"need {need} devices, have {devices.size}")
+    devices = devices[:need].reshape(dp, pp, sharding, sep, mp)
+    return Mesh(devices, AXES)
+
+
+def set_mesh(mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh():
+    global _global_mesh
+    if _global_mesh is None:
+        _global_mesh = build_mesh(dp=len(jax.devices()))
+    return _global_mesh
+
+
+def has_mesh():
+    return _global_mesh is not None
+
+
+def reset_mesh():
+    global _global_mesh
+    _global_mesh = None
+
+
+@contextmanager
+def mesh_guard(mesh):
+    global _global_mesh
+    prev = _global_mesh
+    _global_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _global_mesh = prev
+
+
+def axis_size(name):
+    mesh = get_mesh()
+    return mesh.shape[name] if name in mesh.axis_names else 1
+
+
+def sharding_for(spec):
+    """PartitionSpec -> NamedSharding on the global mesh."""
+    return NamedSharding(get_mesh(), spec if isinstance(spec, PartitionSpec) else PartitionSpec(*spec))
+
+
+def replicated():
+    return NamedSharding(get_mesh(), PartitionSpec())
+
+
+def data_sharding(batch_axes=("dp", "sharding")):
+    """Input batch sharding: batch dim split over dp×sharding (reference: DP
+    group × sharding group both consume distinct data shards)."""
+    mesh = get_mesh()
+    axes = tuple(a for a in batch_axes if a in mesh.axis_names and mesh.shape[a] > 1)
+    if not axes:
+        return replicated()
+    return NamedSharding(mesh, PartitionSpec(axes))

@@ -1,0 +1,143 @@
+"""init_parallel_env + DataParallel (reference:
+python/paddle/distributed/parallel.py).
+
+DataParallel on TPU: the wrapper marks the model for data-parallel execution.
+Under a compiled step with the batch sharded on the "dp" axis, XLA emits the
+gradient all-reduce automatically with latency-hiding overlap — the entire
+EagerReducer machinery (bucketing, comm_buffer_size_MB, overlap with
+backward; reference reducer.cc) is subsumed by the XLA scheduler, which is
+the designed TPU equivalent (SURVEY.md §2.3 DP row).
+"""
+import jax
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+from . import env as _env
+from .communication.group import _world_group
+from .mesh import build_mesh, get_mesh, has_mesh, set_mesh
+
+
+def init_parallel_env():
+    """reference: init_parallel_env — env contract + store + process group.
+    Here: jax.distributed.initialize (+ default dp mesh over all devices)."""
+    _env.init_distributed()
+    if not has_mesh():
+        set_mesh(build_mesh(dp=len(jax.devices())))
+    return _world_group()
+
+
+def get_rank(group=None):
+    return _env.get_rank()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return max(_env.get_world_size(), jax.process_count())
+
+
+class DataParallel(Layer):
+    def __init__(
+        self,
+        layers,
+        strategy=None,
+        comm_buffer_size=25,
+        last_comm_buffer_size=1,
+        find_unused_parameters=False,
+        group=None,
+    ):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+        # comm_buffer_size: accepted for compat; XLA handles comm scheduling.
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        """Eager-mode grad sync: under shard_map-bound dp axis, psum grads
+        (reference: EagerReducer fused allreduce)."""
+        from .communication.ops import ReduceOp, _bound_axes, all_reduce
+
+        axes = _bound_axes(self.group)
+        if not axes:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None and not getattr(p, "no_sync", False):
+                all_reduce(p.grad, op=ReduceOp.AVG, group=self.group)
+
+    def no_sync(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+class ParallelEnv:
+    """reference: python/paddle/distributed/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return _env.get_rank()
+
+    @property
+    def world_size(self):
+        return max(_env.get_world_size(), 1)
+
+    @property
+    def local_rank(self):
+        return _env.get_local_rank()
+
+    @property
+    def dev_id(self):
+        return _env.get_local_rank()
+
+    @property
+    def current_endpoint(self):
+        eps = self.trainer_endpoints
+        r = self.rank
+        return eps[r] if r < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        import os
+
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: paddle.distributed.spawn. On TPU the unit of spawn is a
+    HOST process (single-controller drives all local chips), so nprocs>1 in
+    one host is emulation only — delegate to the launcher for real jobs."""
+    import multiprocessing as mp
+
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return None
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_worker, args=(func, args, rank, nprocs), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs
+
+
+def _spawn_worker(func, args, rank, nprocs):
+    import os
+
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    func(*args)

@@ -1,0 +1,54 @@
+"""paddle.fft parity over jnp.fft (reference: python/paddle/fft.py)."""
+import jax.numpy as jnp
+
+from .framework.core import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _mk(fn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(lambda a: fn(a, n=n, axis=axis, norm=norm), _t(x))
+
+    return op
+
+
+def _mk_nd(fn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply(lambda a: fn(a, s=s, axes=axes, norm=norm), _t(x))
+
+    return op
+
+
+fft = _mk(jnp.fft.fft)
+ifft = _mk(jnp.fft.ifft)
+rfft = _mk(jnp.fft.rfft)
+irfft = _mk(jnp.fft.irfft)
+hfft = _mk(jnp.fft.hfft)
+ihfft = _mk(jnp.fft.ihfft)
+fft2 = _mk_nd(jnp.fft.fft2)
+ifft2 = _mk_nd(jnp.fft.ifft2)
+rfft2 = _mk_nd(jnp.fft.rfft2)
+irfft2 = _mk_nd(jnp.fft.irfft2)
+fftn = _mk_nd(jnp.fft.fftn)
+ifftn = _mk_nd(jnp.fft.ifftn)
+rfftn = _mk_nd(jnp.fft.rfftn)
+irfftn = _mk_nd(jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), _t(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), _t(x))

@@ -1,0 +1,14 @@
+from . import dtype, random
+from .core import (
+    GradNode,
+    Parameter,
+    Tensor,
+    apply,
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    no_grad_guard,
+    to_tensor,
+)
+from .dtype import convert_dtype, get_default_dtype, set_default_dtype
+from .random import get_rng_state, seed, set_rng_state

@@ -1,0 +1,487 @@
+"""Dygraph core: Tensor + tape autograd over jax.vjp.
+
+Reference parity (architecture, not a port):
+- paddle/fluid/eager/ (GradNodeBase, RunBackward in eager/backward.cc): the
+  reference records a GradNode per op and runs a reverse topological queue
+  with pending-count scheduling. We do the same, but each node's backward is
+  the vjp closure jax.vjp returned at forward time.
+- The decisive TPU divergence (SURVEY.md §3.1): this entire tape is built
+  from traceable jax operations, so a whole train step — forward, backward,
+  optimizer — wrapped in `paddle_tpu.jit` becomes ONE XLA program. Eager
+  Python dispatch cost exists only in uncompiled (debug) mode.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+
+_tls = threading.local()
+
+
+def _grad_enabled():
+    return getattr(_tls, "grad_enabled", True)
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    prev = _grad_enabled()
+    _tls.grad_enabled = False
+    try:
+        yield
+    finally:
+        _tls.grad_enabled = prev
+
+
+class no_grad:
+    """paddle.no_grad parity: usable as context manager or decorator."""
+
+    def __enter__(self):
+        self._cm = no_grad_guard()
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+    def __call__(self, fn):
+        def wrapper(*a, **k):
+            with no_grad_guard():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+def enable_grad():
+    _tls.grad_enabled = True
+
+
+def is_grad_enabled():
+    return _grad_enabled()
+
+
+class GradNode:
+    """One recorded op on the tape (reference: eager/grad_node_info.h
+    GradNodeBase). Holds the vjp closure and edges to input tensors."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "n_outputs", "name", "cotangents", "pending")
+
+    def __init__(self, vjp_fn, inputs, out_avals, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list of (Tensor, is_diff)
+        self.out_avals = out_avals  # [(shape, dtype)] per output
+        self.n_outputs = len(out_avals)
+        self.name = name
+        self.cotangents = None
+        self.pending = 0
+
+
+def _is_inexact(d):
+    return np.issubdtype(np.dtype(d), np.inexact) or np.dtype(d) == jnp.bfloat16
+
+
+class Tensor:
+    """Imperative tensor over a jax.Array (reference: phi::DenseTensor +
+    the eager Tensor in paddle/fluid/pybind/eager.cc).
+
+    Registered as a jax pytree, so Tensors flow through jax.jit / pjit /
+    shard_map unchanged.
+    """
+
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx", "_hooks", "name", "__weakref__")
+    __array_priority__ = 100  # win over numpy operator dispatch
+
+    def __init__(self, data, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        self._hooks = []
+        self.name = name
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def T(self):
+        from ..tensor import manipulation
+
+        return manipulation.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def data(self):
+        return self
+
+    @data.setter
+    def data(self, value):
+        self._data = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+
+    @property
+    def place(self):
+        try:
+            return str(next(iter(self._data.devices())))
+        except Exception:
+            return "traced"
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return self.size
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            body = repr(self._data)
+        except Exception:
+            body = f"<traced {self._data.aval}>"
+        return f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}, stop_gradient={sg},\n       {body})"
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return format(repr(self), spec)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    __hash__ = object.__hash__
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        """Reverse-mode pass (reference: egr::Backward in eager/backward.cc):
+        pending-count scheduled reverse topological walk over GradNodes."""
+        if self.stop_gradient:
+            raise RuntimeError("backward() on a tensor with stop_gradient=True")
+        if grad_tensor is None:
+            if not _is_inexact(self.dtype):
+                raise RuntimeError("backward() requires a floating tensor")
+            seed = jnp.ones_like(self._data)
+        else:
+            seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+        if self._node is None:
+            self._accum_grad(seed)
+            return
+
+        # Pass 1: discover reachable nodes and per-node consumer counts.
+        nodes = []
+        seen = set()
+        stack = [self._node]
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            nodes.append(n)
+            n.cotangents = [None] * n.n_outputs
+            n.pending = 0
+            # Only traverse differentiable edges: a node reachable solely via
+            # non-diff edges never receives cotangents and must not inflate
+            # pending counts (it would deadlock diff-reachable ancestors).
+            for t, is_diff in n.inputs:
+                if t._node is not None and is_diff:
+                    stack.append(t._node)
+        for n in nodes:
+            for t, is_diff in n.inputs:
+                if t._node is not None and is_diff:
+                    t._node.pending += 1
+
+        # Seed the root.
+        root = self._node
+        root.cotangents[self._out_idx] = seed
+
+        ready = [n for n in nodes if n.pending == 0]
+        # Root must be processed first; pending counts guarantee ancestors of
+        # any ready node already ran, and the root has no consumers here.
+        while ready:
+            n = ready.pop()
+            cts = tuple(
+                c if c is not None else jnp.zeros(shape, dtype)
+                for c, (shape, dtype) in zip(n.cotangents, n.out_avals)
+            )
+            if n.vjp_fn is None:
+                raise RuntimeError(
+                    "the backward graph has been freed; call backward(retain_graph=True) "
+                    "to backprop through the same graph twice"
+                )
+            in_cts = n.vjp_fn(cts if n.n_outputs > 1 else cts[0])
+            if not retain_graph:
+                n.vjp_fn = None
+            ct_iter = iter(in_cts)
+            for t, is_diff in n.inputs:
+                if not is_diff:
+                    continue
+                ct = next(ct_iter)
+                if t._node is not None:
+                    m = t._node
+                    prev = m.cotangents[t._out_idx]
+                    m.cotangents[t._out_idx] = ct if prev is None else prev + ct
+                    m.pending -= 1
+                    if m.pending == 0:
+                        ready.append(m)
+                elif not t.stop_gradient:
+                    t._accum_grad(ct)
+            n.cotangents = None
+
+    def _accum_grad(self, ct):
+        for h in self._hooks:
+            out = h(Tensor(ct, stop_gradient=True))
+            if out is not None:
+                ct = out._data if isinstance(out, Tensor) else out
+        if self.grad is None:
+            self.grad = Tensor(ct, stop_gradient=True)
+        else:
+            self.grad = Tensor(self.grad._data + ct, stop_gradient=True)
+
+    def register_hook(self, hook):
+        self._hooks.append(hook)
+
+        class _Handle:
+            def remove(handle_self):
+                if hook in self._hooks:
+                    self._hooks.remove(hook)
+
+        return _Handle()
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        return apply(lambda x: x + 0, self, name="clone")
+
+    # -- conversion ---------------------------------------------------------
+    def astype(self, dt):
+        dt = dtypes.convert_dtype(dt)
+        return apply(lambda x: x.astype(dt), self, name="cast")
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in dtypes._NAME2DTYPE:
+                return self.astype(a)
+            if a in (np.float32, np.float16, jnp.bfloat16, np.float64):
+                return self.astype(a)
+        return self
+
+    def cpu(self):
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *_):
+        return self
+
+    # -- mutation -----------------------------------------------------------
+    def set_value(self, value):
+        v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        self._data = v.astype(self.dtype) if v.dtype != self.dtype else v
+        return self
+
+    def copy_(self, other, *_):
+        return self.set_value(other)
+
+    def fill_(self, v):
+        self._data = jnp.full_like(self._data, v)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def scale_(self, s):
+        self._data = self._data * s
+        return self
+
+    def __setitem__(self, idx, value):
+        idx = _index_data(idx)
+        v = value._data if isinstance(value, Tensor) else value
+        self._data = self._data.at[idx].set(v)
+
+    def __getitem__(self, idx):
+        idx = _index_data(idx)
+        return apply(lambda x: x[idx], self, name="getitem")
+
+
+def _index_data(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(i._data if isinstance(i, Tensor) else i for i in idx)
+    return idx
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: paddle.base.framework.Parameter —
+    stop_gradient defaults False, carries an optional trainable flag and a
+    distributed PartitionSpec hint used by the pjit paths)."""
+
+    __slots__ = ("trainable", "optimize_attr", "is_distributed", "partition_spec", "no_sync")
+
+    def __init__(self, data, trainable=True, name=None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.is_distributed = False
+        self.partition_spec = None
+        self.no_sync = False
+
+
+# -- pytree registration ----------------------------------------------------
+def _tensor_flatten(t):
+    return (t._data,), (type(t), t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    cls, sg, name = aux
+    t = cls.__new__(cls)
+    Tensor.__init__(t, children[0], stop_gradient=sg, name=name)
+    if cls is Parameter:
+        t.trainable = not sg
+        t.optimize_attr = {"learning_rate": 1.0}
+        t.is_distributed = False
+        t.partition_spec = None
+        t.no_sync = False
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+jax.tree_util.register_pytree_node(Parameter, _tensor_flatten, _tensor_unflatten)
+
+
+# -- the op recorder --------------------------------------------------------
+def apply(fn, *tensors, name="", n_outputs=None, **kw):
+    """Run `fn` on raw arrays; record a GradNode when grad is needed.
+
+    `fn` may return a single array or a tuple. Non-floating inputs are closed
+    over as constants (no float0 cotangent bookkeeping).
+    """
+    tensors = [t if isinstance(t, Tensor) else Tensor(t) for t in tensors]
+    datas = [t._data for t in tensors]
+    if kw:
+        base = fn
+        fn = lambda *xs: base(*xs, **kw)
+
+    diff_mask = [
+        (not t.stop_gradient) and _is_inexact(t.dtype) and _grad_enabled() for t in tensors
+    ]
+    needs_grad = any(diff_mask)
+
+    if not needs_grad:
+        out = fn(*datas)
+        if isinstance(out, (tuple, list)):
+            return type(out)(Tensor(o, stop_gradient=True) for o in out)
+        return Tensor(out, stop_gradient=True)
+
+    diff_idx = [i for i, m in enumerate(diff_mask) if m]
+
+    def diff_fn(*diff_args):
+        full = list(datas)
+        for i, a in zip(diff_idx, diff_args):
+            full[i] = a
+        return fn(*full)
+
+    out, vjp_fn = jax.vjp(diff_fn, *[datas[i] for i in diff_idx])
+
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    node = GradNode(
+        vjp_fn,
+        [(t, m) for t, m in zip(tensors, diff_mask)],
+        [(o.shape, o.dtype) for o in outs],
+        name=name,
+    )
+    wrapped = []
+    for i, o in enumerate(outs):
+        w = Tensor(o, stop_gradient=False)
+        w._node = node
+        w._out_idx = i
+        wrapped.append(w)
+    if multi:
+        return type(out)(wrapped)
+    return wrapped[0]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        d = data._data
+    elif isinstance(data, (jax.Array, jax.core.Tracer, np.ndarray)):
+        d = jnp.asarray(data)
+    else:
+        arr = np.asarray(data)
+        if arr.dtype == np.float64 and dtype is None:
+            arr = arr.astype(dtypes.get_default_dtype())
+        d = jnp.asarray(arr)
+    if dtype is not None:
+        dt = dtypes.convert_dtype(dtype)
+        if d.dtype != dt:
+            d = d.astype(dt)
+    return Tensor(d, stop_gradient=stop_gradient)
+
+
+def _ensure_tensor(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)

@@ -1,0 +1,66 @@
+"""Dtype registry — Paddle-style dtype names over jnp dtypes.
+
+Reference parity: paddle/phi/common/data_type.h (DataType enum) and
+python/paddle/framework/dtype.py. Here dtypes are plain numpy/jnp dtypes —
+XLA is the single source of truth for device layouts.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+bool = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_NAME2DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_default_dtype = jnp.float32
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (str | np.dtype | jnp dtype) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _NAME2DTYPE:
+            raise ValueError(f"unknown dtype {dtype!r}")
+        return np.dtype(_NAME2DTYPE[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype):
+    return np.dtype(dtype).name
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def is_floating_point_dtype(dtype):
+    return np.issubdtype(np.dtype(dtype), np.floating) or np.dtype(dtype) == jnp.bfloat16

@@ -1,0 +1,107 @@
+"""RNG discipline.
+
+Paddle seeds a global generator (`paddle.seed`) and layers draw from it
+imperatively. On TPU, randomness must be functional: a PRNG key threaded
+through the program. We bridge the two:
+
+- Eager mode: a global key split on every draw (imperative ergonomics).
+- Traced mode (inside `paddle_tpu.jit` / compiled train steps): the trainer
+  installs a traced base key via `rng_guard`; draws fold in a per-call
+  counter so the trace stays pure and reproducible.
+- TP-parallel dropout (reference: fleet meta_parallel/parallel_layers/
+  random.py RNGStatesTracker): `RNGStatesTracker` keeps named states whose
+  keys fold in mesh coordinates, so "local" dropout differs across model-
+  parallel ranks while "global" seeds agree.
+"""
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        _state.traced_key = None
+        _state.counter = 0
+    return _state
+
+
+def seed(s: int):
+    """paddle.seed parity: reseed the global generator."""
+    t = _tls()
+    t.key = jax.random.PRNGKey(int(s))
+    t.counter = 0
+    get_rng_state_tracker().reset(int(s))
+    return t.key
+
+
+def next_key():
+    """Draw a fresh PRNG key. Pure under trace (fold_in counter), split eagerly."""
+    t = _tls()
+    if t.traced_key is not None:
+        t.counter += 1
+        return jax.random.fold_in(t.traced_key, t.counter)
+    t.key, sub = jax.random.split(t.key)
+    return sub
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Install a (possibly traced) base key; draws become fold_in(key, n)."""
+    t = _tls()
+    prev, prev_c = t.traced_key, t.counter
+    t.traced_key, t.counter = key, 0
+    try:
+        yield
+    finally:
+        t.traced_key, t.counter = prev, prev_c
+
+
+def get_rng_state():
+    return _tls().key
+
+
+def set_rng_state(key):
+    _tls().key = key
+
+
+class RNGStatesTracker:
+    """Named RNG states for tensor-parallel dropout (reference:
+    fleet/meta_parallel/parallel_layers/random.py, get_rng_state_tracker)."""
+
+    def __init__(self):
+        self._seeds = {}
+
+    def reset(self, base_seed=0):
+        self._seeds = {}
+        self._base = base_seed
+
+    def add(self, name, seed_):
+        if name in self._seeds and self._seeds[name][0] != seed_:
+            raise ValueError(f"rng state {name} already exists")
+        self._seeds[name] = (seed_, jax.random.PRNGKey(seed_))
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self._seeds:
+            self.add(name, np.random.randint(0, 2**31 - 1))
+        s, key = self._seeds[name]
+        t = _tls()
+        prev_key, prev_traced, prev_c = t.key, t.traced_key, t.counter
+        t.key, t.traced_key, t.counter = key, key, 0
+        try:
+            yield
+        finally:
+            self._seeds[name] = (s, jax.random.fold_in(key, 1))
+            t.key, t.traced_key, t.counter = prev_key, prev_traced, prev_c
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker

@@ -1,0 +1,9 @@
+from .callbacks import (
+    Callback,
+    CallbackList,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
+from .model import Model, summary

@@ -1,0 +1,226 @@
+"""hapi callbacks (reference: python/paddle/hapi/callbacks.py)."""
+import numbers
+import time
+
+import numpy as np
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def on_begin(self, mode, logs=None):
+        getattr(self, f"on_{mode}_begin", lambda logs=None: None)(logs)
+
+    def on_end(self, mode, logs=None):
+        getattr(self, f"on_{mode}_end", lambda logs=None: None)(logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks=None, model=None, verbose=2):
+        self.callbacks = list(callbacks or [])
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in self.callbacks):
+            self.callbacks.insert(0, ProgBarLogger(verbose=verbose))
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def on_begin(self, mode, params=None):
+        for c in self.callbacks:
+            c.set_params(params)
+        self._call("on_begin", mode, params)
+
+    def on_end(self, mode, logs=None):
+        self._call("on_end", mode, logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._call("on_epoch_begin", epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._call("on_epoch_end", epoch, logs)
+
+    def on_batch_begin(self, mode, step, logs=None):
+        self._call(f"on_{mode}_batch_begin", step, logs)
+
+    def on_batch_end(self, mode, step, logs=None):
+        self._call(f"on_{mode}_batch_end", step, logs)
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=10, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+        self._t0 = None
+        self._step = 0
+        self._epoch = 0
+
+    def on_train_begin(self, logs=None):
+        self._t0 = time.time()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._step = 0
+        self._epoch_t0 = time.time()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step = step
+        if self.verbose >= 2 and step % self.log_freq == 0:
+            msg = self._fmt(logs)
+            total = self.params.get("steps")
+            print(f"Epoch {self._epoch}: step {step}{f'/{total}' if total else ''} - {msg}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._epoch_t0
+            print(f"Epoch {epoch} done in {dt:.1f}s - {self._fmt(logs)}")
+
+    @staticmethod
+    def _fmt(logs):
+        parts = []
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)):
+                v = v[0] if v else 0.0
+            if isinstance(v, numbers.Number):
+                parts.append(f"{k}: {v:.4f}")
+        return ", ".join(parts)
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and self.save_dir and epoch % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/{epoch}")
+
+    def on_train_end(self, logs=None):
+        if self.model is not None and self.save_dir:
+            self.model.save(f"{self.save_dir}/final")
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        return opt._learning_rate_scheduler if opt is not None else None
+
+    def on_train_batch_end(self, step, logs=None):
+        # TrainStep already steps the scheduler per step; only epoch mode acts
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1, min_delta=0,
+                 baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.wait = 0
+        self.best = None
+        if mode == "max" or (mode == "auto" and "acc" in monitor):
+            self.cmp = lambda cur, best: cur > best + self.min_delta
+        else:
+            self.cmp = lambda cur, best: cur < best - self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        if self.best is None or self.cmp(cur, self.best):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+
+
+class VisualDL(Callback):
+    """Metrics file writer (reference: VisualDL callback). Writes JSONL —
+    TensorBoard-free observability for this environment."""
+
+    def __init__(self, log_dir="./vdl_log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._f = None
+
+    def on_train_begin(self, logs=None):
+        import json
+        import os
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._f = open(f"{self.log_dir}/metrics.jsonl", "a")
+
+    def on_train_batch_end(self, step, logs=None):
+        import json
+
+        if self._f:
+            rec = {"step": step}
+            for k, v in (logs or {}).items():
+                if isinstance(v, (list, tuple)):
+                    v = v[0] if v else None
+                if isinstance(v, numbers.Number):
+                    rec[k] = float(v)
+            self._f.write(json.dumps(rec) + "\n")
+
+    def on_train_end(self, logs=None):
+        if self._f:
+            self._f.close()

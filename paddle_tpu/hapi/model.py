@@ -1,0 +1,258 @@
+"""hapi.Model (reference: python/paddle/hapi/model.py — Model.fit/evaluate/
+predict over callbacks).
+
+The train step runs through jit_api.TrainStep: one compiled XLA program per
+(shapes) signature, the dygraph loop only feeds batches — this is where the
+reference's per-op dispatch cost disappears (SURVEY.md §3.1).
+"""
+import numpy as np
+
+from ..framework.core import Tensor, to_tensor
+from ..io import DataLoader
+from ..jit_api import TrainStep
+from .callbacks import CallbackList, ProgBarLogger
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else ([metrics] if metrics else [])
+        self._train_step = None
+        return self
+
+    # -- single step APIs ---------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
+        if self._train_step is None:
+            self._train_step = TrainStep(
+                self.network, self._wrapped_loss, self._optimizer, n_labels=max(len(labels), 1)
+            )
+        loss = self._train_step(*inputs, *labels)
+        metrics = self._eval_metrics_on_batch(inputs, labels)
+        return ([float(loss.numpy())], metrics) if metrics else [float(loss.numpy())]
+
+    @property
+    def _wrapped_loss(self):
+        loss_fn = self._loss
+
+        def fn(*args):
+            out = loss_fn(*args)
+            if isinstance(out, (list, tuple)):
+                total = out[0]
+                for o in out[1:]:
+                    total = total + o
+                return total.mean() if total.ndim > 0 else total
+            return out.mean() if out.ndim > 0 else out
+
+        return fn
+
+    def _eval_metrics_on_batch(self, inputs, labels):
+        if not self._metrics:
+            return None
+        import paddle_tpu as ptpu
+
+        with ptpu.no_grad():
+            self.network.eval()
+            out = self.network(*inputs)
+            self.network.train()
+        res = []
+        for m in self._metrics:
+            c = m.compute(out, *labels)
+            res.append(m.update(c))
+        return res
+
+    def eval_batch(self, inputs, labels=None):
+        import paddle_tpu as ptpu
+
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
+        with ptpu.no_grad():
+            out = self.network(*inputs)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            loss = self._wrapped_loss(*outs, *[to_tensor(l) for l in labels])
+        metrics = []
+        for m in self._metrics:
+            c = m.compute(out, *labels)
+            metrics.append(m.update(c))
+        return ([float(loss.numpy())], metrics) if metrics else [float(loss.numpy())]
+
+    def predict_batch(self, inputs):
+        import paddle_tpu as ptpu
+
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with ptpu.no_grad():
+            self.network.eval()
+            out = self.network(*inputs)
+            self.network.train()
+        return [o.numpy() for o in (out if isinstance(out, (list, tuple)) else [out])]
+
+    # -- loops --------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1,
+            log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False, shuffle=True,
+            num_workers=0, callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        train_loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last, num_workers=num_workers
+        )
+        eval_loader = None
+        if eval_data is not None:
+            eval_loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
+                eval_data, batch_size=batch_size, num_workers=num_workers
+            )
+        cbks = CallbackList(callbacks, model=self, verbose=verbose)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks.on_begin("train", {"epochs": epochs, "steps": steps, "verbose": verbose,
+                                "metrics": ["loss"] + self._metric_names()})
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbks.on_batch_begin("train", step, logs)
+                ins, labs = self._split_batch(batch)
+                res = self.train_batch(ins, labs)
+                logs = self._to_logs(res)
+                cbks.on_batch_end("train", step, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_res = self.evaluate(eval_loader, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_res.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+        cbks.on_end("train", logs)
+        if save_dir:
+            self.save(f"{save_dir}/final")
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0,
+                 callbacks=None, num_iters=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
+            eval_data, batch_size=batch_size, num_workers=num_workers
+        )
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            ins, labs = self._split_batch(batch)
+            res = self.eval_batch(ins, labs)
+            losses.append(res[0][0] if isinstance(res, tuple) else res[0])
+        out = {"loss": [float(np.mean(losses))] if losses else [0.0]}
+        for m in self._metrics:
+            name = m.name()
+            acc = m.accumulate()
+            if isinstance(name, list):
+                for n, a in zip(name, acc if isinstance(acc, list) else [acc]):
+                    out[n] = a
+            else:
+                out[name] = acc
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
+            test_data, batch_size=batch_size, num_workers=num_workers
+        )
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, has_labels=False)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n_out)]
+        return outputs
+
+    def _split_batch(self, batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            if has_labels and len(batch) >= 2:
+                return batch[:-1], batch[-1:]
+            return batch, []
+        return [batch], []
+
+    def _metric_names(self):
+        names = []
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    def _to_logs(self, res):
+        logs = {}
+        if isinstance(res, tuple):
+            losses, metrics = res
+            logs["loss"] = losses
+            for m, v in zip(self._metrics, metrics):
+                n = m.name()
+                if isinstance(n, list):
+                    for nn, vv in zip(n, v if isinstance(v, list) else [v]):
+                        logs[nn] = vv
+                else:
+                    logs[n] = v
+        else:
+            logs["loss"] = res
+        return logs
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        from .. import serialization
+
+        payload = {"model": self.network.state_dict()}
+        if training and self._optimizer is not None:
+            payload["optimizer"] = self._optimizer.state_dict()
+        serialization.save(payload["model"], path + ".pdparams")
+        if training and self._optimizer is not None:
+            serialization.save(payload["optimizer"], path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+
+        from .. import serialization
+
+        sd = serialization.load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(serialization.load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """paddle.summary parity: parameter count table."""
+    total, trainable = 0, 0
+    lines = [f"{'Layer':<40}{'Params':>12}"]
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        lines.append(f"{name:<40}{n:>12}")
+    lines.append(f"Total params: {total}")
+    lines.append(f"Trainable params: {trainable}")
+    report = "\n".join(lines)
+    print(report)
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,107 @@
+"""incubate fused functionals (reference: python/paddle/incubate/nn/functional/
+— fused_rotary_position_embedding, fused_rms_norm, fused_linear...).
+
+On TPU these are jnp compositions XLA fuses into adjacent matmuls; rope gets
+a Pallas kernel upgrade path in paddle_tpu/ops/.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    from ...nn import functional as F
+    from ...tensor import linalg
+
+    if transpose_weight:
+        weight = linalg.t(weight)
+    return F.linear(x, weight, bias)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6, begin_norm_axis=-1, **kw):
+    from ...nn.functional.norm import rms_norm
+
+    out = rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + _t(norm_bias)
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, **kw):
+    from ...nn.functional.norm import layer_norm
+
+    return layer_norm(x, [_t(x).shape[-1]], norm_weight, norm_bias, epsilon)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None, ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.0, ln_epsilon=1e-5, training=True):
+    from ...nn import functional as F
+
+    y = x if bias is None else x + _t(bias)
+    y = F.dropout(y, dropout_rate, training=training)
+    y = y + residual
+    return F.layer_norm(y, [y.shape[-1]], ln_scale, ln_bias, ln_epsilon)
+
+
+def rope_rotate(x, cos, sin):
+    """Rotate-half rope application on [B, S, H, D]."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    return x * cos + rotated * sin
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None, position_ids=None,
+                                    use_neox_rotary_style=True, rotary_emb_base=10000.0):
+    """reference: incubate fused_rope (phi/kernels/fusion/gpu/fused_rope*). Computes
+    sin/cos on the fly if not given. Layout [batch, seq, heads, head_dim]."""
+    q = _t(q)
+    B, S, H, D = q.shape
+    if sin is None or cos is None:
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+        pos = jnp.arange(S, dtype=jnp.float32)
+        freqs = jnp.outer(pos, inv)  # S, D/2
+        emb = jnp.concatenate([freqs, freqs], axis=-1)
+        cos_a = jnp.cos(emb)[None, :, None, :]
+        sin_a = jnp.sin(emb)[None, :, None, :]
+    else:
+        cos_a = _t(cos)._data
+        sin_a = _t(sin)._data
+        if cos_a.ndim == 2:
+            cos_a = cos_a[None, :, None, :]
+            sin_a = sin_a[None, :, None, :]
+    if position_ids is not None:
+        pid = _t(position_ids)._data  # B, S
+        cos_a = jnp.take(cos_a[0, :, 0, :], pid, axis=0)[:, :, None, :]
+        sin_a = jnp.take(sin_a[0, :, 0, :], pid, axis=0)[:, :, None, :]
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        t = _t(t)
+        outs.append(apply(lambda a: rope_rotate(a.astype(jnp.float32), cos_a, sin_a).astype(a.dtype), t, name="fused_rope"))
+    return tuple(outs)
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train"):
+    from ...nn import functional as F
+
+    return F.dropout(x, p, training=training, mode=mode) + _t(y)
+
+
+def swiglu(x, y=None, name=None):
+    """LLaMA MLP gate: silu(x) * y (reference: phi swiglu fusion kernel)."""
+    if y is None:
+        a, b = jnp.split(_t(x)._data, 2, axis=-1)
+        return apply(lambda v: jax.nn.silu(v[..., : v.shape[-1] // 2]) * v[..., v.shape[-1] // 2 :], _t(x), name="swiglu")
+    return apply(lambda a, b: jax.nn.silu(a) * b, _t(x), _t(y), name="swiglu")
+
+
+def fused_multi_head_attention(*args, **kwargs):
+    raise NotImplementedError("use nn.functional.scaled_dot_product_attention (Pallas flash path)")

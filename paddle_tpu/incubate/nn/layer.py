@@ -1,0 +1,46 @@
+"""incubate fused layers (reference: python/paddle/incubate/nn/layer/...)."""
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer
+
+
+class FusedLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        if transpose_weight:
+            shape = [out_features, in_features]
+        else:
+            shape = [in_features, out_features]
+        self.weight = self.create_parameter(shape, attr=weight_attr, default_initializer=I.XavierNormal())
+        self.bias = None if bias_attr is False else self.create_parameter([out_features], attr=bias_attr, is_bias=True)
+        self.transpose_weight = transpose_weight
+
+    def forward(self, x):
+        from . import functional as FF
+
+        return FF.fused_linear(x, self.weight, self.bias, self.transpose_weight)
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.0, attn_dropout_rate=0.0, **kw):
+        super().__init__()
+        from ...nn.layer.transformer import MultiHeadAttention
+
+        self.inner = MultiHeadAttention(embed_dim, num_heads, attn_dropout_rate)
+
+    def forward(self, query, key=None, value=None, attn_mask=None):
+        return self.inner(query, key, value, attn_mask)
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, activation="relu", **kw):
+        super().__init__()
+        from ...nn.layer.common import Dropout, Linear
+
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.dropout = Dropout(dropout_rate)
+        self.activation = getattr(F, activation)
+
+    def forward(self, x):
+        return self.linear2(self.dropout(self.activation(self.linear1(x))))

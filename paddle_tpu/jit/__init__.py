@@ -1,0 +1,44 @@
+"""paddle.jit parity namespace (python/paddle/jit/)."""
+import os
+
+from ..jit_api import StaticLayer, TrainStep, jit, not_to_static, to_static  # noqa: F401
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save parity: persist state_dict + a small descriptor. AOT-exported
+    XLA executables are hardware-keyed, so the portable artifact is weights +
+    the to_static-able Layer (reference: paddle/fluid/jit/ property format)."""
+    from .. import serialization
+    from ..nn.layer.layers import Layer
+
+    target = layer._layer if isinstance(layer, StaticLayer) else layer
+    if isinstance(target, Layer):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        serialization.save(
+            {
+                "state_dict": target.state_dict(),
+                "class_name": type(target).__name__,
+                "input_spec": [repr(s) for s in (input_spec or [])],
+            },
+            path + ".pdparams",
+        )
+    else:
+        raise TypeError("jit.save expects a Layer or StaticLayer")
+
+
+def load(path, **configs):
+    from .. import serialization
+
+    return serialization.load(path + ".pdparams")
+
+
+def enable_to_static(flag):
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+_to_static_enabled = True
+
+
+def ignore_module(modules):
+    pass

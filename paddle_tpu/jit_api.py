@@ -1,0 +1,190 @@
+"""Compiled execution (reference analogue: @paddle.jit.to_static +
+dygraph-to-static, python/paddle/jit/ — but TPU-native: tracing IS jax).
+
+Key design (SURVEY.md §3.1): the dygraph tape is built from traceable jax
+ops, so wrapping a whole train step in jax.jit compiles forward + backward +
+optimizer into ONE XLA program. `TrainStep` is that wrapper; `jit`/`to_static`
+are the user-facing decorators.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .framework import random as prandom
+from .framework.core import Tensor, to_tensor
+
+
+def jit(fn=None, static_argnums=None, donate_argnums=None, backend=None):
+    """Compile a Tensor->Tensor function with XLA. An implicit PRNG key is
+    threaded per call so dropout stays random without retracing."""
+
+    def deco(f):
+        kw = {}
+        # user indexes refer to f's positional args; inner prepends the key,
+        # so shift by exactly 1 (inner takes *args positionally, not packed)
+        if static_argnums is not None:
+            nums = static_argnums if isinstance(static_argnums, (list, tuple)) else (static_argnums,)
+            kw["static_argnums"] = tuple(a + 1 for a in nums)
+        if donate_argnums is not None:
+            nums = donate_argnums if isinstance(donate_argnums, (list, tuple)) else (donate_argnums,)
+            kw["donate_argnums"] = tuple(a + 1 for a in nums)
+
+        @functools.partial(jax.jit, **kw)
+        def inner(key, *args, **kwargs):
+            with prandom.rng_guard(key):
+                return f(*args, **kwargs)
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            return inner(prandom.next_key(), *args, **kwargs)
+
+        wrapper._jax_fn = inner
+        return wrapper
+
+    return deco(fn) if fn is not None else deco
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """@paddle.jit.to_static parity. If applied to a Layer, returns a wrapper
+    whose __call__ runs the compiled functional forward."""
+    from .nn.layer.layers import Layer
+
+    def deco(obj):
+        if isinstance(obj, Layer):
+            return StaticLayer(obj)
+        return jit(obj)
+
+    return deco(function) if function is not None else deco
+
+
+class StaticLayer:
+    """A Layer compiled to a pure XLA callable: params/buffers become jit
+    arguments via functional_call (reference: PartialProgramLayer running the
+    traced program via the run_program op, python/paddle/jit/dy2static)."""
+
+    def __init__(self, layer):
+        self._layer = layer
+
+        @jax.jit
+        def fwd(state, key, args, kwargs):
+            with prandom.rng_guard(key):
+                out = layer.functional_call(
+                    {k: Tensor(v, stop_gradient=True) for k, v in state.items()}, *args, **kwargs
+                )
+            return out
+
+        self._fwd = fwd
+
+    def __call__(self, *args, **kwargs):
+        state = self._layer.raw_state_dict()
+        return self._fwd(state, prandom.next_key(), args, kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class TrainStep:
+    """One fully-compiled training step over a dygraph model.
+
+    forward (+AMP autocast) → tape backward → grad clip → optimizer update →
+    buffer (BN stats) update, all inside ONE jax.jit with donated state.
+    Mirrors what the reference needed eager codegen + fused kernels +
+    interpreter scheduling for (SURVEY.md §3.1 consequence).
+
+    loss_fn(outputs, *labels) -> scalar Tensor.
+    """
+
+    def __init__(self, model, loss_fn, optimizer, n_labels=1, scaler=None, mesh_shardings=None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.n_labels = n_labels
+        self.scaler = scaler
+
+        self._trainable = {
+            k: p for k, p in dict(model.named_parameters()).items() if not p.stop_gradient
+        }
+        self._frozen = {
+            k: p for k, p in dict(model.named_parameters()).items() if p.stop_gradient
+        }
+        self._buffers = dict(model.named_buffers())
+        self.opt_state = optimizer.init_state(self._trainable)
+        self._scaler_state = scaler.init_state() if scaler is not None else None
+
+        opt = optimizer
+        n_lab = n_labels
+
+        def step_fn(params, buffers, frozen, opt_state, scaler_state, lr, key, batch):
+            inputs = batch[:-n_lab] if n_lab else batch
+            labels = batch[-n_lab:] if n_lab else ()
+            overrides = {k: Tensor(v, stop_gradient=False) for k, v in params.items()}
+            buf_over = {k: Tensor(v, stop_gradient=True) for k, v in buffers.items()}
+            frozen_over = {k: Tensor(v, stop_gradient=True) for k, v in frozen.items()}
+            with prandom.rng_guard(key):
+                out = model.functional_call(
+                    {**overrides, **buf_over, **frozen_over},
+                    *[Tensor(b) for b in inputs],
+                    training=True,
+                )
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                loss = loss_fn(*outs, *[Tensor(b, stop_gradient=True) for b in labels])
+
+            if scaler is not None:
+                # seed the cotangent with the loss scale (≡ scaling the loss)
+                loss.backward(Tensor(jnp.ones_like(loss._data) * scaler_state["scale"]))
+            else:
+                loss.backward()
+
+            grads = {}
+            for k, t in overrides.items():
+                if t.grad is not None:
+                    g = t.grad._data
+                    if scaler is not None:
+                        g = g / scaler_state["scale"]
+                    grads[k] = g
+
+            skip = None
+            new_scaler_state = scaler_state
+            if scaler is not None:
+                finite = jnp.all(
+                    jnp.stack([jnp.all(jnp.isfinite(g.astype(jnp.float32))) for g in grads.values()])
+                )
+                skip = ~finite
+                new_scaler_state = scaler.update_state(scaler_state, finite)
+
+            if opt._grad_clip is not None:
+                pg = [(Tensor(params[k]), Tensor(g)) for k, g in grads.items()]
+                pg = opt._grad_clip(pg)
+                grads = {k: t._data for (k, _), (_, t) in zip(grads.items(), pg)}
+
+            new_params, new_opt_state = opt.apply_gradients(params, grads, opt_state, lr, skip_update=skip)
+            new_buffers = {k: t._data for k, t in buf_over.items()}
+            return loss._data, new_params, new_buffers, new_opt_state, new_scaler_state
+
+        self._compiled = jax.jit(step_fn, donate_argnums=(0, 1, 3, 4))
+
+    def __call__(self, *batch):
+        params = {k: p._data for k, p in self._trainable.items()}
+        buffers = {k: b._data for k, b in self._buffers.items()}
+        frozen = {k: p._data for k, p in self._frozen.items()}
+        lr = self.optimizer.get_lr()
+        batch_data = tuple(to_tensor(b)._data for b in batch)
+        loss, new_params, new_buffers, self.opt_state, self._scaler_state = self._compiled(
+            params, buffers, frozen, self.opt_state, self._scaler_state, lr, prandom.next_key(), batch_data
+        )
+        # write state back into the dygraph objects
+        for k, v in new_params.items():
+            self._trainable[k]._data = v
+        for k, v in new_buffers.items():
+            self._buffers[k]._data = v
+        sched = self.optimizer._learning_rate_scheduler
+        if sched is not None:
+            sched.step()
+        self.optimizer._global_step += 1
+        return Tensor(loss)

@@ -1,0 +1,97 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py).
+
+ClipGradByGlobalNorm is the one that matters for LLM training; under hybrid
+parallel the global norm additionally reduces across mesh axes (see
+distributed/fleet/hybrid_optimizer.py, mirroring HybridParallelClipGrad).
+"""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, apply(lambda a: jnp.clip(a, self.min, self.max), g)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+
+            def fn(a):
+                n = jnp.sqrt(jnp.sum(a * a))
+                return a * jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+
+            out.append((p, apply(fn, g)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+        self.group_name = group_name
+
+    def global_norm(self, grads):
+        sq = [jnp.sum(jnp.square(g._data.astype(jnp.float32))) for g in grads if g is not None]
+        if not sq:
+            return None
+        total = sq[0]
+        for s in sq[1:]:
+            total = total + s
+        return jnp.sqrt(total)
+
+    def _clip(self, params_grads):
+        gnorm = self.global_norm([g for _, g in params_grads])
+        if gnorm is None:
+            return params_grads
+        factor = jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12))
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._data.astype(jnp.float32) * factor).astype(g.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    ps = [p for p in (parameters if isinstance(parameters, (list, tuple)) else [parameters]) if p.grad is not None]
+    if not ps:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._data)) for p in ps]))
+    else:
+        total = jnp.sum(jnp.stack([jnp.sum(jnp.abs(p.grad._data) ** norm_type) for p in ps])) ** (1.0 / norm_type)
+    factor = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    for p in ps:
+        p.grad = Tensor(p.grad._data * factor)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    ps = parameters if isinstance(parameters, (list, tuple)) else [parameters]
+    for p in ps:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(p.grad._data, -clip_value, clip_value))

@@ -1,0 +1,149 @@
+"""Activations (reference: python/paddle/nn/functional/activation.py)."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _u(fn, name):
+    def op(x, name_=None, **kw):
+        return apply(lambda a: fn(a, **kw) if kw else fn(a), _t(x), name=name)
+
+    op.__name__ = name
+    return op
+
+
+relu = _u(jax.nn.relu, "relu")
+relu_ = relu
+relu6 = _u(jax.nn.relu6, "relu6")
+sigmoid = _u(jax.nn.sigmoid, "sigmoid")
+tanh = _u(jnp.tanh, "tanh")
+silu = _u(jax.nn.silu, "silu")
+swish = silu
+mish = _u(lambda a: a * jnp.tanh(jax.nn.softplus(a)), "mish")
+softsign = _u(jax.nn.soft_sign, "softsign")
+tanhshrink = _u(lambda a: a - jnp.tanh(a), "tanhshrink")
+log_sigmoid = _u(jax.nn.log_sigmoid, "log_sigmoid")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), _t(x), name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), _t(x), name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.elu(a, alpha), _t(x), name="elu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.celu(a, alpha), _t(x), name="celu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), _t(x), name="selu")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), _t(x), name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        lambda a: jnp.where(a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)),
+        _t(x),
+        name="softshrink",
+    )
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), _t(x), name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return apply(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, _t(x), name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), _t(x), name="hardtanh")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(
+        lambda a: jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta), _t(x), name="softplus"
+    )
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a, value), _t(x), name="thresholded_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return apply(fn, _t(x), _t(weight), name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=False, name=None):
+    mid = (lower + upper) / 2.0
+    return apply(lambda a: jnp.where(a >= 0, a, mid * a), _t(x), name="rrelu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (groups, c // groups) + a.shape[ax + 1 :]
+        return jnp.max(a.reshape(new_shape), axis=ax)
+
+    return apply(fn, _t(x), name="maxout")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply(lambda a: jax.nn.softmax(a, axis=axis), x, name="softmax")
+
+
+softmax_ = softmax
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return apply(lambda a: jax.nn.log_softmax(a, axis=axis), x, name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as prandom
+
+    g = jax.random.gumbel(prandom.next_key(), tuple(_t(x).shape), _t(x).dtype)
+
+    def fn(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply(fn, _t(x), name="gumbel_softmax")
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda a: jax.nn.glu(a, axis=axis), _t(x), name="glu")

@@ -1,0 +1,354 @@
+"""Common functional ops: linear, dropout, embedding-adjacent utilities
+(reference: python/paddle/nn/functional/common.py)."""
+import jax
+import jax.numpy as jnp
+
+from ...framework import random as prandom
+from ...framework.core import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Weight layout [in, out] as in the reference
+    (python/paddle/nn/functional/common.py linear; phi matmul kernel)."""
+    from ...amp.auto_cast import amp_cast_inputs
+
+    if bias is None:
+
+        def fn(a, w):
+            a, w = amp_cast_inputs("linear", [a, w])
+            return a @ w
+
+        return apply(fn, _t(x), _t(weight), name="linear")
+
+    def fnb(a, w, b):
+        a, w, b = amp_cast_inputs("linear", [a, w, b])
+        return a @ w + b
+
+    return apply(fnb, _t(x), _t(weight), _t(bias), name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda a: a * (1.0 - p), x, name="dropout_infer")
+        return x.clone() if not x.stop_gradient else Tensor(x._data)
+    if p == 1.0:
+        return apply(lambda a: jnp.zeros_like(a), x, name="dropout")
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        mask_shape = tuple(s if i in [a % len(shape) for a in axes] else 1 for i, s in enumerate(shape))
+    else:
+        mask_shape = shape
+    keep = jax.random.bernoulli(prandom.next_key(), 1.0 - p, mask_shape)
+
+    def fn(a):
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply(fn, x, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    x = _t(x)
+    keep = jax.random.bernoulli(prandom.next_key(), 1.0 - p, tuple(x.shape))
+    a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5)
+    b = -a * alpha_p * p
+
+    def fn(v):
+        return (jnp.where(keep, v, alpha_p) * a + b).astype(v.dtype)
+
+    return apply(fn, x, name="alpha_dropout")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...tensor import manipulation
+
+    return manipulation.pad(x, pad, mode, value, data_format)
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    x = _t(x)
+    spatial = x.shape[2:] if data_format.startswith("NC") else x.shape[1:-1]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    if isinstance(size, Tensor):
+        size = [int(v) for v in size.numpy()]
+    size = [int(v.item()) if isinstance(v, Tensor) else int(v) for v in size]
+    method = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear", "bicubic": "cubic", "linear": "linear", "area": "linear"}[mode]
+
+    if data_format.startswith("NC"):
+        out_shape = tuple(x.shape[:2]) + tuple(size)
+        spatial_axes = tuple(range(2, x.ndim))
+    else:
+        out_shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+        spatial_axes = tuple(range(1, x.ndim - 1))
+
+    def fn(a):
+        return jax.image.resize(a, out_shape, method=method)
+
+    return apply(fn, x, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = _t(x)
+    k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    s = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    d = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])))
+        oh = (a.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (a.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patches.append(
+                    a[:, :, i * d[0] : i * d[0] + oh * s[0] : s[0], j * d[1] : j * d[1] + ow * s[1] : s[1]]
+                )
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * k[0] * k[1], oh * ow)
+
+    return apply(fn, x, name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = _t(x)
+    k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    s = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    p = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+    d = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    oh_out, ow_out = output_sizes
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        ph, pw = oh_out + p[0] + p[2], ow_out + p[1] + p[3]
+        oh = (ph - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (pw - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        a = a.reshape(n, c, k[0], k[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[:, :, i * d[0] : i * d[0] + oh * s[0] : s[0], j * d[1] : j * d[1] + ow * s[1] : s[1]].add(
+                    a[:, :, i, j]
+                )
+        return out[:, :, p[0] : ph - p[2], p[1] : pw - p[3]]
+
+    return apply(fn, x, name="fold")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = [_t(x1), _t(x2), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return apply(fn, *args, name="bilinear")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return apply(fn, _t(x1), _t(x2), name="cosine_similarity")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(a):
+        n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return apply(fn, _t(x), name="normalize")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._data if isinstance(prior_dist, Tensor) else jnp.asarray(prior_dist)
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+
+    return apply(fn, _t(label), name="label_smooth")
+
+
+def one_hot(x, num_classes, name=None):
+    return Tensor(jax.nn.one_hot(_t(x)._data, num_classes))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    idx = _t(x)._data
+
+    def fn(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply(fn, _t(weight), name="embedding")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample is PS-scale; out of TPU scope (SURVEY.md §2.3)")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply(fn, _t(x), name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        raise NotImplementedError
+
+    return apply(fn, _t(x), name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+    return apply(fn, _t(x), name="channel_shuffle")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    x, grid = _t(x), _t(grid)
+
+    def fn(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            ix = (gx + 1) * (w - 1) / 2
+            iy = (gy + 1) * (h - 1) / 2
+        else:
+            ix = ((gx + 1) * w - 1) / 2
+            iy = ((gy + 1) * h - 1) / 2
+
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+
+        def sample(xi, yi):
+            xi_c = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            yi_c = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            v = a[jnp.arange(n)[:, None, None], :, yi_c, xi_c]  # n,hg,wg,c
+            if padding_mode == "zeros":
+                valid = ((xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1))[..., None]
+                v = jnp.where(valid, v, 0.0)
+            return v
+
+        if mode == "nearest":
+            out = sample(jnp.round(ix), jnp.round(iy))
+        else:
+            wa = ((x1 - ix) * (y1 - iy))[..., None]
+            wb = ((x1 - ix) * (iy - y0))[..., None]
+            wc = ((ix - x0) * (y1 - iy))[..., None]
+            wd = ((ix - x0) * (iy - y0))[..., None]
+            out = wa * sample(x0, y0) + wb * sample(x0, y1) + wc * sample(x1, y0) + wd * sample(x1, y1)
+        return out.transpose(0, 3, 1, 2)
+
+    return apply(fn, x, grid, name="grid_sample")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    theta = _t(theta)
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    n, c, h, w = out_shape
+
+    def fn(th):
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # h,w,3
+        return jnp.einsum("nij,hwj->nhwi", th, base)
+
+    return apply(fn, theta, name="affine_grid")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def fn(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold_ = int(c * shift_ratio)
+        out = jnp.zeros_like(a)
+        out = out.at[:, :-1, :fold_].set(a[:, 1:, :fold_])
+        out = out.at[:, 1:, fold_ : 2 * fold_].set(a[:, :-1, fold_ : 2 * fold_])
+        out = out.at[:, :, 2 * fold_ :].set(a[:, :, 2 * fold_ :])
+        return out.reshape(nt, c, h, w)
+
+    return apply(fn, _t(x), name="temporal_shift")

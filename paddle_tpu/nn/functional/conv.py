@@ -1,0 +1,153 @@
+"""Convolutions over lax.conv_general_dilated — XLA tiles these onto the MXU
+(reference: python/paddle/nn/functional/conv.py; phi conv kernels + cuDNN)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def _resolve_padding(padding, nd, strides, dilations, ksize, in_shape):
+    """Map paddle padding spec (int | list | 'SAME'/'VALID') to lax pairs."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    p = list(padding)
+    if len(p) == nd and all(isinstance(v, int) for v in p):
+        return [(v, v) for v in p]
+    if len(p) == 2 * nd:
+        return [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+    if all(isinstance(v, (list, tuple)) for v in p):
+        # NCHW-style full spec [[0,0],[0,0],[ph,ph],[pw,pw]]
+        return [tuple(v) for v in p[-nd:]]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, nd, name):
+    x, weight = _t(x), _t(weight)
+    strides = _pair(stride, nd)
+    dilations = _pair(dilation, nd)
+    channel_last = data_format[-1] == "C"
+    spatial = "DHW"[3 - nd :]
+    if channel_last:
+        dn_in = "N" + spatial + "C"
+    else:
+        dn_in = "NC" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (dn_in, "OI" + spatial, dn_in)
+    )
+    pad = _resolve_padding(padding, nd, strides, dilations, weight.shape[2:], x.shape)
+
+    def fn(a, w, *rest):
+        from ...amp.auto_cast import amp_cast_inputs
+
+        a, w = amp_cast_inputs("conv2d", [a, w])
+        out = jax.lax.conv_general_dilated(
+            a,
+            w,
+            window_strides=strides,
+            padding=pad,
+            rhs_dilation=dilations,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None,
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    args = [x, weight] + ([_t(bias)] if bias is not None else [])
+    return apply(fn, *args, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 1, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 2, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 3, "conv3d")
+
+
+def _conv_transpose_nd(
+    x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, nd, output_size, name
+):
+    x, weight = _t(x), _t(weight)
+    strides = _pair(stride, nd)
+    dilations = _pair(dilation, nd)
+    channel_last = data_format[-1] == "C"
+    spatial = "DHW"[3 - nd :]
+    dn_in = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # weight layout in paddle conv_transpose: [in, out/groups, *k] = "IO" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (dn_in, "IO" + spatial, dn_in)
+    )
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        pads = _resolve_padding(padding, nd, strides, dilations, weight.shape[2:], x.shape)
+        k = weight.shape[2:]
+        opad = _pair(output_padding, nd)
+        pad = [
+            (d * (kk - 1) - p[0], d * (kk - 1) - p[1] + op)
+            for p, d, kk, op in zip(pads, dilations, k, opad)
+        ]
+
+    def fn(a, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            a,
+            w,
+            window_strides=[1] * nd,
+            padding=pad,
+            lhs_dilation=strides,
+            rhs_dilation=dilations,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    def flip_w(w):
+        return jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+
+    args = [x, apply(flip_w, weight)] + ([_t(bias)] if bias is not None else [])
+    return apply(fn, *args, name=name)
+
+
+def conv1d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, output_size=None, data_format="NCL", name=None
+):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, 1, output_size, "conv1dT")
+
+
+def conv2d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCHW", output_size=None, name=None
+):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, 2, output_size, "conv2dT")
+
+
+def conv3d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCDHW", output_size=None, name=None
+):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, 3, output_size, "conv3dT")

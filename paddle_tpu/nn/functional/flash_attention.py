@@ -1,0 +1,177 @@
+"""Flash/SDP attention (reference: python/paddle/nn/functional/flash_attention.py
+— FlashAttnKernel glue at paddle/phi/kernels/gpu/flash_attn_kernel.cu).
+
+TPU-native path: a Pallas flash-attention kernel (paddle_tpu/ops/flash_attention.py)
+tiled for the MXU, with a pure-XLA fallback that jnp-composes softmax(QK^T)V —
+XLA itself fuses this well on TPU for moderate sequence lengths.
+
+Layout contract matches the reference: q/k/v are [batch, seqlen, num_heads,
+head_dim]; causal masking supported; dropout applied inside attention.
+"""
+import contextlib
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import random as prandom
+from ...framework.core import Tensor, apply, to_tensor
+
+_sdp_config = {"enable_flash": True, "enable_math": True, "enable_mem_efficient": True}
+
+
+@contextlib.contextmanager
+def sdp_kernel(enable_flash=True, enable_math=True, enable_mem_efficient=True):
+    prev = dict(_sdp_config)
+    _sdp_config.update(
+        enable_flash=enable_flash, enable_math=enable_math, enable_mem_efficient=enable_mem_efficient
+    )
+    try:
+        yield
+    finally:
+        _sdp_config.update(prev)
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _use_pallas(q_shape, head_dim):
+    if not _sdp_config["enable_flash"]:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    # Pallas kernel wants MXU-friendly tiles.
+    return head_dim % 128 == 0 or head_dim in (64, 96, 128, 256)
+
+
+def _math_attention(q, k, v, mask, causal, dropout, dropout_key, scale):
+    # [B, S, H, D] -> [B, H, S, D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    # grouped-query attention: broadcast kv heads
+    hq, hk = qt.shape[1], kt.shape[1]
+    if hq != hk:
+        kt = jnp.repeat(kt, hq // hk, axis=1)
+        vt = jnp.repeat(vt, hq // hk, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    logits = logits.astype(jnp.float32)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def flash_attention(
+    query,
+    key,
+    value,
+    dropout=0.0,
+    causal=False,
+    return_softmax=False,
+    fixed_seed_offset=None,
+    rng_name="",
+    training=True,
+    name=None,
+):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    q, k, v = _t(query), _t(key), _t(value)
+    head_dim = q.shape[-1]
+    scale = 1.0 / (head_dim**0.5)
+    drop = dropout if training else 0.0
+    dropout_key = prandom.next_key() if drop > 0.0 else None
+
+    if _use_pallas(tuple(q.shape), head_dim) and drop == 0.0:
+        from ...ops.flash_attention import flash_attention_fwd
+
+        out = apply(
+            functools.partial(flash_attention_fwd, causal=causal, scale=scale),
+            q,
+            k,
+            v,
+            name="pallas_flash_attn",
+        )
+    else:
+        out = apply(
+            lambda a, b, c: _math_attention(a, b, c, None, causal, drop, dropout_key, scale),
+            q,
+            k,
+            v,
+            name="flash_attn",
+        )
+    return out, None
+
+
+def flash_attn_unpadded(
+    query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqlen_q, max_seqlen_k,
+    scale=None, dropout=0.0, causal=False, return_softmax=False, training=True, name=None,
+):
+    """Varlen flash attention: total-token packed layout [total, H, D] with
+    cumulative sequence offsets (reference: flash_attn_unpadded). Lowered to a
+    segment-masked dense attention — Pallas ragged kernel is the upgrade path."""
+    q, k, v = _t(query), _t(key), _t(value)
+    cu_q = _t(cu_seqlens_q)._data
+    cu_k = _t(cu_seqlens_k)._data
+    scale = scale or 1.0 / (q.shape[-1] ** 0.5)
+
+    def fn(qa, ka, va):
+        tq = qa.shape[0]
+        tk = ka.shape[0]
+        seg_q = jnp.cumsum(jnp.zeros(tq, jnp.int32).at[cu_q[1:-1]].add(1))
+        seg_k = jnp.cumsum(jnp.zeros(tk, jnp.int32).at[cu_k[1:-1]].add(1))
+        logits = jnp.einsum("qhd,khd->hqk", qa, ka) * scale
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(tq) - jnp.take(cu_q, seg_q)
+            pos_k = jnp.arange(tk) - jnp.take(cu_k, seg_k)
+            mask = mask & (pos_q[:, None] >= pos_k[None, :])
+        logits = jnp.where(mask[None], logits.astype(jnp.float32), -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(qa.dtype)
+        return jnp.einsum("hqk,khd->qhd", probs, va)
+
+    out = apply(fn, q, k, v, name="flash_attn_varlen")
+    return out, None
+
+
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+):
+    """paddle.nn.functional.scaled_dot_product_attention parity.
+    Layout [batch, seqlen, heads, head_dim], like the reference."""
+    q, k, v = _t(query), _t(key), _t(value)
+    head_dim = q.shape[-1]
+    scale = 1.0 / (head_dim**0.5)
+    drop = dropout_p if training else 0.0
+    dropout_key = prandom.next_key() if drop > 0.0 else None
+
+    if attn_mask is None and drop == 0.0 and _use_pallas(tuple(q.shape), head_dim):
+        from ...ops.flash_attention import flash_attention_fwd
+
+        return apply(
+            functools.partial(flash_attention_fwd, causal=is_causal, scale=scale),
+            q,
+            k,
+            v,
+            name="pallas_sdpa",
+        )
+
+    mask_data = _t(attn_mask)._data if attn_mask is not None else None
+    return apply(
+        lambda a, b, c: _math_attention(a, b, c, mask_data, is_causal, drop, dropout_key, scale),
+        q,
+        k,
+        v,
+        name="sdpa",
+    )

@@ -1,0 +1,2 @@
+"""Input ops (reference: python/paddle/nn/functional/input.py)."""
+from .common import embedding, one_hot  # noqa: F401

@@ -1,0 +1,270 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    input = _t(input)
+    label = _t(label)
+
+    ldata = label._data
+
+    def fn(logits, *rest):
+        it = iter(rest)
+        lab = next(it) if soft_label else ldata
+        w = next(it) if weight is not None else None
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(jnp.maximum(logits, 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label:
+            sl = lab
+            if label_smoothing > 0:
+                sl = sl * (1 - label_smoothing) + label_smoothing / n_classes
+            per = -jnp.sum(sl * logp, axis=axis)
+            valid = jnp.ones_like(per, dtype=bool)
+        else:
+            li = lab
+            if li.ndim == logp.ndim and li.shape[axis] == 1:
+                li = jnp.squeeze(li, axis)
+            li = li.astype(jnp.int32)
+            valid = li != ignore_index
+            safe = jnp.where(valid, li, 0)
+            if label_smoothing > 0:
+                onehot = jax.nn.one_hot(safe, n_classes, dtype=logp.dtype)
+                sl = onehot * (1 - label_smoothing) + label_smoothing / n_classes
+                per = -jnp.sum(sl * logp, axis=axis)
+            else:
+                per = -jnp.take_along_axis(logp, safe[..., None], axis=axis).squeeze(axis)
+            per = jnp.where(valid, per, 0.0)
+            if w is not None:
+                wt = jnp.take(w, safe, axis=0)
+                wt = jnp.where(valid, wt, 0.0)
+                per = per * wt
+                if reduction == "mean":
+                    return jnp.sum(per) / jnp.maximum(jnp.sum(wt), 1e-12)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+            return jnp.sum(per) / denom
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+
+    args = [input]
+    if soft_label:
+        args.append(label)
+    if weight is not None:
+        args.append(_t(weight))
+    return apply(fn, *args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        from .activation import softmax as _softmax
+
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    label_d = _t(label)._data
+
+    def fn(logp, *rest):
+        li = label_d.astype(jnp.int32)
+        valid = li != ignore_index
+        safe = jnp.where(valid, li, 0)
+        per = -jnp.take_along_axis(logp, safe[..., None], axis=1).squeeze(1)
+        if rest:
+            wt = jnp.take(rest[0], safe, axis=0)
+            wt = jnp.where(valid, wt, 0.0)
+            per = per * wt
+            if reduction == "mean":
+                return jnp.sum(jnp.where(valid, per, 0.0)) / jnp.maximum(jnp.sum(wt), 1e-12)
+        per = jnp.where(valid, per, 0.0)
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(valid.astype(per.dtype)), 1.0)
+        return _reduce(per, reduction)
+
+    args = [_t(input)] + ([_t(weight)] if weight is not None else [])
+    return apply(fn, *args, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction), _t(input), _t(label), name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), _t(input), _t(label), name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        # standard huber: 0.5 d^2 inside delta, linear outside
+        d = jnp.abs(a - b)
+        out = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(out, reduction)
+
+    return apply(fn, _t(input), _t(label), name="smooth_l1")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, l, *rest):
+        per = -(l * jnp.log(jnp.maximum(p, 1e-12)) + (1 - l) * jnp.log(jnp.maximum(1 - p, 1e-12)))
+        if rest:
+            per = per * rest[0]
+        return _reduce(per, reduction)
+
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+    return apply(fn, *args, name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    def fn(z, l, *rest):
+        it = iter(rest)
+        w = next(it) if weight is not None else None
+        pw = next(it) if pos_weight is not None else None
+        log_sig = jax.nn.log_sigmoid(z)
+        log_one_minus = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            per = -(pw * l * log_sig + (1 - l) * log_one_minus)
+        else:
+            per = -(l * log_sig + (1 - l) * log_one_minus)
+        if w is not None:
+            per = per * w
+        return _reduce(per, reduction)
+
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    return apply(fn, *args, name="bce_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(lp, t):
+        tt = jnp.exp(t) if log_target else t
+        per = tt * ((t if log_target else jnp.log(jnp.maximum(t, 1e-12))) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(per) / lp.shape[0]
+        return _reduce(per, reduction)
+
+    return apply(fn, _t(input), _t(label), name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, l):
+        return _reduce(jnp.maximum(0.0, -l * (a - b) + margin), reduction)
+
+    return apply(fn, _t(input), _t(other), _t(label), name="margin_ranking")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fn(a, l):
+        out = jnp.where(l == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(out, reduction)
+
+    return apply(fn, _t(input), _t(label), name="hinge_embedding")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, l):
+        cos = jnp.sum(a * b, axis=-1) / (
+            jnp.maximum(jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        )
+        out = jnp.where(l == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(out, reduction)
+
+    return apply(fn, _t(input1), _t(input2), _t(label), name="cosine_embedding")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply(fn, _t(input), _t(positive), _t(negative), name="triplet")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def fn(z, l, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = -(l * jax.nn.log_sigmoid(z) + (1 - l) * jax.nn.log_sigmoid(-z))
+        pt = p * l + (1 - p) * (1 - l)
+        a_t = alpha * l + (1 - alpha) * (1 - l)
+        out = a_t * ((1 - pt) ** gamma) * ce
+        if rest:
+            out = out / rest[0]
+        return _reduce(out, reduction)
+
+    args = [_t(logit), _t(label)] + ([_t(normalizer)] if normalizer is not None else [])
+    return apply(fn, *args, name="focal")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply(
+        lambda p, l: -l * jnp.log(p + epsilon) - (1 - l) * jnp.log(1 - p + epsilon),
+        _t(input),
+        _t(label),
+        name="log_loss",
+    )
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), _t(input), _t(label), name="square_error")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    raise NotImplementedError("ctc_loss: planned (optax ctc_loss integration)")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def fn(p, l):
+        l_onehot = jax.nn.one_hot(l.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        inter = jnp.sum(p * l_onehot, axis=tuple(range(1, p.ndim)))
+        union = jnp.sum(p, axis=tuple(range(1, p.ndim))) + jnp.sum(l_onehot, axis=tuple(range(1, p.ndim)))
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply(fn, _t(input), _t(label), name="dice")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p):
+        sim = a @ p.T
+        l = _t(labels)._data.reshape(-1)
+        target = (l[:, None] == l[None, :]).astype(sim.dtype)
+        target = target / jnp.sum(target, axis=1, keepdims=True)
+        ce = -jnp.mean(jnp.sum(jax.nn.log_softmax(sim, axis=1) * target, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return ce + reg
+
+    return apply(fn, _t(anchor), _t(positive), name="npair")

@@ -1,0 +1,177 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+These stay as jnp compositions — XLA fuses mean/var/scale chains into the
+surrounding kernels, which is exactly what the reference's fused
+bias-dropout-residual-LN CUDA kernels hand-achieve.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    x = _t(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(normalized_shape)
+    axes = tuple(range(x.ndim - nd, x.ndim))
+
+    def fn(a, *rest):
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it)
+        if bias is not None:
+            out = out + next(it)
+        return out
+
+    args = [x] + ([_t(weight)] if weight is not None else []) + ([_t(bias)] if bias is not None else [])
+    return apply(fn, *args, name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (reference: incubate fused_rms_norm / PaddleNLP): the LLaMA norm."""
+    x = _t(x)
+
+    def fn(a, *rest):
+        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        if rest:
+            out = out * rest[0]
+        return out
+
+    args = [x] + ([_t(weight)] if weight is not None else [])
+    return apply(fn, *args, name="rms_norm")
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    x = _t(x)
+    ch_axis = 1 if (data_format.startswith("NC") or x.ndim <= 2) else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        mean_t = apply(lambda a: jnp.mean(a, axis=reduce_axes), x, name="bn_mean")
+        var_t = apply(
+            lambda a, m: jnp.mean(jnp.square(a - m.reshape(bshape)), axis=reduce_axes), x, mean_t, name="bn_var"
+        )
+        # update running stats in place (reference: phi batch_norm kernel)
+        if running_mean is not None:
+            running_mean.set_value(
+                Tensor(momentum * running_mean._data + (1 - momentum) * mean_t._data)
+            )
+            running_var.set_value(Tensor(momentum * running_var._data + (1 - momentum) * var_t._data))
+        mean_used, var_used = mean_t, var_t
+    else:
+        mean_used, var_used = _t(running_mean), _t(running_var)
+
+    def fn(a, m, v, *rest):
+        out = (a - m.reshape(bshape)) * jax.lax.rsqrt(v.reshape(bshape) + epsilon)
+        it = iter(rest)
+        if weight is not None:
+            out = out * next(it).reshape(bshape)
+        if bias is not None:
+            out = out + next(it).reshape(bshape)
+        return out.astype(a.dtype)
+
+    args = [x, mean_used, var_used]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply(fn, *args, name="batch_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None, use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    x = _t(x)
+    axes = tuple(range(2, x.ndim))
+
+    def fn(a, *rest):
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        it = iter(rest)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        return out
+
+    args = [x] + ([_t(weight)] if weight is not None else []) + ([_t(bias)] if bias is not None else [])
+    return apply(fn, *args, name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    x = _t(x)
+
+    def fn(a, *rest):
+        n, c = a.shape[0], a.shape[1]
+        rest_shape = a.shape[2:]
+        g = a.reshape(n, num_groups, c // num_groups, *rest_shape)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+        it = iter(rest)
+        shape = [1, c] + [1] * (a.ndim - 2)
+        if weight is not None:
+            out = out * next(it).reshape(shape)
+        if bias is not None:
+            out = out + next(it).reshape(shape)
+        return out
+
+    args = [x] + ([_t(weight)] if weight is not None else []) + ([_t(bias)] if bias is not None else [])
+    return apply(fn, *args, name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def fn(a):
+        sq = jnp.square(a)
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        window = [1] * a.ndim
+        window[1] = size
+        summed = jax.lax.reduce_window(padded, 0.0, jax.lax.add, tuple(window), (1,) * a.ndim, "VALID")
+        return a / (k + alpha * summed) ** beta
+
+    return apply(fn, _t(x), name="lrn")
+
+
+def spectral_norm(weight, weight_u, weight_v, dim=0, power_iters=1, eps=1e-12, name=None):
+    w = _t(weight)
+
+    def fn(wd, u, v):
+        wm = jnp.moveaxis(wd, dim, 0).reshape(wd.shape[dim], -1)
+        for _ in range(power_iters):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v
+        return wd / sigma
+
+    return apply(fn, w, _t(weight_u), _t(weight_v), name="spectral_norm")

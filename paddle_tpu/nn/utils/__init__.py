@@ -1,0 +1,2 @@
+from . import utils
+from .utils import parameters_to_vector, vector_to_parameters, weight_norm, remove_weight_norm, spectral_norm

@@ -1,0 +1,55 @@
+"""nn.utils (reference: python/paddle/nn/utils/)."""
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate([p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    data = vec._data
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p.set_value(Tensor(data[offset : offset + n].reshape(tuple(p.shape))))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Simplified weight-norm: reparameterize at call time via a pre-hook."""
+    import jax
+
+    w = layer._parameters[name]
+    dim_ = dim if dim is not None else -1
+    axes = tuple(i for i in range(w.ndim) if i != (dim_ % w.ndim)) if dim is not None else None
+    g = Tensor(jnp.sqrt(jnp.sum(jnp.square(w._data), axis=axes, keepdims=True)))
+    from ...framework.core import Parameter
+
+    layer.add_parameter(name + "_g", Parameter(g._data))
+    layer.add_parameter(name + "_v", Parameter(w._data))
+
+    def hook(l, inputs):
+        v = l._parameters[name + "_v"]
+        gg = l._parameters[name + "_g"]
+        norm_v = jnp.sqrt(jnp.sum(jnp.square(v._data), axis=axes, keepdims=True))
+        l._parameters[name] = Parameter(v._data / norm_v * gg._data)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_handle = handle
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    if hasattr(layer, "_weight_norm_handle"):
+        layer._weight_norm_handle.remove()
+        del layer._parameters[name + "_g"]
+        del layer._parameters[name + "_v"]
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    return layer

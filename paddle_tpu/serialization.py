@@ -1,0 +1,69 @@
+"""paddle.save / paddle.load parity (reference: python/paddle/framework/io.py).
+
+Format: pickle of nested containers with tensors materialized as numpy arrays
+(bfloat16 kept via ml_dtypes). A restricted unpickler guards load, mirroring
+the reference's safe-unpickler concern.
+"""
+import io
+import os
+import pickle
+
+import numpy as np
+
+from .framework.core import Parameter, Tensor
+
+
+def _to_storable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._data), "param": isinstance(obj, Parameter)}
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_storable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_storable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            cls = Parameter if obj.get("param") else Tensor
+            return cls(obj["data"])
+        return {k: _from_storable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_storable(v, return_numpy) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+_SAFE_MODULES = {"numpy", "numpy.core.multiarray", "numpy._core.multiarray", "ml_dtypes", "collections"}
+
+
+class _SafeUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        root = module.split(".")[0]
+        if root in ("numpy", "ml_dtypes", "collections", "builtins"):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(f"blocked unpickle of {module}.{name}")
+
+
+def save(obj, path, protocol=4, **configs):
+    if hasattr(path, "write"):
+        pickle.dump(_to_storable(obj), path, protocol=protocol)
+        return
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_storable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    if hasattr(path, "read"):
+        raw = _SafeUnpickler(path).load()
+    else:
+        with open(path, "rb") as f:
+            raw = _SafeUnpickler(f).load()
+    return _from_storable(raw, return_numpy=return_numpy)

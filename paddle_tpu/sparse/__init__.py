@@ -1,0 +1,113 @@
+"""paddle.sparse parity (reference: python/paddle/sparse/ + phi sparse
+kernels).
+
+TPU note: XLA has no native sparse layouts; COO/CSR tensors here are
+index+values containers whose compute lowers to dense/segment ops (gather,
+scatter-add, segment_sum) — the idiomatic TPU treatment of sparsity. The API
+surface (sparse_coo_tensor, to_dense, matmul, nn.ReLU...) mirrors the
+reference.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_tensor
+
+
+class SparseCooTensor(Tensor):
+    def __init__(self, indices, values, shape):
+        self._indices = indices  # [ndim, nnz] int array
+        self._values = values  # [nnz, ...] array
+        self._dense_shape = tuple(int(s) for s in shape)
+        dense = jnp.zeros(self._dense_shape, values.dtype).at[tuple(indices)].add(values)
+        super().__init__(dense, stop_gradient=True)
+
+    def indices(self):
+        return Tensor(self._indices)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def to_dense(self):
+        return Tensor(self._data)
+
+    def is_sparse_coo(self):
+        return True
+
+    def nnz(self):
+        return self._values.shape[0]
+
+
+class SparseCsrTensor(Tensor):
+    def __init__(self, crows, cols, values, shape):
+        self._crows, self._cols, self._values = crows, cols, values
+        self._dense_shape = tuple(int(s) for s in shape)
+        rows = jnp.repeat(jnp.arange(len(crows) - 1), jnp.diff(crows))
+        dense = jnp.zeros(self._dense_shape, values.dtype).at[rows, cols].add(values)
+        super().__init__(dense, stop_gradient=True)
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def to_dense(self):
+        return Tensor(self._data)
+
+    def is_sparse_csr(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    idx = to_tensor(indices)._data.astype(jnp.int32)
+    vals = to_tensor(values)._data
+    if dtype is not None:
+        from ..framework import dtype as dtypes
+
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    if shape is None:
+        shape = tuple((np.asarray(idx).max(axis=1) + 1).tolist()) + tuple(vals.shape[1:])
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    return SparseCsrTensor(
+        to_tensor(crows)._data.astype(jnp.int32),
+        to_tensor(cols)._data.astype(jnp.int32),
+        to_tensor(values)._data,
+        shape,
+    )
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def matmul(x, y, name=None):
+    from ..tensor import linalg
+
+    return linalg.matmul(x.to_dense() if hasattr(x, "to_dense") else x, y)
+
+
+def masked_matmul(x, y, mask, name=None):
+    from ..tensor import linalg
+
+    out = linalg.matmul(x, y)
+    return Tensor(jnp.where(mask._data != 0, out._data, 0.0))
+
+
+def add(x, y, name=None):
+    return Tensor(x._data + y._data)
+
+
+def multiply(x, y, name=None):
+    return Tensor(x._data * y._data)
+
+
+class nn:
+    class ReLU:
+        def __call__(self, x):
+            return Tensor(jnp.maximum(x._data, 0))

@@ -1,0 +1,94 @@
+"""Static-graph compatibility layer (reference: python/paddle/static/).
+
+On TPU, "static mode" IS jax.jit — the traced program is the Program and XLA
+is the executor (reference: Program/Executor/InterpreterCore in
+paddle/fluid/framework/new_executor/, which SURVEY.md §3.5 maps to XLA).
+This module keeps the script-level API (enable_static, Executor, data) as a
+thin veneer: programs are recorded as traced python callables.
+"""
+import jax
+
+from ..framework.core import Tensor, to_tensor
+
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_static_mode():
+    return _static_mode
+
+
+class Program:
+    def __init__(self):
+        self._fns = []
+        self.random_seed = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+def program_guard(main_program, startup_program=None):
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+class InputSpec:
+    """paddle.static.InputSpec parity — shape/dtype/name spec used by
+    jit.to_static and hapi.Model."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=False):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None):
+        # static programs are python callables under jit in this framework
+        if callable(program):
+            out = program(**{k: to_tensor(v) for k, v in (feed or {}).items()})
+            return out if isinstance(out, (list, tuple)) else [out]
+        raise NotImplementedError(
+            "Executor.run over legacy Program objects is not supported; use "
+            "paddle_tpu.jit.to_static-compiled callables (XLA is the executor)"
+        )

@@ -1,0 +1,73 @@
+"""paddle_tpu.tensor — the op surface (reference: python/paddle/tensor/).
+
+Functions live in submodules; this package re-exports them and installs them
+as Tensor methods + Python operators (reference installs methods via
+monkey-patching in python/paddle/tensor/__init__.py too).
+"""
+from ..framework.core import Tensor
+from . import creation, einsum as _einsum_mod, linalg, logic, manipulation, math, search
+from .creation import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+_METHOD_SOURCES = [math, manipulation, linalg, logic, search, creation]
+
+# name → (module, function) explicit method table where names differ
+_EXPLICIT = {
+    "einsum": _einsum_mod.einsum,
+}
+
+
+def _install_methods():
+    method_names = set()
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith("_"):
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if name in ("to_tensor", "slice_obj", "builtins_slice", "apply") or getattr(
+                fn, "__module__", ""
+            ).startswith(("jax", "scipy")):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+                method_names.add(name)
+
+    # operators
+    Tensor.__add__ = lambda s, o: math.add(s, o)
+    Tensor.__radd__ = lambda s, o: math.add(o, s)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+    Tensor.__mod__ = lambda s, o: math.remainder(s, o)
+    Tensor.__pow__ = lambda s, o: math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    Tensor.__invert__ = lambda s: logic.logical_not(s)
+    Tensor.__and__ = lambda s, o: logic.bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: logic.bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: logic.bitwise_xor(s, o)
+    Tensor.__hash__ = object.__hash__
+
+
+_install_methods()

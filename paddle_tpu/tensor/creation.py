@@ -1,0 +1,226 @@
+"""Creation ops (reference: python/paddle/tensor/creation.py)."""
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework import random as prandom
+from ..framework.core import Tensor, apply, to_tensor  # noqa: F401
+
+
+def _dt(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else (default or dtypes.get_default_dtype())
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = dtypes.get_default_dtype() if isinstance(fill_value, float) else None
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype) if dtype is not None else None))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = to_tensor(x)
+    return Tensor(jnp.zeros(x._data.shape, _dt(dtype, np.dtype(x.dtype))))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = to_tensor(x)
+    return Tensor(jnp.ones(x._data.shape, _dt(dtype, np.dtype(x.dtype))))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = to_tensor(x)
+    return Tensor(jnp.full(x._data.shape, fill_value, _dt(dtype, np.dtype(x.dtype))))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            dtypes.get_default_dtype()
+            if any(isinstance(v, float) for v in (start, end, step))
+            else np.int64
+        )
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = to_tensor(x)
+    if x.ndim == 1 and padding_value != 0:
+
+        def fn(a):
+            n = a.shape[0] + abs(offset)
+            out = jnp.full((n, n), padding_value, a.dtype)
+            idx = jnp.arange(a.shape[0])
+            r, c = (idx, idx + offset) if offset >= 0 else (idx - offset, idx)
+            return out.at[r, c].set(a)
+
+        return apply(fn, x, name="diag")
+    return apply(lambda a: jnp.diag(a, k=offset), x, name="diag")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    x = to_tensor(x)
+    return apply(lambda a: _diag_embed(a, offset, dim1, dim2), x, name="diag_embed")
+
+
+def _diag_embed(a, offset, dim1, dim2):
+    n = a.shape[-1] + abs(offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    r, c = (idx, idx + offset) if offset >= 0 else (idx - offset, idx)
+    out = out.at[..., r, c].set(a)
+    if (dim1, dim2) not in ((-2, -1), (a.ndim - 1, a.ndim)):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.tril(a, k=diagonal), to_tensor(x), name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.triu(a, k=diagonal), to_tensor(x), name="triu")
+
+
+def meshgrid(*args, **kwargs):
+    ts = [to_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*[t._data for t in ts], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    t = to_tensor(x)
+    if output is not None:
+        output.set_value(t)
+        return output
+    return t.clone() if not t.stop_gradient else Tensor(t._data)
+
+
+def clone(x):
+    return to_tensor(x).clone()
+
+
+def complex(real, imag, name=None):
+    return apply(lambda r, i: r + 1j * i.astype(jnp.result_type(i.dtype, jnp.complex64)), real, imag)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), _dt(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), _dt(dtype)))
+
+
+# -- random creation (python/paddle/tensor/random.py) -----------------------
+import jax
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(prandom.next_key(), _shape(shape), _dt(dtype)))
+
+
+uniform_random = rand
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(prandom.next_key(), _shape(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = to_tensor(mean)._data
+        s = to_tensor(std)._data
+        shp = jnp.broadcast_shapes(np.shape(m), np.shape(s))
+        return Tensor(jax.random.normal(prandom.next_key(), shp, dtypes.get_default_dtype()) * s + m)
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(prandom.next_key(), shp, dtypes.get_default_dtype()) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else prandom.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype), minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(prandom.next_key(), _shape(shape), low, high, _dt(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    x = to_tensor(x)
+    if high is None:
+        low, high = 0, low
+    return Tensor(
+        jax.random.randint(prandom.next_key(), tuple(x._data.shape), low, high, _dt(dtype, np.dtype(x.dtype)))
+    )
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(prandom.next_key(), n).astype(_dt(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = to_tensor(x)
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    if replacement:
+        out = jax.random.categorical(prandom.next_key(), logits, axis=-1, shape=(num_samples,) + x._data.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        k = prandom.next_key()
+        g = jax.random.gumbel(k, x._data.shape)
+        out = jax.lax.top_k(logits + g, num_samples)[1]
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    x = to_tensor(x)
+    return Tensor(
+        (jax.random.uniform(prandom.next_key(), tuple(x._data.shape)) < x._data).astype(x.dtype)
+    )

@@ -1,0 +1,9 @@
+"""einsum (reference: python/paddle/tensor/einsum.py) — direct jnp lowering."""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply, to_tensor
+
+
+def einsum(equation, *operands):
+    ts = [o if isinstance(o, Tensor) else to_tensor(o) for o in operands]
+    return apply(lambda *arrs: jnp.einsum(equation, *arrs), *ts, name="einsum")

@@ -1,0 +1,249 @@
+"""Linear algebra (reference: python/paddle/tensor/linalg.py).
+
+matmul is THE op on TPU: it lowers straight to MXU dot_general. No blas
+wrapper layer exists (reference needed cuBLAS glue; XLA is our BLAS).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        from ..amp.auto_cast import amp_cast_inputs
+
+        a, b = amp_cast_inputs("matmul", [a, b])
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return a @ b
+
+    return apply(fn, _t(x), _t(y), name="matmul")
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, _t(x), _t(y), name="bmm")
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, _t(x), _t(vec), name="mv")
+
+
+def t(x, name=None):
+    x = _t(x)
+    if x.ndim < 2:
+        return x.clone()
+    return apply(lambda a: jnp.swapaxes(a, -1, -2), x, name="t")
+
+
+def dist(x, y, p=2.0, name=None):
+    return apply(lambda a, b: _p_norm(a - b, p), _t(x), _t(y), name="dist")
+
+
+def _p_norm(a, p, axis=None, keepdims=False):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdims)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdims)
+    if p == 0:
+        return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdims)
+    return jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=keepdims) ** (1.0 / p)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = _t(x)
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2.0
+    if p == "fro":
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return apply(lambda a: jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim)), x, name="fro_norm")
+    if p == "nuc":
+        return apply(lambda a: jnp.sum(jnp.linalg.svd(a, compute_uv=False), axis=-1), x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda a: _p_norm(a, p, axis=ax, keepdims=keepdim), x, name="p_norm")
+
+
+def p_norm(x, p=2.0, axis=None, keepdim=False):
+    return norm(x, p, axis, keepdim)
+
+
+def cond(x, p=None, name=None):
+    return apply(lambda a: jnp.linalg.cond(a, p=p), _t(x))
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), _t(x), _t(y), name="dot")
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return apply(fn, _t(x), name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, L):
+        Lm = jnp.swapaxes(L, -1, -2) if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(Lm, -1, -2), z, lower=False)
+
+    return apply(fn, _t(x), _t(y))
+
+
+def inverse(x, name=None):
+    return apply(jnp.linalg.inv, _t(x), name="inverse")
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), _t(x))
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, _t(x), name="det")
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logabs])
+
+    return apply(fn, _t(x), name="slogdet")
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, _t(x), _t(y), name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return apply(fn, _t(x), _t(y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    a, b = _t(x)._data, _t(y)._data
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def qr(x, mode="reduced", name=None):
+    x = _t(x)
+    if mode == "r":
+        return apply(lambda a: jnp.linalg.qr(a, mode="r"), x)
+    q, r = jnp.linalg.qr(x._data, mode=mode)
+
+    def fn(a):
+        return jnp.linalg.qr(a, mode=mode)
+
+    return apply(fn, x, name="qr")
+
+
+def svd(x, full_matrices=False, name=None):
+    def fn(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2)  # paddle returns V^H as vh? paddle returns vh
+
+    # paddle.linalg.svd returns (U, S, VH)
+    def fn2(a):
+        return jnp.linalg.svd(a, full_matrices=full_matrices)
+
+    return apply(fn2, _t(x), name="svd")
+
+
+def svdvals(x, name=None):
+    return apply(lambda a: jnp.linalg.svd(a, compute_uv=False), _t(x))
+
+
+def eig(x, name=None):
+    vals, vecs = np.linalg.eig(np.asarray(_t(x)._data))
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(vecs))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigh(a, UPLO=UPLO), _t(x), name="eigh")
+
+
+def eigvals(x, name=None):
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(_t(x)._data))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), _t(x))
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), _t(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(_t(x)._data, rtol=tol))
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *arrs: jnp.linalg.multi_dot(arrs), *[_t(v) for v in x])
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    a = _t(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(a._data)
+    outs = (Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), jnp.int32)),)
+    return outs
+
+
+def householder_product(x, tau, name=None):
+    a, t_ = np.asarray(_t(x)._data), np.asarray(_t(tau)._data)
+    m, n = a.shape[-2], a.shape[-1]
+    q = np.eye(m, dtype=a.dtype)
+    for i in range(len(t_) - 1, -1, -1):
+        v = np.zeros(m, dtype=a.dtype)
+        v[i] = 1.0
+        v[i + 1 :] = a[i + 1 :, i]
+        q = (np.eye(m) - t_[i] * np.outer(v, v)) @ q
+    return Tensor(jnp.asarray(q[:, :n]))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), _t(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), _t(x))
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = np.asarray(_t(input)._data)
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = np.histogram(a, bins=bins, range=rng)
+    return Tensor(jnp.asarray(hist.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = _t(x)._data
+    w = _t(weights)._data if weights is not None else None
+    length = int(np.maximum(np.asarray(a).max(initial=-1) + 1, minlength))
+    return Tensor(jnp.bincount(a, weights=w, length=length))
+
+
+def matrix_exp(x, name=None):
+    return apply(jax.scipy.linalg.expm, _t(x))

@@ -1,0 +1,63 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _cmp(fn):
+    def op(x, y, name=None):
+        a = _t(x)._data
+        b = y if isinstance(y, (int, float, bool)) else _t(y)._data
+        return Tensor(fn(a, b))
+
+    return op
+
+
+equal = _cmp(jnp.equal)
+not_equal = _cmp(jnp.not_equal)
+greater_than = _cmp(jnp.greater)
+greater_equal = _cmp(jnp.greater_equal)
+less_than = _cmp(jnp.less)
+less_equal = _cmp(jnp.less_equal)
+logical_and = _cmp(jnp.logical_and)
+logical_or = _cmp(jnp.logical_or)
+logical_xor = _cmp(jnp.logical_xor)
+bitwise_and = _cmp(jnp.bitwise_and)
+bitwise_or = _cmp(jnp.bitwise_or)
+bitwise_xor = _cmp(jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return Tensor(jnp.logical_not(_t(x)._data))
+
+
+def bitwise_not(x, name=None):
+    return Tensor(jnp.bitwise_not(_t(x)._data))
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(_t(x)._data, _t(y)._data))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(_t(x)._data, _t(y)._data, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(_t(x)._data, _t(y)._data, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_t(x).size == 0))
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return Tensor(jnp.isin(_t(x)._data, _t(test_x)._data, invert=invert))

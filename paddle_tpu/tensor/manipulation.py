@@ -1,0 +1,494 @@
+"""Shape/layout ops (reference: python/paddle/tensor/manipulation.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _shape_arg(shape):
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = tuple(int(v) for v in shape.numpy())
+    else:
+        shape = _shape_arg(shape)
+    # Paddle semantics: 0 means "copy this dim from input".
+    x = _t(x)
+    shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return apply(lambda a: jnp.reshape(a, shape), x, name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _t(x)
+    nd = x.ndim
+    s, e = start_axis % nd, stop_axis % nd
+    new_shape = x.shape[:s] + [int(np.prod(x.shape[s : e + 1]))] + x.shape[e + 1 :]
+    return reshape(x, new_shape)
+
+
+def squeeze(x, axis=None, name=None):
+    x = _t(x)
+    if axis is None:
+        ax = None
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+    return apply(lambda a: jnp.squeeze(a, axis=ax), x, name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a._data) if isinstance(a, Tensor) else int(a) for a in axes]
+    return apply(lambda a: jnp.expand_dims(a, axis=tuple(axes)), _t(x), name="unsqueeze")
+
+
+unsqueeze_ = unsqueeze
+
+
+def transpose(x, perm, name=None):
+    return apply(lambda a: jnp.transpose(a, axes=tuple(perm)), _t(x), name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), _t(x))
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis1, axis2), _t(x))
+
+
+swapdims = swapaxes
+
+
+def concat(x, axis=0, name=None):
+    ts = [_t(v) for v in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply(lambda *arrs: jnp.concatenate(arrs, axis=axis), *ts, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    ts = [_t(v) for v in x]
+    return apply(lambda *arrs: jnp.stack(arrs, axis=axis), *ts, name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ax = axis % x.ndim
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        if x.shape[ax] % n != 0:
+            raise ValueError(
+                f"split: dimension {ax} (size {x.shape[ax]}) is not divisible by {n}; "
+                "pass explicit section sizes instead"
+            )
+        sizes = [x.shape[ax] // n] * n
+    else:
+        sizes = [
+            int(s._data) if isinstance(s, Tensor) else int(s) for s in num_or_sections
+        ]
+        total = x.shape[ax]
+        if -1 in sizes:
+            known = sum(s for s in sizes if s != -1)
+            sizes[sizes.index(-1)] = total - known
+    offsets = np.cumsum([0] + sizes)
+
+    def fn(a):
+        return tuple(jax.lax.slice_in_dim(a, int(offsets[i]), int(offsets[i + 1]), axis=ax) for i in range(len(sizes)))
+
+    return list(apply(fn, x, name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = _t(x)
+    n = x.shape[axis % x.ndim]
+    outs = split(x, n, axis)
+    return [squeeze(o, axis) for o in outs]
+
+
+def tile(x, repeat_times, name=None):
+    reps = tuple(int(r._data) if isinstance(r, Tensor) else int(r) for r in repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), _t(x), name="tile")
+
+
+def expand(x, shape, name=None):
+    x = _t(x)
+    shape = [int(s._data) if isinstance(s, Tensor) else int(s) for s in shape]
+    shape = [x.shape[i - (len(shape) - x.ndim)] if s == -1 and i >= len(shape) - x.ndim else s for i, s in enumerate(shape)]
+    return apply(lambda a: jnp.broadcast_to(a, tuple(shape)), x, name="expand")
+
+
+def expand_as(x, y, name=None):
+    return apply(lambda a: jnp.broadcast_to(a, tuple(_t(y).shape)), _t(x))
+
+
+def broadcast_to(x, shape, name=None):
+    return apply(lambda a: jnp.broadcast_to(a, tuple(shape)), _t(x), name="broadcast_to")
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [_t(v) for v in inputs]
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in ts])
+    return [broadcast_to(t, shape) for t in ts]
+
+
+def flip(x, axis, name=None):
+    axes = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply(lambda a: jnp.flip(a, axis=axes), _t(x), name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), _t(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda a: jnp.roll(a, shifts, axis=axis), _t(x), name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    idx = _t(index)._data
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if idx.ndim > 1:
+        idx = idx.reshape(-1)
+    return apply(lambda a: jnp.take(a, idx, axis=axis), _t(x), name="gather")
+
+
+def gather_nd(x, index, name=None):
+    idx = _t(index)._data
+
+    def fn(a):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return apply(fn, _t(x), name="gather_nd")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    idx = _t(indices)._data
+    arr = _t(arr)
+    if broadcast:
+        tgt = list(arr.shape)
+        tgt[axis] = idx.shape[axis]
+        idx = jnp.broadcast_to(idx, tuple(tgt))
+    return apply(lambda a: jnp.take_along_axis(a, idx, axis=axis), arr, name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None, **kw):
+    idx = _t(indices)._data
+    arr_t = _t(arr)
+    idx_full = jnp.broadcast_to(idx, tuple(arr_t.shape[:axis]) + (idx.shape[axis],) + tuple(arr_t.shape[axis + 1 :]))
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in idx_full.shape], indexing="ij")
+    grids[axis] = idx_full
+    locs = tuple(grids)
+
+    def fn(a, v):
+        v = jnp.broadcast_to(v, idx_full.shape)
+        ref = a.at[locs]
+        if reduce == "assign":
+            return ref.set(v)
+        if reduce in ("add", "sum"):
+            return ref.add(v)
+        if reduce in ("mul", "multiply"):
+            return ref.multiply(v)
+        if reduce == "amax":
+            return ref.max(v)
+        if reduce == "amin":
+            return ref.min(v)
+        raise ValueError(reduce)
+
+    return apply(fn, arr_t, _t(values), name="put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = _t(index)._data.reshape(-1)
+
+    def fn(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        return a.at[idx].set(0).at[idx].add(u)
+
+    return apply(fn, _t(x), _t(updates), name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = _t(index)._data
+
+    def fn(a, u):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+
+    return apply(fn, _t(x), _t(updates), name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    zeros = Tensor(jnp.zeros(tuple(shape), _t(updates).dtype))
+    return scatter_nd_add(zeros, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index):
+    idx = _t(index)._data
+
+    def fn(a):
+        return jnp.take_along_axis(a, idx, axis=1)
+
+    return apply(fn, _t(x), name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = _t(index)._data
+
+    def fn(a, v):
+        sl = [slice(None)] * a.ndim
+        sl[axis] = idx
+        return a.at[tuple(sl)].add(v)
+
+    return apply(fn, _t(x), _t(value), name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    locs = tuple(_t(i)._data for i in indices)
+
+    def fn(a, v):
+        return a.at[locs].add(v) if accumulate else a.at[locs].set(v)
+
+    return apply(fn, _t(x), _t(value), name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    x, mask = _t(x), _t(mask)
+    return Tensor(x._data[mask._data])
+
+
+def masked_fill(x, mask, value, name=None):
+    m = _t(mask)._data
+    v = value.item() if isinstance(value, Tensor) and value.size == 1 else value
+    if isinstance(v, Tensor):
+        return apply(lambda a, b: jnp.where(m, b, a), _t(x), v, name="masked_fill")
+    return apply(lambda a: jnp.where(m, v, a), _t(x), name="masked_fill")
+
+
+def masked_scatter(x, mask, value, name=None):
+    x, mask, value = _t(x), _t(mask), _t(value)
+    m = mask._data
+    flat_idx = jnp.cumsum(m.reshape(-1)) - 1
+
+    def fn(a, v):
+        picked = v.reshape(-1)[jnp.clip(flat_idx, 0, v.size - 1)].reshape(a.shape)
+        return jnp.where(m, picked, a)
+
+    return apply(fn, x, value, name="masked_scatter")
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = _t(condition)._data
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda a, b: jnp.where(cond, a, b), _t(x), _t(y), name="where")
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(_t(x)._data)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v)) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(_t(x)._data)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(r)) for r in res]
+    if return_index:
+        # paddle's unique does not return first-occurrence index unless asked;
+        # numpy ordering differs (sorted) — acceptable here.
+        pass
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(_t(x)._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        change = np.concatenate([[True], arr[1:] != arr[:-1]])
+        vals = arr[change]
+        outs = [Tensor(jnp.asarray(vals))]
+        if return_inverse:
+            outs.append(Tensor(jnp.asarray(np.cumsum(change) - 1)))
+        if return_counts:
+            idx = np.nonzero(change)[0]
+            outs.append(Tensor(jnp.asarray(np.diff(np.append(idx, arr.size)))))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = _t(x)
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in pad.numpy()]
+    pad = list(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-rank spec: per-dim (low, high) pairs in dim order
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims, last dim first
+        n = len(pad) // 2
+        rev = [(pad[2 * i], pad[2 * i + 1]) for i in range(n)]
+        widths = [(0, 0)] * (nd - n) + rev[::-1]
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    kw = {"constant_values": value} if jmode == "constant" else {}
+    return apply(lambda a: jnp.pad(a, widths, mode=jmode, **kw), x, name="pad")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = _t(x)
+    if isinstance(repeats, Tensor):
+        reps = repeats._data
+        return Tensor(jnp.repeat(x._data if axis is not None else x._data.reshape(-1), reps, axis=axis if axis is not None else 0))
+    return apply(
+        lambda a: jnp.repeat(a if axis is not None else a.reshape(-1), repeats, axis=axis if axis is not None else 0),
+        x,
+    )
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    arr = np.lib.stride_tricks.as_strided(
+        np.asarray(_t(x)._data).reshape(-1)[offset:],
+        shape=tuple(shape),
+        strides=tuple(s * np.dtype(_t(x).dtype).itemsize for s in stride),
+    )
+    return Tensor(jnp.asarray(arr.copy()))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return _t(x).astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, _t(other).shape)
+
+
+def slice(x, axes, starts, ends):
+    x = _t(x)
+    sl = [builtins_slice()] * x.ndim if False else [None] * 0
+    idx = [slice_obj(None) for _ in range(x.ndim)]
+    for ax, s, e in zip(axes, starts, ends):
+        s = int(s._data) if isinstance(s, Tensor) else int(s)
+        e = int(e._data) if isinstance(e, Tensor) else int(e)
+        idx[ax] = slice_obj(s, e)
+    idx = tuple(idx)
+    return apply(lambda a: a[idx], x, name="slice")
+
+
+def slice_obj(*args):
+    import builtins
+
+    return builtins.slice(*args)
+
+
+def builtins_slice():
+    import builtins
+
+    return builtins.slice(None)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = _t(x)
+    idx = [slice_obj(None) for _ in range(x.ndim)]
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = slice_obj(int(s), int(e), int(st))
+    idx = tuple(idx)
+    return apply(lambda a: a[idx], x, name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _t(x)
+    offsets = offsets or [0] * x.ndim
+    idx = tuple(slice_obj(int(o), int(o) + int(s) if int(s) != -1 else None) for o, s in zip(offsets, shape))
+    return apply(lambda a: a[idx], x, name="crop")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = (index_num + nshards - 1) // nshards
+
+    def fn(a):
+        shard = a // size
+        return jnp.where(shard == shard_id, a % size, ignore_value)
+
+    return Tensor(fn(_t(input)._data))
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.numpy().tolist()
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in ax)
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), _t(x), _t(y), name="tensordot")
+
+
+def atleast_1d(*inputs):
+    outs = [apply(jnp.atleast_1d, _t(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs):
+    outs = [apply(jnp.atleast_2d, _t(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs):
+    outs = [apply(jnp.atleast_3d, _t(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def hstack(x, name=None):
+    return apply(lambda *arrs: jnp.hstack(arrs), *[_t(v) for v in x])
+
+
+def vstack(x, name=None):
+    return apply(lambda *arrs: jnp.vstack(arrs), *[_t(v) for v in x])
+
+
+def dstack(x, name=None):
+    return apply(lambda *arrs: jnp.dstack(arrs), *[_t(v) for v in x])
+
+
+def dsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=2)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=1 if _t(x).ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return split(x, num_or_indices, axis=0)

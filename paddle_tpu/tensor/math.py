@@ -1,0 +1,381 @@
+"""Elementwise & reduction math (reference: python/paddle/tensor/math.py).
+
+Every op is a jnp lambda under `apply`, so XLA fuses chains of these into
+single kernels when the surrounding step is jit-compiled.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _binary(fn, name):
+    def op(x, y, name_=None, **kw):
+        if isinstance(y, (int, float, bool)) and not isinstance(y, Tensor):
+            return apply(lambda a: fn(a, y), _t(x), name=name)
+        if isinstance(x, (int, float, bool)) and not isinstance(x, Tensor):
+            return apply(lambda b: fn(x, b), _t(y), name=name)
+        return apply(fn, _t(x), _t(y), name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _unary(fn, name):
+    def op(x, name_=None, **kw):
+        return apply(lambda a: fn(a, **kw) if kw else fn(a), _t(x), name=name)
+
+    op.__name__ = name
+    return op
+
+
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+divide = _binary(jnp.divide, "divide")
+floor_divide = _binary(jnp.floor_divide, "floor_divide")
+remainder = _binary(jnp.remainder, "remainder")
+mod = remainder
+floor_mod = remainder
+pow = _binary(jnp.power, "pow")
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+fmax = _binary(jnp.fmax, "fmax")
+fmin = _binary(jnp.fmin, "fmin")
+atan2 = _binary(jnp.arctan2, "atan2")
+hypot = _binary(jnp.hypot, "hypot")
+logaddexp = _binary(jnp.logaddexp, "logaddexp")
+nextafter = _binary(jnp.nextafter, "nextafter")
+copysign = _binary(jnp.copysign, "copysign")
+heaviside = _binary(jnp.heaviside, "heaviside")
+gcd = _binary(jnp.gcd, "gcd")
+lcm = _binary(jnp.lcm, "lcm")
+
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
+square = _unary(jnp.square, "square")
+abs = _unary(jnp.abs, "abs")
+neg = _unary(jnp.negative, "neg")
+sign = _unary(jnp.sign, "sign")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+frac = _unary(lambda x: x - jnp.trunc(x), "frac")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+logit = _unary(jax.scipy.special.logit, "logit")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+gamma = _unary(lambda x: jnp.exp(jax.scipy.special.gammaln(x)) * jnp.sign(x), "gamma")
+i0 = _unary(jax.scipy.special.i0, "i0")
+i1 = _unary(jax.scipy.special.i1, "i1")
+angle = _unary(jnp.angle, "angle")
+conj = _unary(jnp.conj, "conj")
+real = _unary(jnp.real, "real")
+imag = _unary(jnp.imag, "imag")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+exponential_ = None  # in-place random not supported; use creation.uniform
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def fn(a):
+        out = a * scale + bias if bias_after_scale else (a + bias) * scale
+        return out
+
+    return apply(fn, _t(x), name="scale")
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, mn, mx), _t(x), name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), _t(x), _t(y), weight, name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), _t(x), _t(y), name="lerp")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), _t(input), _t(x), _t(y), name="addmm")
+
+
+def multiplex(inputs, index, name=None):
+    stacked = jnp.stack([_t(i)._data for i in inputs], 1)
+    idx = _t(index)._data.reshape(-1)
+    return Tensor(jnp.take_along_axis(stacked, idx[:, None, *([None] * (stacked.ndim - 2))], axis=1).squeeze(1))
+
+
+# -- reductions --------------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy()
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    x = _t(x)
+    dt = dtypes.convert_dtype(dtype)
+    if dt is None and np.issubdtype(np.dtype(x.dtype), np.bool_):
+        dt = np.dtype(np.int64)
+    return apply(lambda a: jnp.sum(a, axis=_axis(axis), dtype=dt, keepdims=keepdim), x, name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), _t(x), name="mean")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return apply(
+        lambda a: jnp.prod(a, axis=_axis(axis), dtype=dtypes.convert_dtype(dtype), keepdims=keepdim),
+        _t(x),
+        name="prod",
+    )
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), _t(x), name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), _t(x), name="min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(
+        lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim), _t(x), name="logsumexp"
+    )
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = _t(x)
+    if axis is None:
+        return apply(lambda a: jnp.cumsum(a.reshape(-1), dtype=dtypes.convert_dtype(dtype)), x)
+    return apply(lambda a: jnp.cumsum(a, axis=int(axis), dtype=dtypes.convert_dtype(dtype)), x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply(lambda a: jnp.cumprod(a, axis=dim, dtype=dtypes.convert_dtype(dtype)), _t(x))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = _t(x)
+    ax = 0 if axis is None else int(axis)
+    a = x._data.reshape(-1) if axis is None else x._data
+    vals = jax.lax.associative_scan(jnp.maximum, a, axis=ax)
+    idx_src = jnp.arange(a.shape[ax]).reshape([-1 if i == ax % a.ndim else 1 for i in range(a.ndim)])
+    idx = jnp.where(a == vals, jnp.broadcast_to(idx_src, a.shape), 0)
+    idx = jax.lax.associative_scan(jnp.maximum, idx, axis=ax)
+    values = apply(lambda t: jax.lax.associative_scan(jnp.maximum, t.reshape(-1) if axis is None else t, axis=ax), x)
+    return values, Tensor(idx.astype(dtypes.convert_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    neg, idx = cummax(-_t(x), axis, dtype)
+    return -neg, idx
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmean(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply(
+        lambda a: jnp.nansum(a, axis=_axis(axis), dtype=dtypes.convert_dtype(dtype), keepdims=keepdim), _t(x)
+    )
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.count_nonzero(_t(x)._data, axis=_axis(axis), keepdims=keepdim).astype(jnp.int64))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.all(_t(x)._data, axis=_axis(axis), keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return Tensor(jnp.any(_t(x)._data, axis=_axis(axis), keepdims=keepdim))
+
+
+def broadcast_shape(a, b):
+    return list(jnp.broadcast_shapes(tuple(a), tuple(b)))
+
+
+def increment(x, value=1.0, name=None):
+    x.set_value(Tensor(x._data + value))
+    return x
+
+
+def isfinite(x, name=None):
+    return Tensor(jnp.isfinite(_t(x)._data))
+
+
+def isinf(x, name=None):
+    return Tensor(jnp.isinf(_t(x)._data))
+
+
+def isnan(x, name=None):
+    return Tensor(jnp.isnan(_t(x)._data))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), _t(x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), _t(x))
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, _t(x), _t(y), name="inner")
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), _t(x), _t(y), name="outer")
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, _t(x), _t(y), name="kron")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [_t(x)]
+    kw = {}
+    fn = lambda a, *extra: jnp.diff(
+        a,
+        n=n,
+        axis=axis,
+        prepend=extra[0] if prepend is not None else None,
+        append=extra[-1] if append is not None else None,
+    )
+    if prepend is not None:
+        args.append(_t(prepend))
+    if append is not None:
+        args.append(_t(append))
+    return apply(fn, *args, name="diff")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), _t(x), name="trace")
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else (9 if 9 < _t(x).ndim else -1)
+    if ax == 9:
+        ax = next(i for i, s in enumerate(_t(x).shape) if s == 3)
+    return apply(lambda a, b: jnp.cross(a, b, axis=ax), _t(x), _t(y), name="cross")
+
+
+def dot(x, y, name=None):
+    def fn(a, b):
+        return jnp.sum(a * b, axis=-1)
+
+    return apply(fn, _t(x), _t(y), name="dot")
+
+
+def log_normalize(x, axis=-1):
+    return apply(lambda a: a - jax.scipy.special.logsumexp(a, axis=axis, keepdims=True), _t(x))
+
+
+def renorm(x, p, axis, max_norm):
+    def fn(a):
+        dims = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return a * factor
+
+    return apply(fn, _t(x), name="renorm")
+
+
+def take(x, index, mode="raise", name=None):
+    x, index = _t(x), _t(index)
+    idx = index._data
+    if mode == "wrap":
+        idx = idx % x.size
+    elif mode == "clip":
+        idx = jnp.clip(idx, -x.size, x.size - 1)
+    return apply(lambda a: a.reshape(-1)[idx], x, name="take")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = _t(y)
+    if x is not None:
+        return apply(lambda a, b: jax.scipy.integrate.trapezoid(a, b, axis=axis), y, _t(x))
+    return apply(lambda a: jax.scipy.integrate.trapezoid(a, dx=dx or 1.0, axis=axis), y)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = _t(x)
+    if mode == "avg":
+        return apply(lambda a: jnp.median(a, axis=_axis(axis), keepdims=keepdim), x)
+    ax = _axis(axis)
+    out = jnp.quantile(x._data, 0.5, axis=ax, keepdims=keepdim, method="lower")
+    idx = jnp.argmax((jnp.sort(x._data, axis=ax if ax is not None else None) == out), axis=ax)
+    return apply(lambda a: jnp.quantile(a, 0.5, axis=ax, keepdims=keepdim, method="lower"), x), Tensor(idx)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qv = q.numpy() if isinstance(q, Tensor) else q
+    return apply(
+        lambda a: jnp.quantile(a, jnp.asarray(qv), axis=_axis(axis), keepdims=keepdim, method=interpolation),
+        _t(x),
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    qv = q.numpy() if isinstance(q, Tensor) else q
+    return apply(lambda a: jnp.nanquantile(a, jnp.asarray(qv), axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        lambda a: jnp.std(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), _t(x), name="std"
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(
+        lambda a: jnp.var(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), _t(x), name="var"
+    )

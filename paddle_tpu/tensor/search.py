@@ -1,0 +1,103 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py).
+
+Integer-output ops (argmax/argsort/topk indices) are non-differentiable; ops
+with mixed outputs compute indices outside the tape and values via gather so
+gradients flow only through values.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, apply, to_tensor
+from . import manipulation
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    a = _t(x)._data
+    out = jnp.argmax(a.reshape(-1) if axis is None else a, axis=None if axis is None else int(axis))
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, int(axis))
+    return Tensor(out.astype(dtypes.convert_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    a = _t(x)._data
+    out = jnp.argmin(a.reshape(-1) if axis is None else a, axis=None if axis is None else int(axis))
+    if keepdim and axis is not None:
+        out = jnp.expand_dims(out, int(axis))
+    return Tensor(out.astype(dtypes.convert_dtype(dtype)))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    a = _t(x)._data
+    out = jnp.argsort(-a if descending else a, axis=axis, stable=stable or descending)
+    return Tensor(out.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    x = _t(x)
+    idx = argsort(x, axis=axis, descending=descending, stable=stable)._data
+    return manipulation.take_along_axis(x, Tensor(idx), axis=axis, broadcast=False)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = _t(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = -1 if axis is None else int(axis)
+
+    a = x._data
+    moved = jnp.moveaxis(a, ax, -1)
+    vals_idx = jax.lax.top_k(moved if largest else -moved, k)[1]
+    idx = jnp.moveaxis(vals_idx, -1, ax)
+    values = manipulation.take_along_axis(x, Tensor(idx), axis=ax, broadcast=False)
+    return values, Tensor(idx.astype(jnp.int64))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = _t(x)
+    idx_sorted = jnp.argsort(x._data, axis=axis)
+    idx = jnp.take(idx_sorted, k - 1, axis=axis)
+    idx_e = jnp.expand_dims(idx, axis)
+    vals = manipulation.take_along_axis(x, Tensor(idx_e), axis=axis, broadcast=False)
+    if not keepdim:
+        vals = manipulation.squeeze(vals, axis)
+    return vals, Tensor(idx.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(_t(x)._data)
+    from scipy import stats as _stats  # scipy ships with jax deps
+
+    m = _stats.mode(a, axis=axis, keepdims=keepdim)
+    return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(m.count))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    out = jnp.searchsorted(_t(sorted_sequence)._data, _t(values)._data, side="right" if right else "left")
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def index_fill(x, index, axis, value, name=None):
+    idx = _t(index)._data
+
+    def fn(a):
+        sl = [slice(None)] * a.ndim
+        sl[axis] = idx
+        return a.at[tuple(sl)].set(value)
+
+    return apply(fn, _t(x))
+
+
+def masked_argmax(x, mask, axis=None, keepdim=False):
+    a = jnp.where(_t(mask)._data, _t(x)._data, -jnp.inf)
+    return argmax(Tensor(a), axis=axis, keepdim=keepdim)

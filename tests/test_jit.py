@@ -1,0 +1,124 @@
+"""Compiled-step tests: the dygraph tape under jax.jit is ONE XLA program."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.jit_api import TrainStep
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestJit:
+    def test_jit_function(self):
+        calls = []
+
+        @paddle.jit
+        def f(x, y):
+            calls.append(1)
+            return paddle.matmul(x, y) + 1.0
+
+        a = t(np.random.rand(3, 3))
+        out1 = f(a, a)
+        out2 = f(a, a)
+        assert np.allclose(out1.numpy(), a.numpy() @ a.numpy() + 1, atol=1e-5)
+        assert len(calls) == 1  # traced once
+
+    def test_jit_with_tape_inside(self):
+        @paddle.jit
+        def grad_of_square(x):
+            x = paddle.to_tensor(x, stop_gradient=False)
+            y = (x * x).sum()
+            y.backward()
+            return x.grad
+
+        g = grad_of_square(t(np.array([3.0, 4.0])))
+        assert np.allclose(g.numpy(), [6.0, 8.0])
+
+    def test_to_static_layer(self):
+        l = nn.Linear(4, 2)
+        static = paddle.jit.to_static(l)
+        x = t(np.random.rand(3, 4))
+        assert np.allclose(static(x).numpy(), l(x).numpy(), atol=1e-6)
+
+
+class TestTrainStep:
+    def test_matches_eager_steps(self):
+        paddle.seed(7)
+        model_e = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+        paddle.seed(7)
+        model_c = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+        for pe, pc in zip(model_e.parameters(), model_c.parameters()):
+            assert np.allclose(pe.numpy(), pc.numpy())
+
+        loss_fn = lambda out, lab: ((out - lab) ** 2).mean()
+        opt_e = optimizer.AdamW(learning_rate=0.01, parameters=model_e.parameters())
+        opt_c = optimizer.AdamW(learning_rate=0.01, parameters=model_c.parameters())
+        step = TrainStep(model_c, loss_fn, opt_c)
+
+        x = np.random.rand(8, 4).astype(np.float32)
+        y = np.random.rand(8, 2).astype(np.float32)
+        for i in range(3):
+            # eager
+            loss_e = loss_fn(model_e(t(x)), t(y))
+            loss_e.backward()
+            opt_e.step()
+            opt_e.clear_grad()
+            # compiled
+            loss_c = step(t(x), t(y))
+            assert np.allclose(loss_e.numpy(), loss_c.numpy(), atol=1e-5), i
+        for pe, pc in zip(model_e.parameters(), model_c.parameters()):
+            assert np.allclose(pe.numpy(), pc.numpy(), atol=1e-4)
+
+    def test_bn_buffers_update_in_compiled_step(self):
+        model = nn.Sequential(nn.Linear(4, 4), nn.BatchNorm1D(4))
+        opt = optimizer.SGD(learning_rate=0.01, parameters=model.parameters())
+        step = TrainStep(model, lambda o, l: (o * o).mean(), opt)
+        before = model[1]._buffers["_mean"].numpy().copy()
+        step(t(np.random.rand(16, 4) + 5), t(np.zeros((16, 4))))
+        after = model[1]._buffers["_mean"].numpy()
+        assert not np.allclose(before, after)
+
+    def test_scaler_in_compiled_step(self):
+        model = nn.Linear(4, 2)
+        opt = optimizer.SGD(learning_rate=0.01, parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+        step = TrainStep(model, lambda o, l: ((o - l) ** 2).mean(), opt, scaler=scaler)
+        w0 = model.weight.numpy().copy()
+        loss = step(t(np.random.rand(4, 4)), t(np.random.rand(4, 2)))
+        assert np.isfinite(float(loss.numpy()))
+        assert not np.allclose(model.weight.numpy(), w0)
+
+    def test_lr_scheduler_advances(self):
+        model = nn.Linear(2, 2)
+        sched = optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+        opt = optimizer.SGD(learning_rate=sched, parameters=model.parameters())
+        step = TrainStep(model, lambda o, l: (o * o).mean(), opt)
+        step(t(np.random.rand(2, 2)), t(np.zeros((2, 2))))
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+class TestHapiModel:
+    def test_fit_reduces_loss(self):
+        from paddle_tpu.io import TensorDataset
+        from paddle_tpu.metric import Accuracy
+
+        paddle.seed(1)
+        n = 64
+        x = np.random.rand(n, 10).astype(np.float32)
+        w_true = np.random.rand(10, 3).astype(np.float32)
+        y = (x @ w_true).argmax(1).astype(np.int64)
+
+        net = nn.Sequential(nn.Linear(10, 32), nn.ReLU(), nn.Linear(32, 3))
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer.Adam(learning_rate=0.01, parameters=net.parameters()),
+            nn.CrossEntropyLoss(),
+            Accuracy(),
+        )
+        ds = TensorDataset([x, y])
+        model.fit(ds, batch_size=16, epochs=3, verbose=0)
+        res = model.evaluate(ds, batch_size=16, verbose=0)
+        assert res["acc"] > 0.5

@@ -1,0 +1,200 @@
+"""Layer system + layer zoo tests (reference blueprint: test/legacy_test
+API tests, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestLayerBase:
+    def test_parameter_registration(self):
+        l = nn.Linear(3, 4)
+        names = [n for n, _ in l.named_parameters()]
+        assert set(names) == {"weight", "bias"}
+        assert l.weight.shape == [3, 4]
+        assert not l.weight.stop_gradient
+
+    def test_sublayers_state_dict(self):
+        m = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+        sd = m.state_dict()
+        assert set(sd) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+        sd2 = {k: paddle.to_tensor(v.numpy() * 0) for k, v in sd.items()}
+        m.set_state_dict(sd2)
+        assert np.all(m[0].weight.numpy() == 0)
+
+    def test_train_eval_mode(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        assert "_mean" in dict(bn.named_buffers())
+        assert bn.state_dict().keys() >= {"weight", "bias", "_mean", "_variance"}
+
+    def test_apply_and_to_dtype(self):
+        m = nn.Linear(2, 2)
+        m.bfloat16()
+        assert m.weight.dtype == paddle.bfloat16
+        m.float()
+        assert m.weight.dtype == np.float32
+
+    def test_forward_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h = l.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        l(t(np.ones((1, 2))))
+        assert calls
+        h.remove()
+
+    def test_functional_call_substitutes(self):
+        l = nn.Linear(2, 2, bias_attr=False)
+        x = t(np.ones((1, 2)))
+        w_new = np.full((2, 2), 2.0, np.float32)
+        out = l.functional_call({"weight": paddle.to_tensor(w_new)}, x)
+        assert np.allclose(out.numpy(), np.ones((1, 2)) @ w_new)
+        # original restored
+        assert not np.allclose(l.weight.numpy(), w_new)
+
+
+class TestLayers:
+    def test_linear_oracle(self):
+        l = nn.Linear(3, 4)
+        x = np.random.rand(5, 3).astype(np.float32)
+        ref = x @ l.weight.numpy() + l.bias.numpy()
+        assert np.allclose(l(t(x)).numpy(), ref, atol=1e-5)
+
+    def test_conv2d_oracle_vs_scipy(self):
+        from scipy import signal
+
+        conv = nn.Conv2D(1, 1, 3, padding=1, bias_attr=False)
+        x = np.random.rand(1, 1, 8, 8).astype(np.float32)
+        w = conv.weight.numpy()[0, 0]
+        ref = signal.correlate2d(x[0, 0], w, mode="same")
+        out = conv(t(x)).numpy()[0, 0]
+        assert np.allclose(out, ref, atol=1e-4)
+
+    def test_layernorm_oracle(self):
+        ln = nn.LayerNorm(6)
+        x = np.random.rand(4, 6).astype(np.float32)
+        mu, var = x.mean(-1, keepdims=True), x.var(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(var + 1e-5) * ln.weight.numpy() + ln.bias.numpy()
+        assert np.allclose(ln(t(x)).numpy(), ref, atol=1e-4)
+
+    def test_batchnorm_running_stats(self):
+        bn = nn.BatchNorm1D(3, momentum=0.9)
+        x = np.random.rand(16, 3).astype(np.float32) * 2 + 1
+        bn(t(x))
+        assert not np.allclose(bn._buffers["_mean"].numpy(), 0)
+        bn.eval()
+        y = bn(t(x))
+        m, v = bn._buffers["_mean"].numpy(), bn._buffers["_variance"].numpy()
+        ref = (x - m) / np.sqrt(v + 1e-5) * bn.weight.numpy() + bn.bias.numpy()
+        assert np.allclose(y.numpy(), ref, atol=1e-4)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        idx = np.array([[1, 0, 3]])
+        out = emb(paddle.to_tensor(idx))
+        assert out.shape == [1, 3, 4]
+        assert np.all(out.numpy()[0, 1] == 0)
+
+    def test_dropout_statistics(self):
+        d = nn.Dropout(0.5)
+        x = t(np.ones((1000,)))
+        y = d(x).numpy()
+        assert 0.3 < (y == 0).mean() < 0.7
+        assert np.allclose(y[y != 0], 2.0)
+        d.eval()
+        assert np.allclose(d(x).numpy(), 1.0)
+
+    def test_pools(self):
+        x = np.random.rand(1, 2, 8, 8).astype(np.float32)
+        mp = nn.MaxPool2D(2, 2)(t(x))
+        assert mp.shape == [1, 2, 4, 4]
+        assert np.allclose(mp.numpy()[0, 0, 0, 0], x[0, 0, :2, :2].max())
+        ap = nn.AvgPool2D(2, 2)(t(x))
+        assert np.allclose(ap.numpy()[0, 0, 0, 0], x[0, 0, :2, :2].mean(), atol=1e-6)
+        aap = nn.AdaptiveAvgPool2D(1)(t(x))
+        assert np.allclose(aap.numpy()[0, 0, 0, 0], x[0, 0].mean(), atol=1e-6)
+
+    def test_activations(self):
+        x = np.linspace(-2, 2, 11).astype(np.float32)
+        assert np.allclose(nn.ReLU()(t(x)).numpy(), np.maximum(x, 0))
+        from scipy.special import erf
+
+        assert np.allclose(nn.GELU()(t(x)).numpy(), 0.5 * x * (1 + erf(x / np.sqrt(2))), atol=1e-4)
+        assert np.allclose(nn.Sigmoid()(t(x)).numpy(), 1 / (1 + np.exp(-x)), atol=1e-6)
+
+    def test_losses(self):
+        logits = np.random.rand(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 4, 1])
+        ce = nn.CrossEntropyLoss()(t(logits), paddle.to_tensor(labels))
+        exp = -np.log(np.exp(logits) / np.exp(logits).sum(1, keepdims=True))[np.arange(4), labels].mean()
+        assert np.allclose(ce.numpy(), exp, atol=1e-5)
+        mse = nn.MSELoss()(t(logits), t(logits * 0))
+        assert np.allclose(mse.numpy(), (logits**2).mean(), atol=1e-6)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.rand(4, 5).astype(np.float32)
+        labels = np.array([0, -100, 4, -100])
+        ce = nn.CrossEntropyLoss(ignore_index=-100)(t(logits), paddle.to_tensor(labels))
+        lp = -np.log(np.exp(logits) / np.exp(logits).sum(1, keepdims=True))
+        exp = (lp[0, 0] + lp[2, 4]) / 2
+        assert np.allclose(ce.numpy(), exp, atol=1e-5)
+
+    def test_multihead_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = t(np.random.rand(2, 5, 16).astype(np.float32))
+        out = mha(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = t(np.random.rand(2, 5, 16).astype(np.float32))
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_grad_through_network(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        x = t(np.random.rand(3, 4))
+        loss = m(x).sum()
+        loss.backward()
+        for p in m.parameters():
+            assert p.grad is not None and p.grad.shape == p.shape
+
+
+class TestSDPA:
+    def test_sdpa_matches_manual(self):
+        B, S, H, D = 2, 6, 2, 8
+        q = np.random.rand(B, S, H, D).astype(np.float32)
+        k = np.random.rand(B, S, H, D).astype(np.float32)
+        v = np.random.rand(B, S, H, D).astype(np.float32)
+        out = nn.functional.scaled_dot_product_attention(t(q), t(k), t(v)).numpy()
+        qt, kt, vt = [a.transpose(0, 2, 1, 3) for a in (q, k, v)]
+        logits = qt @ kt.transpose(0, 1, 3, 2) / np.sqrt(D)
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        ref = (probs @ vt).transpose(0, 2, 1, 3)
+        assert np.allclose(out, ref, atol=1e-4)
+
+    def test_causal_masks_future(self):
+        B, S, H, D = 1, 4, 1, 8
+        q = np.random.rand(B, S, H, D).astype(np.float32)
+        k = np.random.rand(B, S, H, D).astype(np.float32)
+        v = np.random.rand(B, S, H, D).astype(np.float32)
+        out_c = nn.functional.scaled_dot_product_attention(t(q), t(k), t(v), is_causal=True).numpy()
+        # first position attends only to itself
+        assert np.allclose(out_c[0, 0, 0], v[0, 0, 0], atol=1e-5)
+
+    def test_flash_attention_api(self):
+        q = t(np.random.rand(1, 4, 2, 8).astype(np.float32))
+        out, _ = nn.functional.flash_attention(q, q, q, causal=True)
+        assert out.shape == [1, 4, 2, 8]

@@ -1,0 +1,227 @@
+"""Op oracle tests vs numpy (blueprint: reference OpTest, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(a, stop_gradient=sg)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert np.all(paddle.ones([2]).numpy() == 1)
+        assert np.all(paddle.full([2, 2], 7).numpy() == 7)
+
+    def test_arange_linspace(self):
+        assert np.allclose(paddle.arange(5).numpy(), np.arange(5))
+        assert np.allclose(paddle.arange(1, 10, 2).numpy(), np.arange(1, 10, 2))
+        assert np.allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+
+    def test_eye_tril_triu(self):
+        assert np.allclose(paddle.eye(3).numpy(), np.eye(3))
+        a = np.random.rand(3, 3).astype(np.float32)
+        assert np.allclose(paddle.tril(t(a)).numpy(), np.tril(a))
+        assert np.allclose(paddle.triu(t(a), 1).numpy(), np.triu(a, 1))
+
+    def test_rand_shapes(self):
+        assert paddle.rand([4, 5]).shape == [4, 5]
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        assert sorted(paddle.randperm(10).numpy().tolist()) == list(range(10))
+
+    def test_default_dtype_float64_conversion(self):
+        x = paddle.to_tensor([1.5, 2.5])
+        assert x.dtype == np.float32
+
+
+class TestMath:
+    def test_elementwise(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32) + 0.5
+        for name, ref in [
+            ("add", a + b), ("subtract", a - b), ("multiply", a * b), ("divide", a / b),
+            ("maximum", np.maximum(a, b)), ("minimum", np.minimum(a, b)),
+        ]:
+            out = getattr(paddle, name)(t(a), t(b))
+            assert np.allclose(out.numpy(), ref, atol=1e-6), name
+
+    def test_unary(self):
+        a = np.random.rand(3, 4).astype(np.float32) + 0.1
+        for name, ref in [
+            ("exp", np.exp(a)), ("log", np.log(a)), ("sqrt", np.sqrt(a)),
+            ("abs", np.abs(a)), ("tanh", np.tanh(a)), ("floor", np.floor(a)),
+            ("square", a * a), ("rsqrt", 1 / np.sqrt(a)),
+        ]:
+            out = getattr(paddle, name)(t(a))
+            assert np.allclose(out.numpy(), ref, atol=1e-5), name
+
+    def test_operators(self):
+        a, b = t(np.array([4.0])), t(np.array([2.0]))
+        assert np.allclose((a + b).numpy(), [6])
+        assert np.allclose((a - b).numpy(), [2])
+        assert np.allclose((a * b).numpy(), [8])
+        assert np.allclose((a / b).numpy(), [2])
+        assert np.allclose((a**b).numpy(), [16])
+        assert np.allclose((a % b).numpy(), [0])
+        assert np.allclose((-a).numpy(), [-4])
+        assert np.allclose((2 + a).numpy(), [6])
+        assert np.allclose((8 / a).numpy(), [2])
+
+    def test_reductions(self):
+        a = np.random.rand(3, 4, 5).astype(np.float32)
+        assert np.allclose(paddle.sum(t(a)).numpy(), a.sum(), rtol=1e-5)
+        assert np.allclose(paddle.sum(t(a), axis=1).numpy(), a.sum(1), rtol=1e-5)
+        assert np.allclose(paddle.mean(t(a), axis=[0, 2]).numpy(), a.mean((0, 2)), rtol=1e-5)
+        assert np.allclose(paddle.max(t(a), axis=0).numpy(), a.max(0))
+        assert np.allclose(paddle.min(t(a), keepdim=True).numpy(), a.min(keepdims=True).reshape(1, 1, 1))
+        assert np.allclose(paddle.prod(t(a[:2, :2, 0])).numpy(), a[:2, :2, 0].prod(), rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        assert np.allclose(paddle.cumsum(t(a), axis=1).numpy(), a.cumsum(1), rtol=1e-5)
+        assert np.allclose(paddle.clip(t(a), 0.2, 0.8).numpy(), a.clip(0.2, 0.8))
+
+    def test_std_var(self):
+        a = np.random.rand(10, 5).astype(np.float32)
+        assert np.allclose(paddle.std(t(a), axis=0).numpy(), a.std(0, ddof=1), atol=1e-5)
+        assert np.allclose(paddle.var(t(a), unbiased=False).numpy(), a.var(), atol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_zero_copy_dims(self):
+        a = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+        assert paddle.reshape(t(a), [0, -1]).shape == [2, 12]
+        assert paddle.reshape(t(a), [4, 6]).shape == [4, 6]
+
+    def test_transpose_squeeze(self):
+        a = np.random.rand(2, 1, 3).astype(np.float32)
+        assert paddle.transpose(t(a), [2, 0, 1]).shape == [3, 2, 1]
+        assert paddle.squeeze(t(a), 1).shape == [2, 3]
+        assert paddle.unsqueeze(t(a), 0).shape == [1, 2, 1, 3]
+
+    def test_concat_stack_split(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 3).astype(np.float32)
+        assert np.allclose(paddle.concat([t(a), t(b)], 0).numpy(), np.concatenate([a, b], 0))
+        assert np.allclose(paddle.stack([t(a), t(b)], 1).numpy(), np.stack([a, b], 1))
+        parts = paddle.split(t(a), [1, 2], axis=1)
+        assert parts[0].shape == [2, 1] and parts[1].shape == [2, 2]
+        parts = paddle.split(t(a), 3, axis=1)
+        assert len(parts) == 3
+
+    def test_gather_scatter(self):
+        a = np.random.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        assert np.allclose(paddle.gather(t(a), t(idx), 0).numpy(), a[idx])
+        upd = np.ones((3, 3), np.float32)
+        out = paddle.scatter(t(a), t(idx), t(upd))
+        ref = a.copy()
+        ref[idx] = 1
+        assert np.allclose(out.numpy(), ref)
+
+    def test_where_masked(self):
+        a = np.random.rand(3, 3).astype(np.float32)
+        cond = a > 0.5
+        out = paddle.where(t(cond), t(a), paddle.zeros([3, 3]))
+        assert np.allclose(out.numpy(), np.where(cond, a, 0))
+        mf = paddle.masked_fill(t(a), t(cond), -1.0)
+        assert np.allclose(mf.numpy(), np.where(cond, -1.0, a))
+
+    def test_pad_tile_flip(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        assert np.allclose(paddle.tile(t(a), [2, 1]).numpy(), np.tile(a, (2, 1)))
+        assert np.allclose(paddle.flip(t(a), 0).numpy(), a[::-1])
+        p = paddle.nn.functional.pad(t(a[None, None]), [1, 1], value=0.0)
+        assert p.shape == [1, 1, 2, 5]
+
+    def test_getitem_setitem(self):
+        a = np.arange(12).reshape(3, 4).astype(np.float32)
+        x = t(a)
+        assert np.allclose(x[1].numpy(), a[1])
+        assert np.allclose(x[:, 1:3].numpy(), a[:, 1:3])
+        x[0] = 0.0
+        assert np.all(x.numpy()[0] == 0)
+
+    def test_take_along_axis(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        idx = np.argsort(a, axis=1)
+        out = paddle.take_along_axis(t(a), t(idx), 1, broadcast=False)
+        assert np.allclose(out.numpy(), np.take_along_axis(a, idx, 1))
+
+
+class TestLinalg:
+    def test_matmul_variants(self):
+        a = np.random.rand(4, 3).astype(np.float32)
+        b = np.random.rand(3, 5).astype(np.float32)
+        assert np.allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, atol=1e-5)
+        assert np.allclose(paddle.matmul(t(a.T), t(b), transpose_x=True).numpy(), a @ b, atol=1e-5)
+        assert np.allclose(paddle.matmul(t(a), t(b.T), transpose_y=True).numpy(), a @ b, atol=1e-5)
+        batch = np.random.rand(2, 4, 3).astype(np.float32)
+        assert np.allclose(paddle.bmm(t(batch), t(np.tile(b, (2, 1, 1)))).numpy(), batch @ b, atol=1e-5)
+
+    def test_norms(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        assert np.allclose(paddle.linalg.norm(t(a)).numpy(), np.linalg.norm(a), rtol=1e-5)
+        assert np.allclose(paddle.linalg.norm(t(a), p=1, axis=1).numpy(), np.abs(a).sum(1), rtol=1e-5)
+
+    def test_solve_inv_det(self):
+        a = (np.random.rand(3, 3) + 3 * np.eye(3)).astype(np.float32)
+        b = np.random.rand(3, 2).astype(np.float32)
+        assert np.allclose(paddle.linalg.solve(t(a), t(b)).numpy(), np.linalg.solve(a, b), atol=1e-4)
+        assert np.allclose(paddle.linalg.inv(t(a)).numpy(), np.linalg.inv(a), atol=1e-4)
+        assert np.allclose(paddle.linalg.det(t(a)).numpy(), np.linalg.det(a), rtol=1e-4)
+
+    def test_einsum(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32)
+        assert np.allclose(paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(), a @ b, atol=1e-5)
+
+
+class TestSearchLogic:
+    def test_argmax_sort_topk(self):
+        a = np.random.rand(4, 6).astype(np.float32)
+        assert np.all(paddle.argmax(t(a), axis=1).numpy() == a.argmax(1))
+        vals, idx = paddle.topk(t(a), 3, axis=1)
+        ref = np.sort(a, 1)[:, ::-1][:, :3]
+        assert np.allclose(vals.numpy(), ref, atol=1e-6)
+        s = paddle.sort(t(a), axis=1)
+        assert np.allclose(s.numpy(), np.sort(a, 1))
+
+    def test_topk_grad_flows(self):
+        a = np.random.rand(3, 5).astype(np.float32)
+        x = paddle.to_tensor(a, stop_gradient=False)
+        vals, _ = paddle.topk(x, 2, axis=1)
+        vals.sum().backward()
+        assert x.grad is not None
+        assert np.allclose(x.grad.numpy().sum(), 6.0)
+
+    def test_comparisons(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([2.0, 2.0, 2.0], np.float32)
+        assert np.all((t(a) < t(b)).numpy() == (a < b))
+        assert np.all((t(a) == t(b)).numpy() == (a == b))
+        assert bool(paddle.allclose(t(a), t(a)).numpy())
+
+    def test_unique_nonzero(self):
+        a = np.array([1, 3, 1, 2, 3], np.int64)
+        assert np.all(paddle.unique(t(a)).numpy() == [1, 2, 3])
+        nz = paddle.nonzero(t(np.array([0, 1, 0, 2])))
+        assert nz.numpy().tolist() == [[1], [3]]
+
+
+class TestDtypes:
+    def test_astype(self):
+        x = t(np.array([1.5, 2.7], np.float32))
+        assert x.astype("int32").numpy().tolist() == [1, 2]
+        assert x.astype(paddle.bfloat16).dtype == paddle.bfloat16
+
+    def test_amp_autocast_matmul(self):
+        a = t(np.random.rand(4, 4).astype(np.float32))
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            out = paddle.matmul(a, a)
+        assert out.dtype == paddle.bfloat16
+        out2 = paddle.matmul(a, a)
+        assert out2.dtype == np.float32
