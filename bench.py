@@ -1,0 +1,109 @@
+"""Benchmark driver contract: ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Measures training tokens/sec/chip on a LLaMA-2-shaped proxy sized for one
+chip's HBM, and reports MFU against the BASELINE north star (45% MFU —
+BASELINE.md). MFU = 6·N_params·tokens_per_sec / peak_bf16_flops.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip():
+    import jax
+
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    # bf16 peak: v5e ≈ 197 TF/s, v5p ≈ 459 TF/s, v4 ≈ 275 TF/s
+    if "v5 lite" in kind or "v5e" in kind or "lite" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "cpu" in kind or not kind:
+        return 1e12  # nominal, CPU smoke runs
+    return 197e12
+
+
+def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, batch=8, steps=8):
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit_api import TrainStep
+    from paddle_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        LlamaPretrainingCriterion,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:  # CPU smoke profile
+        hidden, layers, heads, inter, vocab, seq, batch, steps = 256, 2, 4, 512, 1024, 256, 2, 3
+
+    paddle.seed(0)
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+        num_hidden_layers=layers, num_attention_heads=heads,
+        max_position_embeddings=seq, use_recompute=True, dtype="bfloat16",
+    )
+    model = LlamaForCausalLM(cfg)
+    model.bfloat16()
+    n_params = model.num_parameters()
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01)
+    step = TrainStep(model, lambda out, labels: LlamaPretrainingCriterion()(out, labels), opt)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (batch, seq + 1)).astype(np.int32)
+    x, y = paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
+
+    # warmup / compile
+    for _ in range(2):
+        loss = step(x, y)
+    float(loss.numpy())
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    float(loss.numpy())  # sync
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_sec = batch * seq / dt
+    mfu = 6.0 * n_params * tokens_per_sec / peak_flops_per_chip()
+    result = {
+        "metric": "tokens_per_sec_per_chip_llama_proxy",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "params": n_params,
+            "step_time_s": round(dt, 4),
+            "config": f"h{hidden}-L{layers}-a{heads}-i{inter}-v{vocab}-s{seq}-b{batch}",
+            "backend": jax.default_backend(),
+            "final_loss": round(float(loss.numpy()), 4),
+        },
+    }
+    return result
+
+
+if __name__ == "__main__":
+    try:
+        res = run()
+    except Exception as e:  # OOM fallback: smaller model still yields a signal
+        try:
+            res = run(hidden=1536, layers=8, inter=4096, batch=4)
+            res["extra"]["note"] = f"fallback config after: {type(e).__name__}"
+        except Exception as e2:
+            res = {
+                "metric": "tokens_per_sec_per_chip_llama_proxy",
+                "value": 0.0,
+                "unit": "tokens/s/chip",
+                "vs_baseline": 0.0,
+                "error": f"primary: {type(e).__name__}; fallback: {type(e2).__name__}: {str(e2)[:200]}",
+            }
+    print(json.dumps(res))

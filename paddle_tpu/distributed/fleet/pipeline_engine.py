@@ -1,0 +1,166 @@
+"""Pipeline-parallel engine — single-program GPipe over the "pp" mesh axis
+(reference: fleet/meta_parallel/pipeline_parallel.py 1F1B runtime +
+pp_utils/p2p_communication.py; redesigned for XLA per SURVEY.md §7.6:
+collective-permute pipeline, one traced program, cf. PAPERS.md MPMD paper
+for the alternative).
+
+Mechanics:
+- The N homogeneous decoder blocks are stacked: every weight leaf becomes
+  [pp, layers_per_stage, ...] sharded P("pp", ...). Each pp mesh position
+  owns its stage's slice — placement == stage assignment.
+- Forward runs inside shard_map (manual over "pp" only; mp/dp stay GSPMD-
+  automatic): lax.scan over T = M + pp - 1 ticks. Each tick every stage
+  ppermutes its activation to the next stage and applies its blocks —
+  exactly the reference's 1F1B steady state wave, expressed as data flow.
+  Stage 0 injects micro-batch t; stage pp-1 emits outputs.
+- Backward: jax.vjp through the scan (the tape records one node for the
+  whole engine); per-tick remat keeps activation memory at O(M/pp).
+- Bubble: 2(pp-1) ticks, amortized by micro-batch count M (same as GPipe /
+  FThenB; the XLA scheduler overlaps ppermute with compute).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ...framework.core import Parameter, Tensor, apply
+from ...nn.layer.layers import Layer
+
+
+class PipelineStack(Layer):
+    """Stack of `num_layers` identical blocks, pipeline-partitioned into
+    `pp_degree` stages (reference analogue: PipelineLayer's segment of
+    LayerDescs, with placement replacing per-rank construction)."""
+
+    def __init__(self, block_factory, num_layers, pp_degree, num_micro_batches=None, block_kwargs=None):
+        super().__init__()
+        if num_layers % pp_degree != 0:
+            raise ValueError(f"num_layers {num_layers} not divisible by pp {pp_degree}")
+        self.num_layers = num_layers
+        self.pp_degree = pp_degree
+        self.layers_per_stage = num_layers // pp_degree
+        self.num_micro_batches = num_micro_batches or pp_degree
+        # the template block is tracing machinery, NOT a registered sublayer:
+        # its (dead) weights must stay out of parameters()/state_dict() —
+        # only the stacked tensors below are real parameters
+        object.__setattr__(self, "template", block_factory(**(block_kwargs or {})))
+        blocks = [self.template] + [block_factory(**(block_kwargs or {})) for _ in range(num_layers - 1)]
+        self._leaf_names = list(dict(blocks[0].named_parameters()))
+        for ln in self._leaf_names:
+            leaves = [dict(b.named_parameters())[ln] for b in blocks]
+            stacked = jnp.stack([l._data for l in leaves]).reshape(
+                pp_degree, self.layers_per_stage, *leaves[0].shape
+            )
+            p = Parameter(stacked, name=ln)
+            base_spec = getattr(leaves[0], "partition_spec", None)
+            base_entries = list(base_spec) if base_spec is not None else []
+            base_entries += [None] * (len(leaves[0].shape) - len(base_entries))
+            p.partition_spec = P("pp", None, *base_entries)
+            self.add_parameter("stacked__" + ln.replace(".", "__"), p)
+        self._jit_cache = {}
+
+    def _stacked_params(self):
+        return [self._parameters["stacked__" + ln.replace(".", "__")] for ln in self._leaf_names]
+
+    def _block_apply(self, leaf_datas, x, extra):
+        """Pure: apply ONE block given its weight leaves."""
+        overrides = {
+            ln: Tensor(d, stop_gradient=True) for ln, d in zip(self._leaf_names, leaf_datas)
+        }
+        out = self.template.functional_call(overrides, Tensor(x), *extra)
+        return out._data if isinstance(out, Tensor) else out[0]._data
+
+    def forward(self, x, *extra):
+        """x: [M, mb, ...] micro-batched input stream. Returns [M, mb, ...].
+
+        `extra` entries must be static (None/python scalars) — the jitted
+        engine is cached per (mesh, extra) and trace-cached per shape.
+        """
+        from ..mesh import get_mesh
+
+        mesh = get_mesh()
+        pp = self.pp_degree
+        stacked = self._stacked_params()
+        if any(e is not None and hasattr(e, "shape") for e in extra):
+            raise NotImplementedError("PipelineStack: tensor-valued extra args not supported yet")
+
+        if pp == 1 or "pp" not in mesh.axis_names or mesh.shape["pp"] == 1:
+            # no pipeline: plain scan over all layers on the merged micro dim
+            def fn(xd, *leaf_stacks):
+                M = xd.shape[0]
+                flat = tuple(s.reshape(self.num_layers, *s.shape[2:]) for s in leaf_stacks)
+                merged = xd.reshape(M * xd.shape[1], *xd.shape[2:])
+
+                def body(hh, per_layer):
+                    return self._block_apply(list(per_layer), hh, extra), None
+
+                out, _ = jax.lax.scan(body, merged, flat)
+                return out.reshape(xd.shape)
+
+            return apply(fn, Tensor(x) if not isinstance(x, Tensor) else x, *stacked, name="layer_stack")
+
+        cache_key = (id(mesh), tuple(extra))
+        engine_jit = self._jit_cache.get(cache_key)
+        if engine_jit is not None:
+            return apply(engine_jit, x if isinstance(x, Tensor) else Tensor(x), *stacked, name="pipeline")
+
+        def engine(xd, *leaf_stacks):
+            M = xd.shape[0]
+            T = M + pp - 1
+            fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+            def shard_body(x_stream, *my_stacks):
+                # my_stacks leaves: [1, L_s, ...] (this stage's slice)
+                sid = jax.lax.axis_index("pp")
+                mb_shape = x_stream.shape[1:]
+                if hasattr(jax.lax, "pcast"):
+                    _pvary = lambda v, ax: jax.lax.pcast(v, ax, to="varying")
+                else:
+                    _pvary = jax.lax.pvary
+                state = _pvary(jnp.zeros(mb_shape, x_stream.dtype), ("pp",))
+                outputs = _pvary(jnp.zeros((M,) + mb_shape, x_stream.dtype), ("pp",))
+
+                def apply_stage(h):
+                    def body(hh, per_layer):
+                        return self._block_apply(list(per_layer), hh, extra), None
+
+                    out, _ = jax.lax.scan(body, h, tuple(s[0] for s in my_stacks))
+                    return out
+
+                apply_stage = jax.checkpoint(apply_stage)
+
+                def tick(carry, t):
+                    state, outputs = carry
+                    incoming = jax.lax.ppermute(state, "pp", fwd_perm)
+                    inject = x_stream[jnp.minimum(t, M - 1)]
+                    h_in = jnp.where(sid == 0, inject, incoming)
+                    new_state = apply_stage(h_in)
+                    out_idx = jnp.clip(t - (pp - 1), 0, M - 1)
+                    emit = (sid == pp - 1) & (t >= pp - 1)
+                    prev = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+                    outputs = jax.lax.dynamic_update_index_in_dim(
+                        outputs, jnp.where(emit, new_state, prev), out_idx, 0
+                    )
+                    return (new_state, outputs), None
+
+                (state, outputs), _ = jax.lax.scan(tick, (state, outputs), jnp.arange(T))
+                # broadcast results from the last stage to all stages
+                mask = (sid == pp - 1).astype(outputs.dtype)
+                return jax.lax.psum(outputs * mask, "pp")
+
+            shmapped = jax.shard_map(
+                shard_body,
+                mesh=mesh,
+                in_specs=(P(), *[P("pp") for _ in leaf_stacks]),
+                out_specs=P(),
+                axis_names={"pp"},
+            )
+            return shmapped(xd, *leaf_stacks)
+
+        # shard_map with inner scan requires a jit scope even when the model
+        # is driven eagerly; cache the jitted engine so eager loops compile once
+        engine_jit = jax.jit(engine)
+        self._jit_cache[cache_key] = engine_jit
+        return apply(engine_jit, x if isinstance(x, Tensor) else Tensor(x), *stacked, name="pipeline")

@@ -1,0 +1,115 @@
+"""Activation recompute (reference: fleet/recompute/recompute.py —
+RecomputeFunction PyLayer re-running forward in backward with RNG replay).
+
+TPU-native: jax.checkpoint (remat). The wrapped segment's forward is traced
+once; XLA rematerializes it in the backward pass, trading FLOPs for HBM —
+the same contract, without the RNG bookkeeping (keys are traced values).
+
+Gradients flow to parameters only if they are explicit inputs of the
+checkpointed function, so Layers (and bound methods of Layers) have their
+parameters lifted automatically.
+"""
+import jax
+
+from ...framework.core import Tensor, apply, to_tensor
+from ...nn.layer.layers import Layer
+
+
+def recompute(function, *args, **kwargs):
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+
+    owner = None
+    if isinstance(function, Layer):
+        owner = function
+        call = function
+    elif hasattr(function, "__self__") and isinstance(function.__self__, Layer):
+        owner = function.__self__
+        call = function
+    else:
+        call = function
+
+    # split tensor args (flow through the tape/vjp) from static args (None,
+    # ints, flags — closed over)
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor) or hasattr(a, "shape")]
+    arg_ts = [args[i] if isinstance(args[i], Tensor) else to_tensor(args[i]) for i in tensor_idx]
+    n_args = len(arg_ts)
+
+    def rebuild(ins):
+        full = list(args)
+        for pos, d in zip(tensor_idx, ins):
+            full[pos] = Tensor(d, stop_gradient=True)
+        return full
+
+    if owner is not None:
+        named = dict(owner.named_parameters())
+        names = list(named)
+        param_ts = [named[k] for k in names]
+
+        def pure(*datas):
+            ins, ps = datas[:n_args], datas[n_args:]
+            overrides = {k: Tensor(v, stop_gradient=True) for k, v in zip(names, ps)}
+            full = rebuild(ins)
+            out = (
+                owner.functional_call(overrides, *full, **kwargs)
+                if call is owner
+                else _call_with_overrides(owner, call, overrides, full, kwargs)
+            )
+            return out._data if isinstance(out, Tensor) else tuple(o._data for o in out)
+
+        return apply(jax.checkpoint(pure), *arg_ts, *param_ts, name="recompute")
+
+    def pure(*datas):
+        out = call(*rebuild(datas), **kwargs)
+        return out._data if isinstance(out, Tensor) else tuple(o._data for o in out)
+
+    return apply(jax.checkpoint(pure), *arg_ts, name="recompute")
+
+
+def _call_with_overrides(owner, bound_method, overrides, full_args, kwargs):
+    """Run a bound method under parameter substitution on its owning Layer."""
+    handles = []
+    try:
+        for name, value in overrides.items():
+            parts = name.split(".")
+            layer = owner
+            for p in parts[:-1]:
+                layer = layer._sub_layers[p]
+            leaf = parts[-1]
+            store = layer._parameters if leaf in layer._parameters else layer._buffers
+            handles.append((store, leaf, store[leaf]))
+            store[leaf] = value
+        return bound_method(*full_args, **kwargs)
+    finally:
+        for store, leaf, orig in reversed(handles):
+            store[leaf] = orig
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference: recompute_sequential — checkpoint each segment of a
+    Sequential-like list."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    per = max(len(funcs) // segments, 1)
+    out = args
+    for i in range(0, len(funcs), per):
+        seg = funcs[i : i + per]
+
+        class _Seg(Layer):
+            def __init__(self, fns):
+                super().__init__()
+                for j, f in enumerate(fns):
+                    if isinstance(f, Layer):
+                        self.add_sublayer(str(j), f)
+                self.fns = fns
+
+            def forward(self, *xs):
+                y = xs
+                for f in self.fns:
+                    y = f(*y) if isinstance(y, tuple) else f(y)
+                    y = y if isinstance(y, tuple) else (y,)
+                return y[0] if len(y) == 1 else y
+
+        out = recompute(_Seg(seg), *(out if isinstance(out, tuple) else (out,)), **kwargs)
+        out = out if isinstance(out, tuple) else (out,)
+    return out[0] if len(out) == 1 else out

@@ -1,0 +1,186 @@
+"""DistributedTrainStep — the hybrid-parallel compiled step (reference
+analogue: the whole Fleet meta_parallel runtime, SURVEY.md §3.3; here the
+schedule/overlap/collectives are XLA's job via GSPMD shardings).
+
+Sharding decisions, matching HybridCommunicateGroup semantics:
+- weights: each Parameter's `partition_spec` ("mp" for TP layers) —
+  optionally + a "sharding"-axis dim for ZeRO stage 3;
+- optimizer slots (and master weights): weight spec + "sharding" axis
+  (ZeRO-1; XLA's weight-update sharding makes stage-2 grad reduce-scatter
+  fall out of this — PAPERS.md[4]);
+- batch: first dim over (dp, sharding) — both consume distinct data shards,
+  as in the reference's DP×sharding grid;
+- everything else replicated.
+
+XLA then inserts/overlaps all-reduce / reduce-scatter / all-gather over ICI
+— the EagerReducer, GroupSharded*, p2p machinery of the reference collapses
+into these annotations.
+"""
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..jit_api import TrainStep
+from .mesh import get_mesh
+
+
+def _axis_in_use(spec):
+    used = set()
+    for e in spec:
+        if e is None:
+            continue
+        for n in e if isinstance(e, tuple) else (e,):
+            used.add(n)
+    return used
+
+
+def _add_axis(spec, shape, mesh, axis):
+    """Add `axis` sharding on the first divisible dim not already sharded."""
+    if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    if axis in _axis_in_use(entries):
+        return P(*entries)
+    for i, (e, dim) in enumerate(zip(entries, shape)):
+        cur = 1
+        if e is not None:
+            for n in e if isinstance(e, tuple) else (e,):
+                cur *= mesh.shape[n]
+        if dim % (cur * mesh.shape[axis]) == 0 and dim > 0:
+            if e is None:
+                entries[i] = axis
+            else:
+                entries[i] = (e if isinstance(e, tuple) else (e,)) + (axis,)
+            return P(*entries)
+    return P(*entries)
+
+
+class DistributedTrainStep(TrainStep):
+    """sharding_stage: 0 = pure DP/TP, 1/2 = shard optimizer state (+XLA
+    grad reduce-scatter), 3 = also shard parameters (FSDP)."""
+
+    def __init__(self, model, loss_fn, optimizer, n_labels=1, scaler=None, mesh=None,
+                 sharding_stage=1, batch_axes=("dp", "sharding")):
+        self.mesh = mesh if mesh is not None else get_mesh()
+        self.sharding_stage = sharding_stage
+        self.batch_axes = batch_axes
+        super().__init__(model, loss_fn, optimizer, n_labels=n_labels, scaler=scaler)
+        self._place_state()
+
+    # -- sharding construction ----------------------------------------------
+    def _ns(self, spec):
+        return NamedSharding(self.mesh, spec)
+
+    def _param_spec(self, p):
+        spec = p.partition_spec if getattr(p, "partition_spec", None) is not None else P()
+        spec = P(*spec) if not isinstance(spec, P) else spec
+        # drop axes the mesh doesn't have (e.g. mp spec on a dp-only mesh)
+        entries = []
+        for e in list(spec):
+            if e is None:
+                entries.append(None)
+            else:
+                names = tuple(n for n in (e if isinstance(e, tuple) else (e,)) if n in self.mesh.axis_names and self.mesh.shape[n] > 1)
+                entries.append(names if len(names) > 1 else (names[0] if names else None))
+        spec = P(*entries)
+        if self.sharding_stage >= 3:
+            spec = _add_axis(spec, tuple(p.shape), self.mesh, "sharding")
+        return spec
+
+    def _slot_spec(self, param_spec, param_shape, slot_arr):
+        if np.shape(slot_arr) == tuple(param_shape) and self.sharding_stage >= 1:
+            return _add_axis(param_spec, tuple(param_shape), self.mesh, "sharding")
+        if np.shape(slot_arr) == tuple(param_shape):
+            return param_spec
+        return P()
+
+    def _batch_spec(self, arr):
+        if np.ndim(arr) == 0:
+            return P()
+        axes = tuple(a for a in self.batch_axes if a in self.mesh.axis_names and self.mesh.shape[a] > 1)
+        if not axes:
+            return P()
+        total = int(np.prod([self.mesh.shape[a] for a in axes]))
+        if np.shape(arr)[0] % total != 0:
+            return P()
+        return P(axes if len(axes) > 1 else axes[0])
+
+    def _sharding_trees(self, batch_datas):
+        p_spec = {k: self._param_spec(p) for k, p in self._trainable.items()}
+        params_sh = {k: self._ns(s) for k, s in p_spec.items()}
+        buffers_sh = {k: self._ns(P()) for k in self._buffers}
+        frozen_sh = {k: self._ns(P()) for k in self._frozen}
+        slots_sh = {}
+        for name, slots in self.opt_state["slots"].items():
+            pspec = p_spec.get(name, P())
+            pshape = tuple(self._trainable[name].shape) if name in self._trainable else ()
+            slots_sh[name] = {
+                s: self._ns(self._slot_spec(pspec, pshape, arr)) for s, arr in slots.items()
+            }
+        opt_sh = {"step": self._ns(P()), "slots": slots_sh}
+        scaler_sh = (
+            {k: self._ns(P()) for k in self._scaler_state} if self._scaler_state is not None else None
+        )
+        batch_sh = tuple(self._ns(self._batch_spec(b)) for b in batch_datas)
+        return params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, batch_sh
+
+    def _compile(self, step_fn):
+        # deferred: in_shardings depend on batch shapes; compile lazily,
+        # keyed by batch shape/dtype signature
+        self._jitted = {}
+        return None
+
+    def _place_state(self):
+        """device_put params/opt state onto their shardings once, up front."""
+        for k, p in self._trainable.items():
+            p._data = jax.device_put(p._data, self._ns(self._param_spec(p)))
+        for k, b in self._buffers.items():
+            b._data = jax.device_put(b._data, self._ns(P()))
+        p_spec = {k: self._param_spec(p) for k, p in self._trainable.items()}
+        new_slots = {}
+        for name, slots in self.opt_state["slots"].items():
+            pshape = tuple(self._trainable[name].shape) if name in self._trainable else ()
+            new_slots[name] = {
+                s: jax.device_put(arr, self._ns(self._slot_spec(p_spec.get(name, P()), pshape, arr)))
+                if hasattr(arr, "shape")
+                else arr
+                for s, arr in slots.items()
+            }
+        self.opt_state = {"step": self.opt_state["step"], "slots": new_slots}
+
+    def __call__(self, *batch):
+        from ..framework import random as prandom
+        from ..framework.core import Tensor, to_tensor
+
+        batch_datas = tuple(to_tensor(b)._data for b in batch)
+        sig = tuple((tuple(np.shape(b)), str(np.asarray(b).dtype) if not hasattr(b, "dtype") else str(b.dtype)) for b in batch_datas)
+        jitted = self._jitted.get(sig)
+        if jitted is None:
+            shardings = self._sharding_trees(batch_datas)
+            params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, batch_sh = shardings
+            jitted = jax.jit(
+                self._step_fn,
+                in_shardings=(params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, self._ns(P()), self._ns(P()), batch_sh),
+                out_shardings=(self._ns(P()), params_sh, buffers_sh, opt_sh, scaler_sh),
+                donate_argnums=(0, 1, 3, 4),
+            )
+            self._jitted[sig] = jitted
+        params = {k: p._data for k, p in self._trainable.items()}
+        buffers = {k: b._data for k, b in self._buffers.items()}
+        frozen = {k: p._data for k, p in self._frozen.items()}
+        lr = self.optimizer.get_lr()
+        with self.mesh:
+            loss, new_params, new_buffers, self.opt_state, self._scaler_state = jitted(
+                params, buffers, frozen, self.opt_state, self._scaler_state, lr,
+                prandom.next_key(), batch_datas
+            )
+        for k, v in new_params.items():
+            self._trainable[k]._data = v
+        for k, v in new_buffers.items():
+            self._buffers[k]._data = v
+        sched = self.optimizer._learning_rate_scheduler
+        if sched is not None:
+            sched.step()
+        self.optimizer._global_step += 1
+        return Tensor(loss)

@@ -167,7 +167,11 @@ class TrainStep:
             new_buffers = {k: t._data for k, t in buf_over.items()}
             return loss._data, new_params, new_buffers, new_opt_state, new_scaler_state
 
-        self._compiled = jax.jit(step_fn, donate_argnums=(0, 1, 3, 4))
+        self._step_fn = step_fn
+        self._compiled = self._compile(step_fn)
+
+    def _compile(self, step_fn):
+        return jax.jit(step_fn, donate_argnums=(0, 1, 3, 4))
 
     def __call__(self, *batch):
         params = {k: p._data for k, p in self._trainable.items()}

@@ -1,0 +1,11 @@
+"""Model zoo — flagship LLM families (BASELINE configs 2-5)."""
+from . import bert, gpt, llama
+from .bert import BertConfig, BertForPretraining, BertForSequenceClassification, BertModel
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel
+from .llama import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaForCausalLMPipe,
+    LlamaModel,
+    LlamaPretrainingCriterion,
+)

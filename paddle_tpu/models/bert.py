@@ -1,0 +1,170 @@
+"""BERT family (BASELINE config 2: BERT-base data-parallel; reference
+analogue: PaddleNLP BERT). Encoder blocks via nn.TransformerEncoder pieces,
+MLM + NSP pretraining heads, classification head for fine-tuning."""
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..tensor import creation, manipulation
+from .llama import _mk_linear
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=3072, max_position_embeddings=512,
+                 type_vocab_size=2, hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 layer_norm_eps=1e-12):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.layer_norm_eps = layer_norm_eps
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_large(**kw):
+    return BertConfig(hidden_size=1024, num_hidden_layers=24, num_attention_heads=16,
+                      intermediate_size=4096, **kw)
+
+
+def bert_tiny(**kw):
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    return BertConfig(**kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.word_embeddings = Embedding(config.vocab_size, config.hidden_size)
+        self.position_embeddings = Embedding(config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = Embedding(config.type_vocab_size, config.hidden_size)
+        self.layer_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        S = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = creation.arange(S, dtype="int32")
+        if token_type_ids is None:
+            token_type_ids = creation.zeros([S], dtype="int32")
+        e = (
+            self.word_embeddings(input_ids)
+            + self.position_embeddings(position_ids)
+            + self.token_type_embeddings(token_type_ids)
+        )
+        return self.dropout(self.layer_norm(e))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.qkv = _mk_linear(h, 3 * h, P(None, "mp"))
+        self.out = _mk_linear(h, h, P("mp", None))
+        self.dropout_p = config.attention_probs_dropout_prob
+
+    def forward(self, x, attention_mask=None):
+        B, S = x.shape[0], x.shape[1]
+        qkv = manipulation.reshape(self.qkv(x), [B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = manipulation.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attention_mask, dropout_p=self.dropout_p, training=self.training
+        )
+        return self.out(manipulation.reshape(out, [B, S, self.num_heads * self.head_dim]))
+
+
+class BertLayer(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.attention = BertSelfAttention(config)
+        self.attn_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.intermediate = _mk_linear(config.hidden_size, config.intermediate_size, P(None, "mp"))
+        self.output = _mk_linear(config.intermediate_size, config.hidden_size, P("mp", None))
+        self.out_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attention_mask=None):
+        x = self.attn_norm(x + self.dropout(self.attention(x, attention_mask)))
+        h = self.output(F.gelu(self.intermediate(x)))
+        return self.out_norm(x + self.dropout(h))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = LayerList([BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, attention_mask=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, S] padding mask -> additive [B, 1, 1, S]
+            m = manipulation.unsqueeze(attention_mask, [1, 2])
+            attention_mask = (1.0 - m.astype("float32")) * -1e9
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask=attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (reference: BertPretrainingHeads)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.nsp = Linear(config.hidden_size, 2)
+        self.mlm_bias = self.create_parameter([config.vocab_size], is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_labels=None):
+        from ..tensor import linalg
+
+        seq_out, pooled = self.bert(input_ids, token_type_ids, attention_mask=attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(seq_out)))
+        mlm_logits = linalg.matmul(h, self.bert.embeddings.word_embeddings.weight, transpose_y=True) + self.mlm_bias
+        nsp_logits = self.nsp(pooled)
+        if masked_lm_labels is not None:
+            loss = F.cross_entropy(mlm_logits.astype("float32"), masked_lm_labels, ignore_index=-100)
+            if next_sentence_labels is not None:
+                loss = loss + F.cross_entropy(nsp_logits, next_sentence_labels)
+            return loss
+        return mlm_logits, nsp_logits

@@ -1,0 +1,141 @@
+"""GPT family (BASELINE config 3: GPT-3 1.3B tensor-parallel; reference
+analogue: PaddleNLP GPT on fleet meta_parallel layers).
+
+Same TPU-first pattern as llama.py: weights carry PartitionSpecs; attention
+goes through the flash/SDPA path; blocks are homogeneous for the pipeline
+engine.
+"""
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..framework.core import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..tensor import creation, manipulation
+from .llama import _mk_linear
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=1024, num_hidden_layers=24,
+                 num_attention_heads=16, intermediate_size=None, max_position_embeddings=1024,
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1, layer_norm_epsilon=1e-5,
+                 use_recompute=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.use_recompute = use_recompute
+
+
+def gpt3_1p3b(**kw):
+    return GPTConfig(hidden_size=2048, num_hidden_layers=24, num_attention_heads=32, **kw)
+
+
+def gpt2_small(**kw):
+    return GPTConfig(hidden_size=768, num_hidden_layers=12, num_attention_heads=12, **kw)
+
+
+def gpt_tiny(**kw):
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("max_position_embeddings", 128)
+    return GPTConfig(**kw)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.qkv_proj = _mk_linear(h, 3 * h, P(None, "mp"))
+        self.out_proj = _mk_linear(h, h, P("mp", None))
+        self.dropout_p = config.attention_probs_dropout_prob
+
+    def forward(self, x):
+        B, S = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        qkv = manipulation.reshape(qkv, [B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = manipulation.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.dropout_p, training=self.training
+        )
+        out = manipulation.reshape(out, [B, S, self.num_heads * self.head_dim])
+        return self.out_proj(out)
+
+
+class GPTBlock(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.fc_in = _mk_linear(config.hidden_size, config.intermediate_size, P(None, "mp"))
+        self.fc_out = _mk_linear(config.intermediate_size, config.hidden_size, P("mp", None))
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        h = self.fc_out(F.gelu(self.fc_in(self.ln_2(x)), approximate=True))
+        return x + self.dropout(h)
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.wte = Embedding(config.vocab_size, config.hidden_size)
+        self.wte.weight.partition_spec = P("mp", None)
+        self.wpe = Embedding(config.max_position_embeddings, config.hidden_size)
+        self.drop = Dropout(config.hidden_dropout_prob)
+        self.h = LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        S = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = creation.arange(S, dtype="int32")
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        for block in self.h:
+            if self.config.use_recompute and self.training:
+                from ..distributed.fleet.recompute import recompute
+
+                x = recompute(block, x)
+            else:
+                x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    """Tied-embedding LM head (reference GPT: logits = h @ wte^T)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.config = config
+
+    def forward(self, input_ids, labels=None):
+        from ..tensor import linalg
+
+        h = self.gpt(input_ids)
+        logits = linalg.matmul(h, self.gpt.wte.weight, transpose_y=True)
+        if labels is not None:
+            return F.cross_entropy(logits.astype("float32"), labels, reduction="mean")
+        return logits
+
+    def num_parameters(self):
+        import numpy as np
+
+        return int(sum(np.prod(p.shape) for p in self.parameters()))

@@ -1,0 +1,303 @@
+"""LLaMA family — the flagship model (BASELINE configs 4/5; reference
+analogue: PaddleNLP llama modeling on top of fleet meta_parallel layers).
+
+TPU-first design:
+- every weight carries a PartitionSpec (mp for tensor parallel, sharding for
+  ZeRO) consumed by DistributedTrainStep's pjit shardings;
+- attention lowers to the Pallas flash kernel on TPU (ops/flash_attention);
+- rope/swiglu/rms_norm are the fused incubate functionals (XLA fuses);
+- optional jax.checkpoint recompute per decoder layer;
+- homogeneous decoder blocks so the pipeline engine can stack/scan them.
+"""
+import math
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor
+from ..incubate.nn.functional import fused_rotary_position_embedding, swiglu
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.common import Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import RMSNorm
+from ..tensor import manipulation
+
+
+class LlamaConfig:
+    def __init__(
+        self,
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=11008,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=None,
+        max_position_embeddings=4096,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        use_recompute=False,
+        sequence_parallel=False,
+        dtype="float32",
+        seq_length=2048,
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads or num_attention_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.tie_word_embeddings = tie_word_embeddings
+        self.use_recompute = use_recompute
+        self.sequence_parallel = sequence_parallel
+        self.dtype = dtype
+        self.seq_length = seq_length
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+# canonical sizes (LLaMA-2 family) — BASELINE configs 4 (7B) and 5 (70B)
+def llama2_7b(**kw):
+    return LlamaConfig(hidden_size=4096, intermediate_size=11008, num_hidden_layers=32,
+                       num_attention_heads=32, **kw)
+
+
+def llama2_13b(**kw):
+    return LlamaConfig(hidden_size=5120, intermediate_size=13824, num_hidden_layers=40,
+                       num_attention_heads=40, **kw)
+
+
+def llama2_70b(**kw):
+    return LlamaConfig(hidden_size=8192, intermediate_size=28672, num_hidden_layers=80,
+                       num_attention_heads=64, num_key_value_heads=8, **kw)
+
+
+def llama_tiny(**kw):
+    """test-scale config"""
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("max_position_embeddings", 128)
+    return LlamaConfig(**kw)
+
+
+def _mk_linear(in_f, out_f, spec, std=0.02):
+    l = Linear(in_f, out_f, weight_attr=None, bias_attr=False)
+    l.weight._data = I.Normal(0.0, std)((in_f, out_f), l.weight.dtype)
+    l.weight.partition_spec = spec
+    l.weight.is_distributed = True
+    return l
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.head_dim
+        # column-parallel qkv (heads split over mp), row-parallel output
+        self.q_proj = _mk_linear(h, self.num_heads * self.head_dim, P(None, "mp"))
+        self.k_proj = _mk_linear(h, self.num_kv_heads * self.head_dim, P(None, "mp"))
+        self.v_proj = _mk_linear(h, self.num_kv_heads * self.head_dim, P(None, "mp"))
+        self.o_proj = _mk_linear(self.num_heads * self.head_dim, h, P("mp", None))
+
+    def forward(self, hidden_states, attention_mask=None, position_ids=None, past_key_value=None):
+        B, S = hidden_states.shape[0], hidden_states.shape[1]
+        q = manipulation.reshape(self.q_proj(hidden_states), [B, S, self.num_heads, self.head_dim])
+        k = manipulation.reshape(self.k_proj(hidden_states), [B, S, self.num_kv_heads, self.head_dim])
+        v = manipulation.reshape(self.v_proj(hidden_states), [B, S, self.num_kv_heads, self.head_dim])
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, None, position_ids=position_ids, rotary_emb_base=self.config.rope_theta
+        )
+        if past_key_value is not None:
+            k = manipulation.concat([past_key_value[0], k], axis=1)
+            v = manipulation.concat([past_key_value[1], v], axis=1)
+        present = (k, v)
+        # causal ALWAYS holds for the decoder; a user mask only adds padding.
+        # [B, S] padding masks become additive [B, 1, 1, S].
+        mask = attention_mask
+        if mask is not None and mask.ndim == 2:
+            mask = (1.0 - manipulation.unsqueeze(mask.astype("float32"), [1, 2])) * -1e9
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=mask,
+                                             is_causal=True, training=self.training)
+        out = manipulation.reshape(out, [B, S, self.num_heads * self.head_dim])
+        return self.o_proj(out), present
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        self.gate_proj = _mk_linear(h, m, P(None, "mp"))
+        self.up_proj = _mk_linear(h, m, P(None, "mp"))
+        self.down_proj = _mk_linear(m, h, P("mp", None))
+
+    def forward(self, x):
+        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, hidden_states, attention_mask=None, position_ids=None):
+        residual = hidden_states
+        h, _ = self.self_attn(self.input_layernorm(hidden_states), attention_mask, position_ids)
+        h = residual + h
+        residual = h
+        h = residual + self.mlp(self.post_attention_layernorm(h))
+        return h
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        self.embed_tokens.weight._data = I.Normal(0.0, 0.02)(
+            (config.vocab_size, config.hidden_size), self.embed_tokens.weight.dtype
+        )
+        self.embed_tokens.weight.partition_spec = P("mp", None)
+        self.layers = LayerList([LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids, attention_mask=None, position_ids=None):
+        h = self.embed_tokens(input_ids)
+        if self.config.sequence_parallel:
+            h = _seq_shard(h)
+        for layer in self.layers:
+            if self.config.use_recompute and self.training:
+                from ..distributed.fleet.recompute import recompute
+
+                h = recompute(layer, h, attention_mask, position_ids)
+            else:
+                h = layer(h, attention_mask, position_ids)
+        return self.norm(h)
+
+
+def _seq_shard(h):
+    """Megatron-SP equivalent: constrain the activation's seq dim onto the mp
+    axis (reference: sequence_parallel_utils.py ScatterOp). Under GSPMD this
+    single constraint induces the scatter/gather pattern."""
+    import jax
+
+    from ..distributed.mesh import get_mesh, has_mesh
+    from ..framework.core import apply
+
+    if not has_mesh():
+        return h
+    mesh = get_mesh()
+    if "mp" not in mesh.axis_names or mesh.shape["mp"] == 1:
+        return h
+    sharding = jax.sharding.NamedSharding(mesh, P(None, "mp", None))
+    return apply(lambda a: jax.lax.with_sharding_constraint(a, sharding), h, name="seq_shard")
+
+
+class LlamaPretrainingCriterion(Layer):
+    """reference: PaddleNLP LlamaPretrainingCriterion (TP-aware CE)."""
+
+    def __init__(self, config=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        return F.cross_entropy(
+            logits.astype("float32"), labels, ignore_index=self.ignore_index, reduction="mean"
+        )
+
+
+class LlamaForCausalLMPipe(Layer):
+    """Pipeline-parallel LLaMA (reference analogue: PaddleNLP LlamaForCausalLMPipe
+    built from PipelineLayer LayerDescs). The decoder stack runs through the
+    shard_map+ppermute GPipe engine; embed/norm/head stay GSPMD-sharded."""
+
+    def __init__(self, config: LlamaConfig, pp_degree=1, num_micro_batches=None):
+        super().__init__()
+        from ..distributed.fleet.pipeline_engine import PipelineStack
+
+        self.config = config
+        self.pp_degree = pp_degree
+        self.num_micro_batches = num_micro_batches or max(pp_degree, 1)
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        self.embed_tokens.weight.partition_spec = P("mp", None)
+        self.decoder = PipelineStack(
+            lambda: LlamaDecoderLayer(config), config.num_hidden_layers, pp_degree,
+            num_micro_batches=self.num_micro_batches,
+        )
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.lm_head = _mk_linear(config.hidden_size, config.vocab_size, P(None, "mp"))
+
+    def forward(self, input_ids, labels=None):
+        B, S = input_ids.shape[0], input_ids.shape[1]
+        M = self.num_micro_batches
+        if B % M != 0:
+            raise ValueError(f"batch size {B} must be divisible by num_micro_batches {M}")
+        h = self.embed_tokens(input_ids)
+        if self.config.sequence_parallel:
+            h = _seq_shard(h)
+        h = manipulation.reshape(h, [M, B // M, S, self.config.hidden_size])
+        h = self.decoder(h)
+        h = manipulation.reshape(h, [B, S, self.config.hidden_size])
+        h = self.norm(h)
+        logits = self.lm_head(h)
+        if labels is not None:
+            return LlamaPretrainingCriterion()(logits, labels)
+        return logits
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = _mk_linear(config.hidden_size, config.vocab_size, P(None, "mp"))
+
+    def forward(self, input_ids, attention_mask=None, position_ids=None, labels=None):
+        h = self.llama(input_ids, attention_mask, position_ids)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            from ..tensor import linalg
+
+            logits = linalg.matmul(h, self.llama.embed_tokens.weight, transpose_y=True)
+        if labels is not None:
+            return LlamaPretrainingCriterion()(logits, labels)
+        return logits
+
+    def num_parameters(self):
+        import numpy as np
+
+        return int(sum(np.prod(p.shape) for p in self.parameters()))
+
+    @staticmethod
+    def flops_per_token(config):
+        """6*N approximation + attention quadratic term."""
+        n = (
+            config.vocab_size * config.hidden_size * (1 if config.tie_word_embeddings else 2)
+            + config.num_hidden_layers
+            * (
+                4 * config.hidden_size * config.hidden_size  # qkvo (approx, GQA ignored)
+                + 3 * config.hidden_size * config.intermediate_size
+            )
+        )
+        return 6 * n
