@@ -46,6 +46,8 @@ from .auto_parallel.api import (
 )
 from . import checkpoint
 from .checkpoint import load_state_dict, save_state_dict
+from . import utils
+from .utils import global_gather, global_scatter
 
 is_initialized = env.is_initialized
 

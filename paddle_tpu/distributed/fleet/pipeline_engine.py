@@ -101,7 +101,7 @@ class PipelineStack(Layer):
 
             return apply(fn, Tensor(x) if not isinstance(x, Tensor) else x, *stacked, name="layer_stack")
 
-        cache_key = (id(mesh), tuple(extra))
+        cache_key = (mesh, tuple(extra))  # Mesh is hashable by content+devices
         engine_jit = self._jit_cache.get(cache_key)
         if engine_jit is not None:
             return apply(engine_jit, x if isinstance(x, Tensor) else Tensor(x), *stacked, name="pipeline")

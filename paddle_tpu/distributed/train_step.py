@@ -103,6 +103,13 @@ class DistributedTrainStep(TrainStep):
             return P()
         total = int(np.prod([self.mesh.shape[a] for a in axes]))
         if np.shape(arr)[0] % total != 0:
+            import warnings
+
+            warnings.warn(
+                f"batch dim {np.shape(arr)[0]} not divisible by dp×sharding={total}; "
+                "falling back to replicated input (no data parallelism for this array)",
+                stacklevel=3,
+            )
             return P()
         return P(axes if len(axes) > 1 else axes[0])
 

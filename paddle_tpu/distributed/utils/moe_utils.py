@@ -1,0 +1,39 @@
+"""MoE token-exchange collectives (reference:
+python/paddle/distributed/utils/moe_utils.py global_scatter/global_gather →
+paddle/fluid/operators/collective/global_scatter_op.*, global_gather_op.*).
+
+The reference's ops are a count-driven all-to-all over the expert NCCL
+group. On TPU the idiomatic form is `lax.all_to_all` over the expert mesh
+axis inside shard_map (static splits — XLA needs static shapes, which is
+also why MoELayer routes with a static capacity instead of dynamic counts).
+These functions are the explicit-collective escape hatch; MoELayer itself
+relies on GSPMD to insert the same collective from the einsum sharding.
+"""
+import jax
+
+from ...framework.core import Tensor, apply, to_tensor
+from ..communication.ops import _bound_axes
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def global_scatter(x, local_count=None, global_count=None, group=None, use_calc_stream=True):
+    """Exchange per-expert token blocks: rank r sends block e to the rank
+    owning expert e. With equal static blocks this IS all_to_all over the
+    expert axis (split/concat on dim 0)."""
+    t = _t(x)
+    axes = _bound_axes(group)
+    if axes:
+        return apply(
+            lambda a: jax.lax.all_to_all(a, axes[0], split_axis=0, concat_axis=0, tiled=True),
+            t, name="global_scatter",
+        )
+    return t
+
+
+def global_gather(x, local_count=None, global_count=None, group=None, use_calc_stream=True):
+    """Inverse exchange of global_scatter (all_to_all is an involution for
+    equal blocks)."""
+    return global_scatter(x, local_count, global_count, group, use_calc_stream)

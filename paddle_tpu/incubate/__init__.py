@@ -1,4 +1,4 @@
 """paddle.incubate parity (reference: python/paddle/incubate/) — fused nn
 ops and distributed extras. On TPU, "fused" means XLA/Pallas fusion."""
-from . import nn
+from . import distributed, nn
 from .nn import functional

@@ -1,0 +1,155 @@
+"""MoE gates (reference: python/paddle/incubate/distributed/models/moe/
+gate/{naive_gate,gshard_gate,switch_gate}.py).
+
+TPU-native formulation: a gate maps tokens [T, M] to dense dispatch/combine
+tensors with a STATIC expert capacity —
+
+    combine_weights [T, E, C]  (float; routing probabilities)
+    dispatch_mask   [T, E, C]  (0/1 float; combine > 0)
+
+so the whole MoE layer is three einsums that XLA partitions over the expert
+mesh axis (the all_to_all the reference issues by hand via global_scatter/
+global_gather becomes an XLA collective inserted by GSPMD). Static capacity
+is what keeps shapes XLA-compilable; overflow tokens are dropped exactly as
+in GShard/Switch.
+"""
+import jax
+import jax.numpy as jnp
+
+from .....framework.core import Tensor, apply
+from .....nn import initializer as I
+from .....nn.layer.layers import Layer
+
+
+def _capacity(num_tokens, num_experts, top_k, capacity_factor):
+    cap = int(capacity_factor * top_k * num_tokens / num_experts)
+    return max(cap, 4)
+
+
+def top_k_dispatch(probs, top_k, capacity, normalize=True):
+    """GShard-style top-k routing with positional capacity assignment.
+
+    probs: [T, E] routing probabilities. Returns
+    (combine [T,E,C], dispatch [T,E,C], aux_loss scalar).
+    Pure jnp — called under `apply` so gradients flow to the gate weight.
+    """
+    T, E = probs.shape
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)  # [T, k]
+    if normalize:
+        gate_vals = gate_vals / (gate_vals.sum(-1, keepdims=True) + 1e-9)
+    mask = jax.nn.one_hot(gate_idx, E, dtype=probs.dtype)  # [T, k, E]
+
+    # position-in-expert: all 1st choices queue before any 2nd choice
+    # (k-major cumsum), matching GShard's priority rule.
+    mask_kmaj = jnp.transpose(mask, (1, 0, 2)).reshape(top_k * T, E)
+    pos_kmaj = jnp.cumsum(mask_kmaj, axis=0) - mask_kmaj
+    pos = jnp.transpose(pos_kmaj.reshape(top_k, T, E), (1, 0, 2))  # [T, k, E]
+
+    keep = (pos < capacity).astype(probs.dtype) * mask  # [T, k, E]
+    pos_in_e = (pos * mask).sum(-1).astype(jnp.int32)  # [T, k]
+    onehot_c = jax.nn.one_hot(pos_in_e, capacity, dtype=probs.dtype)  # [T, k, C]
+    combine = jnp.einsum("tke,tk,tkc->tec", keep, gate_vals, onehot_c)
+    dispatch = (combine > 0).astype(probs.dtype)
+
+    # load-balancing auxiliary loss (GShard eq. for top-1 fraction)
+    me = probs.mean(axis=0)  # mean router prob per expert
+    first_choice = mask[:, 0, :]
+    ce = first_choice.mean(axis=0)  # fraction of tokens whose 1st choice is e
+    aux = (me * ce).sum() * E
+    return combine, dispatch, aux
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2, capacity_factor=1.25):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.world_size = world_size
+        self.tot_expert = num_expert * world_size
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter(
+            [d_model, self.tot_expert], default_initializer=I.XavierUniform()
+        )
+        self._loss = None
+
+    def get_loss(self, clear=True):
+        loss = self._loss
+        if clear:
+            self._loss = None
+        return loss
+
+    def set_loss(self, loss):
+        self._loss = loss
+
+    def _route(self, x, noise="none", noise_eps=0.0):
+        """x: [T, M] Tensor → (combine, dispatch, aux_loss) Tensors.
+
+        noise: "none" | "mult_uniform" (Switch: logits × U[1-eps, 1+eps]) |
+        "gumbel" (GShard random_routing: stochastic tie-breaking). Keys come
+        from the framework RNG stream (framework/random.py next_key) so the
+        draw differs per step / per traced call, eagerly and under jit.
+        """
+        from .....framework import random as prandom
+
+        T = x.shape[0]
+        cap = _capacity(T, self.tot_expert, self.top_k, self.capacity_factor)
+        k = self.top_k
+        key = prandom.next_key() if noise != "none" else None
+
+        def fn(xx, w):
+            logits = xx @ w
+            if noise == "mult_uniform":
+                u = jax.random.uniform(key, logits.shape, logits.dtype,
+                                       1.0 - noise_eps, 1.0 + noise_eps)
+                logits = logits * u
+            elif noise == "gumbel":
+                g = jax.random.gumbel(key, logits.shape, logits.dtype)
+                logits = logits + noise_eps * g
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(xx.dtype)
+            return top_k_dispatch(probs, k, cap)
+
+        combine, dispatch, aux = apply(fn, x, self.weight, name="moe_gate")
+        self.set_loss(aux)
+        return combine, dispatch, aux
+
+    def forward(self, x):
+        return self._route(x)
+
+
+class NaiveGate(BaseGate):
+    """Plain learned top-k gate, no noise (reference: gate/naive_gate.py)."""
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with load-balance aux loss (reference: gate/gshard_gate.py).
+    random_routing: stochastic second-choice routing during training,
+    realized as gumbel perturbation of the logits (reference randomly accepts
+    the 2nd expert proportional to its gate value — same exploration effect,
+    expressed as a shape-static perturbation XLA can compile)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=2, capacity=(1.2, 2.4),
+                 random_routing=True, group=None):
+        cf = capacity[0] if isinstance(capacity, (tuple, list)) else capacity
+        super().__init__(d_model, num_expert, world_size, top_k=top_k, capacity_factor=cf)
+        self.random_routing = random_routing
+
+    def forward(self, x):
+        if self.random_routing and self.training:
+            return self._route(x, noise="gumbel", noise_eps=0.01)
+        return self._route(x)
+
+
+class SwitchGate(BaseGate):
+    """Top-1 switch-transformer gate (reference: gate/switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, world_size=1, top_k=1, switch_eps=0.1,
+                 capacity=(1.2, 2.4), group=None):
+        cf = capacity[0] if isinstance(capacity, (tuple, list)) else capacity
+        super().__init__(d_model, num_expert, world_size, top_k=1, capacity_factor=cf)
+        self.switch_eps = switch_eps
+
+    def forward(self, x):
+        if self.training and self.switch_eps:
+            return self._route(x, noise="mult_uniform", noise_eps=self.switch_eps)
+        return self._route(x)

@@ -236,6 +236,9 @@ class LlamaForCausalLMPipe(Layer):
         self.pp_degree = pp_degree
         self.num_micro_batches = num_micro_batches or max(pp_degree, 1)
         self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        self.embed_tokens.weight._data = I.Normal(0.0, 0.02)(
+            (config.vocab_size, config.hidden_size), self.embed_tokens.weight.dtype
+        )
         self.embed_tokens.weight.partition_spec = P("mp", None)
         self.decoder = PipelineStack(
             lambda: LlamaDecoderLayer(config), config.num_hidden_layers, pp_degree,
