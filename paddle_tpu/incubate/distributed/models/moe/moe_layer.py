@@ -109,20 +109,18 @@ class MoELayer(Layer):
 
         dispatched = einsum("tec,tm->ecm", dispatch, tokens)  # [E, C, M]
 
-        def expert_forward(d):
-            if isinstance(self.experts, ExpertStack):
-                return self.experts(d)
+        remat = self.recompute_interval > 0
+        if remat:
+            from .....distributed.fleet.recompute import recompute
+        if isinstance(self.experts, ExpertStack):
+            # pass the Layer itself so recompute lifts its parameters as
+            # differentiable inputs of the checkpointed region
+            expert_out = recompute(self.experts, dispatched) if remat else self.experts(dispatched)
+        else:
             outs = []
             for e, expert in enumerate(self.experts):
-                outs.append(expert(d[e]))
-            return manipulation.stack(outs, axis=0)
-
-        if self.recompute_interval > 0:
-            from .....distributed.fleet.recompute import recompute
-
-            expert_out = recompute(expert_forward, dispatched)
-        else:
-            expert_out = expert_forward(dispatched)
+                outs.append(recompute(expert, dispatched[e]) if remat else expert(dispatched[e]))
+            expert_out = manipulation.stack(outs, axis=0)
         out = einsum("tec,ecm->tm", combine, expert_out)  # [T, M]
         return manipulation.reshape(out, list(orig_shape[:-1]) + [M])
 
