@@ -80,6 +80,10 @@ class Fleet:
             if isinstance(model, PipelineLayer):
                 return PipelineParallel(model, hcg, self._strategy)
             raise TypeError("pp_degree > 1 requires a PipelineLayer model")
+        if hcg.get_sep_parallel_world_size() > 1:
+            from .meta_parallel import SegmentParallel
+
+            return SegmentParallel(model, hcg, self._strategy)
         if hcg.get_model_parallel_world_size() > 1:
             return TensorParallel(model, hcg, self._strategy)
         if hcg.get_sharding_parallel_world_size() > 1:

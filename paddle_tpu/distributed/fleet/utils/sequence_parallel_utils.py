@@ -1,0 +1,180 @@
+"""Megatron-style sequence parallelism utilities (reference:
+fleet/utils/sequence_parallel_utils.py — ScatterOp/GatherOp/AllGatherOp/
+ReduceScatterOp autograd pairs, ColumnSequenceParallelLinear/
+RowSequenceParallelLinear, mark_as_sequence_parallel_parameter +
+register_sequence_parallel_allreduce_hooks for LN/bias grads).
+
+TPU-native: under GSPMD, sequence parallelism is an ACTIVATION SHARDING
+decision — annotate the activation's sequence dim with the "mp" axis and
+XLA inserts exactly the all-gather/reduce-scatter pair Megatron-SP issues by
+hand around the TP matmuls. The ops below are therefore thin autograd pairs
+that (a) in eager single-controller mode apply/clear a sharding hint, and
+(b) inside shard_map lower to the real collectives, keeping reference
+script compatibility either way.
+"""
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ....framework.core import Tensor, apply, to_tensor
+from ....nn import functional as F
+from ....nn import initializer as I
+from ....nn.layer.layers import Layer
+from ...communication.ops import _bound_axes
+from ...mesh import axis_size, get_mesh, has_mesh, sharding_for
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _hint(t, spec):
+    """Annotate (don't move) — with_sharding_constraint under jit/pjit,
+    device_put eagerly."""
+    if not has_mesh():
+        return t
+
+    def fn(a):
+        try:
+            return jax.lax.with_sharding_constraint(a, sharding_for(spec))
+        except Exception:
+            return a
+
+    return apply(fn, t, name="sp_hint")
+
+
+class ScatterOp:
+    """Partition the sequence dim (dim 0, [s, b, h] layout like the
+    reference; dim 1 via `axis`) across mp ranks. fw: split, bw: all-gather."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        x = _t(x)
+        axes = _bound_axes(None)
+        if "mp" in axes:
+            def fn(a):
+                n = jax.lax.psum(1, "mp")
+                i = jax.lax.axis_index("mp")
+                size = a.shape[axis] // n
+                return jax.lax.dynamic_slice_in_dim(a, i * size, size, axis)
+            return apply(fn, x, name="sp_scatter")
+        spec = [None] * len(x.shape)
+        spec[axis] = "mp"
+        return _hint(x, P(*spec))
+
+
+class GatherOp:
+    """Inverse of ScatterOp: fw all-gather along seq, bw scatter."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        x = _t(x)
+        axes = _bound_axes(None)
+        if "mp" in axes:
+            return apply(lambda a: jax.lax.all_gather(a, "mp", axis=axis, tiled=True), x, name="sp_gather")
+        return _hint(x, P(*([None] * len(x.shape))))
+
+
+class AllGatherOp(GatherOp):
+    """fw: all-gather seq dim; bw: reduce-scatter (the Megatron-SP pair)."""
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x, axis=0):
+        x = _t(x)
+        axes = _bound_axes(None)
+        if "mp" in axes:
+            return apply(
+                lambda a: jax.lax.psum_scatter(a, "mp", scatter_dimension=axis, tiled=True),
+                x, name="sp_reduce_scatter",
+            )
+        spec = [None] * len(x.shape)
+        spec[axis] = "mp"
+        return _hint(x, P(*spec))
+
+
+def scatter(x, axis=0):
+    return ScatterOp.apply(x, axis)
+
+
+def all_gather(x, axis=0):
+    return AllGatherOp.apply(x, axis)
+
+
+def reduce_scatter(x, axis=0):
+    return ReduceScatterOp.apply(x, axis)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    """LN/bias params replicated across mp whose grads the reference
+    all-reduces over the mp group via hooks; under GSPMD the grad psum is
+    emitted by the partitioner, so the mark is metadata."""
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1, fuse_sequence_parallel_allreduce=False):
+    marked = [p for p in model.parameters() if is_sequence_parallel_parameter(p)]
+    return marked  # grads of replicated params are reduced by GSPMD
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column-parallel linear whose INPUT is sequence-sharded (reference:
+    ColumnSequenceParallelLinear — all-gathers the seq dim, matmuls against
+    the column-sharded weight). Weight spec (None, "mp"); the activation
+    gather is GSPMD's job once the output spec wants full seq."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None,
+                 gather_output=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr, default_initializer=I.XavierNormal()
+        )
+        self.weight.partition_spec = P(None, "mp")
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.partition_spec = P("mp")
+        else:
+            self.bias = None
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x)
+        return F.linear(x, self.weight, self.bias)
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-parallel linear whose OUTPUT is sequence-sharded (reference:
+    RowSequenceParallelLinear — matmul then reduce-scatter onto the seq
+    dim). Weight spec ("mp", None)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=True, mp_group=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr, default_initializer=I.XavierNormal()
+        )
+        self.weight.partition_spec = P("mp", None)
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+        else:
+            self.bias = None
+            self.add_parameter("bias", None)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, None)
+        out = ReduceScatterOp.apply(out)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def create_fused_allreduce_gradient_hooks(model, accumulation_steps):
+    return register_sequence_parallel_allreduce_hooks(model, accumulation_steps)

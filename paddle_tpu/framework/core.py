@@ -379,7 +379,8 @@ class Parameter(Tensor):
     stop_gradient defaults False, carries an optional trainable flag and a
     distributed PartitionSpec hint used by the pjit paths)."""
 
-    __slots__ = ("trainable", "optimize_attr", "is_distributed", "partition_spec", "no_sync")
+    __slots__ = ("trainable", "optimize_attr", "is_distributed", "partition_spec", "no_sync",
+                 "sequence_parallel", "__dict__")
 
     def __init__(self, data, trainable=True, name=None):
         super().__init__(data, stop_gradient=not trainable, name=name)
@@ -388,6 +389,7 @@ class Parameter(Tensor):
         self.is_distributed = False
         self.partition_spec = None
         self.no_sync = False
+        self.sequence_parallel = False
 
 
 # -- pytree registration ----------------------------------------------------

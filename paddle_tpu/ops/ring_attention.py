@@ -1,0 +1,95 @@
+"""Ring attention over the ICI ring (reference capability: context/ring
+parallelism — ecosystem RingFlashAttention atop core sep groups, SURVEY.md
+§5 long-context; here first-class).
+
+Blockwise flash attention with the KV blocks rotating around the mesh axis
+by `lax.ppermute` while Q stays resident: each of the N steps computes one
+Q-block × KV-block tile with online-softmax accumulation (running max m,
+normalizer l, unnormalized output o — the flash-attention recurrence), so
+peak memory is O(S_local²) instead of O(S²) and the sequence scales with the
+number of chips on the ring. Causal masking is by GLOBAL positions (block
+skew): q_pos = q_shard·S + i, k_pos = src_shard·S + j, mask q_pos ≥ k_pos.
+
+Use inside shard_map with the sequence dim sharded on a mesh axis (canonical:
+"sep"). Layout: [B, H, S_local, D].
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _online_step(q, k_cur, v_cur, o, l, m, mask, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cur).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
+    m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    l = l * corr + p.sum(axis=-1, keepdims=True)
+    o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+    return o, l, m_new
+
+
+def ring_attention(q, k, v, axis_name="sep", causal=False, scale=None):
+    """q/k/v: [B, H, S_local, D] local shards inside shard_map; the logical
+    sequence is S_local × axis_size(axis_name). Returns [B, H, S_local, D]."""
+    B, H, S, D = q.shape
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    o0 = jnp.zeros((B, H, S, D), jnp.float32)
+    l0 = jnp.zeros((B, H, S, 1), jnp.float32)
+    m0 = jnp.full((B, H, S, 1), -1e30, jnp.float32)
+    back_perm = [(j, (j - 1) % n) for j in range(n)]  # kv block walks the ring
+
+    qpos = my * S + jnp.arange(S)[:, None]
+
+    def body(carry, i):
+        o, l, m, k_cur, v_cur = carry
+        src = (my + i) % n  # whose kv block we hold at step i
+        if causal:
+            kpos = src * S + jnp.arange(S)[None, :]
+            mask = qpos >= kpos
+        else:
+            mask = None
+        o, l, m = _online_step(q, k_cur, v_cur, o, l, m, mask, scale)
+        k_cur = jax.lax.ppermute(k_cur, axis_name, back_perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, back_perm)
+        return (o, l, m, k_cur, v_cur), None
+
+    # scan (not fori_loop): reverse-mode AD flows through it, and n is a
+    # static mesh-axis size so the ring unrolls to a fixed trip count
+    (o, l, m, _, _), _ = jax.lax.scan(body, (o0, l0, m0, k, v), jnp.arange(n))
+    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name="sep", causal=False, scale=None, attn_impl=None):
+    """Ulysses/sep segment parallelism (reference: meta_parallel/
+    segment_parallel.py sep axis — all-to-all head↔seq exchange around
+    attention). q/k/v: [B, S_local, H, D] with H divisible by axis size.
+
+    all_to_all swaps the sharded dim: seq-sharded → head-sharded, runs FULL
+    sequence attention on H/N heads, then swaps back. Two all_to_alls over
+    ICI replace the reference's global_scatter-style exchange.
+    """
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
+    # [B, S_loc, H, D] -> [B, S, H_loc, D]
+    q_f = a2a(q, split_axis=2, concat_axis=1)
+    k_f = a2a(k, split_axis=2, concat_axis=1)
+    v_f = a2a(v, split_axis=2, concat_axis=1)
+    if attn_impl is None:
+        def attn_impl(qq, kk, vv):
+            B, Sq, Hh, Dd = qq.shape
+            sc = scale if scale is not None else 1.0 / math.sqrt(Dd)
+            s = jnp.einsum("bqhd,bkhd->bhqk", qq, kk).astype(jnp.float32) * sc
+            if causal:
+                mask = jnp.tril(jnp.ones((Sq, kk.shape[1]), bool))
+                s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bkhd->bqhd", p, vv.astype(jnp.float32)).astype(qq.dtype)
+    out = attn_impl(q_f, k_f, v_f)
+    # [B, S, H_loc, D] -> [B, S_loc, H, D]
+    return a2a(out, split_axis=1, concat_axis=2)
