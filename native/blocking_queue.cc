@@ -1,0 +1,108 @@
+// Bounded blocking queue + prefetch buffer: the native core of the
+// DataLoader pipeline. TPU-native counterpart of the reference's C++
+// BlockingQueue feeding device-side queues
+// (paddle/fluid/operators/reader/blocking_queue.h, LoDTensorBlockingQueue)
+// — here it decouples Python worker threads producing host numpy batches
+// from the trainer thread feeding jax.device_put, so host IO overlaps step
+// execution without the GIL serializing the handoff.
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Buf {
+  char* data;
+  size_t len;
+};
+
+struct Queue {
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  std::deque<Buf> items;
+  size_t capacity;
+  bool closed = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bq_create(int capacity) {
+  auto* q = new Queue();
+  q->capacity = capacity > 0 ? static_cast<size_t>(capacity) : 1;
+  return q;
+}
+
+// 0 ok, -1 closed, -2 timeout. Copies buf (caller keeps ownership of input).
+int bq_push(void* handle, const void* buf, long long len, int timeout_ms) {
+  auto* q = static_cast<Queue*>(handle);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [&] { return q->closed || q->items.size() < q->capacity; };
+  if (timeout_ms < 0) {
+    q->not_full.wait(lk, pred);
+  } else if (!q->not_full.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred)) {
+    return -2;
+  }
+  if (q->closed) return -1;
+  Buf b;
+  b.len = static_cast<size_t>(len);
+  b.data = static_cast<char*>(std::malloc(b.len ? b.len : 1));
+  std::memcpy(b.data, buf, b.len);
+  q->items.push_back(b);
+  lk.unlock();
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// returns length >=0 (caller frees via bq_free), -1 closed+drained, -2 timeout
+long long bq_pop(void* handle, char** out, int timeout_ms) {
+  auto* q = static_cast<Queue*>(handle);
+  std::unique_lock<std::mutex> lk(q->mu);
+  auto pred = [&] { return q->closed || !q->items.empty(); };
+  if (timeout_ms < 0) {
+    q->not_empty.wait(lk, pred);
+  } else if (!q->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred)) {
+    return -2;
+  }
+  if (q->items.empty()) return -1;  // closed and drained
+  Buf b = q->items.front();
+  q->items.pop_front();
+  lk.unlock();
+  q->not_full.notify_one();
+  *out = b.data;
+  return static_cast<long long>(b.len);
+}
+
+int bq_size(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return static_cast<int>(q->items.size());
+}
+
+void bq_close(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->closed = true;
+  }
+  q->not_empty.notify_all();
+  q->not_full.notify_all();
+}
+
+void bq_destroy(void* handle) {
+  auto* q = static_cast<Queue*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    for (auto& b : q->items) std::free(b.data);
+    q->items.clear();
+  }
+  delete q;
+}
+
+void bq_free(char* p) { std::free(p); }
+
+}  // extern "C"

@@ -1,0 +1,309 @@
+// TCPStore: rendezvous key-value store for the distributed launcher.
+// TPU-native counterpart of the reference's C++ store at
+// paddle/phi/core/distributed/store/tcp_store.cc (TCPStore, tcp_utils) —
+// same contract: rank-0 hosts the server; workers set/get/wait keys and
+// bump atomic counters to rendezvous before jax.distributed handshakes.
+//
+// Protocol (length-prefixed, one request per round-trip):
+//   request:  u8 op | u32 klen | key | u32 vlen | value
+//   ops: 'S' set, 'G' get(blocking), 'A' add(i64 delta in value), 'D' delete,
+//        'C' check (non-blocking existence), 'L' list-keys-count
+//   response: u8 status ('O' ok, 'N' not found) | u32 vlen | value
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+  std::atomic<bool> stop{false};
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_reply(int fd, char status, const std::string& val) {
+  uint32_t len = static_cast<uint32_t>(val.size());
+  if (!write_full(fd, &status, 1)) return false;
+  if (!write_full(fd, &len, 4)) return false;
+  if (len && !write_full(fd, val.data(), len)) return false;
+  return true;
+}
+
+void serve_conn(Store* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    char op;
+    uint32_t klen = 0, vlen = 0;
+    if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) break;
+    if (klen > (1u << 20)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_full(fd, &key[0], klen)) break;
+    if (!read_full(fd, &vlen, 4)) break;
+    if (vlen > (1u << 30)) break;
+    std::string val(vlen, '\0');
+    if (vlen && !read_full(fd, &val[0], vlen)) break;
+
+    if (op == 'S') {
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->kv[key] = val;
+      }
+      s->cv.notify_all();
+      if (!send_reply(fd, 'O', "")) break;
+    } else if (op == 'G') {  // blocking get: waits until key exists or stop
+      std::unique_lock<std::mutex> lk(s->mu);
+      bool found = s->cv.wait_for(lk, std::chrono::milliseconds(600000), [&] {
+        return s->stop.load() || s->kv.count(key) > 0;
+      });
+      if (found && s->kv.count(key)) {
+        std::string v = s->kv[key];
+        lk.unlock();
+        if (!send_reply(fd, 'O', v)) break;
+      } else {
+        lk.unlock();
+        if (!send_reply(fd, 'N', "")) break;
+      }
+    } else if (op == 'A') {  // atomic add, value = i64 delta (little endian)
+      int64_t delta = 0;
+      if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+      int64_t result;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        int64_t cur = 0;
+        auto it = s->kv.find(key);
+        if (it != s->kv.end() && it->second.size() == 8)
+          std::memcpy(&cur, it->second.data(), 8);
+        result = cur + delta;
+        std::string stored(8, '\0');
+        std::memcpy(&stored[0], &result, 8);
+        s->kv[key] = stored;
+      }
+      s->cv.notify_all();
+      std::string out(8, '\0');
+      std::memcpy(&out[0], &result, 8);
+      if (!send_reply(fd, 'O', out)) break;
+    } else if (op == 'D') {
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->kv.erase(key);
+      }
+      if (!send_reply(fd, 'O', "")) break;
+    } else if (op == 'C') {  // non-blocking existence check
+      bool has;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        has = s->kv.count(key) > 0;
+      }
+      if (!send_reply(fd, has ? 'O' : 'N', "")) break;
+    } else if (op == 'L') {
+      size_t n;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        n = s->kv.size();
+      }
+      int64_t n64 = static_cast<int64_t>(n);
+      std::string out(8, '\0');
+      std::memcpy(&out[0], &n64, 8);
+      if (!send_reply(fd, 'O', out)) break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// returns server handle, or null on failure; port 0 picks a free port
+// (readable via tcpstore_server_port)
+void* tcpstore_server_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* s = new Store();
+  s->listen_fd = fd;
+  s->accept_thread = std::thread([s] {
+    for (;;) {
+      int cfd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (cfd < 0) {
+        if (s->stop.load()) return;
+        continue;
+      }
+      s->workers.emplace_back([s, cfd] { serve_conn(s, cfd); });
+    }
+  });
+  return s;
+}
+
+int tcpstore_server_port(void* handle) {
+  auto* s = static_cast<Store*>(handle);
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len) != 0)
+    return -1;
+  return ntohs(addr.sin_port);
+}
+
+void tcpstore_server_stop(void* handle) {
+  auto* s = static_cast<Store*>(handle);
+  s->stop.store(true);
+  s->cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  for (auto& t : s->workers)
+    if (t.joinable()) t.detach();  // conns close as clients disconnect
+  delete s;
+}
+
+// ---- client ----
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one request/response at a time per connection
+};
+
+void* tcpstore_client_connect(const char* host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return nullptr;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new Client();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() >= deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+}
+
+static bool request(Client* c, char op, const char* key, const void* val,
+                    uint32_t vlen, char* status, std::string* out) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  if (!write_full(c->fd, &op, 1) || !write_full(c->fd, &klen, 4) ||
+      !write_full(c->fd, key, klen) || !write_full(c->fd, &vlen, 4))
+    return false;
+  if (vlen && !write_full(c->fd, val, vlen)) return false;
+  uint32_t rlen = 0;
+  if (!read_full(c->fd, status, 1) || !read_full(c->fd, &rlen, 4)) return false;
+  out->assign(rlen, '\0');
+  if (rlen && !read_full(c->fd, &(*out)[0], rlen)) return false;
+  return true;
+}
+
+int tcpstore_set(void* handle, const char* key, const void* val, int len) {
+  char st;
+  std::string out;
+  auto* c = static_cast<Client*>(handle);
+  return request(c, 'S', key, val, static_cast<uint32_t>(len), &st, &out) && st == 'O' ? 0 : -1;
+}
+
+// blocking get; returns value length (caller frees via tcpstore_free), -1 on miss
+int tcpstore_get(void* handle, const char* key, char** out_val) {
+  char st;
+  std::string out;
+  auto* c = static_cast<Client*>(handle);
+  if (!request(c, 'G', key, nullptr, 0, &st, &out) || st != 'O') return -1;
+  *out_val = static_cast<char*>(std::malloc(out.size() ? out.size() : 1));
+  std::memcpy(*out_val, out.data(), out.size());
+  return static_cast<int>(out.size());
+}
+
+long long tcpstore_add(void* handle, const char* key, long long delta) {
+  char st;
+  std::string out;
+  int64_t d = delta;
+  auto* c = static_cast<Client*>(handle);
+  if (!request(c, 'A', key, &d, 8, &st, &out) || st != 'O' || out.size() != 8)
+    return -1;
+  int64_t result;
+  std::memcpy(&result, out.data(), 8);
+  return result;
+}
+
+int tcpstore_check(void* handle, const char* key) {
+  char st;
+  std::string out;
+  auto* c = static_cast<Client*>(handle);
+  if (!request(c, 'C', key, nullptr, 0, &st, &out)) return -1;
+  return st == 'O' ? 1 : 0;
+}
+
+int tcpstore_delete(void* handle, const char* key) {
+  char st;
+  std::string out;
+  auto* c = static_cast<Client*>(handle);
+  return request(c, 'D', key, nullptr, 0, &st, &out) && st == 'O' ? 0 : -1;
+}
+
+void tcpstore_client_close(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  ::close(c->fd);
+  delete c;
+}
+
+void tcpstore_free(char* p) { std::free(p); }
+
+}  // extern "C"

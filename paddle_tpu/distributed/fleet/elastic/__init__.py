@@ -1,0 +1,121 @@
+"""Elastic training manager (reference: fleet/elastic/manager.py
+ElasticManager — etcd-registered trainers with TTL'd keys; watches
+membership, rewrites the rank map, relaunches; scripts resume from
+checkpoints).
+
+TPU-native: heartbeats go through the launcher's TCPStore (no etcd dep);
+the launcher's watch loop performs the restart (controller.py
+elastic_level>=1); this manager supplies membership detection and the
+autoresume loop that the reference expects training scripts to implement
+by hand.
+"""
+import os
+import time
+
+from ....framework.native import TCPStore
+
+ELASTIC_TIMEOUT = 30
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, args=None, store=None, rank=None, world_size=None,
+                 heartbeat_interval=5, timeout=ELASTIC_TIMEOUT):
+        self.rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.world_size = world_size if world_size is not None else int(
+            os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.heartbeat_interval = heartbeat_interval
+        self.timeout = timeout
+        self._store = store
+        if self._store is None:
+            master = os.environ.get("PADDLE_MASTER")
+            if master:
+                host, port = master.rsplit(":", 1)
+                try:
+                    self._store = TCPStore(host, int(port), is_master=False)
+                except (TimeoutError, OSError):
+                    self._store = None
+        self.enabled = self._store is not None and self.world_size > 1
+
+    def beat(self):
+        if not self.enabled:
+            return
+        self._store.set(f"__beat__/{self.rank}", str(time.time()))
+
+    def dead_members(self):
+        """Ranks whose last heartbeat is older than `timeout` seconds."""
+        if not self.enabled:
+            return []
+        now = time.time()
+        dead = []
+        for r in range(self.world_size):
+            if r == self.rank:
+                continue
+            key = f"__beat__/{r}"
+            if not self._store.check(key):
+                continue  # never beat yet — still starting
+            ts = float(self._store.get(key))
+            if now - ts > self.timeout:
+                dead.append(r)
+        return dead
+
+    def health(self):
+        return ElasticStatus.RESTART if self.dead_members() else ElasticStatus.HOLD
+
+
+def autoresume(train_fn, checkpoint_dir, model=None, optimizer=None, max_attempts=3,
+               save_every=None):
+    """Autoresume loop (reference pattern: elastic relaunch + script-level
+    checkpoint resume; SURVEY.md §5 failure detection → TPU equivalent).
+
+    Runs train_fn(start_step, save_cb); on failure, reloads the latest
+    checkpoint and retries. train_fn calls save_cb(step) at checkpoint
+    boundaries."""
+    import json
+
+    from .... import serialization
+
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    meta_path = os.path.join(checkpoint_dir, "resume.json")
+
+    def latest_step():
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                return json.load(f)["step"]
+        return 0
+
+    def save_cb(step):
+        if model is not None:
+            serialization.save(model.state_dict(), os.path.join(checkpoint_dir, "model.pdparams"))
+        if optimizer is not None:
+            serialization.save(optimizer.state_dict(), os.path.join(checkpoint_dir, "opt.pdopt"))
+        with open(meta_path, "w") as f:
+            json.dump({"step": step, "ts": time.time()}, f)
+
+    def load():
+        model_path = os.path.join(checkpoint_dir, "model.pdparams")
+        if model is not None and os.path.exists(model_path):
+            model.set_state_dict(serialization.load(model_path))
+        opt_path = os.path.join(checkpoint_dir, "opt.pdopt")
+        if optimizer is not None and os.path.exists(opt_path):
+            optimizer.set_state_dict(serialization.load(opt_path))
+
+    last_err = None
+    for attempt in range(max_attempts):
+        try:
+            start = latest_step()
+            if attempt > 0 or start > 0:
+                load()
+            return train_fn(start, save_cb)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 — any trainer failure triggers resume
+            last_err = e
+    raise RuntimeError(f"autoresume: {max_attempts} attempts failed") from last_err

@@ -1,0 +1,3 @@
+from .context import Context  # noqa: F401
+from .controller import CollectiveController, launch  # noqa: F401
+from .job import Container, Pod  # noqa: F401

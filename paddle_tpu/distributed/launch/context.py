@@ -1,0 +1,63 @@
+"""Launch context: CLI args + env (reference:
+python/paddle/distributed/launch/context/__init__.py Context — argparse +
+PADDLE_* env snapshot merged into a Node/Args description)."""
+import argparse
+import os
+import socket
+
+
+def free_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch distributed training (reference: paddle.distributed.launch). "
+        "TPU semantics: one worker process per HOST drives all local chips; "
+        "--nproc_per_node>1 is for CPU-simulated multi-process runs.",
+    )
+    p.add_argument("--master", default=None,
+                   help="rendezvous store endpoint ip:port (rank-0 hosts it)")
+    p.add_argument("--rank", type=int, default=-1, help="node rank; -1 = assign via store")
+    p.add_argument("--nnodes", type=str, default="1", help="N or N:M for elastic range")
+    p.add_argument("--nproc_per_node", type=int, default=None)
+    p.add_argument("--devices", "--gpus", "--xpus", dest="devices", default=None,
+                   help="device ids this node uses (informational on TPU)")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--log_level", default="INFO")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--elastic_level", type=int, default=-1,
+                   help="-1/0: fail whole job on worker failure; 1: restart failed workers in place")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+class Context:
+    def __init__(self, argv=None):
+        self.args = build_parser().parse_args(argv)
+        self.envs = dict(os.environ)
+        nn = str(self.args.nnodes)
+        if ":" in nn:
+            lo, hi = nn.split(":")
+            self.nnodes_min, self.nnodes_max = int(lo), int(hi)
+        else:
+            self.nnodes_min = self.nnodes_max = int(nn)
+        self.nproc = self.args.nproc_per_node or 1
+        master = self.args.master or self.envs.get("PADDLE_MASTER")
+        if master is None:
+            master = f"127.0.0.1:{free_port()}"
+        self.master = master
+
+    @property
+    def master_host(self):
+        return self.master.rsplit(":", 1)[0]
+
+    @property
+    def master_port(self):
+        return int(self.master.rsplit(":", 1)[1])

@@ -1,0 +1,143 @@
+"""CollectiveController (reference:
+python/paddle/distributed/launch/controllers/{controller,collective,
+master}.py): rank-0 hosts the rendezvous store (native TCPStore); nodes
+register endpoints, derive ranks, build the Pod with the PADDLE_* env
+contract, then watch — restarting or aborting on failure per
+--elastic_level (fleet/elastic/manager.py ElasticManager semantics folded
+in: the restart path reassigns PADDLE_TRAINER_ID and relies on scripts
+resuming from checkpoints)."""
+import os
+import sys
+import time
+
+from ...framework.native import TCPStore
+from .context import Context
+from .job import Container, Pod
+
+
+class CollectiveController:
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.store = None
+        self.node_rank = None
+        self.endpoints = []
+
+    # ---- rendezvous ----
+    def build_store(self):
+        args = self.ctx.args
+        is_master = args.rank in (0, -1) and self._local_master()
+        try:
+            self.store = TCPStore(
+                self.ctx.master_host, self.ctx.master_port,
+                is_master=is_master, world_size=self.ctx.nnodes_max,
+            )
+        except (OSError, RuntimeError):
+            # somebody else bound it first — join as client
+            self.store = TCPStore(self.ctx.master_host, self.ctx.master_port, is_master=False)
+
+    def _local_master(self):
+        return self.ctx.master_host in ("127.0.0.1", "localhost", "0.0.0.0") or \
+            self.ctx.args.rank <= 0
+
+    def rendezvous(self):
+        args = self.ctx.args
+        if args.rank >= 0:
+            self.node_rank = args.rank
+        else:
+            self.node_rank = int(self.store.add("__nodes__", 1)) - 1
+        self.store.set(f"__node__/{self.node_rank}", f"{self._host()}:{self.ctx.master_port}")
+        self.store.barrier("rendezvous", self.ctx.nnodes_min, timeout=600)
+        self.endpoints = []
+        for r in range(self.ctx.nnodes_min):
+            ep = self.store.get(f"__node__/{r}")
+            self.endpoints.append(ep.decode() if isinstance(ep, bytes) else str(ep))
+
+    def _host(self):
+        import socket
+
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
+    # ---- pod ----
+    def build_pod(self):
+        args = self.ctx.args
+        nproc = self.ctx.nproc
+        nnodes = self.ctx.nnodes_min
+        world = nproc * nnodes
+        pod = Pod(name=f"{args.job_id}-{self.node_rank}")
+        trainer_endpoints = ",".join(self.endpoints)
+        for local_rank in range(nproc):
+            rank = self.node_rank * nproc + local_rank
+            env = {
+                "PADDLE_MASTER": self.ctx.master,
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_LOCAL_SIZE": str(nproc),
+                "PADDLE_NNODES": str(nnodes),
+                "PADDLE_NODE_RANK": str(self.node_rank),
+                "PADDLE_TRAINER_ENDPOINTS": trainer_endpoints,
+                "PADDLE_JOB_ID": str(args.job_id),
+                # torch-style aliases many scripts read
+                "RANK": str(rank),
+                "WORLD_SIZE": str(world),
+                "LOCAL_RANK": str(local_rank),
+                "MASTER_ADDR": self.ctx.master_host,
+                "MASTER_PORT": str(self.ctx.master_port),
+            }
+            if args.devices:
+                env["FLAGS_selected_devices"] = args.devices
+            log = os.path.join(args.log_dir, f"workerlog.{rank}")
+            cmd = [sys.executable, "-u", args.training_script, *args.training_script_args]
+            pod.add(Container(cmd, env, log))
+        return pod
+
+    # ---- watch loop ----
+    def watch(self, pod):
+        args = self.ctx.args
+        while True:
+            failed = pod.failed_containers()
+            if not failed and pod.finished():
+                return 0 if pod.success() else 1
+            if failed:
+                if args.elastic_level >= 1:
+                    restartable = [c for c in failed if c.restarts < args.max_restart]
+                    if len(restartable) < len(failed):
+                        pod.terminate()
+                        return 1
+                    for c in restartable:
+                        c.restarts += 1
+                        c.close_log()
+                        c.start()
+                else:
+                    pod.terminate()
+                    return 1
+            time.sleep(0.3)
+
+    def run(self):
+        self.build_store()
+        self.rendezvous()
+        pod = self.build_pod()
+        pod.deploy()
+        try:
+            rc = self.watch(pod)
+        except KeyboardInterrupt:
+            pod.terminate()
+            rc = 130
+        finally:
+            pod.terminate()
+            if self.store is not None:
+                try:
+                    self.store.barrier("teardown", self.ctx.nnodes_min, timeout=30)
+                except Exception:
+                    pass
+                self.store.stop_server()
+        return rc
+
+
+def launch(argv=None):
+    """Entry point (reference: launch/main.py launch())."""
+    ctx = Context(argv)
+    return CollectiveController(ctx).run()

@@ -1,0 +1,85 @@
+"""Pod / Container process model (reference:
+python/paddle/distributed/launch/job/{pod,container}.py — a Pod is this
+node's set of worker Containers, each a subprocess with the PADDLE_* env
+contract and a per-rank log file workerlog.N)."""
+import os
+import subprocess
+import sys
+import time
+
+
+class Container:
+    def __init__(self, cmd, env, log_path):
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc = None
+        self._log_f = None
+        self.restarts = 0
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        self._log_f = open(self.log_path, "ab")
+        full_env = {**os.environ, **self.env}
+        self.proc = subprocess.Popen(
+            self.cmd, env=full_env, stdout=self._log_f, stderr=subprocess.STDOUT
+        )
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def exit_code(self):
+        return None if self.proc is None else self.proc.poll()
+
+    def terminate(self, timeout=10):
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+
+    def close_log(self):
+        if self._log_f:
+            self._log_f.close()
+            self._log_f = None
+
+
+class Pod:
+    """One node's workers."""
+
+    def __init__(self, name="pod"):
+        self.name = name
+        self.containers = []
+
+    def add(self, container):
+        self.containers.append(container)
+
+    def deploy(self):
+        for c in self.containers:
+            c.start()
+
+    def alive_count(self):
+        return sum(1 for c in self.containers if c.alive())
+
+    def failed_containers(self):
+        return [c for c in self.containers if not c.alive() and c.exit_code not in (None, 0)]
+
+    def finished(self):
+        return all(not c.alive() for c in self.containers)
+
+    def success(self):
+        return all(c.exit_code == 0 for c in self.containers)
+
+    def terminate(self):
+        for c in self.containers:
+            c.terminate()
+        for c in self.containers:
+            c.close_log()
+
+    def join(self, poll_interval=0.5):
+        while not self.finished():
+            time.sleep(poll_interval)

@@ -1,0 +1,423 @@
+"""ctypes bindings for the native C++ runtime (native/*.cc →
+lib/libpaddle_tpu_native.so): TCPStore rendezvous (reference:
+paddle/phi/core/distributed/store/tcp_store.cc) and the DataLoader blocking
+queue (reference: paddle/fluid/operators/reader/blocking_queue.h).
+
+If the shared lib is missing, it is built on demand with `make` (g++ is in
+the image); if that fails, pure-Python fallbacks keep every API working —
+the native path is a performance/GIL-contention win, not a correctness
+dependency.
+"""
+import ctypes
+import os
+import queue as _pyqueue
+import socket
+import struct
+import subprocess
+import threading
+
+_LIB = None
+_TRIED = False
+
+
+def _lib_path():
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "lib", "libpaddle_tpu_native.so")
+
+
+def load_native():
+    """Load (building if needed) the native lib; returns None on failure."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _lib_path()
+    if not os.path.exists(path):
+        native_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(path))), "native")
+        if os.path.isdir(native_dir):
+            try:
+                subprocess.run(["make"], cwd=native_dir, check=True,
+                               capture_output=True, timeout=120)
+            except Exception:
+                return None
+    if not os.path.exists(path):
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+    # signatures
+    lib.tcpstore_server_start.restype = ctypes.c_void_p
+    lib.tcpstore_server_start.argtypes = [ctypes.c_int]
+    lib.tcpstore_server_port.restype = ctypes.c_int
+    lib.tcpstore_server_port.argtypes = [ctypes.c_void_p]
+    lib.tcpstore_server_stop.argtypes = [ctypes.c_void_p]
+    lib.tcpstore_client_connect.restype = ctypes.c_void_p
+    lib.tcpstore_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.tcpstore_set.restype = ctypes.c_int
+    lib.tcpstore_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    lib.tcpstore_get.restype = ctypes.c_int
+    lib.tcpstore_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p)]
+    lib.tcpstore_add.restype = ctypes.c_longlong
+    lib.tcpstore_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+    lib.tcpstore_check.restype = ctypes.c_int
+    lib.tcpstore_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tcpstore_delete.restype = ctypes.c_int
+    lib.tcpstore_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tcpstore_client_close.argtypes = [ctypes.c_void_p]
+    lib.tcpstore_free.argtypes = [ctypes.c_char_p]
+    lib.bq_create.restype = ctypes.c_void_p
+    lib.bq_create.argtypes = [ctypes.c_int]
+    lib.bq_push.restype = ctypes.c_int
+    lib.bq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong, ctypes.c_int]
+    lib.bq_pop.restype = ctypes.c_longlong
+    lib.bq_pop.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
+    lib.bq_size.restype = ctypes.c_int
+    lib.bq_size.argtypes = [ctypes.c_void_p]
+    lib.bq_close.argtypes = [ctypes.c_void_p]
+    lib.bq_destroy.argtypes = [ctypes.c_void_p]
+    lib.bq_free.argtypes = [ctypes.c_char_p]
+    _LIB = lib
+    return _LIB
+
+
+def native_available():
+    return load_native() is not None
+
+
+# --------------------------------------------------------------------------
+# TCPStore
+# --------------------------------------------------------------------------
+class _PyStoreServer:
+    """Pure-Python fallback server, protocol-compatible with tcp_store.cc."""
+
+    def __init__(self, port):
+        self._kv = {}
+        self._cond = threading.Condition()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(128)
+        self._stop = False
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self):
+        return self._sock.getsockname()[1]
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _recv(self, conn, n):
+        data = b""
+        while len(data) < n:
+            chunk = conn.recv(n - len(data))
+            if not chunk:
+                raise ConnectionError
+            data += chunk
+        return data
+
+    def _serve(self, conn):
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while True:
+                op = self._recv(conn, 1)
+                (klen,) = struct.unpack("<I", self._recv(conn, 4))
+                key = self._recv(conn, klen).decode()
+                (vlen,) = struct.unpack("<I", self._recv(conn, 4))
+                val = self._recv(conn, vlen) if vlen else b""
+                if op == b"S":
+                    with self._cond:
+                        self._kv[key] = val
+                        self._cond.notify_all()
+                    conn.sendall(b"O" + struct.pack("<I", 0))
+                elif op == b"G":
+                    with self._cond:
+                        ok = self._cond.wait_for(
+                            lambda: self._stop or key in self._kv, timeout=600)
+                        v = self._kv.get(key)
+                    if ok and v is not None:
+                        conn.sendall(b"O" + struct.pack("<I", len(v)) + v)
+                    else:
+                        conn.sendall(b"N" + struct.pack("<I", 0))
+                elif op == b"A":
+                    (delta,) = struct.unpack("<q", val)
+                    with self._cond:
+                        cur = struct.unpack("<q", self._kv.get(key, b"\0" * 8))[0]
+                        res = cur + delta
+                        self._kv[key] = struct.pack("<q", res)
+                        self._cond.notify_all()
+                    conn.sendall(b"O" + struct.pack("<I", 8) + struct.pack("<q", res))
+                elif op == b"D":
+                    with self._cond:
+                        self._kv.pop(key, None)
+                    conn.sendall(b"O" + struct.pack("<I", 0))
+                elif op == b"C":
+                    with self._cond:
+                        has = key in self._kv
+                    conn.sendall((b"O" if has else b"N") + struct.pack("<I", 0))
+                elif op == b"L":
+                    with self._cond:
+                        n = len(self._kv)
+                    conn.sendall(b"O" + struct.pack("<I", 8) + struct.pack("<q", n))
+                else:
+                    return
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop = True
+        with self._cond:
+            self._cond.notify_all()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _PyStoreClient:
+    def __init__(self, host, port, timeout_ms):
+        import time
+
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=5)
+                self._sock.settimeout(None)
+                self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._lock = threading.Lock()
+                return
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(f"cannot connect to store at {host}:{port}")
+                time.sleep(0.1)
+
+    def _recv(self, n):
+        data = b""
+        while len(data) < n:
+            chunk = self._sock.recv(n - len(data))
+            if not chunk:
+                raise ConnectionError
+            data += chunk
+        return data
+
+    def _request(self, op, key, val=b""):
+        with self._lock:
+            k = key.encode()
+            self._sock.sendall(op + struct.pack("<I", len(k)) + k + struct.pack("<I", len(val)) + val)
+            status = self._recv(1)
+            (rlen,) = struct.unpack("<I", self._recv(4))
+            out = self._recv(rlen) if rlen else b""
+        return status, out
+
+    def set(self, key, val):
+        st, _ = self._request(b"S", key, val)
+        return st == b"O"
+
+    def get(self, key):
+        st, out = self._request(b"G", key)
+        return out if st == b"O" else None
+
+    def add(self, key, delta):
+        st, out = self._request(b"A", key, struct.pack("<q", delta))
+        return struct.unpack("<q", out)[0] if st == b"O" else -1
+
+    def check(self, key):
+        st, _ = self._request(b"C", key)
+        return st == b"O"
+
+    def delete(self, key):
+        st, _ = self._request(b"D", key)
+        return st == b"O"
+
+    def close(self):
+        self._sock.close()
+
+
+class TCPStore:
+    """reference: paddle.base.core.TCPStore(host, port, is_master, world_size,
+    timeout). is_master starts the in-process server (rank 0)."""
+
+    def __init__(self, host, port, is_master=False, world_size=1, timeout=900,
+                 use_native=True):
+        self._server = None
+        self._native = use_native and native_available()
+        self.host, self.port = host, port
+        timeout_ms = int(timeout * 1000)
+        if is_master:
+            if self._native:
+                lib = load_native()
+                self._server = lib.tcpstore_server_start(port)
+                if not self._server:
+                    raise RuntimeError(f"TCPStore: cannot bind port {port}")
+                self.port = lib.tcpstore_server_port(self._server)
+            else:
+                self._server = _PyStoreServer(port)
+                self.port = self._server.port
+            host = "127.0.0.1"
+        if self._native:
+            lib = load_native()
+            self._client = lib.tcpstore_client_connect(host.encode(), self.port, timeout_ms)
+            if not self._client:
+                raise TimeoutError(f"cannot connect to store at {host}:{self.port}")
+        else:
+            self._client = _PyStoreClient(host, self.port, timeout_ms)
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        if self._native:
+            lib = load_native()
+            if lib.tcpstore_set(self._client, key.encode(), value, len(value)) != 0:
+                raise RuntimeError(f"TCPStore.set({key}) failed")
+        else:
+            self._client.set(key, value)
+
+    def get(self, key):
+        """Blocking get (waits for the key)."""
+        if self._native:
+            lib = load_native()
+            out = ctypes.c_char_p()
+            n = lib.tcpstore_get(self._client, key.encode(), ctypes.byref(out))
+            if n < 0:
+                return None
+            data = ctypes.string_at(out, n)
+            lib.tcpstore_free(out)
+            return data
+        return self._client.get(key)
+
+    def add(self, key, delta=1):
+        if self._native:
+            lib = load_native()
+            return int(lib.tcpstore_add(self._client, key.encode(), delta))
+        return self._client.add(key, delta)
+
+    def wait(self, keys, timeout=None):
+        for k in keys if isinstance(keys, (list, tuple)) else [keys]:
+            self.get(k)
+
+    def check(self, key):
+        if self._native:
+            lib = load_native()
+            return lib.tcpstore_check(self._client, key.encode()) == 1
+        return self._client.check(key)
+
+    def delete_key(self, key):
+        if self._native:
+            lib = load_native()
+            return lib.tcpstore_delete(self._client, key.encode()) == 0
+        return self._client.delete(key)
+
+    def barrier(self, name, world_size, timeout=600):
+        """All `world_size` participants block until everyone arrives."""
+        import time
+
+        n = self.add(f"__barrier/{name}", 1)
+        if n >= world_size:
+            self.set(f"__barrier/{name}/done", b"1")
+            return
+        deadline = time.monotonic() + timeout
+        while not self.check(f"__barrier/{name}/done"):
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"barrier {name}: {n}/{world_size} after {timeout}s")
+            time.sleep(0.05)
+
+    def stop_server(self):
+        if self._server is None:
+            return
+        if self._native:
+            load_native().tcpstore_server_stop(self._server)
+        else:
+            self._server.stop()
+        self._server = None
+
+    def __del__(self):
+        try:
+            if self._native and self._client:
+                load_native().tcpstore_client_close(self._client)
+                self._client = None
+            elif not self._native and getattr(self, "_client", None):
+                self._client.close()
+        except Exception:
+            pass
+
+
+# --------------------------------------------------------------------------
+# BlockingQueue
+# --------------------------------------------------------------------------
+class BlockingQueue:
+    """Bounded byte-buffer queue; native (no-GIL handoff) when the lib is
+    loadable, queue.Queue otherwise. Payloads are bytes (the DataLoader
+    pickles numpy batches into it)."""
+
+    def __init__(self, capacity=8, use_native=True):
+        self._native = use_native and native_available()
+        if self._native:
+            self._h = load_native().bq_create(capacity)
+        else:
+            self._q = _pyqueue.Queue(maxsize=capacity)
+            self._closed = False
+
+    def push(self, data: bytes, timeout=None):
+        if self._native:
+            rc = load_native().bq_push(self._h, data, len(data),
+                                       -1 if timeout is None else int(timeout * 1000))
+            if rc == -1:
+                raise RuntimeError("queue closed")
+            if rc == -2:
+                raise TimeoutError
+            return
+        if self._closed:
+            raise RuntimeError("queue closed")
+        try:
+            self._q.put(data, timeout=timeout)
+        except _pyqueue.Full:
+            raise TimeoutError from None
+
+    def pop(self, timeout=None):
+        """Returns bytes, or None when closed and drained."""
+        if self._native:
+            lib = load_native()
+            out = ctypes.c_char_p()
+            n = lib.bq_pop(self._h, ctypes.byref(out),
+                           -1 if timeout is None else int(timeout * 1000))
+            if n == -1:
+                return None
+            if n == -2:
+                raise TimeoutError
+            data = ctypes.string_at(out, n)
+            lib.bq_free(out)
+            return data
+        while True:
+            try:
+                return self._q.get(timeout=0.1 if self._closed else timeout)
+            except _pyqueue.Empty:
+                if self._closed and self._q.empty():
+                    return None
+                if timeout is not None:
+                    raise TimeoutError from None
+
+    def size(self):
+        return load_native().bq_size(self._h) if self._native else self._q.qsize()
+
+    def close(self):
+        if self._native:
+            load_native().bq_close(self._h)
+        else:
+            self._closed = True
+
+    def __del__(self):
+        try:
+            if self._native and getattr(self, "_h", None):
+                load_native().bq_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass

@@ -1,19 +1,25 @@
 """DataLoader (reference: python/paddle/io/dataloader/dataloader_iter.py —
-multiprocess workers feeding a device-side blocking queue).
+_DataLoaderIterMultiProcess: worker processes feeding a C++ blocking queue).
 
-TPU-native shape: worker processes (or the inline path) produce numpy
-batches; a background prefetch thread stages `prefetch_factor` batches and
-initiates async host→device transfer (jax device_put), overlapping input
-processing with device compute — the role the reference's pinned-memory
-thread + C++ BlockingQueue play.
+TPU-native shape: with num_workers>0, forked worker processes produce numpy
+batches, pickle them into per-worker pipes; parent reader threads stage the
+raw bytes into the NATIVE BlockingQueue (native/blocking_queue.cc — the
+GIL-free handoff), and the consumer unpickles + converts to Tensors,
+overlapping input processing with device compute — the role the reference's
+pinned-memory thread + C++ BlockingQueue play. num_workers=0 keeps the
+inline thread-prefetch path.
 """
 import itertools
+import os
+import pickle
 import queue
+import struct
 import threading
 
 import numpy as np
 
 from ..framework.core import Tensor, to_tensor
+from ..framework.native import BlockingQueue
 from .dataset import IterableDataset
 from .sampler import BatchSampler, DistributedBatchSampler
 
@@ -49,6 +55,17 @@ def default_collate_fn(batch):
         return to_tensor(np.asarray(batch))
     except Exception:
         return batch
+
+
+def _tensors_to_numpy(obj):
+    """Make a batch picklable across the worker pipe (Tensors → numpy)."""
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._data)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tensors_to_numpy(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tensors_to_numpy(v) for k, v in obj.items()}
+    return obj
 
 
 class DataLoader:
@@ -112,7 +129,106 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
+    def _np_collate(self, batch):
+        """Numpy-only collate for forked workers (no jax in children)."""
+        sample = batch[0]
+        if isinstance(sample, (np.ndarray, np.generic)):
+            return np.stack(batch)
+        if isinstance(sample, (int, float)):
+            return np.asarray(batch)
+        if isinstance(sample, (list, tuple)):
+            return type(sample)(self._np_collate(list(t)) for t in zip(*batch))
+        if isinstance(sample, dict):
+            return {k: self._np_collate([d[k] for d in batch]) for k in sample}
+        return np.asarray(batch)
+
+    def _to_tensors(self, obj):
+        if isinstance(obj, np.ndarray):
+            return to_tensor(obj)
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(self._to_tensors(o) for o in obj)
+        if isinstance(obj, dict):
+            return {k: self._to_tensors(v) for k, v in obj.items()}
+        return obj
+
+    def _mp_iter(self):
+        """Forked-worker path. Batch i is produced by worker i % W; the
+        consumer round-robins pops so sampler order is preserved (same
+        ordering contract as the reference's _DataLoaderIterMultiProcess)."""
+        global _worker_info
+        W = self.num_workers
+        all_indices = list(self.batch_sampler)
+        custom_collate = self.collate_fn is not default_collate_fn
+        pipes, pids, queues = [], [], []
+        for w in range(W):
+            r, wr = os.pipe()
+            pid = os.fork()
+            if pid == 0:  # child
+                try:
+                    os.close(r)
+                    _worker_info = WorkerInfo(w, W, self.dataset)
+                    if self.worker_init_fn is not None:
+                        self.worker_init_fn(w)
+                    for bi in range(w, len(all_indices), W):
+                        samples = [self.dataset[i] for i in all_indices[bi]]
+                        batch = self.collate_fn(samples) if custom_collate else self._np_collate(samples)
+                        blob = pickle.dumps(_tensors_to_numpy(batch), protocol=4)
+                        os.write(wr, struct.pack("<q", len(blob)))
+                        left = blob
+                        while left:
+                            n = os.write(wr, left)
+                            left = left[n:]
+                    os.write(wr, struct.pack("<q", 0))
+                    os.close(wr)
+                finally:
+                    os._exit(0)
+            os.close(wr)
+            pipes.append(r)
+            pids.append(pid)
+            q = BlockingQueue(capacity=self.prefetch_factor)
+            queues.append(q)
+
+            def reader(fd=r, bq=q):
+                try:
+                    while True:
+                        hdr = b""
+                        while len(hdr) < 8:
+                            chunk = os.read(fd, 8 - len(hdr))
+                            if not chunk:
+                                return
+                            hdr += chunk
+                        (n,) = struct.unpack("<q", hdr)
+                        if n == 0:
+                            return
+                        buf = bytearray()
+                        while len(buf) < n:
+                            chunk = os.read(fd, min(1 << 20, n - len(buf)))
+                            if not chunk:
+                                return
+                            buf.extend(chunk)
+                        bq.push(bytes(buf))
+                finally:
+                    bq.close()
+                    os.close(fd)
+
+            threading.Thread(target=reader, daemon=True).start()
+        try:
+            for bi in range(len(all_indices)):
+                blob = queues[bi % W].pop()
+                if blob is None:
+                    raise RuntimeError(f"DataLoader worker {bi % W} exited early")
+                yield self._to_tensors(pickle.loads(blob))
+        finally:
+            for pid in pids:
+                try:
+                    os.waitpid(pid, os.WNOHANG)
+                except ChildProcessError:
+                    pass
+
     def __iter__(self):
+        if self.num_workers > 0 and not self._iterable_mode:
+            yield from self._mp_iter()
+            return
         if not self.use_buffer_reader:
             yield from self._raw_batches()
             return
