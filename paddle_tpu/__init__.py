@@ -57,6 +57,7 @@ from . import hapi
 from .hapi import Model
 from . import distributed
 from . import incubate
+from . import distribution
 from . import profiler
 from . import sparse
 from . import linalg as _linalg_ns

@@ -1,0 +1,54 @@
+"""paddle.distribution parity (reference: python/paddle/distribution/ —
+Distribution base in distribution.py, kl registry in kl.py).
+
+TPU-native design: every density/sampling routine is pure jnp + jax.random,
+so distributions compose with jit/vmap/grad; sampling draws keys from the
+framework's threaded PRNG (framework/random.py) exactly like creation ops do.
+"""
+from .distribution import Distribution
+from .normal import Normal
+from .uniform import Uniform
+from .categorical import Categorical
+from .bernoulli import Bernoulli
+from .beta import Beta
+from .dirichlet import Dirichlet
+from .exponential import Exponential
+from .gamma import Gamma
+from .geometric import Geometric
+from .gumbel import Gumbel
+from .laplace import Laplace
+from .lognormal import LogNormal
+from .multinomial import Multinomial
+from .poisson import Poisson
+from .cauchy import Cauchy
+from .binomial import Binomial
+from .studentT import StudentT
+from .independent import Independent
+from .transformed_distribution import TransformedDistribution
+from .transform import (
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    IndependentTransform,
+    PowerTransform,
+    ReshapeTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StackTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+)
+from .kl import kl_divergence, register_kl
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
+    "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace",
+    "LogNormal", "Multinomial", "Poisson", "Cauchy", "Binomial", "StudentT",
+    "Independent", "TransformedDistribution", "kl_divergence", "register_kl",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]

@@ -1,0 +1,73 @@
+"""Bernoulli distribution (reference: python/paddle/distribution/bernoulli.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = self._to_float(probs)
+        self._retrace()
+        super().__init__(batch_shape=jnp.shape(self.probs))
+        self._track(probs=probs)
+
+    def _retrace(self):
+        self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.probs * (1 - self.probs))
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        return jax.random.bernoulli(key, self.probs, full).astype(self.probs.dtype)
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxation (paddle's rsample contract)."""
+        from ..framework.core import Tensor
+        from ..framework import random as prandom
+
+        full = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(prandom.next_key(), full, self.probs.dtype, 1e-6, 1 - 1e-6)
+        logistic = jnp.log(u) - jnp.log1p(-u)
+        return Tensor(jax.nn.sigmoid((self.logits + logistic) / temperature))
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value).astype(self.probs.dtype)
+        eps = 1e-8
+        p = jnp.clip(self.probs, eps, 1 - eps)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        eps = 1e-8
+        p = jnp.clip(self.probs, eps, 1 - eps)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+    def cdf(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value)
+        return Tensor(jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - self.probs, 1.0)))
+
+    def kl_divergence(self, other):
+        from ..framework.core import Tensor
+
+        if isinstance(other, Bernoulli):
+            eps = 1e-8
+            p = jnp.clip(self.probs, eps, 1 - eps)
+            q = jnp.clip(other.probs, eps, 1 - eps)
+            return Tensor(p * (jnp.log(p) - jnp.log(q)) + (1 - p) * (jnp.log1p(-p) - jnp.log1p(-q)))
+        return super().kl_divergence(other)

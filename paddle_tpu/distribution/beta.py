@@ -1,0 +1,53 @@
+"""Beta distribution (reference: python/paddle/distribution/beta.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        (self.alpha, self.beta), shape = self._validate_args(
+            self._to_float(alpha), self._to_float(beta)
+        )
+        super().__init__(batch_shape=shape)
+        self._track(alpha=alpha, beta=beta)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s**2 * (s + 1)))
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        return jax.random.beta(key, self.alpha, self.beta, full)
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value)
+        lbeta = (
+            jax.scipy.special.gammaln(self.alpha)
+            + jax.scipy.special.gammaln(self.beta)
+            - jax.scipy.special.gammaln(self.alpha + self.beta)
+        )
+        return Tensor((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        a, b = self.alpha, self.beta
+        lbeta = (
+            jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+            - jax.scipy.special.gammaln(a + b)
+        )
+        dg = jax.scipy.special.digamma
+        return Tensor(lbeta - (a - 1) * dg(a) - (b - 1) * dg(b) + (a + b - 2) * dg(a + b))

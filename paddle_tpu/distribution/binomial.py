@@ -1,0 +1,68 @@
+"""Binomial distribution (reference: python/paddle/distribution/binomial.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count) if jnp.ndim(total_count) == 0 else total_count
+        self.probs = self._to_float(probs)
+        super().__init__(batch_shape=jnp.shape(self.probs))
+        self._track(probs=probs)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        n = jnp.asarray(self.total_count, self.probs.dtype)
+        return jax.random.binomial(key, n, self.probs, full).astype(self.probs.dtype)
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        k = _data(value).astype(self.probs.dtype)
+        n = jnp.asarray(self.total_count, self.probs.dtype)
+        gl = jax.scipy.special.gammaln
+        eps = 1e-8
+        p = jnp.clip(self.probs, eps, 1 - eps)
+        return Tensor(
+            gl(n + 1) - gl(k + 1) - gl(n - k + 1) + k * jnp.log(p) + (n - k) * jnp.log1p(-p)
+        )
+
+    def entropy(self):
+        """Exact support sum for concrete scalar n ≤ 1024; Gaussian
+        approximation ½log(2πe·np(1−p)) otherwise."""
+        from ..framework.core import Tensor
+
+        n = jnp.asarray(self.total_count, self.probs.dtype)
+        p = self.probs
+        if jnp.ndim(self.total_count) == 0 and isinstance(self.total_count, int) \
+                and self.total_count <= 1024:
+            k = jnp.arange(self.total_count + 1, dtype=p.dtype)
+            k = k.reshape((self.total_count + 1,) + (1,) * p.ndim)
+            lp = self.log_prob(k)._data
+            return Tensor(-jnp.sum(jnp.exp(lp) * lp, axis=0))
+        return Tensor(0.5 * jnp.log(2 * jnp.pi * jnp.e * n * p * (1 - p) + 1e-8))
+
+    def kl_divergence(self, other):
+        from ..framework.core import Tensor
+
+        if isinstance(other, Binomial):
+            n = jnp.asarray(self.total_count, self.probs.dtype)
+            eps = 1e-8
+            p = jnp.clip(self.probs, eps, 1 - eps)
+            q = jnp.clip(other.probs, eps, 1 - eps)
+            return Tensor(n * (p * jnp.log(p / q) + (1 - p) * jnp.log((1 - p) / (1 - q))))
+        return super().kl_divergence(other)

@@ -1,0 +1,58 @@
+"""Categorical distribution (reference: python/paddle/distribution/categorical.py
+— paddle parameterizes by unnormalized `logits` acting as relative weights)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        # paddle semantics: `logits` are non-negative relative weights (not
+        # log-space); normalize to probabilities
+        self.logits = self._to_float(logits)
+        self._retrace()
+        super().__init__(batch_shape=self.logits.shape[:-1])
+        self._track(logits=logits)
+
+    def _retrace(self):
+        self._probs = self.logits / jnp.sum(self.logits, axis=-1, keepdims=True)
+
+    @property
+    def probs_array(self):
+        return self._probs
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        return jax.random.categorical(key, jnp.log(self._probs), shape=full)
+
+    def sample(self, shape=()):
+        from ..framework.core import Tensor
+        from ..framework import random as prandom
+
+        return Tensor(self._sample(prandom.next_key(), shape))
+
+    def probs(self, value):
+        from ..framework.core import Tensor
+
+        idx = _data(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(self._probs, idx[..., None], axis=-1)[..., 0])
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        return Tensor(jnp.log(self.probs(value)._data))
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        p = self._probs
+        return Tensor(-jnp.sum(p * jnp.log(jnp.where(p > 0, p, 1.0)), axis=-1))
+
+    def kl_divergence(self, other):
+        from ..framework.core import Tensor
+
+        if isinstance(other, Categorical):
+            p, q = self._probs, other._probs
+            return Tensor(jnp.sum(p * (jnp.log(p) - jnp.log(q)), axis=-1))
+        return super().kl_divergence(other)

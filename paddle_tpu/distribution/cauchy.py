@@ -1,0 +1,57 @@
+"""Cauchy distribution (reference: python/paddle/distribution/cauchy.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        (self.loc, self.scale), shape = self._validate_args(
+            self._to_float(loc), self._to_float(scale)
+        )
+        super().__init__(batch_shape=shape)
+        self._track(loc=loc, scale=scale)
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean.")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance.")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev.")
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        return self.loc + self.scale * jax.random.cauchy(key, full, self.loc.dtype)
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        z = (_data(value) - self.loc) / self.scale
+        return Tensor(-jnp.log(jnp.pi * self.scale * (1 + z**2)))
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        return Tensor(jnp.log(4 * jnp.pi * self.scale))
+
+    def cdf(self, value):
+        from ..framework.core import Tensor
+
+        z = (_data(value) - self.loc) / self.scale
+        return Tensor(jnp.arctan(z) / jnp.pi + 0.5)
+
+    def kl_divergence(self, other):
+        from ..framework.core import Tensor
+
+        if isinstance(other, Cauchy):
+            # closed form (Chyzak & Nielsen 2019)
+            num = (self.scale + other.scale) ** 2 + (self.loc - other.loc) ** 2
+            den = 4 * self.scale * other.scale
+            return Tensor(jnp.log(num / den))
+        return super().kl_divergence(other)

@@ -1,0 +1,53 @@
+"""Dirichlet distribution (reference: python/paddle/distribution/dirichlet.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = self._to_float(concentration)
+        super().__init__(
+            batch_shape=self.concentration.shape[:-1],
+            event_shape=self.concentration.shape[-1:],
+        )
+        self._track(concentration=concentration)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.concentration / jnp.sum(self.concentration, -1, keepdims=True))
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        a0 = jnp.sum(self.concentration, -1, keepdims=True)
+        m = self.concentration / a0
+        return Tensor(m * (1 - m) / (a0 + 1))
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        return jax.random.dirichlet(key, self.concentration, full)
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value)
+        a = self.concentration
+        norm = jnp.sum(jax.scipy.special.gammaln(a), -1) - jax.scipy.special.gammaln(
+            jnp.sum(a, -1)
+        )
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1) - norm)
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        a = self.concentration
+        k = a.shape[-1]
+        a0 = jnp.sum(a, -1)
+        dg = jax.scipy.special.digamma
+        lnB = jnp.sum(jax.scipy.special.gammaln(a), -1) - jax.scipy.special.gammaln(a0)
+        return Tensor(lnB + (a0 - k) * dg(a0) - jnp.sum((a - 1) * dg(a), -1))

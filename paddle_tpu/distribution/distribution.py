@@ -1,0 +1,187 @@
+"""Distribution base class (reference: python/paddle/distribution/distribution.py
+``class Distribution`` — batch_shape/event_shape, sample/log_prob/entropy).
+
+Functional core: subclasses implement `_sample(key, shape)` and pure-jnp
+`log_prob`; the base class handles Tensor boxing, key threading, and the
+broadcasting rules paddle's API exposes.
+
+Differentiability: subclass __init__ calls `self._track(attr=original, ...)`
+with the user-passed parameters; every density method (log_prob/entropy/kl/…)
+is auto-wrapped (``__init_subclass__``) to run through the dygraph tape
+(core.apply) with those Tensors as differentiable inputs — so VAE/ELBO/policy
+losses backprop into distribution parameters, matching the reference's
+differentiable distributions.
+"""
+import copy
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as prandom
+from ..framework.core import Tensor
+
+
+def _data(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32) if not hasattr(x, "dtype") else jnp.asarray(x)
+
+
+_TAPED_METHODS = ("log_prob", "pmf", "entropy", "cdf", "icdf", "kl_divergence", "rsample")
+_TAPED_PROPS = ("mean", "variance", "stddev")
+
+
+def _run_taped(fn, dists, args, kwargs=None):
+    """Run fn(self, *args, **kwargs) recording ONE tape node over all tracked
+    parameter Tensors of every Distribution involved (self, plus any
+    Distribution args for KL) and any Tensor-valued args. kwargs are closed
+    over as constants."""
+    from ..framework.core import apply
+
+    kwargs = kwargs or {}
+
+    spec, tensors = [], []
+    for di, d in enumerate(dists):
+        for attr, t, shape in getattr(d, "_tracked", ()):
+            spec.append((di, attr, shape))
+            tensors.append(t)
+    arg_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    all_tensors = tensors + [args[i] for i in arg_idx]
+    if not all_tensors:
+        return fn(dists[0], *args, **kwargs)
+
+    def raw(*arrays):
+        ps, vs = arrays[: len(spec)], arrays[len(spec):]
+        clones = [copy.copy(d) for d in dists]
+        for c in clones:
+            c._tracked = ()
+        for (di, attr, shape), p in zip(spec, ps):
+            cur = getattr(clones[di], attr)
+            val = p.astype(cur.dtype)
+            if shape is not None:
+                val = jnp.broadcast_to(val, shape)
+            setattr(clones[di], attr, val)
+        for c in clones:
+            retrace = getattr(c, "_retrace", None)
+            if retrace is not None:
+                retrace()
+        new_args = list(args)
+        rest = iter(clones[1:])
+        for i, a in enumerate(new_args):
+            if isinstance(a, Distribution):
+                new_args[i] = next(rest)
+        for i, v in zip(arg_idx, vs):
+            new_args[i] = v
+        out = fn(clones[0], *new_args, **kwargs)
+        return out._data if isinstance(out, Tensor) else out
+
+    return apply(raw, *all_tensors, name=getattr(fn, "__qualname__", "dist_op"))
+
+
+def _tape_wrap(fn):
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        dists = [self] + [a for a in args if isinstance(a, Distribution)]
+        return _run_taped(fn, dists, args, kwargs)
+
+    wrapper._taped = True
+    return wrapper
+
+
+class Distribution:
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        for name in _TAPED_METHODS:
+            impl = cls.__dict__.get(name)
+            if impl is not None and callable(impl) and not getattr(impl, "_taped", False):
+                setattr(cls, name, _tape_wrap(impl))
+        for name in _TAPED_PROPS:
+            impl = cls.__dict__.get(name)
+            if isinstance(impl, property) and not getattr(impl.fget, "_taped", False):
+                setattr(cls, name, property(_tape_wrap(impl.fget)))
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    def _track(self, **orig):
+        """Record original (possibly differentiable) parameter Tensors; the
+        attr named must already hold the broadcast raw array."""
+        tracked = []
+        for attr, v in orig.items():
+            if isinstance(v, Tensor):
+                cur = getattr(self, attr, None)
+                shape = tuple(cur.shape) if hasattr(cur, "shape") else None
+                tracked.append((attr, v, shape))
+        self._tracked = tuple(tracked)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    # -- sampling ---------------------------------------------------------
+    def _sample(self, key, shape):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        shape = tuple(shape)
+        return Tensor(self._sample(prandom.next_key(), shape))
+
+    def rsample(self, shape=()):
+        # reparameterized (pathwise) where the underlying sampler is; runs
+        # through the tape so gradients reach tracked parameters
+        key = prandom.next_key()
+        shape = tuple(shape)
+        return _run_taped(lambda d: Tensor(d._sample(key, shape)), [self], ())
+
+    # -- densities --------------------------------------------------------
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..framework.core import apply
+
+        return apply(jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    # -- helpers ----------------------------------------------------------
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    @staticmethod
+    def _validate_args(*args):
+        """Broadcast params to a common shape, returning jnp arrays."""
+        arrs = [_data(a) for a in args]
+        shape = jnp.broadcast_shapes(*[a.shape for a in arrs])
+        return [jnp.broadcast_to(a, shape) for a in arrs], shape
+
+    @staticmethod
+    def _to_float(*args):
+        out = []
+        for a in args:
+            d = _data(a)
+            if not np.issubdtype(np.dtype(d.dtype), np.floating):
+                d = d.astype(jnp.float32)
+            out.append(d)
+        return out[0] if len(out) == 1 else out

@@ -1,0 +1,52 @@
+"""Exponential distribution (reference: python/paddle/distribution/exponential.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = self._to_float(rate)
+        super().__init__(batch_shape=jnp.shape(self.rate))
+        self._track(rate=rate)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor(1.0 / self.rate**2)
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        return jax.random.exponential(key, full, self.rate.dtype) / self.rate
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        return Tensor(1.0 - jnp.log(self.rate))
+
+    def cdf(self, value):
+        from ..framework.core import Tensor
+
+        return Tensor(-jnp.expm1(-self.rate * _data(value)))
+
+    def kl_divergence(self, other):
+        from ..framework.core import Tensor
+
+        if isinstance(other, Exponential):
+            r = self.rate / other.rate
+            return Tensor(jnp.log(r) + 1.0 / r - 1.0)
+        return super().kl_divergence(other)

@@ -1,0 +1,57 @@
+"""Gamma distribution (reference: python/paddle/distribution/gamma.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        (self.concentration, self.rate), shape = self._validate_args(
+            self._to_float(concentration), self._to_float(rate)
+        )
+        super().__init__(batch_shape=shape)
+        self._track(concentration=concentration, rate=rate)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.concentration / self.rate**2)
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        return jax.random.gamma(key, self.concentration, full) / self.rate
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value)
+        a, r = self.concentration, self.rate
+        return Tensor(a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v - jax.scipy.special.gammaln(a))
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        a = self.concentration
+        dg = jax.scipy.special.digamma
+        return Tensor(a - jnp.log(self.rate) + jax.scipy.special.gammaln(a) + (1 - a) * dg(a))
+
+    def kl_divergence(self, other):
+        from ..framework.core import Tensor
+
+        if isinstance(other, Gamma):
+            a1, r1, a2, r2 = self.concentration, self.rate, other.concentration, other.rate
+            dg = jax.scipy.special.digamma
+            gl = jax.scipy.special.gammaln
+            return Tensor(
+                (a1 - a2) * dg(a1) - gl(a1) + gl(a2)
+                + a2 * (jnp.log(r1) - jnp.log(r2)) + a1 * (r2 / r1 - 1.0)
+            )
+        return super().kl_divergence(other)

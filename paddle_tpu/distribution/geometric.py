@@ -1,0 +1,67 @@
+"""Geometric distribution (reference: python/paddle/distribution/geometric.py
+— counts failures before first success, support {0, 1, 2, ...})."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = self._to_float(probs)
+        super().__init__(batch_shape=jnp.shape(self.probs))
+        self._track(probs=probs)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor((1 - self.probs) / self.probs**2)
+
+    @property
+    def stddev(self):
+        from ..framework.core import Tensor
+
+        return Tensor(jnp.sqrt((1 - self.probs) / self.probs**2))
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(key, full, self.probs.dtype, 1e-7, 1.0)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs))
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        k = _data(value)
+        return Tensor(k * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    def pmf(self, value):
+        from ..framework.core import Tensor
+
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        p = self.probs
+        return Tensor(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+    def cdf(self, value):
+        from ..framework.core import Tensor
+
+        k = _data(value)
+        return Tensor(1 - jnp.power(1 - self.probs, k + 1))
+
+    def kl_divergence(self, other):
+        from ..framework.core import Tensor
+
+        if isinstance(other, Geometric):
+            p, q = self.probs, other.probs
+            return Tensor(jnp.log(p / q) + ((1.0 - p) / p) * jnp.log((1.0 - p) / (1.0 - q)))
+        return super().kl_divergence(other)

@@ -1,0 +1,56 @@
+"""Gumbel distribution (reference: python/paddle/distribution/gumbel.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+_EULER = 0.57721566490153286
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        (self.loc, self.scale), shape = self._validate_args(
+            self._to_float(loc), self._to_float(scale)
+        )
+        super().__init__(batch_shape=shape)
+        self._track(loc=loc, scale=scale)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.loc + self.scale * _EULER)
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor((jnp.pi**2 / 6) * self.scale**2)
+
+    @property
+    def stddev(self):
+        from ..framework.core import Tensor
+
+        return Tensor(jnp.sqrt((jnp.pi**2 / 6)) * self.scale)
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        g = jax.random.gumbel(key, full, self.loc.dtype)
+        return self.loc + self.scale * g
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        z = (_data(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        return Tensor(jnp.log(self.scale) + 1 + _EULER)
+
+    def cdf(self, value):
+        from ..framework.core import Tensor
+
+        z = (_data(value) - self.loc) / self.scale
+        return Tensor(jnp.exp(-jnp.exp(-z)))

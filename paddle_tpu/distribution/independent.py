@@ -1,0 +1,42 @@
+"""Independent wrapper (reference: python/paddle/distribution/independent.py —
+reinterprets batch dims as event dims)."""
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        split = len(base.batch_shape) - self.reinterpreted_batch_rank
+        super().__init__(batch_shape=shape[:split], event_shape=shape[split:])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def _sample(self, key, shape):
+        return self.base._sample(key, shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        from ..framework.core import apply
+
+        r = self.reinterpreted_batch_rank
+        return apply(
+            lambda a: jnp.sum(a, axis=tuple(range(-r, 0))), self.base.log_prob(value)
+        )
+
+    def entropy(self):
+        from ..framework.core import apply
+
+        r = self.reinterpreted_batch_rank
+        return apply(lambda a: jnp.sum(a, axis=tuple(range(-r, 0))), self.base.entropy())

@@ -1,0 +1,71 @@
+"""Laplace distribution (reference: python/paddle/distribution/laplace.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        (self.loc, self.scale), shape = self._validate_args(
+            self._to_float(loc), self._to_float(scale)
+        )
+        super().__init__(batch_shape=shape)
+        self._track(loc=loc, scale=scale)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor(2 * self.scale**2)
+
+    @property
+    def stddev(self):
+        from ..framework.core import Tensor
+
+        return Tensor(jnp.sqrt(2.0) * self.scale)
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        return jax.random.laplace(key, full, self.loc.dtype) * self.scale + self.loc
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+    def cdf(self, value):
+        from ..framework.core import Tensor
+
+        z = (_data(value) - self.loc) / self.scale
+        return Tensor(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, value):
+        from ..framework.core import Tensor
+
+        p = _data(value)
+        return Tensor(self.loc - self.scale * jnp.sign(p - 0.5) * jnp.log1p(-2 * jnp.abs(p - 0.5)))
+
+    def kl_divergence(self, other):
+        from ..framework.core import Tensor
+
+        if isinstance(other, Laplace):
+            d = jnp.abs(self.loc - other.loc)
+            return Tensor(
+                jnp.log(other.scale / self.scale)
+                + (self.scale * jnp.exp(-d / self.scale) + d) / other.scale
+                - 1.0
+            )
+        return super().kl_divergence(other)

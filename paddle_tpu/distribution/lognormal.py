@@ -1,0 +1,48 @@
+"""LogNormal distribution (reference: python/paddle/distribution/lognormal.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+from .normal import Normal
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._base = Normal(loc, scale)
+        self.loc, self.scale = self._base.loc, self._base.scale
+        super().__init__(batch_shape=self._base._batch_shape)
+        self._track(loc=loc, scale=scale)
+
+    def _retrace(self):
+        self._base = Normal(self.loc, self.scale)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(jnp.exp(self.loc + self.scale**2 / 2))
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor(jnp.expm1(self.scale**2) * jnp.exp(2 * self.loc + self.scale**2))
+
+    def _sample(self, key, shape):
+        return jnp.exp(self._base._sample(key, shape))
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value)
+        return Tensor(self._base.log_prob(jnp.log(v))._data - jnp.log(v))
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self._base.entropy()._data + self.loc)
+
+    def kl_divergence(self, other):
+        if isinstance(other, LogNormal):
+            return self._base.kl_divergence(other._base)
+        return super().kl_divergence(other)

@@ -1,0 +1,61 @@
+"""Multinomial distribution (reference: python/paddle/distribution/multinomial.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = self._to_float(probs)
+        self._retrace()
+        super().__init__(
+            batch_shape=self.probs.shape[:-1], event_shape=self.probs.shape[-1:]
+        )
+        self._track(probs=probs)
+
+    def _retrace(self):
+        self.probs = self.probs / jnp.sum(self.probs, -1, keepdims=True)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        logits = jnp.log(self.probs)
+        draws = jax.random.categorical(
+            key, logits, axis=-1, shape=(self.total_count,) + full
+        )
+        k = self.probs.shape[-1]
+        counts = jax.nn.one_hot(draws, k, dtype=self.probs.dtype).sum(0)
+        return counts
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value).astype(self.probs.dtype)
+        gl = jax.scipy.special.gammaln
+        logfact = gl(jnp.asarray(self.total_count + 1.0)) - jnp.sum(gl(v + 1.0), -1)
+        return Tensor(logfact + jnp.sum(v * jnp.log(self.probs), -1))
+
+    def entropy(self):
+        # no closed form; Monte-Carlo-free bound used by paddle: compute via
+        # sum over categories of binomial entropies is an approximation —
+        # return the exact series truncated at total_count like torch does is
+        # heavy; use the normal approximation paddle documents.
+        from ..framework.core import Tensor
+
+        n, p = self.total_count, self.probs
+        return Tensor(
+            0.5 * jnp.sum(jnp.log(2 * jnp.pi * jnp.e * n * p * (1 - p) + 1e-8), -1)
+        )

@@ -1,0 +1,76 @@
+"""Normal distribution (reference: python/paddle/distribution/normal.py
+``class Normal(Distribution)``)."""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+# plain math, not jnp: module import must not initialize the jax backend
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        (self.loc, self.scale), shape = self._validate_args(
+            self._to_float(loc), self._to_float(scale)
+        )
+        super().__init__(batch_shape=shape)
+        self._track(loc=loc, scale=scale)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.scale**2)
+
+    @property
+    def stddev(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.scale)
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        eps = jax.random.normal(key, full, self.loc.dtype)
+        return self.loc + eps * self.scale
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value)
+        var = self.scale**2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - _HALF_LOG_2PI)
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        return Tensor(0.5 + _HALF_LOG_2PI + jnp.log(self.scale) * jnp.ones_like(self.loc))
+
+    def cdf(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value)
+        return Tensor(0.5 * (1 + jax.scipy.special.erf((v - self.loc) / (self.scale * jnp.sqrt(2.0)))))
+
+    def icdf(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value)
+        return Tensor(self.loc + self.scale * jnp.sqrt(2.0) * jax.scipy.special.erfinv(2 * v - 1))
+
+    def kl_divergence(self, other):
+        from ..framework.core import Tensor
+
+        if isinstance(other, Normal):
+            var_ratio = (self.scale / other.scale) ** 2
+            t1 = ((self.loc - other.loc) / other.scale) ** 2
+            return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+        return super().kl_divergence(other)

@@ -1,0 +1,61 @@
+"""Poisson distribution (reference: python/paddle/distribution/poisson.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = self._to_float(rate)
+        super().__init__(batch_shape=jnp.shape(self.rate))
+        self._track(rate=rate)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.rate)
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        return jax.random.poisson(key, self.rate, full).astype(self.rate.dtype)
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        k = _data(value).astype(self.rate.dtype)
+        return Tensor(k * jnp.log(self.rate) - self.rate - jax.scipy.special.gammaln(k + 1))
+
+    def entropy(self):
+        """Exact truncated-support sum when the rate is concrete; asymptotic
+        expansion H ≈ ½log(2πeλ) − 1/(12λ) − 1/(24λ²) under tracing."""
+        from ..framework.core import Tensor
+
+        r = self.rate
+        try:
+            rmax = float(jnp.max(r))
+        except (jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError):
+            rmax = None
+        if rmax is not None and rmax <= 256.0:
+            kmax = int(rmax + 10.0 * rmax**0.5 + 24.0)
+            ks = jnp.arange(kmax, dtype=r.dtype).reshape((kmax,) + (1,) * r.ndim)
+            lp = ks * jnp.log(r) - r - jax.scipy.special.gammaln(ks + 1)
+            return Tensor(-jnp.sum(jnp.exp(lp) * lp, axis=0))
+        return Tensor(
+            0.5 * jnp.log(2 * jnp.pi * jnp.e * r) - 1 / (12 * r) - 1 / (24 * r**2)
+        )
+
+    def kl_divergence(self, other):
+        from ..framework.core import Tensor
+
+        if isinstance(other, Poisson):
+            r1, r2 = self.rate, other.rate
+            return Tensor(r1 * jnp.log(r1 / r2) - r1 + r2)
+        return super().kl_divergence(other)

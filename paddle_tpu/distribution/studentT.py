@@ -1,0 +1,60 @@
+"""Student's t distribution (reference: python/paddle/distribution/studentT.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc, scale, name=None):
+        (self.df, self.loc, self.scale), shape = self._validate_args(
+            self._to_float(df), self._to_float(loc), self._to_float(scale)
+        )
+        super().__init__(batch_shape=shape)
+        self._track(df=df, loc=loc, scale=scale)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        v = jnp.where(
+            self.df > 2,
+            self.scale**2 * self.df / (self.df - 2),
+            jnp.where(self.df > 1, jnp.inf, jnp.nan),
+        )
+        return Tensor(v)
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        t = jax.random.t(key, self.df, full, self.loc.dtype)
+        return self.loc + self.scale * t
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        z = (_data(value) - self.loc) / self.scale
+        df = self.df
+        gl = jax.scipy.special.gammaln
+        return Tensor(
+            gl((df + 1) / 2) - gl(df / 2)
+            - 0.5 * jnp.log(df * jnp.pi) - jnp.log(self.scale)
+            - (df + 1) / 2 * jnp.log1p(z**2 / df)
+        )
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        df = self.df
+        dg = jax.scipy.special.digamma
+        gl = jax.scipy.special.gammaln
+        return Tensor(
+            (df + 1) / 2 * (dg((df + 1) / 2) - dg(df / 2))
+            + 0.5 * jnp.log(df) + gl(df / 2) - gl((df + 1) / 2)
+            + 0.5 * jnp.log(jnp.pi) + jnp.log(self.scale)
+        )

@@ -1,0 +1,284 @@
+"""Bijective transforms (reference: python/paddle/distribution/transform.py —
+``Transform`` zoo with forward/inverse/log_det_jacobian used by
+TransformedDistribution)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import _data
+
+
+def _box(x):
+    from ..framework.core import Tensor
+
+    return Tensor(x) if not isinstance(x, Tensor) else x
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+
+class Transform:
+    _type = Type.OTHER
+
+    def forward(self, x):
+        return _box(self._forward(_data(x)))
+
+    def inverse(self, y):
+        return _box(self._inverse(_data(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _box(self._forward_log_det_jacobian(_data(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        y = _data(y)
+        return _box(-self._forward_log_det_jacobian(self._inverse(y)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # subclass hooks on raw jnp arrays
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        # generic: d forward / dx via jax for elementwise transforms
+        g = jax.vmap(jax.grad(lambda t: self._forward(t).sum()))(x.reshape(-1))
+        return jnp.log(jnp.abs(g)).reshape(x.shape)
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _data(loc)
+        self.scale = _data(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _data(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        z = jax.nn.sigmoid(x - jnp.log(offset.astype(x.dtype)))
+        zc = jnp.cumprod(1 - z, axis=-1)
+        pad = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        return jnp.concatenate([z, pad], -1) * jnp.concatenate([pad, zc], -1)
+
+    def _inverse(self, y):
+        # logit of the per-step fraction: x_k = log(y_k / (1-Σ_{j≤k} y_j)) + log(offset)
+        y_crop = y[..., :-1]
+        offset = y_crop.shape[-1] + 1 - jnp.arange(1, y_crop.shape[-1] + 1)
+        sf_after = 1 - jnp.cumsum(y_crop, axis=-1)
+        x = jnp.log(y_crop / sf_after)
+        return x + jnp.log(offset.astype(y.dtype))
+
+    def _forward_log_det_jacobian(self, x):
+        offset = x.shape[-1] + 1 - jnp.arange(1, x.shape[-1] + 1)
+        z = jax.nn.sigmoid(x - jnp.log(offset.astype(x.dtype)))
+        # dy_k/dx_k = z_k(1-z_k)·prod_{j<k}(1-z_j); Jacobian lower-triangular
+        detail = jnp.log(z) + jnp.log1p(-z)
+        sf = jnp.cumsum(jnp.log1p(-z), axis=-1)
+        sf = jnp.concatenate([jnp.zeros(x.shape[:-1] + (1,), x.dtype), sf[..., :-1]], -1)
+        return jnp.sum(detail + sf, -1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[:-n] if n else shape) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[:-n] if n else shape) + self.in_event_shape
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base._forward_log_det_jacobian(x)
+        return jnp.sum(ld, axis=tuple(range(-self.rank, 0)))
+
+
+class StackTransform(Transform):
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _split(self, x):
+        return [jnp.squeeze(s, self.axis) for s in jnp.split(x, len(self.transforms), self.axis)]
+
+    def _forward(self, x):
+        return jnp.stack(
+            [t._forward(s) for t, s in zip(self.transforms, self._split(x))], self.axis
+        )
+
+    def _inverse(self, y):
+        return jnp.stack(
+            [t._inverse(s) for t, s in zip(self.transforms, self._split(y))], self.axis
+        )
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.stack(
+            [t._forward_log_det_jacobian(s) for t, s in zip(self.transforms, self._split(x))],
+            self.axis,
+        )

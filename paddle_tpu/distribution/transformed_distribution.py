@@ -1,0 +1,36 @@
+"""TransformedDistribution (reference:
+python/paddle/distribution/transformed_distribution.py)."""
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+from .transform import ChainTransform
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms)
+        shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        out_shape = self._chain.forward_shape(shape)
+        nb = len(base.batch_shape)
+        super().__init__(batch_shape=out_shape[:nb], event_shape=out_shape[nb:])
+
+    def _sample(self, key, shape):
+        x = self.base._sample(key, shape)
+        return self._chain._forward(x)
+
+    def sample(self, shape=()):
+        from ..framework.core import Tensor
+        from ..framework import random as prandom
+
+        return Tensor(self._sample(prandom.next_key(), tuple(shape)))
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        y = _data(value)
+        x = self._chain._inverse(y)
+        base_lp = _data(self.base.log_prob(x))
+        ld = self._chain._forward_log_det_jacobian(x)
+        return Tensor(base_lp - ld)

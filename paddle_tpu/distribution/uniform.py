@@ -1,0 +1,50 @@
+"""Uniform distribution (reference: python/paddle/distribution/uniform.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        (self.low, self.high), shape = self._validate_args(
+            self._to_float(low), self._to_float(high)
+        )
+        super().__init__(batch_shape=shape)
+        self._track(low=low, high=high)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(key, full, self.low.dtype)
+        return self.low + u * (self.high - self.low)
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        return Tensor(jnp.log(self.high - self.low))
+
+    def cdf(self, value):
+        from ..framework.core import Tensor
+
+        v = _data(value)
+        return Tensor(jnp.clip((v - self.low) / (self.high - self.low), 0.0, 1.0))
