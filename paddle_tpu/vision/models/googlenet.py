@@ -1,0 +1,109 @@
+"""GoogLeNet / Inception v1 (reference: python/paddle/vision/models/googlenet.py
+— `GoogLeNet`, `googlenet`; returns (main, aux1, aux2) logits in train mode)."""
+from ...nn import (
+    AdaptiveAvgPool2D,
+    AvgPool2D,
+    Conv2D,
+    Dropout,
+    Linear,
+    MaxPool2D,
+    ReLU,
+    Sequential,
+)
+from ...nn.layer.layers import Layer
+from ...tensor.manipulation import concat, flatten
+
+
+class ConvBlock(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(in_channels, out_channels, kernel_size, stride=stride, padding=padding)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.conv(x))
+
+
+class Inception(Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.branch1 = ConvBlock(in_c, c1, 1)
+        self.branch2 = Sequential(ConvBlock(in_c, c3r, 1), ConvBlock(c3r, c3, 3, padding=1))
+        self.branch3 = Sequential(ConvBlock(in_c, c5r, 1), ConvBlock(c5r, c5, 5, padding=2))
+        self.branch4 = Sequential(MaxPool2D(3, stride=1, padding=1), ConvBlock(in_c, proj, 1))
+
+    def forward(self, x):
+        return concat(
+            [self.branch1(x), self.branch2(x), self.branch3(x), self.branch4(x)], axis=1
+        )
+
+
+class _AuxHead(Layer):
+    def __init__(self, in_c, num_classes):
+        super().__init__()
+        # adaptive 4x4 (not AvgPool2D(5,3)) so aux heads work at any input size
+        self.pool = AdaptiveAvgPool2D(4)
+        self.conv = ConvBlock(in_c, 128, 1)
+        self.fc1 = Linear(2048, 1024)
+        self.relu = ReLU()
+        self.dropout = Dropout(0.7)
+        self.fc2 = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = flatten(x, 1)
+        x = self.dropout(self.relu(self.fc1(x)))
+        return self.fc2(x)
+
+
+class GoogLeNet(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            ConvBlock(3, 64, 7, stride=2, padding=3),
+            MaxPool2D(3, stride=2, padding=1),
+            ConvBlock(64, 64, 1),
+            ConvBlock(64, 192, 3, padding=1),
+            MaxPool2D(3, stride=2, padding=1),
+        )
+        self.inc3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, stride=2, padding=1)
+        self.inc4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, stride=2, padding=1)
+        self.inc5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.pool5 = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.4)
+            self.fc = Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.inc3b(self.inc3a(x)))
+        x = self.inc4a(x)
+        aux1 = self.aux1(x) if self.num_classes > 0 and self.training else None
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        aux2 = self.aux2(x) if self.num_classes > 0 and self.training else None
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        if self.training and self.num_classes > 0:
+            return x, aux1, aux2
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

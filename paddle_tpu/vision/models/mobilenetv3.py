@@ -1,0 +1,146 @@
+"""MobileNetV3 (reference: python/paddle/vision/models/mobilenetv3.py —
+`MobileNetV3Small`, `MobileNetV3Large`, `mobilenet_v3_small/large`)."""
+from ...nn import (
+    AdaptiveAvgPool2D,
+    BatchNorm2D,
+    Conv2D,
+    Dropout,
+    Hardsigmoid,
+    Hardswish,
+    Linear,
+    ReLU,
+    Sequential,
+)
+from ...nn.layer.layers import Layer
+from ...tensor.manipulation import flatten
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNActivation(Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, groups=1, act="hardswish"):
+        super().__init__()
+        padding = (kernel - 1) // 2
+        self.conv = Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                           groups=groups, bias_attr=False)
+        self.bn = BatchNorm2D(out_c)
+        self.act = {"relu": ReLU, "hardswish": Hardswish, None: None}.get(act)
+        self.act = self.act() if self.act else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class SqueezeExcitation(Layer):
+    def __init__(self, channels, squeeze_factor=4):
+        super().__init__()
+        squeeze_c = _make_divisible(channels // squeeze_factor)
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(channels, squeeze_c, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(squeeze_c, channels, 1)
+        self.hsig = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class InvertedResidual(Layer):
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp_c != in_c:
+            layers.append(ConvBNActivation(in_c, exp_c, 1, act=act))
+        layers.append(ConvBNActivation(exp_c, exp_c, kernel, stride=stride, groups=exp_c, act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(exp_c))
+        layers.append(ConvBNActivation(exp_c, out_c, 1, act=None))
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, exp, out, use_se, act, stride)
+_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(Layer):
+    def __init__(self, cfg, last_channel, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        first_c = _make_divisible(16 * scale)
+        self.conv = ConvBNActivation(3, first_c, 3, stride=2, act="hardswish")
+        blocks = []
+        in_c = first_c
+        for k, exp, out, se, act, s in cfg:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            blocks.append(InvertedResidual(in_c, exp_c, out_c, k, s, se, act))
+            in_c = out_c
+        self.blocks = Sequential(*blocks)
+        last_conv_c = _make_divisible(6 * in_c * scale)
+        self.lastconv = ConvBNActivation(in_c, last_conv_c, 1, act="hardswish")
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(last_conv_c, last_channel),
+                Hardswish(),
+                Dropout(0.2),
+                Linear(last_channel, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.lastconv(self.blocks(self.conv(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, _make_divisible(1280 * scale), scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, _make_divisible(1024 * scale), scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)

@@ -1,0 +1,138 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py —
+`ShuffleNetV2`, `shufflenet_v2_x0_25 … x2_0`, `shufflenet_v2_swish`)."""
+from ...nn import (
+    AdaptiveAvgPool2D,
+    BatchNorm2D,
+    Conv2D,
+    Linear,
+    MaxPool2D,
+    ReLU,
+    Sequential,
+    Swish,
+)
+from ...nn.layer.common import ChannelShuffle
+from ...nn.layer.layers import Layer
+from ...tensor.manipulation import concat, flatten, split
+
+_STAGE_REPEATS = [4, 8, 4]
+_CFG = {
+    "x0_25": [24, 24, 48, 96, 512],
+    "x0_33": [24, 32, 64, 128, 512],
+    "x0_5": [24, 48, 96, 192, 1024],
+    "x1_0": [24, 116, 232, 464, 1024],
+    "x1_5": [24, 176, 352, 704, 1024],
+    "x2_0": [24, 244, 488, 976, 2048],
+}
+
+
+def _act(name):
+    return Swish() if name == "swish" else ReLU()
+
+
+class InvertedResidual(Layer):
+    def __init__(self, in_channels, out_channels, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_features = out_channels // 2
+        if stride > 1:
+            self.branch1 = Sequential(
+                Conv2D(in_channels, in_channels, 3, stride=stride, padding=1,
+                       groups=in_channels, bias_attr=False),
+                BatchNorm2D(in_channels),
+                Conv2D(in_channels, branch_features, 1, bias_attr=False),
+                BatchNorm2D(branch_features),
+                _act(act),
+            )
+            branch2_in = in_channels
+        else:
+            self.branch1 = None
+            branch2_in = in_channels // 2
+        self.branch2 = Sequential(
+            Conv2D(branch2_in, branch_features, 1, bias_attr=False),
+            BatchNorm2D(branch_features),
+            _act(act),
+            Conv2D(branch_features, branch_features, 3, stride=stride, padding=1,
+                   groups=branch_features, bias_attr=False),
+            BatchNorm2D(branch_features),
+            Conv2D(branch_features, branch_features, 1, bias_attr=False),
+            BatchNorm2D(branch_features),
+            _act(act),
+        )
+        self.shuffle = ChannelShuffle(2)
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale="x1_0", act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        stage_out = _CFG[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = Sequential(
+            Conv2D(3, stage_out[0], 3, stride=2, padding=1, bias_attr=False),
+            BatchNorm2D(stage_out[0]),
+            _act(act),
+        )
+        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = stage_out[0]
+        for stage_i, repeats in enumerate(_STAGE_REPEATS):
+            out_c = stage_out[stage_i + 1]
+            blocks = [InvertedResidual(in_c, out_c, 2, act)]
+            for _ in range(repeats - 1):
+                blocks.append(InvertedResidual(out_c, out_c, 1, act))
+            stages.append(Sequential(*blocks))
+            in_c = out_c
+        self.stages = Sequential(*stages)
+        self.conv_last = Sequential(
+            Conv2D(in_c, stage_out[-1], 1, bias_attr=False),
+            BatchNorm2D(stage_out[-1]),
+            _act(act),
+        )
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(stage_out[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2("x0_25", **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2("x0_33", **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2("x0_5", **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2("x1_0", **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2("x1_5", **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2("x2_0", **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2("x1_0", act="swish", **kwargs)

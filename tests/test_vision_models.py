@@ -1,0 +1,87 @@
+"""Vision model zoo tests (reference test model: test/legacy_test/
+test_vision_models.py — forward-shape checks per architecture; here plus a
+grad step through each family to catch broken tapes)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.vision import models
+
+
+def _img(bs=2, hw=64):
+    return paddle.to_tensor(
+        np.random.RandomState(0).rand(bs, 3, hw, hw).astype(np.float32)
+    )
+
+
+def _check_forward(model, hw=64, num_classes=10):
+    model.eval()
+    out = model(_img(hw=hw))
+    assert out.shape == [2, num_classes]
+    return out
+
+
+# one representative per family at small width/classes; hw sized to each
+# architecture's minimum stem reduction
+FAMILIES = [
+    ("squeezenet1_1", lambda: models.squeezenet1_1(num_classes=10), 64),
+    ("shufflenet_v2_x0_25", lambda: models.shufflenet_v2_x0_25(num_classes=10), 64),
+    ("mobilenet_v1_x025", lambda: models.mobilenet_v1(scale=0.25, num_classes=10), 64),
+    ("mobilenet_v3_small", lambda: models.mobilenet_v3_small(scale=0.5, num_classes=10), 64),
+    ("densenet121", lambda: models.densenet121(num_classes=10), 64),
+    ("googlenet", lambda: models.googlenet(num_classes=10), 96),
+    ("inception_v3", lambda: models.inception_v3(num_classes=10), 128),
+]
+
+
+@pytest.mark.parametrize("name,build,hw", FAMILIES, ids=[f[0] for f in FAMILIES])
+def test_forward_shape(name, build, hw):
+    paddle.seed(0)
+    _check_forward(build(), hw=hw)
+
+
+def test_googlenet_aux_heads_in_train_mode():
+    paddle.seed(0)
+    m = models.googlenet(num_classes=10)
+    m.train()
+    out, aux1, aux2 = m(_img(hw=96))
+    assert out.shape == [2, 10] and aux1.shape == [2, 10] and aux2.shape == [2, 10]
+
+
+def test_grad_step_squeezenet():
+    """One optimizer step must reduce loss on a fixed batch (tape through
+    concat/fire blocks)."""
+    paddle.seed(1)
+    m = models.squeezenet1_1(num_classes=4)
+    m.train()
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+    x = _img(bs=4, hw=64)
+    y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+    losses = []
+    for _ in range(6):
+        loss = F.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_grad_step_shufflenet():
+    """Channel-shuffle + split path is differentiable."""
+    paddle.seed(1)
+    m = models.shufflenet_v2_x0_25(num_classes=4)
+    m.train()
+    opt = optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+    x = _img(bs=4, hw=64)
+    y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+    l0 = None
+    for i in range(6):
+        loss = F.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        l0 = l0 if l0 is not None else float(loss.numpy())
+    assert float(loss.numpy()) < l0
