@@ -58,6 +58,7 @@ from .hapi import Model
 from . import distributed
 from . import incubate
 from . import distribution
+from . import quantization
 from . import profiler
 from . import sparse
 from . import linalg as _linalg_ns

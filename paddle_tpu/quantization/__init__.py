@@ -1,0 +1,32 @@
+"""paddle.quantization parity (reference: python/paddle/quantization/ —
+QuantConfig in config.py, QAT in qat.py, PTQ in ptq.py, observers/ and
+quanters/ subpackages).
+
+TPU-native design: fake-quantization is expressed as traceable jnp ops with a
+straight-through estimator (x + stop_gradient(q(x) − x)), so QAT runs inside
+the same jit-compiled train step as everything else — no custom kernels, and
+XLA fuses the quant/dequant pair into neighbouring ops.
+"""
+from .config import QuantConfig
+from .observers import (
+    AbsmaxObserver,
+    AVGObserver,
+    EMAObserver,
+    HistObserver,
+    PercentObserver,
+)
+from .quanters import (
+    FakeQuanterChannelWiseAbsMaxObserver,
+    FakeQuanterWithAbsMaxObserver,
+    fake_quant,
+)
+from .qat import QAT
+from .ptq import PTQ
+from .quantize import quanted_layers
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ",
+    "AbsmaxObserver", "AVGObserver", "EMAObserver", "HistObserver", "PercentObserver",
+    "FakeQuanterWithAbsMaxObserver", "FakeQuanterChannelWiseAbsMaxObserver",
+    "fake_quant", "quanted_layers",
+]

@@ -1,0 +1,60 @@
+"""QuantConfig (reference: python/paddle/quantization/config.py — maps layers
+to (activation, weight) quanter/observer factories by type, name, or
+prefix)."""
+import copy
+
+from ..nn.layer.layers import Layer
+
+
+class SingleLayerConfig:
+    def __init__(self, activation, weight):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._global = SingleLayerConfig(activation, weight) if (activation or weight) else None
+        self._type_configs = {}
+        self._name_configs = {}
+        self._prefix_configs = {}
+        self._customized_leaves = []
+
+    # -- registration ------------------------------------------------------
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            # match specific instances by identity (reference uses full_name)
+            self._name_configs[id(l)] = SingleLayerConfig(activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) else [layer_name]
+        for n in names:
+            self._prefix_configs[n] = SingleLayerConfig(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._type_configs[t] = SingleLayerConfig(activation, weight)
+
+    def add_qat_layer_mapping(self, source, target):
+        from .quantize import QAT_LAYER_MAP
+
+        QAT_LAYER_MAP[source] = target
+
+    def add_customized_leaves(self, layer_type):
+        self._customized_leaves.append(layer_type)
+
+    # -- lookup ------------------------------------------------------------
+    def _get_config_for_layer(self, layer, name=""):
+        if id(layer) in self._name_configs:
+            return self._name_configs[id(layer)]
+        for prefix, cfg in self._prefix_configs.items():
+            if name.startswith(prefix):
+                return cfg
+        if type(layer) in self._type_configs:
+            return self._type_configs[type(layer)]
+        return self._global
+
+    def copy(self):
+        return copy.deepcopy(self)

@@ -1,0 +1,121 @@
+"""PTQ observers (reference: python/paddle/quantization/observers/abs_max.py
+and PaddleSlim's observer zoo — collect activation statistics in eval mode to
+derive quantization scales)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+
+
+class _BaseObserver(Layer):
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._scale = None
+
+    def forward(self, x):
+        self._observe(np.asarray(jax.device_get(x._data), np.float32))
+        return x
+
+    def _observe(self, arr):
+        raise NotImplementedError
+
+    def cal_thresholds(self):
+        pass
+
+    def scales(self):
+        self.cal_thresholds()
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+    def quant_axis(self):
+        return None
+
+    def bit_length(self):
+        return self._quant_bits
+
+
+class AbsmaxObserver(_BaseObserver):
+    """Running max of |x| (reference: observers/abs_max.py AbsmaxObserver)."""
+
+    def _observe(self, arr):
+        m = float(np.max(np.abs(arr))) if arr.size else 0.0
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class EMAObserver(_BaseObserver):
+    """Exponential moving average of per-batch abs-max."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self._moving_rate = moving_rate
+
+    def _observe(self, arr):
+        m = float(np.max(np.abs(arr))) if arr.size else 0.0
+        r = self._moving_rate
+        self._scale = m if self._scale is None else r * self._scale + (1 - r) * m
+
+
+class AVGObserver(_BaseObserver):
+    """Average of per-batch abs-max (reference: observers/avg.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._sum, self._n = 0.0, 0
+
+    def _observe(self, arr):
+        self._sum += float(np.max(np.abs(arr))) if arr.size else 0.0
+        self._n += 1
+        self._scale = self._sum / max(self._n, 1)
+
+
+class PercentObserver(_BaseObserver):
+    """Percentile of |x| (clips outliers; reference: PaddleSlim
+    PercentileObserver)."""
+
+    def __init__(self, quant_bits=8, percent=0.999, sample_limit=1 << 20):
+        super().__init__(quant_bits)
+        self._percent = percent
+        self._samples = []
+        self._limit = sample_limit
+
+    def _observe(self, arr):
+        flat = np.abs(arr).ravel()
+        if flat.size > self._limit:
+            flat = np.random.default_rng(0).choice(flat, self._limit, replace=False)
+        self._samples.append(flat)
+
+    def cal_thresholds(self):
+        if self._samples:
+            allv = np.concatenate(self._samples)
+            self._scale = float(np.quantile(allv, self._percent))
+
+
+class HistObserver(_BaseObserver):
+    """Histogram-based threshold (simplified KL-free variant: pick the bin
+    edge covering `coverage` of mass; reference: observers/hist.py)."""
+
+    def __init__(self, quant_bits=8, bins_count=2048, coverage=0.9999):
+        super().__init__(quant_bits)
+        self._bins = bins_count
+        self._coverage = coverage
+        self._hist = None
+        self._max = 0.0
+
+    def _observe(self, arr):
+        m = float(np.max(np.abs(arr))) if arr.size else 0.0
+        self._max = max(self._max, m)
+        hist, _ = np.histogram(np.abs(arr), bins=self._bins, range=(0, self._max or 1.0))
+        if self._hist is None or self._hist.shape != hist.shape:
+            self._hist = hist.astype(np.float64)
+        else:
+            self._hist += hist
+
+    def cal_thresholds(self):
+        if self._hist is None:
+            return
+        cum = np.cumsum(self._hist)
+        total = cum[-1] or 1.0
+        idx = int(np.searchsorted(cum / total, self._coverage))
+        self._scale = (idx + 1) / self._bins * (self._max or 1.0)

@@ -1,0 +1,47 @@
+"""Post-training quantization (reference: python/paddle/quantization/ptq.py —
+``PTQ(config).quantize(model)`` inserts observers; run calibration batches in
+eval mode; ``convert`` freezes observed scales into fake-quant layers)."""
+from .quanters import FakeQuanterWithAbsMaxObserver
+from .quantize import _convert_inplace
+from ..framework.core import Tensor
+
+
+class PTQ:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        n = _convert_inplace(model, self._config)
+        if n == 0:
+            raise ValueError("no quantizable layer matched the QuantConfig")
+        model.eval()
+        return model
+
+    def convert(self, model, inplace=False):
+        """Freeze observer statistics into static scales: every observer
+        becomes a fixed fake-quanter whose scale no longer updates."""
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        from .observers import _BaseObserver
+
+        def freeze(layer):
+            for name, child in list(layer._sub_layers.items()):
+                if isinstance(child, _BaseObserver):
+                    scale = child.scales()
+                    fq = FakeQuanterWithAbsMaxObserver(bit_length=child.bit_length())
+                    fq.scale._data = scale._data
+                    fq.eval()
+                    layer._sub_layers[name] = fq
+                    setattr(layer, name, fq)
+                else:
+                    freeze(child)
+
+        freeze(model)
+        model.eval()
+        return model

@@ -1,0 +1,26 @@
+"""Quantization-aware training entry (reference:
+python/paddle/quantization/qat.py — ``QAT(config).quantize(model)`` swaps
+quantizable layers for fake-quant wrappers; training then proceeds normally
+and the straight-through estimator carries gradients)."""
+from .quantize import _convert_inplace
+
+
+class QAT:
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        n = _convert_inplace(model, self._config)
+        if n == 0:
+            raise ValueError("no quantizable layer matched the QuantConfig")
+        return model
+
+    def convert(self, model, inplace=False):
+        """QAT model → inference form. Fake-quant layers already simulate
+        int8 numerics; conversion is the identity here (export handles real
+        int8 packing when targeted)."""
+        return model if inplace else __import__("copy").deepcopy(model)

@@ -1,0 +1,88 @@
+"""Fake quanters (reference: python/paddle/quantization/quanters/abs_max.py —
+FakeQuanterWithAbsMaxObserver; C++ kernels fake_quantize_abs_max etc. in
+paddle/fluid/operators/fake_quantize_op.*).
+
+The straight-through estimator is the whole trick: forward quantizes, backward
+is identity — `x + stop_gradient(quant(x) - x)` gives exactly that under
+jax.vjp, no custom gradient registration needed.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply
+from ..nn.layer.layers import Layer
+
+
+def _quant_dequant(x, scale, bit_length):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-9) / qmax
+    q = jnp.clip(jnp.round(x / s), -qmax, qmax) * s
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def fake_quant(x, scale, bit_length=8):
+    """Quantize-dequantize with straight-through gradient. `x` Tensor,
+    `scale` Tensor or float (per-tensor) / vector (per-channel, last axis)."""
+    scale_t = scale if isinstance(scale, Tensor) else Tensor(jnp.asarray(scale, jnp.float32))
+    return apply(
+        lambda xd, sd: _quant_dequant(xd, sd, bit_length), x, scale_t, name="fake_quant"
+    )
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """Per-tensor fake quant with moving-average abs-max scale (reference:
+    FakeQuanterWithAbsMaxObserver + moving_average_abs_max kernel)."""
+
+    def __init__(self, moving_rate=0.9, bit_length=8, dtype="float32", name=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._bit_length = bit_length
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+        self.register_buffer("accum", Tensor(jnp.ones((), jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.ones((), jnp.float32)))
+
+    def forward(self, x):
+        if self.training:
+            absmax = jnp.max(jnp.abs(jax.lax.stop_gradient(x._data))).astype(jnp.float32)
+            r = self._moving_rate
+            state = self.state._data * r + 1.0
+            accum = self.accum._data * r + absmax
+            self.state._data = state
+            self.accum._data = accum
+            self.scale._data = accum / state
+        return fake_quant(x, Tensor(self.scale._data), self._bit_length)
+
+    def quant_axis(self):
+        return None
+
+    def scales(self):
+        return self.scale
+
+
+class FakeQuanterChannelWiseAbsMaxObserver(Layer):
+    """Per-channel abs-max fake quant (reference:
+    quanters/channel_wise_abs_max.py; quant_axis=output-channel)."""
+
+    def __init__(self, quant_axis=-1, bit_length=8, dtype="float32", name=None):
+        super().__init__()
+        self._quant_axis = quant_axis
+        self._bit_length = bit_length
+        self.scale = None  # lazily sized on first call
+
+    def forward(self, x):
+        axis = self._quant_axis if self._quant_axis >= 0 else x.ndim + self._quant_axis
+        reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+        absmax = jnp.max(
+            jnp.abs(jax.lax.stop_gradient(x._data)), axis=reduce_axes, keepdims=True
+        ).astype(jnp.float32)
+        if self.scale is None:
+            self.register_buffer("scale", Tensor(absmax))
+        else:
+            self.scale._data = absmax
+        return fake_quant(x, Tensor(absmax), self._bit_length)
+
+    def quant_axis(self):
+        return self._quant_axis
+
+    def scales(self):
+        return self.scale

@@ -1,0 +1,74 @@
+"""Quanted layer wrappers (reference: paddle/nn/quant/qat/linear.py
+QuantedLinear, conv.py QuantedConv2D — forward = act_quanter(x) ·
+weight_quanter(W))."""
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from ..nn.layer.layers import Layer
+
+
+class QuantedLinear(Layer):
+    def __init__(self, layer: Linear, q_config):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self.activation_quanter = q_config.activation() if q_config.activation else None
+        self.weight_quanter = q_config.weight() if q_config.weight else None
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer: Conv2D, q_config):
+        super().__init__()
+        self._inner = layer
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self.activation_quanter = q_config.activation() if q_config.activation else None
+        self.weight_quanter = q_config.weight() if q_config.weight else None
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        saved = self._inner.weight
+        try:
+            self._inner.weight = w
+            return self._inner.forward(x)
+        finally:
+            self._inner.weight = saved
+
+
+QAT_LAYER_MAP = {
+    Linear: QuantedLinear,
+    Conv2D: QuantedConv2D,
+}
+
+
+def quanted_layers():
+    return dict(QAT_LAYER_MAP)
+
+
+def _convert_inplace(model, config):
+    """Replace quantizable sublayers per config; returns count converted."""
+    n = 0
+    for name, child in list(model._sub_layers.items()):
+        cfg = config._get_config_for_layer(child, name)
+        target = QAT_LAYER_MAP.get(type(child))
+        if cfg is not None and target is not None and (cfg.activation or cfg.weight):
+            model._sub_layers[name] = target(child, cfg)
+            setattr(model, name, model._sub_layers[name])
+            n += 1
+        else:
+            n += _convert_inplace(child, config)
+    return n

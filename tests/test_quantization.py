@@ -1,0 +1,130 @@
+"""Quantization tests (reference test model: test/quantization/test_quant.py
+— numeric tolerance vs fp32 baseline, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, quantization as Q
+from paddle_tpu.nn import functional as F
+
+
+def _mlp():
+    return nn.Sequential(
+        nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 32), nn.ReLU(), nn.Linear(32, 4)
+    )
+
+
+def _batch(bs=16):
+    rng = np.random.RandomState(0)
+    x = rng.rand(bs, 8).astype(np.float32)
+    y = (x.sum(-1) * 2).astype(np.int64) % 4
+    return paddle.to_tensor(x), paddle.to_tensor(y)
+
+
+class TestFakeQuant:
+    def test_quant_dequant_int8_grid(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 17).astype(np.float32))
+        out = Q.fake_quant(x, 1.0, bit_length=8).numpy()
+        # every output is k*(1/127) for integer k in [-127,127]
+        ks = np.asarray(out, np.float64) * 127.0
+        np.testing.assert_allclose(ks, np.round(ks), atol=1e-4)
+
+    def test_clipping_at_scale(self):
+        x = paddle.to_tensor(np.array([5.0, -5.0], np.float32))
+        out = Q.fake_quant(x, 1.0, bit_length=8).numpy()
+        np.testing.assert_allclose(out, [1.0, -1.0], rtol=1e-5)
+
+    def test_straight_through_gradient(self):
+        x = paddle.to_tensor(np.array([0.3, -0.7], np.float32), stop_gradient=False)
+        Q.fake_quant(x, 1.0).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), [1.0, 1.0], rtol=1e-6)
+
+
+class TestObservers:
+    def test_absmax(self):
+        ob = Q.AbsmaxObserver()
+        ob(paddle.to_tensor(np.array([1.0, -3.0], np.float32)))
+        ob(paddle.to_tensor(np.array([2.0], np.float32)))
+        assert abs(float(ob.scales().numpy()) - 3.0) < 1e-6
+
+    def test_avg(self):
+        ob = Q.AVGObserver()
+        ob(paddle.to_tensor(np.array([2.0], np.float32)))
+        ob(paddle.to_tensor(np.array([4.0], np.float32)))
+        assert abs(float(ob.scales().numpy()) - 3.0) < 1e-6
+
+    def test_percentile_clips_outliers(self):
+        ob = Q.PercentObserver(percent=0.99)
+        data = np.concatenate([np.ones(990), np.full(10, 100.0)]).astype(np.float32)
+        ob(paddle.to_tensor(data))
+        s = float(ob.scales().numpy())
+        assert s < 100.0  # the outlier mass beyond the 99th pct is clipped
+
+    def test_hist(self):
+        ob = Q.HistObserver(coverage=0.999)
+        ob(paddle.to_tensor(np.random.RandomState(0).randn(4096).astype(np.float32)))
+        s = float(ob.scales().numpy())
+        assert 1.0 < s < 6.0
+
+
+class TestQAT:
+    def test_quantize_swaps_linears(self):
+        cfg = Q.QuantConfig(
+            activation=Q.FakeQuanterWithAbsMaxObserver,
+            weight=Q.FakeQuanterWithAbsMaxObserver,
+        )
+        model = Q.QAT(cfg).quantize(_mlp())
+        from paddle_tpu.quantization.quantize import QuantedLinear
+
+        kinds = [type(l) for l in model.sublayers()]
+        assert QuantedLinear in kinds and nn.Linear not in kinds
+
+    def test_qat_trains_and_tracks_fp32(self):
+        paddle.seed(5)
+        fp32 = _mlp()
+        cfg = Q.QuantConfig(
+            activation=Q.FakeQuanterWithAbsMaxObserver,
+            weight=Q.FakeQuanterWithAbsMaxObserver,
+        )
+        model = Q.QAT(cfg).quantize(fp32)
+        model.train()
+        opt = optimizer.Adam(learning_rate=0.01, parameters=model.parameters())
+        x, y = _batch()
+        losses = []
+        for _ in range(30):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.7  # STE gradients actually train
+
+    def test_qat_inference_close_to_fp32(self):
+        paddle.seed(6)
+        fp32 = _mlp()
+        fp32.eval()
+        x, _ = _batch()
+        ref = fp32(x).numpy()
+        cfg = Q.QuantConfig(weight=Q.FakeQuanterWithAbsMaxObserver)
+        model = Q.QAT(cfg).quantize(fp32)  # deepcopy: fp32 untouched
+        model.eval()
+        got = model(x).numpy()
+        # int8 weight-only quantization of a small MLP: outputs close
+        assert np.max(np.abs(np.asarray(got) - np.asarray(ref))) < 0.05
+
+
+class TestPTQ:
+    def test_calibrate_and_convert(self):
+        paddle.seed(7)
+        fp32 = _mlp()
+        fp32.eval()
+        cfg = Q.QuantConfig(activation=Q.AbsmaxObserver, weight=None)
+        ptq = Q.PTQ(cfg)
+        model = ptq.quantize(fp32)
+        x, _ = _batch()
+        for _ in range(3):
+            model(x)  # calibration passes feed observers
+        frozen = ptq.convert(model)
+        out = frozen(x).numpy()
+        ref = fp32(x).numpy()
+        assert np.max(np.abs(np.asarray(out) - np.asarray(ref))) < 0.1
