@@ -59,6 +59,8 @@ from . import distributed
 from . import incubate
 from . import distribution
 from . import quantization
+from . import audio
+from . import text
 from . import profiler
 from . import sparse
 from . import linalg as _linalg_ns

@@ -1,0 +1,14 @@
+"""paddle.audio parity (reference: python/paddle/audio/ — features/ layers
+Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC, functional/ window +
+mel/dct helpers, backends/ soundfile io).
+
+TPU-native: all DSP is jnp (rfft rides XLA); file-backed io is gated on
+soundfile availability (no egress / optional dep environment).
+"""
+from . import functional
+from . import features
+from .features import LogMelSpectrogram, MelSpectrogram, MFCC, Spectrogram
+from . import backends
+
+__all__ = ["functional", "features", "backends",
+           "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

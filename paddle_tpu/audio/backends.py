@@ -1,0 +1,69 @@
+"""Audio io backends (reference: python/paddle/audio/backends/ — wave_backend
+with load/save/info; soundfile optional). Pure-stdlib WAV support so io works
+without optional deps."""
+import wave as _wave
+
+import numpy as np
+
+from ..framework.core import Tensor
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise ValueError("only wave_backend is available (no optional audio deps)")
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels, bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath):
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         8 * f.getsampwidth())
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True, channels_first=True):
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n = f.getnframes()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(count)
+    dt = {1: np.int8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dt).reshape(-1, ch)
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    out = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(out)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True, encoding="PCM_16", bits_per_sample=16):
+    arr = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T
+    width = bits_per_sample // 8
+    if arr.dtype.kind == "f":
+        arr = (np.clip(arr, -1, 1) * (2 ** (bits_per_sample - 1) - 1)).astype(
+            {1: np.int8, 2: np.int16, 4: np.int32}[width]
+        )
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
+        f.setsampwidth(width)
+        f.setframerate(sample_rate)
+        f.writeframes(arr.tobytes())

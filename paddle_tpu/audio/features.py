@@ -1,0 +1,92 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py —
+Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC as nn.Layers over a framed
+STFT)."""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+from . import functional as AF
+
+
+def _stft(x, n_fft, hop_length, win_length, window, center, pad_mode):
+    """x: [..., time] → complex [..., n_fft//2+1, frames]. Framed matmul-free
+    STFT via strided reshape + rfft (XLA-friendly, no conv)."""
+    win = AF.get_window(window, win_length)._data
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)], mode=pad_mode)
+    n_frames = 1 + (x.shape[-1] - n_fft) // hop_length
+    idx = jnp.arange(n_fft)[None, :] + hop_length * jnp.arange(n_frames)[:, None]
+    frames = x[..., idx]  # [..., frames, n_fft]
+    spec = jnp.fft.rfft(frames * win, n=n_fft, axis=-1)
+    return jnp.moveaxis(spec, -1, -2)  # [..., freq, frames]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None, window="hann",
+                 power=2.0, center=True, pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.window = window
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+
+    def forward(self, x):
+        spec = _stft(x._data if isinstance(x, Tensor) else jnp.asarray(x),
+                     self.n_fft, self.hop_length, self.win_length, self.window,
+                     self.center, self.pad_mode)
+        mag = jnp.abs(spec)
+        if self.power != 1.0:
+            mag = mag**self.power
+        return Tensor(mag)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode, dtype)
+        self.fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)._data
+        return Tensor(jnp.einsum("mf,...ft->...mt", self.fbank._data, spec))
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window, power,
+                                  center, pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect", n_mels=64,
+                 f_min=50.0, f_max=None, htk=False, norm="slaney", ref_value=1.0,
+                 amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                        power, center, pad_mode, n_mels, f_min, f_max,
+                                        htk, norm, ref_value, amin, top_db, dtype)
+        self.dct = AF.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        lm = self.logmel(x)._data
+        return Tensor(jnp.einsum("mk,...mt->...kt", self.dct._data, lm))

@@ -1,0 +1,129 @@
+"""Audio functional ops (reference: python/paddle/audio/functional/functional.py
+— hz_to_mel/mel_to_hz/mel_frequencies/fft_frequencies/compute_fbank_matrix/
+power_to_db/create_dct; window.py get_window)."""
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_tensor
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not hasattr(freq, "__len__") and not isinstance(freq, Tensor)
+    f = _data(freq).astype(jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        # Slaney formula: linear below 1 kHz, log above
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mels = jnp.where(f >= min_log_hz, min_log_mel + jnp.log(f / min_log_hz) / logstep, mels)
+        out = mels
+    return float(out) if scalar else Tensor(out)
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not hasattr(mel, "__len__") and not isinstance(mel, Tensor)
+    m = _data(mel).astype(jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel, min_log_hz * jnp.exp(logstep * (m - min_log_mel)), freqs)
+    return float(out) if scalar else Tensor(out)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False, dtype="float32"):
+    low = hz_to_mel(float(f_min), htk)
+    high = hz_to_mel(float(f_max), htk)
+    mels = jnp.linspace(low, high, n_mels)
+    return Tensor(_data(mel_to_hz(Tensor(mels), htk)).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.linspace(0, sr / 2, n_fft // 2 + 1).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None, htk=False,
+                         norm="slaney", dtype="float32"):
+    """[n_mels, n_fft//2+1] triangular mel filterbank."""
+    f_max = f_max or sr / 2.0
+    fftfreqs = _data(fft_frequencies(sr, n_fft))
+    melfreqs = _data(mel_frequencies(n_mels + 2, f_min, f_max, htk))
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2 : n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    s = _data(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II basis (reference: functional.create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    basis = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        basis = basis * jnp.where(k == 0, 1.0 / math.sqrt(n_mels), math.sqrt(2.0 / n_mels))
+    else:
+        basis = basis * 2.0
+    return Tensor(basis.astype(dtype))
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """hann/hamming/blackman/bartlett/kaiser/gaussian/exponential/taylor
+    subset that covers the reference's get_window zoo."""
+    if isinstance(window, tuple):
+        name, *params = window
+    else:
+        name, params = window, []
+    M = win_length + 1 if fftbins else win_length
+    n = jnp.arange(M, dtype=jnp.float32)
+    if name == "hann":
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * n / (M - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * n / (M - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * n / (M - 1))
+             + 0.08 * jnp.cos(4 * math.pi * n / (M - 1)))
+    elif name == "bartlett":
+        w = 1.0 - jnp.abs(2 * n / (M - 1) - 1.0)
+    elif name == "kaiser":
+        beta = params[0] if params else 12.0
+        arg = beta * jnp.sqrt(jnp.maximum(0.0, 1 - (2 * n / (M - 1) - 1) ** 2))
+        w = jnp.i0(arg) / jnp.i0(jnp.asarray(beta, jnp.float32))
+    elif name == "gaussian":
+        std = params[0] if params else 7.0
+        w = jnp.exp(-0.5 * ((n - (M - 1) / 2) / std) ** 2)
+    elif name == "exponential":
+        tau = params[0] if params and params[0] is not None else 1.0
+        w = jnp.exp(-jnp.abs(n - (M - 1) / 2) / tau)
+    else:
+        raise ValueError(f"unsupported window: {window}")
+    if fftbins:
+        w = w[:-1]
+    return Tensor(w.astype(dtype))

@@ -1,0 +1,14 @@
+"""paddle.text parity (reference: python/paddle/text/ — datasets Imdb/
+Conll05st/Movielens/UCIHousing/WMT14/WMT16 + ViterbiDecoder in paddle.text.
+viterbi_decode lives in python/paddle/text/viterbi_decode.py).
+
+No-egress environment: datasets read local files when paths are given and
+fall back to deterministic synthetic corpora (same pattern as vision
+datasets)."""
+from .datasets import Conll05st, Imdb, Movielens, UCIHousing, WMT14, WMT16
+from .viterbi_decode import ViterbiDecoder, viterbi_decode
+
+__all__ = [
+    "Imdb", "Conll05st", "Movielens", "UCIHousing", "WMT14", "WMT16",
+    "ViterbiDecoder", "viterbi_decode",
+]

