@@ -1,0 +1,23 @@
+"""paddle.distributed.rpc parity (reference: python/paddle/distributed/rpc/
+rpc.py — init_rpc/rpc_sync/rpc_async/shutdown over brpc).
+
+TPU-native: host-side RPC only (device communication is XLA collectives).
+Implemented over the stdlib multiprocessing connection listener — no brpc.
+Single-process mode (the common test/CI case) short-circuits locally.
+"""
+from .rpc import (
+    WorkerInfo,
+    get_all_worker_infos,
+    get_current_worker_info,
+    get_worker_info,
+    init_rpc,
+    rpc_async,
+    rpc_sync,
+    shutdown,
+)
+
+__all__ = [
+    "init_rpc", "rpc_sync", "rpc_async", "shutdown",
+    "get_worker_info", "get_all_worker_infos", "get_current_worker_info",
+    "WorkerInfo",
+]

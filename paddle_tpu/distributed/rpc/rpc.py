@@ -1,0 +1,137 @@
+"""Minimal host-side RPC (reference: python/paddle/distributed/rpc/rpc.py —
+init_rpc spawns a service per worker, rpc_sync/rpc_async invoke a picklable
+python callable on a peer and return (a future for) its result).
+
+Transport: multiprocessing.connection (authenticated pickle over TCP). Each
+worker runs one daemon serving thread; worker discovery through the same
+PADDLE_MASTER-style env contract the launcher provides, or an explicit
+endpoint list.
+"""
+import concurrent.futures as _fut
+import os
+import pickle
+import threading
+import time
+from multiprocessing.connection import Client, Listener
+
+_AUTH = b"paddle-tpu-rpc"
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip, port):
+        self.name, self.rank, self.ip, self.port = name, rank, ip, port
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name}, rank={self.rank}, ip={self.ip}, port={self.port})"
+
+
+_state = threading.local()
+_workers = {}
+_current = None
+_listener = None
+_serving = None
+_pool = None
+
+
+def _serve(listener):
+    while True:
+        try:
+            conn = listener.accept()
+        except OSError:
+            return
+        def handle(c):
+            try:
+                fn, args, kwargs = pickle.loads(c.recv_bytes())
+                if fn == "__shutdown__":
+                    c.send_bytes(pickle.dumps((True, None)))
+                    return
+                try:
+                    out = fn(*args, **kwargs)
+                    c.send_bytes(pickle.dumps((True, out)))
+                except Exception as e:  # deliver remote exceptions
+                    c.send_bytes(pickle.dumps((False, e)))
+            finally:
+                c.close()
+        threading.Thread(target=handle, args=(conn,), daemon=True).start()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC service and register the worker map.
+
+    Single-process usage (world_size in (None, 1)) needs no master: calls to
+    own name run locally; a Listener is still started so rpc to self via TCP
+    also works.
+    """
+    global _current, _listener, _serving, _pool
+    rank = int(rank if rank is not None else os.environ.get("PADDLE_TRAINER_ID", 0))
+    world_size = int(world_size if world_size is not None else os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    _listener = Listener(("127.0.0.1", 0), authkey=_AUTH)
+    port = _listener.address[1]
+    _serving = threading.Thread(target=_serve, args=(_listener,), daemon=True)
+    _serving.start()
+    _pool = _fut.ThreadPoolExecutor(max_workers=8)
+    _current = WorkerInfo(name, rank, "127.0.0.1", port)
+    _workers.clear()
+    _workers[name] = _current
+    if world_size > 1:
+        # exchange (name, rank, port) through the TCPStore kv master (same
+        # rendezvous the launcher/init_parallel_env use)
+        from ...framework.native import TCPStore
+
+        ep = master_endpoint or os.environ.get("PADDLE_MASTER") or os.environ.get(
+            "MASTER_ENDPOINT", "127.0.0.1:49175"
+        )
+        host, p = ep.rsplit(":", 1)
+        store = TCPStore(host, int(p), is_master=(rank == 0), world_size=world_size)
+        _state.store = store
+        store.set(f"rpc/{rank}", pickle.dumps((name, rank, "127.0.0.1", port)))
+        for r in range(world_size):
+            raw = store.get(f"rpc/{r}")  # blocking
+            n, rr, ip, pp = pickle.loads(raw)
+            _workers[n] = WorkerInfo(n, rr, ip, pp)
+    return _current
+
+
+def get_current_worker_info():
+    return _current
+
+
+def get_worker_info(name):
+    return _workers[name]
+
+
+def get_all_worker_infos():
+    return sorted(_workers.values(), key=lambda w: w.rank)
+
+
+def _invoke(to, fn, args, kwargs, timeout):
+    info = _workers[to]
+    with Client((info.ip, info.port), authkey=_AUTH) as conn:
+        conn.send_bytes(pickle.dumps((fn, args, kwargs)))
+        if timeout and timeout > 0:
+            if not conn.poll(timeout):
+                raise TimeoutError(f"rpc to {to} timed out after {timeout}s")
+        ok, payload = pickle.loads(conn.recv_bytes())
+    if not ok:
+        raise payload
+    return payload
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
+    return _invoke(to, fn, args or (), kwargs or {}, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
+    return _pool.submit(_invoke, to, fn, args or (), kwargs or {}, timeout)
+
+
+def shutdown():
+    global _listener, _pool, _current
+    if _pool is not None:
+        _pool.shutdown(wait=True)
+        _pool = None
+    if _listener is not None:
+        _listener.close()
+        _listener = None
+    _workers.clear()
+    _current = None

@@ -1,0 +1,7 @@
+"""paddle.distributed.sharding parity (reference:
+python/paddle/distributed/sharding/group_sharded.py —
+``group_sharded_parallel(model, optimizer, level)`` and
+``save_group_sharded_model``)."""
+from .group_sharded import group_sharded_parallel, save_group_sharded_model
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
