@@ -50,12 +50,13 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
         vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
         num_hidden_layers=layers, num_attention_heads=heads,
         max_position_embeddings=seq, use_recompute=True, dtype="bfloat16",
+        fuse_linear_cross_entropy=True,
     )
     model = LlamaForCausalLM(cfg)
     model.bfloat16()
     n_params = model.num_parameters()
     opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01)
-    step = TrainStep(model, lambda out, labels: LlamaPretrainingCriterion()(out, labels), opt)
+    step = TrainStep(model, lambda *a: LlamaPretrainingCriterion()(*a), opt)
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, vocab, (batch, seq + 1)).astype(np.int32)

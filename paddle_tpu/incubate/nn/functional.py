@@ -105,3 +105,53 @@ def swiglu(x, y=None, name=None):
 
 def fused_multi_head_attention(*args, **kwargs):
     raise NotImplementedError("use nn.functional.scaled_dot_product_attention (Pallas flash path)")
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
+                               chunk_size=1024, reduction="mean", name=None):
+    """Cross-entropy straight from hidden states — the [N, vocab] logits
+    tensor is never materialized (reference analogue: fused softmax-CE
+    kernels in paddle/phi/kernels/fusion/ + PaddleNLP's parallel CE; here the
+    memory win matters most: O(chunk·vocab) live instead of O(N·vocab)).
+
+    hidden [..., H] (any leading dims), weight [H, V], labels [...] int.
+    The chunk loop is a lax.map over N/chunk_size slices; each chunk's logits
+    are recomputed in the backward pass (jax.checkpoint), so peak memory is
+    one chunk of logits fwd + one bwd. Chunked matmuls stay MXU-sized for
+    chunk_size ≥ 512.
+    """
+    hidden = _t(hidden)
+    weight = _t(weight)
+    labels = _t(labels)
+
+    def fn(h, w, lab):
+        hs = h.reshape(-1, h.shape[-1])
+        ls = lab.reshape(-1).astype(jnp.int32)
+        n, hd = hs.shape
+        c = min(chunk_size, n)
+        pad = (-n) % c
+        if pad:
+            hs = jnp.concatenate([hs, jnp.zeros((pad, hd), hs.dtype)], 0)
+            ls = jnp.concatenate([ls, jnp.full((pad,), ignore_index, ls.dtype)], 0)
+        hs = hs.reshape(-1, c, hd)
+        ls = ls.reshape(-1, c)
+
+        def chunk_fn(args):
+            hc, lc = args
+            logits = jnp.matmul(hc, w, preferred_element_type=jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            safe = jnp.clip(lc, 0, logits.shape[-1] - 1)
+            ll = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+            valid = lc != ignore_index
+            return jnp.where(valid, lse - ll, 0.0), valid
+
+        losses, valids = jax.lax.map(jax.checkpoint(chunk_fn), (hs, ls))
+        total = jnp.sum(losses)
+        count = jnp.sum(valids)
+        if reduction == "mean":
+            return total / jnp.maximum(count, 1)
+        if reduction == "sum":
+            return total
+        return losses.reshape(-1)[: lab.size].reshape(lab.shape)
+
+    return apply(fn, hidden, weight, labels, name="fused_linear_cross_entropy")

@@ -41,6 +41,7 @@ class LlamaConfig:
         tie_word_embeddings=False,
         use_recompute=False,
         sequence_parallel=False,
+        fuse_linear_cross_entropy=False,
         dtype="float32",
         seq_length=2048,
     ):
@@ -56,6 +57,7 @@ class LlamaConfig:
         self.tie_word_embeddings = tie_word_embeddings
         self.use_recompute = use_recompute
         self.sequence_parallel = sequence_parallel
+        self.fuse_linear_cross_entropy = fuse_linear_cross_entropy
         self.dtype = dtype
         self.seq_length = seq_length
 
@@ -217,7 +219,17 @@ class LlamaPretrainingCriterion(Layer):
         super().__init__()
         self.ignore_index = ignore_index
 
-    def forward(self, logits, labels):
+    def forward(self, logits, *rest):
+        if len(rest) == 2:
+            # fused form: (hidden, lm_weight, labels) — chunked CE, no full
+            # logits tensor (incubate.nn.functional.fused_linear_cross_entropy)
+            from ..incubate.nn.functional import fused_linear_cross_entropy
+
+            weight, labels = rest
+            return fused_linear_cross_entropy(
+                logits, weight, labels, ignore_index=self.ignore_index
+            )
+        (labels,) = rest
         return F.cross_entropy(
             logits.astype("float32"), labels, ignore_index=self.ignore_index, reduction="mean"
         )
@@ -277,6 +289,14 @@ class LlamaForCausalLM(Layer):
 
     def forward(self, input_ids, attention_mask=None, position_ids=None, labels=None):
         h = self.llama(input_ids, attention_mask, position_ids)
+        if self.config.fuse_linear_cross_entropy and labels is None:
+            # hand (hidden, lm weight) to the fused CE so [B,S,vocab] logits
+            # are never materialized (incubate fused_linear_cross_entropy)
+            if self.lm_head is not None:
+                return h, self.lm_head.weight
+            from ..tensor import linalg
+
+            return h, linalg.t(self.llama.embed_tokens.weight)
         if self.lm_head is not None:
             logits = self.lm_head(h)
         else:

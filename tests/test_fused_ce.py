@@ -1,0 +1,64 @@
+"""fused_linear_cross_entropy tests — value/grad parity with full-logits CE
+(oracle pattern per SURVEY.md §4: kernel vs reference impl + grad check)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn.functional import fused_linear_cross_entropy
+from paddle_tpu.models.llama import LlamaForCausalLM, LlamaPretrainingCriterion, llama_tiny
+from paddle_tpu.nn import functional as F
+from paddle_tpu.tensor import linalg
+
+
+def _setup(n=37, h=16, v=50, seed=0, ignore_head=5):
+    rng = np.random.RandomState(seed)
+    hid = paddle.to_tensor(rng.randn(2, n, h).astype(np.float32), stop_gradient=False)
+    w = paddle.to_tensor(rng.randn(h, v).astype(np.float32), stop_gradient=False)
+    labels = rng.randint(0, v, (2, n))
+    labels[0, :ignore_head] = -100
+    y = paddle.to_tensor(labels.astype(np.int64))
+    return hid, w, y
+
+
+class TestFusedLinearCE:
+    def test_matches_full_logits_value_and_grads(self):
+        hid, w, y = _setup()
+        loss = fused_linear_cross_entropy(hid, w, y, chunk_size=8)
+        loss.backward()
+        gh, gw = np.asarray(hid.grad.numpy()), np.asarray(w.grad.numpy())
+
+        h2 = paddle.to_tensor(np.asarray(hid.numpy()), stop_gradient=False)
+        w2 = paddle.to_tensor(np.asarray(w.numpy()), stop_gradient=False)
+        ref = F.cross_entropy(linalg.matmul(h2, w2), y, ignore_index=-100)
+        ref.backward()
+        np.testing.assert_allclose(float(loss.numpy()), float(ref.numpy()), rtol=1e-5)
+        np.testing.assert_allclose(gh, np.asarray(h2.grad.numpy()), rtol=2e-4, atol=1e-6)
+        np.testing.assert_allclose(gw, np.asarray(w2.grad.numpy()), rtol=2e-4, atol=1e-6)
+
+    def test_chunk_size_invariance(self):
+        hid, w, y = _setup(n=24)
+        vals = [
+            float(fused_linear_cross_entropy(hid, w, y, chunk_size=c).numpy())
+            for c in (4, 16, 48, 1024)
+        ]
+        np.testing.assert_allclose(vals, vals[0], rtol=1e-6)
+
+    def test_all_ignored_is_finite(self):
+        hid, w, _ = _setup()
+        y = paddle.to_tensor(np.full((2, 37), -100, np.int64))
+        loss = float(fused_linear_cross_entropy(hid, w, y).numpy())
+        assert np.isfinite(loss) and loss == 0.0
+
+    def test_llama_fused_flag_matches_unfused(self):
+        paddle.seed(11)
+        cfg = llama_tiny(fuse_linear_cross_entropy=True)
+        model = LlamaForCausalLM(cfg)
+        crit = LlamaPretrainingCriterion()
+        ids = np.random.RandomState(1).randint(0, cfg.vocab_size, (2, 17)).astype(np.int32)
+        x, y = paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:].astype(np.int64))
+        out = model(x)
+        assert isinstance(out, tuple) and len(out) == 2
+        fused = float(crit(*out, y).numpy())
+        model.config.fuse_linear_cross_entropy = False
+        logits = model(x)
+        unfused = float(crit(logits.astype("float32"), y).numpy())
+        np.testing.assert_allclose(fused, unfused, rtol=1e-4)
