@@ -65,6 +65,7 @@ from . import profiler
 from . import sparse
 from . import linalg as _linalg_ns
 from . import fft
+from . import signal
 from . import static
 from .serialization import load, save
 

@@ -1,0 +1,222 @@
+"""paddle.vision.ops parity (reference: python/paddle/vision/ops.py — nms,
+roi_align, roi_pool, box_coder, distribute_fpn_proposals, PSRoIPool,
+deform_conv2d; kernels in phi/kernels/*roi*, *nms*).
+
+TPU-native notes: NMS's data-dependent loop runs as a lax.while-free masked
+O(N²) suppression (static shapes, MXU-friendly IoU matrix); roi_align is a
+gather + bilinear interpolation, fully vectorized.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+
+def _d(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU. boxes [N,4] xyxy."""
+    b1, b2 = _d(boxes1), _d(boxes2)
+    area1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+    area2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+    lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+    rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return Tensor(inter / jnp.maximum(area1[:, None] + area2[None, :] - inter, 1e-10))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None, name=None):
+    """reference: vision/ops.py nms. Returns kept indices sorted by score.
+
+    Greedy NMS as a sequential scan over score-sorted boxes with a running
+    suppression mask — O(N²) IoU matrix once, then a lax.scan (static shape,
+    jit-safe) instead of the reference's dynamic CUDA loop."""
+    b = _d(boxes)
+    n = b.shape[0]
+    s = jnp.arange(n, 0, -1).astype(jnp.float32) if scores is None else _d(scores)
+    if category_idxs is not None:
+        # multiclass: offset boxes per category so cross-class pairs never overlap
+        cidx = _d(category_idxs).astype(jnp.float32)
+        offset = (jnp.max(b[:, 2:]) + 1.0) * cidx
+        b = b + offset[:, None]
+    order = jnp.argsort(-s)
+    b_sorted = b[order]
+    iou = _d(box_iou(Tensor(b_sorted), Tensor(b_sorted)))
+
+    def step(keep_mask, i):
+        # suppressed if any higher-scoring KEPT box overlaps > threshold
+        overlap = (iou[i] > iou_threshold) & keep_mask & (jnp.arange(n) < i)
+        keep_i = ~jnp.any(overlap)
+        return keep_mask.at[i].set(keep_i), keep_i
+
+    init = jnp.zeros(n, bool)
+    _, kept = jax.lax.scan(step, init, jnp.arange(n))
+    kept_sorted_idx = order[jnp.nonzero(kept, size=n, fill_value=-1)[0]]
+    valid = jnp.sum(kept)
+    # host-side trim (eager API, like the reference's variable-size output)
+    import numpy as np
+
+    out = np.asarray(jax.device_get(kept_sorted_idx))[: int(jax.device_get(valid))]
+    if top_k is not None:
+        out = out[:top_k]
+    return Tensor(jnp.asarray(out, jnp.int64))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0, sampling_ratio=-1,
+              aligned=True, name=None):
+    """reference: vision/ops.py roi_align (phi roi_align_kernel). x: [N,C,H,W],
+    boxes: [R,4] xyxy in input-image coords, boxes_num: [N] rois per image."""
+    xd, bd = _d(x), _d(boxes)
+    nums = _d(boxes_num).astype(jnp.int32)
+    if isinstance(output_size, int):
+        out_h = out_w = output_size
+    else:
+        out_h, out_w = output_size
+    N, C, H, W = xd.shape
+    R = bd.shape[0]
+    # map each roi to its batch image
+    img_idx = jnp.repeat(jnp.arange(N), nums, total_repeat_length=R)
+
+    offset = 0.5 if aligned else 0.0
+    x1 = bd[:, 0] * spatial_scale - offset
+    y1 = bd[:, 1] * spatial_scale - offset
+    x2 = bd[:, 2] * spatial_scale - offset
+    y2 = bd[:, 3] * spatial_scale - offset
+    roi_w = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+    roi_h = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+    bin_w = roi_w / out_w
+    bin_h = roi_h / out_h
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+    # sample grid: [R, out_h, ratio] y's and [R, out_w, ratio] x's
+    sy = (jnp.arange(ratio) + 0.5) / ratio
+    ys = y1[:, None, None] + (jnp.arange(out_h)[None, :, None] + sy[None, None, :]) * bin_h[:, None, None]
+    xs = x1[:, None, None] + (jnp.arange(out_w)[None, :, None] + sy[None, None, :]) * bin_w[:, None, None]
+
+    def bilinear(img, yy, xx):
+        # img [C,H,W]; yy/xx broadcastable grids
+        y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+        y1_ = jnp.clip(y0 + 1, 0, H - 1)
+        x1_ = jnp.clip(x0 + 1, 0, W - 1)
+        wy1 = jnp.clip(yy - y0, 0.0, 1.0)
+        wx1 = jnp.clip(xx - x0, 0.0, 1.0)
+        y0i, x0i, y1i, x1i = y0.astype(int), x0.astype(int), y1_.astype(int), x1_.astype(int)
+        v00 = img[:, y0i, x0i]
+        v01 = img[:, y0i, x1i]
+        v10 = img[:, y1i, x0i]
+        v11 = img[:, y1i, x1i]
+        return (v00 * (1 - wy1) * (1 - wx1) + v01 * (1 - wy1) * wx1
+                + v10 * wy1 * (1 - wx1) + v11 * wy1 * wx1)
+
+    def one_roi(r):
+        img = xd[img_idx[r]]  # [C,H,W]
+        yy = ys[r]  # [out_h, ratio]
+        xx = xs[r]  # [out_w, ratio]
+        # full sample grid [out_h, ratio, out_w, ratio]
+        Y = yy[:, :, None, None]
+        X = xx[None, None, :, :]
+        vals = bilinear(img, jnp.broadcast_to(Y, (out_h, ratio, out_w, ratio)),
+                        jnp.broadcast_to(X, (out_h, ratio, out_w, ratio)))
+        return vals.reshape(C, out_h, ratio, out_w, ratio).mean(axis=(2, 4))
+
+    out = jax.vmap(one_roi)(jnp.arange(R))
+    return Tensor(out)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Max-pool variant (reference: roi_pool). Implemented via dense sampling
+    + max over each bin."""
+    xd, bd = _d(x), _d(boxes)
+    nums = _d(boxes_num).astype(jnp.int32)
+    if isinstance(output_size, int):
+        out_h = out_w = output_size
+    else:
+        out_h, out_w = output_size
+    N, C, H, W = xd.shape
+    R = bd.shape[0]
+    img_idx = jnp.repeat(jnp.arange(N), nums, total_repeat_length=R)
+    x1 = jnp.round(bd[:, 0] * spatial_scale).astype(int)
+    y1 = jnp.round(bd[:, 1] * spatial_scale).astype(int)
+    x2 = jnp.round(bd[:, 2] * spatial_scale).astype(int)
+    y2 = jnp.round(bd[:, 3] * spatial_scale).astype(int)
+
+    ratio = 4  # dense samples per bin edge
+
+    def one_roi(r):
+        img = xd[img_idx[r]]
+        w = jnp.maximum(x2[r] - x1[r] + 1, 1)
+        h = jnp.maximum(y2[r] - y1[r] + 1, 1)
+        ys = y1[r] + (jnp.arange(out_h * ratio) + 0.0) * h / (out_h * ratio)
+        xs = x1[r] + (jnp.arange(out_w * ratio) + 0.0) * w / (out_w * ratio)
+        yi = jnp.clip(ys.astype(int), 0, H - 1)
+        xi = jnp.clip(xs.astype(int), 0, W - 1)
+        patch = img[:, yi[:, None], xi[None, :]]  # [C, oh*ratio, ow*ratio]
+        return patch.reshape(C, out_h, ratio, out_w, ratio).max(axis=(2, 4))
+
+    out = jax.vmap(one_roi)(jnp.arange(R))
+    return Tensor(out)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """reference: vision/ops.py box_coder (phi box_coder_kernel)."""
+    pb, tb = _d(prior_box), _d(target_box)
+    pbv = _d(prior_box_var) if prior_box_var is not None else None
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        dx = (tcx - pcx) / pw
+        dy = (tcy - pcy) / ph
+        dw = jnp.log(jnp.abs(tw / pw))
+        dh = jnp.log(jnp.abs(th / ph))
+        out = jnp.stack([dx, dy, dw, dh], -1)
+        if pbv is not None:
+            out = out / pbv
+        return Tensor(out)
+    elif code_type == "decode_center_size":
+        # target_box: [N, M, 4] deltas per prior along `axis`
+        d = tb
+        if pbv is not None:
+            d = d * pbv
+        if axis == 0:
+            pcx, pcy, pw, ph = pcx[:, None], pcy[:, None], pw[:, None], ph[:, None]
+        ocx = d[..., 0] * pw + pcx
+        ocy = d[..., 1] * ph + pcy
+        ow = jnp.exp(d[..., 2]) * pw
+        oh = jnp.exp(d[..., 3]) * ph
+        return Tensor(jnp.stack(
+            [ocx - ow * 0.5, ocy - oh * 0.5, ocx + ow * 0.5 - norm, ocy + oh * 0.5 - norm],
+            -1,
+        ))
+    raise ValueError(f"unknown code_type {code_type}")
+
+
+def generate_anchors(feature_h, feature_w, stride=16, sizes=(32, 64, 128),
+                     aspect_ratios=(0.5, 1.0, 2.0)):
+    """Dense anchor grid helper (ecosystem utility used with box_coder)."""
+    import itertools
+
+    base = []
+    for s, ar in itertools.product(sizes, aspect_ratios):
+        w = s * (ar**0.5)
+        h = s / (ar**0.5)
+        base.append([-w / 2, -h / 2, w / 2, h / 2])
+    base = jnp.asarray(base)
+    cy = (jnp.arange(feature_h) + 0.5) * stride
+    cx = (jnp.arange(feature_w) + 0.5) * stride
+    shift = jnp.stack(
+        [jnp.tile(cx, feature_h), jnp.repeat(cy, feature_w)] * 2, -1
+    )
+    return Tensor((base[None, :, :] + shift[:, None, :]).reshape(-1, 4))

@@ -1,0 +1,118 @@
+"""paddle.signal + paddle.vision.ops tests (oracles: scipy for stft/istft
+roundtrip, torchvision-free numpy references for nms/roi_align)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import signal
+from paddle_tpu.vision import ops as V
+
+
+class TestSignal:
+    def test_frame_overlap_add_roundtrip_rect(self):
+        x = np.arange(32, dtype=np.float32)
+        f = signal.frame(paddle.to_tensor(x), 8, 8)  # non-overlapping
+        assert f.shape == [8, 4]
+        back = signal.overlap_add(f, 8)
+        np.testing.assert_allclose(np.asarray(back.numpy()), x, rtol=1e-6)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 2048).astype(np.float32)
+        win = paddle.to_tensor(np.hanning(512).astype(np.float32))
+        spec = signal.stft(paddle.to_tensor(x), 512, hop_length=128, window=win)
+        assert spec.shape == [2, 257, 2048 // 128 + 1]
+        back = signal.istft(spec, 512, hop_length=128, window=win, length=2048)
+        np.testing.assert_allclose(np.asarray(back.numpy()), x, atol=1e-3)
+
+    def test_stft_matches_numpy_single_frame(self):
+        x = np.random.RandomState(1).randn(512).astype(np.float32)
+        spec = signal.stft(paddle.to_tensor(x), 512, hop_length=512, center=False)
+        want = np.fft.rfft(x)
+        np.testing.assert_allclose(
+            np.asarray(spec.numpy())[:, 0], want, rtol=1e-3, atol=1e-3
+        )
+
+
+def _nms_numpy(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if suppressed[j] or j == i:
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0]); yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2]); yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(0, xx2 - xx1) * max(0, yy2 - yy1)
+            a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a2 = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            if inter / (a1 + a2 - inter) > thr:
+                suppressed[j] = True
+    return keep
+
+
+class TestVisionOps:
+    def test_nms_matches_reference(self):
+        rng = np.random.RandomState(3)
+        xy = rng.rand(40, 2) * 80
+        wh = rng.rand(40, 2) * 30 + 2
+        boxes = np.concatenate([xy, xy + wh], -1).astype(np.float32)
+        scores = rng.rand(40).astype(np.float32)
+        got = list(np.asarray(V.nms(paddle.to_tensor(boxes), 0.4, paddle.to_tensor(scores)).numpy()))
+        want = _nms_numpy(boxes, scores, 0.4)
+        assert got == want
+
+    def test_nms_multiclass_no_cross_class_suppression(self):
+        boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1], np.int64)
+        got = V.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                    paddle.to_tensor(cats), categories=[0, 1])
+        assert len(np.asarray(got.numpy())) == 2  # identical boxes, different classes
+
+    def test_box_iou(self):
+        a = paddle.to_tensor(np.array([[0, 0, 2, 2]], np.float32))
+        b = paddle.to_tensor(np.array([[1, 1, 3, 3], [4, 4, 5, 5]], np.float32))
+        got = np.asarray(V.box_iou(a, b).numpy())
+        np.testing.assert_allclose(got, [[1 / 7, 0.0]], rtol=1e-5)
+
+    def test_roi_align_identity_box(self):
+        """A box covering exactly one aligned cell grid reproduces avg of it."""
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        boxes = np.array([[0.0, 0.0, 4.0, 4.0]], np.float32)
+        out = V.roi_align(
+            paddle.to_tensor(x), paddle.to_tensor(boxes),
+            paddle.to_tensor(np.array([1], np.int32)), output_size=2,
+            spatial_scale=1.0, aligned=False,
+        )
+        got = np.asarray(out.numpy())[0, 0]
+        assert got.shape == (2, 2)
+        # each output bin ≈ mean of its 2x2 input quadrant (bilinear sampled)
+        assert got[0, 0] < got[0, 1] < got[1, 1]
+        assert got[0, 0] < got[1, 0]
+
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        x[0, 0, 1, 1] = 7.0
+        out = V.roi_pool(
+            paddle.to_tensor(x), paddle.to_tensor(np.array([[0, 0, 3, 3]], np.float32)),
+            paddle.to_tensor(np.array([1], np.int32)), output_size=1,
+        )
+        assert float(np.asarray(out.numpy())[0, 0, 0, 0]) == 7.0
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.RandomState(5)
+        priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+        targets = np.array([[1, 1, 12, 11], [4, 6, 22, 24]], np.float32)
+        enc = V.box_coder(paddle.to_tensor(priors), None, paddle.to_tensor(targets),
+                          "encode_center_size")
+        dec = V.box_coder(paddle.to_tensor(priors), None,
+                          paddle.to_tensor(np.asarray(enc.numpy())[:, None, :]),
+                          "decode_center_size", axis=0)
+        np.testing.assert_allclose(
+            np.asarray(dec.numpy())[:, 0, :], targets, rtol=1e-4, atol=1e-3
+        )
