@@ -104,6 +104,8 @@ _install_dataparallel()
 
 disable_signal_handler = lambda: None
 
+from .framework.flags import get_flags, set_flags  # noqa: E402
+
 
 def set_grad_enabled(flag):
     """Applies immediately (paddle semantics); also usable as a context

@@ -2,3 +2,4 @@ from .auto_cast import auto_cast, autocast, decorate, is_autocast_enabled, white
 from .grad_scaler import AmpScaler, GradScaler
 
 __all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "AmpScaler"]
+from . import debugging

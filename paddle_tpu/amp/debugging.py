@@ -1,0 +1,55 @@
+"""paddle.amp.debugging parity (reference: python/paddle/amp/debugging.py —
+check_numerics, enable/disable_operator_stats_collection, collect_operator_
+numerical_stats via the C++ nan-inf checker)."""
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import flags as F
+from ..framework.core import Tensor
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Scan one tensor for NaN/Inf (reference: paddle.amp.debugging.
+    check_numerics). Returns (num_nan, num_inf, num_zero) like the reference's
+    stats triple."""
+    d = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    d32 = d.astype(jnp.float32)
+    n_nan = int(jnp.sum(jnp.isnan(d32)))
+    n_inf = int(jnp.sum(jnp.isinf(d32)))
+    n_zero = int(jnp.sum(d32 == 0))
+    if (n_nan or n_inf) and debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise FloatingPointError(
+            f"[check_numerics] op={op_type} var={var_name}: {n_nan} NaN, {n_inf} Inf"
+        )
+    return (
+        Tensor(jnp.asarray(n_nan)),
+        Tensor(jnp.asarray(n_inf)),
+        Tensor(jnp.asarray(n_zero)),
+    )
+
+
+def enable_operator_stats_collection():
+    """Turn on the per-op eager NaN/Inf scan (FLAGS_check_nan_inf)."""
+    F.set_flags({"check_nan_inf": True, "check_nan_inf_level": 1})
+
+
+def disable_operator_stats_collection():
+    F.set_flags({"check_nan_inf": False, "check_nan_inf_level": 0})
+
+
+@contextlib.contextmanager
+def collect_operator_numerical_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()

@@ -415,6 +415,29 @@ jax.tree_util.register_pytree_node(Parameter, _tensor_flatten, _tensor_unflatten
 
 
 # -- the op recorder --------------------------------------------------------
+def _nan_check(name, outs):
+    """FLAGS_check_nan_inf: per-op output scan in eager mode (reference:
+    CheckVarHasNanOrInf, framework/details/nan_inf_utils_detail.cc). Only
+    concrete arrays are checked — inside a jit trace this is a no-op, matching
+    the reference's debug workflow of rerunning eagerly with the flag set."""
+    from . import flags as F
+
+    if not F.flag("check_nan_inf"):
+        return
+    for i, o in enumerate(outs):
+        if isinstance(o, jax.core.Tracer) or not _is_inexact(getattr(o, "dtype", np.int32)):
+            continue
+        bad = int(jnp.sum(~jnp.isfinite(o.astype(jnp.float32))))
+        if bad:
+            msg = f"Operator {name or 'unknown'} output {i} contains {bad} NaN/Inf values"
+            if F.flag("check_nan_inf_level", 0) >= 1:
+                import warnings
+
+                warnings.warn(msg, stacklevel=3)
+            else:
+                raise FloatingPointError(msg)
+
+
 def apply(fn, *tensors, name="", n_outputs=None, **kw):
     """Run `fn` on raw arrays; record a GradNode when grad is needed.
 
@@ -435,7 +458,9 @@ def apply(fn, *tensors, name="", n_outputs=None, **kw):
     if not needs_grad:
         out = fn(*datas)
         if isinstance(out, (tuple, list)):
+            _nan_check(name, out)
             return type(out)(Tensor(o, stop_gradient=True) for o in out)
+        _nan_check(name, (out,))
         return Tensor(out, stop_gradient=True)
 
     diff_idx = [i for i, m in enumerate(diff_mask) if m]
@@ -450,6 +475,7 @@ def apply(fn, *tensors, name="", n_outputs=None, **kw):
 
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
+    _nan_check(name, outs)
     node = GradNode(
         vjp_fn,
         [(t, m) for t, m in zip(tensors, diff_mask)],

@@ -1,0 +1,86 @@
+"""Runtime flag registry (reference: paddle/utils/flags.h
+PD_DEFINE_EXPORTED_* + flags.cc registry; Python surface paddle.set_flags /
+paddle.get_flags; env override contract FLAGS_<name>=value).
+
+The reference exports ~200 C++ flags; here the registry carries the ones
+with TPU-meaningful behavior plus accepts unknown names (stored, inert) so
+scripts that set CUDA-era flags keep running.
+"""
+import os
+import threading
+
+_lock = threading.Lock()
+
+
+def _env_default(name, default, typ):
+    raw = os.environ.get(f"FLAGS_{name}")
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    try:
+        return typ(raw)
+    except ValueError:
+        return default
+
+
+# name -> (default, type, help)
+_DEFS = {
+    # debugging (reference: nan_inf_utils_detail, enforce)
+    "check_nan_inf": (False, bool, "scan every eager op output for NaN/Inf"),
+    "check_nan_inf_level": (0, int, "0 raise, 1 warn"),
+    "call_stack_level": (2, int, "error message verbosity"),
+    # determinism (reference: cudnn_deterministic)
+    "cudnn_deterministic": (False, bool, "accepted for script compat; XLA on TPU is deterministic per compile"),
+    "embedding_deterministic": (0, int, "script compat"),
+    # allocator stats (reference: FLAGS_fraction_of_gpu_memory_to_use etc.)
+    "fraction_of_gpu_memory_to_use": (0.92, float, "script compat; XLA preallocation analogue"),
+    "allocator_strategy": ("auto_growth", str, "script compat"),
+    "gpu_memory_limit_mb": (0, int, "script compat"),
+    # profiler / logging
+    "enable_profiler": (False, bool, "v1 profiler toggle"),
+    "v": (0, int, "glog-style verbosity (GLOG_v)"),
+    # distributed
+    "distributed_timeout_s": (900, int, "rendezvous / collective timeout"),
+    "stop_check_timeout": (300, int, "launcher watchdog timeout"),
+    # numerics
+    "use_tf32": (True, bool, "script compat; TPU matmuls are bf16/fp32 per dtype"),
+    "matmul_use_bf16": (True, bool, "prefer bf16 matmul accumulation inputs"),
+}
+
+_values = {}
+_types = {}
+for _n, (_d, _t, _h) in _DEFS.items():
+    _values[_n] = _env_default(_n, _d, _t)
+    _types[_n] = _t
+
+
+def set_flags(flags):
+    """paddle.set_flags parity. Accepts {'FLAGS_name': value} or {'name': value}."""
+    with _lock:
+        for k, v in flags.items():
+            name = k[6:] if k.startswith("FLAGS_") else k
+            t = _types.get(name)
+            if t is bool and isinstance(v, str):
+                v = v.lower() in ("1", "true", "yes", "on")
+            elif t is not None and not isinstance(v, t):
+                v = t(v)
+            _values[name] = v
+
+
+def get_flags(flags):
+    """paddle.get_flags parity: name or list of names → {FLAGS_name: value}."""
+    names = [flags] if isinstance(flags, str) else list(flags)
+    out = {}
+    with _lock:
+        for k in names:
+            name = k[6:] if k.startswith("FLAGS_") else k
+            if name not in _values:
+                raise ValueError(f"unknown flag {k}")
+            out[f"FLAGS_{name}"] = _values[name]
+    return out
+
+
+def flag(name, default=None):
+    """Internal fast read."""
+    return _values.get(name, default)

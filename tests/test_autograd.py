@@ -151,3 +151,37 @@ class TestPyLayer:
         eps = 1e-3
         num = (f_np(x0 + eps) - f_np(x0 - eps)) / (2 * eps)
         assert np.allclose(x.grad.numpy(), num, atol=1e-3)
+
+
+class TestFlagsAndNanChecker:
+    def test_set_get_flags(self):
+        import paddle_tpu as paddle
+
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is True
+        paddle.set_flags({"check_nan_inf": False})
+        assert paddle.get_flags(["check_nan_inf"])["FLAGS_check_nan_inf"] is False
+
+    def test_nan_checker_catches_bad_op(self):
+        import numpy as np
+        import pytest
+
+        import paddle_tpu as paddle
+
+        paddle.set_flags({"check_nan_inf": True, "check_nan_inf_level": 0})
+        try:
+            x = paddle.to_tensor(np.array([0.0], np.float32))
+            with pytest.raises(FloatingPointError, match="NaN/Inf"):
+                paddle.log(x - 1.0)  # log(-1) = nan
+        finally:
+            paddle.set_flags({"check_nan_inf": False})
+
+    def test_check_numerics_stats(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.amp import debugging
+
+        t = paddle.to_tensor(np.array([1.0, 0.0, np.inf], np.float32))
+        n_nan, n_inf, n_zero = debugging.check_numerics(t, debug_mode=debugging.DebugMode.CHECK_ALL)
+        assert int(n_nan.numpy()) == 0 and int(n_inf.numpy()) == 1 and int(n_zero.numpy()) == 1
