@@ -66,6 +66,7 @@ from . import sparse
 from . import linalg as _linalg_ns
 from . import fft
 from . import signal
+from . import inference
 from . import static
 from .serialization import load, save
 

@@ -1,0 +1,31 @@
+"""paddle.inference parity (reference: paddle/fluid/inference/ —
+AnalysisPredictor + paddle_infer Python API in
+python/paddle/inference/__init__.py: Config, create_predictor, Predictor,
+zero-copy input/output handles).
+
+TPU-native design (SURVEY.md §3.5): the reference's pass pipeline + executor
+collapse into one AOT-compiled jax.jit callable — XLA is the optimizer
+(fusion passes ≡ IR passes, buffer assignment ≡ memory-reuse pass, and the
+TensorRT subgraph engine has no analogue because XLA compiles the WHOLE
+graph). The Predictor keeps the zero-copy handle API shape so deployment
+scripts port over.
+"""
+from .config import Config
+from .predictor import Predictor, create_predictor
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+class PlaceType:
+    kHOST = 0
+    kCPU = 0
+    kGPU = 1
+    kXPU = 2
+    kCUSTOM = 3

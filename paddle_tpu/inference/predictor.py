@@ -1,0 +1,110 @@
+"""Predictor (reference: AnalysisPredictor in
+paddle/fluid/inference/api/analysis_predictor.cc + the paddle_infer handle
+API: get_input_names/get_input_handle/run/get_output_handle).
+
+The predictor wraps either (a) a Layer instance (direct, the common in-process
+path) or (b) a jit.save'd artifact directory. forward is jit-compiled once per
+input signature — XLA's AOT compile IS the reference's pass pipeline.
+"""
+import numpy as np
+
+from ..framework.core import Tensor, to_tensor
+
+
+class _IOHandle:
+    """Zero-copy style tensor handle (reference: ZeroCopyTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def reshape(self, shape):
+        self._shape = tuple(shape)
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def share_external_data(self, arr):
+        self._value = arr
+
+    def copy_to_cpu(self):
+        v = self._value
+        if isinstance(v, Tensor):
+            return np.asarray(v.numpy())
+        return np.asarray(v)
+
+    def shape(self):
+        v = self._value
+        return list(np.shape(v.numpy() if isinstance(v, Tensor) else v))
+
+
+class Predictor:
+    def __init__(self, config_or_layer, input_names=None):
+        from ..nn.layer.layers import Layer
+
+        self._jitted = {}
+        if isinstance(config_or_layer, Layer):
+            self._layer = config_or_layer
+            self._layer.eval()
+        else:
+            config = config_or_layer
+            # artifact path: a jit.save'd Layer is weights + descriptor; a
+            # Layer instance must be supplied to bind them (the reference
+            # deserializes a Program; our program is the traced Layer)
+            raise ValueError(
+                "create_predictor(Config) from serialized artifacts requires "
+                "the model class; pass the Layer directly: "
+                "create_predictor(layer) or Predictor(layer). For jit.save'd "
+                "weights, build the Layer, layer.set_state_dict(paddle.jit."
+                "load(path)['state_dict']), then Predictor(layer)."
+            )
+        self._input_names = list(input_names) if input_names else ["x"]
+        self._inputs = {n: _IOHandle(n) for n in self._input_names}
+        self._outputs = {}
+
+    # -- handle API --------------------------------------------------------
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    # -- execution ---------------------------------------------------------
+    def run(self, inputs=None):
+        """Either positional (list of np arrays, paddle_infer v2 style) or via
+        previously-filled input handles."""
+        if inputs is not None:
+            arrs = [np.asarray(a) for a in inputs]
+        else:
+            arrs = [self._inputs[n]._value for n in self._input_names]
+
+        sig = tuple((a.shape, str(a.dtype)) for a in arrs)
+        fn = self._jitted.get(sig)
+        if fn is None:
+            from ..jit_api import StaticLayer
+
+            fn = StaticLayer(self._layer)
+            self._jitted[sig] = fn
+        out = fn(*[to_tensor(a) for a in arrs])
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        self._outputs = {}
+        results = []
+        for i, o in enumerate(outs):
+            h = _IOHandle(f"out_{i}")
+            h._value = o
+            self._outputs[h.name] = h
+            results.append(np.asarray(o.numpy()) if isinstance(o, Tensor) else np.asarray(o))
+        return results if inputs is not None else None
+
+    def clone(self):
+        return Predictor(self._layer, self._input_names)
+
+
+def create_predictor(config_or_layer, input_names=None):
+    return Predictor(config_or_layer, input_names)
