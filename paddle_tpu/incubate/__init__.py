@@ -2,3 +2,5 @@
 ops and distributed extras. On TPU, "fused" means XLA/Pallas fusion."""
 from . import distributed, nn
 from .nn import functional
+
+from . import asp

@@ -1,0 +1,36 @@
+"""Automatic SParsity (reference: python/paddle/incubate/asp/ —
+asp.py decorate/prune_model, utils.py 2:4 mask kernels
+check_mask_1d/get_mask_1d/check_mask_2d/get_mask_2d_greedy/best).
+
+n:m structured sparsity: every group of m consecutive weights keeps the n
+largest by magnitude. Masks are applied on prune and re-applied by the
+decorated optimizer after each step so pruned weights stay zero through
+training (the reference's OptimizerWithSparsityGuarantee).
+"""
+from .asp import (
+    ASPHelper,
+    add_supported_layer,
+    decorate,
+    prune_model,
+    reset_excluded_layers,
+    set_excluded_layers,
+)
+from .utils import (
+    CheckMethod,
+    MaskAlgo,
+    check_mask_1d,
+    check_mask_2d,
+    check_sparsity,
+    create_mask,
+    get_mask_1d,
+    get_mask_2d_best,
+    get_mask_2d_greedy,
+)
+
+__all__ = [
+    "decorate", "prune_model", "set_excluded_layers", "reset_excluded_layers",
+    "add_supported_layer", "ASPHelper",
+    "create_mask", "check_sparsity", "get_mask_1d", "check_mask_1d",
+    "get_mask_2d_greedy", "get_mask_2d_best", "check_mask_2d",
+    "MaskAlgo", "CheckMethod",
+]

@@ -1,0 +1,98 @@
+"""ASP workflow (reference: python/paddle/incubate/asp/asp.py — ASPHelper,
+decorate → OptimizerWithSparsityGuarantee, prune_model)."""
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor
+from ...nn.layer.common import Linear
+from ...nn.layer.conv import Conv2D
+from .utils import CheckMethod, MaskAlgo, check_sparsity, create_mask
+
+_SUPPORTED_TYPES = {Linear, Conv2D}
+_EXCLUDED = set()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def add_supported_layer(layer_type):
+    _SUPPORTED_TYPES.add(layer_type)
+
+
+class ASPHelper:
+    MASK_APPENDDED_NAME = "asp_mask"
+    masks = {}  # param name -> np mask
+
+    @classmethod
+    def _is_supported_param(cls, model, name, param):
+        if name in _EXCLUDED:
+            return False
+        if param.ndim < 2:
+            return False
+        # only params of supported layer types (weight, not bias)
+        owner = name.rsplit(".", 1)[0] if "." in name else ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf != "weight":
+            return False
+        sub = model
+        try:
+            for part in owner.split(".") if owner else []:
+                sub = getattr(sub, part)
+        except AttributeError:
+            return True
+        return type(sub) in _SUPPORTED_TYPES or not isinstance(sub, object.__class__)
+
+    @classmethod
+    def prune_model(cls, model, n=2, m=4, mask_algo=MaskAlgo.MASK_1D, with_mask=True):
+        cls.masks.clear()
+        for name, p in model.named_parameters():
+            if not cls._is_supported_param(model, name, p):
+                continue
+            w = np.asarray(p.numpy())
+            mask = create_mask(w, mask_algo, n, m)
+            p._data = jnp.asarray(w * mask, p._data.dtype)
+            if with_mask:
+                cls.masks[name] = mask
+        return cls.masks
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune supported weights to n:m sparsity, record masks for training."""
+    algo = MaskAlgo(mask_algo) if not isinstance(mask_algo, MaskAlgo) else mask_algo
+    return ASPHelper.prune_model(model, n, m, algo, with_mask)
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies the pruning masks after every optimizer step so pruned
+    weights stay exactly zero (reference: same-named class)."""
+
+    def __init__(self, optimizer, model):
+        self._inner = optimizer
+        self._model = model
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+        params = dict(self._model.named_parameters())
+        for name, mask in ASPHelper.masks.items():
+            p = params.get(name)
+            if p is not None:
+                p._data = p._data * jnp.asarray(mask, p._data.dtype)
+
+    def clear_grad(self, *a, **k):
+        return self._inner.clear_grad(*a, **k)
+
+
+def decorate(optimizer, model=None):
+    """reference: asp.decorate(optimizer). The model binds at decorate time
+    (our optimizers don't back-reference the Layer)."""
+    if model is None:
+        raise ValueError("paddle_tpu asp.decorate needs the model: decorate(opt, model)")
+    return OptimizerWithSparsityGuarantee(optimizer, model)

@@ -1,0 +1,155 @@
+"""n:m sparsity mask algorithms (reference: python/paddle/incubate/asp/utils.py
+— get_mask_1d, get_mask_2d_greedy, get_mask_2d_best, checkers)."""
+import itertools
+from enum import Enum
+
+import numpy as np
+
+
+class MaskAlgo(Enum):
+    MASK_1D = "mask_1d"
+    MASK_2D_GREEDY = "mask_2d_greedy"
+    MASK_2D_BEST = "mask_2d_best"
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_1d"
+    CHECK_2D = "check_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo):
+        return CheckMethod.CHECK_1D if mask_algo == MaskAlgo.MASK_1D else CheckMethod.CHECK_2D
+
+
+def _reshape_1d(mat, m):
+    """Pad the flattened last dim to a multiple of m and view as rows of m."""
+    flat = mat.reshape(mat.shape[0], -1)
+    pad = (-flat.shape[1]) % m
+    if pad:
+        flat = np.concatenate([flat, np.zeros((flat.shape[0], pad), flat.dtype)], 1)
+    return flat, pad
+
+
+def get_mask_1d(mat, n, m):
+    """Keep the n largest |w| in every m consecutive weights along rows."""
+    mat = np.asarray(mat)
+    flat, pad = _reshape_1d(mat, m)
+    groups = flat.reshape(-1, m)
+    order = np.argsort(-np.abs(groups), axis=1)[:, :n]
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order, 1.0, axis=1)
+    mask = mask.reshape(flat.shape)
+    if pad:
+        mask = mask[:, :-pad]
+    return mask.reshape(mat.shape)
+
+
+def check_mask_1d(mat, n, m):
+    mat = np.asarray(mat)
+    flat, pad = _reshape_1d(mat, m)
+    groups = flat.reshape(-1, m)
+    return bool(np.all((groups != 0).sum(1) <= n))
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """m×m block-wise greedy: pick entries largest-first while keeping each
+    row and column of the block ≤ n nonzeros."""
+    mat = np.asarray(mat)
+    h, w = mat.shape
+    ph, pw = (-h) % m, (-w) % m
+    padded = np.pad(np.abs(mat), ((0, ph), (0, pw)))
+    H, W = padded.shape
+    mask = np.zeros_like(padded)
+    for bi in range(0, H, m):
+        for bj in range(0, W, m):
+            block = padded[bi : bi + m, bj : bj + m]
+            order = np.dstack(np.unravel_index(np.argsort(-block, axis=None), (m, m)))[0]
+            rows = np.zeros(m, int)
+            cols = np.zeros(m, int)
+            for r, c in order:
+                if rows[r] < n and cols[c] < n:
+                    mask[bi + r, bj + c] = 1.0
+                    rows[r] += 1
+                    cols[c] += 1
+    return mask[:h, :w]
+
+
+def get_mask_2d_best(mat, n, m):
+    """Exhaustive best m×m mask (small m only) — maximizes retained |w| sum
+    over row-and-column n:m patterns; falls back to greedy for m > 4."""
+    mat = np.asarray(mat)
+    if m > 4:
+        return get_mask_2d_greedy(mat, n, m)
+    # all binary m×m masks with each row/col summing to n — precompute once
+    patterns = _valid_patterns(n, m)
+    h, w = mat.shape
+    ph, pw = (-h) % m, (-w) % m
+    padded = np.pad(np.abs(mat), ((0, ph), (0, pw)))
+    H, W = padded.shape
+    mask = np.zeros_like(padded)
+    for bi in range(0, H, m):
+        for bj in range(0, W, m):
+            block = padded[bi : bi + m, bj : bj + m]
+            scores = np.einsum("pij,ij->p", patterns, block)
+            mask[bi : bi + m, bj : bj + m] = patterns[int(np.argmax(scores))]
+    return mask[:h, :w]
+
+
+_PATTERN_CACHE = {}
+
+
+def _valid_patterns(n, m):
+    key = (n, m)
+    if key in _PATTERN_CACHE:
+        return _PATTERN_CACHE[key]
+    rows = [p for p in itertools.product((0.0, 1.0), repeat=m) if sum(p) == n]
+    out = []
+    for combo in itertools.product(rows, repeat=m):
+        arr = np.asarray(combo)
+        if np.all(arr.sum(0) == n):
+            out.append(arr)
+    pats = np.stack(out)
+    _PATTERN_CACHE[key] = pats
+    return pats
+
+
+def check_mask_2d(mat, n, m):
+    mat = np.asarray(mat)
+    h, w = mat.shape
+    for bi in range(0, h - m + 1, m):
+        for bj in range(0, w - m + 1, m):
+            block = mat[bi : bi + m, bj : bj + m] != 0
+            if np.any(block.sum(0) > n) or np.any(block.sum(1) > n):
+                return False
+    return True
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
+    t = np.asarray(tensor)
+    shape = t.shape
+    if t.ndim == 1:
+        mat = t.reshape(1, -1)
+    elif t.ndim == 2:
+        mat = t
+    elif t.ndim == 4:
+        # conv weights [O,I,H,W] → [O, I*H*W] (reference layout handling)
+        mat = t.reshape(shape[0], -1)
+    else:
+        mat = t.reshape(shape[0], -1)
+    algo = MaskAlgo(func_name) if not isinstance(func_name, MaskAlgo) else func_name
+    if algo == MaskAlgo.MASK_1D:
+        mask = get_mask_1d(mat, n, m)
+    elif algo == MaskAlgo.MASK_2D_GREEDY:
+        mask = get_mask_2d_greedy(mat, n, m)
+    else:
+        mask = get_mask_2d_best(mat, n, m)
+    return mask.reshape(shape).astype(t.dtype)
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n=2, m=4):
+    t = np.asarray(tensor)
+    mat = t.reshape(t.shape[0], -1) if t.ndim != 2 else t
+    method = CheckMethod(func_name) if not isinstance(func_name, CheckMethod) else func_name
+    if method == CheckMethod.CHECK_1D:
+        return check_mask_1d(mat, n, m)
+    return check_mask_2d(mat, n, m)

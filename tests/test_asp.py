@@ -1,0 +1,59 @@
+"""ASP 2:4 sparsity tests (reference model: test/asp/test_asp_pruning_*.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate import asp
+from paddle_tpu.nn import functional as F
+
+
+class TestMasks:
+    def test_mask_1d_2of4(self):
+        rng = np.random.RandomState(0)
+        w = rng.randn(8, 16).astype(np.float32)
+        mask = asp.get_mask_1d(w, 2, 4)
+        assert asp.check_mask_1d(w * mask, 2, 4)
+        # exactly half kept, and the kept ones are the group-wise largest
+        assert mask.sum() == w.size / 2
+        groups = (np.abs(w) * mask).reshape(-1, 4)
+        raw = np.abs(w).reshape(-1, 4)
+        np.testing.assert_allclose(groups.sum(1), np.sort(raw, 1)[:, 2:].sum(1), rtol=1e-6)
+
+    def test_mask_2d_greedy_constraints(self):
+        rng = np.random.RandomState(1)
+        w = rng.randn(8, 8).astype(np.float32)
+        mask = asp.get_mask_2d_greedy(w, 2, 4)
+        assert asp.check_mask_2d(w * mask, 2, 4)
+
+    def test_mask_2d_best_at_least_greedy(self):
+        rng = np.random.RandomState(2)
+        w = rng.randn(4, 4).astype(np.float32)
+        g = asp.get_mask_2d_greedy(w, 2, 4)
+        b = asp.get_mask_2d_best(w, 2, 4)
+        assert (np.abs(w) * b).sum() >= (np.abs(w) * g).sum() - 1e-6
+        assert asp.check_mask_2d(w * b, 2, 4)
+
+
+class TestWorkflow:
+    def test_prune_and_train_keeps_sparsity(self):
+        paddle.seed(4)
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+        masks = asp.prune_model(model, n=2, m=4)
+        assert masks  # linear weights pruned
+        opt = asp.decorate(
+            optimizer.Adam(learning_rate=0.01, parameters=model.parameters()), model
+        )
+        x = paddle.to_tensor(np.random.RandomState(0).rand(8, 16).astype(np.float32))
+        y = paddle.to_tensor(np.arange(8, dtype=np.int64) % 4)
+        first = None
+        for _ in range(10):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss.numpy())
+        assert float(loss.numpy()) < first  # training proceeds
+        for name, p in model.named_parameters():
+            if name in masks:
+                w = np.asarray(p.numpy())
+                assert asp.check_sparsity(w, n=2, m=4)  # sparsity survives steps
