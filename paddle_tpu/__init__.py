@@ -107,6 +107,67 @@ disable_signal_handler = lambda: None
 
 from .framework.flags import get_flags, set_flags  # noqa: E402
 
+from . import regularizer
+from . import utils
+from . import version
+from . import hub
+from .hapi import callbacks
+
+__version__ = version.full_version
+base = framework  # paddle.base compat alias (reference: python/paddle/base)
+
+
+def iinfo(dtype):
+    import numpy as np
+
+    return np.iinfo(np.dtype(_dtype_mod.convert_dtype(dtype)))
+
+
+def finfo(dtype):
+    import jax.numpy as jnp
+    import ml_dtypes
+    import numpy as np
+
+    d = _dtype_mod.convert_dtype(dtype)
+    return ml_dtypes.finfo(d) if d == jnp.bfloat16 else np.finfo(np.dtype(d))
+
+
+def batch(reader, batch_size, drop_last=False):
+    """legacy paddle.batch reader decorator."""
+
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+class LazyGuard:
+    """reference: paddle.LazyGuard — delayed param init. Params here are
+    cheap host arrays until first use, so this is a no-op guard."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class onnx:  # namespace stub (reference: paddle.onnx.export via paddle2onnx)
+    @staticmethod
+    def export(*a, **k):
+        raise NotImplementedError(
+            "ONNX export is not part of the TPU-native build; export via "
+            "paddle_tpu.jit.save (weights) or AOT-compile with jax.export"
+        )
+
+
 
 def set_grad_enabled(flag):
     """Applies immediately (paddle semantics); also usable as a context

@@ -1,0 +1,34 @@
+"""paddle.hub parity (reference: python/paddle/hapi/hub.py — list/help/load
+from github/local hubconf.py). No-egress: local source only."""
+import importlib.util
+import os
+import sys
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no hubconf.py in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def list(repo_dir, source="local", force_reload=False):
+    if source != "local":
+        raise RuntimeError("no network egress; only source='local' is supported")
+    mod = _load_hubconf(repo_dir)
+    return [k for k, v in vars(mod).items() if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    if source != "local":
+        raise RuntimeError("no network egress; only source='local' is supported")
+    return getattr(_load_hubconf(repo_dir), model).__doc__
+
+
+def load(repo_dir, model, *args, source="local", force_reload=False, **kwargs):
+    if source != "local":
+        raise RuntimeError("no network egress; only source='local' is supported")
+    return getattr(_load_hubconf(repo_dir), model)(*args, **kwargs)

@@ -12,6 +12,17 @@ from .layer.conv import (  # noqa: F401
     Conv3DTranspose,
 )
 from .layer.layers import Layer  # noqa: F401
+from .layer.rnn import (  # noqa: F401
+    GRU,
+    LSTM,
+    RNN,
+    BiRNN,
+    GRUCell,
+    LSTMCell,
+    RNNCellBase,
+    SimpleRNN,
+    SimpleRNNCell,
+)
 from .layer.loss import *  # noqa: F401,F403
 from .layer.norm import *  # noqa: F401,F403
 from .layer.pooling import *  # noqa: F401,F403

@@ -222,3 +222,27 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input (reference: nn.Softmax2D)."""
+
+    def forward(self, x):
+        from .. import functional as F
+
+        return F.softmax(x, axis=-3)
+
+
+class Unflatten(Layer):
+    """reference: nn.Unflatten(axis, shape)."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape_ = axis, list(shape)
+
+    def forward(self, x):
+        from ...tensor.manipulation import reshape
+
+        s = list(x.shape)
+        ax = self.axis if self.axis >= 0 else len(s) + self.axis
+        return reshape(x, s[:ax] + self.shape_ + s[ax + 1:])

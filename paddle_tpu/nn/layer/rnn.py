@@ -1,0 +1,370 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py —
+SimpleRNNCell/LSTMCell/GRUCell, RNN/BiRNN wrappers, SimpleRNN/LSTM/GRU;
+C++ fused kernels phi/kernels/gpu/rnn_kernel.cu).
+
+TPU-native: the time recurrence is one lax.scan per (layer, direction) —
+XLA compiles the whole unrolled-in-time program with the matmuls on the MXU;
+no cuDNN-style fused kernel is needed. Gate layout follows the i,f,g,o /
+r,z,n convention (weight_ih [G*H, I]), so state_dicts port from the
+reference/torch checkpoints directly.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        h = jnp.full((batch, self.hidden_size), init_value, jnp.float32)
+        if getattr(self, "state_components", 1) == 2:
+            return Tensor(h), Tensor(h)
+        return Tensor(h)
+
+
+def _uniform_std(hidden_size):
+    k = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-k, k)
+
+
+class SimpleRNNCell(RNNCellBase):
+    state_components = 1
+
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter([hidden_size, input_size], weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr, is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr, is_bias=True,
+                                             default_initializer=init)
+
+    def _step(self, x, h, wih, whh, bih, bhh):
+        pre = x @ wih.T + bih + h @ whh.T + bhh
+        return jnp.tanh(pre) if self.activation == "tanh" else jax.nn.relu(pre)
+
+    def forward(self, inputs, states=None):
+        states = states if states is not None else self.get_initial_states(inputs)
+        out = apply(
+            lambda x, h, a, b, c, d: self._step(x, h, a, b, c, d),
+            inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+            name="simple_rnn_cell",
+        )
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    state_components = 2
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr, is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr, is_bias=True,
+                                             default_initializer=init)
+
+    @staticmethod
+    def _step(x, h, c, wih, whh, bih, bhh, H):
+        gates = x @ wih.T + bih + h @ whh.T + bhh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+
+    def forward(self, inputs, states=None):
+        states = states if states is not None else self.get_initial_states(inputs)
+        h, c = states
+        hc = apply(
+            lambda x, hh, cc, a, b, d, e: self._step(x, hh, cc, a, b, d, e, self.hidden_size),
+            inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+            name="lstm_cell",
+        )
+        h_new, c_new = hc
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    state_components = 1
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        init = _uniform_std(hidden_size)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr, is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr, is_bias=True,
+                                             default_initializer=init)
+
+    @staticmethod
+    def _step(x, h, wih, whh, bih, bhh):
+        gi = x @ wih.T + bih
+        gh = h @ whh.T + bhh
+        ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+        hr, hz, hn = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(in_ + r * hn)
+        return (1 - z) * n + z * h
+
+    def forward(self, inputs, states=None):
+        states = states if states is not None else self.get_initial_states(inputs)
+        out = apply(
+            lambda x, h, a, b, c, d: self._step(x, h, a, b, c, d),
+            inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+            name="gru_cell",
+        )
+        return out, out
+
+
+def _scan_direction(step_raw, x_seq, init_states, mask, reverse):
+    """Run one direction over [T, B, I] with optional [T, B] validity mask
+    (sequence_length support: past-end steps carry the last valid state; in
+    reverse mode the masked tail leaves the carry at init, so the backward
+    pass effectively starts at each sequence's true end)."""
+    if mask is None:
+        def body(carry, x_t):
+            return step_raw(carry, x_t)
+
+        return jax.lax.scan(body, init_states, x_seq, reverse=reverse)
+
+    def body(carry, inp):
+        x_t, m_t = inp
+        new_carry, out = step_raw(carry, x_t)
+        keep = m_t[:, None]
+        new_carry = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(keep, n, o), new_carry, carry
+        )
+        out = jnp.where(keep, out, jnp.zeros_like(out))
+        return new_carry, out
+
+    return jax.lax.scan(body, init_states, (x_seq, mask), reverse=reverse)
+
+
+class RNN(Layer):
+    """Wrap a cell into a full-sequence runner (reference: nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        outputs, final = _run_cell_over_time(
+            self.cell, inputs, initial_states, sequence_length,
+            self.time_major, self.is_reverse,
+        )
+        return outputs, final
+
+
+def _run_cell_over_time(cell, inputs, initial_states, sequence_length, time_major, reverse):
+    from ...framework.core import to_tensor
+
+    x = inputs if isinstance(inputs, Tensor) else to_tensor(inputs)
+    if initial_states is None:
+        batch_dim = 1 if time_major else 0
+        initial_states = cell.get_initial_states(x, batch_dim_idx=batch_dim)
+    states_list = list(initial_states) if isinstance(initial_states, (tuple, list)) else [initial_states]
+    seq_t = sequence_length if sequence_length is None else (
+        sequence_length if isinstance(sequence_length, Tensor) else to_tensor(sequence_length)
+    )
+
+    params = [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
+    two = cell.state_components == 2
+
+    def fn(xd, *rest):
+        it = iter(rest)
+        sts = [next(it) for _ in states_list]
+        wih, whh, bih, bhh = (next(it) for _ in range(4))
+        sl = next(it) if seq_t is not None else None
+        seq = xd if time_major else jnp.swapaxes(xd, 0, 1)  # [T,B,I]
+        T = seq.shape[0]
+        if sl is not None:
+            t_idx = jnp.arange(T)[:, None]
+            mask = t_idx < sl[None, :]
+        else:
+            mask = None
+
+        if two:
+            def step_raw(carry, x_t):
+                h, c = carry
+                h2, c2 = LSTMCell._step(x_t, h, c, wih, whh, bih, bhh, cell.hidden_size)
+                return (h2, c2), h2
+            init = (sts[0], sts[1])
+        elif isinstance(cell, GRUCell):
+            def step_raw(carry, x_t):
+                h2 = GRUCell._step(x_t, carry, wih, whh, bih, bhh)
+                return h2, h2
+            init = sts[0]
+        else:
+            def step_raw(carry, x_t):
+                pre = x_t @ wih.T + bih + carry @ whh.T + bhh
+                h2 = jnp.tanh(pre) if cell.activation == "tanh" else jax.nn.relu(pre)
+                return h2, h2
+            init = sts[0]
+
+        final, outs = _scan_direction(step_raw, seq, init, mask, reverse)
+        out = outs if time_major else jnp.swapaxes(outs, 0, 1)
+        if two:
+            return out, final[0], final[1]
+        return out, final
+
+    args = [x] + states_list + params + ([seq_t] if seq_t is not None else [])
+    res = apply(fn, *args, name=type(cell).__name__.lower())
+    if two:
+        out, h, c = res
+        return out, (h, c)
+    out, h = res
+    return out, h
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw, self.cell_bw = cell_fw, cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        from ...tensor.manipulation import concat
+
+        fw_init, bw_init = (initial_states if initial_states is not None else (None, None))
+        out_f, st_f = _run_cell_over_time(self.cell_fw, inputs, fw_init, sequence_length,
+                                          self.time_major, False)
+        out_b, st_b = _run_cell_over_time(self.cell_bw, inputs, bw_init, sequence_length,
+                                          self.time_major, True)
+        return concat([out_f, out_b], axis=-1), (st_f, st_b)
+
+
+class _RNNBase(Layer):
+    """Multi-layer, optionally bidirectional runner (reference: _RNNBase)."""
+
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation=None,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        if direction in ("bidirectional", "bidirect"):
+            self.bidirectional = True
+        elif direction == "forward":
+            self.bidirectional = False
+        else:
+            raise ValueError(f"direction must be forward|bidirectional, got {direction}")
+        self.state_components = 2 if self.CELL is LSTMCell else 1
+        kw = {}
+        if self.CELL is SimpleRNNCell and activation is not None:
+            kw["activation"] = activation
+
+        num_dirs = 2 if self.bidirectional else 1
+        self._cells = []
+        for layer_i in range(num_layers):
+            in_sz = input_size if layer_i == 0 else hidden_size * num_dirs
+            for d in range(num_dirs):
+                cell = self.CELL(in_sz, hidden_size, weight_ih_attr=weight_ih_attr,
+                                 weight_hh_attr=weight_hh_attr, bias_ih_attr=bias_ih_attr,
+                                 bias_hh_attr=bias_hh_attr, **kw)
+                suffix = f"l{layer_i}" + ("_reverse" if d else "")
+                self.add_sublayer(f"cell_{suffix}", cell)
+                # torch/paddle-portable parameter aliases
+                setattr(self, f"weight_ih_{suffix}", cell.weight_ih)
+                setattr(self, f"weight_hh_{suffix}", cell.weight_hh)
+                setattr(self, f"bias_ih_{suffix}", cell.bias_ih)
+                setattr(self, f"bias_hh_{suffix}", cell.bias_hh)
+                self._cells.append(cell)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat, stack
+
+        num_dirs = 2 if self.bidirectional else 1
+        batch_dim = 1 if self.time_major else 0
+        x = inputs
+
+        # normalize initial states to per-(layer,dir) list
+        if initial_states is None:
+            per = [None] * (self.num_layers * num_dirs)
+        else:
+            if self.state_components == 2:
+                h0, c0 = initial_states  # [L*D, B, H] each
+                per = [
+                    (h0[i], c0[i]) for i in range(self.num_layers * num_dirs)
+                ]
+            else:
+                h0 = initial_states
+                per = [h0[i] for i in range(self.num_layers * num_dirs)]
+
+        finals = []
+        for layer_i in range(self.num_layers):
+            outs = []
+            for d in range(num_dirs):
+                cell = self._cells[layer_i * num_dirs + d]
+                init = per[layer_i * num_dirs + d]
+                o, st = _run_cell_over_time(cell, x, init, sequence_length,
+                                            self.time_major, d == 1)
+                outs.append(o)
+                finals.append(st)
+            x = outs[0] if num_dirs == 1 else concat(outs, axis=-1)
+            if self.dropout and layer_i < self.num_layers - 1 and self.training:
+                from .. import functional as F
+
+                x = F.dropout(x, p=self.dropout, training=True)
+
+        if self.state_components == 2:
+            h = stack([st[0] for st in finals], axis=0)
+            c = stack([st[1] for st in finals], axis=0)
+            return x, (h, c)
+        h = stack(finals, axis=0)
+        return x, h
+
+
+class SimpleRNN(_RNNBase):
+    CELL = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, activation=activation, **kw)
+
+
+class LSTM(_RNNBase):
+    CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    CELL = GRUCell

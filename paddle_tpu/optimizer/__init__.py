@@ -11,3 +11,5 @@ from .optimizers import (
     Momentum,
     RMSProp,
 )
+
+from .lbfgs import LBFGS  # noqa: F401

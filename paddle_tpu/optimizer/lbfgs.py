@@ -1,0 +1,154 @@
+"""LBFGS (reference: python/paddle/optimizer/lbfgs.py — closure-driven full
+-batch quasi-Newton with strong-Wolfe line search).
+
+Unlike the per-slot optimizers, LBFGS is host-driven (history of (s, y)
+pairs, line-search loop) — matching the reference's Python implementation.
+The inner products/direction math are jnp ops on-device.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from .optimizer import Optimizer
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s_hist = []
+        self._y_hist = []
+        self._prev_flat_grad = None
+
+    # -- flat helpers ------------------------------------------------------
+    def _params(self):
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def _flat(self, tensors):
+        return jnp.concatenate([jnp.ravel(t) for t in tensors])
+
+    def _gather_grads(self):
+        return self._flat([
+            (p.grad._data if p.grad is not None else jnp.zeros(p._data.shape, p._data.dtype))
+            for p in self._params()
+        ]).astype(jnp.float32)
+
+    def _assign_flat(self, flat):
+        i = 0
+        for p in self._params():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            p._data = flat[i : i + n].reshape(p._data.shape).astype(p._data.dtype)
+            i += n
+
+    def _gather_params(self):
+        return self._flat([p._data for p in self._params()]).astype(jnp.float32)
+
+    def _direction(self, grad):
+        """Two-loop recursion over the (s, y) history."""
+        q = grad
+        alphas = []
+        for s, y in reversed(list(zip(self._s_hist, self._y_hist))):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._s_hist:
+            s, y = self._s_hist[-1], self._y_hist[-1]
+            gamma = jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y), 1e-10)
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    def step(self, closure=None):
+        """closure() -> loss Tensor, recomputing forward+backward. Without a
+        closure, performs a single gradient-descent-flavored LBFGS update
+        using the grads already on the parameters."""
+        if closure is None:
+            grad = self._gather_grads()
+            d = self._direction(grad)
+            x0 = self._gather_params()
+            lr = float(self.get_lr())
+            self._update_history(x0, grad, x0 + lr * d)
+            self._assign_flat(x0 + lr * d)
+            self._global_step += 1
+            return None
+
+        loss = closure()
+        grad = self._gather_grads()
+        evals = 1
+        for _ in range(self.max_iter):
+            if float(jnp.max(jnp.abs(grad))) <= self.tolerance_grad:
+                break
+            d = self._direction(grad)
+            x0 = self._gather_params()
+            lr = float(self.get_lr())
+            if self.line_search_fn == "strong_wolfe":
+                lr, loss, grad, evals_ls = self._strong_wolfe(closure, x0, d, lr, loss, grad)
+                evals += evals_ls
+            else:
+                self._assign_flat(x0 + lr * d)
+                for p in self._params():
+                    p.clear_grad()
+                loss = closure()
+                grad_new = self._gather_grads()
+                self._update_history(x0, grad, self._gather_params())
+                grad = grad_new
+                evals += 1
+            if evals >= self.max_eval:
+                break
+            x_new = self._gather_params()
+            if float(jnp.max(jnp.abs(x_new - x0))) < self.tolerance_change:
+                break
+        self._global_step += 1
+        return loss
+
+    def _update_history(self, x_old, g_old, x_new):
+        s = x_new - x_old
+        # y computed lazily on next step in closure mode; here use curvature
+        # of current grad state if available
+        if self._prev_flat_grad is not None:
+            y = g_old - self._prev_flat_grad
+            if float(jnp.vdot(s, y)) > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                if len(self._s_hist) > self.history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+        self._prev_flat_grad = g_old
+
+    def _strong_wolfe(self, closure, x0, d, lr, f0, g0, c1=1e-4, c2=0.9, max_ls=20):
+        """Backtracking line search satisfying (approximate) strong Wolfe."""
+        dg0 = float(jnp.vdot(g0, d))
+        evals = 0
+        t = lr
+        f_prev = float(f0.numpy()) if isinstance(f0, Tensor) else float(f0)
+        for _ in range(max_ls):
+            self._assign_flat(x0 + t * d)
+            for p in self._params():
+                p.clear_grad()
+            loss = closure()
+            evals += 1
+            f_t = float(loss.numpy())
+            g_t = self._gather_grads()
+            if f_t <= f_prev + c1 * t * dg0 and abs(float(jnp.vdot(g_t, d))) <= c2 * abs(dg0):
+                self._update_history(x0, g0, x0 + t * d)
+                return t, loss, g_t, evals
+            t *= 0.5
+        self._update_history(x0, g0, x0 + t * d)
+        return t, loss, g_t, evals
+
+    def _create_slots(self, p):  # pragma: no cover - unused, host-driven
+        return {}
+
+    def _rule(self, p, g, slots, lr, step):  # pragma: no cover
+        raise NotImplementedError

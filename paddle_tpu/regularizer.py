@@ -1,0 +1,34 @@
+"""paddle.regularizer parity (reference: python/paddle/regularizer.py —
+L1Decay/L2Decay attached per-param via ParamAttr or optimizer weight_decay)."""
+import jax.numpy as jnp
+
+from .framework.core import Tensor
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param):
+        d = param._data if isinstance(param, Tensor) else jnp.asarray(param)
+        return Tensor(self.coeff * jnp.sign(d))
+
+    def __repr__(self):
+        return f"L1Decay({self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param):
+        d = param._data if isinstance(param, Tensor) else jnp.asarray(param)
+        return Tensor(self.coeff * d)
+
+    def __repr__(self):
+        return f"L2Decay({self.coeff})"

@@ -1,0 +1,56 @@
+"""paddle.utils parity (reference: python/paddle/utils/ — deprecated
+decorator, try_import, run_check, download stub, unique_name)."""
+import functools
+import importlib
+import warnings
+
+from . import unique_name
+from .lazy_import import try_import
+
+__all__ = ["deprecated", "try_import", "run_check", "unique_name"]
+
+
+def deprecated(update_to="", since="", reason="", level=1):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            if level > 0:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **k)
+
+        return wrapper
+
+    return deco
+
+
+def run_check():
+    """paddle.utils.run_check parity — verify the framework can compile and
+    run a tiny program on the available device(s)."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    net = nn.Linear(4, 4)
+    out = net(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    loss = out.sum()
+    loss.backward()
+    n = len(jax.devices())
+    print(f"PaddleTPU works! Compiled and ran on {n} device(s): "
+          f"{[d.device_kind for d in jax.devices()][:4]}")
+    return True
+
+
+class download:  # namespace shim (reference: paddle.utils.download)
+    @staticmethod
+    def get_weights_path_from_url(url, md5sum=None):
+        raise RuntimeError(
+            "no network egress in this environment; place weights locally and "
+            "pass the path directly"
+        )

@@ -1,0 +1,11 @@
+"""try_import (reference: python/paddle/utils/lazy_import.py)."""
+import importlib
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        if err_msg is None:
+            err_msg = f"{module_name} is required but not installed."
+        raise ImportError(err_msg)

@@ -1,0 +1,30 @@
+"""unique_name (reference: python/paddle/utils/unique_name.py → base/unique_name)."""
+import contextlib
+import threading
+
+_lock = threading.Lock()
+_counters = {}
+
+
+def generate(key):
+    with _lock:
+        n = _counters.get(key, 0)
+        _counters[key] = n + 1
+    return f"{key}_{n}"
+
+
+def switch(new_generator=None):
+    global _counters
+    old = _counters
+    _counters = {}
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        global _counters
+        _counters = old

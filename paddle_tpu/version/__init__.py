@@ -1,0 +1,34 @@
+"""paddle.version parity (reference: generated python/paddle/version/__init__.py)."""
+full_version = "3.0.0-tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+commit = "unknown"
+istaged = False
+with_pip = False
+
+cuda_version = "False"
+cudnn_version = "False"
+tensorrt_version = "False"
+xpu_version = "False"
+
+
+def show():
+    print(f"full_version: {full_version} (TPU-native build; XLA is the compiler)")
+
+
+def cuda():
+    return "False"
+
+
+def cudnn():
+    return "False"
+
+
+def tensorrt():
+    return "False"
+
+
+def xpu():
+    return "False"

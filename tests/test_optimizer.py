@@ -174,3 +174,44 @@ class TestAMP:
         l2, opt2 = paddle.amp.decorate(l, opt, level="O2", dtype="bfloat16")
         assert l2.weight.dtype == paddle.bfloat16
         assert opt2._multi_precision
+
+
+class TestLBFGS:
+    def test_rosenbrock_quadratic_converges(self):
+        """LBFGS with closure minimizes a convex quadratic far faster than
+        first-order steps (reference: test_lbfgs.py)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import optimizer
+
+        paddle.seed(0)
+        target = np.array([1.5, -2.0, 0.7], np.float32)
+        x = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+        opt = optimizer.LBFGS(learning_rate=0.5, max_iter=10, parameters=[x],
+                              line_search_fn="strong_wolfe")
+
+        def closure():
+            d = x - paddle.to_tensor(target)
+            loss = (d * d).sum()
+            loss.backward()
+            return loss
+
+        for _ in range(5):
+            opt.step(closure)
+        np.testing.assert_allclose(np.asarray(x.numpy()), target, atol=1e-3)
+
+    def test_step_without_closure(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import optimizer
+
+        x = paddle.to_tensor(np.array([4.0], np.float32), stop_gradient=False)
+        opt = optimizer.LBFGS(learning_rate=0.1, parameters=[x])
+        for _ in range(30):
+            loss = (x * x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert abs(float(x.numpy()[0])) < 1.0
