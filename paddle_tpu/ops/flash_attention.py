@@ -53,6 +53,36 @@ def _get_pallas_impl():
     return _PALLAS_IMPL
 
 
+_SPLASH_CACHE = {}
+
+
+def _splash_impl(qt, kt, vt, causal, scale):
+    """GQA/MQA-native Pallas splash-attention kernel — kv heads stay
+    unexpanded (the repeat-based fallback materializes hq/hk× more KV)."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm,
+    )
+
+    hq, sq, sk_len = qt.shape[1], qt.shape[2], kt.shape[2]
+    key = (hq, sq, sk_len, causal)
+    kernel = _SPLASH_CACHE.get(key)
+    if kernel is None:
+        mk = sm.CausalMask if causal else (lambda shape: sm.FullMask(shape))
+        mask = sm.MultiHeadMask([mk((sq, sk_len)) for _ in range(hq)])
+        kernel = sk.make_splash_mha(mask=mask, head_shards=1, q_seq_shards=1)
+        _SPLASH_CACHE[key] = kernel
+    out = jax.vmap(kernel)((qt * scale).astype(vt.dtype), kt, vt)
+    return out
+
+
+def _on_tpu():
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
 def flash_attention_fwd(q, k, v, causal=False, scale=None):
     """q/k/v: [batch, seq, heads, head_dim] (paddle layout)."""
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
@@ -60,12 +90,21 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None):
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     hq, hk = qt.shape[1], kt.shape[1]
-    if hq != hk:  # GQA: expand kv heads
+
+    aligned = qt.shape[2] % 128 == 0 and kt.shape[2] % 128 == 0
+    if _on_tpu() and aligned and hq != hk:
+        try:
+            out = _splash_impl(qt, kt, vt, causal, scale)
+            return jnp.swapaxes(out, 1, 2)
+        except Exception:
+            pass  # fall through to expand + flash/XLA
+
+    if hq != hk:  # GQA fallback: expand kv heads
         kt = jnp.repeat(kt, hq // hk, axis=1)
         vt = jnp.repeat(vt, hq // hk, axis=1)
 
     impl = _get_pallas_impl()
-    if impl and qt.shape[2] % 128 == 0 and kt.shape[2] % 128 == 0:
+    if _on_tpu() and impl and aligned:
         out = impl(qt, kt, vt, causal, scale)
     else:
         out = _xla_attention(qt, kt, vt, causal, scale)
