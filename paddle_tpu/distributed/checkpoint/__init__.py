@@ -29,7 +29,30 @@ def _shard_inventory(arr):
     return out
 
 
-def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0, unique_id=None):
+class _AsyncSaveHandle:
+    """Future-like handle for async_save (reference pattern: Orbax-style
+    async checkpointing — device→host transfer happens synchronously so
+    training can mutate weights immediately; serialization runs in a
+    background thread)."""
+
+    def __init__(self, thread):
+        self._thread = thread
+
+    def wait(self, timeout=None):
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("async checkpoint save still running")
+
+    def done(self):
+        return not self._thread.is_alive()
+
+
+_last_async_save = None
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    unique_id=None, async_save=False):
+    global _last_async_save
     os.makedirs(path, exist_ok=True)
     pid = jax.process_index()
     metadata = {"tensors": {}, "world": jax.process_count()}
@@ -44,6 +67,7 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
             if any(s["index"] == idx for s in shards):
                 continue
             key = f"{name}__shard{i}"
+            # device→host copy happens NOW (so async writes see a snapshot)
             blobs[key] = np.asarray(shard.data)
             shards.append({"index": idx, "file": os.path.basename(data_file), "key": key})
         metadata["tensors"][name] = {
@@ -51,10 +75,24 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
             "dtype": str(np.dtype(arr.dtype)),
             "shards": shards,
         }
-    np.savez(data_file, **blobs)
-    if pid == coordinator_rank:
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(metadata, f)
+
+    def _write():
+        np.savez(data_file, **blobs)
+        if pid == coordinator_rank:
+            with open(os.path.join(path, "metadata.json"), "w") as f:
+                json.dump(metadata, f)
+
+    if async_save:
+        import threading
+
+        if _last_async_save is not None and not _last_async_save.done():
+            _last_async_save.wait()  # serialize overlapping saves
+        th = threading.Thread(target=_write, daemon=True)
+        th.start()
+        _last_async_save = _AsyncSaveHandle(th)
+        return _last_async_save
+    _write()
+    return None
 
 
 def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, unique_id=None, offload=False):
