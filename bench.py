@@ -3,13 +3,34 @@
 
 Measures training tokens/sec/chip on a LLaMA-2-shaped proxy sized for one
 chip's HBM, and reports MFU against the BASELINE north star (45% MFU —
-BASELINE.md). MFU = 6·N_params·tokens_per_sec / peak_bf16_flops.
+BASELINE.md). MFU accounting includes the causal-attention quadratic term:
+flops/token = 6*N_params + 12*L*h*s*0.5 (fwd+bwd, causal halves the matrix).
+
+Robustness contract (VERDICT r1 item 1): each ladder rung runs in a child
+process with a wall-clock budget, because an experimental TPU plugin can wedge
+*inside native code* during backend init — no in-process SIGALRM can interrupt
+that. On a rung timeout the backend is treated as wedged and we fall back to a
+CPU-forced rung so a JSON line is ALWAYS printed (parsed must never be null).
 """
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+PROBE_TIMEOUT_S = 90  # backend init alone; a healthy plugin takes seconds
+RUNG_TIMEOUT_S = [600, 420, 420, 360, 360]  # per-rung wall clock (compile+run)
+CPU_FALLBACK_TIMEOUT_S = 420
+
+LADDER = [
+    # (hidden, layers, heads, inter, seq, batch) — descending HBM footprint;
+    # report the largest config that fits the chip
+    dict(hidden=2048, layers=12, heads=16, inter=5504, seq=2048, batch=8),
+    dict(hidden=1536, layers=8, heads=16, inter=4096, seq=2048, batch=4),
+    dict(hidden=1024, layers=8, heads=16, inter=2816, seq=1024, batch=8),
+    dict(hidden=768, layers=6, heads=12, inter=2048, seq=1024, batch=4),
+    dict(hidden=512, layers=4, heads=8, inter=1408, seq=512, batch=4),
+]
 
 
 def peak_flops_per_chip():
@@ -30,6 +51,8 @@ def peak_flops_per_chip():
 
 
 def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, batch=8, steps=8):
+    import numpy as np
+
     import jax
 
     import paddle_tpu as paddle
@@ -56,7 +79,16 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
     model.bfloat16()
     n_params = model.num_parameters()
     opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01)
-    step = TrainStep(model, lambda *a: LlamaPretrainingCriterion()(*a), opt)
+    # throughput/MFU flows through the framework's step-metrics bus (SURVEY §5)
+    from paddle_tpu.utils.metrics_bus import StepMetricsBus
+
+    bus = StepMetricsBus(
+        tokens_per_step=batch * seq,
+        flops_per_token=LlamaForCausalLM.flops_per_token(cfg, seq_len=seq),
+        peak_flops=peak_flops_per_chip(),
+        log_every=steps, skip_first=2,
+    )
+    step = TrainStep(model, lambda *a: LlamaPretrainingCriterion()(*a), opt, metrics_bus=bus)
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, vocab, (batch, seq + 1)).astype(np.int32)
@@ -73,9 +105,14 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
     float(loss.numpy())  # sync
     dt = (time.perf_counter() - t0) / steps
 
+    from paddle_tpu.ops import flash_attention as fa
+
     tokens_per_sec = batch * seq / dt
-    mfu = 6.0 * n_params * tokens_per_sec / peak_flops_per_chip()
-    result = {
+    # one authoritative flops/token accounting (GQA-aware 6N + causal
+    # attention quadratic term) — same formula the bus uses
+    flops_per_token = LlamaForCausalLM.flops_per_token(cfg, seq_len=seq)
+    mfu = flops_per_token * tokens_per_sec / peak_flops_per_chip()
+    return {
         "metric": "tokens_per_sec_per_chip_llama_proxy",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
@@ -86,33 +123,99 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
             "step_time_s": round(dt, 4),
             "config": f"h{hidden}-L{layers}-a{heads}-i{inter}-v{vocab}-s{seq}-b{batch}",
             "backend": jax.default_backend(),
+            "attn_impl": fa.LAST_IMPL or "math-xla",
             "final_loss": round(float(loss.numpy()), 4),
+            "bus": {k: round(v, 4) for k, v in bus.summary().items()},
         },
     }
-    return result
 
 
-LADDER = [
-    # (hidden, layers, heads, inter, seq, batch) — descending HBM footprint;
-    # report the largest config that fits the chip
-    dict(hidden=2048, layers=12, heads=16, inter=5504, seq=2048, batch=8),
-    dict(hidden=1536, layers=8, heads=16, inter=4096, seq=2048, batch=4),
-    dict(hidden=1024, layers=8, heads=16, inter=2816, seq=1024, batch=8),
-    dict(hidden=768, layers=6, heads=12, inter=2048, seq=1024, batch=4),
-    dict(hidden=512, layers=4, heads=8, inter=1408, seq=512, batch=4),
-]
+def _child_main(rung_idx, force_cpu=False):
+    """Run one ladder rung; ALWAYS print a JSON line (rc 0)."""
+    if force_cpu:
+        # env JAX_PLATFORMS=cpu alone does NOT stop an experimental PJRT
+        # plugin from initializing (verified on axon); the config update does.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
 
-if __name__ == "__main__":
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        res = run(**LADDER[rung_idx])
+    except Exception as e:  # noqa: BLE001 — report, never crash silently
+        res = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    print(json.dumps(res), flush=True)
+
+
+def _run_rung(rung_idx, timeout_s, force_cpu=False):
+    """Spawn a rung child; returns (result_dict | None, timed_out)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--rung", str(rung_idx)]
+    if force_cpu:
+        cmd.append("--cpu")
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return None, True
+    for line in reversed(proc.stdout.strip().splitlines() or []):
+        try:
+            return json.loads(line), False
+        except json.JSONDecodeError:
+            continue
+    tail = (proc.stderr or "")[-200:]
+    return {"error": f"rung exited rc={proc.returncode} with no JSON; stderr tail: {tail}"}, False
+
+
+def _probe_backend():
+    """Cheap child that just initializes the default jax backend. Returns
+    False if it hangs (wedged plugin) — saving the full rung-0 budget."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend(), len(jax.devices()))"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+        print(f"[bench] backend probe: {proc.stdout.strip()!r} rc={proc.returncode}",
+              file=sys.stderr, flush=True)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        print(f"[bench] backend probe hung >{PROBE_TIMEOUT_S}s — backend wedged",
+              file=sys.stderr, flush=True)
+        return False
+
+
+def main():
     errors = []
     res = None
-    for i, cfg in enumerate(LADDER):
-        try:
-            res = run(**cfg)
+    wedged = not _probe_backend()
+    if wedged:
+        errors.append(f"backend probe hung >{PROBE_TIMEOUT_S}s")
+    for i in range(len(LADDER) if not wedged else 0):
+        print(f"[bench] rung {i}: {LADDER[i]}", file=sys.stderr, flush=True)
+        out, timed_out = _run_rung(i, RUNG_TIMEOUT_S[i])
+        if timed_out:
+            errors.append(f"rung{i}: timeout>{RUNG_TIMEOUT_S[i]}s (backend wedged?)")
+            wedged = True
+            break  # same backend would wedge every rung — go straight to CPU
+        if out is not None and "error" not in out:
+            res = out
             if i:
-                res["extra"]["note"] = f"ladder rung {i} after: {'; '.join(errors)}"
+                res.setdefault("extra", {})["note"] = f"ladder rung {i} after: {'; '.join(errors)}"
             break
-        except Exception as e:
-            errors.append(f"{type(e).__name__}: {str(e)[:120]}")
+        errors.append(f"rung{i}: {out.get('error', 'unknown')[:160]}")
+    if res is None:
+        print("[bench] falling back to CPU-forced rung", file=sys.stderr, flush=True)
+        out, timed_out = _run_rung(0, CPU_FALLBACK_TIMEOUT_S, force_cpu=True)
+        if not timed_out and out is not None and "error" not in out:
+            res = out
+            res.setdefault("extra", {})["note"] = (
+                ("tpu backend wedged; " if wedged else "") + f"cpu fallback after: {'; '.join(errors)}"
+            )
+        elif timed_out:
+            errors.append(f"cpu fallback: timeout>{CPU_FALLBACK_TIMEOUT_S}s")
+        else:
+            errors.append(f"cpu fallback: {out.get('error', 'unknown')[:160]}")
     if res is None:
         res = {
             "metric": "tokens_per_sec_per_chip_llama_proxy",
@@ -121,4 +224,11 @@ if __name__ == "__main__":
             "vs_baseline": 0.0,
             "error": " | ".join(errors),
         }
-    print(json.dumps(res))
+    print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 3 and sys.argv[1] == "--rung":
+        _child_main(int(sys.argv[2]), force_cpu="--cpu" in sys.argv)
+    else:
+        main()

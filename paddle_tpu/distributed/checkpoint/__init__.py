@@ -16,6 +16,23 @@ import numpy as np
 from ...framework.core import Tensor, to_tensor
 
 
+_UINT_FOR_WIDTH = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def _to_savable(a):
+    """np.savez round-trips ml_dtypes (bfloat16/fp8) as raw void — store a
+    same-width uint view instead; metadata's dtype tag restores it on load."""
+    if a.dtype.kind == "V" or a.dtype.type.__module__ == "ml_dtypes":
+        return np.ascontiguousarray(a).view(_UINT_FOR_WIDTH[a.dtype.itemsize])
+    return a
+
+
+def _from_savable(a, target_dtype):
+    if a.dtype != target_dtype and a.dtype.kind in "uV":
+        return a.view(target_dtype)
+    return a
+
+
 def _shard_inventory(arr):
     """[(index_slices, device_str)] for every addressable shard."""
     out = []
@@ -68,7 +85,7 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                 continue
             key = f"{name}__shard{i}"
             # device→host copy happens NOW (so async writes see a snapshot)
-            blobs[key] = np.asarray(shard.data)
+            blobs[key] = _to_savable(np.asarray(shard.data))
             shards.append({"index": idx, "file": os.path.basename(data_file), "key": key})
         metadata["tensors"][name] = {
             "global_shape": list(arr.shape),
@@ -115,7 +132,7 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
         full = np.zeros(info["global_shape"], dt)
         for shard in info["shards"]:
             arch = archives[shard["file"]]
-            block = arch[shard["key"]]
+            block = _from_savable(arch[shard["key"]], np.dtype(dt))
             slices = tuple(slice(a, b) for a, b in shard["index"])
             full[slices] = block
         target = t._data.sharding if hasattr(t._data, "sharding") else None

@@ -17,6 +17,22 @@ from multiprocessing.connection import Client, Listener
 _AUTH = b"paddle-tpu-rpc"
 
 
+def _advertise_ip(world_size):
+    """Routable address peers should dial: the launcher's endpoint env when
+    set, else the host's resolved address; loopback only for single-host."""
+    if world_size <= 1:
+        return "127.0.0.1"
+    ep = os.environ.get("PADDLE_CURRENT_ENDPOINT")
+    if ep:
+        return ep.rsplit(":", 1)[0]
+    import socket
+
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
 class WorkerInfo:
     def __init__(self, name, rank, ip, port):
         self.name, self.rank, self.ip, self.port = name, rank, ip, port
@@ -65,12 +81,16 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     global _current, _listener, _serving, _pool
     rank = int(rank if rank is not None else os.environ.get("PADDLE_TRAINER_ID", 0))
     world_size = int(world_size if world_size is not None else os.environ.get("PADDLE_TRAINERS_NUM", 1))
-    _listener = Listener(("127.0.0.1", 0), authkey=_AUTH)
+    # bind all interfaces so cross-host peers can reach us; advertise a
+    # routable address (endpoint env or resolved hostname), falling back to
+    # loopback for single-host runs
+    bind_ip = "127.0.0.1" if world_size <= 1 else "0.0.0.0"
+    _listener = Listener((bind_ip, 0), authkey=_AUTH)
     port = _listener.address[1]
     _serving = threading.Thread(target=_serve, args=(_listener,), daemon=True)
     _serving.start()
     _pool = _fut.ThreadPoolExecutor(max_workers=8)
-    _current = WorkerInfo(name, rank, "127.0.0.1", port)
+    _current = WorkerInfo(name, rank, _advertise_ip(world_size), port)
     _workers.clear()
     _workers[name] = _current
     if world_size > 1:
@@ -84,7 +104,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         host, p = ep.rsplit(":", 1)
         store = TCPStore(host, int(p), is_master=(rank == 0), world_size=world_size)
         _state.store = store
-        store.set(f"rpc/{rank}", pickle.dumps((name, rank, "127.0.0.1", port)))
+        store.set(f"rpc/{rank}", pickle.dumps((name, rank, _current.ip, port)))
         for r in range(world_size):
             raw = store.get(f"rpc/{r}")  # blocking
             n, rr, ip, pp = pickle.loads(raw)

@@ -61,11 +61,12 @@ class DistributedTrainStep(TrainStep):
     grad reduce-scatter), 3 = also shard parameters (FSDP)."""
 
     def __init__(self, model, loss_fn, optimizer, n_labels=1, scaler=None, mesh=None,
-                 sharding_stage=1, batch_axes=("dp", "sharding")):
+                 sharding_stage=1, batch_axes=("dp", "sharding"), metrics_bus=None):
         self.mesh = mesh if mesh is not None else get_mesh()
         self.sharding_stage = sharding_stage
         self.batch_axes = batch_axes
-        super().__init__(model, loss_fn, optimizer, n_labels=n_labels, scaler=scaler)
+        super().__init__(model, loss_fn, optimizer, n_labels=n_labels, scaler=scaler,
+                         metrics_bus=metrics_bus)
         self._place_state()
 
     # -- sharding construction ----------------------------------------------
@@ -190,4 +191,10 @@ class DistributedTrainStep(TrainStep):
         if sched is not None:
             sched.step()
         self.optimizer._global_step += 1
+        if self.metrics_bus is not None:
+            if self.metrics_bus.tokens_per_step is None and batch_datas:
+                import math
+
+                self.metrics_bus.tokens_per_step = int(math.prod(batch_datas[0].shape))
+            self.metrics_bus.on_step(loss=loss)
         return Tensor(loss)

@@ -3,7 +3,10 @@ from .callbacks import (
     CallbackList,
     EarlyStopping,
     LRScheduler,
+    MetricsBusCallback,
     ModelCheckpoint,
     ProgBarLogger,
+    TensorBoard,
+    VisualDL,
 )
 from .model import Model, summary

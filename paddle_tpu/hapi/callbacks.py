@@ -224,3 +224,80 @@ class VisualDL(Callback):
     def on_train_end(self, logs=None):
         if self._f:
             self._f.close()
+
+
+class TensorBoard(Callback):
+    """TensorBoard scalar logging for Model.fit via the self-contained
+    tfevents writer (utils/tensorboard.py); per-batch loss + per-epoch
+    metrics land under `train/` and `epoch/` tags."""
+
+    def __init__(self, log_dir="./runs", log_freq=10):
+        super().__init__()
+        self.log_dir = log_dir
+        self.log_freq = log_freq
+        self._writer = None
+        self._global_step = 0
+
+    def _w(self):
+        if self._writer is None:
+            from ..utils.tensorboard import SummaryWriter
+
+            self._writer = SummaryWriter(self.log_dir)
+        return self._writer
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        if self._global_step % self.log_freq:
+            return
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)):
+                v = v[0] if v else None
+            if isinstance(v, numbers.Number):
+                self._w().add_scalar(f"train/{k}", v, self._global_step)
+        self._w().flush()
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)):
+                v = v[0] if v else None
+            if isinstance(v, numbers.Number):
+                self._w().add_scalar(f"epoch/{k}", v, epoch)
+        self._w().flush()
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+
+
+class MetricsBusCallback(Callback):
+    """Routes Model.fit batches through the step-metrics bus (SURVEY.md §5:
+    loss/throughput/memory observability). tokens_per_sample converts
+    sample throughput to token throughput for LM training."""
+
+    def __init__(self, bus=None, log_every=10, tensorboard_dir=None, jsonl_path=None,
+                 tokens_per_sample=None):
+        super().__init__()
+        from ..utils.metrics_bus import JsonlWriter, StepMetricsBus, stdout_logger
+
+        self.tokens_per_sample = tokens_per_sample
+        if bus is not None:
+            # caller-provided bus: its sinks are the caller's business
+            self.bus = bus
+            return
+        self.bus = StepMetricsBus(log_every=log_every, skip_first=1)
+        self.bus.subscribe(stdout_logger())
+        if jsonl_path:
+            self.bus.subscribe(JsonlWriter(jsonl_path))
+        if tensorboard_dir:
+            from ..utils.tensorboard import SummaryWriter
+
+            self.bus.subscribe(SummaryWriter(tensorboard_dir))
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        loss = logs.get("loss")
+        if isinstance(loss, (list, tuple)):
+            loss = loss[0] if loss else None
+        bs = logs.get("batch_size", 1)
+        tokens = bs * (self.tokens_per_sample or 1)
+        self.bus.on_step(loss=loss, tokens=tokens)

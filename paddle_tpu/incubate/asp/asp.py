@@ -44,8 +44,10 @@ class ASPHelper:
             for part in owner.split(".") if owner else []:
                 sub = getattr(sub, part)
         except AttributeError:
-            return True
-        return type(sub) in _SUPPORTED_TYPES or not isinstance(sub, object.__class__)
+            return False  # can't resolve owner layer → don't prune blindly
+        # prune only FC/Conv weights (reference ASP supported-layer set);
+        # embeddings/norms etc. must never be 2:4-pruned
+        return any(isinstance(sub, t) for t in _SUPPORTED_TYPES)
 
     @classmethod
     def prune_model(cls, model, n=2, m=4, mask_algo=MaskAlgo.MASK_1D, with_mask=True):

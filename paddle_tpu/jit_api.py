@@ -100,12 +100,14 @@ class TrainStep:
     loss_fn(outputs, *labels) -> scalar Tensor.
     """
 
-    def __init__(self, model, loss_fn, optimizer, n_labels=1, scaler=None, mesh_shardings=None):
+    def __init__(self, model, loss_fn, optimizer, n_labels=1, scaler=None, mesh_shardings=None,
+                 metrics_bus=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.n_labels = n_labels
         self.scaler = scaler
+        self.metrics_bus = metrics_bus
 
         self._trainable = {
             k: p for k, p in dict(model.named_parameters()).items() if not p.stop_gradient
@@ -191,4 +193,10 @@ class TrainStep:
         if sched is not None:
             sched.step()
         self.optimizer._global_step += 1
+        if self.metrics_bus is not None:
+            if self.metrics_bus.tokens_per_step is None and batch_data:
+                import math
+
+                self.metrics_bus.tokens_per_step = int(math.prod(batch_data[0].shape))
+            self.metrics_bus.on_step(loss=loss)
         return Tensor(loss)

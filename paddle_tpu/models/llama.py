@@ -289,14 +289,20 @@ class LlamaForCausalLM(Layer):
 
     def forward(self, input_ids, attention_mask=None, position_ids=None, labels=None):
         h = self.llama(input_ids, attention_mask, position_ids)
-        if self.config.fuse_linear_cross_entropy and labels is None:
+        if self.config.fuse_linear_cross_entropy and (labels is not None or self.training):
             # hand (hidden, lm weight) to the fused CE so [B,S,vocab] logits
-            # are never materialized (incubate fused_linear_cross_entropy)
+            # are never materialized (incubate fused_linear_cross_entropy);
+            # eval/generation calls (labels=None, not training) fall through
+            # to the logits path below
             if self.lm_head is not None:
-                return h, self.lm_head.weight
-            from ..tensor import linalg
+                w = self.lm_head.weight
+            else:
+                from ..tensor import linalg
 
-            return h, linalg.t(self.llama.embed_tokens.weight)
+                w = linalg.t(self.llama.embed_tokens.weight)
+            if labels is not None:
+                return LlamaPretrainingCriterion()(h, w, labels)
+            return h, w
         if self.lm_head is not None:
             logits = self.lm_head(h)
         else:
@@ -313,14 +319,25 @@ class LlamaForCausalLM(Layer):
         return int(sum(np.prod(p.shape) for p in self.parameters()))
 
     @staticmethod
-    def flops_per_token(config):
-        """6*N approximation + attention quadratic term."""
+    def flops_per_token(config, seq_len=None, causal=True):
+        """Training matmul FLOPs per token: 6*N (GQA-aware) plus the
+        attention quadratic term 12*L*h*s (halved when causal — that is
+        what the flash/splash kernels actually compute)."""
+        h = config.hidden_size
+        kv_heads = getattr(config, "num_key_value_heads", None) or config.num_attention_heads
+        head_dim = h // config.num_attention_heads
+        kv_dim = kv_heads * head_dim
         n = (
-            config.vocab_size * config.hidden_size * (1 if config.tie_word_embeddings else 2)
+            config.vocab_size * h * (1 if config.tie_word_embeddings else 2)
             + config.num_hidden_layers
             * (
-                4 * config.hidden_size * config.hidden_size  # qkvo (approx, GQA ignored)
-                + 3 * config.hidden_size * config.intermediate_size
+                2 * h * h  # q + o projections
+                + 2 * h * kv_dim  # k + v projections (GQA-reduced)
+                + 3 * h * config.intermediate_size  # gate/up/down
             )
         )
-        return 6 * n
+        flops = 6 * n
+        if seq_len is not None:
+            attn = 12.0 * config.num_hidden_layers * h * seq_len
+            flops += attn * (0.5 if causal else 1.0)
+        return flops

@@ -17,6 +17,11 @@ import jax.numpy as jnp
 
 _PALLAS_IMPL = None
 
+# Which attention impl was selected at last trace ("splash" | "pallas" | "xla").
+# Selection happens at trace time (shapes are static under jit), so this is an
+# accurate record of what the compiled program runs; bench.py reports it.
+LAST_IMPL = None
+
 
 def _get_pallas_impl():
     global _PALLAS_IMPL
@@ -85,6 +90,7 @@ def _on_tpu():
 
 def flash_attention_fwd(q, k, v, causal=False, scale=None):
     """q/k/v: [batch, seq, heads, head_dim] (paddle layout)."""
+    global LAST_IMPL
     scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
@@ -95,6 +101,7 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None):
     if _on_tpu() and aligned and hq != hk:
         try:
             out = _splash_impl(qt, kt, vt, causal, scale)
+            LAST_IMPL = "splash"
             return jnp.swapaxes(out, 1, 2)
         except Exception:
             pass  # fall through to expand + flash/XLA
@@ -106,8 +113,10 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None):
     impl = _get_pallas_impl()
     if _on_tpu() and impl and aligned:
         out = impl(qt, kt, vt, causal, scale)
+        LAST_IMPL = "pallas"
     else:
         out = _xla_attention(qt, kt, vt, causal, scale)
+        LAST_IMPL = "xla"
     return jnp.swapaxes(out, 1, 2)
 
 

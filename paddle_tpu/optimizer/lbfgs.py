@@ -26,6 +26,7 @@ class LBFGS(Optimizer):
         self.line_search_fn = line_search_fn
         self._s_hist = []
         self._y_hist = []
+        self._prev_x = None
         self._prev_flat_grad = None
 
     # -- flat helpers ------------------------------------------------------
@@ -75,11 +76,15 @@ class LBFGS(Optimizer):
         using the grads already on the parameters."""
         if closure is None:
             grad = self._gather_grads()
-            d = self._direction(grad)
             x0 = self._gather_params()
+            # secant pairs span successive step() calls here: pair the
+            # previous (x, g) with the freshly computed (x, g)
+            if self._prev_flat_grad is not None:
+                self._update_history(self._prev_x, self._prev_flat_grad, x0, grad)
+            d = self._direction(grad)
             lr = float(self.get_lr())
-            self._update_history(x0, grad, x0 + lr * d)
             self._assign_flat(x0 + lr * d)
+            self._prev_x, self._prev_flat_grad = x0, grad
             self._global_step += 1
             return None
 
@@ -101,7 +106,7 @@ class LBFGS(Optimizer):
                     p.clear_grad()
                 loss = closure()
                 grad_new = self._gather_grads()
-                self._update_history(x0, grad, self._gather_params())
+                self._update_history(x0, grad, self._gather_params(), grad_new)
                 grad = grad_new
                 evals += 1
             if evals >= self.max_eval:
@@ -112,19 +117,16 @@ class LBFGS(Optimizer):
         self._global_step += 1
         return loss
 
-    def _update_history(self, x_old, g_old, x_new):
+    def _update_history(self, x_old, g_old, x_new, g_new):
+        # secant condition: pair s_k = x_{k+1} - x_k with y_k = g_{k+1} - g_k
         s = x_new - x_old
-        # y computed lazily on next step in closure mode; here use curvature
-        # of current grad state if available
-        if self._prev_flat_grad is not None:
-            y = g_old - self._prev_flat_grad
-            if float(jnp.vdot(s, y)) > 1e-10:
-                self._s_hist.append(s)
-                self._y_hist.append(y)
-                if len(self._s_hist) > self.history_size:
-                    self._s_hist.pop(0)
-                    self._y_hist.pop(0)
-        self._prev_flat_grad = g_old
+        y = g_new - g_old
+        if float(jnp.vdot(s, y)) > 1e-10:  # curvature guard keeps H_k PD
+            self._s_hist.append(s)
+            self._y_hist.append(y)
+            if len(self._s_hist) > self.history_size:
+                self._s_hist.pop(0)
+                self._y_hist.pop(0)
 
     def _strong_wolfe(self, closure, x0, d, lr, f0, g0, c1=1e-4, c2=0.9, max_ls=20):
         """Backtracking line search satisfying (approximate) strong Wolfe."""
@@ -141,11 +143,14 @@ class LBFGS(Optimizer):
             f_t = float(loss.numpy())
             g_t = self._gather_grads()
             if f_t <= f_prev + c1 * t * dg0 and abs(float(jnp.vdot(g_t, d))) <= c2 * abs(dg0):
-                self._update_history(x0, g0, x0 + t * d)
+                self._update_history(x0, g0, x0 + t * d, g_t)
                 return t, loss, g_t, evals
+            t_eval = t  # params/loss/grad all correspond to this step size
             t *= 0.5
-        self._update_history(x0, g0, x0 + t * d)
-        return t, loss, g_t, evals
+        # exhausted: report the LAST EVALUATED point (params are still there)
+        # so the secant pair and returned step stay mutually consistent
+        self._update_history(x0, g0, x0 + t_eval * d, g_t)
+        return t_eval, loss, g_t, evals
 
     def _create_slots(self, p):  # pragma: no cover - unused, host-driven
         return {}

@@ -1,111 +1,127 @@
 """paddle.signal parity (reference: python/paddle/signal.py — stft/istft over
-frame/overlap_add ops in phi/kernels/frame_kernel.*)."""
+frame/overlap_add ops in phi/kernels/frame_kernel.*).
+
+All ops route through framework.core.apply so they record tape nodes and
+gradients flow to the input signal (and window), matching the reference's
+differentiable signal ops.
+"""
 import jax.numpy as jnp
 
-from .audio import functional as AF
-from .framework.core import Tensor
+from .framework.core import Tensor, apply, to_tensor
 
 
-def _d(x):
-    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
 
 
 def frame(x, frame_length, hop_length, axis=-1, name=None):
     """Slice x into overlapping frames along `axis` (reference: signal.frame)."""
-    xd = _d(x)
-    if axis not in (-1, xd.ndim - 1):
-        xd = jnp.moveaxis(xd, axis, -1)
-    n_frames = 1 + (xd.shape[-1] - frame_length) // hop_length
-    idx = jnp.arange(frame_length)[None, :] + hop_length * jnp.arange(n_frames)[:, None]
-    out = xd[..., idx]  # [..., n_frames, frame_length]
-    out = jnp.swapaxes(out, -1, -2)  # paddle layout: [..., frame_length, n_frames]
-    if axis not in (-1, xd.ndim - 1):
-        out = jnp.moveaxis(out, -1, axis)
-    return Tensor(out)
+
+    def fn(xd):
+        moved = axis not in (-1, xd.ndim - 1)
+        if moved:
+            xd = jnp.moveaxis(xd, axis, -1)
+        n_frames = 1 + (xd.shape[-1] - frame_length) // hop_length
+        idx = jnp.arange(frame_length)[None, :] + hop_length * jnp.arange(n_frames)[:, None]
+        out = xd[..., idx]  # [..., n_frames, frame_length]
+        out = jnp.swapaxes(out, -1, -2)  # paddle layout: [..., frame_length, n_frames]
+        if moved:
+            out = jnp.moveaxis(out, -1, axis)
+        return out
+
+    return apply(fn, _t(x), name="frame")
 
 
 def overlap_add(x, hop_length, axis=-1, name=None):
     """Inverse of frame (reference: signal.overlap_add). x: [..., frame_length,
     n_frames] (axis=-1) → [..., output_len]."""
-    xd = _d(x)
-    if axis not in (-1, xd.ndim - 1):
-        xd = jnp.moveaxis(xd, axis, -1)
-    frame_length, n_frames = xd.shape[-2], xd.shape[-1]
-    out_len = frame_length + hop_length * (n_frames - 1)
-    batch = xd.shape[:-2]
-    out = jnp.zeros(batch + (out_len,), xd.dtype)
-    idx = jnp.arange(frame_length)[None, :] + hop_length * jnp.arange(n_frames)[:, None]
-    # scatter-add each frame at its offset
-    out = out.at[..., idx].add(jnp.swapaxes(xd, -1, -2))
-    return Tensor(out)
+
+    def fn(xd):
+        if axis not in (-1, xd.ndim - 1):
+            xd = jnp.moveaxis(xd, axis, -1)
+        frame_length, n_frames = xd.shape[-2], xd.shape[-1]
+        out_len = frame_length + hop_length * (n_frames - 1)
+        batch = xd.shape[:-2]
+        out = jnp.zeros(batch + (out_len,), xd.dtype)
+        idx = jnp.arange(frame_length)[None, :] + hop_length * jnp.arange(n_frames)[:, None]
+        # scatter-add each frame at its offset
+        return out.at[..., idx].add(jnp.swapaxes(xd, -1, -2))
+
+    return apply(fn, _t(x), name="overlap_add")
 
 
 def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
          pad_mode="reflect", normalized=False, onesided=True, name=None):
     """reference: paddle.signal.stft. x: [B, T] or [T]. Returns complex
     [B, n_fft//2+1, n_frames] (onesided)."""
-    xd = _d(x)
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
-    if window is None:
-        win = jnp.ones(win_length, jnp.float32)
-    else:
-        win = _d(window).astype(jnp.float32)
-    if win_length < n_fft:
-        lpad = (n_fft - win_length) // 2
-        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
-    if center:
-        pad = n_fft // 2
-        xd = jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(pad, pad)], mode=pad_mode)
-    n_frames = 1 + (xd.shape[-1] - n_fft) // hop_length
-    idx = jnp.arange(n_fft)[None, :] + hop_length * jnp.arange(n_frames)[:, None]
-    frames = xd[..., idx] * win  # [..., n_frames, n_fft]
-    if onesided:
-        spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
-    else:
-        spec = jnp.fft.fft(frames, n=n_fft, axis=-1)
-    if normalized:
-        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
-    return Tensor(jnp.moveaxis(spec, -1, -2))
+
+    def fn(xd, win):
+        win = win.astype(jnp.float32)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+        if center:
+            pad = n_fft // 2
+            xd = jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(pad, pad)], mode=pad_mode)
+        n_frames = 1 + (xd.shape[-1] - n_fft) // hop_length
+        idx = jnp.arange(n_fft)[None, :] + hop_length * jnp.arange(n_frames)[:, None]
+        frames = xd[..., idx] * win  # [..., n_frames, n_fft]
+        if onesided:
+            spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, n=n_fft, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.moveaxis(spec, -1, -2)
+
+    win_t = _t(window) if window is not None else Tensor(
+        jnp.ones(win_length, jnp.float32), stop_gradient=True
+    )
+    return apply(fn, _t(x), win_t, name="stft")
 
 
 def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
           normalized=False, onesided=True, length=None, return_complex=False, name=None):
     """reference: paddle.signal.istft — WOLA reconstruction."""
-    sd = _d(x)  # [..., freq, frames]
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
-    if window is None:
-        win = jnp.ones(win_length, jnp.float32)
-    else:
-        win = _d(window).astype(jnp.float32)
-    if win_length < n_fft:
-        lpad = (n_fft - win_length) // 2
-        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
-    spec = jnp.moveaxis(sd, -2, -1)  # [..., frames, freq]
-    if normalized:
-        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
-    if onesided:
-        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
-    else:
-        frames = jnp.fft.ifft(spec, axis=-1)
-        if not return_complex:
-            frames = frames.real
-    frames = frames * win
-    n_frames = frames.shape[-2]
-    out_len = n_fft + hop_length * (n_frames - 1)
-    batch = frames.shape[:-2]
-    out = jnp.zeros(batch + (out_len,), frames.dtype)
-    idx = jnp.arange(n_fft)[None, :] + hop_length * jnp.arange(n_frames)[:, None]
-    out = out.at[..., idx].add(frames)
-    # WOLA normalization: divide by summed squared window
-    wsq = jnp.zeros(out_len, jnp.float32).at[idx.reshape(-1)].add(
-        jnp.tile(win**2, n_frames)
+
+    def fn(sd, win):
+        win = win.astype(jnp.float32)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+        spec = jnp.moveaxis(sd, -2, -1)  # [..., frames, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * win
+        n_frames = frames.shape[-2]
+        out_len = n_fft + hop_length * (n_frames - 1)
+        batch = frames.shape[:-2]
+        out = jnp.zeros(batch + (out_len,), frames.dtype)
+        idx = jnp.arange(n_fft)[None, :] + hop_length * jnp.arange(n_frames)[:, None]
+        out = out.at[..., idx].add(frames)
+        # WOLA normalization: divide by summed squared window
+        wsq = jnp.zeros(out_len, jnp.float32).at[idx.reshape(-1)].add(
+            jnp.tile(win**2, n_frames)
+        )
+        out = out / jnp.maximum(wsq, 1e-10)
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:-pad] if out.shape[-1] > 2 * pad else out
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    win_t = _t(window) if window is not None else Tensor(
+        jnp.ones(win_length, jnp.float32), stop_gradient=True
     )
-    out = out / jnp.maximum(wsq, 1e-10)
-    if center:
-        pad = n_fft // 2
-        out = out[..., pad:-pad] if out.shape[-1] > 2 * pad else out
-    if length is not None:
-        out = out[..., :length]
-    return Tensor(out)
+    return apply(fn, _t(x), win_t, name="istft")

@@ -1,0 +1,141 @@
+"""Step-metrics bus (SURVEY.md §5 metrics row: "step-metrics callback bus
+(loss/MFU/tokens-per-sec)"). BASELINE's primary metric is tokens/sec/chip;
+this is the framework component that computes and publishes it.
+
+Design: the hot path stays async — `on_step` only stamps host wall-clock and
+holds the (un-synced) loss array. Every `log_every` steps the bus syncs once,
+computes throughput/MFU/memory, and fans the record out to subscribers
+(stdout logger, JSONL, TensorBoard SummaryWriter, user callbacks).
+"""
+import json
+import logging
+import os
+import time
+
+logger = logging.getLogger("paddle_tpu.metrics")
+
+
+def device_peak_memory():
+    try:
+        from ..device import memory_stats
+
+        return int(memory_stats().get("peak_bytes_in_use", 0))
+    except Exception:
+        return 0
+
+
+class StepMetricsBus:
+    """Publish/subscribe bus for per-step training metrics.
+
+    tokens_per_step: tokens processed per optimizer step (batch*seq), enables
+        tokens/sec. flops_per_token + peak_flops enable MFU.
+    """
+
+    def __init__(self, tokens_per_step=None, flops_per_token=None, peak_flops=None,
+                 log_every=10, skip_first=1):
+        self.tokens_per_step = tokens_per_step
+        self.flops_per_token = flops_per_token
+        self.peak_flops = peak_flops
+        self.log_every = max(1, log_every)
+        self.skip_first = skip_first  # first step(s) include compile time
+        self._subs = []
+        self._step = 0
+        self._last_emit_t = None
+        self._last_emit_step = 0
+        self._pending_loss = None
+        self._intervals = []  # (steps, seconds) since previous emission
+        self._t0 = None
+
+    def subscribe(self, fn):
+        """fn(record: dict) — called at each emission."""
+        self._subs.append(fn)
+        return fn
+
+    def on_step(self, loss=None, tokens=None):
+        """Cheap host-side hook; call once per optimizer step. `loss` may be a
+        Tensor/jax.Array — it is only synced at emission time."""
+        now = time.perf_counter()
+        self._step += 1
+        self._pending_loss = loss
+        if tokens is not None:
+            self.tokens_per_step = tokens
+        if self._step <= self.skip_first:
+            # warmup/compile steps: restart the timing window after them
+            self._last_emit_t = now
+            self._last_emit_step = self._step
+            return
+        if self._t0 is None:
+            self._t0 = now
+        if self._last_emit_t is None:
+            self._last_emit_t = now
+            self._last_emit_step = self._step
+            return
+        if (self._step - self._last_emit_step) >= self.log_every:
+            self._emit(now)
+
+    def _emit(self, now):
+        steps = self._step - self._last_emit_step
+        dt = now - self._last_emit_t
+        if steps <= 0 or dt <= 0:
+            return
+        step_time = dt / steps
+        record = {"step": self._step, "step_time_s": round(step_time, 6)}
+        if self._pending_loss is not None:
+            try:
+                loss = self._pending_loss
+                record["loss"] = float(loss.numpy() if hasattr(loss, "numpy") else loss)
+            except Exception:
+                pass
+        if self.tokens_per_step:
+            tps = self.tokens_per_step / step_time
+            record["tokens_per_sec"] = round(tps, 2)
+            if self.flops_per_token and self.peak_flops:
+                record["mfu"] = round(self.flops_per_token * tps / self.peak_flops, 4)
+        mem = device_peak_memory()
+        if mem:
+            record["peak_memory_bytes"] = mem
+        self._intervals.append((steps, dt))
+        self._last_emit_t = now
+        self._last_emit_step = self._step
+        for fn in self._subs:
+            try:
+                fn(record)
+            except Exception:  # a broken sink must not kill training
+                logger.exception("metrics subscriber failed")
+
+    def summary(self):
+        """Aggregate over all post-warmup emissions: steps/sec, tokens/sec, MFU."""
+        total_steps = sum(s for s, _ in self._intervals)
+        total_dt = sum(d for _, d in self._intervals)
+        if not total_steps or not total_dt:
+            return {}
+        step_time = total_dt / total_steps
+        out = {"steps": total_steps, "step_time_s": step_time}
+        if self.tokens_per_step:
+            out["tokens_per_sec"] = self.tokens_per_step / step_time
+            if self.flops_per_token and self.peak_flops:
+                out["mfu"] = self.flops_per_token * out["tokens_per_sec"] / self.peak_flops
+        return out
+
+
+def stdout_logger(prefix="step"):
+    def fn(record):
+        parts = " ".join(f"{k}={v}" for k, v in record.items())
+        logger.info("%s %s", prefix, parts)
+
+    return fn
+
+
+class JsonlWriter:
+    """Structured per-rank metrics log (SURVEY.md §5: per-rank workerlog.N)."""
+
+    def __init__(self, path):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a")
+
+    def __call__(self, record):
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def close(self):
+        self._f.close()

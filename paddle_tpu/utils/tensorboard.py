@@ -1,0 +1,114 @@
+"""Self-contained TensorBoard event-file writer (reference analogue: the
+VisualDL writer behind hapi's VisualDL callback — SURVEY.md §5 metrics row).
+
+Writes standard `events.out.tfevents.*` files readable by TensorBoard with no
+external dependency: the Event/Summary protos for scalar values are tiny and
+hand-encoded here, as is the masked CRC32C record framing of TFRecord.
+"""
+import os
+import socket
+import struct
+import threading
+import time
+
+_CRC_TABLE = None
+
+
+def _crc_table():
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78  # CRC-32C (Castagnoli), reflected
+        table = []
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table.append(c)
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+def _crc32c(data):
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data):
+    crc = _crc32c(data)
+    return ((crc >> 15) | (crc << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def _varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field_bytes(num, payload):
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _scalar_event(tag, value, step, wall_time):
+    value_msg = _field_bytes(1, tag.encode()) + b"\x15" + struct.pack("<f", value)
+    summary = _field_bytes(1, value_msg)
+    ev = struct.pack("<Bd", 0x09, wall_time)  # field 1: wall_time double
+    ev += b"\x10" + _varint(step)  # field 2: step varint
+    ev += _field_bytes(5, summary)  # field 5: summary
+    return ev
+
+
+def _version_event(wall_time):
+    ev = struct.pack("<Bd", 0x09, wall_time)
+    ev += _field_bytes(3, b"brain.Event:2")  # field 3: file_version
+    return ev
+
+
+class SummaryWriter:
+    """Minimal TensorBoard scalar writer: add_scalar / flush / close."""
+
+    def __init__(self, log_dir="./runs"):
+        os.makedirs(log_dir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}.{os.getpid()}"
+        self._path = os.path.join(log_dir, fname)
+        self._f = open(self._path, "ab")
+        self._lock = threading.Lock()
+        self._write_record(_version_event(time.time()))
+
+    def _write_record(self, data):
+        header = struct.pack("<Q", len(data))
+        with self._lock:
+            self._f.write(header)
+            self._f.write(struct.pack("<I", _masked_crc(header)))
+            self._f.write(data)
+            self._f.write(struct.pack("<I", _masked_crc(data)))
+
+    def add_scalar(self, tag, value, step=0, walltime=None):
+        self._write_record(_scalar_event(str(tag), float(value), int(step), walltime or time.time()))
+
+    def add_scalars(self, main_tag, tag_value_dict, step=0):
+        for k, v in tag_value_dict.items():
+            self.add_scalar(f"{main_tag}/{k}", v, step)
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self.flush()
+        self._f.close()
+
+    # metrics-bus integration: SummaryWriter can subscribe directly
+    def __call__(self, record):
+        step = record.get("step", 0)
+        for k, v in record.items():
+            if k != "step" and isinstance(v, (int, float)):
+                self.add_scalar(k, v, step)
+        self.flush()

@@ -9,6 +9,10 @@ from paddle_tpu import optimizer
 from paddle_tpu.nn import functional as F
 from paddle_tpu.vision import models
 
+# full zoo sweep ≈ 5 min — excluded from the default fast suite
+# (run with `pytest -m slow` / include via `pytest -m ""`)
+pytestmark = pytest.mark.slow
+
 
 def _img(bs=2, hw=64):
     return paddle.to_tensor(
