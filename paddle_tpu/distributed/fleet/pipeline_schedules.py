@@ -1,0 +1,307 @@
+"""Pipeline schedules — FThenB / 1F1B / interleaved VPP as STATIC tick tables
+driving ONE lax.scan (reference: fleet/meta_parallel/pipeline_parallel.py
+``forward_backward_pipeline`` + ``PipelineParallelWithInterleave``, and
+passes/pipeline_scheduler_pass.py ``Pipeline1F1BPass``).
+
+TPU-first redesign: the reference's imperative per-rank send/recv schedule
+becomes a schedule *table* computed in Python (numpy) and baked into a single
+SPMD program:
+
+- every stage runs the SAME traced program (shard_map over the "pp" axis);
+  per-tick behavior is selected by indexing the static tables with
+  ``lax.axis_index("pp")`` — predication instead of MPMD;
+- activations/cotangents move by ring ``lax.ppermute`` once per tick;
+- backward is hand-scheduled (not left to autodiff): each backward op is a
+  per-stage ``jax.vjp`` that REMATERIALIZES the stage forward from its saved
+  input (the reference's recompute+pipeline mode) so the carry holds only
+  O(schedule-depth) activations, not O(num_micro);
+- buffer slots are interval-colored statically, so 1F1B's memory bound
+  (O(pp) in-flight) vs FThenB's (O(M)) is a *provable* property of the
+  tables (``n_act``), asserted in tests, not an emergent runtime behavior.
+
+Op kinds (values index lax.switch branches):
+  fwd:  F_NONE, F_FIRST (embed+layers, visit 0), F_MID (layers),
+        F_LAST (store-only: the bwd vjp recomputes layers+norm+head+loss)
+  bwd:  B_NONE, B_FIRST (vjp of embed+layers w.r.t. embed weights+layers),
+        B_MID (vjp of layers), B_LAST (vjp of layers+norm+head+loss, seeded)
+"""
+import dataclasses
+
+import numpy as np
+
+F_NONE, F_FIRST, F_MID, F_LAST = 0, 1, 2, 3
+B_NONE, B_FIRST, B_MID, B_LAST = 0, 1, 2, 3
+
+# fwd_src / bwd_src sentinel values (>= 0 means recv-buffer slot)
+SRC_TOKENS = -2  # F_FIRST reads tokens[mb] (no tensor input)
+SRC_MSG = -1  # read this tick's incoming ppermute message directly
+SRC_SEED = -2  # B_LAST seeds from the loss cotangent
+
+
+@dataclasses.dataclass
+class Schedule:
+    """Static tick tables, all [T, pp] int32 unless noted."""
+
+    num_micro: int
+    pp: int
+    num_chunks: int
+    style: str
+    T: int
+    fwd_mb: np.ndarray  # micro-batch index of this tick's fwd op (-1 none)
+    fwd_visit: np.ndarray  # stage-visit index k (chunk = k // pp)
+    fwd_kind: np.ndarray  # F_* switch branch
+    fwd_src: np.ndarray  # SRC_TOKENS / SRC_MSG / frecv slot
+    fwd_save: np.ndarray  # act-buffer slot to save resolved input into (-1)
+    frecv_store: np.ndarray  # slot to store the incoming fwd msg into (-1)
+    bwd_mb: np.ndarray
+    bwd_visit: np.ndarray
+    bwd_kind: np.ndarray  # B_*
+    bwd_src: np.ndarray  # SRC_SEED / SRC_MSG / brecv slot
+    bwd_read_act: np.ndarray  # act slot holding the op's saved fwd input (-1)
+    brecv_store: np.ndarray
+    n_act: int  # act-buffer slots (peak live saved activations, max over stages)
+    n_frecv: int
+    n_brecv: int
+    peak_live: np.ndarray  # [pp] peak in-flight (F done, B pending) per stage
+
+    def bubble_fraction(self):
+        """Idle fraction of the schedule: 1 - useful_ops / (T * pp * 2)."""
+        useful = int((self.fwd_mb >= 0).sum() + (self.bwd_mb >= 0).sum())
+        return 1.0 - useful / float(self.T * self.pp * 2)
+
+
+def build_schedule(num_micro, pp, num_chunks=1, style="1f1b"):
+    """Greedy dependency-driven list scheduler.
+
+    Priorities reproduce the named schedules:
+    - "fthenb": forwards first (GPipe — all F then all B per stage);
+    - "1f1b":  backwards first + per-stage in-flight cap V*(pp-s) — yields
+      Megatron's warmup/steady-state/drain pattern (one F and one B per tick
+      in steady state);
+    - num_chunks > 1 with "1f1b" is the interleaved (VPP) variant: stage s
+      owns chunks {s, s+pp, ...}; the ring ppermute wraps stage pp-1 -> 0
+      between chunks, so the same tables express the interleaved flow.
+    """
+    if style not in ("fthenb", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {style!r}")
+    M, V = int(num_micro), int(num_chunks)
+    K = V * pp  # total stage-visits per micro-batch
+    INF = 1 << 30
+
+    f_done = {}  # (m, k) -> tick
+    b_done = {}
+    remaining_f = {(m, k) for m in range(M) for k in range(K)}
+    remaining_b = set(remaining_f)
+    # Micro-batch injection cap (the 1F1B memory bound): a micro-batch's
+    # round trip through the lockstep pipeline is 2K+1 ticks (K fwd hops,
+    # turnaround, K bwd hops; ppermute is a global sync), so at rate one
+    # per tick at most 2K-1 micro-batches are ever in flight. Gating only
+    # *injections* (visit 0) keeps every deeper visit free to run, which
+    # both preserves full-rate steady state and avoids cap deadlocks on
+    # interleaved chunk wraps. Per-stage activation memory follows as
+    # O(V*(pp-s)) — asserted M-independent in tests — vs FThenB's O(M).
+    inject_cap = 2 * K - 1
+    rows = []  # per tick: [(f_op | None, b_op | None)] * pp
+    t = 0
+    while remaining_f or remaining_b:
+        if t > 4 * (M * K + pp):  # safety: schedule must terminate
+            raise RuntimeError(f"schedule did not converge: {style} M={M} pp={pp} V={V}")
+
+        def plan_tick(lift_caps):
+            row = []
+            picks_f, picks_b = [], []
+            for s in range(pp):
+                # deepest visit first: drains in-flight work into backwards
+                # fastest (and avoids cap deadlock across chunk wraps)
+                f_cands = sorted(
+                    (-k, m)
+                    for (m, k) in remaining_f
+                    if k % pp == s and (k == 0 or f_done.get((m, k - 1), INF) < t)
+                )
+                b_cands = sorted(
+                    (-k, m)
+                    for (m, k) in remaining_b
+                    if k % pp == s
+                    and (
+                        f_done.get((m, k), INF) < t
+                        if k == K - 1
+                        else b_done.get((m, k + 1), INF) < t
+                    )
+                )
+                b_pick = None
+                f_pick = None
+                if style == "fthenb":
+                    if f_cands:
+                        # GPipe order: shallow visits / low micro-batch first
+                        kk, mm = min((-nk, m) for nk, m in f_cands)
+                        f_pick = (mm, kk)
+                    # faithful FThenB: no backward until every forward is done
+                    if b_cands and not remaining_f:
+                        b_pick = (b_cands[0][1], -b_cands[0][0])
+                else:  # 1f1b: drain first, then fill under the injection cap
+                    if b_cands:
+                        b_pick = (b_cands[0][1], -b_cands[0][0])
+                    if f_cands:
+                        nk, m = f_cands[0]
+                        inflight = sum(1 for (mm, kk) in f_done if kk == 0) - sum(
+                            1 for (mm, kk) in b_done if kk == 0
+                        )
+                        if -nk > 0 or lift_caps or inflight < inject_cap:
+                            f_pick = (m, -nk)
+                row.append((f_pick, b_pick))
+                if f_pick:
+                    picks_f.append(f_pick)
+                if b_pick:
+                    picks_b.append(b_pick)
+            return row, picks_f, picks_b
+
+        row, picks_f, picks_b = plan_tick(lift_caps=False)
+        if not picks_f and not picks_b:
+            # cap deadlock (possible with interleaved chunk wraps): a capped
+            # stage holds the F that would enable the next B — lift for a tick
+            row, picks_f, picks_b = plan_tick(lift_caps=True)
+            if not picks_f and not picks_b:
+                raise RuntimeError(f"schedule stuck: {style} M={M} pp={pp} V={V} t={t}")
+        for p in picks_f:
+            f_done[p] = t
+            remaining_f.discard(p)
+        for p in picks_b:
+            b_done[p] = t
+            remaining_b.discard(p)
+        rows.append(row)
+        t += 1
+    T = t
+
+    fwd_mb = np.full((T, pp), -1, np.int32)
+    fwd_visit = np.full((T, pp), -1, np.int32)
+    fwd_kind = np.full((T, pp), F_NONE, np.int32)
+    fwd_src = np.full((T, pp), SRC_MSG, np.int32)
+    fwd_save = np.full((T, pp), -1, np.int32)
+    frecv_store = np.full((T, pp), -1, np.int32)
+    bwd_mb = np.full((T, pp), -1, np.int32)
+    bwd_visit = np.full((T, pp), -1, np.int32)
+    bwd_kind = np.full((T, pp), B_NONE, np.int32)
+    bwd_src = np.full((T, pp), SRC_MSG, np.int32)
+    bwd_read_act = np.full((T, pp), -1, np.int32)
+    brecv_store = np.full((T, pp), -1, np.int32)
+
+    for tick, row in enumerate(rows):
+        for s, (f_op, b_op) in enumerate(row):
+            if f_op is not None:
+                m, k = f_op
+                fwd_mb[tick, s], fwd_visit[tick, s] = m, k
+                fwd_kind[tick, s] = F_FIRST if k == 0 else (F_LAST if k == K - 1 else F_MID)
+                if k == 0:
+                    fwd_src[tick, s] = SRC_TOKENS
+            if b_op is not None:
+                m, k = b_op
+                bwd_mb[tick, s], bwd_visit[tick, s] = m, k
+                bwd_kind[tick, s] = B_FIRST if k == 0 else (B_LAST if k == K - 1 else B_MID)
+                if k == K - 1:
+                    bwd_src[tick, s] = SRC_SEED
+
+    # --- act buffer: saved fwd inputs, live [f_tick, b_tick] (k > 0 only;
+    # visit 0 recomputes from tokens) — interval-color per stage
+    def _color(intervals_per_stage):
+        """intervals: stage -> list of (start, end, payload). Returns
+        (n_slots, {payload: slot})."""
+        n_max = 0
+        assign = {}
+        for s, ivs in intervals_per_stage.items():
+            busy = []  # slot -> busy-until tick
+            for start, end, payload in sorted(ivs):
+                slot = None
+                for i, until in enumerate(busy):
+                    if until < start:
+                        slot = i
+                        break
+                if slot is None:
+                    slot = len(busy)
+                    busy.append(end)
+                else:
+                    busy[slot] = end
+                assign[payload] = slot
+            n_max = max(n_max, len(busy))
+        return n_max, assign
+
+    act_ivs = {s: [] for s in range(pp)}
+    for (m, k), ft in f_done.items():
+        if k == 0:
+            continue
+        act_ivs[k % pp].append((ft, b_done[(m, k)], ("act", m, k)))
+    n_act, act_slots = _color(act_ivs)
+    for (m, k), ft in f_done.items():
+        if k == 0:
+            continue
+        slot = act_slots[("act", m, k)]
+        fwd_save[ft, k % pp] = slot
+        bwd_read_act[b_done[(m, k)], k % pp] = slot
+
+    # --- fwd recv buffer: output of F(m,k) arrives at stage (k+1)%pp at
+    # tick f_done+1, consumed by F(m,k+1). Same-tick consume bypasses (MSG).
+    frecv_ivs = {s: [] for s in range(pp)}
+    for (m, k), ft in f_done.items():
+        if k == K - 1:
+            continue
+        arrive, consume = ft + 1, f_done[(m, k + 1)]
+        dst = (k + 1) % pp
+        if consume < arrive:
+            raise RuntimeError(f"fwd dep violated: F({m},{k + 1}) before arrival")
+        if consume > arrive:
+            frecv_ivs[dst].append((arrive, consume, ("f", m, k + 1)))
+    n_frecv, f_slots = _color(frecv_ivs)
+    for (m, k), ft in f_done.items():
+        if k == K - 1:
+            continue
+        arrive, consume = ft + 1, f_done[(m, k + 1)]
+        dst = (k + 1) % pp
+        if consume > arrive:
+            slot = f_slots[("f", m, k + 1)]
+            frecv_store[arrive, dst] = slot
+            fwd_src[consume, dst] = slot
+        # else: fwd_src stays SRC_MSG
+
+    # --- bwd recv buffer: dh of B(m,k) (k>0) arrives at stage (k-1)%pp
+    brecv_ivs = {s: [] for s in range(pp)}
+    for (m, k), bt in b_done.items():
+        if k == 0:
+            continue
+        arrive, consume = bt + 1, b_done[(m, k - 1)]
+        dst = (k - 1) % pp
+        if consume < arrive:
+            raise RuntimeError(f"bwd dep violated: B({m},{k - 1}) before arrival")
+        if consume > arrive:
+            brecv_ivs[dst].append((arrive, consume, ("b", m, k - 1)))
+    n_brecv, b_slots = _color(brecv_ivs)
+    for (m, k), bt in b_done.items():
+        if k == 0:
+            continue
+        arrive, consume = bt + 1, b_done[(m, k - 1)]
+        dst = (k - 1) % pp
+        if consume > arrive:
+            slot = b_slots[("b", m, k - 1)]
+            brecv_store[arrive, dst] = slot
+            bwd_src[consume, dst] = slot
+
+    # --- peak in-flight (memory bound proof) per stage
+    peak = np.zeros(pp, np.int64)
+    live = np.zeros(pp, np.int64)
+    for tick in range(T):
+        for s in range(pp):
+            if fwd_mb[tick, s] >= 0 and fwd_visit[tick, s] > 0:
+                live[s] += 1
+        peak = np.maximum(peak, live)
+        for s in range(pp):
+            if bwd_mb[tick, s] >= 0 and bwd_visit[tick, s] > 0:
+                live[s] -= 1
+    assert (live == 0).all()
+
+    return Schedule(
+        num_micro=M, pp=pp, num_chunks=V, style=style, T=T,
+        fwd_mb=fwd_mb, fwd_visit=fwd_visit, fwd_kind=fwd_kind, fwd_src=fwd_src,
+        fwd_save=fwd_save, frecv_store=frecv_store,
+        bwd_mb=bwd_mb, bwd_visit=bwd_visit, bwd_kind=bwd_kind, bwd_src=bwd_src,
+        bwd_read_act=bwd_read_act, brecv_store=brecv_store,
+        n_act=max(n_act, 1), n_frecv=max(n_frecv, 1), n_brecv=max(n_brecv, 1),
+        peak_live=peak,
+    )

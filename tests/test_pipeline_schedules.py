@@ -1,0 +1,114 @@
+"""Static pipeline-schedule table tests (reference invariants:
+Pipeline1F1BPass ordering + PipelineParallelWithInterleave memory bound)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet.pipeline_schedules import (
+    B_LAST,
+    B_NONE,
+    F_FIRST,
+    F_LAST,
+    F_NONE,
+    SRC_MSG,
+    SRC_SEED,
+    SRC_TOKENS,
+    build_schedule,
+)
+
+
+def check_schedule(sched):
+    """Every (m, k) F and B executed exactly once, deps respected."""
+    M, K = sched.num_micro, sched.num_chunks * sched.pp
+    f_tick, b_tick = {}, {}
+    for t in range(sched.T):
+        for s in range(sched.pp):
+            if sched.fwd_mb[t, s] >= 0:
+                key = (int(sched.fwd_mb[t, s]), int(sched.fwd_visit[t, s]))
+                assert key not in f_tick, f"dup fwd {key}"
+                assert key[1] % sched.pp == s
+                f_tick[key] = t
+            if sched.bwd_mb[t, s] >= 0:
+                key = (int(sched.bwd_mb[t, s]), int(sched.bwd_visit[t, s]))
+                assert key not in b_tick, f"dup bwd {key}"
+                b_tick[key] = t
+    assert len(f_tick) == M * K, f"missing fwd ops: {len(f_tick)} != {M * K}"
+    assert len(b_tick) == M * K
+    for (m, k), t in f_tick.items():
+        if k > 0:
+            assert f_tick[(m, k - 1)] < t, f"F({m},{k}) before F({m},{k - 1})"
+    for (m, k), t in b_tick.items():
+        if k == K - 1:
+            assert f_tick[(m, k)] < t
+        else:
+            assert b_tick[(m, k + 1)] < t
+    return f_tick, b_tick
+
+
+@pytest.mark.parametrize("style", ["fthenb", "1f1b"])
+@pytest.mark.parametrize("M,pp,V", [(4, 2, 1), (8, 4, 1), (8, 2, 2), (8, 4, 2), (2, 4, 1), (6, 3, 1)])
+def test_schedule_valid(style, M, pp, V):
+    s = build_schedule(M, pp, num_chunks=V, style=style)
+    check_schedule(s)
+
+
+def test_1f1b_memory_strictly_below_fthenb():
+    # the 1F1B point: peak in-flight activations O(pp), not O(M)
+    for M, pp in [(8, 2), (16, 4), (12, 3)]:
+        g = build_schedule(M, pp, style="fthenb")
+        o = build_schedule(M, pp, style="1f1b")
+        assert o.n_act < g.n_act, (M, pp, o.n_act, g.n_act)
+        assert g.n_act >= M - 1  # fthenb really holds ~all micro-batches
+        # lockstep 1f1b bound: 2*(pp-s)-1 in-flight, M-independent
+        assert o.n_act <= 2 * pp, (M, pp, o.n_act)
+        big = build_schedule(4 * M, pp, style="1f1b")
+        assert big.n_act == o.n_act  # truly M-independent
+
+
+def test_1f1b_steady_state_one_f_one_b():
+    s = build_schedule(16, 4, style="1f1b")
+    # the last stage alternates F and B every tick once warm (steady state)
+    both = [
+        t
+        for t in range(s.T)
+        if s.fwd_mb[t, s.pp - 1] >= 0 and s.bwd_mb[t, s.pp - 1] >= 0
+    ]
+    assert len(both) >= 12, f"steady-state F+B ticks: {len(both)}"
+
+
+def test_vpp_memory_between():
+    # interleaved: more in-flight than V=1 1F1B but still < fthenb
+    g = build_schedule(8, 2, num_chunks=2, style="fthenb")
+    v = build_schedule(8, 2, num_chunks=2, style="1f1b")
+    assert v.n_act < g.n_act
+
+
+def test_kind_tables_consistent():
+    s = build_schedule(4, 2, style="1f1b")
+    K = s.pp * s.num_chunks
+    for t in range(s.T):
+        for st in range(s.pp):
+            if s.fwd_kind[t, st] == F_FIRST:
+                assert s.fwd_src[t, st] == SRC_TOKENS
+                assert s.fwd_save[t, st] == -1  # tokens recomputable
+            if s.fwd_kind[t, st] in (F_LAST,) or (
+                s.fwd_kind[t, st] != F_NONE and s.fwd_visit[t, st] > 0
+            ):
+                assert s.fwd_save[t, st] >= 0  # saved for the bwd vjp
+            if s.bwd_kind[t, st] == B_LAST:
+                assert s.bwd_src[t, st] == SRC_SEED
+            if s.bwd_kind[t, st] != B_NONE and s.bwd_visit[t, st] > 0:
+                assert s.bwd_read_act[t, st] >= 0
+
+
+def test_bubble_shrinks_with_micro_batches():
+    small = build_schedule(4, 4, style="1f1b").bubble_fraction()
+    big = build_schedule(32, 4, style="1f1b").bubble_fraction()
+    assert big < small
+
+
+def test_vpp_bubble_not_worse():
+    plain = build_schedule(8, 4, num_chunks=1, style="1f1b")
+    inter = build_schedule(8, 4, num_chunks=2, style="1f1b")
+    # interleaving splits each visit into V shorter ones; tick count grows,
+    # but per-tick work halves — tick*chunk-normalized span must not regress
+    assert inter.T <= 2 * plain.T + 2 * plain.pp
