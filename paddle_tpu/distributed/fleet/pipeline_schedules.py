@@ -26,6 +26,7 @@ Op kinds (values index lax.switch branches):
         B_MID (vjp of layers), B_LAST (vjp of layers+norm+head+loss, seeded)
 """
 import dataclasses
+import functools
 
 import numpy as np
 
@@ -305,3 +306,240 @@ def build_schedule(num_micro, pp, num_chunks=1, style="1f1b"):
         n_act=max(n_act, 1), n_frecv=max(n_frecv, 1), n_brecv=max(n_brecv, 1),
         peak_live=peak,
     )
+
+
+# =====================================================================
+# Runtime engine: one lax.scan over the tick tables inside shard_map("pp")
+# =====================================================================
+
+def _pvary(v, axes):
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(v, axes, to="varying")
+    return jax.lax.pvary(v, axes)  # pragma: no cover
+
+
+def _store(buf, slot, val):
+    """dynamic_update buf[slot] = val when slot >= 0 (read-modify-write keeps
+    the old value for slot == -1, so the table IS the predicate)."""
+    import jax
+
+    idx = jnp_max0(slot)
+    cur = jax.lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
+    import jax.numpy as jnp
+
+    new = jnp.where(slot >= 0, val.astype(buf.dtype), cur)
+    return jax.lax.dynamic_update_index_in_dim(buf, new, idx, 0)
+
+
+def jnp_max0(x):
+    import jax.numpy as jnp
+
+    return jnp.maximum(x, 0)
+
+
+def make_pipeline_train_fn(sched, mesh, first_fn, mid_fn, last_fn):
+    """Build the scheduled-pipeline train function.
+
+    Stage callables operate on RAW jax arrays (no Tensor tape — backward is
+    hand-scheduled here):
+      first_fn(tokens_mb, embed_ws, chunk_leaves, extras_mb) -> h     [visit 0]
+      mid_fn(h, chunk_leaves, extras_mb) -> h                         [middle]
+      last_fn(h, chunk_leaves, tail_ws, labels_mb, extras_mb) -> loss_sum
+          [last visit: layers + norm + head + token-SUM loss, f32 scalar]
+
+    Returns engine(tokens, labels, seed_ct, stacked, embed_ws, tail_ws,
+    extras) -> (loss_sum_total, d_stacked, d_embed_ws, d_tail_ws) where
+      tokens/labels: [M, mb, S] int; seed_ct: f32 scalar cotangent seeded
+      into every micro-batch's loss (1/total_valid_tokens for mean CE);
+      stacked: tuple of [V, pp, Lc, ...] leaves; extras: tuple of [M, ...]
+      per-micro-batch streams (masks / position ids — stop-gradient).
+    Gradients are f32, accumulated across micro-batches inside the scan.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    pp, V, T = sched.pp, sched.num_chunks, sched.T
+    tFMB, tFVI, tFK, tFSRC = map(jnp.asarray, (sched.fwd_mb, sched.fwd_visit, sched.fwd_kind, sched.fwd_src))
+    tFSAVE, tFRST = jnp.asarray(sched.fwd_save), jnp.asarray(sched.frecv_store)
+    tBMB, tBVI, tBK, tBSRC = map(jnp.asarray, (sched.bwd_mb, sched.bwd_visit, sched.bwd_kind, sched.bwd_src))
+    tBACT, tBRST = jnp.asarray(sched.bwd_read_act), jnp.asarray(sched.brecv_store)
+    fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+    bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+
+    def engine(tokens, labels, seed_ct, stacked, embed_ws, tail_ws, extras):
+        stacked = tuple(stacked)
+        embed_ws = tuple(embed_ws)
+        tail_ws = tuple(tail_ws)
+        extras = tuple(extras)
+        M = tokens.shape[0]
+        # abstract-eval the hidden-state shape/dtype the stream carries
+        chunk0_abs = tuple(
+            jax.ShapeDtypeStruct(l.shape[2:], l.dtype) for l in stacked
+        )
+        h_abs = jax.eval_shape(
+            first_fn,
+            jax.ShapeDtypeStruct(tokens.shape[1:], tokens.dtype),
+            tuple(jax.ShapeDtypeStruct(w.shape, w.dtype) for w in embed_ws),
+            chunk0_abs,
+            tuple(jax.ShapeDtypeStruct(e.shape[1:], e.dtype) for e in extras),
+        )
+
+        def shard_body(tokens, labels, seed_ct, *flat):
+            ns, ne, nt = len(stacked), len(embed_ws), len(tail_ws)
+            # replicated inputs are used in stage-divergent (varying) ways:
+            # promote them so VMA typing accepts the per-stage data flow
+            pv = lambda x: _pvary(x, ("pp",))
+            tokens, labels, seed_ct = pv(tokens), pv(labels), pv(seed_ct)
+            stk_local = tuple(l[:, 0] for l in flat[:ns])  # [V, Lc, ...]
+            emb = tuple(pv(x) for x in flat[ns:ns + ne])
+            tws = tuple(pv(x) for x in flat[ns + ne:ns + ne + nt])
+            exs = tuple(pv(x) for x in flat[ns + ne + nt:])
+            sid = jax.lax.axis_index("pp")
+
+            def zeros(shape_dtype):
+                return _pvary(jnp.zeros(shape_dtype.shape, shape_dtype.dtype), ("pp",))
+
+            h0 = jax.ShapeDtypeStruct(h_abs.shape, h_abs.dtype)
+            carry = dict(
+                act=zeros(jax.ShapeDtypeStruct((sched.n_act,) + h0.shape, h0.dtype)),
+                frecv=zeros(jax.ShapeDtypeStruct((sched.n_frecv,) + h0.shape, h0.dtype)),
+                brecv=zeros(jax.ShapeDtypeStruct((sched.n_brecv,) + h0.shape, h0.dtype)),
+                fmsg=zeros(h0),
+                bmsg=zeros(h0),
+                dstk=tuple(
+                    zeros(jax.ShapeDtypeStruct(l.shape, jnp.float32)) for l in stk_local
+                ),
+                demb=tuple(zeros(jax.ShapeDtypeStruct(w.shape, jnp.float32)) for w in emb),
+                dtail=tuple(zeros(jax.ShapeDtypeStruct(w.shape, jnp.float32)) for w in tws),
+                loss=zeros(jax.ShapeDtypeStruct((), jnp.float32)),
+            )
+
+            def tick(carry, t):
+                inc_f = jax.lax.ppermute(carry["fmsg"], "pp", fwd_perm)
+                inc_b = jax.lax.ppermute(carry["bmsg"], "pp", bwd_perm)
+                frecv = _store(carry["frecv"], tFRST[t, sid], inc_f)
+                brecv = _store(carry["brecv"], tBRST[t, sid], inc_b)
+
+                # ---- forward op
+                fsrc = tFSRC[t, sid]
+                h_in = jnp.where(
+                    fsrc == SRC_MSG,
+                    inc_f,
+                    jax.lax.dynamic_index_in_dim(frecv, jnp_max0(fsrc), 0, keepdims=False),
+                )
+                fmb = jnp_max0(tFMB[t, sid])
+                fchunk = jnp_max0(tFVI[t, sid]) // pp
+                tok_f = jax.lax.dynamic_index_in_dim(tokens, fmb, 0, keepdims=False)
+                ex_f = tuple(jax.lax.dynamic_index_in_dim(e, fmb, 0, keepdims=False) for e in exs)
+                cl_f = tuple(
+                    jax.lax.dynamic_index_in_dim(l, fchunk, 0, keepdims=False) for l in stk_local
+                )
+                h_out = jax.lax.switch(
+                    tFK[t, sid],
+                    (
+                        lambda: h_in,  # F_NONE
+                        lambda: first_fn(tok_f, emb, cl_f, ex_f).astype(h_in.dtype),
+                        lambda: mid_fn(h_in, cl_f, ex_f).astype(h_in.dtype),
+                        lambda: h_in,  # F_LAST: store-only; bwd vjp recomputes
+                    ),
+                )
+                act = _store(carry["act"], tFSAVE[t, sid], h_in)
+
+                # ---- backward op
+                bsrc = tBSRC[t, sid]
+                g_in = jnp.where(
+                    bsrc == SRC_MSG,
+                    inc_b,
+                    jax.lax.dynamic_index_in_dim(brecv, jnp_max0(bsrc), 0, keepdims=False),
+                )
+                bmb = jnp_max0(tBMB[t, sid])
+                bchunk = jnp_max0(tBVI[t, sid]) // pp
+                tok_b = jax.lax.dynamic_index_in_dim(tokens, bmb, 0, keepdims=False)
+                lab_b = jax.lax.dynamic_index_in_dim(labels, bmb, 0, keepdims=False)
+                ex_b = tuple(jax.lax.dynamic_index_in_dim(e, bmb, 0, keepdims=False) for e in exs)
+                cl_b = tuple(
+                    jax.lax.dynamic_index_in_dim(l, bchunk, 0, keepdims=False) for l in stk_local
+                )
+                h_saved = jax.lax.dynamic_index_in_dim(
+                    act, jnp_max0(tBACT[t, sid]), 0, keepdims=False
+                )
+                zero_cl = tuple(pv(jnp.zeros(l.shape, jnp.float32)) for l in cl_b)
+                zero_e = tuple(pv(jnp.zeros(w.shape, jnp.float32)) for w in emb)
+                zero_t = tuple(pv(jnp.zeros(w.shape, jnp.float32)) for w in tws)
+                f32 = lambda tree: tuple(x.astype(jnp.float32) for x in tree)
+
+                zloss = pv(jnp.float32(0))
+
+                def b_none():
+                    return jnp.zeros_like(h_in), zero_cl, zero_e, zero_t, zloss
+
+                def b_first():
+                    _, vjp = jax.vjp(lambda ew, cl: first_fn(tok_b, ew, cl, ex_b), emb, cl_b)
+                    de, dcl = vjp(g_in.astype(h_abs.dtype))
+                    return jnp.zeros_like(h_in), f32(dcl), f32(de), zero_t, zloss
+
+                def b_mid():
+                    _, vjp = jax.vjp(lambda h, cl: mid_fn(h, cl, ex_b), h_saved, cl_b)
+                    dh, dcl = vjp(g_in.astype(h_abs.dtype))
+                    return dh.astype(h_in.dtype), f32(dcl), zero_e, zero_t, zloss
+
+                def b_last():
+                    lsum, vjp = jax.vjp(
+                        lambda h, cl, tw: last_fn(h, cl, tw, lab_b, ex_b), h_saved, cl_b, tws
+                    )
+                    dh, dcl, dtw = vjp(seed_ct.astype(lsum.dtype))
+                    return dh.astype(h_in.dtype), f32(dcl), zero_e, f32(dtw), lsum.astype(jnp.float32)
+
+                dh, dcl, de, dtw, loss_add = jax.lax.switch(
+                    tBK[t, sid], (b_none, b_first, b_mid, b_last)
+                )
+                dstk = tuple(
+                    jax.lax.dynamic_update_index_in_dim(
+                        acc,
+                        jax.lax.dynamic_index_in_dim(acc, bchunk, 0, keepdims=False) + dc,
+                        bchunk,
+                        0,
+                    )
+                    for acc, dc in zip(carry["dstk"], dcl)
+                )
+                new = dict(
+                    act=act,
+                    frecv=frecv,
+                    brecv=brecv,
+                    fmsg=h_out,
+                    bmsg=dh,
+                    dstk=dstk,
+                    demb=tuple(a + d for a, d in zip(carry["demb"], de)),
+                    dtail=tuple(a + d for a, d in zip(carry["dtail"], dtw)),
+                    loss=carry["loss"] + loss_add,
+                )
+                return new, None
+
+            carry, _ = jax.lax.scan(tick, carry, jnp.arange(T))
+            loss = jax.lax.psum(carry["loss"], "pp")
+            d_stacked = tuple(l[:, None] for l in carry["dstk"])  # [V, 1, Lc, ...]
+            d_emb = tuple(jax.lax.psum(g, "pp") for g in carry["demb"])
+            d_tail = tuple(jax.lax.psum(g, "pp") for g in carry["dtail"])
+            return (loss, d_stacked, d_emb, d_tail)
+
+        stk_specs = tuple(P(None, "pp") for _ in stacked)
+        rep = P()
+        out_specs = (
+            rep,
+            tuple(P(None, "pp") for _ in stacked),
+            tuple(rep for _ in embed_ws),
+            tuple(rep for _ in tail_ws),
+        )
+        shmapped = jax.shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(rep, rep, rep) + stk_specs + tuple(rep for _ in embed_ws + tail_ws + extras),
+            out_specs=out_specs,
+            axis_names={"pp"},
+        )
+        return shmapped(tokens, labels, jnp.asarray(seed_ct, jnp.float32), *stacked, *embed_ws, *tail_ws, *extras)
+
+    return engine
