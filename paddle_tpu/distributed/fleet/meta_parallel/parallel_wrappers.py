@@ -63,6 +63,18 @@ class PipelineParallel(MetaParallelBase):
         cfg = (strategy.pipeline_configs if strategy is not None else {}) or {}
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self.micro_batch_size = cfg.get("micro_batch_size", 1)
+        # schedule_mode routes to the scheduled engine when the wrapped model
+        # supports it (LlamaForCausalLMPipe-style `schedule` attr); the
+        # desc-based PipelineLayer path runs the differentiable FThenB engine
+        # (same math — schedule only changes memory/overlap)
+        self.schedule_mode = str(cfg.get("schedule_mode", "1F1B")).lower()
+        if self.schedule_mode not in ("1f1b", "fthenb", "vpp"):
+            raise ValueError(
+                f"pipeline_configs.schedule_mode {cfg.get('schedule_mode')!r} not in "
+                "{'1F1B', 'FThenB', 'VPP'}"
+            )
+        if hasattr(layers, "schedule") and layers.schedule != self.schedule_mode:
+            layers.schedule = self.schedule_mode
         self._train_step = None
         self._loss_fn = layers._loss_fn
 

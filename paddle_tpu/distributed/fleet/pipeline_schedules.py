@@ -362,14 +362,17 @@ def make_pipeline_train_fn(sched, mesh, first_fn, mid_fn, last_fn):
     from jax.sharding import PartitionSpec as P
 
     pp, V, T = sched.pp, sched.num_chunks, sched.T
-    tFMB, tFVI, tFK, tFSRC = map(jnp.asarray, (sched.fwd_mb, sched.fwd_visit, sched.fwd_kind, sched.fwd_src))
-    tFSAVE, tFRST = jnp.asarray(sched.fwd_save), jnp.asarray(sched.frecv_store)
-    tBMB, tBVI, tBK, tBSRC = map(jnp.asarray, (sched.bwd_mb, sched.bwd_visit, sched.bwd_kind, sched.bwd_src))
-    tBACT, tBRST = jnp.asarray(sched.bwd_read_act), jnp.asarray(sched.brecv_store)
     fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
     bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
 
     def engine(tokens, labels, seed_ct, stacked, embed_ws, tail_ws, extras):
+        # tables staged as constants INSIDE the consuming trace (converting
+        # them at build time would leak tracers into the engine closure if
+        # the builder runs under an outer jit)
+        tFMB, tFVI, tFK, tFSRC = map(jnp.asarray, (sched.fwd_mb, sched.fwd_visit, sched.fwd_kind, sched.fwd_src))
+        tFSAVE, tFRST = jnp.asarray(sched.fwd_save), jnp.asarray(sched.frecv_store)
+        tBMB, tBVI, tBK, tBSRC = map(jnp.asarray, (sched.bwd_mb, sched.bwd_visit, sched.bwd_kind, sched.bwd_src))
+        tBACT, tBRST = jnp.asarray(sched.bwd_read_act), jnp.asarray(sched.brecv_store)
         stacked = tuple(stacked)
         embed_ws = tuple(embed_ws)
         tail_ws = tuple(tail_ws)
