@@ -61,12 +61,13 @@ class DistributedTrainStep(TrainStep):
     grad reduce-scatter), 3 = also shard parameters (FSDP)."""
 
     def __init__(self, model, loss_fn, optimizer, n_labels=1, scaler=None, mesh=None,
-                 sharding_stage=1, batch_axes=("dp", "sharding"), metrics_bus=None):
+                 sharding_stage=1, batch_axes=("dp", "sharding"), metrics_bus=None,
+                 accumulate_steps=1):
         self.mesh = mesh if mesh is not None else get_mesh()
         self.sharding_stage = sharding_stage
         self.batch_axes = batch_axes
         super().__init__(model, loss_fn, optimizer, n_labels=n_labels, scaler=scaler,
-                         metrics_bus=metrics_bus)
+                         metrics_bus=metrics_bus, accumulate_steps=accumulate_steps)
         self._place_state()
 
     # -- sharding construction ----------------------------------------------

@@ -37,7 +37,8 @@ class Model:
         labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
         if self._train_step is None:
             self._train_step = TrainStep(
-                self.network, self._wrapped_loss, self._optimizer, n_labels=max(len(labels), 1)
+                self.network, self._wrapped_loss, self._optimizer, n_labels=max(len(labels), 1),
+                accumulate_steps=getattr(self, "_accumulate_grad_batches", 1),
             )
         loss = self._train_step(*inputs, *labels)
         metrics = self._eval_metrics_on_batch(inputs, labels)
@@ -110,6 +111,10 @@ class Model:
             eval_loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
                 eval_data, batch_size=batch_size, num_workers=num_workers
             )
+        acc = int(accumulate_grad_batches)
+        if acc != getattr(self, "_accumulate_grad_batches", 1):
+            self._accumulate_grad_batches = acc
+            self._train_step = None  # rebuild the compiled step with the scan
         cbks = CallbackList(callbacks, model=self, verbose=verbose)
         try:
             steps = len(train_loader)

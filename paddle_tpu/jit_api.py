@@ -98,16 +98,28 @@ class TrainStep:
     interpreter scheduling for (SURVEY.md §3.1 consequence).
 
     loss_fn(outputs, *labels) -> scalar Tensor.
+
+    accumulate_steps=k (reference: fleet gradient_merge_optimizer.py /
+    passes/auto_parallel_gradient_merge.py) runs k micro-batches through a
+    lax.scan INSIDE the one compiled step: forward+backward per micro-batch,
+    f32 grad accumulation, ONE optimizer update on the averaged grads. The
+    batch's leading dim must be divisible by k. Composes with AMP (loss
+    scale seeds each micro-backward; the finite check runs once on the
+    merged grads), grad clip (applied to merged grads) and the
+    DistributedTrainStep shardings (micro-split happens after sharding).
     """
 
     def __init__(self, model, loss_fn, optimizer, n_labels=1, scaler=None, mesh_shardings=None,
-                 metrics_bus=None):
+                 metrics_bus=None, accumulate_steps=1):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.n_labels = n_labels
         self.scaler = scaler
         self.metrics_bus = metrics_bus
+        self.accumulate_steps = int(accumulate_steps)
+        if self.accumulate_steps < 1:
+            raise ValueError(f"accumulate_steps must be >= 1, got {accumulate_steps}")
 
         self._trainable = {
             k: p for k, p in dict(model.named_parameters()).items() if not p.stop_gradient
@@ -121,8 +133,11 @@ class TrainStep:
 
         opt = optimizer
         n_lab = n_labels
+        acc = self.accumulate_steps
 
-        def step_fn(params, buffers, frozen, opt_state, scaler_state, lr, key, batch):
+        def fwd_bwd(params, buffers, frozen, key, batch, scale):
+            """One forward+tape-backward; returns (loss, grads, new_buffers).
+            Grads stay loss-scale-scaled (unscaling happens once, merged)."""
             inputs = batch[:-n_lab] if n_lab else batch
             labels = batch[-n_lab:] if n_lab else ()
             overrides = {k: Tensor(v, stop_gradient=False) for k, v in params.items()}
@@ -136,20 +151,63 @@ class TrainStep:
                 )
                 outs = out if isinstance(out, (tuple, list)) else (out,)
                 loss = loss_fn(*outs, *[Tensor(b, stop_gradient=True) for b in labels])
-
-            if scaler is not None:
+            if scale is not None:
                 # seed the cotangent with the loss scale (≡ scaling the loss)
-                loss.backward(Tensor(jnp.ones_like(loss._data) * scaler_state["scale"]))
+                loss.backward(Tensor(jnp.ones_like(loss._data) * scale))
             else:
                 loss.backward()
+            grads = {k: t.grad._data for k, t in overrides.items() if t.grad is not None}
+            new_buffers = {k: t._data for k, t in buf_over.items()}
+            return loss._data, grads, new_buffers
 
-            grads = {}
-            for k, t in overrides.items():
-                if t.grad is not None:
-                    g = t.grad._data
-                    if scaler is not None:
-                        g = g / scaler_state["scale"]
-                    grads[k] = g
+        def step_fn(params, buffers, frozen, opt_state, scaler_state, lr, key, batch):
+            scale = scaler_state["scale"] if scaler is not None else None
+            if acc == 1:
+                loss_data, grads, new_buffers = fwd_bwd(params, buffers, frozen, key, batch, scale)
+            else:
+                # micro-batch split: arrays sharing the batch leading dim are
+                # scanned [acc, B/acc, ...]; everything else replicates
+                bdim = jnp.shape(batch[0])[0] if batch else 0
+                split = [
+                    hasattr(b, "shape") and jnp.ndim(b) >= 1 and b.shape[0] == bdim and bdim % acc == 0
+                    for b in batch
+                ]
+                if not any(split):
+                    raise ValueError(
+                        f"accumulate_steps={acc}: no batch array with leading dim divisible by {acc}"
+                    )
+                xs = tuple(
+                    b.reshape(acc, b.shape[0] // acc, *b.shape[1:]) if s else None
+                    for b, s in zip(batch, split)
+                )
+                keys = jax.random.split(key, acc)
+
+                def micro(carry, x):
+                    gacc, buf_c, loss_acc = carry
+                    mkey, micro_xs = x
+                    micro_batch = tuple(
+                        (m if s else b) for m, b, s in zip(micro_xs, batch, split)
+                    )
+                    loss_m, grads_m, buf_n = fwd_bwd(params, buf_c, frozen, mkey, micro_batch, scale)
+                    gacc = {
+                        k: gacc[k] + grads_m[k].astype(jnp.float32) for k in gacc
+                    }
+                    return (gacc, buf_n, loss_acc + loss_m.astype(jnp.float32)), None
+
+                # trace one micro to learn the grad structure (shapes static)
+                g0 = jax.eval_shape(
+                    lambda p, b, f, kk, bb: fwd_bwd(p, b, f, kk, bb, scale)[1],
+                    params, buffers, frozen, keys[0],
+                    tuple(x[0] if s else b for x, b, s in zip(xs, batch, split)),
+                )
+                gacc0 = {k: jnp.zeros(v.shape, jnp.float32) for k, v in g0.items()}
+                (gsum, new_buffers, loss_sum), _ = jax.lax.scan(
+                    micro, (gacc0, buffers, jnp.float32(0)), (keys, xs)
+                )
+                grads = {k: v / acc for k, v in gsum.items()}
+                loss_data = loss_sum / acc
+            if scaler is not None:
+                grads = {k: g / scaler_state["scale"] for k, g in grads.items()}
 
             skip = None
             new_scaler_state = scaler_state
@@ -166,8 +224,7 @@ class TrainStep:
                 grads = {k: t._data for (k, _), (_, t) in zip(grads.items(), pg)}
 
             new_params, new_opt_state = opt.apply_gradients(params, grads, opt_state, lr, skip_update=skip)
-            new_buffers = {k: t._data for k, t in buf_over.items()}
-            return loss._data, new_params, new_buffers, new_opt_state, new_scaler_state
+            return loss_data, new_params, new_buffers, new_opt_state, new_scaler_state
 
         self._step_fn = step_fn
         self._compiled = self._compile(step_fn)
