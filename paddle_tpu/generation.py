@@ -1,0 +1,108 @@
+"""Autoregressive generation (reference: PaddleNLP GenerationMixin.generate +
+paddle/fluid/inference decode loop; TPU-native: ONE jitted program — prefill
+fills a fixed-shape KV cache via dynamic_update_slice, the decode loop is a
+lax.scan (static trip count, static shapes — XLA requirements), greedy or
+temperature sampling via jax.random.categorical).
+
+The cache never reallocates: [B, S0 + max_new_tokens, kv_heads, head_dim]
+per layer, written at the running position. PAPERS.md ragged-paged-attention
+is the multi-tenant serving upgrade path.
+"""
+import jax
+import jax.numpy as jnp
+
+from .framework.core import Tensor, to_tensor
+
+
+class GenerationMixin:
+    """Mixin for causal LMs whose forward supports
+    (input_ids, past_key_values, cache_position, use_cache) -> (logits, caches).
+    """
+
+    def init_cache(self, batch_size, max_length, dtype=None):
+        cfg = self.config
+        if dtype is None:
+            dtype = self.lm_head.weight.dtype if getattr(self, "lm_head", None) is not None \
+                else self.llama.embed_tokens.weight.dtype
+        import numpy as np
+
+        jdt = dtype if not isinstance(dtype, str) else jnp.dtype(dtype)
+        shape = (batch_size, max_length, cfg.num_key_value_heads, cfg.head_dim)
+        return tuple(
+            (jnp.zeros(shape, jdt), jnp.zeros(shape, jdt))
+            for _ in range(cfg.num_hidden_layers)
+        )
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False, temperature=1.0,
+                 top_k=0, eos_token_id=None, pad_token_id=None, seed=0):
+        """Returns [B, S0 + max_new_tokens] int32 token ids (prompt included).
+        After eos, a sequence keeps emitting pad_token_id (defaults to eos)."""
+        ids = to_tensor(input_ids)._data.astype(jnp.int32)
+        B, S0 = ids.shape
+        if pad_token_id is None:
+            pad_token_id = eos_token_id if eos_token_id is not None else 0
+        cache_key = (B, S0, max_new_tokens, do_sample, float(temperature), int(top_k),
+                     eos_token_id, pad_token_id)
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        run = cache.get(cache_key)
+        if run is None:
+            run = cache[cache_key] = jax.jit(
+                self._build_generate_fn(B, S0, max_new_tokens, do_sample, temperature,
+                                        top_k, eos_token_id, pad_token_id)
+            )
+        state = self.raw_state_dict()
+        out = run(state, ids, jax.random.PRNGKey(seed))
+        return Tensor(out, stop_gradient=True)
+
+    def _build_generate_fn(self, B, S0, max_new, do_sample, temperature, top_k,
+                           eos_token_id, pad_token_id):
+        model = self
+        total = S0 + max_new
+
+        def fwd(state, toks, caches, pos):
+            overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
+            wrapped = [(Tensor(kc), Tensor(vc)) for kc, vc in caches]
+            logits, presents = model.functional_call(
+                overrides, Tensor(toks), past_key_values=wrapped,
+                cache_position=Tensor(pos), use_cache=True, training=False,
+            )
+            return logits._data, tuple((p[0]._data, p[1]._data) for p in presents)
+
+        def sample(logits, key):
+            logits = logits.astype(jnp.float32)
+            if not do_sample:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits = logits / jnp.maximum(temperature, 1e-6)
+            if top_k and top_k > 0:
+                kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+        def run(state, ids, key):
+            caches = model.init_cache(B, total)
+            logits, caches = fwd(state, ids, caches, jnp.int32(0))
+            key, sk = jax.random.split(key)
+            nxt = sample(logits[:, -1], sk)
+            done = jnp.zeros((B,), bool)
+            if eos_token_id is not None:
+                done = nxt == eos_token_id
+
+            def step(carry, k_i):
+                caches, tok, pos, done = carry
+                lg, caches = fwd(state, tok[:, None], caches, pos)
+                n = sample(lg[:, -1], k_i)
+                n = jnp.where(done, jnp.int32(pad_token_id), n)
+                new_done = done | (n == eos_token_id) if eos_token_id is not None else done
+                return (caches, n, pos + 1, new_done), n
+
+            if max_new > 1:
+                keys = jax.random.split(key, max_new - 1)
+                (_, _, _, _), rest = jax.lax.scan(
+                    step, (caches, nxt, jnp.int32(S0), done), keys
+                )
+                return jnp.concatenate([ids, nxt[:, None], rest.T], axis=1)
+            return jnp.concatenate([ids, nxt[:, None]], axis=1)
+
+        return run

@@ -102,8 +102,70 @@ class Predictor:
             results.append(np.asarray(o.numpy()) if isinstance(o, Tensor) else np.asarray(o))
         return results if inputs is not None else None
 
+    # -- generation --------------------------------------------------------
+    def generate(self, input_ids, **kwargs):
+        """Autoregressive decode via the model's jitted KV-cache loop
+        (GenerationMixin) — reference: AnalysisPredictor-driven generation."""
+        if not hasattr(self._layer, "generate"):
+            raise TypeError(f"{type(self._layer).__name__} has no generate()")
+        out = self._layer.generate(to_tensor(input_ids), **kwargs)
+        return np.asarray(out.numpy())
+
+    # -- AOT export (reference: save_optimized_model / Program serialization;
+    # TPU-native: StableHLO via jax.export — the compiled artifact is
+    # hardware-portable and reloadable without the model class) ------------
+    def export_aot(self, path, *example_inputs):
+        """Trace + lower the forward on example inputs and serialize the
+        StableHLO artifact to `path`. Returns the byte count."""
+        import jax
+        from jax import export as jexport
+
+        layer = self._layer
+        state = layer.raw_state_dict()
+
+        def pure(state, *args):
+            out = layer.functional_call(
+                {k: Tensor(v, stop_gradient=True) for k, v in state.items()},
+                *[Tensor(a) for a in args],
+                training=False,
+            )
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+
+        args = tuple(to_tensor(a)._data for a in example_inputs)
+        exp = jexport.export(jax.jit(pure))(state, *args)
+        data = exp.serialize()
+        with open(path, "wb") as f:
+            f.write(data)
+        self._aot = (exp, state)
+        return len(data)
+
+    @staticmethod
+    def load_aot(path):
+        """Load a serialized AOT artifact; returns AotPredictor (call with
+        the same state pytree + inputs signature used at export)."""
+        from jax import export as jexport
+
+        with open(path, "rb") as f:
+            exp = jexport.deserialize(bytearray(f.read()))
+        return AotPredictor(exp)
+
     def clone(self):
         return Predictor(self._layer, self._input_names)
+
+
+class AotPredictor:
+    """Runs a deserialized StableHLO export: state-free serving — the weights
+    travel as the first pytree argument (reference: the deserialized
+    inference Program + persistables)."""
+
+    def __init__(self, exported):
+        self._exported = exported
+
+    def run(self, state, *inputs):
+        args = tuple(to_tensor(a)._data for a in inputs)
+        out = self._exported.call(state, *args)
+        return [np.asarray(o) for o in (out if isinstance(out, (tuple, list)) else [out])]
 
 
 def create_predictor(config_or_layer, input_names=None):

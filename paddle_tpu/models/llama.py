@@ -115,14 +115,73 @@ class LlamaAttention(Layer):
         self.v_proj = _mk_linear(h, self.num_kv_heads * self.head_dim, P(None, "mp"))
         self.o_proj = _mk_linear(self.num_heads * self.head_dim, h, P("mp", None))
 
-    def forward(self, hidden_states, attention_mask=None, position_ids=None, past_key_value=None):
+    def forward(self, hidden_states, attention_mask=None, position_ids=None,
+                past_key_value=None, cache_position=None):
+        """past_key_value:
+        - None: plain causal attention;
+        - (k, v) without cache_position: legacy growing-concat cache (eager);
+        - (k_cache, v_cache) [B, S_max, hk, D] WITH cache_position: the
+          fixed-shape decode cache (XLA-friendly — dynamic_update_slice at
+          the write offset, full-cache attention under a position mask;
+          reference: flash_attn decode / PAPERS.md ragged-paged-attention is
+          the multi-sequence upgrade path)."""
+        import jax
+
+        from ..framework.core import apply
+
         B, S = hidden_states.shape[0], hidden_states.shape[1]
         q = manipulation.reshape(self.q_proj(hidden_states), [B, S, self.num_heads, self.head_dim])
         k = manipulation.reshape(self.k_proj(hidden_states), [B, S, self.num_kv_heads, self.head_dim])
         v = manipulation.reshape(self.v_proj(hidden_states), [B, S, self.num_kv_heads, self.head_dim])
+        rope_kw = {}
+        if cache_position is not None:
+            if position_ids is None:
+                pos0 = cache_position if hasattr(cache_position, "_data") else Tensor(jnp.asarray(cache_position))
+                position_ids = apply(
+                    lambda p: jnp.broadcast_to(p + jnp.arange(S), (B, S)), pos0, name="cache_pos"
+                )
+            # rope table must cover absolute positions up to the cache end
+            # (the default table is sized to the CURRENT q length — one row
+            # during decode)
+            S_tab = past_key_value[0].shape[1] if past_key_value is not None else self.config.max_position_embeddings
+            D = self.head_dim
+            inv = 1.0 / (self.config.rope_theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+            emb = jnp.concatenate([o := jnp.outer(jnp.arange(S_tab, dtype=jnp.float32), inv), o], axis=-1)
+            rope_kw = dict(cos=Tensor(jnp.cos(emb)), sin=Tensor(jnp.sin(emb)))
         q, k, _ = fused_rotary_position_embedding(
-            q, k, None, position_ids=position_ids, rotary_emb_base=self.config.rope_theta
+            q, k, None, position_ids=position_ids, rotary_emb_base=self.config.rope_theta,
+            **rope_kw,
         )
+        if past_key_value is not None and cache_position is not None:
+            k_cache, v_cache = past_key_value
+            pos_a = (cache_position._data if hasattr(cache_position, "_data")
+                     else jnp.asarray(cache_position))
+
+            def write(cache, new):
+                return jax.lax.dynamic_update_slice(
+                    cache, new.astype(cache.dtype), (0, pos_a, 0, 0)
+                )
+
+            k_cache = apply(write, k_cache, k, name="kv_cache_write")
+            v_cache = apply(write, v_cache, v, name="kv_cache_write")
+            present = (k_cache, v_cache)
+            S_max = k_cache.shape[1]
+            # absolute-position causal mask over the full fixed cache:
+            # query row i (absolute pos p+i) may see cache cols j <= p+i
+            def build_mask(p):
+                rows = p + jnp.arange(S)[:, None]
+                cols = jnp.arange(S_max)[None, :]
+                m = jnp.where(cols <= rows, 0.0, jnp.float32(-1e9))
+                return m[None, None]  # [1, 1, S, S_max]
+
+            mask = apply(build_mask, Tensor(pos_a), name="cache_mask")
+            if attention_mask is not None and attention_mask.ndim == 2:
+                pad = (1.0 - manipulation.unsqueeze(attention_mask.astype("float32"), [1, 2])) * -1e9
+                mask = mask + pad
+            out = F.scaled_dot_product_attention(q, k_cache, v_cache, attn_mask=mask,
+                                                 is_causal=False, training=self.training)
+            out = manipulation.reshape(out, [B, S, self.num_heads * self.head_dim])
+            return self.o_proj(out), present
         if past_key_value is not None:
             k = manipulation.concat([past_key_value[0], k], axis=1)
             v = manipulation.concat([past_key_value[1], v], axis=1)
@@ -159,12 +218,18 @@ class LlamaDecoderLayer(Layer):
         self.input_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
         self.post_attention_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, hidden_states, attention_mask=None, position_ids=None):
+    def forward(self, hidden_states, attention_mask=None, position_ids=None,
+                past_key_value=None, cache_position=None):
         residual = hidden_states
-        h, _ = self.self_attn(self.input_layernorm(hidden_states), attention_mask, position_ids)
+        h, present = self.self_attn(
+            self.input_layernorm(hidden_states), attention_mask, position_ids,
+            past_key_value=past_key_value, cache_position=cache_position,
+        )
         h = residual + h
         residual = h
         h = residual + self.mlp(self.post_attention_layernorm(h))
+        if past_key_value is not None:
+            return h, present
         return h
 
 
@@ -180,18 +245,28 @@ class LlamaModel(Layer):
         self.layers = LayerList([LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids, attention_mask=None, position_ids=None):
+    def forward(self, input_ids, attention_mask=None, position_ids=None,
+                past_key_values=None, cache_position=None, use_cache=False):
         h = self.embed_tokens(input_ids)
         if self.config.sequence_parallel:
             h = _seq_shard(h)
-        for layer in self.layers:
-            if self.config.use_recompute and self.training:
+        presents = [] if (use_cache or past_key_values is not None) else None
+        for i, layer in enumerate(self.layers):
+            pkv = past_key_values[i] if past_key_values is not None else None
+            if pkv is not None:
+                h, present = layer(h, attention_mask, position_ids,
+                                   past_key_value=pkv, cache_position=cache_position)
+                presents.append(present)
+            elif self.config.use_recompute and self.training:
                 from ..distributed.fleet.recompute import recompute
 
                 h = recompute(layer, h, attention_mask, position_ids)
             else:
                 h = layer(h, attention_mask, position_ids)
-        return self.norm(h)
+        out = self.norm(h)
+        if presents is not None and past_key_values is not None:
+            return out, presents
+        return out
 
 
 def _seq_shard(h):
@@ -447,7 +522,10 @@ class LlamaForCausalLMPipe(Layer):
         return Tensor(loss_arr, stop_gradient=True)
 
 
-class LlamaForCausalLM(Layer):
+from ..generation import GenerationMixin
+
+
+class LlamaForCausalLM(GenerationMixin, Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -457,7 +535,21 @@ class LlamaForCausalLM(Layer):
         else:
             self.lm_head = _mk_linear(config.hidden_size, config.vocab_size, P(None, "mp"))
 
-    def forward(self, input_ids, attention_mask=None, position_ids=None, labels=None):
+    def forward(self, input_ids, attention_mask=None, position_ids=None, labels=None,
+                past_key_values=None, cache_position=None, use_cache=False):
+        if past_key_values is not None:
+            h, presents = self.llama(
+                input_ids, attention_mask, position_ids,
+                past_key_values=past_key_values, cache_position=cache_position,
+                use_cache=True,
+            )
+            if self.lm_head is not None:
+                logits = self.lm_head(h)
+            else:
+                from ..tensor import linalg
+
+                logits = linalg.matmul(h, self.llama.embed_tokens.weight, transpose_y=True)
+            return logits, presents
         h = self.llama(input_ids, attention_mask, position_ids)
         if self.config.fuse_linear_cross_entropy and (labels is not None or self.training):
             # hand (hidden, lm weight) to the fused CE so [B,S,vocab] logits
