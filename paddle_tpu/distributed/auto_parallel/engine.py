@@ -80,10 +80,60 @@ class Engine:
         self.metrics = metrics if isinstance(metrics, (list, tuple)) else ([metrics] if metrics else [])
         self.strategy = strategy or Strategy()
         self._train_step = None
+        self._plan = None
 
     def _ensure_step(self):
-        if self._train_step is None:
-            self._train_step = TrainStep(self.model, self.loss, self.optimizer)
+        """Apply the Strategy (reference: engine._apply_pre/post_optimization
+        pass pipeline — amp/recompute/sharding/gradient-merge/pipeline) and
+        build the compiled step. On a multi-device backend with no global
+        mesh yet, planner v1 chooses the mesh shape (reference: tuner/)."""
+        if self._train_step is not None:
+            return
+        import jax
+
+        from ..mesh import has_mesh
+        from .planner import build_planned_mesh, plan_for_model
+
+        st = self.strategy
+        model = self.model
+
+        scaler = None
+        if st.amp.enable:
+            dtype = getattr(st.amp, "dtype", "bfloat16")
+            if getattr(st.amp, "level", "O2").upper() == "O2":
+                (model.bfloat16 if dtype == "bfloat16" else model.float16)()
+            if dtype == "float16":
+                from ...amp import GradScaler
+
+                scaler = GradScaler()
+        if st.recompute.enable and hasattr(getattr(model, "config", None), "use_recompute"):
+            model.config.use_recompute = True
+        if st.pipeline.enable and hasattr(model, "schedule"):
+            mode = str(getattr(st.pipeline, "schedule_mode", "1F1B")).lower()
+            model.schedule = mode
+        acc = int(getattr(st.gradient_merge, "k_steps", 1)) if st.gradient_merge.enable else 1
+
+        n_dev = len(jax.devices())
+        if n_dev > 1:
+            from ..train_step import DistributedTrainStep
+
+            if not has_mesh():
+                mins = {}
+                if st.sharding.enable and getattr(st.sharding, "degree", 1) > 1:
+                    mins["sharding"] = int(st.sharding.degree)
+                if st.pipeline.enable and getattr(st.pipeline, "pp_degree", 1) > 1:
+                    mins["pp"] = int(st.pipeline.pp_degree)
+                self._plan = plan_for_model(model, n_devices=n_dev, min_axes=mins)
+                build_planned_mesh(self._plan)
+            stage = int(getattr(st.sharding, "stage", 1)) if st.sharding.enable else 1
+            self._train_step = DistributedTrainStep(
+                model, self.loss, self.optimizer, scaler=scaler,
+                sharding_stage=stage, accumulate_steps=acc,
+            )
+        else:
+            self._train_step = TrainStep(
+                model, self.loss, self.optimizer, scaler=scaler, accumulate_steps=acc
+            )
 
     def fit(self, train_data, train_sample_split=None, batch_size=1, epochs=1, steps_per_epoch=None,
             log_freq=10, valid_data=None, collate_fn=None, callbacks=None, verbose=1):
