@@ -126,6 +126,10 @@ class Engine:
                 self._plan = plan_for_model(model, n_devices=n_dev, min_axes=mins)
                 build_planned_mesh(self._plan)
             stage = int(getattr(st.sharding, "stage", 1)) if st.sharding.enable else 1
+            if self._plan is not None and self._plan.sharding_stage == 3 and stage < 3:
+                # the plan only fits memory with ZeRO-3 param sharding;
+                # running it at a lower stage would OOM silently — escalate
+                stage = 3
             self._train_step = DistributedTrainStep(
                 model, self.loss, self.optimizer, scaler=scaler,
                 sharding_stage=stage, accumulate_steps=acc,

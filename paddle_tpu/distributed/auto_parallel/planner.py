@@ -25,7 +25,15 @@ HBM_BYTES_DEFAULT = 16e9  # v5e
 # resident optimizer bytes/param: AdamW f32 moments (8) + f32 master (4);
 # grads are transient inside the donated jitted step
 OPT_BYTES_PER_PARAM = 12.0
-ACT_BYTES_FACTOR = 8.0  # per-token-per-hidden-per-layer bytes with recompute
+# With full recompute, the only per-layer residency is the checkpointed
+# block input (one activation of B_micro·S·H at each layer boundary);
+# the transient working set of the layer being recomputed is charged
+# separately as RECOMPUTE_WORKING_LAYERS extra layer-activations.
+RECOMPUTE_WORKING_LAYERS = 8.0
+# Latency constants: a scheduled-pipeline tick is a lockstep ppermute
+# (global sync + dispatch), a collective has a latency floor per hop.
+TICK_LATENCY_S = 1e-5
+COLL_LATENCY_S = 5e-6
 
 
 @dataclasses.dataclass
@@ -98,19 +106,31 @@ def plan_mesh(
             param_bytes = n_params * dtype_bytes / (state_shard if zero3 else model_shard)
             opt_bytes = n_params * OPT_BYTES_PER_PARAM / state_shard
             # constant GLOBAL batch across candidates (fair cost comparison);
-            # each dp x sharding replica sees B / (dp*sh)
+            # each dp x sharding replica sees B / (dp*sh) samples, processed
+            # as micro-batches of batch_per_device (grad accumulation keeps
+            # the live working set micro-batch-sized regardless of dp)
             B = batch_per_device * n_devices
             replica_b = max(B // max(dp * sh, 1), 1)
+            micro_b = batch_per_device
+            n_micro = max(replica_b // micro_b, 1)
+            # full-recompute residency: one dtype-sized boundary activation
+            # per local layer (split over mp inside the layer), plus the
+            # transient working set of the one layer being recomputed.
+            # A 1F1B stage keeps up to pp in-flight micro-batches resident
+            # during the steady state, so the boundary term scales with
+            # min(n_micro, pp).
+            layers_local = max(-(-num_layers // pp), 1)  # ceil
+            in_flight = min(n_micro, pp)
             act_bytes = (
-                ACT_BYTES_FACTOR * replica_b * seq_len * hidden_size
-                * max(num_layers // pp, 1) / max(mp, 1)
+                micro_b * seq_len * hidden_size * dtype_bytes
+                * (in_flight * layers_local / max(mp, 1) + RECOMPUTE_WORKING_LAYERS)
             )
             mem = param_bytes + opt_bytes + act_bytes
             if mem > hbm_bytes * 0.92:
                 continue
 
             # ---- per-step cost in SECONDS: comm bytes / ICI bandwidth,
-            # bubble and imbalance charged against the compute-time base
+            # bubble and per-tick latency charged against the step
             ICI_BW = 4e11  # v5e aggregate per-chip ICI ≈ 400 GB/s
             PEAK = 197e12  # bf16 FLOP/s per chip
             tokens = B * seq_len
@@ -120,20 +140,33 @@ def plan_mesh(
             cost = 0.0
             if grad_sync_ways > 1:
                 cost += 2.0 * P / model_shard * (grad_sync_ways - 1) / grad_sync_ways / ICI_BW
+                cost += COLL_LATENCY_S * np.log2(grad_sync_ways)
             if zero3:
                 # per-step weight all-gather (XLA weight-update sharding)
                 cost += P / model_shard * (sh - 1) / sh / ICI_BW
             if mp > 1:
+                # 2 activation all-reduces fwd + 2 bwd per layer per
+                # micro-batch (Megatron TP), bytes summed over the replica
+                # batch, plus the per-collective latency floor
                 cost += (
                     8.0 * num_layers / pp * replica_b * seq_len * hidden_size
                     * dtype_bytes * (mp - 1) / mp / ICI_BW
                 )
+                cost += 4.0 * num_layers / pp * n_micro * COLL_LATENCY_S
             if pp > 1:
-                act = replica_b * seq_len * hidden_size * dtype_bytes
-                cost += 2.0 * act * (pp - 1) / ICI_BW
-                # bubble as lost compute: (pp-1)/(M + pp - 1) with M ≈ 2pp
-                # (1F1B), plus a 2%/stage imbalance-and-latency tax
-                bubble = (pp - 1) / (3.0 * pp - 1)
+                # micro-batched boundary p2p: every micro-batch crosses each
+                # of the pp-1 boundaries forward and backward
+                act = micro_b * seq_len * hidden_size * dtype_bytes
+                cost += 2.0 * n_micro * act * (pp - 1) / ICI_BW
+                # the scheduled engine runs in lockstep ticks (one global
+                # ppermute sync each): 2·(M + pp − 1) ticks per step — this
+                # fixed latency is what makes pipelining a loss for models
+                # whose compute does not dwarf it
+                ticks = 2.0 * (n_micro + pp - 1)
+                cost += ticks * TICK_LATENCY_S
+                # bubble as lost compute: (pp−1)/(M + pp − 1) of the step,
+                # plus a 2%/stage imbalance tax (last stage carries the head)
+                bubble = (pp - 1) / (n_micro + pp - 1.0)
                 cost += (bubble + 0.02 * (pp - 1)) * compute_s
             candidates.append(
                 Plan(dp, mp, pp, sh, cost, mem,

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Pre-snapshot gate: the committed suite must be green before any commit
+# that closes a milestone. Run from the repo root:
+#   bash scripts/ci.sh          # default tier (CPU, 8 virtual devices)
+#   bash scripts/ci.sh --tpu    # additionally run TPU-marked tests first
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ARGS=(-q -p no:cacheprovider)
+if [[ "${1:-}" == "--tpu" ]]; then
+  shift
+  # exit code 5 = no tests collected — fine while the tpu tier is empty
+  PADDLE_TPU_TEST_PLATFORM=tpu python -m pytest tests/ "${ARGS[@]}" -m tpu "$@" \
+    || { rc=$?; [[ $rc -eq 5 ]] || exit $rc; }
+fi
+exec python -m pytest tests/ "${ARGS[@]}" "$@"

@@ -1,16 +1,19 @@
 """Test harness: force an 8-device virtual CPU mesh (SURVEY.md §4 — the
 Gloo-equivalent fake backend: XLA_FLAGS=--xla_force_host_platform_device_count).
-Must run before jax initializes a backend."""
+Must run before jax initializes a backend. Set PADDLE_TPU_TEST_PLATFORM=tpu
+(scripts/ci.sh --tpu does) to leave the real backend alone for tpu-marked
+tests."""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
+if os.environ.get("PADDLE_TPU_TEST_PLATFORM", "cpu") == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
 
-import jax
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
