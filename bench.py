@@ -19,17 +19,30 @@ import sys
 import time
 
 PROBE_TIMEOUT_S = 90  # backend init alone; a healthy plugin takes seconds
-RUNG_TIMEOUT_S = [600, 420, 420, 360, 360]  # per-rung wall clock (compile+run)
+RUNG_TIMEOUT_S = [600, 420, 420, 420, 360]  # per-rung wall clock (compile+run)
+GQA_RUNG_TIMEOUT_S = 420
 CPU_FALLBACK_TIMEOUT_S = 420
 
+# GQA rung (kv_heads < heads): exercises the splash kernel on record —
+# run additionally after the primary rung, result attached as extra.gqa.
+GQA_RUNG = dict(hidden=2048, layers=12, heads=16, kv_heads=4, inter=5504,
+                seq=2048, batch=4, recompute="dots")
+
 LADDER = [
-    # (hidden, layers, heads, inter, seq, batch) — descending HBM footprint;
-    # report the largest config that fits the chip
-    dict(hidden=2048, layers=12, heads=16, inter=5504, seq=2048, batch=8),
-    dict(hidden=1536, layers=8, heads=16, inter=4096, seq=2048, batch=4),
-    dict(hidden=1024, layers=8, heads=16, inter=2816, seq=1024, batch=8),
-    dict(hidden=768, layers=6, heads=12, inter=2048, seq=1024, batch=4),
-    dict(hidden=512, layers=4, heads=8, inter=1408, seq=512, batch=4),
+    # Preference-ordered: the first rung that fits the chip is reported.
+    # recompute="dots" saves matmul outputs and recomputes elementwise only
+    # (≈0 extra FLOPs); "full" re-runs the layer forward (+1/3 FLOPs) and is
+    # the deep fallback for memory; "none" keeps everything live.
+    dict(hidden=2048, layers=12, heads=16, inter=5504, seq=2048, batch=8,
+         recompute="dots"),
+    dict(hidden=2048, layers=12, heads=16, inter=5504, seq=2048, batch=4,
+         recompute="none"),
+    dict(hidden=2048, layers=12, heads=16, inter=5504, seq=2048, batch=4,
+         recompute="dots"),
+    dict(hidden=2048, layers=12, heads=16, inter=5504, seq=2048, batch=8,
+         recompute="full"),
+    dict(hidden=1024, layers=8, heads=16, inter=2816, seq=1024, batch=8,
+         recompute="none"),
 ]
 
 
@@ -50,7 +63,8 @@ def peak_flops_per_chip():
     return 197e12
 
 
-def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, batch=8, steps=8):
+def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, batch=8,
+        steps=12, recompute="dots", kv_heads=None):
     import numpy as np
 
     import jax
@@ -72,7 +86,11 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
     cfg = LlamaConfig(
         vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
         num_hidden_layers=layers, num_attention_heads=heads,
-        max_position_embeddings=seq, use_recompute=True, dtype="bfloat16",
+        num_key_value_heads=kv_heads,
+        max_position_embeddings=seq,
+        use_recompute=recompute != "none",
+        recompute_policy=recompute if recompute != "none" else "full",
+        dtype="bfloat16",
         fuse_linear_cross_entropy=True,
     )
     model = LlamaForCausalLM(cfg)
@@ -121,7 +139,8 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
             "mfu": round(mfu, 4),
             "params": n_params,
             "step_time_s": round(dt, 4),
-            "config": f"h{hidden}-L{layers}-a{heads}-i{inter}-v{vocab}-s{seq}-b{batch}",
+            "config": (f"h{hidden}-L{layers}-a{heads}-i{inter}-v{vocab}-s{seq}-b{batch}"
+                       f"-r{recompute}" + (f"-kv{kv_heads}" if kv_heads else "")),
             "backend": jax.default_backend(),
             "attn_impl": fa.LAST_IMPL or "math-xla",
             "final_loss": round(float(loss.numpy()), 4),
@@ -140,7 +159,7 @@ def _child_main(rung_idx, force_cpu=False):
 
         jax.config.update("jax_platforms", "cpu")
     try:
-        res = run(**LADDER[rung_idx])
+        res = run(**(LADDER[rung_idx] if rung_idx >= 0 else GQA_RUNG))
     except Exception as e:  # noqa: BLE001 — report, never crash silently
         res = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
     print(json.dumps(res), flush=True)
@@ -204,6 +223,22 @@ def main():
                 res.setdefault("extra", {})["note"] = f"ladder rung {i} after: {'; '.join(errors)}"
             break
         errors.append(f"rung{i}: {out.get('error', 'unknown')[:160]}")
+    if res is not None and not wedged:
+        # GQA/splash rung on record (VERDICT r3 item 8) — additional, never
+        # replaces the primary number
+        print(f"[bench] gqa rung: {GQA_RUNG}", file=sys.stderr, flush=True)
+        gqa, gqa_timeout = _run_rung(-1, GQA_RUNG_TIMEOUT_S)
+        if gqa is not None and "error" not in gqa:
+            res.setdefault("extra", {})["gqa"] = {
+                "tokens_per_sec": gqa["value"],
+                "mfu": gqa.get("extra", {}).get("mfu"),
+                "attn_impl": gqa.get("extra", {}).get("attn_impl"),
+                "config": gqa.get("extra", {}).get("config"),
+            }
+        else:
+            res.setdefault("extra", {})["gqa"] = {
+                "error": "timeout" if gqa_timeout else str((gqa or {}).get("error"))[:160]
+            }
     if res is None:
         print("[bench] falling back to CPU-forced rung", file=sys.stderr, flush=True)
         out, timed_out = _run_rung(0, CPU_FALLBACK_TIMEOUT_S, force_cpu=True)

@@ -130,6 +130,10 @@ class Engine:
                 # the plan only fits memory with ZeRO-3 param sharding;
                 # running it at a lower stage would OOM silently — escalate
                 stage = 3
+            if self._plan is not None:
+                # the plan's memory estimate assumed micro-batching the
+                # replica batch this many ways — honor it
+                acc = max(acc, self._plan.accumulate_steps)
             self._train_step = DistributedTrainStep(
                 model, self.loss, self.optimizer, scaler=scaler,
                 sharding_stage=stage, accumulate_steps=acc,

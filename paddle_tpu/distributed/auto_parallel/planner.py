@@ -46,6 +46,10 @@ class Plan:
     mem_per_device: float
     reason: str
     sharding_stage: int = 1  # 3 = params ZeRO-sharded too (needed to fit)
+    # micro-batches per replica the memory model assumed (grad accumulation
+    # keeps the live working set micro-batch-sized); the Engine must run
+    # with at least this many accumulate steps or the act estimate is void
+    accumulate_steps: int = 1
 
     def mesh_shape(self):
         return dict(dp=self.dp, mp=self.mp, pp=self.pp, sharding=self.sharding)
@@ -172,7 +176,8 @@ def plan_mesh(
                 Plan(dp, mp, pp, sh, cost, mem,
                      reason=f"mem {mem / 1e9:.1f}GB of {hbm_bytes / 1e9:.0f}GB, "
                             f"cost {cost * 1e3:.2f}ms/step" + (", zero3" if zero3 else ""),
-                     sharding_stage=3 if zero3 else (2 if sh > 1 else 1))
+                     sharding_stage=3 if zero3 else (2 if sh > 1 else 1),
+                     accumulate_steps=n_micro)
             )
     if not candidates:
         raise ValueError(

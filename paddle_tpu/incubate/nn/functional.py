@@ -108,18 +108,25 @@ def fused_multi_head_attention(*args, **kwargs):
 
 
 def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
-                               chunk_size=1024, reduction="mean", name=None):
+                               chunk_size=None, reduction="mean",
+                               checkpoint_chunks=True, name=None):
     """Cross-entropy straight from hidden states — the [N, vocab] logits
     tensor is never materialized (reference analogue: fused softmax-CE
     kernels in paddle/phi/kernels/fusion/ + PaddleNLP's parallel CE; here the
     memory win matters most: O(chunk·vocab) live instead of O(N·vocab)).
 
     hidden [..., H] (any leading dims), weight [H, V], labels [...] int.
-    The chunk loop is a lax.map over N/chunk_size slices; each chunk's logits
-    are recomputed in the backward pass (jax.checkpoint), so peak memory is
-    one chunk of logits fwd + one bwd. Chunked matmuls stay MXU-sized for
-    chunk_size ≥ 512.
+    chunk_size (default 4096, or FLAGS_fused_ce_chunk_size) trades peak
+    memory against loop count; a single-chunk call skips the loop entirely
+    so XLA sees one fused matmul+softmax. checkpoint_chunks=False keeps
+    chunk logits live for the backward (faster when memory allows); True
+    recomputes them, so peak is one chunk of logits fwd + one bwd.
+    Chunked matmuls stay MXU-sized for chunk_size ≥ 512.
     """
+    import os
+
+    if chunk_size is None:
+        chunk_size = int(os.environ.get("FLAGS_fused_ce_chunk_size", 4096))
     hidden = _t(hidden)
     weight = _t(weight)
     labels = _t(labels)
@@ -129,12 +136,6 @@ def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
         ls = lab.reshape(-1).astype(jnp.int32)
         n, hd = hs.shape
         c = min(chunk_size, n)
-        pad = (-n) % c
-        if pad:
-            hs = jnp.concatenate([hs, jnp.zeros((pad, hd), hs.dtype)], 0)
-            ls = jnp.concatenate([ls, jnp.full((pad,), ignore_index, ls.dtype)], 0)
-        hs = hs.reshape(-1, c, hd)
-        ls = ls.reshape(-1, c)
 
         def chunk_fn(args):
             hc, lc = args
@@ -145,7 +146,18 @@ def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
             valid = lc != ignore_index
             return jnp.where(valid, lse - ll, 0.0), valid
 
-        losses, valids = jax.lax.map(jax.checkpoint(chunk_fn), (hs, ls))
+        if c >= n:
+            body = jax.checkpoint(chunk_fn) if checkpoint_chunks else chunk_fn
+            losses, valids = body((hs, ls))
+        else:
+            pad = (-n) % c
+            if pad:
+                hs = jnp.concatenate([hs, jnp.zeros((pad, hd), hs.dtype)], 0)
+                ls = jnp.concatenate([ls, jnp.full((pad,), ignore_index, ls.dtype)], 0)
+            hs = hs.reshape(-1, c, hd)
+            ls = ls.reshape(-1, c)
+            body = jax.checkpoint(chunk_fn) if checkpoint_chunks else chunk_fn
+            losses, valids = jax.lax.map(body, (hs, ls))
         total = jnp.sum(losses)
         count = jnp.sum(valids)
         if reduction == "mean":

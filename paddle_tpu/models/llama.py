@@ -40,8 +40,10 @@ class LlamaConfig:
         rope_theta=10000.0,
         tie_word_embeddings=False,
         use_recompute=False,
+        recompute_policy="full",
         sequence_parallel=False,
         fuse_linear_cross_entropy=False,
+        ce_chunk_size=None,
         dtype="float32",
         seq_length=2048,
     ):
@@ -56,8 +58,10 @@ class LlamaConfig:
         self.rope_theta = rope_theta
         self.tie_word_embeddings = tie_word_embeddings
         self.use_recompute = use_recompute
+        self.recompute_policy = recompute_policy
         self.sequence_parallel = sequence_parallel
         self.fuse_linear_cross_entropy = fuse_linear_cross_entropy
+        self.ce_chunk_size = ce_chunk_size
         self.dtype = dtype
         self.seq_length = seq_length
 
@@ -260,7 +264,8 @@ class LlamaModel(Layer):
             elif self.config.use_recompute and self.training:
                 from ..distributed.fleet.recompute import recompute
 
-                h = recompute(layer, h, attention_mask, position_ids)
+                h = recompute(layer, h, attention_mask, position_ids,
+                              policy=self.config.recompute_policy)
             else:
                 h = layer(h, attention_mask, position_ids)
         out = self.norm(h)
@@ -293,6 +298,7 @@ class LlamaPretrainingCriterion(Layer):
     def __init__(self, config=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
+        self.ce_chunk_size = getattr(config, "ce_chunk_size", None)
 
     def forward(self, logits, *rest):
         if len(rest) == 2:
@@ -302,7 +308,8 @@ class LlamaPretrainingCriterion(Layer):
 
             weight, labels = rest
             return fused_linear_cross_entropy(
-                logits, weight, labels, ignore_index=self.ignore_index
+                logits, weight, labels, ignore_index=self.ignore_index,
+                chunk_size=self.ce_chunk_size
             )
         (labels,) = rest
         return F.cross_entropy(
@@ -389,7 +396,7 @@ class LlamaForCausalLMPipe(Layer):
 
             logits = linalg.matmul(h, self.embed_tokens.weight, transpose_y=True)
         if labels is not None:
-            return LlamaPretrainingCriterion()(logits, labels)
+            return LlamaPretrainingCriterion(self.config)(logits, labels)
         return logits
 
     # -- scheduled (1F1B / interleaved-VPP) training path --------------------
@@ -563,7 +570,7 @@ class LlamaForCausalLM(GenerationMixin, Layer):
 
                 w = linalg.t(self.llama.embed_tokens.weight)
             if labels is not None:
-                return LlamaPretrainingCriterion()(h, w, labels)
+                return LlamaPretrainingCriterion(self.config)(h, w, labels)
             return h, w
         if self.lm_head is not None:
             logits = self.lm_head(h)
@@ -572,7 +579,7 @@ class LlamaForCausalLM(GenerationMixin, Layer):
 
             logits = linalg.matmul(h, self.llama.embed_tokens.weight, transpose_y=True)
         if labels is not None:
-            return LlamaPretrainingCriterion()(logits, labels)
+            return LlamaPretrainingCriterion(self.config)(logits, labels)
         return logits
 
     def num_parameters(self):

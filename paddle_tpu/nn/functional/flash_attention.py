@@ -36,13 +36,11 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
-def _use_pallas(q_shape, head_dim):
-    if not _sdp_config["enable_flash"]:
-        return False
-    if jax.default_backend() != "tpu":
-        return False
-    # Pallas kernel wants MXU-friendly tiles.
-    return head_dim % 128 == 0 or head_dim in (64, 96, 128, 256)
+def _flash_enabled():
+    # sdp_kernel(enable_flash=False) is the user escape hatch: it must
+    # force the math path even on TPU (head-dim/alignment gating lives in
+    # ops.flash_attention.flash_attention_fwd, the single dispatch point)
+    return _sdp_config["enable_flash"]
 
 
 def _math_attention(q, k, v, mask, causal, dropout, dropout_key, scale):
@@ -93,7 +91,10 @@ def flash_attention(
     drop = dropout if training else 0.0
     dropout_key = prandom.next_key() if drop > 0.0 else None
 
-    if _use_pallas(tuple(q.shape), head_dim) and drop == 0.0:
+    if drop == 0.0 and _flash_enabled():
+        # single dispatch point: flash_attention_fwd picks splash/pallas on
+        # an aligned TPU trace and the fused-XLA math path otherwise, and
+        # records the choice in ops.flash_attention.LAST_IMPL
         from ...ops.flash_attention import flash_attention_fwd
 
         out = apply(
@@ -156,7 +157,7 @@ def scaled_dot_product_attention(
     drop = dropout_p if training else 0.0
     dropout_key = prandom.next_key() if drop > 0.0 else None
 
-    if attn_mask is None and drop == 0.0 and _use_pallas(tuple(q.shape), head_dim):
+    if attn_mask is None and drop == 0.0 and _flash_enabled():
         from ...ops.flash_attention import flash_attention_fwd
 
         return apply(

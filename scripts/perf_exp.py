@@ -1,16 +1,17 @@
-"""Round-3 MFU ablation harness (VERDICT item 1).
+"""MFU ablation harness (VERDICT r3 item 2: knobs drive REAL config, no
+monkeypatching).
 
 Runs one bench-rung-0-shaped training-step measurement per child process with
 knobs from env vars, printing one JSON line. Parent mode sweeps the variants.
 
 Knobs (env):
-  EXP_RECOMPUTE=0/1      use_recompute on the model
-  EXP_FUSED_CE=0/1       fused_linear_cross_entropy vs plain logits CE
-  EXP_ATTN=pallas|xla    force attention impl
-  EXP_CHUNK=N            fused-CE chunk size
-  EXP_BATCH=N            batch size
-  EXP_STEPS=N            timed steps
-  EXP_BLOCK=N            flash attention block size
+  EXP_RECOMPUTE=none|dots|full   recompute policy (LlamaConfig.recompute_policy)
+  EXP_FUSED_CE=0/1               fused_linear_cross_entropy vs plain logits CE
+  EXP_ATTN=pallas|xla            force attention impl (ops.flash_attention.force_xla)
+  EXP_CHUNK=N                    fused-CE chunk size (LlamaConfig.ce_chunk_size)
+  EXP_BATCH=N                    batch size
+  EXP_STEPS=N                    timed steps
+  EXP_BLOCK_Q=N / EXP_BLOCK_K=N  flash kernel tiles (ops.flash_attention.configure)
 """
 import json
 import os
@@ -26,73 +27,40 @@ CFG = dict(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048)
 def child():
     import numpy as np
 
-    recompute = os.environ.get("EXP_RECOMPUTE", "1") == "1"
+    recompute = os.environ.get("EXP_RECOMPUTE", "dots")
     fused_ce = os.environ.get("EXP_FUSED_CE", "1") == "1"
     attn = os.environ.get("EXP_ATTN", "pallas")
-    chunk = int(os.environ.get("EXP_CHUNK", "1024"))
+    chunk = int(os.environ.get("EXP_CHUNK", "4096"))
     batch = int(os.environ.get("EXP_BATCH", "8"))
     steps = int(os.environ.get("EXP_STEPS", "6"))
-    block = int(os.environ.get("EXP_BLOCK", "512"))
-
-    import jax
+    block_q = int(os.environ.get("EXP_BLOCK_Q", "0")) or None
+    block_k = int(os.environ.get("EXP_BLOCK_K", "0")) or None
 
     import paddle_tpu as paddle
     from paddle_tpu import optimizer
     from paddle_tpu.jit_api import TrainStep
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion
+    from paddle_tpu.ops import flash_attention as fa
 
     if attn == "xla":
-        from paddle_tpu.ops import flash_attention as fa
-
-        fa._PALLAS_IMPL = False
-        fa._on_tpu = lambda: False
-    if block != 512:
-        import paddle_tpu.ops.flash_attention as fa_mod
-
-        src_get = fa_mod._get_pallas_impl
-
-        def patched():
-            impl = src_get()
-            if not impl:
-                return impl
-
-            def impl2(q, k, v, causal, scale):
-                from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes, flash_attention as _fa
-
-                b = min(block, q.shape[2])
-                sizes = BlockSizes(block_q=b, block_k_major=b, block_k=b, block_b=1,
-                                   block_q_major_dkv=b, block_k_major_dkv=b, block_k_dkv=b,
-                                   block_q_dkv=b, block_k_major_dq=b, block_k_dq=b, block_q_dq=b)
-                return _fa(q, k, v, causal=causal, sm_scale=scale, block_sizes=sizes)
-
-            return impl2
-
-        fa_mod._get_pallas_impl = patched
-        fa_mod._PALLAS_IMPL = None
-
-    if chunk != 1024:
-        import paddle_tpu.incubate.nn.functional as inf
-
-        orig = inf.fused_linear_cross_entropy
-
-        def patched_ce(h, w, l, **kw):
-            kw["chunk_size"] = chunk
-            return orig(h, w, l, **kw)
-
-        inf.fused_linear_cross_entropy = patched_ce
-        import paddle_tpu.models.llama as llama_mod
+        fa.force_xla(True)
+    fa.configure(block_q=block_q, block_k=block_k)
 
     paddle.seed(0)
     cfg = LlamaConfig(
         vocab_size=CFG["vocab"], hidden_size=CFG["hidden"], intermediate_size=CFG["inter"],
         num_hidden_layers=CFG["layers"], num_attention_heads=CFG["heads"],
-        max_position_embeddings=CFG["seq"], use_recompute=recompute, dtype="bfloat16",
+        max_position_embeddings=CFG["seq"],
+        use_recompute=recompute != "none",
+        recompute_policy=recompute if recompute != "none" else "full",
+        dtype="bfloat16",
         fuse_linear_cross_entropy=fused_ce,
+        ce_chunk_size=chunk,
     )
     model = LlamaForCausalLM(cfg)
     model.bfloat16()
     opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(), weight_decay=0.01)
-    step = TrainStep(model, lambda *a: LlamaPretrainingCriterion()(*a), opt)
+    step = TrainStep(model, lambda *a: LlamaPretrainingCriterion(cfg)(*a), opt)
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, CFG["vocab"], (batch, CFG["seq"] + 1)).astype(np.int32)
@@ -113,23 +81,27 @@ def child():
     flops_per_token = LlamaForCausalLM.flops_per_token(cfg, seq_len=CFG["seq"])
     toks = batch * CFG["seq"] / dt
     mfu = flops_per_token * toks / 197e12
-    from paddle_tpu.ops import flash_attention as fa2
 
     print(json.dumps({
-        "recompute": recompute, "fused_ce": fused_ce, "attn": fa2.LAST_IMPL,
-        "chunk": chunk, "batch": batch, "block": block,
+        "recompute": recompute, "fused_ce": fused_ce, "attn": fa.LAST_IMPL,
+        "chunk": chunk, "batch": batch, "block_q": block_q, "block_k": block_k,
         "step_s": round(dt, 4), "tok_s": round(toks, 1), "mfu": round(mfu, 4),
         "compile_s": round(compile_s, 1),
     }), flush=True)
 
 
 VARIANTS = [
-    {},  # baseline as benched
-    {"EXP_RECOMPUTE": "0"},
-    {"EXP_RECOMPUTE": "0", "EXP_FUSED_CE": "0"},
-    {"EXP_RECOMPUTE": "0", "EXP_ATTN": "xla"},
-    {"EXP_RECOMPUTE": "0", "EXP_CHUNK": "8192"},
-    {"EXP_RECOMPUTE": "0", "EXP_BATCH": "16"},
+    {},  # new default: dots recompute, fused CE chunk 4096, batch 8
+    {"EXP_RECOMPUTE": "none"},
+    {"EXP_RECOMPUTE": "full"},
+    {"EXP_FUSED_CE": "0"},
+    {"EXP_ATTN": "xla"},
+    {"EXP_CHUNK": "8192"},
+    {"EXP_CHUNK": "16384"},
+    {"EXP_BATCH": "16"},
+    {"EXP_BATCH": "4", "EXP_RECOMPUTE": "none"},
+    {"EXP_BLOCK_Q": "1024", "EXP_BLOCK_K": "1024"},
+    {"EXP_BLOCK_Q": "256", "EXP_BLOCK_K": "256"},
 ]
 
 
@@ -140,8 +112,12 @@ def main():
             continue
         env = {**os.environ, **v}
         print(f"--- variant {i}: {v}", file=sys.stderr, flush=True)
-        p = subprocess.run([sys.executable, __file__, "--child"], env=env,
-                           capture_output=True, text=True, timeout=900)
+        try:
+            p = subprocess.run([sys.executable, __file__, "--child"], env=env,
+                               capture_output=True, text=True, timeout=900)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"variant": i, "error": "timeout>900s"}), flush=True)
+            continue
         out = [l for l in p.stdout.splitlines() if l.startswith("{")]
         print(out[-1] if out else f"FAILED rc={p.returncode}: {p.stderr[-300:]}", flush=True)
 
