@@ -82,7 +82,7 @@ class Engine:
         self._train_step = None
         self._plan = None
 
-    def _ensure_step(self):
+    def _ensure_step(self, global_batch=None):
         """Apply the Strategy (reference: engine._apply_pre/post_optimization
         pass pipeline — amp/recompute/sharding/gradient-merge/pipeline) and
         build the compiled step. On a multi-device backend with no global
@@ -123,17 +123,22 @@ class Engine:
                     mins["sharding"] = int(st.sharding.degree)
                 if st.pipeline.enable and getattr(st.pipeline, "pp_degree", 1) > 1:
                     mins["pp"] = int(st.pipeline.pp_degree)
-                self._plan = plan_for_model(model, n_devices=n_dev, min_axes=mins)
+                bpd = max(int(global_batch) // n_dev, 1) if global_batch else 1
+                self._plan = plan_for_model(model, n_devices=n_dev, min_axes=mins,
+                                            batch_per_device=bpd)
                 build_planned_mesh(self._plan)
             stage = int(getattr(st.sharding, "stage", 1)) if st.sharding.enable else 1
             if self._plan is not None and self._plan.sharding_stage == 3 and stage < 3:
                 # the plan only fits memory with ZeRO-3 param sharding;
                 # running it at a lower stage would OOM silently — escalate
                 stage = 3
-            if self._plan is not None:
+            if self._plan is not None and self._plan.accumulate_steps > acc:
                 # the plan's memory estimate assumed micro-batching the
-                # replica batch this many ways — honor it
-                acc = max(acc, self._plan.accumulate_steps)
+                # replica batch this many ways — honor it when the real
+                # batch splits evenly (pp plans micro-batch inside the pipe
+                # and always carry accumulate_steps=1)
+                if global_batch is None or global_batch % self._plan.accumulate_steps == 0:
+                    acc = self._plan.accumulate_steps
             self._train_step = DistributedTrainStep(
                 model, self.loss, self.optimizer, scaler=scaler,
                 sharding_stage=stage, accumulate_steps=acc,
@@ -150,7 +155,7 @@ class Engine:
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=True, drop_last=True, collate_fn=collate_fn
         )
-        self._ensure_step()
+        self._ensure_step(global_batch=getattr(loader, "batch_size", batch_size))
         history = {"loss": []}
         for epoch in range(epochs):
             for step, batch in enumerate(loader):

@@ -177,7 +177,10 @@ def plan_mesh(
                      reason=f"mem {mem / 1e9:.1f}GB of {hbm_bytes / 1e9:.0f}GB, "
                             f"cost {cost * 1e3:.2f}ms/step" + (", zero3" if zero3 else ""),
                      sharding_stage=3 if zero3 else (2 if sh > 1 else 1),
-                     accumulate_steps=n_micro)
+                     # pp>1: the pipe engine micro-batches internally (the
+                     # in_flight term models it); only plain-path plans ask
+                     # the Engine for gradient accumulation
+                     accumulate_steps=1 if pp > 1 else n_micro)
             )
     if not candidates:
         raise ValueError(

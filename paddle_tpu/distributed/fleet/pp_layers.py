@@ -1,0 +1,395 @@
+"""General pipeline-stage API (reference: fleet/meta_parallel/parallel_layers/
+pp_layers.py — PipelineLayer, LayerDesc, SharedLayerDesc).
+
+The reference builds each rank's sub-model from a LayerDesc list and wires
+tied weights with SharedLayerDesc + an allreduce on the shared grad. The
+TPU-native redesign keeps the DESC surface but maps it onto the SPMD
+scheduled engine (pipeline_schedules):
+
+- the desc list is segmented into HEAD descs (embeddings etc. → stage 0's
+  F_FIRST op), a homogeneous BODY run (the repeated transformer block —
+  stacked [V, pp, Lc, ...], placement == stage assignment), and TAIL descs
+  (final norm / lm head / anything after the blocks → last stage's
+  F_LAST/B_LAST op, fused with the loss);
+- SharedLayerDesc ties a tail consumer to a head layer's weight: ONE
+  Parameter, the engine returns separate cotangents for its two uses and
+  PipelineModule sums them (the reference's shared-grad allreduce becomes
+  an addition inside one program);
+- heterogeneity: head/tail groups may hold arbitrary layers; the body must
+  be stackable (identical block architecture). A fully heterogeneous body
+  has no efficient SPMD expression (each stage would trace a different
+  program) — the reference's common topologies (embed + N×block + norm +
+  head) all fit this shape.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import GradNode, Tensor, to_tensor
+from ...nn.layer.layers import Layer
+from .pipeline_engine import PipelineStack
+
+
+class LayerDesc:
+    """Deferred layer construction: LayerDesc(cls, *args, **kwargs).
+    Consecutive descs with equal (cls, args, kwargs) form the stackable
+    body run (reference: pp_layers.py LayerDesc)."""
+
+    def __init__(self, layer_func, *args, **kwargs):
+        self.layer_func = layer_func
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.layer_func(*self.args, **self.kwargs)
+
+    def signature(self):
+        return (self.layer_func, self.args, tuple(sorted(self.kwargs.items())))
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', self.layer_func)})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Tied-weight desc (reference: pp_layers.py SharedLayerDesc).
+
+    The first desc with a given `key` OWNS the layer (built normally); every
+    later desc with the same key is a CONSUMER: at that point in the
+    pipeline, `forward_func(x, shared_weight_tensor)` runs with the owner's
+    `shared_weight_attr` parameter. Default forward_func is the tied LM
+    head: matmul(x, W, transpose_y=True) for an embedding-shaped [V, H] W.
+    """
+
+    def __init__(self, key, layer_func=None, *args, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_func, *args, **kwargs)
+        self.key = key
+        self.shared_weight_attr = shared_weight_attr
+        self.forward_func = forward_func or _tied_lm_head
+
+    def signature(self):
+        return ("shared", self.key, self.shared_weight_attr)
+
+
+def _tied_lm_head(x, w):
+    from ...tensor import linalg
+
+    return linalg.matmul(x, w, transpose_y=True)
+
+
+def _resolve_attr(obj, dotted):
+    for part in dotted.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def default_loss_sum(logits, labels, ignore_index=-100):
+    """Token-SUM cross entropy in f32 (the engine seeds 1/total_valid)."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    safe = jnp.clip(labels, 0, logits.shape[-1] - 1)
+    ll = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    valid = labels != ignore_index
+    return jnp.sum(jnp.where(valid, lse - ll, 0.0))
+
+
+def _segment(descs, body=None):
+    """Split a desc list into (head, body_run, tail). With `body=(s, e)`
+    the caller names the block run explicitly (required when it has length
+    1 — a single-decoder-layer model is otherwise indistinguishable from
+    its head/tail); else body_run is the longest run of consecutive
+    equal-signature descs."""
+    n = len(descs)
+    if body is not None:
+        s, e = body
+        if not (0 <= s < e <= n):
+            raise ValueError(f"body range {body} out of bounds for {n} descs")
+        return list(descs[:s]), list(descs[s:e]), list(descs[e:])
+    best = (0, 0)
+    i = 0
+    while i < n:
+        j = i + 1
+        if not isinstance(descs[i], SharedLayerDesc):
+            while j < n and descs[j].signature() == descs[i].signature():
+                j += 1
+        if j - i > best[1] - best[0]:
+            best = (i, j)
+        i = j
+    s, e = best
+    if e - s < 1 or (e - s == 1 and n > 1):
+        raise ValueError(
+            "PipelineModule could not identify a homogeneous run of block "
+            f"descs to partition over pp (longest run {e - s} of {n} descs)"
+            " — pass body=(start, end) to name it explicitly"
+        )
+    return list(descs[:s]), list(descs[s:e]), list(descs[e:])
+
+
+class PipelineModule(Layer):
+    """Model-agnostic scheduled-pipeline module built from a LayerDesc list
+    (reference: PipelineLayer(layers=[...], num_stages=pp)).
+
+    forward(input_ids, labels=None, *extras):
+    - schedule '1f1b'/'vpp' with labels: the scheduled engine computes the
+      mean loss (and hand-scheduled grads) in one SPMD program;
+    - otherwise: head → GPipe PipelineStack → tail; returns logits, or the
+      mean loss when labels are given.
+    `extras` are optional per-batch tensors (masks, position ids) streamed
+    to every BODY block as extra forward args.
+    """
+
+    def __init__(self, descs, pp_degree=1, num_micro_batches=None,
+                 schedule="1f1b", virtual_pp_degree=1,
+                 loss_sum_fn=None, ignore_index=-100, body=None):
+        super().__init__()
+        if schedule not in ("fthenb", "1f1b", "vpp"):
+            raise ValueError(f"unknown schedule {schedule!r}")
+        if schedule == "vpp" and virtual_pp_degree < 2:
+            raise ValueError("schedule='vpp' needs virtual_pp_degree >= 2")
+        head, body, tail = _segment(list(descs), body=body)
+        self.pp_degree = pp_degree
+        self.schedule = schedule
+        self.virtual_pp_degree = virtual_pp_degree
+        self.num_micro_batches = num_micro_batches or max(pp_degree, 1)
+        self.ignore_index = ignore_index
+        self._loss_sum_fn = loss_sum_fn or (
+            lambda lg, lb: default_loss_sum(lg, lb, ignore_index)
+        )
+
+        self._shared_owners = {}  # key -> (layer, attr)
+        self._head_entries = []  # (kind, layer_or_fwd, param_names | shared key)
+        self._tail_entries = []
+        for group, entries, prefix in (
+            (head, self._head_entries, "head"),
+            (tail, self._tail_entries, "tail"),
+        ):
+            for i, d in enumerate(group):
+                if isinstance(d, SharedLayerDesc) and d.key in self._shared_owners:
+                    entries.append(("shared", d.forward_func, d.key))
+                    continue
+                layer = d.build()
+                self.add_sublayer(f"{prefix}_{i}", layer)
+                if isinstance(d, SharedLayerDesc):
+                    self._shared_owners[d.key] = (layer, d.shared_weight_attr)
+                entries.append(("layer", layer, list(dict(layer.named_parameters()))))
+
+        self.decoder = PipelineStack(
+            body[0].build if not body[0].args and not body[0].kwargs
+            else (lambda _d=body[0]: _d.build()),
+            len(body), pp_degree,
+            num_micro_batches=self.num_micro_batches,
+            virtual_pp_degree=virtual_pp_degree,
+        )
+        self._sched_cache = {}
+
+    # -- parameter group plumbing -------------------------------------------
+    def _group_params(self, entries):
+        """Ordered (params, layout) for a group: layout mirrors entries with
+        per-entry param counts; shared consumers contribute the owner's
+        shared weight as one 'param'."""
+        params = []
+        layout = []
+        for kind, obj, meta in entries:
+            if kind == "layer":
+                named = dict(obj.named_parameters())
+                ps = [named[n] for n in meta]
+                layout.append(("layer", obj, meta, len(ps)))
+                params.extend(ps)
+            else:
+                owner, attr = self._shared_owners[meta]
+                params.append(_resolve_attr(owner, attr))
+                layout.append(("shared", obj, meta, 1))
+        return params, layout
+
+    def load_body_from(self, blocks):
+        """Load the stacked body from a list of per-layer blocks with the
+        same architecture (the plain model's decoder layers)."""
+        stack = self.decoder
+        V, pp, Lc = stack.virtual_pp_degree, stack.pp_degree, stack.layers_per_chunk
+        for ln in stack._leaf_names:
+            per_layer = [dict(b.named_parameters())[ln]._data for b in blocks]
+            if V == 1:
+                stacked = jnp.stack(per_layer).reshape(
+                    pp, stack.layers_per_stage, *per_layer[0].shape
+                )
+            else:
+                stacked = jnp.stack(per_layer).reshape(V, pp, Lc, *per_layer[0].shape)
+            stack._parameters["stacked__" + ln.replace(".", "__")].set_value(Tensor(stacked))
+        return self
+
+    @staticmethod
+    def _apply_group(layout, ws, h_arr, dtype_follow=True):
+        """Run a group's layers functionally on a raw array."""
+        i = 0
+        h = Tensor(h_arr, stop_gradient=True)
+        for kind, obj, meta, n in layout:
+            if kind == "layer":
+                over = {
+                    name: Tensor(ws[i + j], stop_gradient=True)
+                    for j, name in enumerate(meta)
+                }
+                h = obj.functional_call(over, h)
+            else:
+                h = obj(h, Tensor(ws[i], stop_gradient=True))
+            i += n
+        return h._data
+
+    # -- scheduled path ------------------------------------------------------
+    def _stage_fns(self, n_extras, stream_idx):
+        """stream_idx: positions of tensor-valued extras; other positions
+        are static None placeholders rebuilt for each block call."""
+        stack = self.decoder
+        _, head_layout = self._group_params(self._head_entries)
+        _, tail_layout = self._group_params(self._tail_entries)
+        loss_sum = self._loss_sum_fn
+        apply_group = self._apply_group
+
+        def rebuild(ex):
+            full = [None] * n_extras
+            for j, i in enumerate(stream_idx):
+                full[i] = Tensor(ex[j], stop_gradient=True)
+            return tuple(full)
+
+        def run_chunk(h, chunk_leaves, ex):
+            extra = rebuild(ex)
+
+            def body(hh, per_layer):
+                return stack._block_apply(list(per_layer), hh, extra), None
+
+            out, _ = jax.lax.scan(body, h, tuple(chunk_leaves))
+            return out
+
+        def first_fn(tokens_mb, head_ws, chunk_leaves, ex):
+            h = apply_group(head_layout, head_ws, tokens_mb)
+            return run_chunk(h, chunk_leaves, ex)
+
+        def mid_fn(h, chunk_leaves, ex):
+            return run_chunk(h, chunk_leaves, ex)
+
+        def last_fn(h, chunk_leaves, tail_ws, labels_mb, ex):
+            h = run_chunk(h, chunk_leaves, ex)
+            logits = apply_group(tail_layout, tail_ws, h)
+            return loss_sum(logits, labels_mb)
+
+        return first_fn, mid_fn, last_fn
+
+    def _scheduled_loss(self, ids, labs, extras):
+        from ..mesh import get_mesh
+        from .pipeline_schedules import build_schedule, make_pipeline_train_fn
+
+        mesh = get_mesh()
+        M = self.num_micro_batches
+        V = self.virtual_pp_degree
+        B = ids.shape[0]
+        mb = B // M
+        tokens = ids._data.reshape(M, mb, *ids.shape[1:])
+        lab_arr = labs._data.reshape(M, mb, *labs.shape[1:])
+        stream_idx = tuple(i for i, e in enumerate(extras) if e is not None)
+        ex_arrs = tuple(
+            to_tensor(extras[i])._data.reshape(M, mb, *to_tensor(extras[i]).shape[1:])
+            for i in stream_idx
+        )
+
+        head_ps, _ = self._group_params(self._head_entries)
+        tail_ps, _ = self._group_params(self._tail_entries)
+        stacked_ts = self.decoder._stacked_params()
+        stacked = tuple(self.decoder.engine_leaves())
+
+        key = (mesh, M, self.schedule, V, len(extras), stream_idx)
+        engine = self._sched_cache.get(key)
+        if engine is None:
+            style = "1f1b" if self.schedule in ("1f1b", "vpp") else "fthenb"
+            sched = build_schedule(M, self.pp_degree, num_chunks=V, style=style)
+            fns = self._stage_fns(len(extras), stream_idx)
+            engine = jax.jit(make_pipeline_train_fn(sched, mesh, *fns))
+            self._sched_cache[key] = engine
+
+        total = jnp.maximum(jnp.sum(lab_arr != self.ignore_index), 1)
+        seed_ct = 1.0 / total.astype(jnp.float32)
+        loss_sum, d_stacked, d_head, d_tail = engine(
+            tokens, lab_arr, seed_ct, stacked,
+            tuple(p._data for p in head_ps), tuple(p._data for p in tail_ps),
+            ex_arrs,
+        )
+        loss_arr = loss_sum * seed_ct
+
+        # fold cotangents onto unique Parameters (a tied weight appears in
+        # both groups: its two cotangents SUM — the reference's shared-grad
+        # allreduce, expressed as addition)
+        by_param = {}
+        order = []
+
+        def add(p, ct):
+            k = id(p)
+            if k not in by_param:
+                by_param[k] = [p, ct]
+                order.append(k)
+            else:
+                by_param[k][1] = by_param[k][1] + ct
+
+        for p, d in zip(stacked_ts, d_stacked):
+            add(p, d.reshape(p.shape))
+        for p, d in zip(head_ps, d_head):
+            add(p, d)
+        for p, d in zip(tail_ps, d_tail):
+            add(p, d)
+        param_ts = [by_param[k][0] for k in order]
+        cts = [by_param[k][1].astype(p.dtype) for k, p in zip(order, param_ts)]
+        diff = [not p.stop_gradient for p in param_ts]
+        if any(diff):
+            diff_cts = [c for c, d in zip(cts, diff) if d]
+            node = GradNode(
+                lambda ct, _cs=tuple(diff_cts): tuple(c * ct for c in _cs),
+                list(zip(param_ts, diff)),
+                [(loss_arr.shape, loss_arr.dtype)],
+                name=f"pipeline_{self.schedule}",
+            )
+            out = Tensor(loss_arr, stop_gradient=False)
+            out._node = node
+            out._out_idx = 0
+            return out
+        return Tensor(loss_arr, stop_gradient=True)
+
+    # -- generic forward -----------------------------------------------------
+    def forward(self, input_ids, labels=None, *extras):
+        ids = to_tensor(input_ids)
+        B = ids.shape[0]
+        M = self.num_micro_batches
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible by num_micro_batches {M}")
+        if labels is not None and self.schedule in ("1f1b", "vpp") and self.pp_degree > 1:
+            return self._scheduled_loss(ids, to_tensor(labels), extras)
+
+        h = ids
+        for kind, obj, meta in self._head_entries:
+            h = obj(h) if kind == "layer" else obj(h, _shared_w(self, meta))
+        from ...tensor import manipulation
+
+        mb = B // M
+        stream = manipulation.reshape(h, [M, mb, *h.shape[1:]])
+        ex_streams = [
+            None if e is None
+            else manipulation.reshape(to_tensor(e), [M, mb, *to_tensor(e).shape[1:]])
+            for e in extras
+        ]
+        out = self.decoder(stream, *ex_streams)
+        h = manipulation.reshape(out, [B, *out.shape[2:]])
+        for kind, obj, meta in self._tail_entries:
+            h = obj(h) if kind == "layer" else obj(h, _shared_w(self, meta))
+        if labels is None:
+            return h
+
+        labs = to_tensor(labels)
+
+        def mean_loss(lg, lb):
+            s = self._loss_sum_fn(lg, lb)
+            n = jnp.maximum(jnp.sum(lb != self.ignore_index), 1)
+            return s / n.astype(jnp.float32)
+
+        from ...framework.core import apply
+
+        return apply(mean_loss, h, labs, name="pipeline_loss")
+
+
+def _shared_w(mod, key):
+    owner, attr = mod._shared_owners[key]
+    return _resolve_attr(owner, attr)

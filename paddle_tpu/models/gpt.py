@@ -15,6 +15,7 @@ from ..nn.layer.common import Dropout, Embedding, Linear
 from ..nn.layer.container import LayerList
 from ..nn.layer.layers import Layer
 from ..nn.layer.norm import LayerNorm
+from ..distributed.fleet.pp_layers import PipelineModule
 from ..tensor import creation, manipulation
 from .llama import _mk_linear
 
@@ -116,6 +117,59 @@ class GPTModel(Layer):
             else:
                 x = block(x)
         return self.ln_f(x)
+
+
+class GPTEmbeddings(Layer):
+    """wte + learned positions + dropout as ONE pipeline head layer
+    (reference: GPTEmbeddingPipe in PaddleNLP's GPTForCausalLMPipe)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.wte = Embedding(config.vocab_size, config.hidden_size)
+        self.wte.weight.partition_spec = P("mp", None)
+        self.wpe = Embedding(config.max_position_embeddings, config.hidden_size)
+        self.drop = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids):
+        S = input_ids.shape[1]
+        pos = creation.arange(S, dtype="int32")
+        return self.drop(self.wte(input_ids) + self.wpe(pos))
+
+
+class GPTForCausalLMPipe(PipelineModule):
+    """Pipeline GPT assembled ONLY from the generic desc API (reference:
+    GPTForCausalLMPipe built from LayerDesc/SharedLayerDesc lists) — the
+    second model family through the scheduled 1F1B engine, zero
+    model-specific engine code: embeddings desc + N×GPTBlock + final
+    LayerNorm + tied lm head via SharedLayerDesc("wte")."""
+
+    def __init__(self, config: GPTConfig, pp_degree=1, num_micro_batches=None,
+                 schedule="1f1b", virtual_pp_degree=1):
+        from ..distributed.fleet.pp_layers import LayerDesc, SharedLayerDesc
+
+        descs = [SharedLayerDesc("wte", GPTEmbeddings, config,
+                                 shared_weight_attr="wte.weight")]
+        descs += [LayerDesc(GPTBlock, config) for _ in range(config.num_hidden_layers)]
+        descs += [
+            LayerDesc(LayerNorm, config.hidden_size, epsilon=config.layer_norm_epsilon),
+            SharedLayerDesc("wte"),  # tied head: logits = h @ wte^T
+        ]
+        super().__init__(
+            descs, pp_degree=pp_degree, num_micro_batches=num_micro_batches,
+            schedule=schedule, virtual_pp_degree=virtual_pp_degree,
+            body=(1, 1 + config.num_hidden_layers),
+        )
+        self.config = config
+
+    def load_from_causal_lm(self, src):
+        emb = self._head_entries[0][1]
+        emb.wte.weight.set_value(src.gpt.wte.weight)
+        emb.wpe.weight.set_value(src.gpt.wpe.weight)
+        self.load_body_from(list(src.gpt.h))
+        ln = self._tail_entries[0][1]
+        ln.weight.set_value(src.gpt.ln_f.weight)
+        ln.bias.set_value(src.gpt.ln_f.bias)
+        return self
 
 
 class GPTForCausalLM(Layer):

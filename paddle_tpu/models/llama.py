@@ -22,6 +22,8 @@ from ..nn import initializer as I
 from ..nn.layer.common import Embedding, Linear
 from ..nn.layer.container import LayerList
 from ..nn.layer.layers import Layer
+from ..distributed.fleet.pp_layers import PipelineModule
+from ..generation import GenerationMixin
 from ..nn.layer.norm import RMSNorm
 from ..tensor import manipulation
 
@@ -288,6 +290,14 @@ def _seq_shard(h):
     mesh = get_mesh()
     if "mp" not in mesh.axis_names or mesh.shape["mp"] == 1:
         return h
+    try:
+        # inside the scheduled engine's shard_map the pp axis is manual and
+        # a GSPMD constraint cannot apply to pp-varying values — SP sharding
+        # there is GSPMD's job via the weight specs, so skip the hint
+        jax.lax.axis_index("pp")
+        return h
+    except NameError:
+        pass
     sharding = jax.sharding.NamedSharding(mesh, P(None, "mp", None))
     return apply(lambda a: jax.lax.with_sharding_constraint(a, sharding), h, name="seq_shard")
 
@@ -317,219 +327,98 @@ class LlamaPretrainingCriterion(Layer):
         )
 
 
-class LlamaForCausalLMPipe(Layer):
-    """Pipeline-parallel LLaMA (reference analogue: PaddleNLP LlamaForCausalLMPipe
-    built from PipelineLayer LayerDescs, run by PipelineParallel /
-    PipelineParallelWithInterleave).
+class LlamaEmbeddingPipe(Embedding):
+    """Pipe head desc (reference: LlamaEmbeddingPipe in PaddleNLP's pipe
+    model): 0.02-std init, mp-sharded rows; applies the Megatron-SP
+    activation constraint when config.sequence_parallel."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__(config.vocab_size, config.hidden_size)
+        self.weight._data = I.Normal(0.0, 0.02)(
+            (config.vocab_size, config.hidden_size), self.weight.dtype
+        )
+        self.weight.partition_spec = P("mp", None)
+        self._sp = bool(config.sequence_parallel)
+
+    def forward(self, input_ids):
+        h = super().forward(input_ids)
+        if self._sp:
+            h = _seq_shard(h)
+        return h
+
+
+class LlamaForCausalLMPipe(PipelineModule):
+    """Pipeline-parallel LLaMA (reference analogue: PaddleNLP
+    LlamaForCausalLMPipe built from PipelineLayer LayerDescs, run by
+    PipelineParallel / PipelineParallelWithInterleave).
+
+    Assembled ONLY from the generic desc API (pp_layers.PipelineModule):
+    embedding desc + N x LlamaDecoderLayer + RMSNorm + head. Tied
+    embeddings (config.tie_word_embeddings) use SharedLayerDesc("embed"):
+    ONE parameter, both gradient contributions summed by the module.
 
     schedule:
-    - "fthenb" (default): differentiable GPipe — decoder stack through the
-      shard_map+ppermute engine, autodiff backward, embed/norm/head GSPMD;
-    - "1f1b" / "vpp": the scheduled engine (pipeline_schedules) — embed
-      lives in stage 0, norm+head+loss in the last stage, forward AND
-      backward hand-interleaved per the static 1F1B/interleaved tick tables
-      (activation memory O(pp), not O(M)); "vpp" requires
-      virtual_pp_degree > 1 (interleaved model chunks).
-    Tied embeddings (config.tie_word_embeddings) reuse the embedding matrix
-    as the head; both gradient contributions sum on the embedding weight
-    (reference: SharedLayerDesc tied-weight allreduce)."""
+    - "fthenb" (default): differentiable GPipe (shard_map+ppermute engine,
+      autodiff backward, embed/norm/head GSPMD);
+    - "1f1b" / "vpp": the scheduled engine (pipeline_schedules) with
+      hand-interleaved forward/backward per static tick tables (activation
+      memory O(pp), not O(M)); "vpp" needs virtual_pp_degree >= 2."""
 
     SCHEDULES = ("fthenb", "1f1b", "vpp")
 
     def __init__(self, config: LlamaConfig, pp_degree=1, num_micro_batches=None,
                  schedule="fthenb", virtual_pp_degree=1):
-        super().__init__()
-        from ..distributed.fleet.pipeline_engine import PipelineStack
+        from ..distributed.fleet.pp_layers import LayerDesc, SharedLayerDesc
 
         if schedule not in self.SCHEDULES:
             raise ValueError(f"schedule must be one of {self.SCHEDULES}, got {schedule!r}")
-        if schedule == "vpp" and virtual_pp_degree < 2:
-            raise ValueError("schedule='vpp' needs virtual_pp_degree >= 2")
         if schedule == "fthenb" and virtual_pp_degree > 1:
             raise ValueError("virtual_pp_degree > 1 needs schedule '1f1b' or 'vpp'")
+        tied = config.tie_word_embeddings
+        descs = [
+            SharedLayerDesc("embed", LlamaEmbeddingPipe, config,
+                            shared_weight_attr="weight")
+            if tied else LayerDesc(LlamaEmbeddingPipe, config)
+        ]
+        descs += [LayerDesc(LlamaDecoderLayer, config)
+                  for _ in range(config.num_hidden_layers)]
+        descs += [LayerDesc(RMSNorm, config.hidden_size, epsilon=config.rms_norm_eps)]
+        descs += [SharedLayerDesc("embed") if tied
+                  else LayerDesc(_mk_linear, config.hidden_size, config.vocab_size,
+                                 P(None, "mp"))]
+        super().__init__(descs, pp_degree=pp_degree,
+                         num_micro_batches=num_micro_batches,
+                         schedule=schedule, virtual_pp_degree=virtual_pp_degree,
+                         body=(1, 1 + config.num_hidden_layers))
         self.config = config
-        self.pp_degree = pp_degree
-        self.schedule = schedule
-        self.virtual_pp_degree = virtual_pp_degree
-        self.num_micro_batches = num_micro_batches or max(pp_degree, 1)
-        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
-        self.embed_tokens.weight._data = I.Normal(0.0, 0.02)(
-            (config.vocab_size, config.hidden_size), self.embed_tokens.weight.dtype
-        )
-        self.embed_tokens.weight.partition_spec = P("mp", None)
-        self.decoder = PipelineStack(
-            lambda: LlamaDecoderLayer(config), config.num_hidden_layers, pp_degree,
-            num_micro_batches=self.num_micro_batches, virtual_pp_degree=virtual_pp_degree,
-        )
-        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
-        if config.tie_word_embeddings:
-            self.lm_head = None
-        else:
-            self.lm_head = _mk_linear(config.hidden_size, config.vocab_size, P(None, "mp"))
-        self._sched_cache = {}
+
+    @property
+    def embed_tokens(self):
+        return self._head_entries[0][1]
+
+    @property
+    def norm(self):
+        return self._tail_entries[0][1]
+
+    @property
+    def lm_head(self):
+        kind, obj, _ = self._tail_entries[1]
+        return obj if kind == "layer" else None
 
     def forward(self, input_ids, labels=None, attention_mask=None, position_ids=None):
-        B, S = input_ids.shape[0], input_ids.shape[1]
-        M = self.num_micro_batches
-        if B % M != 0:
-            raise ValueError(f"batch size {B} must be divisible by num_micro_batches {M}")
-        if labels is not None and self.schedule in ("1f1b", "vpp") and self.pp_degree > 1:
-            return self._scheduled_loss(input_ids, labels, attention_mask, position_ids)
-        h = self.embed_tokens(input_ids)
-        if self.config.sequence_parallel:
-            h = _seq_shard(h)
-        h = manipulation.reshape(h, [M, B // M, S, self.config.hidden_size])
-        extras = []
-        for e in (attention_mask, position_ids):
-            if e is not None:
-                e = e if hasattr(e, "_data") else manipulation.to_tensor(e)
-                extras.append(manipulation.reshape(e, [M, B // M, *e.shape[1:]]))
-            else:
-                extras.append(None)
-        h = self.decoder(h, *extras)
-        h = manipulation.reshape(h, [B, S, self.config.hidden_size])
-        h = self.norm(h)
+        return super().forward(input_ids, labels, attention_mask, position_ids)
+
+    def load_from_causal_lm(self, src):
+        """Copy weights from a same-config LlamaForCausalLM into the pipe
+        (stacked [V, pp, Lc, ...] body layout via load_body_from)."""
+        sd = {k: v for k, v in src.named_parameters()}
+        self.embed_tokens.weight.set_value(sd["llama.embed_tokens.weight"])
+        self.norm.weight.set_value(sd["llama.norm.weight"])
         if self.lm_head is not None:
-            logits = self.lm_head(h)
-        else:
-            from ..tensor import linalg
+            self.lm_head.weight.set_value(sd["lm_head.weight"])
+        self.load_body_from(list(src.llama.layers))
+        return self
 
-            logits = linalg.matmul(h, self.embed_tokens.weight, transpose_y=True)
-        if labels is not None:
-            return LlamaPretrainingCriterion(self.config)(logits, labels)
-        return logits
-
-    # -- scheduled (1F1B / interleaved-VPP) training path --------------------
-    def _stage_fns(self, has_mask, has_pid):
-        """Raw-array stage callables for the scheduled engine."""
-        import jax
-
-        stack = self.decoder
-        eps = self.config.rms_norm_eps
-        ignore_index = -100
-
-        def rebuild_extras(ex):
-            i = 0
-            mask = pid = None
-            if has_mask:
-                mask = Tensor(ex[i], stop_gradient=True)
-                i += 1
-            if has_pid:
-                pid = Tensor(ex[i], stop_gradient=True)
-            return (mask, pid)
-
-        def run_chunk(h, chunk_leaves, ex):
-            extra = rebuild_extras(ex)
-
-            def body(hh, per_layer):
-                return stack._block_apply(list(per_layer), hh, extra), None
-
-            out, _ = jax.lax.scan(body, h, tuple(chunk_leaves))
-            return out
-
-        def first_fn(tokens_mb, embed_ws, chunk_leaves, ex):
-            (emb_w,) = embed_ws
-            h = jnp.take(emb_w, tokens_mb, axis=0)
-            return run_chunk(h, chunk_leaves, ex)
-
-        def mid_fn(h, chunk_leaves, ex):
-            return run_chunk(h, chunk_leaves, ex)
-
-        def last_fn(h, chunk_leaves, tail_ws, labels_mb, ex):
-            norm_w, head_w = tail_ws
-            h = run_chunk(h, chunk_leaves, ex)
-            var = jnp.mean(jnp.square(h.astype(jnp.float32)), axis=-1, keepdims=True)
-            hn = (h.astype(jnp.float32) * jax.lax.rsqrt(var + eps)).astype(h.dtype)
-            hn = hn * norm_w
-            logits = jnp.matmul(hn, head_w.astype(hn.dtype), preferred_element_type=jnp.float32)
-            lse = jax.scipy.special.logsumexp(logits, axis=-1)
-            safe = jnp.clip(labels_mb, 0, logits.shape[-1] - 1)
-            ll = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
-            valid = labels_mb != ignore_index
-            return jnp.sum(jnp.where(valid, lse - ll, 0.0))
-
-        return first_fn, mid_fn, last_fn
-
-    def _scheduled_loss(self, input_ids, labels, attention_mask, position_ids):
-        import jax
-
-        from ..distributed.fleet.pipeline_schedules import (
-            build_schedule,
-            make_pipeline_train_fn,
-        )
-        from ..distributed.mesh import get_mesh
-        from ..framework.core import GradNode, to_tensor
-
-        mesh = get_mesh()
-        cfg = self.config
-        M = self.num_micro_batches
-        V = self.virtual_pp_degree
-        ids = to_tensor(input_ids)
-        labs = to_tensor(labels)
-        B, S = ids.shape
-        mb = B // M
-        tokens = ids._data.reshape(M, mb, S)
-        lab_arr = labs._data.reshape(M, mb, S)
-        extras = []
-        for e in (attention_mask, position_ids):
-            if e is not None:
-                e = to_tensor(e)
-                extras.append(e._data.reshape(M, mb, *e.shape[1:]))
-        has_mask = attention_mask is not None
-        has_pid = position_ids is not None
-
-        embed_t = self.embed_tokens.weight
-        norm_t = self.norm.weight
-        tied = self.lm_head is None
-        head_t = embed_t if tied else self.lm_head.weight
-        stacked_ts = self.decoder._stacked_params()
-        stacked = tuple(self.decoder.engine_leaves())
-        head_arr = embed_t._data.T if tied else head_t._data
-
-        key = (mesh, M, self.schedule, V, has_mask, has_pid)
-        engine = self._sched_cache.get(key)
-        if engine is None:
-            style = "1f1b" if self.schedule in ("1f1b", "vpp") else "fthenb"
-            sched = build_schedule(M, self.pp_degree, num_chunks=V, style=style)
-            fns = self._stage_fns(has_mask, has_pid)
-            engine = jax.jit(make_pipeline_train_fn(sched, mesh, *fns))
-            self._sched_cache[key] = engine
-
-        total = jnp.maximum(jnp.sum(lab_arr != -100), 1)
-        seed_ct = 1.0 / total.astype(jnp.float32)
-        loss_sum, d_stacked, (d_emb,), (d_norm, d_head) = engine(
-            tokens, lab_arr, seed_ct, stacked, (embed_t._data,), (norm_t._data, head_arr),
-            tuple(extras),
-        )
-        loss_arr = loss_sum * seed_ct
-
-        # wire the engine-computed grads into the autograd tape
-        param_ts = list(stacked_ts) + [embed_t, norm_t] + ([] if tied else [head_t])
-        d_stk_param = [
-            d.reshape(p.shape) for d, p in zip(d_stacked, stacked_ts)
-        ]
-        d_emb_total = d_emb + d_head.T if tied else d_emb
-        cts = d_stk_param + [d_emb_total, d_norm] + ([] if tied else [d_head])
-        cts = [c.astype(p.dtype) for c, p in zip(cts, param_ts)]
-        diff = [not p.stop_gradient for p in param_ts]
-        if any(diff):
-            # vjp: cotangents for diff inputs only, in input order (the
-            # backward walk pairs them with is_diff edges)
-            diff_cts = [c for c, d in zip(cts, diff) if d]
-            node = GradNode(
-                lambda ct, _cs=tuple(diff_cts): tuple(c * ct for c in _cs),
-                list(zip(param_ts, diff)),
-                [(loss_arr.shape, loss_arr.dtype)],
-                name=f"pipeline_{self.schedule}",
-            )
-            out = Tensor(loss_arr, stop_gradient=False)
-            out._node = node
-            out._out_idx = 0
-            return out
-        return Tensor(loss_arr, stop_gradient=True)
-
-
-from ..generation import GenerationMixin
 
 
 class LlamaForCausalLM(GenerationMixin, Layer):

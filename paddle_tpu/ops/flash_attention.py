@@ -28,20 +28,29 @@ LAST_IMPL = None
 _BLOCK_CONFIG = {"block_q": None, "block_k": None}
 
 
-def configure(block_q=None, block_k=None):
+_UNSET = object()
+
+
+def configure(block_q=_UNSET, block_k=_UNSET):
     """Set flash-attention kernel tile sizes (None = auto: min(512, seq)).
+
+    Called with NO arguments, (re)reads the FLAGS_flash_block_q/k env
+    flags; called with explicit values (including None), sets exactly
+    those — so configure(block_q=None, block_k=None) always resets to
+    auto regardless of the environment.
 
     Tiles must divide the (128-aligned) sequence length; larger tiles
     raise arithmetic intensity per VMEM fill, smaller tiles cut VMEM
     pressure for long head dims. perf_exp.py sweeps these."""
     import os
 
-    if block_q is None and "FLAGS_flash_block_q" in os.environ:
-        block_q = int(os.environ["FLAGS_flash_block_q"])
-    if block_k is None and "FLAGS_flash_block_k" in os.environ:
-        block_k = int(os.environ["FLAGS_flash_block_k"])
-    _BLOCK_CONFIG["block_q"] = block_q
-    _BLOCK_CONFIG["block_k"] = block_k
+    if block_q is _UNSET and block_k is _UNSET:
+        env_q = os.environ.get("FLAGS_flash_block_q")
+        env_k = os.environ.get("FLAGS_flash_block_k")
+        block_q = int(env_q) if env_q else None
+        block_k = int(env_k) if env_k else None
+    _BLOCK_CONFIG["block_q"] = None if block_q is _UNSET else block_q
+    _BLOCK_CONFIG["block_k"] = None if block_k is _UNSET else block_k
 
 
 configure()  # pick up env flags at import

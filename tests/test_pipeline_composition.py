@@ -1,0 +1,160 @@
+"""Scheduled 1F1B × TP × sharding composition (VERDICT r4 item 4; reference
+invariant: hybrid_parallel_pp_alexnet.py — a hybrid pp×mp×dp config must
+match the single-process model's math exactly).
+
+The north-star config is TP2×PP2×Sharding2 on 8 devices; these tests prove
+the scheduled engine's shard_map(axis_names={"pp"}) manual/auto split
+really composes: mp axes partition the stage matmuls via GSPMD, the
+sharding axis splits optimizer state, and loss/grads still match plain."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.distributed import mesh as M
+from paddle_tpu.distributed.train_step import DistributedTrainStep
+from paddle_tpu.models.llama import (
+    LlamaForCausalLM,
+    LlamaForCausalLMPipe,
+    LlamaPretrainingCriterion,
+    llama_tiny,
+)
+
+
+def make_batch(bs=8, seq=16, vocab=128, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, vocab, (bs, seq + 1)).astype(np.int32)
+    return ids[:, :-1], ids[:, 1:]
+
+
+def _plain_ref(cfg, x, y, seed=11):
+    paddle.seed(seed)
+    plain = LlamaForCausalLM(cfg)
+    lp = plain(paddle.to_tensor(x), labels=paddle.to_tensor(y))
+    lp.backward()
+    return plain, float(lp.numpy())
+
+
+class TestScheduled1F1BComposition:
+    def test_pp2_mp2_loss_and_grads_match_plain(self):
+        cfg = llama_tiny(num_hidden_layers=4)
+        x, y = make_batch(bs=8, seq=16)
+        plain, ref = _plain_ref(cfg, x, y)
+
+        m = M.build_mesh(pp=2, mp=2)
+        with M.mesh_guard(m):
+            pipe = LlamaForCausalLMPipe(cfg, pp_degree=2, num_micro_batches=4,
+                                        schedule="1f1b")
+            pipe.load_from_causal_lm(plain)
+            lq = pipe(paddle.to_tensor(x), paddle.to_tensor(y))
+            lq.backward()
+        assert abs(float(lq.numpy()) - ref) < 1e-5, (float(lq.numpy()), ref)
+        pd = dict(plain.named_parameters())
+        np.testing.assert_allclose(
+            pipe.embed_tokens.weight.grad.numpy(),
+            pd["llama.embed_tokens.weight"].grad.numpy(), atol=1e-4,
+        )
+        name = "stacked__" + "self_attn.q_proj.weight".replace(".", "__")
+        g_stack = pipe.decoder._parameters[name].grad.numpy().reshape(
+            4, *pd["llama.layers.0.self_attn.q_proj.weight"].shape
+        )
+        for k in range(4):
+            np.testing.assert_allclose(
+                g_stack[k],
+                pd[f"llama.layers.{k}.self_attn.q_proj.weight"].grad.numpy(),
+                atol=1e-4, err_msg=f"layer {k}",
+            )
+
+    def test_pp2_sharding2_first_step_loss_matches_plain(self):
+        cfg = llama_tiny(num_hidden_layers=2)
+        x, y = make_batch(bs=8, seq=8)
+        plain, ref = _plain_ref(cfg, x, y, seed=21)
+
+        m = M.build_mesh(pp=2, sharding=2)
+        with M.mesh_guard(m):
+            pipe = LlamaForCausalLMPipe(cfg, pp_degree=2, num_micro_batches=2,
+                                        schedule="1f1b")
+            pipe.load_from_causal_lm(plain)
+            opt = optimizer.AdamW(learning_rate=1e-2, parameters=pipe.parameters(),
+                                  weight_decay=0.0)
+            step = DistributedTrainStep(pipe, lambda loss: loss, opt, n_labels=0,
+                                        sharding_stage=2)
+            losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+                      for _ in range(4)]
+        assert abs(losses[0] - ref) < 1e-4, (losses[0], ref)
+        assert losses[-1] < losses[0], losses
+
+    def test_north_star_pp2_mp2_sharding2(self):
+        """TP2×PP2×Sharding2 on the 8-device mesh — the BASELINE north-star
+        shape — trains under the scheduled 1F1B engine with first-step loss
+        parity against the plain single-device model."""
+        cfg = llama_tiny(num_hidden_layers=4)
+        x, y = make_batch(bs=8, seq=16)
+        plain, ref = _plain_ref(cfg, x, y, seed=31)
+
+        m = M.build_mesh(pp=2, mp=2, sharding=2)
+        with M.mesh_guard(m):
+            pipe = LlamaForCausalLMPipe(cfg, pp_degree=2, num_micro_batches=4,
+                                        schedule="1f1b")
+            pipe.load_from_causal_lm(plain)
+            opt = optimizer.AdamW(learning_rate=1e-2, parameters=pipe.parameters(),
+                                  weight_decay=0.0)
+            step = DistributedTrainStep(pipe, lambda loss: loss, opt, n_labels=0,
+                                        sharding_stage=2)
+            losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+                      for _ in range(4)]
+            # ZeRO really sharded: an optimizer slot spans >1 device
+            slots = step.opt_state["slots"]
+            some = next(
+                v["moment1"] for k, v in slots.items()
+                if "q_proj" in k and hasattr(v.get("moment1", None), "shape")
+            )
+            devs = {s.device for s in some.addressable_shards}
+            assert len(devs) > 1, "optimizer state not sharded across devices"
+        assert abs(losses[0] - ref) < 1e-4, (losses[0], ref)
+        assert losses[-1] < losses[0], losses
+
+    def test_tp_matmuls_actually_partition_under_mp(self):
+        """The stage fns' projections must be partitioned over mp, not
+        gathered: the placed q_proj weight shards along mp, and the compiled
+        step contains both the pp collective-permute (ring) and an
+        all-reduce (TP activation / grad reduction)."""
+        cfg = llama_tiny(num_hidden_layers=2)
+        x, y = make_batch(bs=4, seq=8)
+        m = M.build_mesh(pp=2, mp=2)
+        with M.mesh_guard(m):
+            paddle.seed(41)
+            pipe = LlamaForCausalLMPipe(cfg, pp_degree=2, num_micro_batches=2,
+                                        schedule="1f1b")
+            opt = optimizer.AdamW(learning_rate=1e-3, parameters=pipe.parameters())
+            step = DistributedTrainStep(pipe, lambda loss: loss, opt, n_labels=0,
+                                        sharding_stage=0)
+            loss = step(paddle.to_tensor(x), paddle.to_tensor(y))
+            assert np.isfinite(float(loss.numpy()))
+
+            name = "stacked__" + "self_attn.q_proj.weight".replace(".", "__")
+            w = pipe.decoder._parameters[name]._data
+            spec = w.sharding.spec
+            flat = []
+            for e in spec:
+                flat.extend(e if isinstance(e, tuple) else [e])
+            assert "mp" in flat, f"q_proj not mp-sharded: {spec}"
+            # shard bytes strictly smaller than the full array on each device
+            shard = next(iter(w.addressable_shards))
+            assert np.prod(shard.data.shape) < np.prod(w.shape)
+
+            (sig, jitted), = step._jitted.items()
+            import jax
+
+            from paddle_tpu.framework import random as prandom
+
+            params = {k: p._data for k, p in step._trainable.items()}
+            buffers = {k: b._data for k, b in step._buffers.items()}
+            frozen = {k: p._data for k, p in step._frozen.items()}
+            hlo = jitted.lower(
+                params, buffers, frozen, step.opt_state, step._scaler_state,
+                step.optimizer.get_lr(), prandom.next_key(),
+                tuple(paddle.to_tensor(b)._data for b in (x, y)),
+            ).compile().as_text()
+        assert "collective-permute" in hlo, "pp ring ppermute missing from HLO"
+        assert "all-reduce" in hlo, "no all-reduce in HLO — TP not partitioned"
