@@ -173,9 +173,7 @@ class PipelineModule(Layer):
                 entries.append(("layer", layer, list(dict(layer.named_parameters()))))
 
         self.decoder = PipelineStack(
-            body[0].build if not body[0].args and not body[0].kwargs
-            else (lambda _d=body[0]: _d.build()),
-            len(body), pp_degree,
+            body[0].build, len(body), pp_degree,
             num_micro_batches=self.num_micro_batches,
             virtual_pp_degree=virtual_pp_degree,
         )

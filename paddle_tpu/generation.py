@@ -4,14 +4,27 @@ fills a fixed-shape KV cache via dynamic_update_slice, the decode loop is a
 lax.scan (static trip count, static shapes — XLA requirements), greedy or
 temperature sampling via jax.random.categorical).
 
-The cache never reallocates: [B, S0 + max_new_tokens, kv_heads, head_dim]
-per layer, written at the running position. PAPERS.md ragged-paged-attention
-is the multi-tenant serving upgrade path.
+The cache never reallocates: [B, S0b + max_new_tokens, kv_heads, head_dim]
+per layer, written at the running position. Prompt lengths are BUCKETED to
+powers of two (min 16): the compiled program is keyed on the bucket, takes
+the true length as a dynamic scalar, and right-pads the prompt — so serving
+compiles O(log S) variants, not one per prompt length. PAPERS.md
+ragged-paged-attention is the multi-tenant serving upgrade path.
 """
 import jax
 import jax.numpy as jnp
 
 from .framework.core import Tensor, to_tensor
+
+_MIN_BUCKET = 16
+
+
+def prompt_bucket(s0):
+    """Smallest power-of-two bucket >= s0 (floor _MIN_BUCKET)."""
+    b = _MIN_BUCKET
+    while b < s0:
+        b *= 2
+    return b
 
 
 class GenerationMixin:
@@ -41,7 +54,8 @@ class GenerationMixin:
         B, S0 = ids.shape
         if pad_token_id is None:
             pad_token_id = eos_token_id if eos_token_id is not None else 0
-        cache_key = (B, S0, max_new_tokens, do_sample, float(temperature), int(top_k),
+        S0b = prompt_bucket(S0)
+        cache_key = (B, S0b, max_new_tokens, do_sample, float(temperature), int(top_k),
                      eos_token_id, pad_token_id)
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
@@ -49,17 +63,25 @@ class GenerationMixin:
         run = cache.get(cache_key)
         if run is None:
             run = cache[cache_key] = jax.jit(
-                self._build_generate_fn(B, S0, max_new_tokens, do_sample, temperature,
+                self._build_generate_fn(B, S0b, max_new_tokens, do_sample, temperature,
                                         top_k, eos_token_id, pad_token_id)
             )
+        ids_p = jnp.pad(ids, ((0, 0), (0, S0b - S0)), constant_values=pad_token_id)
         state = self.raw_state_dict()
-        out = run(state, ids, jax.random.PRNGKey(seed))
-        return Tensor(out, stop_gradient=True)
+        gen = run(state, ids_p, jnp.int32(S0), jax.random.PRNGKey(seed))
+        return Tensor(jnp.concatenate([ids, gen], axis=1), stop_gradient=True)
 
-    def _build_generate_fn(self, B, S0, max_new, do_sample, temperature, top_k,
+    def _build_generate_fn(self, B, S0b, max_new, do_sample, temperature, top_k,
                            eos_token_id, pad_token_id):
+        """Compiled for the (B, S0b bucket, max_new) shape; the true prompt
+        length is a dynamic scalar: prefill runs on the right-padded bucket,
+        the first token samples from logits[true_len-1], and decode starts
+        writing the cache at true_len (pad K/V beyond it are never visible —
+        the causal position mask excludes columns > current position).
+        Returns the [B, max_new] generated tokens (prompt re-attached
+        outside the compiled program)."""
         model = self
-        total = S0 + max_new
+        total = S0b + max_new
 
         def fwd(state, toks, caches, pos):
             overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
@@ -80,11 +102,13 @@ class GenerationMixin:
                 logits = jnp.where(logits < kth, -jnp.inf, logits)
             return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
-        def run(state, ids, key):
+        def run(state, ids, true_len, key):
             caches = model.init_cache(B, total)
             logits, caches = fwd(state, ids, caches, jnp.int32(0))
+            last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
+                                                keepdims=False)
             key, sk = jax.random.split(key)
-            nxt = sample(logits[:, -1], sk)
+            nxt = sample(last, sk)
             done = jnp.zeros((B,), bool)
             if eos_token_id is not None:
                 done = nxt == eos_token_id
@@ -100,9 +124,9 @@ class GenerationMixin:
             if max_new > 1:
                 keys = jax.random.split(key, max_new - 1)
                 (_, _, _, _), rest = jax.lax.scan(
-                    step, (caches, nxt, jnp.int32(S0), done), keys
+                    step, (caches, nxt, true_len, done), keys
                 )
-                return jnp.concatenate([ids, nxt[:, None], rest.T], axis=1)
-            return jnp.concatenate([ids, nxt[:, None]], axis=1)
+                return jnp.concatenate([nxt[:, None], rest.T], axis=1)
+            return nxt[:, None]
 
         return run

@@ -120,29 +120,33 @@ def flash_attn_unpadded(
     scale=None, dropout=0.0, causal=False, return_softmax=False, training=True, name=None,
 ):
     """Varlen flash attention: total-token packed layout [total, H, D] with
-    cumulative sequence offsets (reference: flash_attn_unpadded). Lowered to a
-    segment-masked dense attention — Pallas ragged kernel is the upgrade path."""
+    cumulative sequence offsets (reference: flash_attn_unpadded). On TPU,
+    the Pallas splash kernel with dynamic SegmentIds — O(total·block)
+    memory, no dense [total, total] score matrix; dense segment-masked
+    math fallback elsewhere (ops.flash_attention.flash_attention_varlen_fwd)."""
+    import functools
+
+    from ...ops.flash_attention import flash_attention_varlen_fwd
+
+    from ...ops.flash_attention import _same_offsets
+
     q, k, v = _t(query), _t(key), _t(value)
     cu_q = _t(cu_seqlens_q)._data
     cu_k = _t(cu_seqlens_k)._data
     scale = scale or 1.0 / (q.shape[-1] ** 0.5)
-
-    def fn(qa, ka, va):
-        tq = qa.shape[0]
-        tk = ka.shape[0]
-        seg_q = jnp.cumsum(jnp.zeros(tq, jnp.int32).at[cu_q[1:-1]].add(1))
-        seg_k = jnp.cumsum(jnp.zeros(tk, jnp.int32).at[cu_k[1:-1]].add(1))
-        logits = jnp.einsum("qhd,khd->hqk", qa, ka) * scale
-        mask = seg_q[:, None] == seg_k[None, :]
-        if causal:
-            pos_q = jnp.arange(tq) - jnp.take(cu_q, seg_q)
-            pos_k = jnp.arange(tk) - jnp.take(cu_k, seg_k)
-            mask = mask & (pos_q[:, None] >= pos_k[None, :])
-        logits = jnp.where(mask[None], logits.astype(jnp.float32), -jnp.inf)
-        probs = jax.nn.softmax(logits, axis=-1).astype(qa.dtype)
-        return jnp.einsum("hqk,khd->qhd", probs, va)
-
-    out = apply(fn, q, k, v, name="flash_attn_varlen")
+    # decide self- vs cross-attention HERE, where the offsets may still be
+    # concrete — inside the traced region the values are unreadable and the
+    # kernel path would be lost. Under an outer jit, pass the SAME tensor
+    # object as both cu_seqlens to keep the kernel path for self-attention.
+    same = cu_seqlens_q is cu_seqlens_k or _same_offsets(cu_q, cu_k)
+    out = apply(
+        functools.partial(
+            flash_attention_varlen_fwd, cu_q=cu_q, cu_k=cu_k, causal=causal,
+            scale=scale, same_offsets=same,
+        ),
+        q, k, v,
+        name="flash_attn_varlen",
+    )
     return out, None
 
 

@@ -49,8 +49,10 @@ def configure(block_q=_UNSET, block_k=_UNSET):
         env_k = os.environ.get("FLAGS_flash_block_k")
         block_q = int(env_q) if env_q else None
         block_k = int(env_k) if env_k else None
-    _BLOCK_CONFIG["block_q"] = None if block_q is _UNSET else block_q
-    _BLOCK_CONFIG["block_k"] = None if block_k is _UNSET else block_k
+    if block_q is not _UNSET:
+        _BLOCK_CONFIG["block_q"] = block_q
+    if block_k is not _UNSET:
+        _BLOCK_CONFIG["block_k"] = block_k
 
 
 configure()  # pick up env flags at import
@@ -137,6 +139,117 @@ def _on_tpu():
         return jax.devices()[0].platform == "tpu"
     except Exception:
         return False
+
+
+def varlen_segment_ids(cu_seqlens, total):
+    """Packed-layout token → sequence index from cumulative offsets:
+    cu=[0,3,5], total=6 → [0,0,0,1,1,2] (tokens past cu[-1] get the next
+    id — the padding segment, attending only itself)."""
+    seg = jnp.zeros(total, jnp.int32)
+    seg = seg.at[cu_seqlens[1:]].add(1, mode="drop")
+    return jnp.cumsum(seg)
+
+
+def flash_attention_varlen_fwd(q, k, v, cu_q, cu_k, causal=True, scale=None,
+                               same_offsets=None):
+    """Ragged/varlen flash attention on the packed [total, H, D] layout
+    (reference: flash_attn_unpadded / flash_attn_varlen kernels; PAPERS.md
+    ragged-paged-attention is the serving upgrade).
+
+    TPU path: the Pallas splash kernel with dynamic SegmentIds — packed
+    sequences are contiguous, so a static global CausalMask ∧ same-segment
+    equals within-sequence causal. O(total·block) memory, never the dense
+    [total, total] score matrix. Pads totals to the 128 lattice with a
+    self-attending padding segment, sliced off on return. Falls back to
+    the dense segment-masked math path off-TPU / on kernel rejection."""
+    global LAST_IMPL
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    head_dim = q.shape[-1]
+    dim_ok = head_dim % 128 == 0 or head_dim in (64, 96, 128, 256)
+    # causal ∧ global-position mask is only within-sequence causal when q
+    # and k share offsets (self-attention); cross-offset causal needs the
+    # per-segment positions of the dense path. Callers that still hold the
+    # CONCRETE offsets decide same_offsets before tracing (the wrapper in
+    # nn.functional does); value comparison here is a concrete-only fallback.
+    if same_offsets is None:
+        same_offsets = _same_offsets(cu_q, cu_k)
+    offsets_ok = not causal or same_offsets
+    if _on_tpu() and dim_ok and offsets_ok and not _FORCE_XLA:
+        try:
+            out = _splash_varlen(q, k, v, cu_q, cu_k, causal, scale)
+            LAST_IMPL = "splash-varlen"
+            return out
+        except Exception:
+            pass
+    LAST_IMPL = "xla-varlen"
+    return _dense_varlen(q, k, v, cu_q, cu_k, causal, scale)
+
+
+def _same_offsets(a, b):
+    if a is b:
+        return True
+    try:
+        import numpy as np
+
+        return bool(np.array_equal(np.asarray(a), np.asarray(b)))
+    except Exception:
+        return False  # traced offsets: unknown → take the safe dense path
+
+
+def _splash_varlen(q, k, v, cu_q, cu_k, causal, scale):
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm,
+    )
+
+    tq, hq, d = q.shape
+    tk, hk = k.shape[0], k.shape[1]
+    pq, pk = (-tq) % 128, (-tk) % 128
+    qp = jnp.pad(q, ((0, pq), (0, 0), (0, 0)))
+    kp = jnp.pad(k, ((0, pk), (0, 0), (0, 0)))
+    vp = jnp.pad(v, ((0, pk), (0, 0), (0, 0)))
+    seg_q = varlen_segment_ids(cu_q, tq + pq)
+    seg_k = varlen_segment_ids(cu_k, tk + pk)
+    # padding tokens: their own segment, shared by q and k pads so every
+    # padded query row has at least one visible key (defined softmax)
+    if pq:
+        seg_q = seg_q.at[tq:].set(jnp.int32(2**30))
+    if pk:
+        seg_k = seg_k.at[tk:].set(jnp.int32(2**30))
+
+    qt = jnp.swapaxes(qp, 0, 1)  # [H, T, D]
+    kt = jnp.swapaxes(kp, 0, 1)
+    vt = jnp.swapaxes(vp, 0, 1)
+    key = ("varlen", hq, qt.shape[1], kt.shape[1], causal)
+    kernel = _SPLASH_CACHE.get(key)
+    if kernel is None:
+        mk = sm.CausalMask if causal else (lambda shape: sm.FullMask(shape))
+        mask = sm.MultiHeadMask([mk((qt.shape[1], kt.shape[1])) for _ in range(hq)])
+        kernel = sk.make_splash_mha(mask=mask, head_shards=1, q_seq_shards=1)
+        _SPLASH_CACHE[key] = kernel
+    seg = sk.SegmentIds(q=seg_q, kv=seg_k)
+    out = kernel((qt * scale).astype(vt.dtype), kt, vt, segment_ids=seg)
+    return jnp.swapaxes(out, 0, 1)[:tq]
+
+
+def _dense_varlen(q, k, v, cu_q, cu_k, causal, scale):
+    tq, tk = q.shape[0], k.shape[0]
+    hq, hk = q.shape[1], k.shape[1]
+    if hq != hk:  # GQA: expand kv heads for the dense path
+        k = jnp.repeat(k, hq // hk, axis=1)
+        v = jnp.repeat(v, hq // hk, axis=1)
+    seg_q = varlen_segment_ids(cu_q, tq)
+    seg_k = varlen_segment_ids(cu_k, tk)
+    logits = jnp.einsum("qhd,khd->hqk", q, k) * scale
+    mask = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        pos_q = jnp.arange(tq) - jnp.take(cu_q, seg_q)
+        pos_k = jnp.arange(tk) - jnp.take(cu_k, seg_k)
+        mask = mask & (pos_q[:, None] >= pos_k[None, :])
+    logits = jnp.where(mask[None], logits.astype(jnp.float32), -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)
+    return jnp.einsum("hqk,khd->qhd", probs, v)
 
 
 def flash_attention_fwd(q, k, v, causal=False, scale=None):
