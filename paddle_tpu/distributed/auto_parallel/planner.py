@@ -34,6 +34,10 @@ RECOMPUTE_WORKING_LAYERS = 8.0
 # (global sync + dispatch), a collective has a latency floor per hop.
 TICK_LATENCY_S = 1e-5
 COLL_LATENCY_S = 5e-6
+# Cross-slice data-center network: ~25 GB/s per chip vs ~400 GB/s ICI —
+# the reason ONLY the dcn_dp grad sync may cross slices (mesh.py).
+DCN_BW_DEFAULT = 2.5e10
+DCN_LATENCY_S = 5e-5
 
 
 @dataclasses.dataclass
@@ -50,9 +54,11 @@ class Plan:
     # keeps the live working set micro-batch-sized); the Engine must run
     # with at least this many accumulate steps or the act estimate is void
     accumulate_steps: int = 1
+    dcn_dp: int = 1  # slice-crossing data-parallel ways (multi-slice)
 
     def mesh_shape(self):
-        return dict(dp=self.dp, mp=self.mp, pp=self.pp, sharding=self.sharding)
+        return dict(dp=self.dp, mp=self.mp, pp=self.pp, sharding=self.sharding,
+                    dcn_dp=self.dcn_dp)
 
 
 def _divisor_tuples(n):
@@ -81,13 +87,22 @@ def plan_mesh(
     max_mp=8,
     dtype_bytes=2,
     min_axes=None,
+    n_slices=1,
+    dcn_bw=DCN_BW_DEFAULT,
 ):
     """Pick (dp, mp, pp, sharding) for `n_params` on `n_devices` chips.
 
     Returns the lowest-communication Plan that fits memory; raises if none
     fits. hidden_size/num_layers refine the mp/pp activation terms when
     known (else estimated from n_params, LLaMA-ish shape assumptions).
+    n_slices > 1 splits n_devices over that many TPU slices: the inner
+    factorization stays within a slice (ICI) and an extra grad all-reduce
+    over the dcn_dp axis is charged at DCN bandwidth.
     """
+    if n_slices > 1:
+        if n_devices % n_slices:
+            raise ValueError(f"{n_devices} devices not divisible by {n_slices} slices")
+        n_devices = n_devices // n_slices
     if hidden_size is None:
         # n ≈ 12 L h² and L ≈ h/128 → h ≈ (128 n / 12)^(1/3)
         hidden_size = int((128 * n_params / 12) ** (1 / 3))
@@ -110,11 +125,11 @@ def plan_mesh(
             param_bytes = n_params * dtype_bytes / (state_shard if zero3 else model_shard)
             opt_bytes = n_params * OPT_BYTES_PER_PARAM / state_shard
             # constant GLOBAL batch across candidates (fair cost comparison);
-            # each dp x sharding replica sees B / (dp*sh) samples, processed
-            # as micro-batches of batch_per_device (grad accumulation keeps
-            # the live working set micro-batch-sized regardless of dp)
-            B = batch_per_device * n_devices
-            replica_b = max(B // max(dp * sh, 1), 1)
+            # each dcn x dp x sharding replica sees B / (dcn*dp*sh) samples,
+            # processed as micro-batches of batch_per_device (grad
+            # accumulation keeps the live working set micro-batch-sized)
+            B = batch_per_device * n_devices * n_slices
+            replica_b = max(B // max(n_slices * dp * sh, 1), 1)
             micro_b = batch_per_device
             n_micro = max(replica_b // micro_b, 1)
             # full-recompute residency: one dtype-sized boundary activation
@@ -138,13 +153,18 @@ def plan_mesh(
             ICI_BW = 4e11  # v5e aggregate per-chip ICI ≈ 400 GB/s
             PEAK = 197e12  # bf16 FLOP/s per chip
             tokens = B * seq_len
-            compute_s = 6.0 * n_params * tokens / (n_devices * PEAK)
+            compute_s = 6.0 * n_params * tokens / (n_devices * n_slices * PEAK)
             P = n_params * dtype_bytes
             grad_sync_ways = dp * sh
             cost = 0.0
             if grad_sync_ways > 1:
                 cost += 2.0 * P / model_shard * (grad_sync_ways - 1) / grad_sync_ways / ICI_BW
                 cost += COLL_LATENCY_S * np.log2(grad_sync_ways)
+            if n_slices > 1:
+                # cross-slice grad all-reduce over the dcn_dp axis — the one
+                # collective allowed to ride the DCN
+                cost += (2.0 * P / model_shard * (n_slices - 1) / n_slices / dcn_bw
+                         + DCN_LATENCY_S * np.log2(n_slices))
             if zero3:
                 # per-step weight all-gather (XLA weight-update sharding)
                 cost += P / model_shard * (sh - 1) / sh / ICI_BW
@@ -180,7 +200,8 @@ def plan_mesh(
                      # pp>1: the pipe engine micro-batches internally (the
                      # in_flight term models it); only plain-path plans ask
                      # the Engine for gradient accumulation
-                     accumulate_steps=1 if pp > 1 else n_micro)
+                     accumulate_steps=1 if pp > 1 else n_micro,
+                     dcn_dp=n_slices)
             )
     if not candidates:
         raise ValueError(
@@ -214,6 +235,6 @@ def build_planned_mesh(plan, devices=None):
     from ..mesh import build_mesh, set_mesh
 
     mesh = build_mesh(dp=plan.dp, mp=plan.mp, pp=plan.pp, sharding=plan.sharding,
-                      devices=devices)
+                      dcn_dp=plan.dcn_dp, devices=devices)
     set_mesh(mesh)
     return mesh

@@ -32,6 +32,10 @@ def build_parser():
     p.add_argument("--elastic_level", type=int, default=-1,
                    help="-1/0: fail whole job on worker failure; 1: restart failed workers in place")
     p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--dcn_dp", type=int, default=1,
+                   help="TPU slice count for the hybrid ICI x DCN mesh: "
+                        "build_mesh puts ONLY data parallelism on the "
+                        "slice-crossing dcn_dp axis")
     p.add_argument("--run_mode", default="collective")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)

@@ -80,6 +80,9 @@ class CollectiveController:
                 "PADDLE_NODE_RANK": str(self.node_rank),
                 "PADDLE_TRAINER_ENDPOINTS": trainer_endpoints,
                 "PADDLE_JOB_ID": str(args.job_id),
+                # slice topology: build_mesh(dcn_dp=...) defaults to this so
+                # only data parallelism crosses the DCN (mesh.py)
+                "PADDLE_DCN_DP": str(getattr(args, "dcn_dp", 1) or 1),
                 # torch-style aliases many scripts read
                 "RANK": str(rank),
                 "WORLD_SIZE": str(world),

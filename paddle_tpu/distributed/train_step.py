@@ -61,7 +61,7 @@ class DistributedTrainStep(TrainStep):
     grad reduce-scatter), 3 = also shard parameters (FSDP)."""
 
     def __init__(self, model, loss_fn, optimizer, n_labels=1, scaler=None, mesh=None,
-                 sharding_stage=1, batch_axes=("dp", "sharding"), metrics_bus=None,
+                 sharding_stage=1, batch_axes=("dcn_dp", "dp", "sharding"), metrics_bus=None,
                  accumulate_steps=1):
         self.mesh = mesh if mesh is not None else get_mesh()
         self.sharding_stage = sharding_stage

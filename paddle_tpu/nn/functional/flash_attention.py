@@ -124,11 +124,7 @@ def flash_attn_unpadded(
     the Pallas splash kernel with dynamic SegmentIds — O(total·block)
     memory, no dense [total, total] score matrix; dense segment-masked
     math fallback elsewhere (ops.flash_attention.flash_attention_varlen_fwd)."""
-    import functools
-
-    from ...ops.flash_attention import flash_attention_varlen_fwd
-
-    from ...ops.flash_attention import _same_offsets
+    from ...ops.flash_attention import _same_offsets, flash_attention_varlen_fwd
 
     q, k, v = _t(query), _t(key), _t(value)
     cu_q = _t(cu_seqlens_q)._data
@@ -142,7 +138,7 @@ def flash_attn_unpadded(
     out = apply(
         functools.partial(
             flash_attention_varlen_fwd, cu_q=cu_q, cu_k=cu_k, causal=causal,
-            scale=scale, same_offsets=same,
+            scale=scale, same_offsets=same, force_math=not _flash_enabled(),
         ),
         q, k, v,
         name="flash_attn_varlen",

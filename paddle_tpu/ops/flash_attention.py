@@ -151,7 +151,7 @@ def varlen_segment_ids(cu_seqlens, total):
 
 
 def flash_attention_varlen_fwd(q, k, v, cu_q, cu_k, causal=True, scale=None,
-                               same_offsets=None):
+                               same_offsets=None, force_math=False):
     """Ragged/varlen flash attention on the packed [total, H, D] layout
     (reference: flash_attn_unpadded / flash_attn_varlen kernels; PAPERS.md
     ragged-paged-attention is the serving upgrade).
@@ -174,7 +174,7 @@ def flash_attention_varlen_fwd(q, k, v, cu_q, cu_k, causal=True, scale=None,
     if same_offsets is None:
         same_offsets = _same_offsets(cu_q, cu_k)
     offsets_ok = not causal or same_offsets
-    if _on_tpu() and dim_ok and offsets_ok and not _FORCE_XLA:
+    if _on_tpu() and dim_ok and offsets_ok and not _FORCE_XLA and not force_math:
         try:
             out = _splash_varlen(q, k, v, cu_q, cu_k, causal, scale)
             LAST_IMPL = "splash-varlen"

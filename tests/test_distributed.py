@@ -43,7 +43,7 @@ def build_model_and_step(mesh=None, stage=1, seed=3, lr=0.01, **cfg_kw):
 class TestMesh:
     def test_build_mesh_axes(self):
         m = M.build_mesh(dp=2, mp=2, pp=2)
-        assert m.axis_names == ("dp", "pp", "sharding", "sep", "mp")
+        assert m.axis_names == ("dcn_dp", "dp", "pp", "sharding", "sep", "mp")
         assert m.shape["dp"] == 2 and m.shape["mp"] == 2 and m.shape["pp"] == 2
 
     def test_topology_maps_to_mesh(self):
