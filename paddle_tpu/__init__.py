@@ -51,6 +51,7 @@ jit.save = jit_mod.save
 jit.load = jit_mod.load
 jit.not_to_static = jit_mod.not_to_static
 jit.enable_to_static = jit_mod.enable_to_static
+jit.ignore_module = jit_mod.ignore_module
 jit.TrainStep = jit_mod.TrainStep
 from . import vision
 from . import hapi

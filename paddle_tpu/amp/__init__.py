@@ -3,3 +3,17 @@ from .grad_scaler import AmpScaler, GradScaler
 
 __all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "AmpScaler"]
 from . import debugging
+
+
+def is_bfloat16_supported(place=None):
+    """TPU MXUs are bf16-native; CPU XLA emulates bf16 correctly."""
+    return True
+
+
+def is_float16_supported(place=None):
+    import jax
+
+    try:
+        return jax.devices()[0].platform in ("tpu", "gpu")
+    except Exception:
+        return False

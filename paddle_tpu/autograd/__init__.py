@@ -1,5 +1,6 @@
 """Autograd utilities (reference: python/paddle/autograd/)."""
-from ..framework.core import Tensor, no_grad, no_grad_guard, to_tensor
+from ..framework.core import (Tensor, is_grad_enabled, no_grad, no_grad_guard,
+                              set_grad_enabled, to_tensor)
 from .backward_mode import backward
 from .functional import grad, jacobian, hessian, vjp, jvp
 from .py_layer import PyLayer, PyLayerContext

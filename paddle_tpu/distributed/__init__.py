@@ -1,5 +1,6 @@
 """paddle_tpu.distributed (reference: python/paddle/distributed/)."""
 from . import env, mesh
+from . import launch  # noqa: F401
 from .communication import (
     Group,
     ReduceOp,
@@ -61,3 +62,49 @@ def is_available():
 
 def get_backend():
     return "xla"
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference: distributed.wait — stream sync. XLA dispatch is async but
+    ordered; block_until_ready gives the strong guarantee."""
+    t = tensor
+    if hasattr(t, "_data") and hasattr(t._data, "block_until_ready"):
+        t._data.block_until_ready()
+    return t
+
+
+def all_gather_object(object_list, obj, group=None):
+    """reference: distributed.all_gather_object. In the SPMD model every
+    process computes the same program, so the gathered list is the object
+    replicated world-size times (multi-host object transport rides the
+    TCPStore rendezvous, not the device network)."""
+    n = get_world_size() if get_world_size() > 0 else 1
+    object_list.extend([obj] * n)
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference: distributed.split — build a model-parallel linear/embedding
+    sharded over `num_partitions` mp ranks. On TPU the partitioning is a
+    PartitionSpec on the weight; GSPMD inserts the collectives."""
+    from .fleet.meta_parallel.mp_layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+
+    if operation == "linear":
+        in_f, out_f = size
+        layer = (ColumnParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      gather_output=gather_out)
+                 if axis == 1 else
+                 RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                   has_bias=bias_attr is not False,
+                                   input_is_parallel=False))
+        return layer(x)
+    if operation == "embedding":
+        num_emb, emb_dim = size
+        layer = VocabParallelEmbedding(num_emb, emb_dim, weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unknown split operation {operation!r}")

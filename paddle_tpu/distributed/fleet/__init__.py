@@ -2,7 +2,8 @@
 
 Module-level functions delegate to the singleton Fleet, as in the reference.
 """
-from . import meta_parallel
+from . import meta_parallel, utils
+from .recompute import recompute, recompute_sequential  # noqa: F401
 from .distributed_strategy import DistributedStrategy
 from .fleet import Fleet, PaddleCloudRoleMaker, UserDefinedRoleMaker, fleet_singleton as _f
 from .hybrid_optimizer import HybridParallelClipGrad, HybridParallelOptimizer

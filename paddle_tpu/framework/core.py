@@ -64,6 +64,34 @@ def is_grad_enabled():
     return _grad_enabled()
 
 
+class set_grad_enabled:
+    """paddle.set_grad_enabled parity: immediate toggle that also works as
+    a context manager (restores the previous mode on exit)."""
+
+    def __init__(self, mode):
+        self._prev = _grad_enabled()
+        _tls.grad_enabled = bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        _tls.grad_enabled = self._prev
+        return False
+
+
+def init_tensor_slots(t, name=None):
+    """Bootstrap Tensor's bookkeeping slots for subclasses that do NOT call
+    Tensor.__init__ (symbolic/sparse tensors with a lazy or absent _data).
+    Single source of truth next to __slots__ — keep in lock-step."""
+    t.stop_gradient = True
+    t.grad = None
+    t._node = None
+    t._out_idx = 0
+    t._hooks = []
+    t.name = name
+
+
 class GradNode:
     """One recorded op on the tape (reference: eager/grad_node_info.h
     GradNodeBase). Holds the vjp closure and edges to input tensors."""
@@ -445,10 +473,16 @@ def apply(fn, *tensors, name="", n_outputs=None, **kw):
     over as constants (no float0 cotangent bookkeeping).
     """
     tensors = [t if isinstance(t, Tensor) else Tensor(t) for t in tensors]
-    datas = [t._data for t in tensors]
     if kw:
         base = fn
         fn = lambda *xs: base(*xs, **kw)
+    if any(getattr(t, "_is_static_var", False) for t in tensors):
+        # static-graph mode: record the op on the default Program instead of
+        # executing (paddle.static — symbolic Variables have no data)
+        from ..static import record_static_op
+
+        return record_static_op(fn, tensors, name=name)
+    datas = [t._data for t in tensors]
 
     diff_mask = [
         (not t.stop_gradient) and _is_inexact(t.dtype) and _grad_enabled() for t in tensors

@@ -4,3 +4,12 @@ from . import distributed, nn
 from .nn import functional
 
 from . import asp
+from .nn.functional import (  # noqa: F401
+    graph_send_recv,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)

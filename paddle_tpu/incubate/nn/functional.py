@@ -167,3 +167,106 @@ def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
         return losses.reshape(-1)[: lab.size].reshape(lab.shape)
 
     return apply(fn, hidden, weight, labels, name="fused_linear_cross_entropy")
+
+
+def segment_sum(data, segment_ids, name=None):
+    """reference: incubate.segment_sum — jax.ops.segment_sum, the TPU-native
+    lowering of the phi segment kernels."""
+    import jax
+
+    d, s = _t(data), _t(segment_ids)
+    n = int(jnp.max(s._data)) + 1 if s._data.size else 0
+    return apply(lambda a, i: jax.ops.segment_sum(a, i, num_segments=n), d, s,
+                 name="segment_sum")
+
+
+def _segment_reduce(reducer):
+    import jax
+
+    def op(data, segment_ids, name=None):
+        d, s = _t(data), _t(segment_ids)
+        n = int(jnp.max(s._data)) + 1 if s._data.size else 0
+
+        def fn(a, i):
+            out = reducer(a, i, n)
+            # empty segments → 0 (paddle semantics), detected by COUNT so
+            # integer sentinels and legitimate ±inf values both survive
+            cnt = jax.ops.segment_sum(jnp.ones(i.shape, jnp.int32), i, num_segments=n)
+            cnt = cnt.reshape(cnt.shape + (1,) * (out.ndim - 1))
+            return jnp.where(cnt > 0, out, jnp.zeros((), out.dtype))
+
+        return apply(fn, d, s, name="segment_reduce")
+
+    return op
+
+
+def _seg_mean(a, i, n):
+    import jax
+
+    tot = jax.ops.segment_sum(a, i, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones(a.shape[:1], a.dtype), i, num_segments=n)
+    cnt = cnt.reshape(cnt.shape + (1,) * (a.ndim - 1))
+    return tot / jnp.maximum(cnt, 1)
+
+
+def _seg_max(a, i, n):
+    import jax
+
+    return jax.ops.segment_max(a, i, num_segments=n)
+
+
+def _seg_min(a, i, n):
+    import jax
+
+    return jax.ops.segment_min(a, i, num_segments=n)
+
+
+segment_mean = _segment_reduce(_seg_mean)
+segment_max = _segment_reduce(_seg_max)
+segment_min = _segment_reduce(_seg_min)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference: incubate.softmax_mask_fuse — additive mask + softmax in
+    one fused expression (XLA fuses into adjacent matmuls)."""
+    return apply(
+        lambda a, m: jax.nn.softmax(a.astype(jnp.float32) + m.astype(jnp.float32), axis=-1).astype(a.dtype),
+        _t(x), _t(mask), name="softmax_mask_fuse",
+    )
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    def fn(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((a.shape[-2], s), bool), k=s - a.shape[-2])
+        logits = jnp.where(mask, a.astype(jnp.float32), jnp.finfo(jnp.float32).min)
+        return jax.nn.softmax(logits, axis=-1).astype(a.dtype)
+
+    return apply(fn, _t(x), name="softmax_mask_fuse_upper_triangle")
+
+
+def graph_send_recv(x, src_index, dst_index, reduce_op="sum", out_size=None, name=None):
+    """reference: incubate.graph_send_recv — gather messages at src, reduce
+    at dst (segment reduction over edges)."""
+    import jax
+
+    if reduce_op not in ("sum", "max", "min", "mean"):
+        raise ValueError(f"graph_send_recv: unsupported reduce_op {reduce_op!r}")
+    xd, si, di = _t(x), _t(src_index), _t(dst_index)
+    n = out_size or int(xd.shape[0])
+    red = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}.get(reduce_op)
+
+    def fn(a, s, d):
+        msgs = a[s]
+        cnt = jax.ops.segment_sum(jnp.ones(d.shape, jnp.int32), d, num_segments=n)
+        cshape = cnt.reshape(cnt.shape + (1,) * (a.ndim - 1))
+        if red is not None:
+            out = red(msgs, d, num_segments=n)
+            if reduce_op in ("max", "min"):
+                out = jnp.where(cshape > 0, out, jnp.zeros((), out.dtype))
+            return out
+        tot = jax.ops.segment_sum(msgs, d, num_segments=n)
+        return tot / jnp.maximum(cshape, 1).astype(tot.dtype)
+
+    return apply(fn, xd, si, di, name="graph_send_recv")

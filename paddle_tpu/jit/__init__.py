@@ -33,12 +33,27 @@ def load(path, **configs):
 
 
 def enable_to_static(flag):
+    """ProgramTranslator.enable parity: when False, @to_static returns the
+    object UNCONVERTED (eager execution for debugging) — jit_api.to_static
+    consults this flag at decoration time."""
     global _to_static_enabled
     _to_static_enabled = bool(flag)
 
 
 _to_static_enabled = True
 
+_ignored_modules = set()
+
 
 def ignore_module(modules):
-    pass
+    """Mark modules whose functions @to_static leaves unconverted
+    (reference: dy2static ignore_module). Functions defined in an ignored
+    module run eagerly inside the traced program — under jax tracing they
+    are inlined anyway, so this registry only gates explicit @to_static
+    decoration."""
+    for m in modules if isinstance(modules, (list, tuple, set)) else [modules]:
+        _ignored_modules.add(getattr(m, "__name__", str(m)))
+
+
+def is_ignored(fn):
+    return getattr(fn, "__module__", None) in _ignored_modules

@@ -47,10 +47,20 @@ def jit(fn=None, static_argnums=None, donate_argnums=None, backend=None):
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
     """@paddle.jit.to_static parity. If applied to a Layer, returns a wrapper
-    whose __call__ runs the compiled functional forward."""
+    whose __call__ runs the compiled functional forward. Honors
+    jit.enable_to_static(False) (reference: ProgramTranslator.enable) — the
+    object is returned unconverted for eager debugging — and skips functions
+    marked @not_to_static."""
     from .nn.layer.layers import Layer
 
     def deco(obj):
+        import importlib
+
+        jit_ns = importlib.import_module(__package__ + ".jit")
+        if not getattr(jit_ns, "_to_static_enabled", True):
+            return obj
+        if getattr(obj, "_not_to_static", False) or jit_ns.is_ignored(obj):
+            return obj
         if isinstance(obj, Layer):
             return StaticLayer(obj)
         return jit(obj)

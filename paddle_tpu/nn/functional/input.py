@@ -1,2 +1,16 @@
 """Input ops (reference: python/paddle/nn/functional/input.py)."""
 from .common import embedding, one_hot  # noqa: F401
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """reference: nn.functional.sequence_mask — mask[i, j] = j < x[i]."""
+    import jax.numpy as jnp
+
+    from ...framework import dtype as dtypes
+    from ...framework.core import apply, to_tensor
+
+    xt = to_tensor(x)
+    m = int(maxlen) if maxlen is not None else int(jnp.max(xt._data))
+    dt = dtypes.convert_dtype(dtype)
+    return apply(lambda a: (jnp.arange(m) < a[..., None]).astype(dt), xt,
+                 name="sequence_mask")

@@ -28,6 +28,7 @@ Tanh = _mk("Tanh", "tanh")
 Tanhshrink = _mk("Tanhshrink", "tanhshrink")
 Softsign = _mk("Softsign", "softsign")
 Silu = _mk("Silu", "silu")
+SiLU = Silu  # torch-style alias the reference also accepts
 Swish = _mk("Swish", "swish")
 Mish = _mk("Mish", "mish")
 LogSigmoid = _mk("LogSigmoid", "log_sigmoid")

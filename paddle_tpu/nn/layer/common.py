@@ -246,3 +246,26 @@ class Unflatten(Layer):
         s = list(x.shape)
         ax = self.axis if self.axis >= 0 else len(s) + self.axis
         return reshape(x, s[:ax] + self.shape_ + s[ax + 1:])
+
+
+class PairwiseDistance(Layer):
+    """reference: nn.PairwiseDistance — p-norm distance along the last axis."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from ...framework.core import apply, to_tensor
+        import jax.numpy as jnp
+
+        def fn(a, b):
+            d = jnp.abs(a - b + self.epsilon)
+            if self.p == 0:
+                return jnp.sum((d != 0).astype(a.dtype), axis=-1, keepdims=self.keepdim)
+            if jnp.isinf(self.p):
+                red = jnp.min if self.p < 0 else jnp.max
+                return red(d, axis=-1, keepdims=self.keepdim)
+            return jnp.sum(d ** self.p, axis=-1, keepdims=self.keepdim) ** (1.0 / self.p)
+
+        return apply(fn, to_tensor(x), to_tensor(y), name="pairwise_distance")

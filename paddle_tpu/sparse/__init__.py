@@ -1,25 +1,51 @@
 """paddle.sparse parity (reference: python/paddle/sparse/ + phi sparse
 kernels).
 
-TPU note: XLA has no native sparse layouts; COO/CSR tensors here are
-index+values containers whose compute lowers to dense/segment ops (gather,
-scatter-add, segment_sum) — the idiomatic TPU treatment of sparsity. The API
-surface (sparse_coo_tensor, to_dense, matmul, nn.ReLU...) mirrors the
-reference.
+TPU note: XLA has no native sparse layouts, so COO/CSR tensors here are
+REAL index+values containers — O(nnz) storage, with compute lowered to the
+idiomatic TPU sparse treatment (gather + segment_sum, value-space
+elementwise). Densification happens ONLY when a dense view is explicitly
+required (`to_dense()`, or a dense-op fallback), never in the constructor.
 """
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.core import Tensor, to_tensor
+from ..framework.core import Tensor, apply, init_tensor_slots, to_tensor
 
 
 class SparseCooTensor(Tensor):
+    """COO container: `_indices` [ndim, nnz] + `_values` [nnz, ...].
+    Subclasses Tensor with a LAZY `_data`: dense materialization is cached
+    on first dense access, so sparse-native paths stay O(nnz)."""
+
     def __init__(self, indices, values, shape):
+        init_tensor_slots(self)
         self._indices = indices  # [ndim, nnz] int array
         self._values = values  # [nnz, ...] array
         self._dense_shape = tuple(int(s) for s in shape)
-        dense = jnp.zeros(self._dense_shape, values.dtype).at[tuple(indices)].add(values)
-        super().__init__(dense, stop_gradient=True)
+        self._dense_cache = None
+
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = (
+                jnp.zeros(self._dense_shape, self._values.dtype)
+                .at[tuple(self._indices)].add(self._values)
+            )
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, v):
+        self._dense_cache = v
+
+    @property
+    def shape(self):
+        return list(self._dense_shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
 
     def indices(self):
         return Tensor(self._indices)
@@ -36,14 +62,46 @@ class SparseCooTensor(Tensor):
     def nnz(self):
         return self._values.shape[0]
 
+    def _with_values(self, values):
+        return SparseCooTensor(self._indices, values, self._dense_shape)
+
 
 class SparseCsrTensor(Tensor):
+    """CSR container: `_crows` [rows+1], `_cols` [nnz], `_values` [nnz];
+    lazy dense view like SparseCooTensor."""
+
     def __init__(self, crows, cols, values, shape):
+        init_tensor_slots(self)
         self._crows, self._cols, self._values = crows, cols, values
         self._dense_shape = tuple(int(s) for s in shape)
-        rows = jnp.repeat(jnp.arange(len(crows) - 1), jnp.diff(crows))
-        dense = jnp.zeros(self._dense_shape, values.dtype).at[rows, cols].add(values)
-        super().__init__(dense, stop_gradient=True)
+        self._dense_cache = None
+
+    def _rows(self):
+        return jnp.repeat(
+            jnp.arange(len(self._crows) - 1), jnp.diff(self._crows),
+            total_repeat_length=self._values.shape[0],
+        )
+
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = (
+                jnp.zeros(self._dense_shape, self._values.dtype)
+                .at[self._rows(), self._cols].add(self._values)
+            )
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, v):
+        self._dense_cache = v
+
+    @property
+    def shape(self):
+        return list(self._dense_shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
 
     def crows(self):
         return Tensor(self._crows)
@@ -59,6 +117,12 @@ class SparseCsrTensor(Tensor):
 
     def is_sparse_csr(self):
         return True
+
+    def nnz(self):
+        return self._values.shape[0]
+
+    def _with_values(self, values):
+        return SparseCsrTensor(self._crows, self._cols, values, self._dense_shape)
 
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
@@ -86,13 +150,47 @@ def is_same_shape(x, y):
     return tuple(x.shape) == tuple(y.shape)
 
 
+def _rows_cols_vals(x):
+    if isinstance(x, SparseCooTensor):
+        return x._indices[0], x._indices[1], x._values
+    if isinstance(x, SparseCsrTensor):
+        return x._rows(), x._cols, x._values
+    return None
+
+
 def matmul(x, y, name=None):
+    """Sparse [M, N] @ dense [N, K] as gather + segment_sum — O(nnz·K),
+    the dense score matrix is never built (reference: phi sparse matmul
+    kernels; TPU treatment per SURVEY §2.1)."""
+    rcv = _rows_cols_vals(x)
+    if rcv is not None and len(x._dense_shape) == 2:
+        rows, cols, vals = rcv
+        m = x._dense_shape[0]
+
+        def fn(v, yd):
+            prod = v.reshape(v.shape[0], *([1] * (yd.ndim - 1))) * yd[cols]
+            return jax.ops.segment_sum(prod, rows, num_segments=m)
+
+        # taped: gradients flow to the dense operand (and to values, were
+        # they ever non-stop-gradient)
+        yt = y if isinstance(y, Tensor) else to_tensor(y)
+        return apply(fn, Tensor(vals), yt, name="sparse_matmul")
     from ..tensor import linalg
 
     return linalg.matmul(x.to_dense() if hasattr(x, "to_dense") else x, y)
 
 
 def masked_matmul(x, y, mask, name=None):
+    """Dense @ dense evaluated ONLY at mask's nnz positions (reference:
+    masked_matmul / SDDMM): out[i,j] = x[i] · y[:,j] for (i,j) in mask."""
+    rcv = _rows_cols_vals(mask)
+    xd, yd = to_tensor(x)._data, to_tensor(y)._data
+    if rcv is not None:
+        rows, cols, _ = rcv
+        vals = jnp.einsum("nk,nk->n", xd[rows], yd[:, cols].T)
+        if isinstance(mask, SparseCsrTensor):
+            return SparseCsrTensor(mask._crows, mask._cols, vals, mask._dense_shape)
+        return SparseCooTensor(mask._indices, vals, mask._dense_shape)
     from ..tensor import linalg
 
     out = linalg.matmul(x, y)
@@ -100,14 +198,42 @@ def masked_matmul(x, y, mask, name=None):
 
 
 def add(x, y, name=None):
-    return Tensor(x._data + y._data)
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        # structural union: concatenate (duplicates sum on densify — COO
+        # semantics), O(nnz_x + nnz_y)
+        return SparseCooTensor(
+            jnp.concatenate([x._indices, y._indices], axis=1),
+            jnp.concatenate([x._values, y._values]),
+            x._dense_shape,
+        )
+    return Tensor(x._data + to_tensor(y)._data)
 
 
 def multiply(x, y, name=None):
-    return Tensor(x._data * y._data)
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)) and np.isscalar(y):
+        return x._with_values(x._values * y)
+    return Tensor(x._data * to_tensor(y)._data)
+
+
+def _value_unary(fn):
+    def op(x, name=None):
+        if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+            return x._with_values(fn(x._values))
+        return Tensor(fn(to_tensor(x)._data))
+
+    return op
+
+
+relu = _value_unary(lambda v: jnp.maximum(v, 0))
+sin = _value_unary(jnp.sin)
+tanh = _value_unary(jnp.tanh)
+sqrt = _value_unary(jnp.sqrt)
+abs = _value_unary(jnp.abs)  # noqa: A001 — paddle.sparse.abs parity
+expm1 = _value_unary(jnp.expm1)
+neg = _value_unary(jnp.negative)
 
 
 class nn:
     class ReLU:
         def __call__(self, x):
-            return Tensor(jnp.maximum(x._data, 0))
+            return relu(x)

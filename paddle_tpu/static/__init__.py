@@ -1,16 +1,35 @@
-"""Static-graph compatibility layer (reference: python/paddle/static/).
+"""Static-graph compatibility layer (reference: python/paddle/static/ —
+Program/Executor/InterpreterCore in paddle/fluid/framework/new_executor/).
 
-On TPU, "static mode" IS jax.jit — the traced program is the Program and XLA
-is the executor (reference: Program/Executor/InterpreterCore in
-paddle/fluid/framework/new_executor/, which SURVEY.md §3.5 maps to XLA).
-This module keeps the script-level API (enable_static, Executor, data) as a
-thin veneer: programs are recorded as traced python callables.
+On TPU, "static mode" IS lazy tracing + XLA execution. This module makes the
+classic script workflow REAL, not a veneer:
+
+    paddle.enable_static()
+    x = paddle.static.data("x", [None, 8])      # symbolic Variable
+    y = paddle.mean(paddle.nn.functional.relu(x @ w))   # ops RECORD, not run
+    exe = paddle.static.Executor()
+    (out,) = exe.run(feed={"x": arr}, fetch_list=[y])   # evaluates the graph
+
+Mechanics: `data()` returns a symbolic `Variable`; `framework.core.apply`
+detects symbolic inputs and records the op (fn + input refs) into the
+default Program instead of executing, with shapes inferred via
+jax.eval_shape. `Executor.run` memo-evaluates the recorded graph on the
+feeds (each fetch set is jit-compiled and cached on the Program).
+
+Scope: forward graphs. `append_backward`-style static autodiff is NOT
+supported — training uses the dygraph TrainStep (one jit with tape
+backward), which subsumes it on this substrate.
 """
-import jax
+import itertools
 
-from ..framework.core import Tensor, to_tensor
+import jax
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, init_tensor_slots, to_tensor
 
 _static_mode = False
+_var_counter = itertools.count()
 
 
 def enable_static():
@@ -27,9 +46,105 @@ def in_static_mode():
     return _static_mode
 
 
+class _Op:
+    """One recorded op: raw-array fn + ordered inputs (Variables or
+    concrete Tensors closed over as constants)."""
+
+    __slots__ = ("fn", "inputs", "n_outputs")
+
+    def __init__(self, fn, inputs, n_outputs):
+        self.fn = fn
+        self.inputs = inputs
+        self.n_outputs = n_outputs
+
+
+class Variable(Tensor):
+    """Symbolic static-graph tensor: shape/dtype known (−1 = dynamic),
+    no data until Executor.run."""
+
+    _is_static_var = True
+
+    def __init__(self, name=None, shape=(), dtype="float32", op=None, out_idx=0):
+        init_tensor_slots(self, name=name or f"tmp_{next(_var_counter)}")
+        self._shape = [-1 if s is None else int(s) for s in shape]
+        self._dtype = dtypes.convert_dtype(dtype) if isinstance(dtype, str) else dtype
+        self._op = op
+        self._op_out = out_idx
+
+    @property
+    def _data(self):
+        raise TypeError(
+            f"static Variable '{self.name}' has no data — run it through "
+            "paddle.static.Executor().run(feed=..., fetch_list=[...])"
+        )
+
+    @_data.setter
+    def _data(self, v):  # pragma: no cover — defensive
+        raise TypeError("static Variables are symbolic; cannot assign data")
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def __repr__(self):
+        return f"Variable(name={self.name}, shape={self._shape}, dtype={self._dtype})"
+
+
+def record_static_op(fn, tensors, name=""):
+    """Called by framework.core.apply when any input is symbolic: infer
+    output shapes abstractly and append the op to the default Program."""
+    def abstracts(dyn_sub):
+        out = []
+        for t in tensors:
+            if getattr(t, "_is_static_var", False):
+                shape = tuple(dyn_sub if s == -1 else s for s in t._shape)
+                out.append(jax.ShapeDtypeStruct(shape, t._dtype))
+            else:
+                out.append(jax.ShapeDtypeStruct(tuple(t._data.shape), t._data.dtype))
+        return out
+
+    # probe with two different substitutions for dynamic dims: output dims
+    # that move with the substitution are themselves dynamic (-1)
+    has_dynamic = any(
+        getattr(t, "_is_static_var", False) and -1 in t._shape for t in tensors
+    )
+    out = jax.eval_shape(fn, *abstracts(1))
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    if has_dynamic:
+        out2 = jax.eval_shape(fn, *abstracts(2))
+        outs2 = list(out2) if multi else [out2]
+        outs = [
+            jax.ShapeDtypeStruct(
+                tuple(-1 if d1 != d2 else d1 for d1, d2 in zip(o1.shape, o2.shape)),
+                o1.dtype,
+            )
+            for o1, o2 in zip(outs, outs2)
+        ]
+    op = _Op(fn, list(tensors), len(outs))
+    prog = default_main_program()
+    vars_ = [
+        Variable(name=f"{name or 'op'}_{next(_var_counter)}",
+                 shape=o.shape, dtype=o.dtype, op=op, out_idx=i)
+        for i, o in enumerate(outs)
+    ]
+    prog._vars.extend(vars_)
+    return type(out)(vars_) if multi else vars_[0]
+
+
 class Program:
     def __init__(self):
-        self._fns = []
+        self._vars = []
+        self._inputs = {}
+        self._exec_cache = {}
         self.random_seed = None
 
     def global_block(self):
@@ -37,6 +152,9 @@ class Program:
 
     def clone(self, for_test=False):
         return self
+
+    def list_vars(self):
+        return list(self._inputs.values()) + list(self._vars)
 
 
 _default_main = Program()
@@ -51,10 +169,26 @@ def default_startup_program():
     return _default_startup
 
 
-def program_guard(main_program, startup_program=None):
-    import contextlib
+class program_guard:
+    """Swap the default main/startup programs for the `with` body
+    (reference: static.program_guard)."""
 
-    return contextlib.nullcontext()
+    def __init__(self, main_program, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def __enter__(self):
+        global _default_main, _default_startup
+        self._prev = (_default_main, _default_startup)
+        _default_main = self._main
+        if self._startup is not None:
+            _default_startup = self._startup
+        return self._main
+
+    def __exit__(self, *exc):
+        global _default_main, _default_startup
+        _default_main, _default_startup = self._prev
+        return False
 
 
 class InputSpec:
@@ -76,7 +210,13 @@ class InputSpec:
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    return InputSpec(shape, dtype, name)
+    """In static mode: a symbolic graph input registered on the default
+    Program. In dygraph mode: an InputSpec (the to_static contract)."""
+    if not _static_mode:
+        return InputSpec(shape, dtype, name)
+    v = Variable(name=name, shape=shape, dtype=dtype)
+    default_main_program()._inputs[name] = v
+    return v
 
 
 class Executor:
@@ -84,11 +224,40 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None):
-        # static programs are python callables under jit in this framework
-        if callable(program):
+        # to_static-compiled callables execute directly
+        if callable(program) and not isinstance(program, Program):
             out = program(**{k: to_tensor(v) for k, v in (feed or {}).items()})
             return out if isinstance(out, (list, tuple)) else [out]
-        raise NotImplementedError(
-            "Executor.run over legacy Program objects is not supported; use "
-            "paddle_tpu.jit.to_static-compiled callables (XLA is the executor)"
-        )
+        program = program if program is not None else default_main_program()
+        fetch_list = fetch_list or []
+        if not fetch_list:
+            return []  # startup programs have nothing to compute here
+        feed = {k: to_tensor(v)._data for k, v in (feed or {}).items()}
+
+        # one jitted evaluator per (fetch set, feed signature), cached
+        key = (tuple(id(f) for f in fetch_list),
+               tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in feed.items())))
+        runner = program._exec_cache.get(key)
+        if runner is None:
+            def evaluate(feed_arrays):
+                memo = {}
+
+                def ev(v):
+                    if not getattr(v, "_is_static_var", False):
+                        return v._data
+                    if v._op is None:
+                        if v.name not in feed_arrays:
+                            raise KeyError(
+                                f"Executor.run: feed missing input '{v.name}'")
+                        return feed_arrays[v.name]
+                    if id(v._op) not in memo:
+                        args = [ev(t) for t in v._op.inputs]
+                        out = v._op.fn(*args)
+                        memo[id(v._op)] = out if isinstance(out, (tuple, list)) else (out,)
+                    return memo[id(v._op)][v._op_out]
+
+                return [ev(f) for f in fetch_list]
+
+            runner = program._exec_cache[key] = jax.jit(evaluate)
+        outs = runner(feed)
+        return [np.asarray(o) for o in outs]

@@ -5,7 +5,7 @@ as Tensor methods + Python operators (reference installs methods via
 monkey-patching in python/paddle/tensor/__init__.py too).
 """
 from ..framework.core import Tensor
-from . import creation, einsum as _einsum_mod, linalg, logic, manipulation, math, search
+from . import creation, einsum as _einsum_mod, extras, linalg, logic, manipulation, math, search
 from .creation import *  # noqa: F401,F403
 from .einsum import einsum  # noqa: F401
 from .linalg import *  # noqa: F401,F403
@@ -13,8 +13,9 @@ from .logic import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
-_METHOD_SOURCES = [math, manipulation, linalg, logic, search, creation]
+_METHOD_SOURCES = [math, manipulation, linalg, logic, search, creation, extras]
 
 # name → (module, function) explicit method table where names differ
 _EXPLICIT = {

@@ -1,0 +1,349 @@
+"""Op-surface sprint (reference: python/paddle/tensor/{math,manipulation,
+creation,linalg}.py long tail). Same contract as math.py: every op is a
+jnp lambda under `apply`, so XLA fuses chains of these under jit.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as prandom
+from ..framework.core import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+# ---- elementwise / special-function math -----------------------------------
+
+def sgn(x, name=None):
+    """Complex-aware sign: x/|x| for complex, jnp.sign for real."""
+    def fn(a):
+        if jnp.iscomplexobj(a):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(a)
+
+    return apply(fn, _t(x), name="sgn")
+
+
+def sinc(x, name=None):
+    return apply(jnp.sinc, _t(x), name="sinc")
+
+
+def signbit(x, name=None):
+    return apply(jnp.signbit, _t(x), name="signbit")
+
+
+def ldexp(x, y, name=None):
+    return apply(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), _t(x), _t(y), name="ldexp")
+
+
+def frexp(x, name=None):
+    return apply(lambda a: jnp.frexp(a), _t(x), name="frexp")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        out = jax.lax.associative_scan(jnp.logaddexp, a.astype(jnp.float32), axis=ax)
+        return out.astype(dtype or a.dtype) if dtype or not jnp.issubdtype(a.dtype, jnp.floating) else out.astype(a.dtype)
+
+    return apply(fn, _t(x), name="logcumsumexp")
+
+
+def cumulative_trapezoid(y, x=None, dx=1.0, axis=-1, name=None):
+    def core(ya, xa=None):
+        n = ya.shape[axis]
+        y0 = jax.lax.slice_in_dim(ya, 0, n - 1, axis=axis)
+        y1 = jax.lax.slice_in_dim(ya, 1, n, axis=axis)
+        if xa is not None:
+            x0 = jax.lax.slice_in_dim(xa, 0, n - 1, axis=axis)
+            x1 = jax.lax.slice_in_dim(xa, 1, n, axis=axis)
+            steps = x1 - x0
+        else:
+            steps = dx
+        return jnp.cumsum((y0 + y1) * 0.5 * steps, axis=axis)
+
+    if x is None:
+        return apply(core, _t(y), name="cumulative_trapezoid")
+    return apply(core, _t(y), _t(x), name="cumulative_trapezoid")
+
+
+def gammaln(x, name=None):
+    return apply(jax.scipy.special.gammaln, _t(x), name="gammaln")
+
+
+def gammainc(x, y, name=None):
+    return apply(jax.scipy.special.gammainc, _t(x), _t(y), name="gammainc")
+
+
+def gammaincc(x, y, name=None):
+    return apply(jax.scipy.special.gammaincc, _t(x), _t(y), name="gammaincc")
+
+
+def multigammaln(x, p, name=None):
+    return apply(lambda a: jax.scipy.special.multigammaln(a, p), _t(x), name="multigammaln")
+
+
+def polygamma(x, n, name=None):
+    return apply(lambda a: jax.scipy.special.polygamma(n, a), _t(x), name="polygamma")
+
+
+def i0e(x, name=None):
+    return apply(jax.scipy.special.i0e, _t(x), name="i0e")
+
+
+def i1e(x, name=None):
+    return apply(jax.scipy.special.i1e, _t(x), name="i1e")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), _t(x),
+                 name="nanmedian")
+
+
+def isneginf(x, name=None):
+    return apply(jnp.isneginf, _t(x), name="isneginf")
+
+
+def isposinf(x, name=None):
+    return apply(jnp.isposinf, _t(x), name="isposinf")
+
+
+def isreal(x, name=None):
+    return apply(jnp.isreal, _t(x), name="isreal")
+
+
+def is_complex(x):
+    return jnp.issubdtype(_t(x)._data.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_t(x)._data.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_t(x)._data.dtype, jnp.integer)
+
+
+# ---- complex construction ---------------------------------------------------
+
+def polar(abs, angle, name=None):  # noqa: A002 — paddle signature
+    return apply(lambda r, t: (r * jnp.exp(1j * t.astype(jnp.complex64))).astype(jnp.complex64),
+                 _t(abs), _t(angle), name="polar")
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), _t(x), name="as_complex")
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), _t(x),
+                 name="as_real")
+
+
+# ---- creation ---------------------------------------------------------------
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=float(base),
+                               dtype=dtype or jnp.float32))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply(lambda a: jnp.vander(a, N=n, increasing=increasing), _t(x), name="vander")
+
+
+def poisson(x, name=None):
+    """Sample Poisson(lam=x) elementwise (reference: paddle.poisson)."""
+    key = prandom.next_key()
+    return apply(lambda lam: jax.random.poisson(key, lam, lam.shape).astype(lam.dtype),
+                 _t(x), name="poisson")
+
+
+# ---- manipulation -----------------------------------------------------------
+
+def cat(x, axis=0, name=None):
+    from .manipulation import concat
+
+    return concat(x, axis=axis)
+
+
+def cast(x, dtype):
+    return _t(x).astype(dtype)
+
+
+def permute(x, *perm):
+    from .manipulation import transpose
+
+    if len(perm) == 1 and isinstance(perm[0], (list, tuple)):
+        perm = tuple(perm[0])
+    return transpose(_t(x), perm)
+
+
+def column_stack(x, name=None):
+    return apply(lambda *arrs: jnp.column_stack(arrs), *[_t(a) for a in x],
+                 name="column_stack")
+
+
+def fliplr(x, name=None):
+    return apply(jnp.fliplr, _t(x), name="fliplr")
+
+
+def flipud(x, name=None):
+    return apply(jnp.flipud, _t(x), name="flipud")
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def fn(a):
+        if isinstance(num_or_indices, int):
+            return tuple(jnp.array_split(a, num_or_indices, axis=axis))
+        return tuple(jnp.split(a, list(num_or_indices), axis=axis))
+
+    return list(apply(fn, _t(x), name="tensor_split"))
+
+
+def unflatten(x, axis, shape, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        new = list(a.shape[:ax]) + list(shape) + list(a.shape[ax + 1:])
+        # a single -1 in shape is inferred
+        if -1 in shape:
+            known = int(np.prod([s for s in shape if s != -1]))
+            new[new.index(-1)] = a.shape[ax] // known
+        return a.reshape(new)
+
+    return apply(fn, _t(x), name="unflatten")
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along `axis`: appends a trailing window dim of
+    `size` (reference: paddle.unfold / Tensor.unfold)."""
+    def fn(a):
+        ax = axis % a.ndim
+        n = (a.shape[ax] - size) // step + 1
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None]
+        out = jnp.take(a, idx, axis=ax)  # [..., n, size, ...] at ax
+        return jnp.moveaxis(out, ax + 1, -1)
+
+    return apply(fn, _t(x), name="unfold")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    from .manipulation import unbind
+
+    return unbind(_t(x), axis=axis)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda a: jnp.diagflat(a, k=offset), _t(x), name="diagflat")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+                 _t(x), name="diagonal")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def fn(a, v):
+        idx = [slice(None)] * a.ndim
+        idx[axis % a.ndim] = index
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+
+    return apply(fn, _t(x), _t(values), name="select_scatter")
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(_t(x).shape)), jnp.int32))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(len(_t(x).shape), jnp.int32))
+
+
+def tolist(x):
+    return np.asarray(_t(x)._data).tolist()
+
+
+# ---- linalg-ish -------------------------------------------------------------
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+        _t(input), _t(x), _t(y), name="baddbmm",
+    )
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def fn(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=-1), 0.0))
+        if p == 0:
+            return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
+        if jnp.isinf(p):
+            return jnp.max(jnp.abs(diff), axis=-1)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+    return apply(fn, _t(x), _t(y), name="cdist")
+
+
+def pdist(x, p=2.0, name=None):
+    def fn(a):
+        n = a.shape[0]
+        iu, ju = np.triu_indices(n, k=1)
+        diff = a[iu] - a[ju]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=-1), 0.0))
+        if jnp.isinf(p):
+            return jnp.max(jnp.abs(diff), axis=-1)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+    return apply(fn, _t(x), name="pdist")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    xa = _t(x)._data
+    wa = _t(weights)._data if weights is not None else None
+    hist, edges = jnp.histogramdd(xa, bins=bins, range=ranges, density=density,
+                                  weights=wa)
+    return Tensor(hist), [Tensor(e) for e in edges]
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor's elements, shape [C, r]."""
+    import itertools
+
+    n = _t(x).shape[0]
+    pick = (itertools.combinations_with_replacement if with_replacement
+            else itertools.combinations)
+    idx = np.asarray(list(pick(range(n), r)), np.int32).reshape(-1, r)
+    return apply(lambda a: jnp.take(a, jnp.asarray(idx), axis=0), _t(x),
+                 name="combinations")
+
+
+# ---- bitwise ----------------------------------------------------------------
+
+def bitwise_invert(x, out=None, name=None):
+    from .logic import bitwise_not
+
+    return bitwise_not(_t(x))
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return apply(jnp.left_shift, _t(x), _t(y), name="bitwise_left_shift")
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    def fn(a, b):
+        if is_arithmetic:
+            return jnp.right_shift(a, b)
+        # logical shift: operate on the unsigned view, cast back
+        ui = jnp.dtype(a.dtype).name.replace("int", "uint")
+        return jax.lax.shift_right_logical(a.view(ui), b.astype(ui).view(ui)).view(a.dtype)
+
+    return apply(fn, _t(x), _t(y), name="bitwise_right_shift")

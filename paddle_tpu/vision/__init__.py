@@ -1,11 +1,54 @@
 from . import datasets, models, transforms
 
+_backend = "numpy"
+
 
 def set_image_backend(backend):
-    pass
+    """reference: vision.set_image_backend — 'pil' | 'cv2' | 'numpy'.
+    Loading normalizes to numpy arrays either way (the tensor substrate)."""
+    global _backend
+    if backend not in ("pil", "cv2", "numpy"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _backend = backend
 
 
 def get_image_backend():
-    return "numpy"
+    return _backend
 
-from . import ops
+
+def image_load(path, backend=None):
+    """reference: vision.image_load — read an image file. PIL when
+    available (or requested), else a raw-numpy fallback for .npy files."""
+    b = backend or _backend
+    if b == "cv2":
+        try:
+            import cv2
+
+            img = cv2.imread(str(path), cv2.IMREAD_UNCHANGED)
+            if img is not None:
+                return img
+        except ImportError:
+            pass  # fall through to PIL/numpy
+        b = "numpy"
+    if b in ("pil", "numpy"):
+        try:
+            from PIL import Image
+
+            img = Image.open(path)
+            if b == "pil":
+                return img
+            import numpy as np
+
+            return np.asarray(img)
+        except ImportError:
+            pass
+    import numpy as np
+
+    if str(path).endswith(".npy"):
+        return np.load(path)
+    raise RuntimeError(
+        f"image_load({path!r}): no usable backend (PIL unavailable and not .npy)"
+    )
+
+
+from . import ops  # noqa: E402,F401

@@ -1,0 +1,182 @@
+"""Generate API_MANIFEST.md: the reference paddle.* public surface vs this
+framework, per namespace (VERDICT r3 item 10 — make the op-surface gap
+measurable). Re-run after any API work:
+
+    python scripts/gen_api_manifest.py > API_MANIFEST.md
+
+The reference lists are curated from the upstream public API (paddle 2.x
+docs surface); "yes" = attribute resolves, "no" = absent. Counting is by
+introspection so the manifest can never drift from the code.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle  # noqa: E402
+
+TOP_LEVEL_OPS = """abs acos acosh add addmm all allclose amax amin angle any arange argmax
+argmin argsort as_complex as_real asin asinh atan atan2 atanh baddbmm bernoulli bincount
+bitwise_and bitwise_invert bitwise_left_shift bitwise_not bitwise_or bitwise_right_shift
+bitwise_xor bmm broadcast_shape broadcast_tensors broadcast_to bucketize cast cat ceil
+chunk clip clone column_stack combinations complex concat conj cos cosh count_nonzero
+cross cummax cummin cumprod cumsum cumulative_trapezoid deg2rad diag diag_embed diagflat
+diagonal diff digamma dist divide dot dsplit dstack einsum empty empty_like equal
+equal_all erf erfinv exp expand expand_as expm1 eye flatten flip fliplr flipud floor
+floor_divide floor_mod fmax fmin frac frexp full full_like gammainc gammaincc gammaln
+gather gather_nd gcd greater_equal greater_than heaviside histogram histogramdd hsplit
+hstack hypot i0 i0e i1 i1e imag increment index_add index_fill index_put index_sample
+index_select inner inverse is_complex is_empty is_floating_point is_integer is_tensor
+isclose isfinite isin isinf isnan isneginf isposinf isreal kron kthvalue lcm ldexp lerp
+less_equal less_than lgamma linspace log log10 log1p log2 logaddexp logcumsumexp
+logical_and logical_not logical_or logical_xor logit logspace logsumexp masked_fill
+masked_scatter masked_select matmul max maximum mean median meshgrid min minimum mm mod
+mode moveaxis multigammaln multiplex multiply multinomial mv nan_to_num nanmean nanmedian
+nanquantile nansum neg nextafter nonzero norm normal not_equal numel ones ones_like outer
+pdist permute poisson polar polygamma pow prod put_along_axis quantile rad2deg rand
+randint randint_like randn randperm rank real reciprocal remainder renorm
+repeat_interleave reshape roll rot90 round rsqrt scale scatter scatter_nd scatter_nd_add
+searchsorted select_scatter sgn shard_index sign signbit sin sinc sinh slice sort split
+sqrt square squeeze stack stanh std strided_slice subtract sum t take take_along_axis tan
+tanh tensor_split tensordot tile to_tensor tolist topk trace transpose trapezoid tril
+tril_indices triu triu_indices trunc unbind unflatten unfold uniform unique
+unique_consecutive unsqueeze unstack vander var view view_as vsplit vstack where zeros
+zeros_like cdist copysign cov corrcoef cumulative_trapezoid""".split()
+
+NAMESPACES = {
+    "paddle.nn": """Layer Linear Conv1D Conv2D Conv3D Conv1DTranspose Conv2DTranspose
+        BatchNorm BatchNorm1D BatchNorm2D BatchNorm3D LayerNorm GroupNorm InstanceNorm1D
+        InstanceNorm2D RMSNorm SyncBatchNorm Embedding Dropout Dropout2D AlphaDropout
+        ReLU ReLU6 GELU SiLU Sigmoid Tanh Softmax LogSoftmax LeakyReLU PReLU ELU SELU
+        Hardswish Hardsigmoid Hardtanh Mish Swish Softplus Softshrink Softsign GLU
+        MaxPool1D MaxPool2D MaxPool3D AvgPool1D AvgPool2D AvgPool3D AdaptiveAvgPool1D
+        AdaptiveAvgPool2D AdaptiveMaxPool2D MultiHeadAttention Transformer
+        TransformerEncoder TransformerEncoderLayer TransformerDecoder
+        TransformerDecoderLayer LSTM GRU SimpleRNN RNN LSTMCell GRUCell SimpleRNNCell
+        CrossEntropyLoss MSELoss L1Loss NLLLoss BCELoss BCEWithLogitsLoss SmoothL1Loss
+        KLDivLoss MarginRankingLoss CosineSimilarity PairwiseDistance Sequential
+        LayerList ParameterList Identity Flatten Unfold Fold Upsample UpsamplingBilinear2D
+        UpsamplingNearest2D Pad1D Pad2D Pad3D ZeroPad2D CosineEmbeddingLoss
+        PixelShuffle ChannelShuffle ClipGradByNorm ClipGradByGlobalNorm ClipGradByValue
+        SpectralNorm utils functional initializer""",
+    "paddle.nn.functional": """linear conv1d conv2d conv3d conv1d_transpose
+        conv2d_transpose relu relu6 gelu silu sigmoid tanh softmax log_softmax
+        leaky_relu prelu elu selu hardswish hardsigmoid hardtanh mish swish softplus
+        softshrink softsign glu max_pool1d max_pool2d max_pool3d avg_pool1d avg_pool2d
+        avg_pool3d adaptive_avg_pool1d adaptive_avg_pool2d batch_norm layer_norm
+        group_norm instance_norm rms_norm dropout dropout2d embedding one_hot
+        cross_entropy binary_cross_entropy binary_cross_entropy_with_logits mse_loss
+        l1_loss nll_loss kl_div smooth_l1_loss margin_ranking_loss cosine_similarity
+        pad interpolate upsample pixel_shuffle channel_shuffle grid_sample affine_grid
+        scaled_dot_product_attention sequence_mask gumbel_softmax normalize unfold fold
+        label_smooth temporal_shift npair_loss square_error_cost softmax_with_cross_entropy""",
+    "paddle.optimizer": """Optimizer SGD Momentum Adam AdamW Adamax Adagrad Adadelta
+        RMSProp Lamb LBFGS lr""",
+    "paddle.optimizer.lr": """LRScheduler NoamDecay ExponentialDecay NaturalExpDecay
+        InverseTimeDecay PolynomialDecay LinearWarmup PiecewiseDecay CosineAnnealingDecay
+        StepDecay LambdaDecay MultiStepDecay ReduceOnPlateau OneCycleLR CyclicLR""",
+    "paddle.distributed": """init_parallel_env get_rank get_world_size all_reduce
+        all_gather all_gather_object all_to_all reduce broadcast scatter gather
+        reduce_scatter send recv isend irecv batch_isend_irecv barrier new_group
+        get_group wait shard_tensor reshard dtensor_from_fn shard_layer Shard Replicate
+        Partial Placement ProcessMesh DistAttr fleet spawn launch rpc ParallelEnv
+        split get_mesh auto_parallel""",
+    "paddle.distributed.fleet": """init Fleet DistributedStrategy UserDefinedRoleMaker
+        PaddleCloudRoleMaker worker_num worker_index distributed_model
+        distributed_optimizer meta_parallel recompute utils""",
+    "paddle.io": """DataLoader Dataset IterableDataset TensorDataset ChainDataset
+        ComposeDataset Subset random_split BatchSampler DistributedBatchSampler Sampler
+        SequenceSampler RandomSampler WeightedRandomSampler get_worker_info""",
+    "paddle.amp": """auto_cast GradScaler decorate is_bfloat16_supported
+        is_float16_supported debugging""",
+    "paddle.jit": """to_static save load not_to_static ignore_module enable_to_static
+        TrainStep""",
+    "paddle.static": """InputSpec Program Executor data program_guard
+        default_main_program default_startup_program Variable""",
+    "paddle.sparse": """sparse_coo_tensor sparse_csr_tensor matmul masked_matmul add
+        multiply relu nn is_same_shape""",
+    "paddle.incubate": """asp nn softmax_mask_fuse segment_sum segment_mean segment_max
+        segment_min graph_send_recv""",
+    "paddle.vision": """models transforms datasets ops image_load set_image_backend""",
+    "paddle.metric": """Metric Accuracy Precision Recall Auc accuracy""",
+    "paddle.distribution": """Distribution Normal Uniform Categorical Bernoulli Beta
+        Dirichlet Exponential Gamma Geometric Gumbel Laplace LogNormal Multinomial
+        Poisson StudentT TransformedDistribution kl_divergence register_kl Independent""",
+    "paddle.linalg": """matmul norm inv det slogdet svd qr lu cholesky eig eigh eigvals
+        eigvalsh matrix_rank matrix_power pinv solve triangular_solve cholesky_solve
+        lstsq cond corrcoef cov householder_product multi_dot""",
+    "paddle.fft": """fft ifft fft2 ifft2 fftn ifftn rfft irfft rfft2 irfft2 rfftn irfftn
+        hfft ihfft fftfreq rfftfreq fftshift ifftshift""",
+    "paddle.signal": """stft istft""",
+    "paddle.audio": """features functional""",
+    "paddle.autograd": """backward grad PyLayer PyLayerContext no_grad
+        set_grad_enabled is_grad_enabled hessian jacobian""",
+}
+
+DESCOPED = {
+    "paddle.distributed.ps (parameter server)": "CPU parameter-server mode — GPU/TPU"
+    " training uses collective mode (SURVEY §2.3 accepted descope)",
+    "paddle.static.append_backward": "static autodiff — dygraph TrainStep (one jit,"
+    " tape backward) subsumes it on this substrate (static/__init__.py docstring)",
+    "paddle.geometric": "graph-learning operator library — out of training-framework"
+    " scope this round",
+    "paddle.quantization (PTQ/QAT)": "IMPLEMENTED in paddle_tpu.quantization —"
+    " listed here because the namespace differs from upstream paddle.static.quantization",
+}
+
+
+def resolve(namespace, name):
+    obj = paddle
+    parts = (namespace.split(".")[1:] if namespace != "paddle" else []) + [name]
+    for p in parts:
+        obj = getattr(obj, p, None)
+        if obj is None:
+            return False
+    return True
+
+
+def main():
+    print("# API manifest — paddle_tpu vs the reference public surface")
+    print()
+    print("Generated by `python scripts/gen_api_manifest.py` (introspection —")
+    print("cannot drift from the code). Reference lists curated from the")
+    print("upstream paddle 2.x public API docs surface.")
+    print()
+    total_yes = total = 0
+    rows = []
+    names = sorted(set(TOP_LEVEL_OPS))
+    missing = [n for n in names if not hasattr(paddle, n)]
+    rows.append(("paddle.* (tensor ops)", len(names) - len(missing), len(names), missing))
+    for ns, names_str in NAMESPACES.items():
+        names = sorted(set(names_str.split()))
+        miss = [n for n in names if not resolve(ns, n)]
+        rows.append((ns, len(names) - len(miss), len(names), miss))
+    for ns, yes, n, miss in rows:
+        total_yes += yes
+        total += n
+    print(f"**Coverage: {total_yes}/{total} "
+          f"({100.0 * total_yes / total:.1f}%) of the curated surface.**")
+    print()
+    print("| Namespace | Present | Missing names |")
+    print("|---|---|---|")
+    for ns, yes, n, miss in rows:
+        miss_s = ", ".join(f"`{m}`" for m in miss) if miss else "—"
+        print(f"| {ns} | {yes}/{n} | {miss_s} |")
+    print()
+    print("## Deliberate descopes")
+    print()
+    for k, v in DESCOPED.items():
+        print(f"- **{k}** — {v}")
+    print()
+    tm = [n for n in dir(paddle.Tensor) if not n.startswith("_")]
+    print(f"`paddle.Tensor` carries {len(tm)} public methods "
+          "(auto-installed from the tensor op modules).")
+
+
+if __name__ == "__main__":
+    main()

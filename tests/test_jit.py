@@ -122,3 +122,37 @@ class TestHapiModel:
         model.fit(ds, batch_size=16, epochs=3, verbose=0)
         res = model.evaluate(ds, batch_size=16, verbose=0)
         assert res["acc"] > 0.5
+
+
+class TestToStaticGates:
+    def test_enable_to_static_false_returns_unconverted(self):
+        from paddle_tpu import jit as pjit
+        from paddle_tpu.jit_api import StaticLayer, to_static
+        from paddle_tpu.nn.layer.common import Linear
+
+        try:
+            pjit.enable_to_static(False)
+            lin = Linear(4, 4)
+            assert to_static(lin) is lin, "must return unconverted when disabled"
+        finally:
+            pjit.enable_to_static(True)
+        assert isinstance(to_static(Linear(4, 4)), StaticLayer)
+
+    def test_not_to_static_and_ignore_module(self):
+        import types
+
+        from paddle_tpu import jit as pjit
+        from paddle_tpu.jit_api import not_to_static, to_static
+
+        @not_to_static
+        def f(x):
+            return x
+
+        assert to_static(f) is f
+
+        mod = types.ModuleType("fake_user_module")
+        def g(x):
+            return x
+        g.__module__ = "fake_user_module"
+        pjit.ignore_module([mod])
+        assert to_static(g) is g

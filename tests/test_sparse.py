@@ -1,0 +1,113 @@
+"""paddle.sparse (reference: python/paddle/sparse + phi sparse kernels):
+COO/CSR are real O(nnz) containers — sparse-native compute must never
+densify (asserted via the lazy dense cache), and must match the dense
+oracle."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo(rng, m=6, n=5, nnz=8):
+    rows = rng.randint(0, m, nnz).astype(np.int32)
+    cols = rng.randint(0, n, nnz).astype(np.int32)
+    vals = rng.randn(nnz).astype(np.float32)
+    st = sparse.sparse_coo_tensor(np.stack([rows, cols]), vals, (m, n))
+    dense = np.zeros((m, n), np.float32)
+    np.add.at(dense, (rows, cols), vals)
+    return st, dense
+
+
+class TestSparseCoo:
+    def test_construction_is_lazy(self):
+        st, dense = _coo(np.random.RandomState(0))
+        assert st._dense_cache is None, "constructor must not densify"
+        assert st.nnz() == 8 and st.shape == [6, 5]
+        np.testing.assert_allclose(np.asarray(st.to_dense().numpy()), dense, rtol=1e-6)
+
+    def test_spmv_matmul_never_densifies(self):
+        rng = np.random.RandomState(1)
+        st, dense = _coo(rng)
+        y = rng.randn(5, 3).astype(np.float32)
+        out = sparse.matmul(st, paddle.to_tensor(y))
+        assert st._dense_cache is None, "sparse matmul densified its input"
+        np.testing.assert_allclose(np.asarray(out.numpy()), dense @ y, rtol=1e-5)
+
+    def test_value_unary_keeps_structure(self):
+        rng = np.random.RandomState(2)
+        st, dense = _coo(rng)
+        out = sparse.relu(st)
+        assert isinstance(out, sparse.SparseCooTensor)
+        assert st._dense_cache is None and out._dense_cache is None
+        np.testing.assert_allclose(
+            np.asarray(out.to_dense().numpy()), np.maximum(dense, 0), rtol=1e-6)
+        out2 = sparse.nn.ReLU()(st)
+        np.testing.assert_allclose(
+            np.asarray(out2.to_dense().numpy()), np.maximum(dense, 0), rtol=1e-6)
+
+    def test_add_union_and_scalar_multiply(self):
+        rng = np.random.RandomState(3)
+        a, da = _coo(rng)
+        b, db = _coo(rng)
+        s = sparse.add(a, b)
+        assert isinstance(s, sparse.SparseCooTensor) and s.nnz() == 16
+        np.testing.assert_allclose(np.asarray(s.to_dense().numpy()), da + db, rtol=1e-5)
+        m = sparse.multiply(a, 2.0)
+        assert isinstance(m, sparse.SparseCooTensor) and a._dense_cache is None
+        np.testing.assert_allclose(np.asarray(m.to_dense().numpy()), da * 2, rtol=1e-6)
+
+    def test_masked_matmul_sddmm(self):
+        rng = np.random.RandomState(4)
+        # unique positions: duplicate COO entries sum on densify, which is
+        # not what the dense-mask oracle models
+        flat = rng.choice(30, 8, replace=False)
+        rows, cols = (flat // 5).astype(np.int32), (flat % 5).astype(np.int32)
+        vals = rng.randn(8).astype(np.float32)
+        mask = sparse.sparse_coo_tensor(np.stack([rows, cols]), vals, (6, 5))
+        dmask = np.zeros((6, 5), np.float32)
+        dmask[rows, cols] = vals
+        x = rng.randn(6, 4).astype(np.float32)
+        y = rng.randn(4, 5).astype(np.float32)
+        out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+        assert isinstance(out, sparse.SparseCooTensor)
+        ref = np.where(dmask != 0, x @ y, 0.0)
+        np.testing.assert_allclose(np.asarray(out.to_dense().numpy()), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestSparseCsr:
+    def test_csr_matmul_and_lazy(self):
+        crows = np.array([0, 2, 3, 5], np.int32)
+        cols = np.array([0, 2, 1, 0, 3], np.int32)
+        vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0], np.float32)
+        st = sparse.sparse_csr_tensor(crows, cols, vals, (3, 4))
+        assert st._dense_cache is None
+        dense = np.zeros((3, 4), np.float32)
+        dense[0, 0], dense[0, 2], dense[1, 1], dense[2, 0], dense[2, 3] = vals
+        np.testing.assert_allclose(np.asarray(st.to_dense().numpy()), dense)
+        y = np.random.RandomState(5).randn(4, 2).astype(np.float32)
+        st2 = sparse.sparse_csr_tensor(crows, cols, vals, (3, 4))
+        out = sparse.matmul(st2, paddle.to_tensor(y))
+        assert st2._dense_cache is None
+        np.testing.assert_allclose(np.asarray(out.numpy()), dense @ y, rtol=1e-5)
+
+    def test_csr_accessors(self):
+        crows = np.array([0, 1, 2], np.int32)
+        st = sparse.sparse_csr_tensor(crows, np.array([0, 1], np.int32),
+                                      np.array([1.0, 2.0], np.float32), (2, 2))
+        np.testing.assert_array_equal(np.asarray(st.crows().numpy()), crows)
+        assert st.nnz() == 2 and st.is_sparse_csr()
+
+
+class TestSparseGrad:
+    def test_matmul_grad_flows_to_dense_operand(self):
+        rows = np.int32([0, 1])
+        cols = np.int32([1, 0])
+        vals = np.float32([2.0, 3.0])
+        st = sparse.sparse_coo_tensor(np.stack([rows, cols]), vals, (2, 2))
+        y = paddle.to_tensor(np.eye(2, dtype=np.float32), stop_gradient=False)
+        out = sparse.matmul(st, y)
+        out.sum().backward()
+        assert y.grad is not None
+        # d(sum)/dy[j, k] = sum_i A[i, j]  (A columns summed)
+        np.testing.assert_allclose(np.asarray(y.grad.numpy()), [[3, 3], [2, 2]])

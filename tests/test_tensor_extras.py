@@ -1,0 +1,262 @@
+"""Op-surface sprint oracles (reference: python/paddle/tensor long tail;
+SURVEY §4 oracle pattern — every op checked against numpy/scipy/torch
+semantics on concrete values)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def T(a, **kw):
+    return paddle.to_tensor(np.asarray(a), **kw)
+
+
+class TestSpecialMath:
+    def test_sgn_real_and_complex(self):
+        np.testing.assert_allclose(
+            np.asarray(paddle.sgn(T([-3.0, 0.0, 2.0])).numpy()), [-1, 0, 1])
+        z = np.array([3 + 4j, 0j], np.complex64)
+        out = np.asarray(paddle.sgn(T(z)).numpy())
+        np.testing.assert_allclose(out, [0.6 + 0.8j, 0j], atol=1e-6)
+
+    def test_sinc_signbit(self):
+        x = np.array([-0.5, 0.0, 0.5, 1.0], np.float32)
+        np.testing.assert_allclose(np.asarray(paddle.sinc(T(x)).numpy()),
+                                   np.sinc(x), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(paddle.signbit(T(x)).numpy()),
+                                      np.signbit(x))
+
+    def test_ldexp_frexp_roundtrip(self):
+        x = np.array([0.5, -3.75, 100.0], np.float32)
+        m, e = paddle.frexp(T(x))
+        np.testing.assert_allclose(
+            np.asarray(paddle.ldexp(m, e).numpy()), x, rtol=1e-6)
+
+    def test_logcumsumexp(self):
+        x = np.random.RandomState(0).randn(10).astype(np.float32)
+        ref = np.logaddexp.accumulate(x)
+        np.testing.assert_allclose(
+            np.asarray(paddle.logcumsumexp(T(x), axis=0).numpy()), ref, rtol=1e-5)
+
+    def test_cumulative_trapezoid(self):
+        y = np.array([1.0, 2.0, 4.0, 7.0], np.float32)
+        ref = np.array([1.5, 4.5, 10.0], np.float32)  # cumsum of trapezoids
+        np.testing.assert_allclose(
+            np.asarray(paddle.cumulative_trapezoid(T(y)).numpy()), ref, rtol=1e-6)
+        x = np.array([0.0, 1.0, 3.0, 6.0], np.float32)
+        ref_x = np.array([1.5, 7.5, 24.0], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(paddle.cumulative_trapezoid(T(y), T(x)).numpy()), ref_x, rtol=1e-6)
+
+    def test_gamma_family(self):
+        from scipy import special as S
+
+        x = np.array([0.5, 1.5, 3.0], np.float32)
+        np.testing.assert_allclose(np.asarray(paddle.gammaln(T(x)).numpy()),
+                                   S.gammaln(x), rtol=1e-5)
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(np.asarray(paddle.gammainc(T(a), T(x)).numpy()),
+                                   S.gammainc(a, x), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(paddle.gammaincc(T(a), T(x)).numpy()),
+                                   S.gammaincc(a, x), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(paddle.i0e(T(x)).numpy()),
+                                   S.i0e(x), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(paddle.i1e(T(x)).numpy()),
+                                   S.i1e(x), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(paddle.polygamma(T(x), 1).numpy()),
+                                   S.polygamma(1, x), rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(paddle.multigammaln(T(x * 3), 2).numpy()),
+                                   S.multigammaln(x * 3, 2), rtol=1e-5)
+
+    def test_nan_inf_predicates(self):
+        x = np.array([np.nan, -np.inf, np.inf, 1.0], np.float32)
+        np.testing.assert_array_equal(np.asarray(paddle.isneginf(T(x)).numpy()),
+                                      np.isneginf(x))
+        np.testing.assert_array_equal(np.asarray(paddle.isposinf(T(x)).numpy()),
+                                      np.isposinf(x))
+        assert paddle.is_floating_point(T(x)) is True or paddle.is_floating_point(T(x)) == True  # noqa: E712
+        assert bool(paddle.is_integer(T(np.int32([1]))))
+        np.testing.assert_allclose(
+            np.asarray(paddle.nanmedian(T(np.array([1.0, np.nan, 3.0], np.float32))).numpy()),
+            2.0)
+
+
+class TestComplexOps:
+    def test_polar_as_complex_as_real(self):
+        r = np.array([1.0, 2.0], np.float32)
+        th = np.array([0.0, np.pi / 2], np.float32)
+        z = np.asarray(paddle.polar(T(r), T(th)).numpy())
+        np.testing.assert_allclose(z, r * np.exp(1j * th), atol=1e-6)
+        pairs = np.asarray(paddle.as_real(T(z)).numpy())
+        np.testing.assert_allclose(pairs[..., 0], z.real, atol=1e-7)
+        z2 = np.asarray(paddle.as_complex(T(pairs)).numpy())
+        np.testing.assert_allclose(z2, z, atol=1e-7)
+
+
+class TestManipulationExtras:
+    def test_tensor_split_unflatten_unfold(self):
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        parts = paddle.tensor_split(T(x), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [4, 2]
+        uf = paddle.unflatten(T(x), 1, [2, 3])
+        assert uf.shape == [4, 2, 3]
+        uf2 = paddle.unflatten(T(x), 1, [2, -1])
+        assert uf2.shape == [4, 2, 3]
+        w = paddle.unfold(T(np.arange(10, dtype=np.float32)), 0, 4, 3)
+        np.testing.assert_array_equal(
+            np.asarray(w.numpy()),
+            [[0, 1, 2, 3], [3, 4, 5, 6], [6, 7, 8, 9]])
+
+    def test_diag_family_and_flips(self):
+        x = np.arange(9, dtype=np.float32).reshape(3, 3)
+        np.testing.assert_array_equal(np.asarray(paddle.diagonal(T(x), 1).numpy()),
+                                      np.diagonal(x, 1))
+        np.testing.assert_array_equal(
+            np.asarray(paddle.diagflat(T(np.array([1.0, 2.0])), 1).numpy()),
+            np.diagflat([1.0, 2.0], 1))
+        np.testing.assert_array_equal(np.asarray(paddle.fliplr(T(x)).numpy()), np.fliplr(x))
+        np.testing.assert_array_equal(np.asarray(paddle.flipud(T(x)).numpy()), np.flipud(x))
+
+    def test_select_scatter_column_stack_unstack(self):
+        x = np.zeros((3, 4), np.float32)
+        out = paddle.select_scatter(T(x), T(np.ones(4, np.float32)), 0, 1)
+        assert np.asarray(out.numpy())[1].sum() == 4
+        cs = paddle.column_stack([T(np.array([1.0, 2.0])), T(np.array([3.0, 4.0]))])
+        np.testing.assert_array_equal(np.asarray(cs.numpy()), [[1, 3], [2, 4]])
+        us = paddle.unstack(T(x), axis=0)
+        assert len(us) == 3 and us[0].shape == [4]
+
+    def test_cat_cast_permute_numel_rank_tolist(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        c = paddle.cat([T(x), T(x)], axis=0)
+        assert c.shape == [4, 3]
+        assert str(paddle.cast(T(x), "int32").dtype).endswith("int32")
+        p = paddle.permute(T(x), [1, 0])
+        assert p.shape == [3, 2]
+        assert int(paddle.numel(T(x)).numpy()) == 6
+        assert int(paddle.rank(T(x)).numpy()) == 2
+        assert paddle.tolist(T(x)) == x.tolist()
+
+    def test_combinations(self):
+        out = np.asarray(paddle.combinations(T(np.array([1.0, 2.0, 3.0])), 2).numpy())
+        np.testing.assert_array_equal(out, [[1, 2], [1, 3], [2, 3]])
+
+
+class TestLinalgExtras:
+    def test_baddbmm(self):
+        rng = np.random.RandomState(3)
+        i = rng.randn(2, 3, 5).astype(np.float32)
+        a = rng.randn(2, 3, 4).astype(np.float32)
+        b = rng.randn(2, 4, 5).astype(np.float32)
+        out = np.asarray(paddle.baddbmm(T(i), T(a), T(b), beta=0.5, alpha=2.0).numpy())
+        np.testing.assert_allclose(out, 0.5 * i + 2.0 * (a @ b), rtol=1e-5)
+
+    def test_cdist_pdist(self):
+        from scipy.spatial.distance import cdist as sp_cdist, pdist as sp_pdist
+
+        rng = np.random.RandomState(4)
+        a = rng.randn(5, 3).astype(np.float32)
+        b = rng.randn(4, 3).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(paddle.cdist(T(a), T(b)).numpy()),
+                                   sp_cdist(a, b), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(paddle.cdist(T(a), T(b), p=1.0).numpy()),
+                                   sp_cdist(a, b, "minkowski", p=1), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(paddle.pdist(T(a)).numpy()),
+                                   sp_pdist(a), rtol=1e-4, atol=1e-5)
+
+    def test_histogramdd_vander_logspace(self):
+        rng = np.random.RandomState(5)
+        x = rng.rand(100, 2).astype(np.float32)
+        hist, edges = paddle.histogramdd(T(x), bins=4)
+        ref_h, ref_e = np.histogramdd(x, bins=4)
+        np.testing.assert_allclose(np.asarray(hist.numpy()), ref_h)
+        assert len(edges) == 2
+        v = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(np.asarray(paddle.vander(T(v)).numpy()), np.vander(v))
+        np.testing.assert_allclose(np.asarray(paddle.logspace(0, 2, 3).numpy()),
+                                   [1, 10, 100], rtol=1e-5)
+
+
+class TestBitwiseExtras:
+    def test_shifts_and_invert(self):
+        x = np.array([1, 2, 4], np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(paddle.bitwise_left_shift(T(x), T(np.int32([1, 2, 3]))).numpy()),
+            [2, 8, 32])
+        np.testing.assert_array_equal(
+            np.asarray(paddle.bitwise_right_shift(T(np.int32([8, 8, 8])), T(np.int32([1, 2, 3]))).numpy()),
+            [4, 2, 1])
+        np.testing.assert_array_equal(np.asarray(paddle.bitwise_invert(T(x)).numpy()), ~x)
+
+    def test_poisson_shape_and_mean(self):
+        paddle.seed(0)
+        lam = np.full((2000,), 4.0, np.float32)
+        out = np.asarray(paddle.poisson(T(lam)).numpy())
+        assert out.shape == (2000,)
+        assert abs(out.mean() - 4.0) < 0.2
+
+
+class TestIncubateSegmentOps:
+    def test_segment_ops_match_reference(self):
+        from paddle_tpu import incubate
+
+        data = np.float32([[1, 2], [3, 4], [5, 6], [7, 8]])
+        ids = np.int32([0, 0, 1, 1])
+        s = np.asarray(incubate.segment_sum(T(data), T(ids)).numpy())
+        np.testing.assert_allclose(s, [[4, 6], [12, 14]])
+        m = np.asarray(incubate.segment_mean(T(data), T(ids)).numpy())
+        np.testing.assert_allclose(m, [[2, 3], [6, 7]])
+        mx = np.asarray(incubate.segment_max(T(data), T(ids)).numpy())
+        np.testing.assert_allclose(mx, [[3, 4], [7, 8]])
+
+    def test_segment_max_empty_segment_int(self):
+        from paddle_tpu import incubate
+
+        out = incubate.segment_max(T(np.int32([1, 2])), T(np.int32([0, 2])))
+        np.testing.assert_array_equal(np.asarray(out.numpy()), [1, 0, 2])
+
+    def test_graph_send_recv(self):
+        from paddle_tpu import incubate
+
+        x = np.float32([[1, 1], [2, 2], [3, 3]])
+        src = np.int32([0, 1, 2, 0])
+        dst = np.int32([1, 2, 1, 0])
+        out = np.asarray(incubate.graph_send_recv(T(x), T(src), T(dst), "sum").numpy())
+        np.testing.assert_allclose(out, [[1, 1], [4, 4], [2, 2]])
+        with pytest.raises(ValueError, match="unsupported reduce_op"):
+            incubate.graph_send_recv(T(x), T(src), T(dst), "SUM")
+
+    def test_softmax_mask_fuse(self):
+        from paddle_tpu import incubate
+
+        x = np.random.RandomState(0).randn(2, 3, 4).astype(np.float32)
+        mask = np.where(np.arange(4) < 3, 0.0, -1e9).astype(np.float32)
+        out = np.asarray(incubate.softmax_mask_fuse(T(x), T(mask)).numpy())
+        assert np.allclose(out[..., 3], 0.0, atol=1e-6)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+
+class TestNewNNSurface:
+    def test_pairwise_distance_norms(self):
+        from paddle_tpu import nn
+
+        a = T(np.float32([[1.0, 5.0]]))
+        b = T(np.float32([[0.0, 0.0]]))
+        d2 = float(np.asarray(nn.PairwiseDistance(p=2.0, epsilon=0.0)(a, b).numpy())[0])
+        assert abs(d2 - np.sqrt(26.0)) < 1e-5
+        dinf = float(np.asarray(nn.PairwiseDistance(p=float("inf"), epsilon=0.0)(a, b).numpy())[0])
+        assert abs(dinf - 5.0) < 1e-5
+
+    def test_sequence_mask(self):
+        import paddle_tpu.nn.functional as F
+
+        out = np.asarray(F.sequence_mask(T(np.int32([1, 3])), maxlen=4).numpy())
+        np.testing.assert_array_equal(out, [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_grad_mode_surface(self):
+        from paddle_tpu import autograd
+
+        assert autograd.is_grad_enabled()
+        with autograd.set_grad_enabled(False):
+            assert not autograd.is_grad_enabled()
+        assert autograd.is_grad_enabled()
