@@ -1,0 +1,411 @@
+"""Dygraph-to-static control-flow conversion (reference:
+python/paddle/jit/dy2static/ — convert_operators.py's convert_ifelse /
+convert_while_loop / convert_logical_and, and the AST transformers under
+transformers/).
+
+TPU-native design: the reference rewrites Python control flow into its own
+cond/while graph ops so the static graph can capture data-dependent branches.
+Here the target is XLA, so the rewrite lowers to `lax.cond` / `lax.while_loop`
+— the structured control-flow primitives XLA compiles natively — and the
+runtime helpers dispatch on traced-ness: a Python-bool predicate keeps plain
+Python semantics (including short-circuit evaluation), a traced/Tensor
+predicate becomes a compiled branch. One source transform therefore serves
+both eager debugging and jit.
+
+Transform strategy (original; no Paddle AST code consulted):
+  if COND: A else: B      ->  outs = _jst.convert_ifelse(COND, _t, _f, ins)
+  while COND: BODY        ->  carry = _jst.convert_while(_cond, _body, carry)
+  a and b / a or b / not  ->  _jst.convert_bool_op(...) (lazy rhs keeps
+                              short-circuit for Python values)
+
+Variable dataflow: branch/loop functions take the names they read as
+parameters and return the names they assign; the call site rebinds them.
+Conversion is CONSERVATIVE — any construct the rewrite cannot represent
+(return/break/continue inside the block, a name assigned in only one branch
+with no prior binding) leaves that statement untouched; un-convertible
+functions fall back to the plain jax trace, which is exactly the previous
+behavior.
+"""
+import ast
+import functools
+import inspect
+import textwrap
+
+
+# --------------------------------------------------------------------------
+# runtime helpers (injected into converted code as `_jst`)
+# --------------------------------------------------------------------------
+
+def _is_traced(x):
+    import jax
+
+    from ..framework.core import Tensor
+
+    if isinstance(x, Tensor):
+        x = x._data
+    if isinstance(x, jax.core.Tracer):
+        return True
+    # concrete jax arrays are fine as Python bools; only tracers need lax
+    return False
+
+
+def _raw(x):
+    from ..framework.core import Tensor
+
+    return x._data if isinstance(x, Tensor) else x
+
+
+def convert_ifelse(pred, true_fn, false_fn, ins):
+    """Data-dependent `if`: traced predicate -> lax.cond, Python predicate ->
+    plain branch call (identical semantics, zero overhead when not traced)."""
+    if _is_traced(pred) or any(_is_traced(x) for x in ins):
+        if _is_traced(pred):
+            import jax
+
+            return jax.lax.cond(_raw(pred), true_fn, false_fn, *ins)
+    return true_fn(*ins) if pred else false_fn(*ins)
+
+
+def convert_while(cond_fn, body_fn, carry):
+    """Data-dependent `while`: traced condition/carry -> lax.while_loop
+    (cond_fn/body_fn take and return the full carry tuple)."""
+    first = cond_fn(*carry)
+    if _is_traced(first) or any(_is_traced(x) for x in carry):
+        import jax
+
+        return jax.lax.while_loop(
+            lambda c: _raw(cond_fn(*c)), lambda c: tuple(body_fn(*c)), tuple(carry)
+        )
+    while cond_fn(*carry):
+        carry = tuple(body_fn(*carry))
+    return tuple(carry)
+
+
+def convert_range_for(bound_args, body_fn, carry):
+    """`for i in range(...)` with a traced bound -> lax.fori_loop; Python
+    ints -> plain loop. body_fn(i, *carry) -> carry."""
+    start, stop, step = bound_args
+    if any(_is_traced(b) for b in (start, stop, step)):
+        import jax
+        import jax.numpy as jnp
+
+        n = jnp.maximum(0, -(-(_raw(stop) - _raw(start)) // _raw(step)))
+
+        def body(k, c):
+            i = _raw(start) + k * _raw(step)
+            return tuple(body_fn(i, *c))
+
+        return jax.lax.fori_loop(0, n, body, tuple(carry))
+    for i in range(start, stop, step):
+        carry = tuple(body_fn(i, *carry))
+    return tuple(carry)
+
+
+def convert_bool_op(op, lhs, rhs_fn):
+    """`and`/`or` with lazy rhs: Python lhs keeps short-circuit; traced lhs
+    evaluates both sides and lowers to logical_and/or (no short-circuit under
+    tracing — both branches are part of the program anyway)."""
+    if _is_traced(lhs):
+        import jax.numpy as jnp
+
+        r = _raw(rhs_fn())
+        l = _raw(lhs)
+        return jnp.logical_and(l, r) if op == "and" else jnp.logical_or(l, r)
+    if op == "and":
+        return rhs_fn() if lhs else lhs
+    return lhs if lhs else rhs_fn()
+
+
+def convert_not(x):
+    if _is_traced(x):
+        import jax.numpy as jnp
+
+        return jnp.logical_not(_raw(x))
+    return not x
+
+
+# --------------------------------------------------------------------------
+# AST transform
+# --------------------------------------------------------------------------
+
+class _NameUse(ast.NodeVisitor):
+    """Collect loaded / stored names in a statement list (nested function
+    bodies are opaque: only their binding name counts as a store)."""
+
+    def __init__(self):
+        self.loads = set()
+        self.stores = set()
+
+    def visit_Name(self, node):
+        (self.loads if isinstance(node.ctx, ast.Load) else self.stores).add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self.stores.add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass  # opaque
+
+    @classmethod
+    def of(cls, stmts):
+        v = cls()
+        for s in stmts if isinstance(stmts, list) else [stmts]:
+            v.visit(s)
+        return v
+
+
+def _has_escape(stmts):
+    """True if the statement list contains return/break/continue/yield at a
+    depth that would escape the rewritten block (nested function bodies and
+    nested loops' own break/continue don't escape)."""
+
+    class V(ast.NodeVisitor):
+        found = False
+        loop_depth = 0
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_Yield(self, node):
+            self.found = True
+
+        visit_YieldFrom = visit_Yield
+
+        def visit_Break(self, node):
+            if self.loop_depth == 0:
+                self.found = True
+
+        visit_Continue = visit_Break
+
+        def _loop(self, node):
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_While = visit_For = _loop
+
+        def visit_FunctionDef(self, node):
+            pass  # opaque
+
+        visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites If/While/For-range statements into _jst.* calls, tracking the
+    set of names bound so far (function args + prior assignments) so branch
+    functions receive initialized values only."""
+
+    def __init__(self):
+        self.counter = 0
+        self.bound = set()
+
+    def _fresh(self, kind):
+        self.counter += 1
+        return f"__jst_{kind}_{self.counter}"
+
+    # ---- expression-level: and/or/not on possibly-traced values ----
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = "and" if isinstance(node.op, ast.And) else "or"
+        expr = node.values[0]
+        for rhs in node.values[1:]:
+            expr = ast.Call(
+                func=ast.Attribute(ast.Name("_jst", ast.Load()), "convert_bool_op", ast.Load()),
+                args=[ast.Constant(op), expr,
+                      ast.Lambda(ast.arguments([], [], None, [], [], None, []), rhs)],
+                keywords=[],
+            )
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(
+                func=ast.Attribute(ast.Name("_jst", ast.Load()), "convert_not", ast.Load()),
+                args=[node.operand], keywords=[],
+            )
+        return node
+
+    # ---- statement-level ----
+    def process_body(self, stmts):
+        out = []
+        for s in stmts:
+            r = self.visit(s)
+            out.extend(r if isinstance(r, list) else [r])
+            u = _NameUse.of(s)
+            self.bound |= u.stores
+        return out
+
+    def visit_FunctionDef(self, node):
+        # only the OUTERMOST function is transformed; nested defs are opaque
+        if getattr(self, "_entered", False):
+            return node
+        self._entered = True
+        for a in node.args.posonlyargs + node.args.args + node.args.kwonlyargs:
+            self.bound.add(a.arg)
+        if node.args.vararg:
+            self.bound.add(node.args.vararg.arg)
+        if node.args.kwarg:
+            self.bound.add(node.args.kwarg.arg)
+        node.decorator_list = []  # avoid re-decoration on exec
+        node.body = self.process_body(node.body)
+        return node
+
+    def _branch_fn(self, name, params, stmts, returns):
+        return ast.FunctionDef(
+            name=name,
+            args=ast.arguments(
+                posonlyargs=[], args=[ast.arg(p) for p in params], vararg=None,
+                kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[],
+            ),
+            body=list(stmts) + [
+                ast.Return(ast.Tuple([ast.Name(r, ast.Load()) for r in returns], ast.Load()))
+            ],
+            decorator_list=[],
+        )
+
+    def visit_If(self, node):
+        # rewrite condition expressions (bool ops) first
+        node.test = self.visit(node.test)
+        saved = set(self.bound)
+        body = self.process_body(node.body)
+        self.bound = set(saved)
+        orelse = self.process_body(node.orelse)
+        self.bound = saved  # caller's process_body re-adds stores
+
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            node.body, node.orelse = body, orelse
+            return node
+        ub, ue = _NameUse.of(node.body), _NameUse.of(node.orelse)
+        outs = sorted(ub.stores | ue.stores)
+        # a name assigned in only one branch needs a prior binding for the
+        # other branch to return — otherwise leave the `if` untouched
+        for n in outs:
+            if n not in saved and not (n in ub.stores and n in ue.stores):
+                node.body, node.orelse = body, orelse
+                return node
+        ins = sorted(((ub.loads | ue.loads | set(outs)) & saved) | (set(outs) & saved))
+        tname, fname = self._fresh("true"), self._fresh("false")
+        tfn = self._branch_fn(tname, ins, body, outs)
+        ffn = self._branch_fn(fname, ins, orelse, outs)
+        call = ast.Call(
+            func=ast.Attribute(ast.Name("_jst", ast.Load()), "convert_ifelse", ast.Load()),
+            args=[node.test, ast.Name(tname, ast.Load()), ast.Name(fname, ast.Load()),
+                  ast.Tuple([ast.Name(i, ast.Load()) for i in ins], ast.Load())],
+            keywords=[],
+        )
+        if outs:
+            assign = ast.Assign(
+                targets=[ast.Tuple([ast.Name(o, ast.Store()) for o in outs], ast.Store())],
+                value=call,
+            )
+        else:
+            assign = ast.Expr(call)
+        return [tfn, ffn, assign]
+
+    def visit_While(self, node):
+        node.test = self.visit(node.test)
+        saved = set(self.bound)
+        body = self.process_body(node.body)
+        self.bound = saved
+
+        u = _NameUse.of(node.body)
+        tu = _NameUse.of(ast.Expr(node.test))
+        if (_has_escape(node.body) or node.orelse
+                or not (u.stores <= saved)):  # carry must be initialized
+            node.body = body
+            return node
+        carry = sorted(u.stores | (tu.loads & u.stores))
+        ins = sorted(carry)
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        cfn = self._branch_fn(cname, ins, [], [])
+        cfn.body = [ast.Return(node.test)]
+        bfn = self._branch_fn(bname, ins, body, carry)
+        call = ast.Call(
+            func=ast.Attribute(ast.Name("_jst", ast.Load()), "convert_while", ast.Load()),
+            args=[ast.Name(cname, ast.Load()), ast.Name(bname, ast.Load()),
+                  ast.Tuple([ast.Name(i, ast.Load()) for i in ins], ast.Load())],
+            keywords=[],
+        )
+        if carry:
+            assign = ast.Assign(
+                targets=[ast.Tuple([ast.Name(c, ast.Store()) for c in carry], ast.Store())],
+                value=call,
+            )
+        else:
+            assign = ast.Expr(call)
+        return [cfn, bfn, assign]
+
+    def visit_For(self, node):
+        # only `for NAME in range(...)` converts; everything else unchanged
+        saved = set(self.bound)
+        body = self.process_body(node.body)
+        self.bound = saved
+        is_range = (
+            isinstance(node.iter, ast.Call)
+            and isinstance(node.iter.func, ast.Name)
+            and node.iter.func.id == "range"
+            and not node.iter.keywords
+            and 1 <= len(node.iter.args) <= 3
+            and isinstance(node.target, ast.Name)
+        )
+        u = _NameUse.of(node.body)
+        if (not is_range or _has_escape(node.body) or node.orelse
+                or not (u.stores - {node.target.id} <= saved)):
+            node.body = body
+            return node
+        carry = sorted(u.stores - {node.target.id})
+        ra = node.iter.args
+        start = ra[0] if len(ra) >= 2 else ast.Constant(0)
+        stop = ra[1] if len(ra) >= 2 else ra[0]
+        step = ra[2] if len(ra) == 3 else ast.Constant(1)
+        bname = self._fresh("forbody")
+        bfn = self._branch_fn(bname, [node.target.id] + carry, body, carry)
+        call = ast.Call(
+            func=ast.Attribute(ast.Name("_jst", ast.Load()), "convert_range_for", ast.Load()),
+            args=[ast.Tuple([start, stop, step], ast.Load()),
+                  ast.Name(bname, ast.Load()),
+                  ast.Tuple([ast.Name(c, ast.Load()) for c in carry], ast.Load())],
+            keywords=[],
+        )
+        if carry:
+            assign = ast.Assign(
+                targets=[ast.Tuple([ast.Name(c, ast.Store()) for c in carry], ast.Store())],
+                value=call,
+            )
+        else:
+            assign = ast.Expr(call)
+        return [bfn, assign]
+
+
+def convert_control_flow(fn):
+    """Return fn with data-dependent Python control flow rewritten onto
+    lax.cond/while_loop/fori_loop. Raises on anything unconvertible (callers
+    catch and fall back to the plain trace)."""
+    src = textwrap.dedent(inspect.getsource(fn))
+    tree = ast.parse(src)
+    tree = ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(tree)
+    import sys
+
+    this = sys.modules[__name__]
+    ns = dict(fn.__globals__)
+    ns["_jst"] = this
+    # closures: bind current cell values (late rebinding is not preserved —
+    # the converted function is a snapshot, same as the reference's
+    # TranslatedLayer contract)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            ns[name] = cell.cell_contents
+    code = compile(tree, filename=f"<dy2static {fn.__qualname__}>", mode="exec")
+    exec(code, ns)
+    converted = ns[fn.__name__]
+    functools.update_wrapper(converted, fn)
+    converted.__dy2static__ = True
+    return converted

@@ -63,9 +63,22 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
             return obj
         if isinstance(obj, Layer):
             return StaticLayer(obj)
-        return jit(obj)
+        return jit(_convert_control_flow(obj))
 
     return deco(function) if function is not None else deco
+
+
+def _convert_control_flow(fn):
+    """Attempt the dy2static AST rewrite (data-dependent if/while/for →
+    lax.cond/while_loop/fori_loop); fall back to the plain trace when the
+    source is unavailable or unconvertible (reference: convert_to_static
+    falling back to dygraph, python/paddle/jit/dy2static/convert_call_func.py)."""
+    from .jit.dy2static import convert_control_flow
+
+    try:
+        return convert_control_flow(fn)
+    except Exception:
+        return fn
 
 
 class StaticLayer:

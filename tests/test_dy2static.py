@@ -1,0 +1,282 @@
+"""Dy2Static control-flow conversion (reference: python/paddle/jit/dy2static/
+transformers + convert_operators). Data-dependent Python if/while/for must
+compile under jit via lax.cond/while_loop/fori_loop; Python-valued control
+flow must keep exact eager semantics (incl. short-circuit)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import convert_control_flow
+
+
+def _jaxpr_of(fn, *args):
+    import jax
+
+    return str(jax.make_jaxpr(fn)(*args))
+
+
+class TestConvertIf:
+    def test_tensor_predicate_compiles_to_cond(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2
+            else:
+                y = x - 1
+            return y
+
+        g = convert_control_flow(f)
+        xp = paddle.to_tensor(np.ones(4, np.float32))
+        xn = paddle.to_tensor(-np.ones(4, np.float32))
+        np.testing.assert_allclose(g(xp).numpy(), np.ones(4) * 2)
+        np.testing.assert_allclose(g(xn).numpy(), -np.ones(4) - 1)
+        # under jit the branch is a lax.cond, not a trace-time choice
+        cg = paddle.jit.to_static(f)
+        np.testing.assert_allclose(cg(xp).numpy(), np.ones(4) * 2)
+        np.testing.assert_allclose(cg(xn).numpy(), -np.ones(4) - 1)
+        assert "cond" in _jaxpr_of(lambda x: g(x)._data, xp)
+
+    def test_python_predicate_keeps_eager_semantics(self):
+        calls = []
+
+        def f(x, flag):
+            if flag:
+                calls.append("t")
+                y = x + 1
+            else:
+                calls.append("f")
+                y = x - 1
+            return y
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        np.testing.assert_allclose(g(x, True).numpy(), np.ones(2))
+        assert calls == ["t"]  # only the taken branch ran
+
+    def test_branch_assigning_prior_variable(self):
+        def f(x):
+            y = x * 0
+            if x.max() > 1:
+                y = x
+            return y + 1
+
+        g = convert_control_flow(f)
+        big = paddle.to_tensor(np.full(3, 5.0, np.float32))
+        small = paddle.to_tensor(np.full(3, 0.5, np.float32))
+        np.testing.assert_allclose(g(big).numpy(), np.full(3, 6.0))
+        np.testing.assert_allclose(g(small).numpy(), np.full(3, 1.0))
+
+    def test_if_with_return_falls_back_unconverted(self):
+        def f(x, flag):
+            if flag:
+                return x + 1
+            return x - 1
+
+        g = convert_control_flow(f)  # must not crash; `if` left as-is
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        np.testing.assert_allclose(g(x, True).numpy(), np.ones(2))
+        np.testing.assert_allclose(g(x, False).numpy(), -np.ones(2))
+
+    def test_nested_if(self):
+        def f(x):
+            y = x
+            if x.sum() > 0:
+                if x.sum() > 10:
+                    y = x * 100
+                else:
+                    y = x * 2
+            else:
+                y = -x
+            return y
+
+        g = convert_control_flow(f)
+        for v in (0.5, 5.0, -1.0):
+            x = paddle.to_tensor(np.full(4, v, np.float32))
+            np.testing.assert_allclose(g(x).numpy(), f(x).numpy())
+
+
+class TestConvertWhile:
+    def test_tensor_while_compiles_to_while_loop(self):
+        def f(x):
+            while x.sum() < 100:
+                x = x * 2
+            return x
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        np.testing.assert_allclose(g(x).numpy(), f(x).numpy())
+        assert "while" in _jaxpr_of(lambda x: g(x)._data, x)
+        # jitted end-to-end
+        cg = paddle.jit.to_static(f)
+        np.testing.assert_allclose(cg(x).numpy(), np.full(4, 32.0))
+
+    def test_while_multiple_carries(self):
+        def f(x):
+            i = paddle.to_tensor(np.int32(0))
+            s = x * 0
+            while i < 5:
+                s = s + x
+                i = i + 1
+            return s, i
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.arange(3, dtype=np.float32))
+        s, i = g(x)
+        np.testing.assert_allclose(s.numpy(), np.arange(3) * 5.0)
+        assert int(i.numpy()) == 5
+
+    def test_python_while_unchanged(self):
+        def f(x, n):
+            while n > 0:
+                x = x + 1
+                n -= 1
+            return x
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        np.testing.assert_allclose(g(x, 3).numpy(), np.full(2, 3.0))
+
+    def test_while_with_break_falls_back(self):
+        def f(x, n):
+            while n > 0:
+                if n == 2:
+                    break
+                x = x + 1
+                n -= 1
+            return x
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        np.testing.assert_allclose(g(x, 4).numpy(), np.full(2, 2.0))
+
+
+class TestConvertFor:
+    def test_range_over_tensor_bound(self):
+        def f(x, n):
+            s = x * 0
+            for i in range(n):
+                s = s + x + i
+            return s
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+
+        # python bound: plain loop
+        np.testing.assert_allclose(g(x, 3).numpy(), np.full(2, 6.0))
+
+        # traced bound via jit: fori_loop
+        import jax
+
+        def run(x, n):
+            return g(paddle.Tensor(x), n)._data
+
+        out = jax.jit(run)(x._data, 3)
+        np.testing.assert_allclose(np.asarray(out), np.full(2, 6.0))
+        assert "while" in _jaxpr_of(run, x._data, 3)  # fori lowers to while
+
+    def test_for_over_list_unchanged(self):
+        def f(x, items):
+            for it in items:
+                x = x + it
+            return x
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        np.testing.assert_allclose(g(x, [1, 2, 3]).numpy(), np.full(2, 6.0))
+
+
+class TestBoolOps:
+    def test_traced_and_or(self):
+        def f(x):
+            if (x.sum() > 0) and (x.max() < 10):
+                y = x + 1
+            else:
+                y = x - 1
+            return y
+
+        g = convert_control_flow(f)
+        for v in (1.0, 20.0, -1.0):
+            x = paddle.to_tensor(np.full(3, v, np.float32))
+            np.testing.assert_allclose(g(x).numpy(), f(x).numpy())
+        cg = paddle.jit.to_static(f)
+        np.testing.assert_allclose(
+            cg(paddle.to_tensor(np.ones(3, np.float32))).numpy(), np.full(3, 2.0)
+        )
+
+    def test_python_short_circuit_preserved(self):
+        def boom():
+            raise RuntimeError("rhs evaluated")
+
+        def f(x, flag):
+            if flag or boom():
+                y = x + 1
+            else:
+                y = x
+            return y
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        np.testing.assert_allclose(g(x, True).numpy(), np.ones(2))  # no boom
+
+    def test_not_on_tensor(self):
+        def f(x):
+            if not (x.sum() > 0):
+                y = x - 1
+            else:
+                y = x + 1
+            return y
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(g(x).numpy(), np.full(2, 2.0))
+        xn = paddle.to_tensor(-np.ones(2, np.float32))
+        np.testing.assert_allclose(g(xn).numpy(), np.full(2, -2.0))
+
+
+class TestIntegration:
+    def test_to_static_gradient_through_cond(self):
+        @paddle.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                y = (x * x).sum()
+            else:
+                y = (x * 3).sum()
+            return y
+
+        # grad through the converted function via the tape
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+
+        def loss(x):
+            if x.sum() > 0:
+                return (x * x).sum()
+            return (x * 3).sum()
+
+        g = convert_control_flow(loss)
+        import jax
+
+        grads = jax.grad(lambda xd: g(paddle.Tensor(xd))._data)(x._data)
+        np.testing.assert_allclose(np.asarray(grads), [2.0, 4.0])
+
+    def test_closure_variables_captured(self):
+        scale = 3.0
+
+        def f(x):
+            if x.sum() > 0:
+                y = x * scale
+            else:
+                y = x
+            return y
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(g(x).numpy(), np.full(2, 3.0))
+
+    def test_enable_to_static_false_skips_conversion(self):
+        paddle.jit.enable_to_static(False)
+        try:
+            def f(x):
+                return x + 1
+
+            g = paddle.jit.to_static(f)
+            assert g is f
+        finally:
+            paddle.jit.enable_to_static(True)
