@@ -25,6 +25,9 @@ class Strategy:
         self.sharding = _SubConfig(enable=False, degree=1, stage=1)
         self.pipeline = _SubConfig(enable=False, schedule_mode="1F1B", accumulate_steps=1)
         self.gradient_merge = _SubConfig(enable=False, k_steps=1)
+        # profile-based mesh selection (reference: tuner/ OptimizationTuner):
+        # measure the top_k planner candidates with the real compiled step
+        self.tuning = _SubConfig(enable=False, top_k=3, steps=2, warmup=1)
 
 
 class _SubConfig:
@@ -81,8 +84,9 @@ class Engine:
         self.strategy = strategy or Strategy()
         self._train_step = None
         self._plan = None
+        self._tuning_result = None
 
-    def _ensure_step(self, global_batch=None):
+    def _ensure_step(self, global_batch=None, sample_batch=None):
         """Apply the Strategy (reference: engine._apply_pre/post_optimization
         pass pipeline — amp/recompute/sharding/gradient-merge/pipeline) and
         build the compiled step. On a multi-device backend with no global
@@ -124,8 +128,25 @@ class Engine:
                 if st.pipeline.enable and getattr(st.pipeline, "pp_degree", 1) > 1:
                     mins["pp"] = int(st.pipeline.pp_degree)
                 bpd = max(int(global_batch) // n_dev, 1) if global_batch else 1
-                self._plan = plan_for_model(model, n_devices=n_dev, min_axes=mins,
-                                            batch_per_device=bpd)
+                if getattr(st.tuning, "enable", False) and sample_batch is not None:
+                    # measure the top-k modeled candidates on the real step
+                    # and take the measured winner (reference: tuner/)
+                    from .tuner import ProfilingTuner
+
+                    tuner = ProfilingTuner(
+                        model, self.loss, lambda: self.optimizer,
+                        warmup=int(getattr(st.tuning, "warmup", 1)),
+                        steps=int(getattr(st.tuning, "steps", 2)),
+                    )
+                    self._tuning_result = tuner.tune(
+                        tuple(to_tensor(b) for b in sample_batch),
+                        top_k=int(getattr(st.tuning, "top_k", 3)),
+                        min_axes=mins,
+                    )
+                    self._plan = self._tuning_result.best
+                else:
+                    self._plan = plan_for_model(model, n_devices=n_dev, min_axes=mins,
+                                                batch_per_device=bpd)
                 build_planned_mesh(self._plan)
             stage = int(getattr(st.sharding, "stage", 1)) if st.sharding.enable else 1
             if self._plan is not None and self._plan.sharding_stage == 3 and stage < 3:
@@ -155,7 +176,13 @@ class Engine:
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=True, drop_last=True, collate_fn=collate_fn
         )
-        self._ensure_step(global_batch=getattr(loader, "batch_size", batch_size))
+        sample = None
+        if getattr(self.strategy.tuning, "enable", False) and self._train_step is None:
+            for batch in loader:
+                sample = tuple(batch if isinstance(batch, (list, tuple)) else [batch])
+                break
+        self._ensure_step(global_batch=getattr(loader, "batch_size", batch_size),
+                          sample_batch=sample)
         history = {"loss": []}
         for epoch in range(epochs):
             for step, batch in enumerate(loader):

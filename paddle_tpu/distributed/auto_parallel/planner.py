@@ -99,6 +99,37 @@ def plan_mesh(
     factorization stays within a slice (ICI) and an extra grad all-reduce
     over the dcn_dp axis is charged at DCN bandwidth.
     """
+    cands = enumerate_plans(
+        n_params, n_devices, seq_len=seq_len, batch_per_device=batch_per_device,
+        hidden_size=hidden_size, num_layers=num_layers, hbm_bytes=hbm_bytes,
+        max_mp=max_mp, dtype_bytes=dtype_bytes, min_axes=min_axes,
+        n_slices=n_slices, dcn_bw=dcn_bw,
+    )
+    if not cands:
+        raise ValueError(
+            f"no mesh shape fits {n_params / 1e9:.2f}B params on {n_devices} devices "
+            f"with {hbm_bytes / 1e9:.0f}GB HBM — add devices or enable offload"
+        )
+    return cands[0]
+
+
+def enumerate_plans(
+    n_params,
+    n_devices,
+    seq_len=2048,
+    batch_per_device=1,
+    hidden_size=None,
+    num_layers=None,
+    hbm_bytes=HBM_BYTES_DEFAULT,
+    max_mp=8,
+    dtype_bytes=2,
+    min_axes=None,
+    n_slices=1,
+    dcn_bw=DCN_BW_DEFAULT,
+):
+    """All memory-feasible Plans, best modeled cost first (the candidate
+    ladder the ProfilingTuner measures — reference: tuner/ enumerating
+    Partitioner candidates before profiling)."""
     if n_slices > 1:
         if n_devices % n_slices:
             raise ValueError(f"{n_devices} devices not divisible by {n_slices} slices")
@@ -203,13 +234,8 @@ def plan_mesh(
                      accumulate_steps=1 if pp > 1 else n_micro,
                      dcn_dp=n_slices)
             )
-    if not candidates:
-        raise ValueError(
-            f"no mesh shape fits {n_params / 1e9:.2f}B params on {n_devices} devices "
-            f"with {hbm_bytes / 1e9:.0f}GB HBM — add devices or enable offload"
-        )
-    best = min(candidates, key=lambda c: (c.cost, c.mp * c.pp))
-    return best
+    candidates.sort(key=lambda c: (c.cost, c.mp * c.pp))
+    return candidates
 
 
 def plan_for_model(model, n_devices=None, seq_len=None, batch_per_device=1, **kw):

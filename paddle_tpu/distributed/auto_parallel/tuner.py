@@ -1,0 +1,139 @@
+"""Profiling tuner (reference: auto_parallel/static/tuner/ —
+OptimizationTuner / rule-based + profile-based trial selection).
+
+The closed-form planner (planner.py) ranks mesh shapes with a bytes-over-ICI
+cost model; the tuner closes the loop the way the reference does: take the
+top-K modeled candidates, run each one FOR REAL — build the mesh, compile the
+actual DistributedTrainStep, time a few steps — and pick the measured winner.
+On TPU the "trial" is cheap because the step is one XLA program; on the CPU
+test mesh the relative ordering still reflects partitioning overheads.
+
+Trials run on the live model instance (the reference's profiler also executes
+the real program): a trial's couple of optimizer steps mutate the weights,
+which is acceptable for training-time tuning and documented on tune().
+"""
+import dataclasses
+import time
+
+from .planner import enumerate_plans
+
+
+@dataclasses.dataclass
+class TrialRecord:
+    plan: object
+    modeled_cost: float
+    measured_s: float | None  # None = trial failed
+    error: str | None = None
+
+
+@dataclasses.dataclass
+class TuneResult:
+    best: object  # Plan
+    records: list
+
+    def summary(self):
+        rows = []
+        for r in self.records:
+            tag = f"dp{r.plan.dp}-mp{r.plan.mp}-pp{r.plan.pp}-sh{r.plan.sharding}"
+            val = f"{r.measured_s * 1e3:.1f}ms" if r.measured_s is not None else f"FAIL({r.error})"
+            rows.append(f"{tag}: modeled {r.modeled_cost * 1e3:.2f}ms measured {val}")
+        return "; ".join(rows)
+
+
+class ProfilingTuner:
+    """Measure top-K planner candidates with the real compiled train step.
+
+    model/loss_fn/optimizer_factory are the live training objects;
+    optimizer_factory() is called once per trial — returning the same
+    optimizer instance is fine (DistributedTrainStep rebuilds its slot
+    state per construction), a fresh instance avoids scheduler-step drift.
+    """
+
+    def __init__(self, model, loss_fn, optimizer_factory, *, n_labels=1,
+                 warmup=1, steps=3, devices=None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer_factory = optimizer_factory
+        self.n_labels = n_labels
+        self.warmup = warmup
+        self.steps = steps
+        self.devices = devices
+
+    def _runnable(self, plan):
+        """A candidate is runnable iff its pp matches the model's fixed
+        pipeline degree (a PipelineModule's pp is set at construction; a
+        plain model runs pp=1 only)."""
+        model_pp = getattr(self.model, "pp_degree", 1)
+        return plan.pp == model_pp
+
+    def measure(self, plan, batch):
+        """Build plan's mesh, compile the real step, return mean step
+        seconds over `steps` timed iterations (after `warmup`)."""
+        import jax
+
+        from ..mesh import build_mesh, mesh_guard
+        from ..train_step import DistributedTrainStep
+
+        devices = self.devices or jax.devices()
+        mesh = build_mesh(dp=plan.dp, mp=plan.mp, pp=plan.pp,
+                          sharding=plan.sharding, devices=devices)
+        with mesh_guard(mesh):
+            opt = self.optimizer_factory()
+            step = DistributedTrainStep(
+                self.model, self.loss_fn, opt, n_labels=self.n_labels,
+                sharding_stage=plan.sharding_stage,
+                accumulate_steps=plan.accumulate_steps,
+            )
+            for _ in range(self.warmup):
+                loss = step(*batch)
+            float(loss.numpy())  # sync compile + warmup
+            t0 = time.perf_counter()
+            for _ in range(self.steps):
+                loss = step(*batch)
+            float(loss.numpy())
+            return (time.perf_counter() - t0) / self.steps
+
+    def tune(self, batch, top_k=4, **plan_kw):
+        """Enumerate → filter runnable → measure top_k → argmin.
+
+        batch: the (inputs..., labels...) tuple trials run on — its weights
+        see top_k × (warmup+steps) optimizer updates. Returns TuneResult;
+        raises if every trial fails.
+        """
+        import jax
+
+        n_dev = len(self.devices or jax.devices())
+        plan_kw.setdefault("batch_per_device", max(batch[0].shape[0] // n_dev, 1))
+        cands = [
+            p for p in enumerate_plans(
+                _n_params(self.model), n_dev,
+                hidden_size=getattr(getattr(self.model, "config", None), "hidden_size", None),
+                num_layers=getattr(getattr(self.model, "config", None), "num_hidden_layers", None),
+                seq_len=batch[0].shape[1] if hasattr(batch[0], "shape") and len(batch[0].shape) > 1 else 2048,
+                **plan_kw,
+            ) if self._runnable(p)
+        ][:top_k]
+        if not cands:
+            raise ValueError("no runnable candidate plans (model pp degree vs device count)")
+        records = []
+        for plan in cands:
+            try:
+                t = self.measure(plan, batch)
+                records.append(TrialRecord(plan, plan.cost, t))
+            except Exception as e:  # infeasible at runtime: record, keep going
+                records.append(TrialRecord(plan, plan.cost, None, f"{type(e).__name__}: {e}"))
+        ok = [r for r in records if r.measured_s is not None]
+        if not ok:
+            raise RuntimeError(
+                "all tuner trials failed: " + "; ".join(str(r.error) for r in records)
+            )
+        best = min(ok, key=lambda r: r.measured_s)
+        return TuneResult(best=best.plan, records=records)
+
+
+def _n_params(model):
+    import numpy as np
+
+    if hasattr(model, "num_parameters"):
+        return model.num_parameters()
+    return int(sum(np.prod(p.shape) for p in model.parameters()))

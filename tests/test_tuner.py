@@ -1,0 +1,88 @@
+"""Profiling tuner (reference: auto_parallel/static/tuner/ — profile-based
+trial selection on top of the closed-form cost model)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.distributed.auto_parallel.planner import enumerate_plans, plan_mesh
+from paddle_tpu.distributed.auto_parallel.tuner import ProfilingTuner
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+
+def _model(layers=2):
+    paddle.seed(0)
+    cfg = gpt_tiny(num_hidden_layers=layers, hidden_dropout_prob=0.0,
+                   attention_probs_dropout_prob=0.0)
+    return GPTForCausalLM(cfg)
+
+
+def _batch(bs=8, seq=16, vocab=128):
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (bs, seq + 1)).astype(np.int32)
+    return paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
+
+
+def _loss(out, labels):
+    import paddle_tpu.nn.functional as F
+
+    return F.cross_entropy(
+        out.reshape([-1, out.shape[-1]]), labels.reshape([-1]).unsqueeze(-1)
+    ).mean()
+
+
+class TestEnumeratePlans:
+    def test_sorted_and_first_equals_plan_mesh(self):
+        cands = enumerate_plans(1e9, 8, hidden_size=2048, num_layers=16)
+        assert len(cands) > 1
+        costs = [c.cost for c in cands]
+        assert costs == sorted(costs)
+        best = plan_mesh(1e9, 8, hidden_size=2048, num_layers=16)
+        assert (best.dp, best.mp, best.pp, best.sharding) == (
+            cands[0].dp, cands[0].mp, cands[0].pp, cands[0].sharding
+        )
+
+    def test_infeasible_raises_only_in_plan_mesh(self):
+        assert enumerate_plans(100e9, 1) == []
+        with pytest.raises(ValueError):
+            plan_mesh(100e9, 1)
+
+
+class TestProfilingTuner:
+    def test_measures_candidates_and_picks_argmin(self):
+        model = _model()
+        x, y = _batch()
+        tuner = ProfilingTuner(model, _loss, lambda: optimizer.AdamW(
+            learning_rate=1e-3, parameters=model.parameters()), steps=2, warmup=1)
+        res = tuner.tune((x, y), top_k=3)
+        ok = [r for r in res.records if r.measured_s is not None]
+        assert len(ok) >= 2, res.summary()
+        assert all(r.measured_s > 0 for r in ok)
+        best_measured = min(ok, key=lambda r: r.measured_s)
+        assert res.best is best_measured.plan
+        # plain model: every trial must be a pp=1 plan
+        assert all(r.plan.pp == 1 for r in res.records)
+        assert "measured" in res.summary()
+
+    def test_engine_tunes_mesh_from_strategy(self):
+        from paddle_tpu.distributed.auto_parallel.engine import Engine, Strategy
+        from paddle_tpu.distributed import mesh as M
+
+        model = _model()
+        st = Strategy()
+        st.tuning.enable = True
+        st.tuning.top_k = 2
+        st.tuning.steps = 1
+        opt = optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        eng = Engine(model=model, loss=_loss, optimizer=opt, strategy=st)
+
+        x, y = _batch(bs=8, seq=16)
+        ds = [(x.numpy()[i], y.numpy()[i]) for i in range(8)]
+        M.reset_mesh()
+        try:
+            hist = eng.fit(ds, batch_size=8, epochs=1, verbose=0)
+        finally:
+            M.reset_mesh()
+        assert np.isfinite(hist["loss"]).all()
+        assert eng._tuning_result is not None
+        assert eng._plan is eng._tuning_result.best
