@@ -4,6 +4,7 @@ from . import distributed, nn
 from .nn import functional
 
 from . import asp
+from .optimizer import DistributedFusedLamb  # noqa: F401
 from .nn.functional import (  # noqa: F401
     graph_send_recv,
     segment_max,

@@ -1,2 +1,2 @@
 from . import functional
-from .layer import FusedLinear, FusedMultiHeadAttention, FusedFeedForward
+from .layer import FusedFeedForward, FusedLinear, FusedMultiHeadAttention, FusedMultiTransformer  # noqa: F401

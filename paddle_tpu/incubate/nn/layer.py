@@ -44,3 +44,123 @@ class FusedFeedForward(Layer):
 
     def forward(self, x):
         return self.linear2(self.dropout(self.activation(self.linear1(x))))
+
+
+class FusedMultiTransformer(Layer):
+    """reference: incubate.nn.FusedMultiTransformer (fused_multi_transformer
+    kernel — the inference-fused N-layer transformer the reference builds
+    from hand-written fused CUDA ops).
+
+    TPU-native redesign: all per-layer weights live STACKED with a leading
+    [num_layers] dim and the forward is one `lax.scan` over layers — XLA
+    traces a single block and fuses LN + qkv matmul + attention + FFN per
+    iteration, which is the whole point of the reference's fused kernel.
+    Weight layout (own, MXU-friendly — not the reference's [3, H, Dh, D]):
+    qkv_weight [L, D, 3D], linear_weight [L, D, D], ffn1 [L, D, F],
+    ffn2 [L, F, D]; LN params [L, D].
+
+    Inference-path layer: dropout_rate must be 0 (the reference's is also
+    serving-oriented); training uses nn.TransformerEncoder. KV-cache decode
+    lives in generation.py (fixed-shape cache + jitted loop), not here.
+
+    attn_mask: None (full), "causal", or an additive float mask
+    broadcastable to [B, H, S, S].
+    """
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, epsilon=1e-5, num_layers=-1, nranks=1,
+                 trans_qkvw=True, ring_id=-1, name=None):
+        super().__init__()
+        if dropout_rate:
+            raise ValueError(
+                "FusedMultiTransformer is the inference-fused path: "
+                "dropout_rate must be 0 (train with nn.TransformerEncoder)"
+            )
+        if embed_dim % num_heads:
+            raise ValueError(f"embed_dim {embed_dim} not divisible by heads {num_heads}")
+        if num_layers < 1:
+            raise ValueError("num_layers must be >= 1 (pass it explicitly)")
+        self.embed_dim, self.num_heads = embed_dim, num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        self.num_layers = num_layers
+        self._act = activation
+        L, D, FF = num_layers, embed_dim, dim_feedforward
+        mk = self.create_parameter
+        ones = I.Constant(1.0)
+        zeros = I.Constant(0.0)
+        xav = I.XavierNormal()
+        self.ln_scale = mk([L, D], default_initializer=ones)
+        self.ln_bias = mk([L, D], default_initializer=zeros, is_bias=True)
+        self.qkv_weight = mk([L, D, 3 * D], default_initializer=xav)
+        self.qkv_bias = mk([L, 3 * D], default_initializer=zeros, is_bias=True)
+        self.linear_weight = mk([L, D, D], default_initializer=xav)
+        self.linear_bias = mk([L, D], default_initializer=zeros, is_bias=True)
+        self.ffn_ln_scale = mk([L, D], default_initializer=ones)
+        self.ffn_ln_bias = mk([L, D], default_initializer=zeros, is_bias=True)
+        self.ffn1_weight = mk([L, D, FF], default_initializer=xav)
+        self.ffn1_bias = mk([L, FF], default_initializer=zeros, is_bias=True)
+        self.ffn2_weight = mk([L, FF, D], default_initializer=xav)
+        self.ffn2_bias = mk([L, D], default_initializer=zeros, is_bias=True)
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, time_step=None):
+        import jax
+        import jax.numpy as jnp
+
+        from ...framework.core import apply
+
+        if caches is not None or pre_caches is not None:
+            raise NotImplementedError(
+                "KV-cache decode is served by GenerationMixin.generate "
+                "(fixed-shape cache, generation.py)"
+            )
+        H, Dh, eps = self.num_heads, self.head_dim, self.epsilon
+        pre_ln = self.normalize_before
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[self._act]
+        causal = isinstance(attn_mask, str) and attn_mask == "causal"
+        add_mask = None if (attn_mask is None or causal) else attn_mask
+
+        def ln(x, s, b):
+            mu = jnp.mean(x, -1, keepdims=True)
+            var = jnp.var(x, -1, keepdims=True)
+            return (x - mu) * jax.lax.rsqrt(var + eps) * s + b
+
+        def run(x, *ws, mask=None):
+            def block(h, w):
+                (ln_s, ln_b, qkv_w, qkv_b, out_w, out_b,
+                 f_ln_s, f_ln_b, f1_w, f1_b, f2_w, f2_b) = w
+                B, S, D = h.shape
+                a_in = ln(h, ln_s, ln_b) if pre_ln else h
+                qkv = (a_in @ qkv_w + qkv_b).reshape(B, S, 3, H, Dh)
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                    jnp.asarray(Dh, h.dtype)
+                )
+                if causal:
+                    cm = jnp.tril(jnp.ones((S, S), bool))
+                    logits = jnp.where(cm, logits, jnp.finfo(logits.dtype).min)
+                if mask is not None:
+                    logits = logits + mask
+                probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(h.dtype)
+                attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, D)
+                attn = attn @ out_w + out_b
+                h = h + attn if pre_ln else ln(h + attn, ln_s, ln_b)
+                f_in = ln(h, f_ln_s, f_ln_b) if pre_ln else h
+                f = act(f_in @ f1_w + f1_b) @ f2_w + f2_b
+                h = h + f if pre_ln else ln(h + f, f_ln_s, f_ln_b)
+                return h, None
+
+            out, _ = jax.lax.scan(block, x, ws)
+            return out
+
+        ws = (self.ln_scale, self.ln_bias, self.qkv_weight, self.qkv_bias,
+              self.linear_weight, self.linear_bias, self.ffn_ln_scale,
+              self.ffn_ln_bias, self.ffn1_weight, self.ffn1_bias,
+              self.ffn2_weight, self.ffn2_bias)
+        if add_mask is not None:
+            return apply(lambda x, m, *w: run(x, *w, mask=m), src, add_mask, *ws,
+                         name="fused_multi_transformer")
+        return apply(run, src, *ws, name="fused_multi_transformer")

@@ -1,0 +1,49 @@
+"""incubate distributed optimizers (reference:
+python/paddle/incubate/optimizer/distributed_fused_lamb.py)."""
+from ..optimizer.optimizers import Lamb
+
+
+class DistributedFusedLamb(Lamb):
+    """reference: incubate.DistributedFusedLamb — LAMB with flattened/fused
+    parameter storage, gradient allreduce, and optimizer states sharded
+    across the data-parallel group.
+
+    TPU-native mapping: every "distributed fused" mechanism the reference
+    hand-builds is the compiled step's job here —
+
+    - fused flat storage & fused kernel: the whole update is ONE XLA program
+      (TrainStep jits every per-param `_rule` together; XLA fuses);
+    - grad allreduce + `is_grad_scaled_by_nranks`: DistributedTrainStep's
+      mean-psum over the batch axes;
+    - sharded optimizer states: `sharding_stage>=1` shards the moment/master
+      slots over the `sharding` mesh axis (XLA weight-update sharding);
+    - `clip_after_allreduce`: global-norm clip always sees post-reduction
+      grads inside the compiled step, so True is the only semantics.
+
+    The class therefore carries the reference's constructor surface, applies
+    the LAMB math (decoupled decay mask per `exclude_from_weight_decay_fn`),
+    and validates the knobs that would silently change numerics.
+    """
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, clip_after_allreduce=True,
+                 is_grad_scaled_by_nranks=True, alignment=128,
+                 use_master_param_norm=True, gradient_accumulation_steps=1,
+                 use_master_acc_grad=True, nproc_per_node=-1, name=None):
+        if not clip_after_allreduce:
+            raise ValueError(
+                "clip_after_allreduce=False is unrepresentable here: the "
+                "compiled step clips the already-reduced gradient"
+            )
+        super().__init__(
+            learning_rate=learning_rate, lamb_weight_decay=lamb_weight_decay,
+            beta1=beta1, beta2=beta2, epsilon=epsilon, parameters=parameters,
+            grad_clip=grad_clip,
+            exclude_from_weight_decay_fn=exclude_from_weight_decay_fn,
+            multi_precision=use_master_param_norm, name=name,
+        )
+        # accumulation is a TrainStep(accumulate_steps=...) concern; stored so
+        # hapi/Engine can read it off the optimizer like the reference does
+        self.gradient_accumulation_steps = int(gradient_accumulation_steps)
+        self.is_grad_scaled_by_nranks = bool(is_grad_scaled_by_nranks)

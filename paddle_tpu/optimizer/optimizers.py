@@ -222,8 +222,27 @@ class Lamb(Optimizer):
         step_f = jnp.asarray(step, jnp.float32)
         mhat = m / (1 - b1**step_f)
         vhat = v / (1 - b2**step_f)
-        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._lamb_wd * p
+        decay = slots.get("_decay", 1.0)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._lamb_wd * decay * p
         w_norm = jnp.linalg.norm(p)
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return p - lr * trust * r, {**slots, "moment1": m, "moment2": v}
+
+    def _no_decay(self, p, name=""):
+        return (self._exclude_fn is not None and self._exclude_fn(p)) or getattr(
+            p, "no_weight_decay", False
+        )
+
+    def step(self):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                if p.grad is not None:
+                    self._slots_for(p)["_decay"] = 0.0 if self._no_decay(p) else 1.0
+        super().step()
+
+    def init_state(self, named_params):
+        state = super().init_state(named_params)
+        for name, p in named_params.items():
+            state["slots"][name]["_decay"] = 0.0 if self._no_decay(p, name) else 1.0
+        return state

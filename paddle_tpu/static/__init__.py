@@ -261,3 +261,108 @@ class Executor:
             runner = program._exec_cache[key] = jax.jit(evaluate)
         outs = runner(feed)
         return [np.asarray(o) for o in outs]
+
+
+def _graph_fn(fetch_list):
+    """The recorded graph as a pure fn of {feed name: array} (the same
+    memo-evaluator Executor.run jits, factored for export)."""
+
+    def evaluate(feed_arrays):
+        memo = {}
+
+        def ev(v):
+            if not getattr(v, "_is_static_var", False):
+                return v._data
+            if v._op is None:
+                if v.name not in feed_arrays:
+                    raise KeyError(f"feed missing input '{v.name}'")
+                return feed_arrays[v.name]
+            if id(v._op) not in memo:
+                args = [ev(t) for t in v._op.inputs]
+                out = v._op.fn(*args)
+                memo[id(v._op)] = out if isinstance(out, (tuple, list)) else (out,)
+            return memo[id(v._op)][v._op_out]
+
+        return tuple(ev(f) for f in fetch_list)
+
+    return evaluate
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **configs):
+    """reference: static.save_inference_model (serialized Program +
+    persistables). TPU-native artifact: the recorded feed→fetch graph is
+    traced and exported as StableHLO (jax.export) with weights baked in as
+    constants; dynamic dims (-1) become SYMBOLIC dimensions — dim 0 shares
+    one "batch" symbol across feeds, other dynamic dims get their own — so
+    the loaded artifact serves any batch size without retracing."""
+    import json
+
+    from jax import export as jexport
+
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+
+    extra = itertools.count()
+    scopes = {}
+
+    def aval(v):
+        dims = []
+        for i, s in enumerate(v._shape):
+            if s == -1:
+                sym = "batch" if i == 0 else f"d{next(extra)}"
+                dims.append(sym)
+            else:
+                dims.append(str(s))
+        shape = jexport.symbolic_shape(",".join(dims), scope=scopes.setdefault("s", jexport.SymbolicScope()))
+        return jax.ShapeDtypeStruct(tuple(shape), v._dtype)
+
+    feeds = {v.name: aval(v) for v in feed_vars}
+    exp = jexport.export(jax.jit(_graph_fn(fetch_vars)))(feeds)
+    header = {
+        "feed": [
+            {"name": v.name, "shape": v._shape, "dtype": str(np.dtype(v._dtype))}
+            for v in feed_vars
+        ],
+        "fetch": [v.name for v in fetch_vars],
+    }
+    blob = json.dumps(header).encode() + b"\n" + exp.serialize()
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(blob)
+    return path_prefix + ".pdmodel"
+
+
+class _LoadedInferenceProgram:
+    """Callable returned by load_inference_model; Executor.run routes
+    callables here: program(**{name: Tensor}) -> [np.ndarray, ...]."""
+
+    def __init__(self, exp, feed_names, fetch_names):
+        self._exp = exp
+        self.feed_target_names = feed_names
+        self.fetch_names = fetch_names
+
+    def __call__(self, **feed):
+        arrays = {k: to_tensor(v)._data for k, v in feed.items()}
+        missing = [n for n in self.feed_target_names if n not in arrays]
+        if missing:
+            raise KeyError(f"load_inference_model program: feed missing {missing}")
+        outs = self._exp.call({n: arrays[n] for n in self.feed_target_names})
+        return [np.asarray(o) for o in outs]
+
+
+def load_inference_model(path_prefix, executor=None, **configs):
+    """Returns [program, feed_target_names, fetch_targets] like the
+    reference; `program` is the deserialized StableHLO artifact wrapped as
+    a callable Executor.run understands."""
+    import json
+
+    from jax import export as jexport
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        blob = f.read()
+    nl = blob.index(b"\n")
+    header = json.loads(blob[:nl].decode())
+    exp = jexport.deserialize(bytearray(blob[nl + 1:]))
+    prog = _LoadedInferenceProgram(exp, [d["name"] for d in header["feed"]],
+                                   header["fetch"])
+    return [prog, prog.feed_target_names, prog.fetch_names]

@@ -97,11 +97,12 @@ NAMESPACES = {
     "paddle.jit": """to_static save load not_to_static ignore_module enable_to_static
         TrainStep""",
     "paddle.static": """InputSpec Program Executor data program_guard
-        default_main_program default_startup_program Variable""",
+        default_main_program default_startup_program Variable
+        save_inference_model load_inference_model""",
     "paddle.sparse": """sparse_coo_tensor sparse_csr_tensor matmul masked_matmul add
         multiply relu nn is_same_shape""",
     "paddle.incubate": """asp nn softmax_mask_fuse segment_sum segment_mean segment_max
-        segment_min graph_send_recv""",
+        segment_min graph_send_recv DistributedFusedLamb""",
     "paddle.vision": """models transforms datasets ops image_load set_image_backend""",
     "paddle.metric": """Metric Accuracy Precision Recall Auc accuracy""",
     "paddle.distribution": """Distribution Normal Uniform Categorical Bernoulli Beta

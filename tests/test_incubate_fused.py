@@ -1,0 +1,166 @@
+"""FusedMultiTransformer / DistributedFusedLamb / static inference-model io
+(reference: incubate/nn/layer/fused_transformer.py,
+incubate/optimizer/distributed_fused_lamb.py, static/io.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+
+def _manual_block(x, i, m, causal=False, mask=None):
+    """One transformer layer in numpy-on-jnp from layer i's sliced weights —
+    the oracle the scanned implementation must match."""
+    import jax
+    import jax.numpy as jnp
+
+    g = lambda t: jnp.asarray(t.numpy()[i])  # noqa: E731
+    eps = m.epsilon
+    H, Dh = m.num_heads, m.head_dim
+
+    def ln(h, s, b):
+        mu = h.mean(-1, keepdims=True)
+        return (h - mu) / jnp.sqrt(h.var(-1, keepdims=True) + eps) * s + b
+
+    B, S, D = x.shape
+    a_in = ln(x, g(m.ln_scale), g(m.ln_bias))
+    qkv = (a_in @ g(m.qkv_weight) + g(m.qkv_bias)).reshape(B, S, 3, H, Dh)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(Dh)
+    if causal:
+        cm = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(cm, logits, jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        logits = logits + mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1).astype(x.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, D)
+    x = x + attn @ g(m.linear_weight) + g(m.linear_bias)
+    f_in = ln(x, g(m.ffn_ln_scale), g(m.ffn_ln_bias))
+    f = jax.nn.gelu(f_in @ g(m.ffn1_weight) + g(m.ffn1_bias)) @ g(m.ffn2_weight) + g(m.ffn2_bias)
+    return x + f
+
+
+class TestFusedMultiTransformer:
+    def _mk(self, L=3, D=32, H=4, FF=64):
+        paddle.seed(7)
+        return FusedMultiTransformer(D, H, FF, num_layers=L)
+
+    def test_scan_matches_per_layer_oracle(self):
+        m = self._mk()
+        x = np.random.RandomState(0).randn(2, 8, 32).astype(np.float32)
+        out = m(paddle.to_tensor(x)).numpy()
+        h = x
+        for i in range(m.num_layers):
+            h = np.asarray(_manual_block(h, i, m))
+        np.testing.assert_allclose(out, h, atol=1e-4)
+
+    def test_causal_mask(self):
+        m = self._mk(L=2)
+        x = np.random.RandomState(1).randn(1, 6, 32).astype(np.float32)
+        out = m(paddle.to_tensor(x), attn_mask="causal").numpy()
+        h = x
+        for i in range(2):
+            h = np.asarray(_manual_block(h, i, m, causal=True))
+        np.testing.assert_allclose(out, h, atol=1e-4)
+        # causality: future tokens must not affect earlier outputs
+        x2 = x.copy()
+        x2[:, -1] += 10.0
+        out2 = m(paddle.to_tensor(x2), attn_mask="causal").numpy()
+        np.testing.assert_allclose(out[:, :-1], out2[:, :-1], atol=1e-4)
+
+    def test_additive_mask_and_grads(self):
+        import jax.numpy as jnp
+
+        m = self._mk(L=2)
+        x = np.random.RandomState(2).randn(1, 5, 32).astype(np.float32)
+        mask = np.where(np.random.RandomState(3).rand(1, 1, 5, 5) > 0.5, 0.0, -1e9).astype(np.float32)
+        out = m(paddle.to_tensor(x), attn_mask=paddle.to_tensor(mask))
+        loss = out.sum()
+        loss.backward()
+        g = m.qkv_weight.grad
+        assert g is not None and np.isfinite(g.numpy()).all()
+        h = x
+        for i in range(2):
+            h = np.asarray(_manual_block(h, i, m, mask=jnp.asarray(mask)))
+        np.testing.assert_allclose(out.numpy(), h, atol=1e-4)
+
+    def test_dropout_rejected(self):
+        with pytest.raises(ValueError):
+            FusedMultiTransformer(32, 4, 64, dropout_rate=0.1, num_layers=2)
+
+
+class TestDistributedFusedLamb:
+    def test_trains_and_excludes_decay(self):
+        from paddle_tpu.incubate import DistributedFusedLamb
+        from paddle_tpu.nn.layer.common import Linear
+
+        paddle.seed(0)
+        net = Linear(8, 4)
+        net.bias.no_weight_decay = False
+        opt = DistributedFusedLamb(
+            learning_rate=1e-2, lamb_weight_decay=0.1,
+            parameters=net.parameters(),
+            exclude_from_weight_decay_fn=lambda p: p is net.bias,
+        )
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        for _ in range(3):
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert np.isfinite(net.weight.numpy()).all()
+
+    def test_decay_mask_changes_update(self):
+        """Same grads, same weights: excluded param must see NO decay pull."""
+        from paddle_tpu.incubate import DistributedFusedLamb
+        from paddle_tpu.nn.layer.common import Linear
+
+        def run(exclude):
+            paddle.seed(0)
+            net = Linear(6, 6)
+            opt = DistributedFusedLamb(
+                learning_rate=1e-2, lamb_weight_decay=0.5,
+                parameters=net.parameters(),
+                exclude_from_weight_decay_fn=(lambda p: True) if exclude else None,
+            )
+            x = paddle.to_tensor(np.ones((2, 6), np.float32))
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            return net.weight.numpy()
+
+        w_ex, w_in = run(True), run(False)
+        assert not np.allclose(w_ex, w_in), "decay exclusion had no effect"
+
+    def test_clip_before_allreduce_rejected(self):
+        from paddle_tpu.incubate import DistributedFusedLamb
+
+        with pytest.raises(ValueError):
+            DistributedFusedLamb(clip_after_allreduce=False)
+
+
+class TestInferenceModelIO:
+    def test_save_load_symbolic_batch(self, tmp_path):
+        import paddle_tpu.static as static
+        import paddle_tpu.nn.functional as F
+
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [None, 8], "float32")
+                w = paddle.to_tensor(
+                    np.random.RandomState(0).randn(8, 4).astype(np.float32))
+                z = F.relu(paddle.matmul(x, w))
+                path = str(tmp_path / "m")
+                static.save_inference_model(path, [x], [z])
+                prog2, feed_names, fetch_names = static.load_inference_model(path)
+                assert feed_names == ["x"]
+                exe = static.Executor()
+                for bs in (2, 5):  # symbolic batch: one artifact, many sizes
+                    arr = np.random.RandomState(bs).randn(bs, 8).astype(np.float32)
+                    (out,) = exe.run(prog2, feed={"x": arr}, fetch_list=[0])
+                    np.testing.assert_allclose(
+                        out, np.maximum(arr @ w.numpy(), 0), rtol=1e-5)
+        finally:
+            paddle.disable_static()
