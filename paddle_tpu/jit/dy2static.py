@@ -124,6 +124,37 @@ def convert_not(x):
     return not x
 
 
+_CALL_CACHE = {}
+
+
+def convert_call(fn):
+    """Recursive conversion (reference: convert_call in
+    convert_call_func.py): a plain Python function invoked from converted
+    code is itself converted (cached per function object), so data-dependent
+    control flow works any depth down the call tree. Anything that isn't a
+    convertible user function — builtins, bound methods, callables without
+    retrievable source, functions from jit.ignore_module modules, already
+    converted functions — passes through untouched."""
+    import types
+
+    if not isinstance(fn, types.FunctionType) or getattr(fn, "__dy2static__", False):
+        return fn
+    from . import is_ignored
+
+    if is_ignored(fn) or fn.__module__ in ("jax", "jax.numpy", "numpy"):
+        return fn
+    key = id(fn)
+    hit = _CALL_CACHE.get(key)
+    if hit is not None and hit[0] is fn:
+        return hit[1]
+    try:
+        converted = convert_control_flow(fn)
+    except Exception:
+        converted = fn
+    _CALL_CACHE[key] = (fn, converted)
+    return converted
+
+
 # --------------------------------------------------------------------------
 # AST transform
 # --------------------------------------------------------------------------
@@ -230,6 +261,16 @@ class ControlFlowTransformer(ast.NodeTransformer):
                 func=ast.Attribute(ast.Name("_jst", ast.Load()), "convert_not", ast.Load()),
                 args=[node.operand], keywords=[],
             )
+        return node
+
+    def visit_Call(self, node):
+        # foo(...) -> _jst.convert_call(foo)(...): called user functions get
+        # converted too (convert_call passes non-functions through untouched)
+        self.generic_visit(node)
+        node.func = ast.Call(
+            func=ast.Attribute(ast.Name("_jst", ast.Load()), "convert_call", ast.Load()),
+            args=[node.func], keywords=[],
+        )
         return node
 
     # ---- statement-level ----

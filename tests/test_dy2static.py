@@ -270,6 +270,39 @@ class TestIntegration:
         x = paddle.to_tensor(np.ones(2, np.float32))
         np.testing.assert_allclose(g(x).numpy(), np.full(2, 3.0))
 
+    def test_convert_call_recurses_into_helpers(self):
+        """A tensor-`if` inside a CALLED module-level function must convert
+        too (reference: convert_call recursion)."""
+        def helper(x):
+            if x.sum() > 0:
+                y = x * 2
+            else:
+                y = -x
+            return y
+
+        def f(x):
+            return helper(x) + 1
+
+        g = convert_control_flow(f)
+        xp = paddle.to_tensor(np.ones(3, np.float32))
+        xn = paddle.to_tensor(-np.ones(3, np.float32))
+        np.testing.assert_allclose(g(xp).numpy(), np.full(3, 3.0))
+        np.testing.assert_allclose(g(xn).numpy(), np.full(3, 2.0))
+        # the helper's branch is a lax.cond in the traced program
+        assert "cond" in _jaxpr_of(lambda x: g(x)._data, xp)
+        # and jitted end-to-end through to_static
+        cg = paddle.jit.to_static(f)
+        np.testing.assert_allclose(cg(xn).numpy(), np.full(3, 2.0))
+
+    def test_convert_call_leaves_builtins_and_methods(self):
+        def f(x):
+            vals = [float(v) for v in range(2)]
+            return x + len(vals) + max(1, 0)
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        np.testing.assert_allclose(g(x).numpy(), np.full(2, 3.0))
+
     def test_enable_to_static_false_skips_conversion(self):
         paddle.jit.enable_to_static(False)
         try:
