@@ -19,7 +19,7 @@ import sys
 import time
 
 PROBE_TIMEOUT_S = 90  # backend init alone; a healthy plugin takes seconds
-RUNG_TIMEOUT_S = [600, 420, 420, 420, 360]  # per-rung wall clock (compile+run)
+RUNG_TIMEOUT_S = [600, 420, 420, 420, 360, 300]  # per-rung wall clock (compile+run)
 GQA_RUNG_TIMEOUT_S = 420
 CPU_FALLBACK_TIMEOUT_S = 420
 
@@ -43,6 +43,10 @@ LADDER = [
     dict(hidden=2048, layers=12, heads=16, inter=5504, seq=2048, batch=8,
          recompute="full"),
     dict(hidden=1024, layers=8, heads=16, inter=2816, seq=1024, batch=8,
+         recompute="none"),
+    # deliberately tiny last rung: the compile-helper failure mode is
+    # program-size-correlated; this is the "any TPU number at all" rung
+    dict(hidden=512, layers=4, heads=8, inter=1408, seq=512, batch=8,
          recompute="none"),
 ]
 
@@ -262,13 +266,32 @@ def main():
     wedged = not _probe_backend()
     if wedged:
         errors.append(f"backend probe hung >{PROBE_TIMEOUT_S}s")
+    last = len(LADDER) - 1
     for i in range(len(LADDER) if not wedged else 0):
         print(f"[bench] rung {i}: {LADDER[i]}", file=sys.stderr, flush=True)
         out, timed_out = _run_rung(i, RUNG_TIMEOUT_S[i])
         if timed_out:
             errors.append(f"rung{i}: timeout>{RUNG_TIMEOUT_S[i]}s (backend wedged?)")
+            if i < last:
+                # the compile helper has been observed to die on LARGE
+                # programs specifically (PROFILE.md r4 timeline) — try the
+                # smallest rung before surrendering to CPU: a small real-TPU
+                # number beats a CPU fallback
+                print(f"[bench] big-rung timeout — trying smallest rung {last}",
+                      file=sys.stderr, flush=True)
+                out, timed_out = _run_rung(last, RUNG_TIMEOUT_S[last])
+                if not timed_out and out is not None and "error" not in out:
+                    res = out
+                    res.setdefault("extra", {})["note"] = (
+                        f"smallest-rung fallback after: {'; '.join(errors)}"
+                    )
+                    break
+                errors.append(
+                    f"rung{last}: timeout" if timed_out
+                    else f"rung{last}: {(out or {}).get('error', 'unknown')[:160]}"
+                )
             wedged = True
-            break  # same backend would wedge every rung — go straight to CPU
+            break  # backend wedged for small programs too — CPU fallback
         if out is not None and "error" not in out:
             res = out
             if i:
