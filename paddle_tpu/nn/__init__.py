@@ -1,5 +1,5 @@
 """paddle_tpu.nn (reference: python/paddle/nn/)."""
-from . import functional, initializer
+from . import functional, initializer, quant
 from .layer.activation import *  # noqa: F401,F403
 from .layer.common import *  # noqa: F401,F403
 from .layer.container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401

@@ -1,0 +1,141 @@
+"""paddle.nn.quant parity — weight-only quantization for inference
+(reference: python/paddle/nn/quant/quantized_linear.py —
+weight_quantize / weight_dequantize / weight_only_linear, backed by
+cutlass/fine-grained-dequant GEMM kernels on GPU).
+
+TPU-native design: weights store as int8 (or int4 packed two-per-byte)
+with per-output-channel f32 absmax scales; the matmul path dequantizes
+just-in-time — XLA fuses the (int8 -> bf16 multiply-by-scale) into the
+GEMM's operand read, so HBM traffic drops ~2x (int8) / ~4x (int4) while
+the MXU still sees bf16. That memory saving is the whole win for
+HBM-bound decode (BASELINE.md: decode is bandwidth-limited)."""
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply, to_tensor
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "WeightOnlyLinear", "quantize_for_inference"]
+
+
+def _absmax_scale(w):
+    # per-output-channel (last dim) symmetric absmax
+    return jnp.max(jnp.abs(w), axis=0, keepdims=True).astype(jnp.float32) / 127.0
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """[K, N] float weight -> (quantized int8 weight, [N] f32 scale).
+    int4 packs two nibbles per int8 byte along K (even rows low nibble)."""
+    if algo not in ("weight_only_int8", "weight_only_int4"):
+        raise ValueError(f"unsupported algo {algo!r}")
+    w = to_tensor(x)._data.astype(jnp.float32)
+
+    def q8(w):
+        scale = _absmax_scale(w)
+        qi = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-30)), -127, 127)
+        return qi.astype(jnp.int8), scale[0]
+
+    if algo == "weight_only_int8":
+        q, s = q8(w)
+        return Tensor(q, stop_gradient=True), Tensor(s, stop_gradient=True)
+    # int4: scale to [-7, 7], pack pairs along K
+    scale = jnp.max(jnp.abs(w), axis=0, keepdims=True).astype(jnp.float32) / 7.0
+    qi = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-30)), -7, 7).astype(jnp.int8)
+    if qi.shape[0] % 2:
+        qi = jnp.pad(qi, ((0, 1), (0, 0)))
+    lo, hi = qi[0::2], qi[1::2]
+    packed = ((hi.astype(jnp.uint8) & 0xF) << 4 | (lo.astype(jnp.uint8) & 0xF)).astype(jnp.int8)
+    return Tensor(packed, stop_gradient=True), Tensor(scale[0], stop_gradient=True)
+
+
+def _unpack_int4(packed, k):
+    u = packed.astype(jnp.uint8)
+    lo = (u & 0xF).astype(jnp.int8)
+    hi = ((u >> 4) & 0xF).astype(jnp.int8)
+    # sign-extend 4-bit two's complement
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    full = jnp.stack([lo, hi], axis=1).reshape(-1, packed.shape[1])
+    return full[:k]
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", out_dtype="float32", k=None):
+    q = to_tensor(x)._data
+    s = to_tensor(scale)._data
+    if algo == "weight_only_int4":
+        q = _unpack_int4(q, k if k is not None else q.shape[0] * 2)
+    return Tensor((q.astype(jnp.float32) * s).astype(jnp.dtype(out_dtype)),
+                  stop_gradient=True)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """y = x @ dequant(weight) (+ bias). The dequant is expressed inside the
+    traced matmul so XLA fuses scale-multiply into the GEMM operand read —
+    the weight never materializes in bf16 in HBM."""
+    algo = "weight_only_int4" if str(weight_dtype) == "int4" else "weight_only_int8"
+    xt = to_tensor(x)
+    k = xt.shape[-1]
+
+    def fn(xa, qa, sa, *rest):
+        q = _unpack_int4(qa, k) if algo == "weight_only_int4" else qa
+        w = q.astype(xa.dtype) * sa.astype(xa.dtype)
+        y = xa @ w
+        if rest:
+            y = y + rest[0].astype(xa.dtype)
+        return y
+
+    args = [xt, to_tensor(weight), to_tensor(weight_scale)]
+    if bias is not None:
+        args.append(to_tensor(bias))
+    return apply(fn, *args, name="weight_only_linear")
+
+
+from ..layer.layers import Layer  # noqa: E402
+
+
+class WeightOnlyLinear(Layer):
+    """Drop-in inference replacement for a trained nn.Linear: holds the
+    int8/int4 weight + scales as BUFFERS (no grads, excluded from
+    optimizer state) and runs weight_only_linear."""
+
+    def __init__(self, linear, weight_dtype="int8"):
+        super().__init__()
+        self.weight_dtype = str(weight_dtype)
+        algo = "weight_only_int4" if self.weight_dtype == "int4" else "weight_only_int8"
+        qw, sc = weight_quantize(linear.weight, algo=algo)
+        self.in_features = linear.weight.shape[0]
+        self.register_buffer("quant_weight", qw)
+        self.register_buffer("weight_scale", sc)
+        self.bias = getattr(linear, "bias", None)
+
+    def forward(self, x):
+        return weight_only_linear(x, self.quant_weight, self.bias,
+                                  self.weight_scale, weight_dtype=self.weight_dtype)
+
+    @property
+    def weight(self):
+        """Compat/debug accessor (e.g. init_cache dtype probing): the
+        dequantized weight — NOT what forward reads (forward dequantizes
+        inside the fused matmul)."""
+        algo = "weight_only_int4" if self.weight_dtype == "int4" else "weight_only_int8"
+        return weight_dequantize(self.quant_weight, self.weight_scale,
+                                 algo=algo, k=self.in_features)
+
+
+def quantize_for_inference(model, weight_dtype="int8", skip=lambda name, layer: False):
+    """Swap every nn.Linear in `model` for WeightOnlyLinear IN PLACE
+    (reference: paddlenlp weight-only PTQ flow). `skip(name, layer)` keeps
+    named layers full-precision (e.g. lm_head for logit fidelity).
+    Returns the model."""
+    from ...nn.layer.common import Linear
+
+    def convert(parent, prefix=""):
+        for name, child in list(parent.named_children()):
+            full = f"{prefix}.{name}" if prefix else name
+            if isinstance(child, Linear) and not skip(full, child):
+                parent.add_sublayer(name, WeightOnlyLinear(child, weight_dtype))
+            else:
+                convert(child, full)
+
+    convert(model)
+    return model

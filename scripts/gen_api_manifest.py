@@ -103,6 +103,8 @@ NAMESPACES = {
         multiply relu nn is_same_shape""",
     "paddle.incubate": """asp nn softmax_mask_fuse segment_sum segment_mean segment_max
         segment_min graph_send_recv DistributedFusedLamb""",
+    "paddle.nn.quant": """weight_quantize weight_dequantize weight_only_linear
+        WeightOnlyLinear quantize_for_inference""",
     "paddle.vision": """models transforms datasets ops image_load set_image_backend""",
     "paddle.metric": """Metric Accuracy Precision Recall Auc accuracy""",
     "paddle.distribution": """Distribution Normal Uniform Categorical Bernoulli Beta
