@@ -103,8 +103,59 @@ def swiglu(x, y=None, name=None):
     return apply(lambda a, b: jax.nn.silu(a) * b, _t(x), _t(y), name="swiglu")
 
 
-def fused_multi_head_attention(*args, **kwargs):
-    raise NotImplementedError("use nn.functional.scaled_dot_product_attention (Pallas flash path)")
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, name=None):
+    """reference: incubate.nn.functional.fused_multi_head_attention
+    (fused_attention CUDA kernel): [pre-LN ->] qkv matmul -> MHA (+mask,
+    attn dropout) -> out proj -> dropout -> [+residual] [-> post-LN], in
+    the reference's weight layout qkv_weight [3, H, Dh, D], qkv_bias
+    [3, H, Dh]. One traced expression here — XLA produces the fusion the
+    reference hand-wrote.
+    """
+    from ...nn import functional as NF
+    from ...tensor import linalg, manipulation
+
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "decode caches are served by GenerationMixin.generate (generation.py)"
+        )
+    three, H, Dh, D = qkv_weight.shape
+    if three != 3 or D != x.shape[-1]:
+        raise ValueError(f"qkv_weight must be [3, H, Dh, D={x.shape[-1]}], got {qkv_weight.shape}")
+    B, S = x.shape[0], x.shape[1]
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = NF.layer_norm(h, [D], weight=pre_ln_scale, bias=pre_ln_bias,
+                          epsilon=pre_ln_epsilon)
+    w2d = manipulation.transpose(manipulation.reshape(qkv_weight, [3 * H * Dh, D]), [1, 0])
+    qkv = linalg.matmul(h, w2d)  # [B, S, 3*H*Dh]
+    if qkv_bias is not None:
+        qkv = qkv + manipulation.reshape(qkv_bias, [3 * H * Dh])
+    qkv = manipulation.reshape(qkv, [B, S, 3, H, Dh])
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    out = NF.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        is_causal=False, training=training,
+    )
+    out = manipulation.reshape(out, [B, S, H * Dh])
+    out = linalg.matmul(out, linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = NF.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = NF.layer_norm(out, [D], weight=ln_scale, bias=ln_bias, epsilon=ln_epsilon)
+    return out
 
 
 def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,

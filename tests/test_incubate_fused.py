@@ -89,6 +89,60 @@ class TestFusedMultiTransformer:
             FusedMultiTransformer(32, 4, 64, dropout_rate=0.1, num_layers=2)
 
 
+class TestFusedMultiHeadAttention:
+    def test_matches_unfused_composition(self):
+        from paddle_tpu.incubate.nn.functional import fused_multi_head_attention
+        import paddle_tpu.nn.functional as F
+
+        rng = np.random.RandomState(0)
+        B, S, H, Dh = 2, 6, 4, 8
+        D = H * Dh
+        x = rng.randn(B, S, D).astype(np.float32)
+        qkv_w = rng.randn(3, H, Dh, D).astype(np.float32) * 0.1
+        qkv_b = rng.randn(3, H, Dh).astype(np.float32) * 0.1
+        lin_w = rng.randn(D, D).astype(np.float32) * 0.1
+        lin_b = rng.randn(D).astype(np.float32) * 0.1
+        ln_s = np.ones(D, np.float32)
+        ln_b = np.zeros(D, np.float32)
+
+        out = fused_multi_head_attention(
+            paddle.to_tensor(x), paddle.to_tensor(qkv_w), paddle.to_tensor(lin_w),
+            pre_layer_norm=True, pre_ln_scale=paddle.to_tensor(ln_s),
+            pre_ln_bias=paddle.to_tensor(ln_b), qkv_bias=paddle.to_tensor(qkv_b),
+            linear_bias=paddle.to_tensor(lin_b), dropout_rate=0.0,
+            attn_dropout_rate=0.0, training=False,
+        ).numpy()
+
+        # unfused oracle
+        h = F.layer_norm(paddle.to_tensor(x), [D],
+                         weight=paddle.to_tensor(ln_s), bias=paddle.to_tensor(ln_b)).numpy()
+        qkv = h @ qkv_w.reshape(3 * H * Dh, D).T + qkv_b.reshape(-1)
+        qkv = qkv.reshape(B, S, 3, H, Dh)
+        att = F.scaled_dot_product_attention(
+            paddle.to_tensor(qkv[:, :, 0]), paddle.to_tensor(qkv[:, :, 1]),
+            paddle.to_tensor(qkv[:, :, 2]), training=False,
+        ).numpy().reshape(B, S, D)
+        ref = x + (att @ lin_w + lin_b)
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    def test_grads_flow(self):
+        from paddle_tpu.incubate.nn.functional import fused_multi_head_attention
+
+        rng = np.random.RandomState(1)
+        B, S, H, Dh = 1, 4, 2, 4
+        D = H * Dh
+        x = paddle.to_tensor(rng.randn(B, S, D).astype(np.float32), stop_gradient=False)
+        qkv_w = paddle.to_tensor(rng.randn(3, H, Dh, D).astype(np.float32) * 0.1,
+                                 stop_gradient=False)
+        lin_w = paddle.to_tensor(rng.randn(D, D).astype(np.float32) * 0.1,
+                                 stop_gradient=False)
+        out = fused_multi_head_attention(x, qkv_w, lin_w, pre_layer_norm=True,
+                                         dropout_rate=0.0, attn_dropout_rate=0.0)
+        out.sum().backward()
+        assert qkv_w.grad is not None and np.isfinite(qkv_w.grad.numpy()).all()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
 class TestDistributedFusedLamb:
     def test_trains_and_excludes_decay(self):
         from paddle_tpu.incubate import DistributedFusedLamb
