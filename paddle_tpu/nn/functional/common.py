@@ -266,7 +266,10 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
             a = a.reshape(n, c, h // r, r, w // r, r)
             a = a.transpose(0, 1, 3, 5, 2, 4)
             return a.reshape(n, c * r * r, h // r, w // r)
-        raise NotImplementedError
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
 
     return apply(fn, _t(x), name="pixel_unshuffle")
 

@@ -244,7 +244,33 @@ def square_error_cost(input, label):
 
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
-    raise NotImplementedError("ctc_loss: planned (optax ctc_loss integration)")
+    """reference: nn/functional/loss.py ctc_loss (warpctc kernel). TPU:
+    optax.ctc_loss — a pure-XLA forward-backward over the label lattice.
+
+    log_probs: [T, B, C] time-major logits/log-probs (reference layout);
+    labels: [B, L] padded with any value past label_lengths; blank=`blank`.
+    reduction 'mean' divides each sample's loss by its label length, then
+    averages (reference semantics). norm_by_times is a warpctc legacy knob
+    (scales grads, not the loss) — accepted, no-op here."""
+    import optax
+
+    def fn(lp, lab, in_len, lab_len):
+        logits = jnp.transpose(lp, (1, 0, 2)).astype(jnp.float32)  # [B, T, C]
+        B, T, _ = logits.shape
+        L = lab.shape[1]
+        logit_pad = (jnp.arange(T)[None, :] >= in_len[:, None]).astype(jnp.float32)
+        label_pad = (jnp.arange(L)[None, :] >= lab_len[:, None]).astype(jnp.float32)
+        # optax reserves blank_id; labels must be valid class ids everywhere
+        safe_labels = jnp.where(label_pad > 0, 0, lab).astype(jnp.int32)
+        per = optax.ctc_loss(logits, logit_pad, safe_labels, label_pad, blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(per / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+        if reduction == "sum":
+            return jnp.sum(per)
+        return per
+
+    return apply(fn, _t(log_probs), _t(labels), _t(input_lengths), _t(label_lengths),
+                 name="ctc_loss")
 
 
 def dice_loss(input, label, epsilon=1e-5, name=None):

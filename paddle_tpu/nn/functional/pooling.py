@@ -80,8 +80,57 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
 
 
 def _pool_mask(x, out, ksize, stride, padding, nd, data_format):
-    # indices of max within each window (flat spatial index), best-effort
-    return Tensor(jnp.zeros(tuple(out.shape), jnp.int32))
+    """REAL argmax indices per pooling window, flattened over the input's
+    spatial dims per channel map (paddle/torch max_pool return_mask
+    semantics — the contract max_unpool inverts)."""
+    if nd == 1:
+        k = _pair(ksize, 1)[0]
+        s = _pair(stride, 1)[0] if stride is not None else k
+        p = _pair(padding, 1)[0]
+        return _max_indices_2d(_t(x), (k, 1), (s, 1), (p, 0), expand_1d=True)
+    if nd == 2:
+        k = _pair(ksize, 2)
+        s = _pair(stride, 2) if stride is not None else k
+        p = _pair(padding, 2)
+        return _max_indices_2d(_t(x), k, s, p)
+    return Tensor(jnp.zeros(tuple(out.shape), jnp.int32))  # 3d: not required by unpool API
+
+
+def _max_indices_2d(x, k, s, p, expand_1d=False):
+    """x: [N, C, H, W] (or [N, C, L] with expand_1d) -> int32 [N, C, Ho, Wo]
+    flat spatial argmax indices (h*W + w)."""
+    kh, kw = int(k[0]), int(k[1])
+    sh, sw = int(s[0]), int(s[1])
+    ph, pw = int(p[0]), int(p[1])
+
+    def fn(a):
+        if expand_1d:
+            a = a[..., None]
+        N, C, H, W = a.shape
+        Ho = (H + 2 * ph - kh) // sh + 1
+        Wo = (W + 2 * pw - kw) // sw + 1
+        hi = jnp.arange(Ho)[:, None] * sh - ph + jnp.arange(kh)[None, :]  # [Ho, kh]
+        wi = jnp.arange(Wo)[:, None] * sw - pw + jnp.arange(kw)[None, :]  # [Wo, kw]
+        vh = (hi >= 0) & (hi < H)
+        vw = (wi >= 0) & (wi < W)
+        hc = jnp.clip(hi, 0, H - 1)
+        wc = jnp.clip(wi, 0, W - 1)
+        # windows: [N, C, Ho, kh, Wo, kw]
+        win = a[:, :, hc[:, :, None, None], wc[None, None, :, :]]
+        valid = vh[:, :, None, None] & vw[None, None, :, :]
+        win = jnp.where(valid, win, -jnp.inf)
+        win = jnp.moveaxis(win, 3, 4).reshape(N, C, Ho, Wo, kh * kw)
+        kidx = jnp.argmax(win, axis=-1)  # [N, C, Ho, Wo]
+        # map window-slot -> absolute h/w: slot = r*kw + c
+        r, c = kidx // kw, kidx % kw
+        h_abs = hc[jnp.arange(Ho)[None, None, :, None], r]
+        w_abs = wc[jnp.arange(Wo)[None, None, None, :], c]
+        flat = (h_abs * W + w_abs).astype(jnp.int32)
+        if expand_1d:
+            flat = flat[..., 0]
+        return flat
+
+    return apply(fn, x, name="max_pool_indices")
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL", name=None):
@@ -166,4 +215,25 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW", output_size=None, name=None):
-    raise NotImplementedError("max_unpool2d requires real pool indices; not yet supported")
+    """Inverse of max_pool2d(return_mask=True): scatter pooled values back
+    to their argmax positions, zeros elsewhere (reference:
+    nn/functional/pooling.py max_unpool2d / phi unpool kernel)."""
+    k = _pair(kernel_size, 2)
+    s = _pair(stride, 2) if stride is not None else k
+    p = _pair(padding, 2)
+    xt, it = _t(x), _t(indices)
+    N, C, Ho, Wo = xt.shape
+    if output_size is not None:
+        Hout, Wout = [int(v) for v in output_size[-2:]]
+    else:
+        Hout = (Ho - 1) * s[0] - 2 * p[0] + k[0]
+        Wout = (Wo - 1) * s[1] - 2 * p[1] + k[1]
+
+    def fn(v, idx):
+        flat = jnp.zeros((N, C, Hout * Wout), v.dtype)
+        n = jnp.arange(N)[:, None, None]
+        c = jnp.arange(C)[None, :, None]
+        flat = flat.at[n, c, idx.reshape(N, C, -1)].set(v.reshape(N, C, -1))
+        return flat.reshape(N, C, Hout, Wout)
+
+    return apply(fn, xt, it, name="max_unpool2d")

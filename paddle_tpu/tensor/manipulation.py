@@ -344,7 +344,18 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, 
             idx = np.nonzero(change)[0]
             outs.append(Tensor(jnp.asarray(np.diff(np.append(idx, arr.size)))))
         return outs[0] if len(outs) == 1 else tuple(outs)
-    raise NotImplementedError
+    # axis path: dedupe consecutive slices along `axis`
+    moved = np.moveaxis(arr, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    change = np.concatenate([[True], np.any(flat[1:] != flat[:-1], axis=1)])
+    vals = np.moveaxis(moved[change], 0, axis)
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(np.cumsum(change) - 1)))
+    if return_counts:
+        idx = np.nonzero(change)[0]
+        outs.append(Tensor(jnp.asarray(np.diff(np.append(idx, moved.shape[0])))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
 
 
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):

@@ -1,0 +1,112 @@
+"""Real pool indices / max_unpool2d / ctc_loss (reference:
+nn/functional/pooling.py, loss.py warpctc). Oracles: torch CPU."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class TestMaxPoolIndices:
+    def test_indices_match_torch(self):
+        import torch
+
+        x = np.random.RandomState(0).randn(2, 3, 8, 10).astype(np.float32)
+        out, mask = F.max_pool2d(paddle.to_tensor(x), kernel_size=2, stride=2,
+                                 return_mask=True)
+        tout, tidx = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 2, 2, return_indices=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(mask.numpy(), tidx.numpy())
+
+    def test_indices_with_padding_and_stride(self):
+        import torch
+
+        x = np.random.RandomState(1).randn(1, 2, 7, 9).astype(np.float32)
+        out, mask = F.max_pool2d(paddle.to_tensor(x), kernel_size=3, stride=2,
+                                 padding=1, return_mask=True)
+        tout, tidx = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 3, 2, 1, return_indices=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(mask.numpy(), tidx.numpy())
+
+    def test_max_pool1d_indices(self):
+        import torch
+
+        x = np.random.RandomState(2).randn(2, 3, 12).astype(np.float32)
+        out, mask = F.max_pool1d(paddle.to_tensor(x), kernel_size=3, stride=3,
+                                 return_mask=True)
+        tout, tidx = torch.nn.functional.max_pool1d(
+            torch.tensor(x), 3, 3, return_indices=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(mask.numpy(), tidx.numpy())
+
+
+class TestMaxUnpool2d:
+    def test_unpool_inverts_pool(self):
+        import torch
+
+        x = np.random.RandomState(3).randn(2, 2, 8, 8).astype(np.float32)
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2, return_mask=True)
+        up = F.max_unpool2d(out, mask, 2, 2).numpy()
+        tout, tidx = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 2, 2, return_indices=True)
+        tup = torch.nn.functional.max_unpool2d(tout, tidx, 2, 2).numpy()
+        np.testing.assert_allclose(up, tup, atol=1e-6)
+
+    def test_output_size(self):
+        x = np.random.RandomState(4).randn(1, 1, 4, 4).astype(np.float32)
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2, return_mask=True)
+        up = F.max_unpool2d(out, mask, 2, 2, output_size=(4, 4))
+        assert up.shape == [1, 1, 4, 4]
+
+    def test_grad_flows_to_pooled_values(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(5).randn(1, 1, 4, 4).astype(np.float32),
+            stop_gradient=False)
+        out, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+        up = F.max_unpool2d(out, mask, 2, 2)
+        up.sum().backward()
+        g = x.grad.numpy()
+        assert np.isfinite(g).all() and (np.sum(g != 0) == 4)  # one per window
+
+
+class TestCtcLoss:
+    def _case(self, seed=0, T=12, B=3, C=6, L=5):
+        rng = np.random.RandomState(seed)
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = rng.randint(1, C, (B, L)).astype(np.int32)
+        in_len = np.array([T, T - 2, T - 4], np.int32)
+        lab_len = np.array([L, L - 1, L - 2], np.int32)
+        return logits, labels, in_len, lab_len
+
+    def test_matches_torch(self):
+        import torch
+
+        logits, labels, in_len, lab_len = self._case()
+        loss = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                          paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                          blank=0, reduction="none").numpy()
+        tlp = torch.tensor(logits).log_softmax(-1)
+        tref = torch.nn.functional.ctc_loss(
+            tlp, torch.tensor(labels), torch.tensor(in_len), torch.tensor(lab_len),
+            blank=0, reduction="none").numpy()
+        np.testing.assert_allclose(loss, tref, rtol=1e-4, atol=1e-4)
+
+    def test_mean_reduction_semantics(self):
+        logits, labels, in_len, lab_len = self._case(seed=1)
+        per = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                         paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                         reduction="none").numpy()
+        mean = float(F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                                paddle.to_tensor(in_len), paddle.to_tensor(lab_len),
+                                reduction="mean").numpy())
+        np.testing.assert_allclose(mean, np.mean(per / lab_len), rtol=1e-5)
+
+    def test_grad_flows(self):
+        logits, labels, in_len, lab_len = self._case(seed=2)
+        lp = paddle.to_tensor(logits, stop_gradient=False)
+        loss = F.ctc_loss(lp, paddle.to_tensor(labels), paddle.to_tensor(in_len),
+                          paddle.to_tensor(lab_len))
+        loss.backward()
+        assert lp.grad is not None and np.isfinite(lp.grad.numpy()).all()
