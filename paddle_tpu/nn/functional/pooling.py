@@ -159,17 +159,80 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
     out = _adaptive(x, output_size, 1, "max", "NCL")
-    return (out, Tensor(jnp.zeros(tuple(out.shape), jnp.int32))) if return_mask else out
+    if not return_mask:
+        return out
+    return out, _adaptive_max_indices(_t(x), _pair(output_size, 1), nd=1)
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     out = _adaptive(x, output_size, 2, "max", "NCHW")
-    return (out, Tensor(jnp.zeros(tuple(out.shape), jnp.int32))) if return_mask else out
+    if not return_mask:
+        return out
+    return out, _adaptive_max_indices(_t(x), _pair(output_size, 2), nd=2)
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     out = _adaptive(x, output_size, 3, "max", "NCDHW")
-    return (out, Tensor(jnp.zeros(tuple(out.shape), jnp.int32))) if return_mask else out
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d(return_mask=True): 3d argmax indices not "
+            "implemented — 1d/2d are; file a need if this path matters"
+        )
+    return out
+
+
+def _adaptive_max_indices(x, osize, nd):
+    """Flat spatial argmax indices for adaptive max pooling (torch/paddle
+    return_mask contract), variable per-bin windows handled by gathering
+    max-width windows with validity masking."""
+    spatial = x.shape[2:]
+    osize = [spatial[i] if osize[i] is None else int(osize[i]) for i in range(nd)]
+
+    def bins(in_s, out_s):
+        st = (np.arange(out_s) * in_s) // out_s
+        en = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+        return st, en, int((en - st).max())
+
+    if nd == 1:
+        (L,) = spatial
+        st, en, K = bins(L, osize[0])
+
+        def fn(a):
+            pos = jnp.asarray(st)[:, None] + jnp.arange(K)[None, :]  # [Lo, K]
+            valid = pos < jnp.asarray(en)[:, None]
+            pc = jnp.clip(pos, 0, L - 1)
+            win = a[:, :, pc]  # [N, C, Lo, K]
+            win = jnp.where(valid[None, None], win, -jnp.inf)
+            kidx = jnp.argmax(win, axis=-1)
+            return jnp.take_along_axis(
+                jnp.broadcast_to(pc, win.shape[:2] + pc.shape), kidx[..., None], -1
+            )[..., 0].astype(jnp.int32)
+
+        return apply(fn, x, name="adaptive_max_indices1d")
+
+    H, W = spatial
+    sh, eh, Kh = bins(H, osize[0])
+    sw, ew, Kw = bins(W, osize[1])
+
+    def fn(a):
+        N, C = a.shape[:2]
+        hp = jnp.asarray(sh)[:, None] + jnp.arange(Kh)[None, :]  # [Ho, Kh]
+        wp = jnp.asarray(sw)[:, None] + jnp.arange(Kw)[None, :]  # [Wo, Kw]
+        vh = hp < jnp.asarray(eh)[:, None]
+        vw = wp < jnp.asarray(ew)[:, None]
+        hc = jnp.clip(hp, 0, H - 1)
+        wc = jnp.clip(wp, 0, W - 1)
+        win = a[:, :, hc[:, :, None, None], wc[None, None, :, :]]  # [N,C,Ho,Kh,Wo,Kw]
+        valid = vh[:, :, None, None] & vw[None, None, :, :]
+        win = jnp.where(valid, win, -jnp.inf)
+        win = jnp.moveaxis(win, 3, 4).reshape(N, C, len(sh), len(sw), Kh * Kw)
+        kidx = jnp.argmax(win, axis=-1)
+        r, c = kidx // Kw, kidx % Kw
+        h_abs = hc[jnp.arange(len(sh))[None, None, :, None], r]
+        w_abs = wc[jnp.arange(len(sw))[None, None, None, :], c]
+        return (h_abs * W + w_abs).astype(jnp.int32)
+
+    return apply(fn, x, name="adaptive_max_indices2d")
 
 
 def _adaptive(x, output_size, nd, mode, data_format):

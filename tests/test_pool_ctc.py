@@ -42,6 +42,28 @@ class TestMaxPoolIndices:
         np.testing.assert_array_equal(mask.numpy(), tidx.numpy())
 
 
+class TestAdaptiveMaxIndices:
+    def test_2d_matches_torch(self):
+        import torch
+
+        x = np.random.RandomState(6).randn(2, 3, 7, 9).astype(np.float32)
+        out, mask = F.adaptive_max_pool2d(paddle.to_tensor(x), (3, 4), return_mask=True)
+        tout, tidx = torch.nn.functional.adaptive_max_pool2d(
+            torch.tensor(x), (3, 4), return_indices=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(mask.numpy(), tidx.numpy())
+
+    def test_1d_matches_torch(self):
+        import torch
+
+        x = np.random.RandomState(7).randn(2, 3, 11).astype(np.float32)
+        out, mask = F.adaptive_max_pool1d(paddle.to_tensor(x), 4, return_mask=True)
+        tout, tidx = torch.nn.functional.adaptive_max_pool1d(
+            torch.tensor(x), 4, return_indices=True)
+        np.testing.assert_allclose(out.numpy(), tout.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(mask.numpy(), tidx.numpy())
+
+
 class TestMaxUnpool2d:
     def test_unpool_inverts_pool(self):
         import torch
