@@ -155,7 +155,8 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
 
 
 def run_decode(hidden=2048, layers=12, heads=16, kv_heads=None, inter=5504,
-               vocab=32000, batch=8, prompt_len=512, new_tokens=256):
+               vocab=32000, batch=8, prompt_len=512, new_tokens=256,
+               quantize=None):
     """Serving-path rung: jitted generate() with the fixed-shape KV cache
     (generation.py). Reports decode tokens/s/chip = B*new_tokens / wall after
     the compile is warm (a second call on the same bucket reuses the program)."""
@@ -180,6 +181,12 @@ def run_decode(hidden=2048, layers=12, heads=16, kv_heads=None, inter=5504,
     )
     model = LlamaForCausalLM(cfg)
     model.bfloat16()
+    if quantize:
+        # weight-only int8/int4: the HBM-bandwidth lever for decode
+        from paddle_tpu.nn.quant import quantize_for_inference
+
+        model.eval()
+        quantize_for_inference(model, quantize, skip=lambda n, l: "lm_head" in n)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, vocab, (batch, prompt_len)).astype(np.int32)
     out = model.generate(ids, max_new_tokens=new_tokens)  # compile + warm
@@ -195,7 +202,8 @@ def run_decode(hidden=2048, layers=12, heads=16, kv_heads=None, inter=5504,
         "unit": "tokens/s/chip",
         "vs_baseline": 0.0,
         "extra": {
-            "config": f"h{hidden}-L{layers}-a{heads}-b{batch}-p{prompt_len}-n{new_tokens}",
+            "config": (f"h{hidden}-L{layers}-a{heads}-b{batch}-p{prompt_len}-n{new_tokens}"
+                       + (f"-w{quantize}" if quantize else "")),
             "backend": jax.default_backend(),
             "wall_s": round(dt, 3),
         },
@@ -212,7 +220,9 @@ def _child_main(rung_idx, force_cpu=False):
 
         jax.config.update("jax_platforms", "cpu")
     try:
-        if rung_idx == -2:
+        if rung_idx == -3:
+            res = run_decode(quantize="int8")
+        elif rung_idx == -2:
             res = run_decode()
         else:
             res = run(**(LADDER[rung_idx] if rung_idx >= 0 else GQA_RUNG))
@@ -323,6 +333,10 @@ def main():
                 "tokens_per_sec": dec["value"],
                 "config": dec.get("extra", {}).get("config"),
             }
+            # int8 weight-only variant: the bandwidth-bound comparison point
+            di, _ = _run_rung(-3, DECODE_RUNG_TIMEOUT_S)
+            if di is not None and "error" not in di:
+                res["extra"]["decode"]["int8_tokens_per_sec"] = di["value"]
         else:
             res.setdefault("extra", {})["decode"] = {
                 "error": "timeout" if dec_timeout else str((dec or {}).get("error"))[:160]
