@@ -55,27 +55,66 @@ def _raw(x):
     return x._data if isinstance(x, Tensor) else x
 
 
+def _jaxable(x):
+    import jax
+    import numpy as np
+
+    from ..framework.core import Tensor
+
+    return isinstance(x, (Tensor, jax.Array, jax.core.Tracer, np.ndarray,
+                          int, float, bool, complex)) and not isinstance(x, str)
+
+
+def _split_operands(ins):
+    """(mask, operands): which ins can ride a lax primitive as operands;
+    the rest (self, modules, strings, layers...) stay closure-carried."""
+    mask = [_jaxable(x) for x in ins]
+    return mask, tuple(x for x, b in zip(ins, mask) if b)
+
+
+def _rebind(fn, ins, mask):
+    """fn over the full ins list -> fn over the jax operands only (aux
+    values captured from `ins` by position)."""
+
+    def call(*ops):
+        it = iter(ops)
+        return fn(*[next(it) if b else x for x, b in zip(ins, mask)])
+
+    return call
+
+
 def convert_ifelse(pred, true_fn, false_fn, ins):
     """Data-dependent `if`: traced predicate -> lax.cond, Python predicate ->
     plain branch call (identical semantics, zero overhead when not traced)."""
-    if _is_traced(pred) or any(_is_traced(x) for x in ins):
-        if _is_traced(pred):
-            import jax
+    if _is_traced(pred):
+        import jax
 
-            return jax.lax.cond(_raw(pred), true_fn, false_fn, *ins)
+        mask, ops = _split_operands(ins)
+        return jax.lax.cond(_raw(pred), _rebind(true_fn, ins, mask),
+                            _rebind(false_fn, ins, mask), *ops)
     return true_fn(*ins) if pred else false_fn(*ins)
 
 
 def convert_while(cond_fn, body_fn, carry):
     """Data-dependent `while`: traced condition/carry -> lax.while_loop
-    (cond_fn/body_fn take and return the full carry tuple)."""
+    (cond_fn/body_fn take and return the full carry tuple; non-jax values
+    in the carry stay closure-bound and are returned unchanged)."""
     first = cond_fn(*carry)
     if _is_traced(first) or any(_is_traced(x) for x in carry):
         import jax
 
-        return jax.lax.while_loop(
-            lambda c: _raw(cond_fn(*c)), lambda c: tuple(body_fn(*c)), tuple(carry)
+        mask, ops = _split_operands(carry)
+        cond_c = _rebind(cond_fn, carry, mask)
+
+        def body_c(ops_):
+            outs = _rebind(body_fn, carry, mask)(*ops_)
+            return tuple(o for o, b in zip(outs, mask) if b)
+
+        final_ops = jax.lax.while_loop(
+            lambda c: _raw(cond_c(*c)), body_c, ops
         )
+        it = iter(final_ops)
+        return tuple(next(it) if b else x for x, b in zip(carry, mask))
     while cond_fn(*carry):
         carry = tuple(body_fn(*carry))
     return tuple(carry)
@@ -90,12 +129,16 @@ def convert_range_for(bound_args, body_fn, carry):
         import jax.numpy as jnp
 
         n = jnp.maximum(0, -(-(_raw(stop) - _raw(start)) // _raw(step)))
+        mask, ops = _split_operands(carry)
 
         def body(k, c):
             i = _raw(start) + k * _raw(step)
-            return tuple(body_fn(i, *c))
+            outs = _rebind(lambda *a: body_fn(i, *a), carry, mask)(*c)
+            return tuple(o for o, b in zip(outs, mask) if b)
 
-        return jax.lax.fori_loop(0, n, body, tuple(carry))
+        final_ops = jax.lax.fori_loop(0, n, body, ops)
+        it = iter(final_ops)
+        return tuple(next(it) if b else x for x, b in zip(carry, mask))
     for i in range(start, stop, step):
         carry = tuple(body_fn(i, *carry))
     return tuple(carry)
@@ -126,6 +169,14 @@ def convert_not(x):
 
 _CALL_CACHE = {}
 
+# framework/library code is already traceable — converting it is at best a
+# waste and at worst wrong (their source may rely on module-local state the
+# re-exec'd copy does not see). Only USER functions convert.
+_FRAMEWORK_ROOTS = frozenset({
+    "jax", "jaxlib", "numpy", "paddle_tpu", "optax", "flax", "chex",
+    "torch", "scipy", "einops", "orbax", "haiku", "transformers",
+})
+
 
 def convert_call(fn):
     """Recursive conversion (reference: convert_call in
@@ -141,7 +192,12 @@ def convert_call(fn):
         return fn
     from . import is_ignored
 
-    if is_ignored(fn) or fn.__module__ in ("jax", "jax.numpy", "numpy"):
+    mod = fn.__module__ or ""
+    root = mod.split(".", 1)[0]
+    import sys
+
+    if (is_ignored(fn) or root in _FRAMEWORK_ROOTS
+            or root in getattr(sys, "stdlib_module_names", ())):
         return fn
     key = id(fn)
     hit = _CALL_CACHE.get(key)

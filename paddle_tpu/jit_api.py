@@ -84,10 +84,19 @@ def _convert_control_flow(fn):
 class StaticLayer:
     """A Layer compiled to a pure XLA callable: params/buffers become jit
     arguments via functional_call (reference: PartialProgramLayer running the
-    traced program via the run_program op, python/paddle/jit/dy2static)."""
+    traced program via the run_program op, python/paddle/jit/dy2static).
+
+    The layer's forward gets the dy2static control-flow rewrite (tensor
+    if/while -> lax.cond/while_loop) when convertible — same contract as
+    function to_static."""
 
     def __init__(self, layer):
         self._layer = layer
+        fwd_fn = _convert_control_flow(type(layer).forward)
+        if getattr(fwd_fn, "__dy2static__", False):
+            import types
+
+            layer.forward = types.MethodType(fwd_fn, layer)
 
         @jax.jit
         def fwd(state, key, args, kwargs):

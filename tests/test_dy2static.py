@@ -303,6 +303,29 @@ class TestIntegration:
         x = paddle.to_tensor(np.zeros(2, np.float32))
         np.testing.assert_allclose(g(x).numpy(), np.full(2, 3.0))
 
+    def test_layer_forward_converts(self):
+        from paddle_tpu.nn.layer.layers import Layer
+
+        class Gated(Layer):
+            def __init__(self):
+                super().__init__()
+                self.w = self.create_parameter([3])
+
+            def forward(self, x):
+                if x.sum() > 0:
+                    y = x * self.w
+                else:
+                    y = x - self.w
+                return y
+
+        paddle.seed(0)
+        net = Gated()
+        s = paddle.jit.to_static(net)
+        xp = paddle.to_tensor(np.ones(3, np.float32))
+        xn = paddle.to_tensor(-np.ones(3, np.float32))
+        np.testing.assert_allclose(s(xp).numpy(), (xp * net.w).numpy(), atol=1e-6)
+        np.testing.assert_allclose(s(xn).numpy(), (xn - net.w).numpy(), atol=1e-6)
+
     def test_enable_to_static_false_skips_conversion(self):
         paddle.jit.enable_to_static(False)
         try:
