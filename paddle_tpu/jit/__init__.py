@@ -5,31 +5,112 @@ from ..jit_api import StaticLayer, TrainStep, jit, not_to_static, to_static  # n
 
 
 def save(layer, path, input_spec=None, **configs):
-    """jit.save parity: persist state_dict + a small descriptor. AOT-exported
-    XLA executables are hardware-keyed, so the portable artifact is weights +
-    the to_static-able Layer (reference: paddle/fluid/jit/ property format)."""
+    """jit.save parity (reference: paddle/fluid/jit/ property format +
+    serialized Program). Artifact:
+
+    - `path.pdparams` — state_dict + descriptor (always);
+    - `path.pdmodel` — a runnable StableHLO export of the traced forward
+      (jax.export), written when `input_spec` is given. None dims export as
+      symbolic, dim 0 shared as "batch" — jit.load then returns a
+      TranslatedLayer that runs WITHOUT the Python class, the reference's
+      load-and-serve contract."""
     from .. import serialization
     from ..nn.layer.layers import Layer
 
     target = layer._layer if isinstance(layer, StaticLayer) else layer
-    if isinstance(target, Layer):
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        serialization.save(
-            {
-                "state_dict": target.state_dict(),
-                "class_name": type(target).__name__,
-                "input_spec": [repr(s) for s in (input_spec or [])],
-            },
-            path + ".pdparams",
-        )
-    else:
+    if not isinstance(target, Layer):
         raise TypeError("jit.save expects a Layer or StaticLayer")
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    serialization.save(
+        {
+            "state_dict": target.state_dict(),
+            "class_name": type(target).__name__,
+            "input_spec": [repr(s) for s in (input_spec or [])],
+        },
+        path + ".pdparams",
+    )
+    if input_spec:
+        import jax
+        from jax import export as jexport
+
+        from ..framework.core import Tensor
+
+        scope = jexport.SymbolicScope()
+        extra = iter(range(10000))
+
+        def aval(spec):
+            dims = []
+            for i, s in enumerate(spec.shape):
+                if s is None or s == -1:
+                    dims.append("batch" if i == 0 else f"d{next(extra)}")
+                else:
+                    dims.append(str(int(s)))
+            shape = jexport.symbolic_shape(",".join(dims), scope=scope)
+            import jax.numpy as jnp
+
+            return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(spec.dtype))
+
+        state = target.raw_state_dict()
+
+        def pure(state, *args):
+            out = target.functional_call(
+                {k: Tensor(v, stop_gradient=True) for k, v in state.items()},
+                *[Tensor(a) for a in args],
+                training=False,
+            )
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+
+        exp = jexport.export(jax.jit(pure))(
+            jax.tree.map(lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), state),
+            *[aval(s) for s in input_spec],
+        )
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exp.serialize())
+
+
+class TranslatedLayer:
+    """reference: TranslatedLayer — the loaded, runnable artifact. Calls the
+    deserialized StableHLO export with the saved weights; no access to the
+    original Python class required."""
+
+    def __init__(self, exp, state, descriptor):
+        self._exp = exp
+        self._state = state
+        self._descriptor = descriptor
+        self.training = False
+
+    def __call__(self, *inputs):
+        from ..framework.core import Tensor, to_tensor
+
+        outs = self._exp.call(self._state, *[to_tensor(i)._data for i in inputs])
+        outs = tuple(Tensor(o, stop_gradient=True) for o in outs)
+        return outs[0] if len(outs) == 1 else outs
+
+    def eval(self):
+        return self
+
+    def state_dict(self):
+        return dict(self._state)
 
 
 def load(path, **configs):
+    """With a `.pdmodel` export present: a runnable TranslatedLayer.
+    Otherwise: the saved dict (state_dict + descriptor), the pre-export
+    behavior."""
     from .. import serialization
 
-    return serialization.load(path + ".pdparams")
+    payload = serialization.load(path + ".pdparams")
+    model_path = path + ".pdmodel"
+    if os.path.exists(model_path):
+        from jax import export as jexport
+
+        with open(model_path, "rb") as f:
+            exp = jexport.deserialize(bytearray(f.read()))
+        state = {k: (v._data if hasattr(v, "_data") else v)
+                 for k, v in payload["state_dict"].items()}
+        return TranslatedLayer(exp, state, payload)
+    return payload
 
 
 def enable_to_static(flag):

@@ -326,6 +326,37 @@ class TestIntegration:
         np.testing.assert_allclose(s(xp).numpy(), (xp * net.w).numpy(), atol=1e-6)
         np.testing.assert_allclose(s(xn).numpy(), (xn - net.w).numpy(), atol=1e-6)
 
+    def test_jit_save_load_translated_layer(self, tmp_path):
+        """jit.save with input_spec writes a runnable StableHLO export;
+        jit.load returns a TranslatedLayer serving any batch size without
+        the Python class (reference: TranslatedLayer contract)."""
+        from paddle_tpu.nn.layer.common import Linear
+        from paddle_tpu.static import InputSpec
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        net = nn.Sequential(Linear(8, 16), nn.ReLU(), Linear(16, 4))
+        p = str(tmp_path / "m")
+        paddle.jit.save(net, p, input_spec=[InputSpec([None, 8], "float32")])
+        tl = paddle.jit.load(p)
+        from paddle_tpu.jit import TranslatedLayer
+
+        assert isinstance(tl, TranslatedLayer)
+        for bs in (2, 7):
+            x = np.random.RandomState(bs).randn(bs, 8).astype(np.float32)
+            np.testing.assert_allclose(
+                tl(paddle.to_tensor(x)).numpy(),
+                net(paddle.to_tensor(x)).numpy(), rtol=1e-5)
+
+    def test_jit_save_without_spec_returns_payload(self, tmp_path):
+        from paddle_tpu.nn.layer.common import Linear
+
+        net = Linear(4, 2)
+        p = str(tmp_path / "w")
+        paddle.jit.save(net, p)
+        payload = paddle.jit.load(p)
+        assert "state_dict" in payload and "weight" in payload["state_dict"]
+
     def test_enable_to_static_false_skips_conversion(self):
         paddle.jit.enable_to_static(False)
         try:
