@@ -70,17 +70,21 @@ def main():
     best = None
     while True:
         if probe():
-            log("backend HEALTHY — running bench rung 0")
-            res = run_rung(0)
-            if res is not None:
+            # SMALLEST programs first: the observed failure mode is the
+            # compile helper dying on a big program and wedging everything
+            # after — harvest maximum evidence before risking the big rung
+            log("backend HEALTHY — harvesting smallest-first")
+            for idx in (5, 4, -2, -1, 2, 0):
+                res = run_rung(idx)
+                if res is None:
+                    log(f"rung {idx} failed — stopping this harvest pass")
+                    break
                 mfu = res.get("extra", {}).get("mfu")
-                if best is None or (mfu or 0) > best:
-                    best = mfu or 0
+                if mfu is not None and (best is None or mfu > best):
+                    best = mfu
                     with open("/tmp/tpu_bench_best.json", "w") as f:
                         json.dump(res, f)
                     log(f"new best mfu={mfu} -> /tmp/tpu_bench_best.json")
-                log("running GQA rung")
-                run_rung(-1)
         time.sleep(PERIOD_S)
 
 
