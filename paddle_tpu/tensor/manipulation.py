@@ -12,7 +12,17 @@ def _t(x):
 
 
 def _shape_arg(shape):
-    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+    def coerce(s):
+        if isinstance(s, Tensor):
+            return int(s._data)
+        try:
+            return int(s)
+        except Exception:
+            # symbolic dims (jax.export shape polymorphism) pass through —
+            # they participate in shape arithmetic but are not constants
+            return s
+
+    return tuple(coerce(s) for s in shape)
 
 
 def reshape(x, shape, name=None):
