@@ -22,10 +22,26 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 CFG = dict(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048)
+# CPU-relative ablation profile (PROFILE.md): small enough to sweep on the
+# virtual backend, big enough that fused-CE/recompute/chunk deltas show
+CPU_CFG = dict(hidden=512, layers=4, heads=8, inter=1408, vocab=8192, seq=512)
 
 
 def child():
     import numpy as np
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # the experimental axon plugin initializes even when the env asks
+        # for cpu; the config update actually enforces it
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if jax.default_backend() != "tpu":
+        CFG.update(CPU_CFG)
+    for key in ("hidden", "layers", "heads", "inter", "vocab", "seq"):
+        env = os.environ.get(f"EXP_{key.upper()}")
+        if env:
+            CFG[key] = int(env)
 
     recompute = os.environ.get("EXP_RECOMPUTE", "dots")
     fused_ce = os.environ.get("EXP_FUSED_CE", "1") == "1"
@@ -82,11 +98,14 @@ def child():
     toks = batch * CFG["seq"] / dt
     mfu = flops_per_token * toks / 197e12
 
+    import jax as _jax
+
     print(json.dumps({
         "recompute": recompute, "fused_ce": fused_ce, "attn": fa.LAST_IMPL,
         "chunk": chunk, "batch": batch, "block_q": block_q, "block_k": block_k,
         "step_s": round(dt, 4), "tok_s": round(toks, 1), "mfu": round(mfu, 4),
-        "compile_s": round(compile_s, 1),
+        "compile_s": round(compile_s, 1), "backend": _jax.default_backend(),
+        "shape": f"h{CFG['hidden']}-L{CFG['layers']}-s{CFG['seq']}-v{CFG['vocab']}",
     }), flush=True)
 
 
