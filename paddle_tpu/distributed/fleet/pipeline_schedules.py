@@ -395,6 +395,17 @@ def make_pipeline_train_fn(sched, mesh, first_fn, mid_fn, last_fn):
             # replicated inputs are used in stage-divergent (varying) ways:
             # promote them so VMA typing accepts the per-stage data flow
             pv = lambda x: _pvary(x, ("pp",))
+
+            def pin_rep(x):
+                """Pin to REPLICATED over the auto (mp/sharding/...) axes.
+                The weight-grad accumulators are touched only inside
+                stage-divergent switch branches; left unconstrained, GSPMD
+                may pick per-use shardings whose reconciliation inserts a
+                resharding collective into a branch only ONE pp group
+                executes — observed as a 16-device rendezvous deadlock at
+                mp2 x sharding4 ("involuntary full rematerialization"
+                warning). A fixed sharding removes the reshard entirely."""
+                return jax.lax.with_sharding_constraint(x, P(*([None] * x.ndim)))
             tokens, labels, seed_ct = pv(tokens), pv(labels), pv(seed_ct)
             stk_local = tuple(l[:, 0] for l in flat[:ns])  # [V, Lc, ...]
             emb = tuple(pv(x) for x in flat[ns:ns + ne])
@@ -413,10 +424,15 @@ def make_pipeline_train_fn(sched, mesh, first_fn, mid_fn, last_fn):
                 fmsg=zeros(h0),
                 bmsg=zeros(h0),
                 dstk=tuple(
-                    zeros(jax.ShapeDtypeStruct(l.shape, jnp.float32)) for l in stk_local
+                    pin_rep(zeros(jax.ShapeDtypeStruct(l.shape, jnp.float32)))
+                    for l in stk_local
                 ),
-                demb=tuple(zeros(jax.ShapeDtypeStruct(w.shape, jnp.float32)) for w in emb),
-                dtail=tuple(zeros(jax.ShapeDtypeStruct(w.shape, jnp.float32)) for w in tws),
+                demb=tuple(
+                    pin_rep(zeros(jax.ShapeDtypeStruct(w.shape, jnp.float32))) for w in emb
+                ),
+                dtail=tuple(
+                    pin_rep(zeros(jax.ShapeDtypeStruct(w.shape, jnp.float32))) for w in tws
+                ),
                 loss=zeros(jax.ShapeDtypeStruct((), jnp.float32)),
             )
 
@@ -499,6 +515,9 @@ def make_pipeline_train_fn(sched, mesh, first_fn, mid_fn, last_fn):
                 dh, dcl, de, dtw, loss_add = jax.lax.switch(
                     tBK[t, sid], (b_none, b_first, b_mid, b_last)
                 )
+                dcl = tuple(pin_rep(x) for x in dcl)
+                de = tuple(pin_rep(x) for x in de)
+                dtw = tuple(pin_rep(x) for x in dtw)
                 dstk = tuple(
                     jax.lax.dynamic_update_index_in_dim(
                         acc,

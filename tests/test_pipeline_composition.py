@@ -114,6 +114,30 @@ class TestScheduled1F1BComposition:
         assert abs(losses[0] - ref) < 1e-4, (losses[0], ref)
         assert losses[-1] < losses[0], losses
 
+    def test_16dev_mp2_sharding4_no_deadlock(self):
+        """Regression: at pp2 x mp2 x sharding4 (16 devices) GSPMD used to
+        insert an involuntary-remat resharding collective into a
+        stage-divergent switch branch of the 1F1B engine — only one pp
+        group joined the rendezvous and the program deadlocked (aborted
+        after the 40s CPU rendezvous timeout). The grad-accumulator
+        sharding pins (pipeline_schedules.pin_rep) remove the reshard.
+        Needs 16 virtual devices, so runs in a fresh subprocess."""
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ,
+               "XLA_FLAGS": "--xla_force_host_platform_device_count=16",
+               "JAX_PLATFORMS": "cpu"}
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import __graft_entry__ as g; g.dryrun_multichip(16)"],
+            capture_output=True, text=True, timeout=540, cwd=repo, env=env)
+        assert p.returncode == 0, p.stderr[-800:]
+        assert "parity_delta" in p.stdout, p.stdout
+        assert "sharding=4" in p.stdout, p.stdout
+
     def test_tp_matmuls_actually_partition_under_mp(self):
         """The stage fns' projections must be partitioned over mp, not
         gathered: the placed q_proj weight shards along mp, and the compiled
