@@ -343,7 +343,9 @@ def main():
             }
     if res is None:
         print("[bench] falling back to CPU-forced rung", file=sys.stderr, flush=True)
-        out, timed_out = _run_rung(0, CPU_FALLBACK_TIMEOUT_S, force_cpu=True)
+        # smallest rung: the CPU smoke profile shares its shape, and
+        # recompute=none is the right default off-accelerator
+        out, timed_out = _run_rung(len(LADDER) - 1, CPU_FALLBACK_TIMEOUT_S, force_cpu=True)
         if not timed_out and out is not None and "error" not in out:
             res = out
             res.setdefault("extra", {})["note"] = (
