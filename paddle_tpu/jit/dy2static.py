@@ -217,14 +217,35 @@ def convert_call(fn):
 
 class _NameUse(ast.NodeVisitor):
     """Collect loaded / stored names in a statement list (nested function
-    bodies are opaque: only their binding name counts as a store)."""
+    bodies are opaque: only their binding name counts as a store;
+    comprehension targets are comprehension-scoped in py3 — their stores
+    must NOT count, or branch rewrites would try to return them)."""
 
     def __init__(self):
         self.loads = set()
         self.stores = set()
+        self._comp_depth = 0
 
     def visit_Name(self, node):
-        (self.loads if isinstance(node.ctx, ast.Load) else self.stores).add(node.id)
+        if isinstance(node.ctx, ast.Load):
+            self.loads.add(node.id)
+        elif isinstance(node.ctx, ast.Store) and self._comp_depth == 0:
+            self.stores.add(node.id)
+        # Del ctx: unbinding is not a value the branch could return
+
+    def _comp(self, node):
+        self._comp_depth += 1
+        self.generic_visit(node)
+        self._comp_depth -= 1
+
+    visit_ListComp = visit_SetComp = visit_DictComp = visit_GeneratorExp = _comp
+
+    def visit_NamedExpr(self, node):
+        # walrus assignments leak to the enclosing scope even inside
+        # comprehensions (PEP 572)
+        if isinstance(node.target, ast.Name):
+            self.stores.add(node.target.id)
+        self.visit(node.value)
 
     def visit_FunctionDef(self, node):
         self.stores.add(node.name)

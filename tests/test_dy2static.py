@@ -326,6 +326,47 @@ class TestIntegration:
         np.testing.assert_allclose(s(xp).numpy(), (xp * net.w).numpy(), atol=1e-6)
         np.testing.assert_allclose(s(xn).numpy(), (xn - net.w).numpy(), atol=1e-6)
 
+    def test_comprehension_in_branch(self):
+        """Comprehension targets are comprehension-scoped: they must not be
+        treated as branch outputs (would NameError on the rewritten path)."""
+        def f(x, flag):
+            if flag:
+                parts = [x * i for i in range(1, 3)]
+                y = parts[0] + parts[1]
+            else:
+                y = x
+            return y
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(g(x, True).numpy(), np.full(2, 3.0))
+        np.testing.assert_allclose(g(x, False).numpy(), np.ones(2))
+
+        # and with a tensor predicate the branch still converts correctly
+        def h(x):
+            if x.sum() > 0:
+                y = sum([x * i for i in range(1, 3)])
+            else:
+                y = x
+            return y
+
+        gh = convert_control_flow(h)
+        np.testing.assert_allclose(gh(x).numpy(), np.full(2, 3.0))
+
+    def test_del_in_branch(self):
+        def f(x, flag):
+            if flag:
+                tmp = x * 2
+                y = tmp + 1
+                del tmp
+            else:
+                y = x
+            return y
+
+        g = convert_control_flow(f)
+        x = paddle.to_tensor(np.zeros(2, np.float32))
+        np.testing.assert_allclose(g(x, True).numpy(), np.ones(2))
+
     def test_jit_save_load_translated_layer(self, tmp_path):
         """jit.save with input_spec writes a runnable StableHLO export;
         jit.load returns a TranslatedLayer serving any batch size without
