@@ -35,8 +35,12 @@ class GenerationMixin:
     def init_cache(self, batch_size, max_length, dtype=None):
         cfg = self.config
         if dtype is None:
-            dtype = self.lm_head.weight.dtype if getattr(self, "lm_head", None) is not None \
-                else self.llama.embed_tokens.weight.dtype
+            if getattr(self, "lm_head", None) is not None:
+                dtype = self.lm_head.weight.dtype
+            else:
+                # model-agnostic probe: cache in the compute dtype of the
+                # first parameter (llama tied-embed, GPT wte, ...)
+                dtype = next(iter(self.parameters())).dtype
         import numpy as np
 
         jdt = dtype if not isinstance(dtype, str) else jnp.dtype(dtype)

@@ -17,6 +17,7 @@ from ..nn.layer.layers import Layer
 from ..nn.layer.norm import LayerNorm
 from ..distributed.fleet.pp_layers import PipelineModule
 from ..tensor import creation, manipulation
+from ..generation import GenerationMixin
 from .llama import _mk_linear
 
 
@@ -35,6 +36,15 @@ class GPTConfig:
         self.attention_probs_dropout_prob = attention_probs_dropout_prob
         self.layer_norm_epsilon = layer_norm_epsilon
         self.use_recompute = use_recompute
+
+    # decode-cache geometry (GenerationMixin.init_cache contract; GPT is MHA)
+    @property
+    def num_key_value_heads(self):
+        return self.num_attention_heads
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
 
 
 def gpt3_1p3b(**kw):
@@ -64,11 +74,43 @@ class GPTAttention(Layer):
         self.out_proj = _mk_linear(h, h, P("mp", None))
         self.dropout_p = config.attention_probs_dropout_prob
 
-    def forward(self, x):
+    def forward(self, x, past_key_value=None, cache_position=None):
+        import jax
+
+        from ..framework.core import apply
+
         B, S = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)
         qkv = manipulation.reshape(qkv, [B, S, 3, self.num_heads, self.head_dim])
         q, k, v = manipulation.unbind(qkv, axis=2)
+        if past_key_value is not None and cache_position is not None:
+            # fixed-shape decode cache, same contract as llama (generation.py):
+            # dynamic_update_slice write + absolute-position mask over S_max
+            k_cache, v_cache = past_key_value
+            pos_a = (cache_position._data if hasattr(cache_position, "_data")
+                     else jnp.asarray(cache_position))
+
+            def write(cache, new):
+                return jax.lax.dynamic_update_slice(
+                    cache, new.astype(cache.dtype), (0, pos_a, 0, 0)
+                )
+
+            k_cache = apply(write, k_cache, k, name="kv_cache_write")
+            v_cache = apply(write, v_cache, v, name="kv_cache_write")
+            S_max = k_cache.shape[1]
+
+            def build_mask(p):
+                rows = p + jnp.arange(S)[:, None]
+                cols = jnp.arange(S_max)[None, :]
+                return jnp.where(cols <= rows, 0.0, jnp.float32(-1e9))[None, None]
+
+            mask = apply(build_mask, Tensor(pos_a), name="cache_mask")
+            out = F.scaled_dot_product_attention(
+                q, k_cache, v_cache, attn_mask=mask, is_causal=False,
+                dropout_p=self.dropout_p, training=self.training,
+            )
+            out = manipulation.reshape(out, [B, S, self.num_heads * self.head_dim])
+            return self.out_proj(out), (k_cache, v_cache)
         out = F.scaled_dot_product_attention(
             q, k, v, is_causal=True, dropout_p=self.dropout_p, training=self.training
         )
@@ -86,7 +128,12 @@ class GPTBlock(Layer):
         self.fc_out = _mk_linear(config.intermediate_size, config.hidden_size, P("mp", None))
         self.dropout = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x):
+    def forward(self, x, past_key_value=None, cache_position=None):
+        if past_key_value is not None:
+            attn, present = self.attn(self.ln_1(x), past_key_value, cache_position)
+            x = x + self.dropout(attn)
+            h = self.fc_out(F.gelu(self.fc_in(self.ln_2(x)), approximate=True))
+            return x + self.dropout(h), present
         x = x + self.dropout(self.attn(self.ln_1(x)))
         h = self.fc_out(F.gelu(self.fc_in(self.ln_2(x)), approximate=True))
         return x + self.dropout(h)
@@ -103,12 +150,25 @@ class GPTModel(Layer):
         self.h = LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
         self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, past_key_values=None,
+                cache_position=None, use_cache=False):
+        from ..framework.core import apply
+
         S = input_ids.shape[1]
         if position_ids is None:
-            position_ids = creation.arange(S, dtype="int32")
+            if cache_position is not None:
+                pos0 = cache_position if hasattr(cache_position, "_data") else Tensor(jnp.asarray(cache_position))
+                position_ids = apply(lambda p: p + jnp.arange(S), pos0, name="cache_pos")
+            else:
+                position_ids = creation.arange(S, dtype="int32")
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
+        if past_key_values is not None:
+            presents = []
+            for block, pkv in zip(self.h, past_key_values):
+                x, present = block(x, pkv, cache_position)
+                presents.append(present)
+            return self.ln_f(x), tuple(presents)
         for block in self.h:
             if self.config.use_recompute and self.training:
                 from ..distributed.fleet.recompute import recompute
@@ -172,17 +232,25 @@ class GPTForCausalLMPipe(PipelineModule):
         return self
 
 
-class GPTForCausalLM(Layer):
-    """Tied-embedding LM head (reference GPT: logits = h @ wte^T)."""
+class GPTForCausalLM(GenerationMixin, Layer):
+    """Tied-embedding LM head (reference GPT: logits = h @ wte^T); decode
+    serves through the same fixed-shape KV-cache GenerationMixin as llama —
+    the generation path is model-agnostic."""
 
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.gpt = GPTModel(config)
         self.config = config
 
-    def forward(self, input_ids, labels=None):
+    def forward(self, input_ids, labels=None, past_key_values=None,
+                cache_position=None, use_cache=False):
         from ..tensor import linalg
 
+        if past_key_values is not None:
+            h, presents = self.gpt(input_ids, past_key_values=past_key_values,
+                                   cache_position=cache_position, use_cache=True)
+            logits = linalg.matmul(h, self.gpt.wte.weight, transpose_y=True)
+            return logits, presents
         h = self.gpt(input_ids)
         logits = linalg.matmul(h, self.gpt.wte.weight, transpose_y=True)
         if labels is not None:

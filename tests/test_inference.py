@@ -114,6 +114,47 @@ class TestKVCacheDecode:
             assert (out[0, hit[0] + 1:] == 0).all()
 
 
+class TestGPTDecode:
+    """The KV-cache generation path is model-agnostic: GPT (learned
+    positions, tied wte head) serves through the same GenerationMixin."""
+
+    def _model(self):
+        paddle.seed(5)
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+        cfg = gpt_tiny(hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        return m, cfg
+
+    def test_incremental_matches_full_context(self):
+        m, cfg = self._model()
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+        full = m(paddle.to_tensor(ids))
+        caches = [(paddle.Tensor(k), paddle.Tensor(v)) for k, v in m.init_cache(2, 12)]
+        logits, caches = m(paddle.to_tensor(ids[:, :5]), past_key_values=caches,
+                           cache_position=paddle.to_tensor(np.int32(0)), use_cache=True)
+        steps = [logits.numpy()[:, i] for i in range(5)]
+        for t in range(5, 8):
+            logits, caches = m(
+                paddle.to_tensor(ids[:, t:t + 1]), past_key_values=caches,
+                cache_position=paddle.to_tensor(np.int32(t)), use_cache=True,
+            )
+            steps.append(logits.numpy()[:, 0])
+        inc = np.stack(steps, axis=1)
+        assert np.allclose(full.numpy(), inc, atol=2e-4), np.abs(full.numpy() - inc).max()
+
+    def test_generate_matches_full_context_greedy(self):
+        m, cfg = self._model()
+        ids = np.random.RandomState(2).randint(0, cfg.vocab_size, (2, 9)).astype(np.int32)
+        out = m.generate(ids, max_new_tokens=5)
+        assert out.shape == [2, 14]
+        full = m(paddle.to_tensor(out.numpy()[:, :-1]))
+        nxt = full.numpy()[:, -1].argmax(-1)
+        assert (nxt == out.numpy()[:, -1]).all()
+
+
 class TestAotExport:
     def test_export_roundtrip(self, tmp_path):
         from paddle_tpu.inference.predictor import Predictor
