@@ -51,9 +51,25 @@ class GenerationMixin:
         )
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False, temperature=1.0,
-                 top_k=0, eos_token_id=None, pad_token_id=None, seed=0):
+                 top_k=0, eos_token_id=None, pad_token_id=None, seed=0,
+                 decode_strategy=None, num_beams=1, length_penalty=0.0):
         """Returns [B, S0 + max_new_tokens] int32 token ids (prompt included).
-        After eos, a sequence keeps emitting pad_token_id (defaults to eos)."""
+        After eos, a sequence keeps emitting pad_token_id (defaults to eos).
+
+        decode_strategy (reference: GenerationMixin.generate):
+        "greedy_search" (default), "sampling" (≡ do_sample=True), or
+        "beam_search" (num_beams > 1, static beam width inside ONE jitted
+        scan; length_penalty applies the GNMT ((5+L)/6)^α normalization)."""
+        if decode_strategy is None:
+            decode_strategy = "sampling" if do_sample else (
+                "beam_search" if num_beams > 1 else "greedy_search")
+        if decode_strategy == "sampling":
+            do_sample = True
+        if decode_strategy == "beam_search":
+            if num_beams < 2:
+                raise ValueError("beam_search needs num_beams >= 2")
+            return self._generate_beam(input_ids, max_new_tokens, num_beams,
+                                       length_penalty, eos_token_id, pad_token_id)
         ids = to_tensor(input_ids)._data.astype(jnp.int32)
         B, S0 = ids.shape
         if pad_token_id is None:
@@ -74,6 +90,114 @@ class GenerationMixin:
         state = self.raw_state_dict()
         gen = run(state, ids_p, jnp.int32(S0), jax.random.PRNGKey(seed))
         return Tensor(jnp.concatenate([ids, gen], axis=1), stop_gradient=True)
+
+    def _generate_beam(self, input_ids, max_new_tokens, num_beams, length_penalty,
+                       eos_token_id, pad_token_id):
+        ids = to_tensor(input_ids)._data.astype(jnp.int32)
+        B, S0 = ids.shape
+        if pad_token_id is None:
+            pad_token_id = eos_token_id if eos_token_id is not None else 0
+        S0b = prompt_bucket(S0)
+        key = ("beam", B, S0b, max_new_tokens, num_beams, float(length_penalty),
+               eos_token_id, pad_token_id)
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        run = cache.get(key)
+        if run is None:
+            run = cache[key] = jax.jit(
+                self._build_beam_fn(B, S0b, max_new_tokens, num_beams,
+                                    length_penalty, eos_token_id, pad_token_id)
+            )
+        ids_p = jnp.pad(ids, ((0, 0), (0, S0b - S0)), constant_values=pad_token_id)
+        gen = run(self.raw_state_dict(), ids_p, jnp.int32(S0))
+        return Tensor(jnp.concatenate([ids, gen], axis=1), stop_gradient=True)
+
+    def _build_beam_fn(self, B, S0b, max_new, K, length_penalty, eos_token_id,
+                       pad_token_id):
+        """Static-width beam search in one compiled program: prefill once on
+        [B], replicate the caches to [B*K] beam rows, then a lax.scan where
+        every step scores [B, K*V], takes the top-K joint (score, token)
+        pairs, and GATHERS the beam-reordered caches (jnp.take along the
+        row axis — the XLA equivalent of the reference's beam reorder on
+        cache tensors). Finished beams (emitted eos) are frozen: only their
+        pad continuation keeps the score, so they compete unchanged."""
+        model = self
+        total = S0b + max_new
+        NEG = jnp.float32(-1e9)
+
+        def fwd(state, toks, caches, pos):
+            overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
+            wrapped = [(Tensor(kc), Tensor(vc)) for kc, vc in caches]
+            logits, presents = model.functional_call(
+                overrides, Tensor(toks), past_key_values=wrapped,
+                cache_position=Tensor(pos), use_cache=True, training=False,
+            )
+            return logits._data, tuple((p[0]._data, p[1]._data) for p in presents)
+
+        def lp_norm(length):
+            if not length_penalty:
+                return jnp.float32(1.0)
+            return ((5.0 + length.astype(jnp.float32)) / 6.0) ** length_penalty
+
+        def run(state, ids, true_len):
+            caches = model.init_cache(B, total)
+            logits, caches = fwd(state, ids, caches, jnp.int32(0))
+            last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
+                                                keepdims=False)  # [B, V]
+            logp = jax.nn.log_softmax(last.astype(jnp.float32), axis=-1)
+            V = logp.shape[-1]
+            scores0, tok0 = jax.lax.top_k(logp, K)  # [B, K]
+            # beam rows: [B*K, ...] (beam-major within batch)
+            caches = tuple(
+                (jnp.repeat(kc, K, axis=0), jnp.repeat(vc, K, axis=0))
+                for kc, vc in caches
+            )
+            toks = jnp.full((B, K, max_new), jnp.int32(pad_token_id))
+            toks = toks.at[:, :, 0].set(tok0)
+            done = (tok0 == eos_token_id) if eos_token_id is not None else jnp.zeros((B, K), bool)
+
+            def step(carry, t):
+                caches, toks, scores, done, pos = carry
+                cur = jax.lax.dynamic_index_in_dim(toks, jnp.maximum(t - 1, 0), 2,
+                                                   keepdims=False)  # [B, K]
+                lg, new_caches = fwd(state, cur.reshape(B * K, 1), caches, pos)
+                logp = jax.nn.log_softmax(lg[:, -1].astype(jnp.float32), -1).reshape(B, K, V)
+                # finished beams: only pad continues, at zero cost
+                pad_only = jnp.full((V,), NEG).at[pad_token_id].set(0.0)
+                logp = jnp.where(done[:, :, None], pad_only[None, None], logp)
+                joint = scores[:, :, None] + logp  # [B, K, V]
+                top_s, top_i = jax.lax.top_k(joint.reshape(B, K * V), K)  # [B, K]
+                src_beam = top_i // V
+                new_tok = (top_i % V).astype(jnp.int32)
+                flat_src = (jnp.arange(B)[:, None] * K + src_beam).reshape(-1)
+                new_caches = tuple(
+                    (jnp.take(kc, flat_src, axis=0), jnp.take(vc, flat_src, axis=0))
+                    for kc, vc in new_caches
+                )
+                toks = jnp.take_along_axis(toks, src_beam[:, :, None], axis=1)
+                toks = jax.lax.dynamic_update_index_in_dim(
+                    jnp.moveaxis(toks, 2, 0), new_tok, t, 0
+                )
+                toks = jnp.moveaxis(toks, 0, 2)
+                done = jnp.take_along_axis(done, src_beam, axis=1)
+                if eos_token_id is not None:
+                    done = done | (new_tok == eos_token_id)
+                return (new_caches, toks, top_s, done, pos + 1), None
+
+            if max_new > 1:
+                (caches, toks, scores, done, _), _ = jax.lax.scan(
+                    step, (caches, toks, scores0, done, true_len), jnp.arange(1, max_new)
+                )
+            else:
+                scores = scores0
+            lengths = jnp.where(done, jnp.argmax(toks == eos_token_id, axis=2) + 1,
+                                max_new) if eos_token_id is not None else jnp.full((B, K), max_new)
+            final = scores / lp_norm(lengths)
+            best = jnp.argmax(final, axis=1)  # [B]
+            return jnp.take_along_axis(toks, best[:, None, None], axis=1)[:, 0]
+
+        return run
 
     def _build_generate_fn(self, B, S0b, max_new, do_sample, temperature, top_k,
                            eos_token_id, pad_token_id):

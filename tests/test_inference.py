@@ -1,6 +1,7 @@
 """paddle.inference Predictor tests (reference model: inference zero-copy
 handle API)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import inference, nn
@@ -153,6 +154,77 @@ class TestGPTDecode:
         full = m(paddle.to_tensor(out.numpy()[:, :-1]))
         nxt = full.numpy()[:, -1].argmax(-1)
         assert (nxt == out.numpy()[:, -1]).all()
+
+
+class TestBeamSearch:
+    def test_full_width_beam_is_exhaustive_for_two_steps(self):
+        """With num_beams == V and max_new=2, beam search IS exhaustive
+        search: its result must equal the brute-force argmax of
+        logp(v1) + logp(v2 | v1) over all (v1, v2)."""
+        paddle.seed(11)
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+        cfg = gpt_tiny(vocab_size=32, hidden_dropout_prob=0.0,
+                       attention_probs_dropout_prob=0.0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        V = cfg.vocab_size
+        ids = np.random.RandomState(3).randint(0, V, (1, 6)).astype(np.int32)
+
+        out = m.generate(ids, max_new_tokens=2, decode_strategy="beam_search",
+                         num_beams=V).numpy()
+
+        # brute force: one batched forward per step
+        lp1 = _log_softmax(m(paddle.to_tensor(ids)).numpy()[0, -1])
+        seqs = np.concatenate(
+            [np.repeat(ids, V, axis=0), np.arange(V, dtype=np.int32)[:, None]], axis=1
+        )
+        lp2 = _log_softmax(m(paddle.to_tensor(seqs)).numpy()[:, -1])  # [V, V]
+        joint = lp1[:, None] + lp2
+        v1, v2 = np.unravel_index(np.argmax(joint), joint.shape)
+        assert out[0, -2] == v1 and out[0, -1] == v2, (out[0, -2:], (v1, v2))
+
+    def test_beam_beats_or_matches_greedy_logprob(self):
+        paddle.seed(12)
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        cfg = llama_tiny(num_hidden_layers=2)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        ids = np.random.RandomState(4).randint(0, cfg.vocab_size, (2, 7)).astype(np.int32)
+
+        def seq_logprob(full_ids, s0, n):
+            lg = m(paddle.to_tensor(full_ids[:, :-1])).numpy()
+            lp = np.stack([_log_softmax(lg[:, t]) for t in range(lg.shape[1])], axis=1)
+            tot = np.zeros(full_ids.shape[0])
+            for t in range(s0 - 1, s0 - 1 + n):
+                tot += np.take_along_axis(lp[:, t], full_ids[:, t + 1:t + 2], -1)[:, 0]
+            return tot
+
+        greedy = m.generate(ids, max_new_tokens=4).numpy()
+        beam = m.generate(ids, max_new_tokens=4, decode_strategy="beam_search",
+                          num_beams=4).numpy()
+        g = seq_logprob(greedy, 7, 4)
+        b = seq_logprob(beam, 7, 4)
+        assert (b >= g - 1e-4).all(), (b, g)
+
+    def test_strategy_routing(self):
+        paddle.seed(13)
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        m = LlamaForCausalLM(llama_tiny(num_hidden_layers=1))
+        m.eval()
+        ids = np.zeros((1, 4), np.int32)
+        with pytest.raises(ValueError):
+            m.generate(ids, decode_strategy="beam_search", num_beams=1)
+        out = m.generate(ids, max_new_tokens=2, decode_strategy="sampling", seed=7)
+        assert out.shape == [1, 6]
+
+
+def _log_softmax(x):
+    x = x.astype(np.float64)
+    x = x - x.max(-1, keepdims=True)
+    return x - np.log(np.exp(x).sum(-1, keepdims=True))
 
 
 class TestAotExport:
