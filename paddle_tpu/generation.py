@@ -51,7 +51,7 @@ class GenerationMixin:
         )
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False, temperature=1.0,
-                 top_k=0, eos_token_id=None, pad_token_id=None, seed=0,
+                 top_k=0, top_p=1.0, eos_token_id=None, pad_token_id=None, seed=0,
                  decode_strategy=None, num_beams=1, length_penalty=0.0):
         """Returns [B, S0 + max_new_tokens] int32 token ids (prompt included).
         After eos, a sequence keeps emitting pad_token_id (defaults to eos).
@@ -76,7 +76,7 @@ class GenerationMixin:
             pad_token_id = eos_token_id if eos_token_id is not None else 0
         S0b = prompt_bucket(S0)
         cache_key = (B, S0b, max_new_tokens, do_sample, float(temperature), int(top_k),
-                     eos_token_id, pad_token_id)
+                     float(top_p), eos_token_id, pad_token_id)
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
@@ -84,7 +84,7 @@ class GenerationMixin:
         if run is None:
             run = cache[cache_key] = jax.jit(
                 self._build_generate_fn(B, S0b, max_new_tokens, do_sample, temperature,
-                                        top_k, eos_token_id, pad_token_id)
+                                        top_k, top_p, eos_token_id, pad_token_id)
             )
         ids_p = jnp.pad(ids, ((0, 0), (0, S0b - S0)), constant_values=pad_token_id)
         state = self.raw_state_dict()
@@ -200,7 +200,7 @@ class GenerationMixin:
         return run
 
     def _build_generate_fn(self, B, S0b, max_new, do_sample, temperature, top_k,
-                           eos_token_id, pad_token_id):
+                           top_p, eos_token_id, pad_token_id):
         """Compiled for the (B, S0b bucket, max_new) shape; the true prompt
         length is a dynamic scalar: prefill runs on the right-padded bucket,
         the first token samples from logits[true_len-1], and decode starts
@@ -228,6 +228,17 @@ class GenerationMixin:
             if top_k and top_k > 0:
                 kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
                 logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_p < 1.0:
+                # nucleus: keep the smallest prefix of the sorted distribution
+                # whose mass reaches top_p (the kept set always includes the
+                # argmax token)
+                srt = jnp.sort(logits, axis=-1)[..., ::-1]
+                probs = jax.nn.softmax(srt, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = cum - probs < top_p  # first token always kept
+                kth_idx = jnp.sum(keep, axis=-1) - 1  # last kept rank
+                cutoff = jnp.take_along_axis(srt, kth_idx[..., None], axis=-1)
+                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
             return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
         def run(state, ids, true_len, key):

@@ -208,6 +208,21 @@ class TestBeamSearch:
         b = seq_logprob(beam, 7, 4)
         assert (b >= g - 1e-4).all(), (b, g)
 
+    def test_top_p_nucleus(self):
+        paddle.seed(14)
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        m = LlamaForCausalLM(llama_tiny(num_hidden_layers=1))
+        m.eval()
+        ids = np.array([[1, 2, 3]], np.int32)
+        a = m.generate(ids, max_new_tokens=6, do_sample=True, top_p=0.9, seed=3).numpy()
+        b = m.generate(ids, max_new_tokens=6, do_sample=True, top_p=0.9, seed=3).numpy()
+        assert (a == b).all()
+        # top_p -> 0 keeps only the argmax token: degenerates to greedy
+        g = m.generate(ids, max_new_tokens=6).numpy()
+        p0 = m.generate(ids, max_new_tokens=6, do_sample=True, top_p=1e-6, seed=9).numpy()
+        assert (g == p0).all()
+
     def test_strategy_routing(self):
         paddle.seed(13)
         from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
