@@ -51,7 +51,8 @@ class GenerationMixin:
         )
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False, temperature=1.0,
-                 top_k=0, top_p=1.0, eos_token_id=None, pad_token_id=None, seed=0,
+                 top_k=0, top_p=1.0, repetition_penalty=1.0, min_length=0,
+                 eos_token_id=None, pad_token_id=None, seed=0,
                  decode_strategy=None, num_beams=1, length_penalty=0.0):
         """Returns [B, S0 + max_new_tokens] int32 token ids (prompt included).
         After eos, a sequence keeps emitting pad_token_id (defaults to eos).
@@ -76,7 +77,8 @@ class GenerationMixin:
             pad_token_id = eos_token_id if eos_token_id is not None else 0
         S0b = prompt_bucket(S0)
         cache_key = (B, S0b, max_new_tokens, do_sample, float(temperature), int(top_k),
-                     float(top_p), eos_token_id, pad_token_id)
+                     float(top_p), float(repetition_penalty), int(min_length),
+                     eos_token_id, pad_token_id)
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
@@ -84,7 +86,8 @@ class GenerationMixin:
         if run is None:
             run = cache[cache_key] = jax.jit(
                 self._build_generate_fn(B, S0b, max_new_tokens, do_sample, temperature,
-                                        top_k, top_p, eos_token_id, pad_token_id)
+                                        top_k, top_p, repetition_penalty, min_length,
+                                        eos_token_id, pad_token_id)
             )
         ids_p = jnp.pad(ids, ((0, 0), (0, S0b - S0)), constant_values=pad_token_id)
         state = self.raw_state_dict()
@@ -200,7 +203,8 @@ class GenerationMixin:
         return run
 
     def _build_generate_fn(self, B, S0b, max_new, do_sample, temperature, top_k,
-                           top_p, eos_token_id, pad_token_id):
+                           top_p, repetition_penalty, min_length,
+                           eos_token_id, pad_token_id):
         """Compiled for the (B, S0b bucket, max_new) shape; the true prompt
         length is a dynamic scalar: prefill runs on the right-padded bucket,
         the first token samples from logits[true_len-1], and decode starts
@@ -220,8 +224,21 @@ class GenerationMixin:
             )
             return logits._data, tuple((p[0]._data, p[1]._data) for p in presents)
 
-        def sample(logits, key):
+        def sample(logits, key, seen=None, n_generated=0):
             logits = logits.astype(jnp.float32)
+            if repetition_penalty != 1.0 and seen is not None:
+                # CTRL-style: seen tokens' positive logits divide by the
+                # penalty, negative multiply (reference: repetition_penalty
+                # in generation_utils)
+                pen = jnp.where(logits > 0, logits / repetition_penalty,
+                                logits * repetition_penalty)
+                logits = jnp.where(seen, pen, logits)
+            if min_length > 0 and eos_token_id is not None:
+                logits = jnp.where(
+                    (jnp.asarray(n_generated) < min_length)
+                    & (jnp.arange(logits.shape[-1]) == eos_token_id)[None],
+                    -jnp.inf, logits,
+                )
             if not do_sample:
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32)
             logits = logits / jnp.maximum(temperature, 1e-6)
@@ -246,24 +263,34 @@ class GenerationMixin:
             logits, caches = fwd(state, ids, caches, jnp.int32(0))
             last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
                                                 keepdims=False)
+            V = logits.shape[-1]
+            # seen-token mask over the true prompt (padding excluded)
+            valid = jnp.arange(S0b)[None, :] < true_len
+            seen = jnp.zeros((B, V), bool).at[
+                jnp.arange(B)[:, None], ids
+            ].max(valid)
             key, sk = jax.random.split(key)
-            nxt = sample(last, sk)
+            nxt = sample(last, sk, seen, 0)
+            seen = seen.at[jnp.arange(B), nxt].set(True)
             done = jnp.zeros((B,), bool)
             if eos_token_id is not None:
                 done = nxt == eos_token_id
 
-            def step(carry, k_i):
-                caches, tok, pos, done = carry
+            def step(carry, xs):
+                k_i, i = xs
+                caches, tok, pos, done, seen = carry
                 lg, caches = fwd(state, tok[:, None], caches, pos)
-                n = sample(lg[:, -1], k_i)
+                n = sample(lg[:, -1], k_i, seen, i)
                 n = jnp.where(done, jnp.int32(pad_token_id), n)
+                seen = seen.at[jnp.arange(B), n].set(True)
                 new_done = done | (n == eos_token_id) if eos_token_id is not None else done
-                return (caches, n, pos + 1, new_done), n
+                return (caches, n, pos + 1, new_done, seen), n
 
             if max_new > 1:
                 keys = jax.random.split(key, max_new - 1)
-                (_, _, _, _), rest = jax.lax.scan(
-                    step, (caches, nxt, true_len, done), keys
+                (_, _, _, _, _), rest = jax.lax.scan(
+                    step, (caches, nxt, true_len, done, seen),
+                    (keys, jnp.arange(1, max_new)),
                 )
                 return jnp.concatenate([nxt[:, None], rest.T], axis=1)
             return nxt[:, None]

@@ -223,6 +223,34 @@ class TestBeamSearch:
         p0 = m.generate(ids, max_new_tokens=6, do_sample=True, top_p=1e-6, seed=9).numpy()
         assert (g == p0).all()
 
+    def test_repetition_penalty_reduces_repeats(self):
+        paddle.seed(15)
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        m = LlamaForCausalLM(llama_tiny(num_hidden_layers=1))
+        m.eval()
+        ids = np.array([[5, 6, 7]], np.int32)
+        plain = m.generate(ids, max_new_tokens=12).numpy()[0, 3:]
+        pen = m.generate(ids, max_new_tokens=12, repetition_penalty=5.0).numpy()[0, 3:]
+        assert len(set(pen.tolist())) >= len(set(plain.tolist()))
+        # penalty=1.0 is exactly the plain path
+        same = m.generate(ids, max_new_tokens=12, repetition_penalty=1.0).numpy()[0, 3:]
+        assert (same == plain).all()
+
+    def test_min_length_suppresses_eos(self):
+        paddle.seed(16)
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        m = LlamaForCausalLM(llama_tiny(num_hidden_layers=1))
+        m.eval()
+        ids = np.array([[1, 2, 3]], np.int32)
+        # pick the greedy first-token as eos: without min_length generation
+        # would end immediately
+        first = int(m.generate(ids, max_new_tokens=1).numpy()[0, -1])
+        out = m.generate(ids, max_new_tokens=6, eos_token_id=first,
+                         min_length=4, pad_token_id=0).numpy()[0, 3:]
+        assert first not in out[:4].tolist(), out
+
     def test_strategy_routing(self):
         paddle.seed(13)
         from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
