@@ -122,7 +122,10 @@ def convert_while(cond_fn, body_fn, carry):
 
 def convert_range_for(bound_args, body_fn, carry):
     """`for i in range(...)` with a traced bound -> lax.fori_loop; Python
-    ints -> plain loop. body_fn(i, *carry) -> carry."""
+    ints -> plain loop. body_fn(i, *carry) -> carry. Returns
+    (final_i,) + carry — Python leaves the loop target bound to its last
+    value, so the rewrite rebinds it (zero-trip loops bind it to `start`,
+    where eager Python would leave it unbound — the one divergence)."""
     start, stop, step = bound_args
     if any(_is_traced(b) for b in (start, stop, step)):
         import jax
@@ -138,10 +141,13 @@ def convert_range_for(bound_args, body_fn, carry):
 
         final_ops = jax.lax.fori_loop(0, n, body, ops)
         it = iter(final_ops)
-        return tuple(next(it) if b else x for x, b in zip(carry, mask))
+        final_i = _raw(start) + jnp.maximum(n - 1, 0) * _raw(step)
+        return (final_i,) + tuple(next(it) if b else x for x, b in zip(carry, mask))
+    last = start
     for i in range(start, stop, step):
         carry = tuple(body_fn(i, *carry))
-    return tuple(carry)
+        last = i
+    return (last,) + tuple(carry)
 
 
 def convert_bool_op(op, lhs, rhs_fn):
@@ -492,13 +498,11 @@ class ControlFlowTransformer(ast.NodeTransformer):
                   ast.Tuple([ast.Name(c, ast.Load()) for c in carry], ast.Load())],
             keywords=[],
         )
-        if carry:
-            assign = ast.Assign(
-                targets=[ast.Tuple([ast.Name(c, ast.Store()) for c in carry], ast.Store())],
-                value=call,
-            )
-        else:
-            assign = ast.Expr(call)
+        outs = [node.target.id] + carry  # loop target stays bound after the loop
+        assign = ast.Assign(
+            targets=[ast.Tuple([ast.Name(o, ast.Store()) for o in outs], ast.Store())],
+            value=call,
+        )
         return [bfn, assign]
 
 

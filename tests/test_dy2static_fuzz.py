@@ -1,0 +1,81 @@
+"""Property fuzz for the dy2static AST conversion: random straight-line +
+nested control-flow programs over a scalar-ish tensor state; the CONVERTED
+function must agree with the eager original on every seed, for both Python
+and tensor predicates (reference: test/dygraph_to_static model-zoo parity,
+here as generative coverage)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import convert_control_flow
+
+
+def _gen_program(rng, depth=0):
+    """Emit statements over variables a, b, c (tensors) and n (python int).
+    Returns list of source lines (body of the function)."""
+    lines = []
+    n_stmts = rng.randint(2, 5)
+    for _ in range(n_stmts):
+        kind = rng.choice(
+            ["assign", "if", "while", "for"] if depth < 2 else ["assign"],
+            p=[0.55, 0.2, 0.125, 0.125] if depth < 2 else [1.0],
+        )
+        ind = "    " * depth
+        if kind == "assign":
+            tgt = rng.choice(["a", "b", "c"])
+            src1, src2 = rng.choice(["a", "b", "c"], 2)
+            op = rng.choice(["+", "-", "*"])
+            scale = round(float(rng.uniform(0.5, 1.5)), 3)
+            lines.append(f"{ind}{tgt} = ({src1} {op} {src2}) * {scale}")
+        elif kind == "if":
+            pred = rng.choice([
+                "a.sum() > b.sum()",
+                "(a.sum() > 0) and (b.sum() > 0)",
+                "not (c.sum() > 1)",
+                "n > 1",
+            ])
+            lines.append(f"{ind}if {pred}:")
+            lines += _gen_program(rng, depth + 1)
+            lines.append(f"{ind}else:")
+            lines += _gen_program(rng, depth + 1)
+        elif kind == "while":
+            # bounded: counter guarantees termination under any predicate
+            lines.append(f"{ind}k = paddle.to_tensor(np.int32(0))")
+            lines.append(f"{ind}while (k < 3) and (a.sum() < 50):")
+            lines.append(f"{ind}    a = a * 1.3 + 0.1")
+            lines.append(f"{ind}    k = k + 1")
+        else:  # for over python range
+            lines.append(f"{ind}for i in range(2):")
+            lines.append(f"{ind}    b = b + c * 0.5 + i")
+    return lines
+
+
+def _build(lines):
+    import linecache
+
+    src = "def f(a, b, c, n):\n"
+    for l in lines:
+        src += "    " + l + "\n"
+    src += "    return a + b + c\n"
+    fname = f"<dy2static-fuzz-{abs(hash(src))}>"
+    linecache.cache[fname] = (len(src), None, src.splitlines(True), fname)
+    ns = {"paddle": paddle, "np": np}
+    exec(compile(src, fname, "exec"), ns)
+    return ns["f"], src
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_converted_matches_eager(seed):
+    rng = np.random.RandomState(seed)
+    f, src = _build(_gen_program(rng))
+    try:
+        g = convert_control_flow(f)
+    except Exception as e:  # conversion must never crash on valid programs
+        pytest.fail(f"conversion crashed on:\n{src}\n{e}")
+    vals = rng.randn(3, 4).astype(np.float32)
+    args = tuple(paddle.to_tensor(vals[i]) for i in range(3))
+    for n in (0, 2):
+        ref = f(*args, n).numpy()
+        out = g(*args, n).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"seed={seed} n={n}\n{src}")
