@@ -222,44 +222,45 @@ def convert_call(fn):
 # --------------------------------------------------------------------------
 
 class _NameUse(ast.NodeVisitor):
-    """Collect loaded / stored names in a statement list (nested function
-    bodies are opaque: only their binding name counts as a store;
-    comprehension targets are comprehension-scoped in py3 — their stores
-    must NOT count, or branch rewrites would try to return them)."""
+    """Collect loaded / stored names in a statement list. Nested scopes
+    (lambdas, defs, comprehension targets) contribute LOADS (they may read
+    enclosing locals as free variables — over-approximating loads is safe)
+    but never stores (their bindings are scope-local; only a def's own name
+    binds in the enclosing scope)."""
 
     def __init__(self):
         self.loads = set()
         self.stores = set()
-        self._comp_depth = 0
+        self._nested = 0  # >0: inside a comprehension/lambda/def body
 
     def visit_Name(self, node):
         if isinstance(node.ctx, ast.Load):
             self.loads.add(node.id)
-        elif isinstance(node.ctx, ast.Store) and self._comp_depth == 0:
+        elif isinstance(node.ctx, ast.Store) and self._nested == 0:
             self.stores.add(node.id)
         # Del ctx: unbinding is not a value the branch could return
 
-    def _comp(self, node):
-        self._comp_depth += 1
+    def _opaque(self, node):
+        self._nested += 1
         self.generic_visit(node)
-        self._comp_depth -= 1
+        self._nested -= 1
 
-    visit_ListComp = visit_SetComp = visit_DictComp = visit_GeneratorExp = _comp
+    visit_ListComp = visit_SetComp = visit_DictComp = visit_GeneratorExp = _opaque
+    visit_Lambda = _opaque
 
     def visit_NamedExpr(self, node):
         # walrus assignments leak to the enclosing scope even inside
         # comprehensions (PEP 572)
-        if isinstance(node.target, ast.Name):
+        if isinstance(node.target, ast.Name) and self._nested == 0:
             self.stores.add(node.target.id)
         self.visit(node.value)
 
     def visit_FunctionDef(self, node):
-        self.stores.add(node.name)
+        if self._nested == 0:
+            self.stores.add(node.name)
+        self._opaque(node)
 
     visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_Lambda(self, node):
-        pass  # opaque
 
     @classmethod
     def of(cls, stmts):
@@ -267,6 +268,46 @@ class _NameUse(ast.NodeVisitor):
         for s in stmts if isinstance(stmts, list) else [stmts]:
             v.visit(s)
         return v
+
+
+def _definite_stores(s):
+    """Names CERTAINLY bound after executing statement s (if: both-branch
+    intersection; loops: nothing — zero-trip leaves targets unbound)."""
+    if isinstance(s, ast.If):
+        if not s.orelse:
+            return set()
+        both = [set().union(*(_definite_stores(x) for x in blk)) if blk else set()
+                for blk in (s.body, s.orelse)]
+        return both[0] & both[1]
+    if isinstance(s, (ast.While, ast.For, ast.AsyncFor)):
+        return set()
+    if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return {s.name}
+    if isinstance(s, (ast.Try,)):
+        return set()
+    return _NameUse.of(s).stores
+
+
+def _free_loads(stmts):
+    """Names a statement list may READ before binding them itself — the
+    values a rewritten branch function genuinely needs from outside."""
+    defined, free = set(), set()
+    for s in stmts:
+        if isinstance(s, ast.For) and isinstance(s.target, ast.Name):
+            free |= _NameUse.of(ast.Expr(s.iter)).loads - defined
+            free |= _free_loads(s.body) - defined - {s.target.id}
+            free |= _free_loads(s.orelse) - defined - {s.target.id}
+        elif isinstance(s, ast.If):
+            free |= _NameUse.of(ast.Expr(s.test)).loads - defined
+            free |= _free_loads(s.body) - defined
+            free |= _free_loads(s.orelse) - defined
+        elif isinstance(s, ast.While):
+            free |= _NameUse.of(ast.Expr(s.test)).loads - defined
+            free |= _free_loads(s.body) - defined
+        else:
+            free |= _NameUse.of(s).loads - defined
+        defined |= _definite_stores(s)
+    return free
 
 
 def _has_escape(stmts):
@@ -317,7 +358,16 @@ class ControlFlowTransformer(ast.NodeTransformer):
 
     def __init__(self):
         self.counter = 0
-        self.bound = set()
+        self.bound = set()   # DEFINITELY bound at this point (flow-aware)
+        self.maybe = set()   # possibly bound (stored on at least one path)
+        # liveness frames: per enclosing body position, the names LOADED by
+        # any later statement (incl. the function's return). A name assigned
+        # in only one branch can be dropped from the rewrite's outputs iff
+        # nothing ever reads it afterwards.
+        self._later = []
+
+    def _read_later(self, name):
+        return any(name in frame for frame in self._later)
 
     def _fresh(self, kind):
         self.counter += 1
@@ -358,12 +408,23 @@ class ControlFlowTransformer(ast.NodeTransformer):
 
     # ---- statement-level ----
     def process_body(self, stmts):
+        # future-loads per statement index (suffix union, pre-transform AST)
+        futures = []
+        acc = set()
+        for s in reversed(stmts):
+            futures.append(set(acc))
+            acc |= _NameUse.of(s).loads
+        futures.reverse()
         out = []
-        for s in stmts:
+        for s, fut in zip(stmts, futures):
+            u = _NameUse.of(s)  # BEFORE visiting (visit mutates the tree)
+            definite = _definite_stores(s)
+            self._later.append(fut)
             r = self.visit(s)
+            self._later.pop()
             out.extend(r if isinstance(r, list) else [r])
-            u = _NameUse.of(s)
-            self.bound |= u.stores
+            self.bound |= definite
+            self.maybe |= u.stores
         return out
 
     def visit_FunctionDef(self, node):
@@ -395,26 +456,38 @@ class ControlFlowTransformer(ast.NodeTransformer):
         )
 
     def visit_If(self, node):
-        # rewrite condition expressions (bool ops) first
-        node.test = self.visit(node.test)
-        saved = set(self.bound)
-        body = self.process_body(node.body)
-        self.bound = set(saved)
-        orelse = self.process_body(node.orelse)
-        self.bound = saved  # caller's process_body re-adds stores
+        import copy
 
-        if _has_escape(node.body) or _has_escape(node.orelse):
+        an = copy.deepcopy(node)  # analysis snapshot (visiting mutates nodes)
+        node.test = self.visit(node.test)
+        saved, saved_maybe = set(self.bound), set(self.maybe)
+        body = self.process_body(node.body)
+        self.bound, self.maybe = set(saved), set(saved_maybe)
+        orelse = self.process_body(node.orelse)
+        self.bound, self.maybe = saved, saved_maybe
+
+        if _has_escape(an.body) or _has_escape(an.orelse):
             node.body, node.orelse = body, orelse
             return node
-        ub, ue = _NameUse.of(node.body), _NameUse.of(node.orelse)
+        ub, ue = _NameUse.of(an.body), _NameUse.of(an.orelse)
+        free = _free_loads([an])
+        # a branch reading a MAYBE-bound name is unrepresentable (the branch
+        # function cannot see a conditionally-bound enclosing local)
+        if free & (saved_maybe - saved):
+            node.body, node.orelse = body, orelse
+            return node
         outs = sorted(ub.stores | ue.stores)
-        # a name assigned in only one branch needs a prior binding for the
-        # other branch to return — otherwise leave the `if` untouched
-        for n in outs:
+        # a name assigned in only one branch needs a prior DEFINITE binding
+        # for the other branch to return. If nothing ever reads it
+        # afterwards, DROP it from the rewrite (dead past the branch); if it
+        # IS read later, the `if` must stay untouched.
+        for n in list(outs):
             if n not in saved and not (n in ub.stores and n in ue.stores):
-                node.body, node.orelse = body, orelse
-                return node
-        ins = sorted(((ub.loads | ue.loads | set(outs)) & saved) | (set(outs) & saved))
+                if self._read_later(n):
+                    node.body, node.orelse = body, orelse
+                    return node
+                outs.remove(n)
+        ins = sorted((free | set(outs)) & saved)
         tname, fname = self._fresh("true"), self._fresh("false")
         tfn = self._branch_fn(tname, ins, body, outs)
         ffn = self._branch_fn(fname, ins, orelse, outs)
@@ -434,18 +507,26 @@ class ControlFlowTransformer(ast.NodeTransformer):
         return [tfn, ffn, assign]
 
     def visit_While(self, node):
-        node.test = self.visit(node.test)
-        saved = set(self.bound)
-        body = self.process_body(node.body)
-        self.bound = saved
+        import copy
 
-        u = _NameUse.of(node.body)
-        tu = _NameUse.of(ast.Expr(node.test))
-        if (_has_escape(node.body) or node.orelse
-                or not (u.stores <= saved)):  # carry must be initialized
+        an = copy.deepcopy(node)
+        node.test = self.visit(node.test)
+        saved, saved_maybe = set(self.bound), set(self.maybe)
+        body = self.process_body(node.body)
+        self.bound, self.maybe = saved, saved_maybe
+
+        u = _NameUse.of(an.body)
+        free = _free_loads([an])
+        # carried names must be DEFINITELY initialized before the loop; an
+        # uninitialized store is droppable iff no one reads it (not the
+        # cond, not the body's free reads, not anything after the loop)
+        missing = u.stores - saved
+        blockers = {n for n in missing if n in free or self._read_later(n)}
+        if (_has_escape(an.body) or node.orelse or blockers
+                or (free & (saved_maybe - saved))):
             node.body = body
             return node
-        carry = sorted(u.stores | (tu.loads & u.stores))
+        carry = sorted(u.stores & saved)
         ins = sorted(carry)
         cname, bname = self._fresh("cond"), self._fresh("body")
         cfn = self._branch_fn(cname, ins, [], [])
@@ -467,10 +548,13 @@ class ControlFlowTransformer(ast.NodeTransformer):
         return [cfn, bfn, assign]
 
     def visit_For(self, node):
+        import copy
+
         # only `for NAME in range(...)` converts; everything else unchanged
-        saved = set(self.bound)
+        an = copy.deepcopy(node)
+        saved, saved_maybe = set(self.bound), set(self.maybe)
         body = self.process_body(node.body)
-        self.bound = saved
+        self.bound, self.maybe = saved, saved_maybe
         is_range = (
             isinstance(node.iter, ast.Call)
             and isinstance(node.iter.func, ast.Name)
@@ -479,12 +563,16 @@ class ControlFlowTransformer(ast.NodeTransformer):
             and 1 <= len(node.iter.args) <= 3
             and isinstance(node.target, ast.Name)
         )
-        u = _NameUse.of(node.body)
-        if (not is_range or _has_escape(node.body) or node.orelse
-                or not (u.stores - {node.target.id} <= saved)):
+        u = _NameUse.of(an.body)
+        free = _free_loads([an])
+        target = node.target.id if isinstance(node.target, ast.Name) else None
+        missing = u.stores - {target} - saved
+        blockers = {n for n in missing if n in free or self._read_later(n)}
+        if (not is_range or _has_escape(an.body) or node.orelse or blockers
+                or (free & (saved_maybe - saved))):
             node.body = body
             return node
-        carry = sorted(u.stores - {node.target.id})
+        carry = sorted((u.stores - {target}) & saved)
         ra = node.iter.args
         start = ra[0] if len(ra) >= 2 else ast.Constant(0)
         stop = ra[1] if len(ra) >= 2 else ra[0]

@@ -64,7 +64,29 @@ def _build(lines):
     return ns["f"], src
 
 
-@pytest.mark.parametrize("seed", range(40))
+@pytest.mark.parametrize("seed", range(6))
+def test_converted_matches_eager_under_jit(seed):
+    """The converted program must also TRACE: run it end-to-end under
+    jax.jit (tensor predicates become lax.cond/while_loop) and match eager."""
+    import jax
+
+    rng = np.random.RandomState(1000 + seed)
+    f, src = _build(_gen_program(rng))
+    g = convert_control_flow(f)
+    vals = rng.randn(3, 4).astype(np.float32)
+
+    def run(arrs, n):
+        out = g(*[paddle.Tensor(a) for a in arrs], n)
+        return out._data
+
+    for n in (0, 2):
+        ref = f(*[paddle.to_tensor(vals[i]) for i in range(3)], n).numpy()
+        out = np.asarray(jax.jit(run, static_argnums=1)(tuple(vals), n))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"seed={seed} n={n}\n{src}")
+
+
+@pytest.mark.parametrize("seed", range(80))
 def test_converted_matches_eager(seed):
     rng = np.random.RandomState(seed)
     f, src = _build(_gen_program(rng))
