@@ -53,7 +53,8 @@ class GenerationMixin:
     def generate(self, input_ids, max_new_tokens=32, do_sample=False, temperature=1.0,
                  top_k=0, top_p=1.0, repetition_penalty=1.0, min_length=0,
                  eos_token_id=None, pad_token_id=None, seed=0,
-                 decode_strategy=None, num_beams=1, length_penalty=0.0):
+                 decode_strategy=None, num_beams=1, length_penalty=0.0,
+                 attention_mask=None):
         """Returns [B, S0 + max_new_tokens] int32 token ids (prompt included).
         After eos, a sequence keeps emitting pad_token_id (defaults to eos).
 
@@ -71,6 +72,11 @@ class GenerationMixin:
                 raise ValueError("beam_search needs num_beams >= 2")
             return self._generate_beam(input_ids, max_new_tokens, num_beams,
                                        length_penalty, eos_token_id, pad_token_id)
+        if attention_mask is not None:
+            return self._generate_ragged(
+                input_ids, attention_mask, max_new_tokens, do_sample, temperature,
+                top_k, top_p, eos_token_id, pad_token_id, seed,
+            )
         ids = to_tensor(input_ids)._data.astype(jnp.int32)
         B, S0 = ids.shape
         if pad_token_id is None:
@@ -93,6 +99,111 @@ class GenerationMixin:
         state = self.raw_state_dict()
         gen = run(state, ids_p, jnp.int32(S0), jax.random.PRNGKey(seed))
         return Tensor(jnp.concatenate([ids, gen], axis=1), stop_gradient=True)
+
+    def _generate_ragged(self, input_ids, attention_mask, max_new_tokens, do_sample,
+                         temperature, top_k, top_p, eos_token_id, pad_token_id, seed):
+        """Per-row prompt lengths in one batch (reference: generate with
+        attention_mask over right-padded prompts). The batch is LEFT-aligned
+        internally: every row's last real token lands at the same column, so
+        the decode loop keeps a single scalar cache position; per-row rope
+        positions subtract the pad offset and left-pad cache columns are
+        masked out of every attention step."""
+        import numpy as np
+
+        ids = np.asarray(to_tensor(input_ids)._data).astype(np.int32)
+        mask = np.asarray(to_tensor(attention_mask)._data).astype(np.int32)
+        B, S0 = ids.shape
+        if pad_token_id is None:
+            pad_token_id = eos_token_id if eos_token_id is not None else 0
+        lens = mask.sum(axis=1).astype(np.int32)
+        S0b = prompt_bucket(int(lens.max()))
+        aligned = np.full((B, S0b), pad_token_id, np.int32)
+        for r in range(B):
+            aligned[r, S0b - lens[r]:] = ids[r, :lens[r]]
+        pad_lens = (S0b - lens).astype(np.int32)
+
+        key = ("ragged", B, S0b, max_new_tokens, do_sample, float(temperature),
+               int(top_k), float(top_p), eos_token_id, pad_token_id)
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        run = cache.get(key)
+        if run is None:
+            run = cache[key] = jax.jit(
+                self._build_ragged_fn(B, S0b, max_new_tokens, do_sample, temperature,
+                                      top_k, top_p, eos_token_id, pad_token_id)
+            )
+        gen = run(self.raw_state_dict(), jnp.asarray(aligned), jnp.asarray(pad_lens),
+                  jax.random.PRNGKey(seed))
+        return Tensor(jnp.concatenate([jnp.asarray(ids), gen], axis=1),
+                      stop_gradient=True)
+
+    def _build_ragged_fn(self, B, S0b, max_new, do_sample, temperature, top_k,
+                         top_p, eos_token_id, pad_token_id):
+        model = self
+        total = S0b + max_new
+
+        def fwd(state, toks, caches, pos, amask, pos_ids):
+            overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
+            wrapped = [(Tensor(kc), Tensor(vc)) for kc, vc in caches]
+            logits, presents = model.functional_call(
+                overrides, Tensor(toks), attention_mask=Tensor(amask),
+                position_ids=Tensor(pos_ids), past_key_values=wrapped,
+                cache_position=Tensor(pos), use_cache=True, training=False,
+            )
+            return logits._data, tuple((p[0]._data, p[1]._data) for p in presents)
+
+        def sample(logits, key):
+            logits = logits.astype(jnp.float32)
+            if not do_sample:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits = logits / jnp.maximum(temperature, 1e-6)
+            if top_k and top_k > 0:
+                kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_p < 1.0:
+                srt = jnp.sort(logits, axis=-1)[..., ::-1]
+                probs = jax.nn.softmax(srt, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = cum - probs < top_p
+                kth_idx = jnp.sum(keep, axis=-1) - 1
+                cutoff = jnp.take_along_axis(srt, kth_idx[..., None], axis=-1)
+                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+            return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+        def run(state, ids, pad_lens, key):
+            caches = model.init_cache(B, total)
+            # visibility over the FULL cache width: left-pad columns never
+            # attendable; future columns handled by the causal position mask
+            amask = (jnp.arange(total)[None, :] >= pad_lens[:, None]).astype(jnp.float32)
+            pos_prefill = jnp.maximum(
+                jnp.arange(S0b)[None, :] - pad_lens[:, None], 0
+            ).astype(jnp.int32)
+            logits, caches = fwd(state, ids, caches, jnp.int32(0), amask, pos_prefill)
+            key, sk = jax.random.split(key)
+            nxt = sample(logits[:, -1], sk)  # every row's last real token is col S0b-1
+            done = (nxt == eos_token_id) if eos_token_id is not None else jnp.zeros((B,), bool)
+
+            def step(carry, xs):
+                k_i, t = xs
+                caches, tok, done = carry
+                pos = jnp.int32(S0b) + t
+                pos_ids = (pos - pad_lens)[:, None].astype(jnp.int32)
+                lg, caches = fwd(state, tok[:, None], caches, pos, amask, pos_ids)
+                n = sample(lg[:, -1], k_i)
+                n = jnp.where(done, jnp.int32(pad_token_id), n)
+                new_done = done | (n == eos_token_id) if eos_token_id is not None else done
+                return (caches, n, new_done), n
+
+            if max_new > 1:
+                keys = jax.random.split(key, max_new - 1)
+                (_, _, _), rest = jax.lax.scan(
+                    step, (caches, nxt, done), (keys, jnp.arange(max_new - 1))
+                )
+                return jnp.concatenate([nxt[:, None], rest.T], axis=1)
+            return nxt[:, None]
+
+        return run
 
     def _generate_beam(self, input_ids, max_new_tokens, num_beams, length_penalty,
                        eos_token_id, pad_token_id):

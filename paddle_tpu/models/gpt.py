@@ -74,7 +74,8 @@ class GPTAttention(Layer):
         self.out_proj = _mk_linear(h, h, P("mp", None))
         self.dropout_p = config.attention_probs_dropout_prob
 
-    def forward(self, x, past_key_value=None, cache_position=None):
+    def forward(self, x, past_key_value=None, cache_position=None,
+                attention_mask=None):
         import jax
 
         from ..framework.core import apply
@@ -105,6 +106,10 @@ class GPTAttention(Layer):
                 return jnp.where(cols <= rows, 0.0, jnp.float32(-1e9))[None, None]
 
             mask = apply(build_mask, Tensor(pos_a), name="cache_mask")
+            if attention_mask is not None and attention_mask.ndim == 2:
+                pad = (1.0 - manipulation.unsqueeze(
+                    attention_mask.astype("float32"), [1, 2])) * -1e9
+                mask = mask + pad
             out = F.scaled_dot_product_attention(
                 q, k_cache, v_cache, attn_mask=mask, is_causal=False,
                 dropout_p=self.dropout_p, training=self.training,
@@ -128,9 +133,11 @@ class GPTBlock(Layer):
         self.fc_out = _mk_linear(config.intermediate_size, config.hidden_size, P("mp", None))
         self.dropout = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x, past_key_value=None, cache_position=None):
+    def forward(self, x, past_key_value=None, cache_position=None,
+                attention_mask=None):
         if past_key_value is not None:
-            attn, present = self.attn(self.ln_1(x), past_key_value, cache_position)
+            attn, present = self.attn(self.ln_1(x), past_key_value, cache_position,
+                                      attention_mask)
             x = x + self.dropout(attn)
             h = self.fc_out(F.gelu(self.fc_in(self.ln_2(x)), approximate=True))
             return x + self.dropout(h), present
@@ -151,7 +158,7 @@ class GPTModel(Layer):
         self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None, past_key_values=None,
-                cache_position=None, use_cache=False):
+                cache_position=None, use_cache=False, attention_mask=None):
         from ..framework.core import apply
 
         S = input_ids.shape[1]
@@ -166,7 +173,7 @@ class GPTModel(Layer):
         if past_key_values is not None:
             presents = []
             for block, pkv in zip(self.h, past_key_values):
-                x, present = block(x, pkv, cache_position)
+                x, present = block(x, pkv, cache_position, attention_mask)
                 presents.append(present)
             return self.ln_f(x), tuple(presents)
         for block in self.h:
@@ -243,12 +250,15 @@ class GPTForCausalLM(GenerationMixin, Layer):
         self.config = config
 
     def forward(self, input_ids, labels=None, past_key_values=None,
-                cache_position=None, use_cache=False):
+                cache_position=None, use_cache=False, attention_mask=None,
+                position_ids=None):
         from ..tensor import linalg
 
         if past_key_values is not None:
-            h, presents = self.gpt(input_ids, past_key_values=past_key_values,
-                                   cache_position=cache_position, use_cache=True)
+            h, presents = self.gpt(input_ids, position_ids=position_ids,
+                                   past_key_values=past_key_values,
+                                   cache_position=cache_position, use_cache=True,
+                                   attention_mask=attention_mask)
             logits = linalg.matmul(h, self.gpt.wte.weight, transpose_y=True)
             return logits, presents
         h = self.gpt(input_ids)

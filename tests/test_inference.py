@@ -156,6 +156,44 @@ class TestGPTDecode:
         assert (nxt == out.numpy()[:, -1]).all()
 
 
+class TestRaggedBatchGenerate:
+    """generate(attention_mask=...) serves per-row prompt lengths in one
+    batch (internal left-alignment): each row's continuation must equal the
+    single-row generate() of that prompt alone."""
+
+    def _ragged(self, m, V, l0, l1, new):
+        rng = np.random.RandomState(7)
+        r0 = rng.randint(0, V, (l0,)).astype(np.int32)
+        r1 = rng.randint(0, V, (l1,)).astype(np.int32)
+        S = max(l0, l1)
+        ids = np.zeros((2, S), np.int32)
+        mask = np.zeros((2, S), np.int32)
+        ids[0, :l0], ids[1, :l1] = r0, r1
+        mask[0, :l0], mask[1, :l1] = 1, 1
+        out = m.generate(ids, max_new_tokens=new, attention_mask=mask).numpy()
+        ref0 = m.generate(r0[None], max_new_tokens=new).numpy()[0, l0:]
+        ref1 = m.generate(r1[None], max_new_tokens=new).numpy()[0, l1:]
+        assert (out[0, S:] == ref0).all(), (out[0, S:], ref0)
+        assert (out[1, S:] == ref1).all(), (out[1, S:], ref1)
+
+    def test_llama_rows_match_single(self):
+        paddle.seed(17)
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        m = LlamaForCausalLM(llama_tiny(num_hidden_layers=2))
+        m.eval()
+        self._ragged(m, 128, 5, 9, 5)
+
+    def test_gpt_rows_match_single(self):
+        paddle.seed(18)
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+
+        m = GPTForCausalLM(gpt_tiny(hidden_dropout_prob=0.0,
+                                    attention_probs_dropout_prob=0.0))
+        m.eval()
+        self._ragged(m, 128, 4, 7, 4)
+
+
 class TestBeamSearch:
     def test_full_width_beam_is_exhaustive_for_two_steps(self):
         """With num_beams == V and max_new=2, beam search IS exhaustive
