@@ -27,3 +27,15 @@ barrier_worker = _f.barrier_worker
 
 def worker_index():
     return _f.worker_index
+
+
+def distributed_scaler(scaler):
+    """reference: fleet.distributed_scaler wraps GradScaler so found_inf is
+    all-reduced across the mp/pp/sharding groups before the skip decision.
+
+    Identity here BY DESIGN: the compiled step runs the finite-check on the
+    merged gradients inside one SPMD program (jit_api.TrainStep), so every
+    device computes the identical skip decision — there is no per-rank
+    found_inf to reconcile. The wrapper exists so fleet-style scripts port
+    unchanged."""
+    return scaler

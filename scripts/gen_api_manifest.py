@@ -86,7 +86,7 @@ NAMESPACES = {
         get_group wait shard_tensor reshard dtensor_from_fn shard_layer Shard Replicate
         Partial Placement ProcessMesh DistAttr fleet spawn launch rpc ParallelEnv
         split get_mesh auto_parallel""",
-    "paddle.distributed.fleet": """init Fleet DistributedStrategy UserDefinedRoleMaker
+    "paddle.distributed.fleet": """distributed_scaler init Fleet DistributedStrategy UserDefinedRoleMaker
         PaddleCloudRoleMaker worker_num worker_index distributed_model
         distributed_optimizer meta_parallel recompute utils""",
     "paddle.io": """DataLoader Dataset IterableDataset TensorDataset ChainDataset
