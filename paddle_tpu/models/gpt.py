@@ -150,9 +150,14 @@ class GPTModel(Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config
-        self.wte = Embedding(config.vocab_size, config.hidden_size)
+        # GPT convention: embeddings ~ N(0, 0.02) (reference: gpt modeling
+        # initializer_range) — the framework default N(0,1) makes the tied
+        # head's logits ~sqrt(H) hot at init
+        self.wte = Embedding(config.vocab_size, config.hidden_size,
+                             weight_attr=I.Normal(0.0, 0.02))
         self.wte.weight.partition_spec = P("mp", None)
-        self.wpe = Embedding(config.max_position_embeddings, config.hidden_size)
+        self.wpe = Embedding(config.max_position_embeddings, config.hidden_size,
+                             weight_attr=I.Normal(0.0, 0.02))
         self.drop = Dropout(config.hidden_dropout_prob)
         self.h = LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
         self.ln_f = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
@@ -192,9 +197,11 @@ class GPTEmbeddings(Layer):
 
     def __init__(self, config: GPTConfig):
         super().__init__()
-        self.wte = Embedding(config.vocab_size, config.hidden_size)
+        self.wte = Embedding(config.vocab_size, config.hidden_size,
+                             weight_attr=I.Normal(0.0, 0.02))
         self.wte.weight.partition_spec = P("mp", None)
-        self.wpe = Embedding(config.max_position_embeddings, config.hidden_size)
+        self.wpe = Embedding(config.max_position_embeddings, config.hidden_size,
+                             weight_attr=I.Normal(0.0, 0.02))
         self.drop = Dropout(config.hidden_dropout_prob)
 
     def forward(self, input_ids):

@@ -119,9 +119,14 @@ class Layer:
         name = None
         learning_rate = 1.0
         if attr is not None and attr is not False:
-            init = getattr(attr, "initializer", None) or init
-            name = getattr(attr, "name", None)
-            learning_rate = getattr(attr, "learning_rate", 1.0)
+            if isinstance(attr, I.Initializer):
+                # reference accepts a bare Initializer as weight_attr/bias_attr
+                # (ParamAttr._to_attr wraps it)
+                init = attr
+            else:
+                init = getattr(attr, "initializer", None) or init
+                name = getattr(attr, "name", None)
+                learning_rate = getattr(attr, "learning_rate", 1.0)
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         data = init(tuple(int(s) for s in shape), dtype)
