@@ -73,6 +73,11 @@ class GenerationMixin:
             return self._generate_beam(input_ids, max_new_tokens, num_beams,
                                        length_penalty, eos_token_id, pad_token_id)
         if attention_mask is not None:
+            if repetition_penalty != 1.0 or min_length > 0:
+                raise NotImplementedError(
+                    "repetition_penalty/min_length are not yet wired into the "
+                    "ragged (attention_mask) decode path"
+                )
             return self._generate_ragged(
                 input_ids, attention_mask, max_new_tokens, do_sample, temperature,
                 top_k, top_p, eos_token_id, pad_token_id, seed,
