@@ -114,6 +114,34 @@ class TestScheduled1F1BComposition:
         assert abs(losses[0] - ref) < 1e-4, (losses[0], ref)
         assert losses[-1] < losses[0], losses
 
+    def test_north_star_bf16_master_weights(self):
+        """The north-star shape in its REAL dtype: bf16 params + f32 master
+        weights (multi_precision AdamW) through the scheduled 1F1B engine on
+        pp2 x mp2 x sharding2 — first-step loss parity vs the plain bf16
+        model, and training descends."""
+        cfg = llama_tiny(num_hidden_layers=4, dtype="bfloat16")
+        paddle.seed(41)
+        plain = LlamaForCausalLM(cfg)
+        plain.bfloat16()
+        x, y = make_batch(bs=8, seq=16)
+        ref = float(LlamaPretrainingCriterion()(
+            plain(paddle.to_tensor(x)), paddle.to_tensor(y)).numpy())
+
+        m = M.build_mesh(pp=2, mp=2, sharding=2)
+        with M.mesh_guard(m):
+            pipe = LlamaForCausalLMPipe(cfg, pp_degree=2, num_micro_batches=4,
+                                        schedule="1f1b")
+            pipe.load_from_causal_lm(plain)
+            pipe.bfloat16()
+            opt = optimizer.AdamW(learning_rate=1e-3, parameters=pipe.parameters(),
+                                  multi_precision=True)
+            step = DistributedTrainStep(pipe, lambda loss: loss, opt, n_labels=0,
+                                        sharding_stage=2)
+            losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+                      for _ in range(3)]
+        assert abs(losses[0] - ref) < 5e-2, (losses[0], ref)
+        assert losses[-1] < losses[0], losses
+
     def test_16dev_mp2_sharding4_no_deadlock(self):
         """Regression: at pp2 x mp2 x sharding4 (16 devices) GSPMD used to
         insert an involuntary-remat resharding collective into a
