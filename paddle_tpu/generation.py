@@ -27,6 +27,57 @@ def prompt_bucket(s0):
     return b
 
 
+def _make_sampler(do_sample, temperature, top_k, top_p, repetition_penalty,
+                  min_length, eos_token_id):
+    """ONE sampling fn shared by the dense and ragged builders (greedy /
+    temperature / top-k / top-p, CTRL-style repetition penalty over the
+    seen-token mask, eos suppression below min_length)."""
+
+    def sample(logits, key, seen=None, n_generated=0):
+        logits = logits.astype(jnp.float32)
+        if repetition_penalty != 1.0 and seen is not None:
+            pen = jnp.where(logits > 0, logits / repetition_penalty,
+                            logits * repetition_penalty)
+            logits = jnp.where(seen, pen, logits)
+        if min_length > 0 and eos_token_id is not None:
+            logits = jnp.where(
+                (jnp.asarray(n_generated) < min_length)
+                & (jnp.arange(logits.shape[-1]) == eos_token_id)[None],
+                -jnp.inf, logits,
+            )
+        if not do_sample:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / jnp.maximum(temperature, 1e-6)
+        if top_k and top_k > 0:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p < 1.0:
+            # nucleus: smallest prefix of the sorted distribution reaching
+            # top_p mass (the argmax token is always kept)
+            srt = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep = cum - probs < top_p
+            kth_idx = jnp.sum(keep, axis=-1) - 1
+            cutoff = jnp.take_along_axis(srt, kth_idx[..., None], axis=-1)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    return sample
+
+
+def _prompt_seen_mask(ids, valid, n_vocab):
+    """[B, V] bool: tokens present in the VALID prompt positions."""
+    B = ids.shape[0]
+    return jnp.zeros((B, n_vocab), bool).at[
+        jnp.arange(B)[:, None], ids
+    ].max(valid)
+
+
+def _mark_seen(seen, tok):
+    return seen if seen is None else seen.at[jnp.arange(seen.shape[0]), tok].set(True)
+
+
 class GenerationMixin:
     """Mixin for causal LMs whose forward supports
     (input_ids, past_key_values, cache_position, use_cache) -> (logits, caches).
@@ -73,14 +124,10 @@ class GenerationMixin:
             return self._generate_beam(input_ids, max_new_tokens, num_beams,
                                        length_penalty, eos_token_id, pad_token_id)
         if attention_mask is not None:
-            if repetition_penalty != 1.0 or min_length > 0:
-                raise NotImplementedError(
-                    "repetition_penalty/min_length are not yet wired into the "
-                    "ragged (attention_mask) decode path"
-                )
             return self._generate_ragged(
                 input_ids, attention_mask, max_new_tokens, do_sample, temperature,
-                top_k, top_p, eos_token_id, pad_token_id, seed,
+                top_k, top_p, repetition_penalty, min_length,
+                eos_token_id, pad_token_id, seed,
             )
         ids = to_tensor(input_ids)._data.astype(jnp.int32)
         B, S0 = ids.shape
@@ -106,7 +153,8 @@ class GenerationMixin:
         return Tensor(jnp.concatenate([ids, gen], axis=1), stop_gradient=True)
 
     def _generate_ragged(self, input_ids, attention_mask, max_new_tokens, do_sample,
-                         temperature, top_k, top_p, eos_token_id, pad_token_id, seed):
+                         temperature, top_k, top_p, repetition_penalty, min_length,
+                         eos_token_id, pad_token_id, seed):
         """Per-row prompt lengths in one batch (reference: generate with
         attention_mask over right-padded prompts). The batch is LEFT-aligned
         internally: every row's last real token lands at the same column, so
@@ -128,7 +176,8 @@ class GenerationMixin:
         pad_lens = (S0b - lens).astype(np.int32)
 
         key = ("ragged", B, S0b, max_new_tokens, do_sample, float(temperature),
-               int(top_k), float(top_p), eos_token_id, pad_token_id)
+               int(top_k), float(top_p), float(repetition_penalty), int(min_length),
+               eos_token_id, pad_token_id)
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
@@ -136,7 +185,8 @@ class GenerationMixin:
         if run is None:
             run = cache[key] = jax.jit(
                 self._build_ragged_fn(B, S0b, max_new_tokens, do_sample, temperature,
-                                      top_k, top_p, eos_token_id, pad_token_id)
+                                      top_k, top_p, repetition_penalty, min_length,
+                                      eos_token_id, pad_token_id)
             )
         gen = run(self.raw_state_dict(), jnp.asarray(aligned), jnp.asarray(pad_lens),
                   jax.random.PRNGKey(seed))
@@ -144,7 +194,8 @@ class GenerationMixin:
                       stop_gradient=True)
 
     def _build_ragged_fn(self, B, S0b, max_new, do_sample, temperature, top_k,
-                         top_p, eos_token_id, pad_token_id):
+                         top_p, repetition_penalty, min_length,
+                         eos_token_id, pad_token_id):
         model = self
         total = S0b + max_new
 
@@ -158,23 +209,9 @@ class GenerationMixin:
             )
             return logits._data, tuple((p[0]._data, p[1]._data) for p in presents)
 
-        def sample(logits, key):
-            logits = logits.astype(jnp.float32)
-            if not do_sample:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = logits / jnp.maximum(temperature, 1e-6)
-            if top_k and top_k > 0:
-                kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            if top_p < 1.0:
-                srt = jnp.sort(logits, axis=-1)[..., ::-1]
-                probs = jax.nn.softmax(srt, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                keep = cum - probs < top_p
-                kth_idx = jnp.sum(keep, axis=-1) - 1
-                cutoff = jnp.take_along_axis(srt, kth_idx[..., None], axis=-1)
-                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-            return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+        sample = _make_sampler(do_sample, temperature, top_k, top_p,
+                               repetition_penalty, min_length, eos_token_id)
+        use_seen = repetition_penalty != 1.0  # static: no carry cost otherwise
 
         def run(state, ids, pad_lens, key):
             caches = model.init_cache(B, total)
@@ -185,26 +222,32 @@ class GenerationMixin:
                 jnp.arange(S0b)[None, :] - pad_lens[:, None], 0
             ).astype(jnp.int32)
             logits, caches = fwd(state, ids, caches, jnp.int32(0), amask, pos_prefill)
+            valid = jnp.arange(S0b)[None, :] >= pad_lens[:, None]
+            seen = _prompt_seen_mask(ids, valid, logits.shape[-1]) if use_seen else None
             key, sk = jax.random.split(key)
-            nxt = sample(logits[:, -1], sk)  # every row's last real token is col S0b-1
+            nxt = sample(logits[:, -1], sk, seen, 0)  # last real token: col S0b-1
+            seen = _mark_seen(seen, nxt)
             done = (nxt == eos_token_id) if eos_token_id is not None else jnp.zeros((B,), bool)
 
             def step(carry, xs):
                 k_i, t = xs
-                caches, tok, done = carry
+                if use_seen:
+                    caches, tok, done, seen = carry
+                else:
+                    (caches, tok, done), seen = carry, None
                 pos = jnp.int32(S0b) + t
                 pos_ids = (pos - pad_lens)[:, None].astype(jnp.int32)
                 lg, caches = fwd(state, tok[:, None], caches, pos, amask, pos_ids)
-                n = sample(lg[:, -1], k_i)
+                n = sample(lg[:, -1], k_i, seen, t + 1)
                 n = jnp.where(done, jnp.int32(pad_token_id), n)
                 new_done = done | (n == eos_token_id) if eos_token_id is not None else done
-                return (caches, n, new_done), n
+                out = (caches, n, new_done)
+                return (out + (_mark_seen(seen, n),) if use_seen else out), n
 
             if max_new > 1:
                 keys = jax.random.split(key, max_new - 1)
-                (_, _, _), rest = jax.lax.scan(
-                    step, (caches, nxt, done), (keys, jnp.arange(max_new - 1))
-                )
+                init = (caches, nxt, done) + ((seen,) if use_seen else ())
+                _, rest = jax.lax.scan(step, init, (keys, jnp.arange(max_new - 1)))
                 return jnp.concatenate([nxt[:, None], rest.T], axis=1)
             return nxt[:, None]
 
@@ -340,74 +383,42 @@ class GenerationMixin:
             )
             return logits._data, tuple((p[0]._data, p[1]._data) for p in presents)
 
-        def sample(logits, key, seen=None, n_generated=0):
-            logits = logits.astype(jnp.float32)
-            if repetition_penalty != 1.0 and seen is not None:
-                # CTRL-style: seen tokens' positive logits divide by the
-                # penalty, negative multiply (reference: repetition_penalty
-                # in generation_utils)
-                pen = jnp.where(logits > 0, logits / repetition_penalty,
-                                logits * repetition_penalty)
-                logits = jnp.where(seen, pen, logits)
-            if min_length > 0 and eos_token_id is not None:
-                logits = jnp.where(
-                    (jnp.asarray(n_generated) < min_length)
-                    & (jnp.arange(logits.shape[-1]) == eos_token_id)[None],
-                    -jnp.inf, logits,
-                )
-            if not do_sample:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = logits / jnp.maximum(temperature, 1e-6)
-            if top_k and top_k > 0:
-                kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-                logits = jnp.where(logits < kth, -jnp.inf, logits)
-            if top_p < 1.0:
-                # nucleus: keep the smallest prefix of the sorted distribution
-                # whose mass reaches top_p (the kept set always includes the
-                # argmax token)
-                srt = jnp.sort(logits, axis=-1)[..., ::-1]
-                probs = jax.nn.softmax(srt, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1)
-                keep = cum - probs < top_p  # first token always kept
-                kth_idx = jnp.sum(keep, axis=-1) - 1  # last kept rank
-                cutoff = jnp.take_along_axis(srt, kth_idx[..., None], axis=-1)
-                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-            return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+        sample = _make_sampler(do_sample, temperature, top_k, top_p,
+                               repetition_penalty, min_length, eos_token_id)
+        use_seen = repetition_penalty != 1.0  # static: no carry cost otherwise
 
         def run(state, ids, true_len, key):
             caches = model.init_cache(B, total)
             logits, caches = fwd(state, ids, caches, jnp.int32(0))
             last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
                                                 keepdims=False)
-            V = logits.shape[-1]
             # seen-token mask over the true prompt (padding excluded)
             valid = jnp.arange(S0b)[None, :] < true_len
-            seen = jnp.zeros((B, V), bool).at[
-                jnp.arange(B)[:, None], ids
-            ].max(valid)
+            seen = _prompt_seen_mask(ids, valid, logits.shape[-1]) if use_seen else None
             key, sk = jax.random.split(key)
             nxt = sample(last, sk, seen, 0)
-            seen = seen.at[jnp.arange(B), nxt].set(True)
+            seen = _mark_seen(seen, nxt)
             done = jnp.zeros((B,), bool)
             if eos_token_id is not None:
                 done = nxt == eos_token_id
 
             def step(carry, xs):
                 k_i, i = xs
-                caches, tok, pos, done, seen = carry
+                if use_seen:
+                    caches, tok, pos, done, seen = carry
+                else:
+                    (caches, tok, pos, done), seen = carry, None
                 lg, caches = fwd(state, tok[:, None], caches, pos)
                 n = sample(lg[:, -1], k_i, seen, i)
                 n = jnp.where(done, jnp.int32(pad_token_id), n)
-                seen = seen.at[jnp.arange(B), n].set(True)
                 new_done = done | (n == eos_token_id) if eos_token_id is not None else done
-                return (caches, n, pos + 1, new_done, seen), n
+                out = (caches, n, pos + 1, new_done)
+                return (out + (_mark_seen(seen, n),) if use_seen else out), n
 
             if max_new > 1:
                 keys = jax.random.split(key, max_new - 1)
-                (_, _, _, _, _), rest = jax.lax.scan(
-                    step, (caches, nxt, true_len, done, seen),
-                    (keys, jnp.arange(1, max_new)),
-                )
+                init = (caches, nxt, true_len, done) + ((seen,) if use_seen else ())
+                _, rest = jax.lax.scan(step, init, (keys, jnp.arange(1, max_new)))
                 return jnp.concatenate([nxt[:, None], rest.T], axis=1)
             return nxt[:, None]
 

@@ -193,6 +193,47 @@ class TestRaggedBatchGenerate:
         m.eval()
         self._ragged(m, 128, 4, 7, 4)
 
+    def test_ragged_with_repetition_penalty(self):
+        """Penalty composes with the ragged path: per-row parity against
+        single-row generate() with the same penalty."""
+        paddle.seed(19)
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        m = LlamaForCausalLM(llama_tiny(num_hidden_layers=1))
+        m.eval()
+        rng = np.random.RandomState(9)
+        l0, l1 = 3, 6
+        r0 = rng.randint(0, 128, (l0,)).astype(np.int32)
+        r1 = rng.randint(0, 128, (l1,)).astype(np.int32)
+        ids = np.zeros((2, 6), np.int32)
+        mask = np.zeros((2, 6), np.int32)
+        ids[0, :l0], ids[1, :l1] = r0, r1
+        mask[0, :l0], mask[1, :l1] = 1, 1
+        out = m.generate(ids, max_new_tokens=6, attention_mask=mask,
+                         repetition_penalty=4.0).numpy()
+        ref0 = m.generate(r0[None], max_new_tokens=6, repetition_penalty=4.0).numpy()[0, l0:]
+        ref1 = m.generate(r1[None], max_new_tokens=6, repetition_penalty=4.0).numpy()[0, l1:]
+        assert (out[0, 6:] == ref0).all(), (out[0, 6:], ref0)
+        assert (out[1, 6:] == ref1).all(), (out[1, 6:], ref1)
+
+    def test_ragged_min_length_suppresses_eos(self):
+        paddle.seed(20)
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        m = LlamaForCausalLM(llama_tiny(num_hidden_layers=1))
+        m.eval()
+        ids = np.array([[1, 2, 3, 0], [4, 5, 6, 7]], np.int32)
+        mask = np.array([[1, 1, 1, 0], [1, 1, 1, 1]], np.int32)
+        # eos = the first greedily generated token of row 0 -> without
+        # min_length it would terminate immediately
+        first = int(m.generate(ids, max_new_tokens=1,
+                               attention_mask=mask).numpy()[0, -1])
+        out = m.generate(ids, max_new_tokens=6, attention_mask=mask,
+                         eos_token_id=first, min_length=4,
+                         pad_token_id=0).numpy()
+        gen0 = out[0, 4:]
+        assert first not in gen0[:4].tolist(), gen0
+
 
 class TestBeamSearch:
     def test_full_width_beam_is_exhaustive_for_two_steps(self):
