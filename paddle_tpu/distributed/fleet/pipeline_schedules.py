@@ -404,7 +404,15 @@ def make_pipeline_train_fn(sched, mesh, first_fn, mid_fn, last_fn):
                 resharding collective into a branch only ONE pp group
                 executes — observed as a 16-device rendezvous deadlock at
                 mp2 x sharding4 ("involuntary full rematerialization"
-                warning). A fixed sharding removes the reshard entirely."""
+                warning). A fixed sharding removes the reshard entirely.
+
+                Tradeoff: replicated f32 accumulators cost ~4 bytes/param
+                of the local stage per device and an all-reduce per
+                backward tick for TP-sharded weight grads. The leaner pin
+                (each accumulator on its weight's own TP spec) needs
+                per-leaf specs threaded into the engine and must be
+                re-validated against the deadlock class on a >=16-device
+                mesh before switching — measure on real hardware first."""
                 return jax.lax.with_sharding_constraint(x, P(*([None] * x.ndim)))
             tokens, labels, seed_ct = pv(tokens), pv(labels), pv(seed_ct)
             stk_local = tuple(l[:, 0] for l in flat[:ns])  # [V, Lc, ...]
