@@ -99,6 +99,50 @@ class Cifar100(Cifar10):
     pass
 
 
+class Flowers(Dataset):
+    """reference: vision/datasets/flowers.py (102-category flowers).
+    Synthetic backend (no egress): deterministic per-split images/labels."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        n = 1020 if mode == "train" else 102
+        rng = np.random.RandomState(4 if mode == "train" else 5)
+        self.labels = rng.randint(0, 102, n).astype(np.int64)
+        self.images = (rng.rand(n, 3, 64, 64) * 255).astype(np.uint8)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+
+class VOC2012(Dataset):
+    """reference: vision/datasets/voc2012.py (segmentation pairs).
+    Synthetic backend: (image [3,H,W], label-mask [H,W]) with 21 classes."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        n = 200 if mode == "train" else 40
+        rng = np.random.RandomState(6 if mode == "train" else 7)
+        self.images = (rng.rand(n, 3, 64, 64) * 255).astype(np.uint8)
+        self.masks = rng.randint(0, 21, (n, 64, 64)).astype(np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform:
+            img = self.transform(img)
+        return img, self.masks[idx]
+
+
 class ImageFolder(Dataset):
     """reference: paddle.vision.datasets.ImageFolder — local directory tree."""
 
