@@ -194,6 +194,23 @@ def is_grad_enabled():
     return _core._grad_enabled()
 
 
+def enable_grad(func=None):
+    """reference: paddle.enable_grad — context manager (or decorator)
+    forcing gradient tracking on, e.g. inside a no_grad region."""
+    guard = set_grad_enabled(True)
+    if func is None:
+        return guard
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with set_grad_enabled(True):
+            return func(*args, **kwargs)
+
+    guard.__exit__()
+    return wrapper
+
+
 def summary(net, input_size=None, dtypes=None, input=None):
     return hapi.summary(net, input_size, dtypes, input)
 

@@ -243,6 +243,16 @@ def diagflat(x, offset=0, name=None):
     return apply(lambda a: jnp.diagflat(a, k=offset), _t(x), name="diagflat")
 
 
+def block_diag(inputs, name=None):
+    """reference: paddle.block_diag — block-diagonal matrix from a list of
+    2-D (or promotable) tensors."""
+    import jax.scipy.linalg as jsl
+
+    ts = [_t(x) for x in inputs]
+    return apply(lambda *arrs: jsl.block_diag(*[jnp.atleast_2d(a) for a in arrs]),
+                 *ts, name="block_diag")
+
+
 def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
     return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
                  _t(x), name="diagonal")

@@ -38,7 +38,7 @@ logical_and logical_not logical_or logical_xor logit logspace logsumexp masked_f
 masked_scatter masked_select matmul max maximum mean median meshgrid min minimum mm mod
 mode moveaxis multigammaln multiplex multiply multinomial mv nan_to_num nanmean nanmedian
 nanquantile nansum neg nextafter nonzero norm normal not_equal numel ones ones_like outer
-pdist permute poisson polar polygamma pow prod put_along_axis quantile rad2deg rand
+block_diag enable_grad pdist permute poisson polar polygamma pow prod put_along_axis quantile rad2deg rand
 randint randint_like randn randperm rank real reciprocal remainder renorm
 repeat_interleave reshape roll rot90 round rsqrt scale scatter scatter_nd scatter_nd_add
 searchsorted select_scatter sgn shard_index sign signbit sin sinc sinh slice sort split

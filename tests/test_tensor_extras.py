@@ -260,3 +260,34 @@ class TestNewNNSurface:
         with autograd.set_grad_enabled(False):
             assert not autograd.is_grad_enabled()
         assert autograd.is_grad_enabled()
+
+
+def test_block_diag_matches_scipy():
+    import scipy.linalg as sl
+
+    import paddle_tpu as paddle
+
+    a = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    b = np.random.RandomState(1).randn(1, 2).astype(np.float32)
+    c = np.random.RandomState(2).randn(3, 1).astype(np.float32)
+    out = paddle.block_diag([paddle.to_tensor(a), paddle.to_tensor(b),
+                             paddle.to_tensor(c)]).numpy()
+    np.testing.assert_allclose(out, sl.block_diag(a, b, c), atol=1e-6)
+
+
+def test_enable_grad_context_and_decorator():
+    import paddle_tpu as paddle
+
+    with paddle.no_grad():
+        assert not paddle.is_grad_enabled()
+        with paddle.enable_grad():
+            assert paddle.is_grad_enabled()
+        assert not paddle.is_grad_enabled()
+    assert paddle.is_grad_enabled()
+
+    @paddle.enable_grad
+    def inner():
+        return paddle.is_grad_enabled()
+
+    with paddle.no_grad():
+        assert inner()
