@@ -65,21 +65,29 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
     return dropout(x, p, axis=axis, training=training)
 
 
-def alpha_dropout(x, p=0.5, training=True, name=None):
-    if not training or p == 0.0:
-        return _t(x)
+def _alpha_dropout_impl(x, p, mask_shape, name):
+    """Shared alpha-dropout core: dropped positions take the SELU negative
+    saturation value, then an affine (a, b) restores zero mean/unit var.
+    mask_shape broadcasts against x (full shape = per-element dropout,
+    [N, C, 1, ...] = whole-channel/feature dropout)."""
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
-    x = _t(x)
-    keep = jax.random.bernoulli(prandom.next_key(), 1.0 - p, tuple(x.shape))
-    a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5)
+    keep = jax.random.bernoulli(prandom.next_key(), 1.0 - p, mask_shape)
+    a = 1.0 / ((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5
     b = -a * alpha_p * p
 
     def fn(v):
         return (jnp.where(keep, v, alpha_p) * a + b).astype(v.dtype)
 
-    return apply(fn, x, name="alpha_dropout")
+    return apply(fn, x, name=name)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    x = _t(x)
+    return _alpha_dropout_impl(x, p, tuple(x.shape), "alpha_dropout")
 
 
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
@@ -355,3 +363,43 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
         return out.reshape(nt, c, h, w)
 
     return apply(fn, _t(x), name="temporal_shift")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """reference: F.zeropad2d — constant-zero spatial padding
+    [left, right, top, bottom]."""
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """reference: F.feature_alpha_dropout — alpha dropout over whole
+    channel maps (one keep/drop decision per [N, C], broadcast over the
+    spatial dims)."""
+    if not training or p == 0.0:
+        return _t(x)
+    t = _t(x)
+    mask_shape = tuple(t.shape[:2]) + (1,) * (len(t.shape) - 2)
+    return _alpha_dropout_impl(t, p, mask_shape, "feature_alpha_dropout")
+
+
+def gather_tree(ids, parents, name=None):
+    """reference: F.gather_tree — walk beam-search parent pointers backward
+    so time step t holds the t-th token of each FULL surviving sequence.
+    ids/parents: [T, B, K] int; out[t, b, k] = token at time t of the
+    sequence ending in beam k at time T-1."""
+    t_ids, t_par = _t(ids), _t(parents)
+
+    def fn(idv, par):
+        T, _, K = idv.shape
+        last_beam = jnp.broadcast_to(jnp.arange(K), idv.shape[1:])
+
+        def body(beam, t):
+            # t runs T-2 .. 0; beam is the surviving beam index at t+1
+            prev_beam = jnp.take_along_axis(par[t + 1], beam, axis=-1)
+            tok = jnp.take_along_axis(idv[t], prev_beam, axis=-1)
+            return prev_beam, tok
+
+        _, toks = jax.lax.scan(body, last_beam, jnp.arange(T - 2, -1, -1))
+        return jnp.concatenate([toks[::-1], idv[T - 1][None]], axis=0)
+
+    return apply(fn, t_ids, t_par, name="gather_tree")

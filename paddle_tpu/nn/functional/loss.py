@@ -294,3 +294,65 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
         return ce + reg
 
     return apply(fn, _t(anchor), _t(positive), name="npair")
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    """reference: F.huber_loss — quadratic within |r|<=delta, linear beyond
+    (SmoothL1 scaled by delta)."""
+    def fn(a, b):
+        r = jnp.abs(a - b)
+        out = jnp.where(r <= delta, 0.5 * r * r, delta * (r - 0.5 * delta))
+        return _reduce(out, reduction)
+
+    return apply(fn, _t(input), _t(label), name="huber_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    """reference: F.poisson_nll_loss (Stirling term when full=True)."""
+    def fn(a, b):
+        if log_input:
+            out = jnp.exp(a) - b * a
+        else:
+            out = a - b * jnp.log(a + epsilon)
+        if full:
+            stir = b * jnp.log(b) - b + 0.5 * jnp.log(2.0 * jnp.pi * b)
+            out = out + jnp.where(b > 1, stir, 0.0)
+        return _reduce(out, reduction)
+
+    return apply(fn, _t(input), _t(label), name="poisson_nll")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """reference: F.gaussian_nll_loss — heteroscedastic Gaussian NLL."""
+    def fn(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        out = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            out = out + 0.5 * jnp.log(2.0 * jnp.pi)
+        return _reduce(out, reduction)
+
+    return apply(fn, _t(input), _t(label), _t(variance), name="gaussian_nll")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """reference: F.soft_margin_loss — log(1 + exp(-y*x))."""
+    return apply(
+        lambda a, b: _reduce(jnp.log1p(jnp.exp(-b * a)), reduction),
+        _t(input), _t(label), name="soft_margin",
+    )
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    """reference: F.multi_label_soft_margin_loss — mean over classes of
+    -[y*log sigma(x) + (1-y)*log sigma(-x)], optional class weights."""
+    def fn(a, b, *w):
+        out = -(b * jax.nn.log_sigmoid(a) + (1.0 - b) * jax.nn.log_sigmoid(-a))
+        if w:
+            out = out * w[0]
+        return _reduce(out.mean(axis=-1), reduction)
+
+    args = [_t(input), _t(label)] + ([_t(weight)] if weight is not None else [])
+    return apply(fn, *args, name="multi_label_soft_margin")

@@ -49,7 +49,8 @@ unique_consecutive unsqueeze unstack vander var view view_as vsplit vstack where
 zeros_like cdist copysign cov corrcoef cumulative_trapezoid""".split()
 
 NAMESPACES = {
-    "paddle.nn": """Layer Linear Conv1D Conv2D Conv3D Conv1DTranspose Conv2DTranspose
+    "paddle.nn": """HuberLoss CTCLoss PoissonNLLLoss GaussianNLLLoss
+        SoftMarginLoss MultiLabelSoftMarginLoss Layer Linear Conv1D Conv2D Conv3D Conv1DTranspose Conv2DTranspose
         BatchNorm BatchNorm1D BatchNorm2D BatchNorm3D LayerNorm GroupNorm InstanceNorm1D
         InstanceNorm2D RMSNorm SyncBatchNorm Embedding Dropout Dropout2D AlphaDropout
         ReLU ReLU6 GELU SiLU Sigmoid Tanh Softmax LogSoftmax LeakyReLU PReLU ELU SELU
@@ -64,7 +65,9 @@ NAMESPACES = {
         UpsamplingNearest2D Pad1D Pad2D Pad3D ZeroPad2D CosineEmbeddingLoss
         PixelShuffle ChannelShuffle ClipGradByNorm ClipGradByGlobalNorm ClipGradByValue
         SpectralNorm utils functional initializer""",
-    "paddle.nn.functional": """linear conv1d conv2d conv3d conv1d_transpose
+    "paddle.nn.functional": """huber_loss poisson_nll_loss gaussian_nll_loss
+        soft_margin_loss multi_label_soft_margin_loss zeropad2d
+        feature_alpha_dropout gather_tree ctc_loss max_unpool2d linear conv1d conv2d conv3d conv1d_transpose
         conv2d_transpose relu relu6 gelu silu sigmoid tanh softmax log_softmax
         leaky_relu prelu elu selu hardswish hardsigmoid hardtanh mish swish softplus
         softshrink softsign glu max_pool1d max_pool2d max_pool3d avg_pool1d avg_pool2d

@@ -1,0 +1,117 @@
+"""New loss layers + common functionals vs torch oracles (reference:
+nn/functional/loss.py, nn/layer/loss.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(0)
+    return (rng.randn(4, 5).astype(np.float32),
+            rng.randn(4, 5).astype(np.float32), rng)
+
+
+def _torch():
+    import torch
+
+    return torch
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_huber(data, reduction):
+    torch = _torch()
+    x, y, _ = data
+    o = nn.HuberLoss(reduction=reduction, delta=0.7)(
+        paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+    r = torch.nn.HuberLoss(reduction=reduction, delta=0.7)(
+        torch.tensor(x), torch.tensor(y)).numpy()
+    np.testing.assert_allclose(o, r, rtol=1e-5, atol=1e-6)
+
+
+def test_poisson_nll(data):
+    torch = _torch()
+    x, _, rng = data
+    lab = rng.rand(4, 5).astype(np.float32) * 3
+    for full in (False, True):
+        o = float(nn.PoissonNLLLoss(full=full)(
+            paddle.to_tensor(x), paddle.to_tensor(lab)).numpy())
+        r = float(torch.nn.PoissonNLLLoss(full=full)(
+            torch.tensor(x), torch.tensor(lab)))
+        assert abs(o - r) < 1e-4, (full, o, r)
+
+
+def test_gaussian_nll(data):
+    torch = _torch()
+    x, y, rng = data
+    var = rng.rand(4, 5).astype(np.float32) + 0.1
+    o = float(nn.GaussianNLLLoss()(paddle.to_tensor(x), paddle.to_tensor(y),
+                                   paddle.to_tensor(var)).numpy())
+    r = float(torch.nn.GaussianNLLLoss()(torch.tensor(x), torch.tensor(y),
+                                         torch.tensor(var)))
+    assert abs(o - r) < 1e-5
+
+
+def test_soft_margin_losses(data):
+    torch = _torch()
+    x, _, rng = data
+    sl = np.sign(rng.randn(4, 5)).astype(np.float32)
+    o = float(nn.SoftMarginLoss()(paddle.to_tensor(x), paddle.to_tensor(sl)).numpy())
+    r = float(torch.nn.SoftMarginLoss()(torch.tensor(x), torch.tensor(sl)))
+    assert abs(o - r) < 1e-6
+    ml = (rng.rand(4, 5) > 0.5).astype(np.float32)
+    o = float(nn.MultiLabelSoftMarginLoss()(
+        paddle.to_tensor(x), paddle.to_tensor(ml)).numpy())
+    r = float(torch.nn.MultiLabelSoftMarginLoss()(
+        torch.tensor(x), torch.tensor(ml)))
+    assert abs(o - r) < 1e-6
+
+
+def test_ctc_layer(data):
+    torch = _torch()
+    _, _, rng = data
+    lp = rng.randn(12, 2, 6).astype(np.float32)
+    labels = rng.randint(1, 6, (2, 4)).astype(np.int32)
+    il = np.array([12, 10], np.int32)
+    ll = np.array([4, 3], np.int32)
+    o = float(nn.CTCLoss(reduction="sum")(
+        paddle.to_tensor(lp), paddle.to_tensor(labels),
+        paddle.to_tensor(il), paddle.to_tensor(ll)).numpy())
+    r = float(torch.nn.functional.ctc_loss(
+        torch.tensor(lp).log_softmax(-1), torch.tensor(labels),
+        torch.tensor(il), torch.tensor(ll), reduction="sum"))
+    assert abs(o - r) < 1e-3
+
+
+def test_zeropad2d(data):
+    _, _, rng = data
+    x = rng.randn(1, 2, 3, 3).astype(np.float32)
+    z = F.zeropad2d(paddle.to_tensor(x), [1, 1, 2, 2]).numpy()
+    assert z.shape == (1, 2, 7, 5)
+    np.testing.assert_array_equal(z[:, :, 2:5, 1:4], x)
+    assert z.sum() == pytest.approx(x.sum(), rel=1e-6)
+
+
+def test_feature_alpha_dropout_channel_granularity(data):
+    _, _, rng = data
+    x = np.ones((2, 8, 4, 4), np.float32)
+    paddle.seed(3)
+    out = F.feature_alpha_dropout(paddle.to_tensor(x), p=0.5).numpy()
+    # whole channel maps share their fate: each [n, c] slice is constant
+    per_chan = out.reshape(2, 8, -1)
+    assert (per_chan == per_chan[:, :, :1]).all()
+    assert len(np.unique(per_chan[:, :, 0].round(4))) == 2  # kept vs dropped
+    # eval mode: identity
+    same = F.feature_alpha_dropout(paddle.to_tensor(x), p=0.5, training=False).numpy()
+    np.testing.assert_array_equal(same, x)
+
+
+def test_gather_tree_tf_doc_example():
+    ids = np.array([[[1, 2, 3]], [[4, 5, 6]], [[7, 8, 9]]], np.int64)
+    par = np.array([[[0, 0, 0]], [[0, 1, 1]], [[2, 1, 2]]], np.int64)
+    out = F.gather_tree(paddle.to_tensor(ids), paddle.to_tensor(par)).numpy()
+    np.testing.assert_array_equal(
+        out[:, 0], np.array([[2, 2, 2], [6, 5, 6], [7, 8, 9]]))
