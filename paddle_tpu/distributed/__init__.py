@@ -21,13 +21,23 @@ from .communication import (
     scatter,
     send,
 )
-from .communication.ops import P2POp, batch_isend_irecv, ppermute, shift
+from .communication.ops import (  # noqa: F401
+    P2POp,
+    all_gather_object,
+    alltoall_single,
+    batch_isend_irecv,
+    broadcast_object_list,
+    ppermute,
+    scatter_object_list,
+    shift,
+)
 from .mesh import build_mesh, get_mesh, set_mesh
 from .parallel import (
     DataParallel,
     ParallelEnv,
     get_rank,
     get_world_size,
+    destroy_process_group,
     init_parallel_env,
     spawn,
 )
@@ -42,6 +52,7 @@ from .auto_parallel.api import (
     Shard,
     dtensor_from_fn,
     reshard,
+    unshard_dtensor,
     shard_layer,
     shard_tensor,
 )

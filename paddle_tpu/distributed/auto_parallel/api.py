@@ -157,7 +157,9 @@ class DistAttr:
 
 
 def shard_tensor(data, mesh, placements, dtype=None, place=None, stop_gradient=None):
-    t = to_tensor(data)
+    # keep an incoming Tensor intact (to_tensor detaches, per its own
+    # paddle contract) so sharding stays on the autograd tape
+    t = data if isinstance(data, Tensor) else to_tensor(data)
     spec = _placements_to_spec(placements, t.ndim, mesh)
     sharding = NamedSharding(mesh.jax_mesh(), spec)
     arr = jax.device_put(t._data, sharding)
@@ -172,11 +174,28 @@ def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
     return shard_tensor(fn(*args, **kwargs), mesh, placements)
 
 
+def unshard_dtensor(dist_tensor):
+    """reference: dist.unshard_dtensor — gather a DistTensor back to a
+    dense replicated tensor (device_put to a fully-replicated sharding;
+    XLA emits the all-gather)."""
+    t = dist_tensor if isinstance(dist_tensor, Tensor) else to_tensor(dist_tensor)
+    attr = getattr(t, "_dist_attr", None)
+    if attr is None:
+        return t
+    mesh = attr.process_mesh
+    spec = PartitionSpec(*([None] * t.ndim))
+    arr = jax.device_put(t._data, NamedSharding(mesh.jax_mesh(), spec))
+    out = Tensor(arr, stop_gradient=t.stop_gradient)
+    if t._node is not None:  # stay on the tape, like shard_tensor/reshard
+        out._node, out._out_idx = t._node, t._out_idx
+    return out
+
+
 def reshard(dist_tensor, mesh, placements):
     """Cross-placement (and cross-mesh) redistribution (reference:
     static/reshard.py Resharder; here a device_put with the target sharding —
     XLA emits the minimal collective: slice/all-gather/all-to-all)."""
-    t = to_tensor(dist_tensor)
+    t = dist_tensor if isinstance(dist_tensor, Tensor) else to_tensor(dist_tensor)
     spec = _placements_to_spec(placements, t.ndim, mesh)
     sharding = NamedSharding(mesh.jax_mesh(), spec)
     arr = jax.device_put(t._data, sharding)

@@ -272,3 +272,28 @@ def barrier(group=None):
 
 def wait(tensor, group=None, use_calc_stream=True):
     pass
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """reference: dist.broadcast_object_list. In-process SPMD has one
+    Python program: every rank already holds src's objects (multi-host
+    object exchange rides the TCPStore rendezvous in launch)."""
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0, group=None):
+    """reference: dist.scatter_object_list — this rank takes its slice;
+    raises on unequal division (the reference errors rather than silently
+    dropping objects)."""
+    if in_object_list:
+        n = group.nranks if group is not None else max(_env.get_world_size(), 1)
+        rank = group.rank if group is not None else _env.get_rank()
+        if len(in_object_list) % n:
+            raise ValueError(
+                f"scatter_object_list: {len(in_object_list)} objects do not "
+                f"divide evenly over {n} ranks"
+            )
+        per = len(in_object_list) // n
+        out_object_list.clear()
+        out_object_list.extend(in_object_list[rank * per:(rank + 1) * per])
+    return out_object_list

@@ -26,6 +26,22 @@ def init_parallel_env():
     return _world_group()
 
 
+def destroy_process_group(group=None):
+    """reference: dist.destroy_process_group — tear down the group/mesh
+    state so init_parallel_env can run fresh (tests, elastic restarts).
+    Clears the group registry too: a handle from the old topology must not
+    silently resolve against a new mesh."""
+    from .communication import group as _grp
+    from .mesh import reset_mesh
+
+    if group is None:
+        reset_mesh()
+        _grp._group_map.clear()
+    else:
+        _grp._group_map.pop(getattr(group, "id", None), None)
+    return None
+
+
 def get_rank(group=None):
     return _env.get_rank()
 

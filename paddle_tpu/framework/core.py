@@ -90,6 +90,7 @@ def init_tensor_slots(t, name=None):
     t._out_idx = 0
     t._hooks = []
     t.name = name
+    t._dist_attr = None
 
 
 class GradNode:
@@ -120,7 +121,8 @@ class Tensor:
     shard_map unchanged.
     """
 
-    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx", "_hooks", "name", "__weakref__")
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx", "_hooks", "name",
+                 "_dist_attr", "__weakref__")
     __array_priority__ = 100  # win over numpy operator dispatch
 
     def __init__(self, data, stop_gradient=True, name=None):
@@ -135,6 +137,7 @@ class Tensor:
         self._out_idx = 0
         self._hooks = []
         self.name = name
+        self._dist_attr = None
 
     # -- basic properties ---------------------------------------------------
     @property

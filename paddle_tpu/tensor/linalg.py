@@ -247,3 +247,66 @@ def bincount(x, weights=None, minlength=0, name=None):
 
 def matrix_exp(x, name=None):
     return apply(jax.scipy.linalg.expm, _t(x))
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=None):
+    """reference: linalg.lu_unpack — (P, L, U) from lu()'s packed output
+    (pivots are the 1-based lu_factor convention lu() emits). Batched
+    inputs unpack per matrix; the 3-tuple arity is stable — a flag turned
+    off yields None in that slot."""
+    a = _t(lu_data)._data
+    piv = _t(lu_pivots)._data.astype(jnp.int32) - 1
+    m, n = a.shape[-2], a.shape[-1]
+    k = min(m, n)
+
+    def unpack_one(mat, pv):
+        L = jnp.tril(mat, -1)[..., :, :k] + jnp.eye(m, k, dtype=mat.dtype)
+        U = jnp.triu(mat)[..., :k, :]
+        perm = jnp.arange(m)
+
+        def body(pr, i):
+            j = pv[i]
+            pi, pj = pr[i], pr[j]
+            return pr.at[i].set(pj).at[j].set(pi), None
+
+        perm, _ = jax.lax.scan(body, perm, jnp.arange(pv.shape[-1]))
+        P = jnp.eye(m, dtype=mat.dtype)[perm].T
+        return P, L, U
+
+    batch = a.shape[:-2]
+    if batch:
+        flat_a = a.reshape((-1,) + a.shape[-2:])
+        flat_p = piv.reshape((-1,) + piv.shape[-1:])
+        P, L, U = jax.vmap(unpack_one)(flat_a, flat_p)
+        P = P.reshape(batch + P.shape[-2:])
+        L = L.reshape(batch + L.shape[-2:])
+        U = U.reshape(batch + U.shape[-2:])
+    else:
+        P, L, U = unpack_one(a, piv)
+    return (
+        Tensor(P) if unpack_pivots else None,
+        Tensor(L) if unpack_ludata else None,
+        Tensor(U) if unpack_ludata else None,
+    )
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """reference: linalg.vector_norm — always the vector norm, any shape.
+    axis=None reduces ALL dims (keepdim yields a rank-preserving all-ones
+    shape, like the reference)."""
+
+    def fn(a):
+        if axis is not None:
+            return jnp.linalg.norm(a, ord=p, axis=axis, keepdims=keepdim)
+        out = jnp.linalg.norm(a.reshape(-1), ord=p)
+        return out.reshape((1,) * a.ndim) if keepdim else out
+
+    return apply(fn, _t(x), name="vector_norm")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """reference: linalg.matrix_norm — norm over the trailing matrix dims."""
+    return apply(
+        lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis), keepdims=keepdim),
+        _t(x), name="matrix_norm",
+    )

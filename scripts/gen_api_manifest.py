@@ -83,7 +83,8 @@ NAMESPACES = {
     "paddle.optimizer.lr": """LRScheduler NoamDecay ExponentialDecay NaturalExpDecay
         InverseTimeDecay PolynomialDecay LinearWarmup PiecewiseDecay CosineAnnealingDecay
         StepDecay LambdaDecay MultiStepDecay ReduceOnPlateau OneCycleLR CyclicLR""",
-    "paddle.distributed": """init_parallel_env get_rank get_world_size all_reduce
+    "paddle.distributed": """broadcast_object_list scatter_object_list
+        alltoall_single destroy_process_group unshard_dtensor all_gather_object init_parallel_env get_rank get_world_size all_reduce
         all_gather all_gather_object all_to_all reduce broadcast scatter gather
         reduce_scatter send recv isend irecv batch_isend_irecv barrier new_group
         get_group wait shard_tensor reshard dtensor_from_fn shard_layer Shard Replicate
@@ -113,7 +114,7 @@ NAMESPACES = {
     "paddle.distribution": """Distribution Normal Uniform Categorical Bernoulli Beta
         Dirichlet Exponential Gamma Geometric Gumbel Laplace LogNormal Multinomial
         Poisson StudentT TransformedDistribution kl_divergence register_kl Independent""",
-    "paddle.linalg": """matmul norm inv det slogdet svd qr lu cholesky eig eigh eigvals
+    "paddle.linalg": """lu_unpack vector_norm matrix_norm matmul norm inv det slogdet svd qr lu cholesky eig eigh eigvals
         eigvalsh matrix_rank matrix_power pinv solve triangular_solve cholesky_solve
         lstsq cond corrcoef cov householder_product multi_dot""",
     "paddle.fft": """fft ifft fft2 ifft2 fftn ifftn rfft irfft rfft2 irfft2 rfftn irfftn

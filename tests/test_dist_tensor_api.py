@@ -1,0 +1,94 @@
+"""DistTensor API (reference: auto_parallel/api.py — shard_tensor, reshard,
+placements, dtensor_from_fn, unshard_dtensor). These had NO direct tests
+before round 4 — shard_tensor was in fact broken (Tensor lacked the
+_dist_attr slot) — so this file is the regression net."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import (
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_tensor,
+    unshard_dtensor,
+)
+
+
+@pytest.fixture
+def mesh():
+    return ProcessMesh([0, 1, 2, 3, 4, 5, 6, 7], dim_names=["x"])
+
+
+def test_shard_tensor_distributes(mesh):
+    data = np.arange(16, dtype=np.float32).reshape(8, 2)
+    dt = shard_tensor(data, mesh, [Shard(0)])
+    devs = {s.device for s in dt._data.addressable_shards}
+    assert len(devs) == 8, "not actually sharded"
+    assert dt._dist_attr is not None
+    np.testing.assert_array_equal(np.asarray(dt._data), data)
+
+
+def test_unshard_and_reshard_roundtrip(mesh):
+    data = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    dt = shard_tensor(data, mesh, [Shard(0)])
+    full = unshard_dtensor(dt)
+    np.testing.assert_array_equal(full.numpy(), data)
+    rep = reshard(dt, mesh, [Replicate()])
+    np.testing.assert_array_equal(rep.numpy(), data)
+    # replicate -> shard(1) moves the split axis
+    back = reshard(rep, mesh, [Shard(1)])
+    np.testing.assert_array_equal(np.asarray(back._data), data)
+
+
+def test_dtensor_from_fn(mesh):
+    dt = dtensor_from_fn(paddle.zeros, mesh, [Replicate()], [8, 8])
+    assert np.asarray(dt._data).sum() == 0.0
+
+
+def test_grad_flows_through_shard_and_unshard(mesh):
+    """shard_tensor/unshard_dtensor must stay on the autograd tape (the
+    normalization used to route through to_tensor, which detaches)."""
+    src = paddle.to_tensor(np.ones((8, 8), np.float32), stop_gradient=False)
+    dt = shard_tensor(src * 2.0, mesh, [Shard(0)])
+    full = unshard_dtensor(dt)
+    full.sum().backward()
+    assert src.grad is not None
+    assert float(src.grad.numpy().sum()) == 128.0
+
+
+def test_lu_unpack_batched_and_norms():
+    import torch
+
+    a = np.random.RandomState(0).randn(3, 4, 4).astype(np.float32)
+    lu_, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    P, L, U = paddle.linalg.lu_unpack(lu_, piv)
+    np.testing.assert_allclose(
+        np.einsum("bij,bjk,bkl->bil", P.numpy(), L.numpy(), U.numpy()), a, atol=1e-4)
+    P2, L2, _ = paddle.linalg.lu_unpack(lu_, piv, unpack_pivots=False)
+    assert P2 is None and L2 is not None  # stable 3-tuple arity
+
+    x = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+    assert paddle.linalg.vector_norm(paddle.to_tensor(x), keepdim=True).shape == [1, 1]
+    np.testing.assert_allclose(
+        float(paddle.linalg.vector_norm(paddle.to_tensor(x), p=3).numpy()),
+        float(torch.linalg.vector_norm(torch.tensor(x), ord=3)), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(paddle.linalg.matrix_norm(paddle.to_tensor(x)).numpy()),
+        float(torch.linalg.matrix_norm(torch.tensor(x))), rtol=1e-5)
+
+
+def test_object_collectives_and_destroy():
+    objs = []
+    dist.broadcast_object_list(objs)
+    out = []
+    dist.scatter_object_list(out, [1, 2, 3, 4])
+    assert out  # this rank took its slice
+    dist.destroy_process_group()
+
+    from paddle_tpu.distributed import mesh as M
+
+    assert not M.has_mesh()
