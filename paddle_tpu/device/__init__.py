@@ -51,6 +51,23 @@ def get_device():
     return f"{backend}:0"
 
 
+def get_all_devices():
+    """reference: device.get_all_devices — every visible device string."""
+    import jax
+
+    return [f"{d.platform}:{i}" for i, d in enumerate(jax.devices())]
+
+
+def get_available_device():
+    """reference: device.get_available_device."""
+    return get_all_devices()
+
+
+def get_cudnn_version():
+    """reference: device.get_cudnn_version — None off-CUDA (TPU build)."""
+    return None
+
+
 def get_all_custom_device_type():
     return ["tpu"] if jax.default_backend() == "tpu" else []
 

@@ -1,6 +1,7 @@
 from . import lr
 from .optimizer import L1Decay, L2Decay, Optimizer
 from .optimizers import (
+    ASGD,
     SGD,
     Adadelta,
     Adagrad,
@@ -9,7 +10,10 @@ from .optimizers import (
     AdamW,
     Lamb,
     Momentum,
+    NAdam,
+    RAdam,
     RMSProp,
+    Rprop,
 )
 
 from .lbfgs import LBFGS  # noqa: F401

@@ -246,3 +246,114 @@ class Lamb(Optimizer):
         for name, p in named_params.items():
             state["slots"][name]["_decay"] = 0.0 if self._no_decay(p, name) else 1.0
         return state
+
+
+class NAdam(Adam):
+    """reference: optimizer/nadam.py — Adam with Nesterov momentum
+    (torch/paddle NAdam: mu-product bias correction)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 momentum_decay=0.004, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, False, multi_precision, name=name)
+        self._psi = momentum_decay
+
+    def _create_slots(self, p):
+        slots = super()._create_slots(p)
+        slots["mu_product"] = jnp.ones((), jnp.float32)
+        return slots
+
+    def _rule(self, p, g, slots, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        t = jnp.asarray(step, jnp.float32)
+        mu_t = b1 * (1.0 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = b1 * (1.0 - 0.5 * 0.96 ** ((t + 1.0) * self._psi))
+        mu_prod = slots["mu_product"] * mu_t
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = mu_t1 * m / (1 - mu_prod * mu_t1) + (1 - mu_t) * g / (1 - mu_prod)
+        vhat = v / (1 - b2**t)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p, {**slots, "moment1": m, "moment2": v, "mu_product": mu_prod}
+
+
+class RAdam(Adam):
+    """reference: optimizer/radam.py — rectified Adam: falls back to SGD-with-
+    momentum while the variance estimate is untrustworthy (small t)."""
+
+    def _rule(self, p, g, slots, lr, step):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        t = jnp.asarray(step, jnp.float32)
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - b1**t)
+        rho_inf = 2.0 / (1.0 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * b2**t / (1 - b2**t)
+        r = jnp.sqrt(
+            jnp.maximum((rho_t - 4) * (rho_t - 2) * rho_inf, 0.0)
+            / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-30)
+        )
+        vhat = jnp.sqrt(v / (1 - b2**t)) + eps
+        adam_step = lr * r * mhat / vhat
+        sgd_step = lr * mhat
+        new_p = p - jnp.where(rho_t > 5.0, adam_step, sgd_step)
+        return new_p, {**slots, "moment1": m, "moment2": v}
+
+
+class Rprop(Optimizer):
+    """reference: optimizer/rprop.py — resilient backprop: per-element step
+    sizes grow on consistent gradient sign, shrink on sign flips (batch
+    training only)."""
+
+    def __init__(self, learning_rate=0.01, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._eta_minus, self._eta_plus = etas
+        self._lr_min, self._lr_max = learning_rate_range
+        self._init_lr = learning_rate
+
+    def _create_slots(self, p):
+        slots = super()._create_slots(p)
+        base = slots.get("master_weight", p._data)
+        slots["prev_grad"] = jnp.zeros_like(base)
+        slots["step_size"] = jnp.full_like(base, self._init_lr)
+        return slots
+
+    def _rule(self, p, g, slots, lr, step):
+        sign = jnp.sign(g * slots["prev_grad"])
+        size = jnp.clip(
+            jnp.where(sign > 0, slots["step_size"] * self._eta_plus,
+                      jnp.where(sign < 0, slots["step_size"] * self._eta_minus,
+                                slots["step_size"])),
+            self._lr_min, self._lr_max,
+        )
+        # on a sign flip, skip the update and zero the remembered grad
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p - jnp.sign(g_eff) * size
+        return new_p, {**slots, "prev_grad": g_eff, "step_size": size}
+
+
+class ASGD(Optimizer):
+    """reference: optimizer/asgd.py — averaged SGD (Polyak-Ruppert): plain
+    SGD steps plus a running average of the iterates in a slot."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _create_slots(self, p):
+        slots = super()._create_slots(p)
+        base = slots.get("master_weight", p._data)
+        slots["averaged_param"] = base.astype(jnp.float32)
+        return slots
+
+    def _rule(self, p, g, slots, lr, step):
+        new_p = p - lr * g
+        t = jnp.asarray(step, jnp.float32)
+        avg = slots["averaged_param"] + (new_p.astype(jnp.float32) - slots["averaged_param"]) / t
+        return new_p, {**slots, "averaged_param": avg}

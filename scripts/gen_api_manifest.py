@@ -78,7 +78,7 @@ NAMESPACES = {
         pad interpolate upsample pixel_shuffle channel_shuffle grid_sample affine_grid
         scaled_dot_product_attention sequence_mask gumbel_softmax normalize unfold fold
         label_smooth temporal_shift npair_loss square_error_cost softmax_with_cross_entropy""",
-    "paddle.optimizer": """Optimizer SGD Momentum Adam AdamW Adamax Adagrad Adadelta
+    "paddle.optimizer": """NAdam RAdam Rprop ASGD Optimizer SGD Momentum Adam AdamW Adamax Adagrad Adadelta
         RMSProp Lamb LBFGS lr""",
     "paddle.optimizer.lr": """LRScheduler NoamDecay ExponentialDecay NaturalExpDecay
         InverseTimeDecay PolynomialDecay LinearWarmup PiecewiseDecay CosineAnnealingDecay

@@ -215,3 +215,49 @@ class TestLBFGS:
             opt.step()
             opt.clear_grad()
         assert abs(float(x.numpy()[0])) < 1.0
+
+
+class TestNewOptimizerFamilies:
+    """NAdam/RAdam/Rprop vs torch (reference: optimizer/{nadam,radam,rprop}.py)."""
+
+    def _run_ours(self, cls, steps=5, **kw):
+        from paddle_tpu.nn.layer.common import Linear
+
+        paddle.seed(0)
+        net = Linear(6, 4, bias_attr=False)
+        w0 = net.weight.numpy().copy()
+        o = cls(parameters=net.parameters(), **kw)
+        x = np.random.RandomState(1).randn(8, 6).astype(np.float32)
+        for _ in range(steps):
+            loss = (net(paddle.to_tensor(x)) ** 2).mean()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        return w0, net.weight.numpy()
+
+    def _run_torch(self, cls, w0, steps=5, **kw):
+        import torch
+
+        w = torch.tensor(w0.copy(), requires_grad=True)
+        o = cls([w], **kw)
+        x = torch.tensor(np.random.RandomState(1).randn(8, 6).astype(np.float32))
+        for _ in range(steps):
+            loss = ((x @ w) ** 2).mean()
+            o.zero_grad()
+            loss.backward()
+            o.step()
+        return w.detach().numpy()
+
+    @pytest.mark.parametrize("name,tol", [("NAdam", 1e-4), ("RAdam", 1e-4), ("Rprop", 1e-6)])
+    def test_matches_torch(self, name, tol):
+        import torch
+
+        ours = getattr(optimizer, name)
+        theirs = getattr(torch.optim, name)
+        w0, wo = self._run_ours(ours, learning_rate=0.01)
+        wt = self._run_torch(theirs, w0, lr=0.01)
+        assert np.abs(wo - wt).max() < tol
+
+    def test_asgd_average_slot(self):
+        w0, wo = self._run_ours(optimizer.ASGD, learning_rate=0.01)
+        assert np.isfinite(wo).all() and not np.allclose(wo, w0)
