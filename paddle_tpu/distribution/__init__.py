@@ -13,6 +13,7 @@ from .bernoulli import Bernoulli
 from .beta import Beta
 from .dirichlet import Dirichlet
 from .exponential import Exponential
+from .extra import Chi2, ContinuousBernoulli, ExponentialFamily, MultivariateNormal  # noqa: F401
 from .gamma import Gamma
 from .geometric import Geometric
 from .gumbel import Gumbel

@@ -1,0 +1,175 @@
+"""Chi2 / ExponentialFamily / MultivariateNormal / ContinuousBernoulli
+(reference: python/paddle/distribution/{chi2,exponential_family,
+multivariate_normal,continuous_bernoulli}.py)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+from .gamma import Gamma
+
+
+class ExponentialFamily(Distribution):
+    """reference: distribution/exponential_family.py — base class carrying
+    the Bregman-divergence entropy identity. Subclasses define natural
+    parameters and log_normalizer; entropy falls out via autodiff."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        """-H = E[log p] via the exponential-family identity:
+        entropy = logZ - sum(eta_i * dlogZ/deta_i) - E[carrier]."""
+        from ..framework.core import Tensor
+
+        nat = tuple(jnp.asarray(p) for p in self._natural_parameters)
+        logz, grads = jax.value_and_grad(
+            lambda etas: jnp.sum(self._log_normalizer(*etas)), argnums=0
+        )(nat)
+        ent = logz - sum(jnp.sum(e * g) for e, g in zip(nat, grads))
+        return Tensor(ent - self._mean_carrier_measure)
+
+
+class Chi2(Gamma):
+    """reference: distribution/chi2.py — Gamma(df/2, rate=1/2)."""
+
+    def __init__(self, df, name=None):
+        df = self._to_float(df)
+        super().__init__(concentration=df / 2.0, rate=jnp.full_like(jnp.asarray(df), 0.5))
+        self.df = df
+
+    def __repr__(self):
+        return f"Chi2(df={self.df})"
+
+
+class MultivariateNormal(Distribution):
+    """reference: distribution/multivariate_normal.py — parameterized by
+    loc + covariance_matrix (or precision_matrix / scale_tril)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = jnp.asarray(_data(loc), jnp.float32)
+        given = [a is not None for a in (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError(
+                "exactly ONE of covariance_matrix / precision_matrix / "
+                "scale_tril must be given"
+            )
+        if scale_tril is not None:
+            self._scale_tril = jnp.asarray(_data(scale_tril), jnp.float32)
+        elif covariance_matrix is not None:
+            self._scale_tril = jnp.linalg.cholesky(
+                jnp.asarray(_data(covariance_matrix), jnp.float32)
+            )
+        else:
+            prec = jnp.asarray(_data(precision_matrix), jnp.float32)
+            self._scale_tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        super().__init__(batch_shape=self.loc.shape[:-1],
+                         event_shape=self.loc.shape[-1:])
+
+    @property
+    def covariance_matrix(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self._scale_tril @ jnp.swapaxes(self._scale_tril, -1, -2))
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor(jnp.sum(jnp.square(self._scale_tril), axis=-1))
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape + self._event_shape
+        eps = jax.random.normal(key, full)
+        return self.loc + jnp.einsum("...ij,...j->...i", self._scale_tril, eps)
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = jnp.asarray(_data(value), jnp.float32)
+        d = v.shape[-1]
+        diff = v - self.loc
+        Lb = jnp.broadcast_to(
+            self._scale_tril, diff.shape[:-1] + self._scale_tril.shape[-2:]
+        )
+        sol = jax.scipy.linalg.solve_triangular(Lb, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(jnp.square(sol), axis=-1)
+        logdet = 2.0 * jnp.sum(
+            jnp.log(jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)), axis=-1
+        )
+        return Tensor(-0.5 * (d * jnp.log(2.0 * jnp.pi) + logdet + maha))
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        d = self._event_shape[0]
+        logdet = 2.0 * jnp.sum(
+            jnp.log(jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)), axis=-1
+        )
+        return Tensor(0.5 * (d * (1.0 + jnp.log(2.0 * jnp.pi)) + logdet))
+
+
+class ContinuousBernoulli(Distribution):
+    """reference: distribution/continuous_bernoulli.py — the [0, 1]-supported
+    exponential-family relaxation of Bernoulli (Loaiza-Ganem & Cunningham
+    2019): p(x) = C(lam) lam^x (1-lam)^(1-x)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = jnp.asarray(_data(self._to_float(probs)), jnp.float32)
+        self._lims = lims
+        super().__init__(batch_shape=self.probs.shape)
+
+    def _outside_lims(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _log_norm_const(self):
+        # C(lam) = 2 atanh(1-2lam) / (1-2lam) for lam != 0.5, else 2
+        lam = jnp.where(self._outside_lims(), self.probs, self._lims[0])
+        x = 1.0 - 2.0 * lam
+        log_c = jnp.log(2.0 * jnp.arctanh(x) / x)
+        # Taylor around lam=0.5: log(2 + x^2 * 2/3 ...) ~ log 2 + x^2/3
+        taylor = jnp.log(2.0) + jnp.square(1.0 - 2.0 * self.probs) / 3.0
+        return jnp.where(self._outside_lims(), log_c, taylor)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        lam = jnp.where(self._outside_lims(), self.probs, self._lims[0])
+        m = lam / (2.0 * lam - 1.0) + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * lam))
+        return Tensor(jnp.where(self._outside_lims(), m, 0.5))
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = jnp.asarray(_data(value), jnp.float32)
+        return Tensor(
+            self._log_norm_const()
+            + v * jnp.log(jnp.maximum(self.probs, 1e-12))
+            + (1.0 - v) * jnp.log(jnp.maximum(1.0 - self.probs, 1e-12))
+        )
+
+    def _sample(self, key, shape):
+        # inverse-CDF sampling
+        full = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(key, full, minval=1e-6, maxval=1.0 - 1e-6)
+        lam = jnp.where(self._outside_lims(), self.probs, self._lims[0])
+        icdf = (
+            jnp.log1p(u * (2.0 * lam - 1.0) / (1.0 - lam))
+            / (jnp.log(lam) - jnp.log1p(-lam))
+        )
+        return jnp.where(self._outside_lims(), icdf, u)

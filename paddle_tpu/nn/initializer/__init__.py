@@ -62,6 +62,25 @@ class Uniform(Initializer):
         return jax.random.uniform(prandom.next_key(), shape, dtype, self.low, self.high)
 
 
+class Bilinear(Initializer):
+    """reference: initializer/Bilinear — transposed-conv upsampling kernels:
+    each [kh, kw] filter is the bilinear interpolation stencil, identical
+    across channels. Weight shape [C_out, C_in, kh, kw]."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError(f"Bilinear expects a 4-D conv weight, got {shape}")
+        kh, kw = shape[2], shape[3]
+
+        def stencil(k):
+            f = (k + 1) // 2
+            c = f - 1 if k % 2 == 1 else f - 0.5
+            return 1.0 - jnp.abs(jnp.arange(k, dtype=jnp.float32) - c) / f
+
+        w = jnp.outer(stencil(kh), stencil(kw))
+        return jnp.broadcast_to(w, tuple(shape)).astype(dtype)
+
+
 class XavierNormal(Initializer):
     def __init__(self, fan_in=None, fan_out=None, gain=1.0):
         self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain

@@ -111,7 +111,8 @@ NAMESPACES = {
         WeightOnlyLinear quantize_for_inference""",
     "paddle.vision": """models transforms datasets ops image_load set_image_backend""",
     "paddle.metric": """Metric Accuracy Precision Recall Auc accuracy""",
-    "paddle.distribution": """Distribution Normal Uniform Categorical Bernoulli Beta
+    "paddle.distribution": """Chi2 ExponentialFamily MultivariateNormal
+        ContinuousBernoulli Distribution Normal Uniform Categorical Bernoulli Beta
         Dirichlet Exponential Gamma Geometric Gumbel Laplace LogNormal Multinomial
         Poisson StudentT TransformedDistribution kl_divergence register_kl Independent""",
     "paddle.linalg": """lu_unpack vector_norm matrix_norm matmul norm inv det slogdet svd qr lu cholesky eig eigh eigvals

@@ -1,0 +1,71 @@
+"""Chi2 / MultivariateNormal / ContinuousBernoulli / Bilinear init
+(reference: distribution/{chi2,multivariate_normal,continuous_bernoulli}.py,
+initializer Bilinear) — scipy oracles and integral/moment properties."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import Chi2, ContinuousBernoulli, MultivariateNormal
+
+
+def test_chi2_matches_scipy():
+    c = Chi2(np.float32(5.0))
+    xs = np.array([1.0, 3.0, 7.5], np.float32)
+    np.testing.assert_allclose(c.log_prob(xs).numpy(), st.chi2.logpdf(xs, 5.0),
+                               rtol=1e-5)
+    assert float(c.mean.numpy()) == pytest.approx(5.0)
+
+
+def test_mvn_matches_scipy():
+    rng = np.random.RandomState(0)
+    A = rng.randn(3, 3).astype(np.float32)
+    cov = A @ A.T + 3 * np.eye(3, dtype=np.float32)
+    loc = rng.randn(3).astype(np.float32)
+    m = MultivariateNormal(loc, covariance_matrix=cov)
+    x = rng.randn(4, 3).astype(np.float32)
+    np.testing.assert_allclose(m.log_prob(x).numpy(),
+                               st.multivariate_normal.logpdf(x, loc, cov), rtol=1e-4)
+    np.testing.assert_allclose(float(m.entropy().numpy()),
+                               st.multivariate_normal(loc, cov).entropy(), rtol=1e-5)
+    paddle.seed(0)
+    s = m.sample([20000]).numpy()
+    np.testing.assert_allclose(s.mean(0), loc, atol=0.1)
+    np.testing.assert_allclose(np.cov(s.T), cov, atol=0.3)
+    # scale_tril / precision parameterizations agree
+    L = np.linalg.cholesky(cov).astype(np.float32)
+    np.testing.assert_allclose(
+        MultivariateNormal(loc, scale_tril=L).log_prob(x).numpy(),
+        m.log_prob(x).numpy(), rtol=1e-4)
+
+
+def test_mvn_requires_exactly_one_parameterization():
+    with pytest.raises(ValueError):
+        MultivariateNormal(np.zeros(2, np.float32))
+
+
+def test_continuous_bernoulli_density_and_moments():
+    cb = ContinuousBernoulli(np.float32(0.3))
+    grid = np.linspace(1e-4, 1 - 1e-4, 20001).astype(np.float32)
+    pdf = np.exp(cb.log_prob(grid).numpy())
+    assert abs(np.trapezoid(pdf, grid) - 1.0) < 1e-3
+    paddle.seed(1)
+    samp = cb.sample([40000]).numpy()
+    assert ((samp >= 0) & (samp <= 1)).all()
+    assert abs(samp.mean() - float(cb.mean.numpy())) < 5e-3
+    # near lam=0.5 the Taylor branch keeps everything finite
+    mid = ContinuousBernoulli(np.float32(0.5))
+    assert np.isfinite(mid.log_prob(np.float32(0.25)).numpy()).all()
+
+
+def test_bilinear_initializer_stencil():
+    from paddle_tpu.nn import initializer as I
+
+    w = np.asarray(I.Bilinear()((2, 3, 4, 4), "float32"))
+    assert w.shape == (2, 3, 4, 4)
+    # identical stencil across channels; symmetric; corner < center
+    assert (w == w[0, 0]).all()
+    np.testing.assert_allclose(w[0, 0], w[0, 0].T)
+    assert w[0, 0, 0, 0] < w[0, 0, 1, 1]
+    with pytest.raises(ValueError):
+        I.Bilinear()((4, 4), "float32")
