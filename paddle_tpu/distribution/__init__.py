@@ -13,7 +13,10 @@ from .bernoulli import Bernoulli
 from .beta import Beta
 from .dirichlet import Dirichlet
 from .exponential import Exponential
-from .extra import Chi2, ContinuousBernoulli, ExponentialFamily, MultivariateNormal  # noqa: F401
+from .chi2 import Chi2  # noqa: F401
+from .continuous_bernoulli import ContinuousBernoulli  # noqa: F401
+from .exponential_family import ExponentialFamily  # noqa: F401
+from .multivariate_normal import MultivariateNormal  # noqa: F401
 from .gamma import Gamma
 from .geometric import Geometric
 from .gumbel import Gumbel

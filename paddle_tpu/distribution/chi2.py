@@ -1,0 +1,23 @@
+"""reference: python/paddle/distribution/chi2.py — Gamma(df/2, rate=1/2)."""
+import jax.numpy as jnp
+
+from .distribution import _data
+from .gamma import Gamma
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df_raw = self._to_float(df)
+        super().__init__(concentration=df_raw / 2.0,
+                         rate=jnp.full_like(jnp.asarray(df_raw), 0.5))
+        self.df = df_raw
+        # differentiability: track the ORIGINAL df tensor; _retrace rebuilds
+        # the Gamma parameters from the traced df inside taped methods
+        self._track(df=df)
+
+    def _retrace(self):
+        self.concentration = jnp.asarray(self.df) / 2.0
+        self.rate = jnp.full_like(jnp.asarray(self.df), 0.5)
+
+    def __repr__(self):
+        return f"Chi2(df={self.df})"

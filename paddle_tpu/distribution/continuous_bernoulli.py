@@ -1,0 +1,59 @@
+"""reference: python/paddle/distribution/continuous_bernoulli.py — the
+[0, 1]-supported exponential-family relaxation of Bernoulli (Loaiza-Ganem
+& Cunningham 2019): p(x) = C(lam) lam^x (1-lam)^(1-x)."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = jnp.asarray(_data(self._to_float(probs)), jnp.float32)
+        self._lims = lims
+        super().__init__(batch_shape=self.probs.shape)
+        self._track(probs=probs)
+
+    def _outside_lims(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _log_norm_const(self):
+        # C(lam) = 2 atanh(1-2lam) / (1-2lam) for lam != 0.5, else 2
+        lam = jnp.where(self._outside_lims(), self.probs, self._lims[0])
+        x = 1.0 - 2.0 * lam
+        log_c = jnp.log(2.0 * jnp.arctanh(x) / x)
+        # Taylor around lam=0.5: log C ~ log 2 + x^2/3
+        taylor = jnp.log(2.0) + jnp.square(1.0 - 2.0 * self.probs) / 3.0
+        return jnp.where(self._outside_lims(), log_c, taylor)
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        lam = jnp.where(self._outside_lims(), self.probs, self._lims[0])
+        m = lam / (2.0 * lam - 1.0) + 1.0 / (2.0 * jnp.arctanh(1.0 - 2.0 * lam))
+        # Taylor around lam=0.5: mean ~ 0.5 + (lam - 0.5)/3 — keeps the value
+        # continuous AND d(mean)/d(probs) ~ 1/3 inside the clamp region
+        taylor = 0.5 + (self.probs - 0.5) / 3.0
+        return Tensor(jnp.where(self._outside_lims(), m, taylor))
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = jnp.asarray(_data(value), jnp.float32)
+        return Tensor(
+            self._log_norm_const()
+            + v * jnp.log(jnp.maximum(self.probs, 1e-12))
+            + (1.0 - v) * jnp.log(jnp.maximum(1.0 - self.probs, 1e-12))
+        )
+
+    def _sample(self, key, shape):
+        # inverse-CDF sampling
+        full = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(key, full, minval=1e-6, maxval=1.0 - 1e-6)
+        lam = jnp.where(self._outside_lims(), self.probs, self._lims[0])
+        icdf = (
+            jnp.log1p(u * (2.0 * lam - 1.0) / (1.0 - lam))
+            / (jnp.log(lam) - jnp.log1p(-lam))
+        )
+        return jnp.where(self._outside_lims(), icdf, u)

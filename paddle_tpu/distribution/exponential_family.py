@@ -1,0 +1,34 @@
+"""reference: python/paddle/distribution/exponential_family.py."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution
+
+
+class ExponentialFamily(Distribution):
+    """Base class carrying the Bregman-divergence entropy identity.
+    Subclasses define natural parameters and log_normalizer; entropy falls
+    out via autodiff, per batch element."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        """entropy = logZ - sum_i eta_i * dlogZ/deta_i - E[carrier], kept
+        per batch element (logZ is elementwise over the batch, so the grad
+        of its SUM is exactly the per-element derivative)."""
+        from ..framework.core import Tensor
+
+        nat = tuple(jnp.asarray(p) for p in self._natural_parameters)
+        logz = self._log_normalizer(*nat)
+        grads = jax.grad(lambda etas: jnp.sum(self._log_normalizer(*etas)))(nat)
+        ent = logz - sum(e * g for e, g in zip(nat, grads)) - self._mean_carrier_measure
+        return Tensor(ent)

@@ -1,0 +1,98 @@
+"""reference: python/paddle/distribution/multivariate_normal.py."""
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _data
+
+
+class MultivariateNormal(Distribution):
+    """Parameterized by loc + exactly one of covariance_matrix /
+    precision_matrix / scale_tril. Batch dims of loc and the matrix
+    broadcast (reference semantics)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = jnp.asarray(_data(loc), jnp.float32)
+        given = [a is not None for a in (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError(
+                "exactly ONE of covariance_matrix / precision_matrix / "
+                "scale_tril must be given"
+            )
+        if scale_tril is not None:
+            self._param_kind = "tril"
+            orig = scale_tril
+        elif covariance_matrix is not None:
+            self._param_kind = "cov"
+            orig = covariance_matrix
+        else:
+            self._param_kind = "prec"
+            orig = precision_matrix
+        self._param = jnp.asarray(_data(orig), jnp.float32)
+        self._retrace()
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1], self._scale_tril.shape[:-2])
+        self.loc = jnp.broadcast_to(self.loc, batch + self.loc.shape[-1:])
+        self._scale_tril = jnp.broadcast_to(
+            self._scale_tril, batch + self._scale_tril.shape[-2:]
+        )
+        super().__init__(batch_shape=batch, event_shape=self.loc.shape[-1:])
+        # differentiability: taped methods rebuild _scale_tril from the
+        # traced parameter via _retrace
+        self._track(loc=loc, _param=orig)
+
+    def _retrace(self):
+        p = jnp.asarray(self._param)
+        if self._param_kind == "tril":
+            self._scale_tril = p
+        elif self._param_kind == "cov":
+            self._scale_tril = jnp.linalg.cholesky(p)
+        else:
+            self._scale_tril = jnp.linalg.cholesky(jnp.linalg.inv(p))
+
+    @property
+    def covariance_matrix(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self._scale_tril @ jnp.swapaxes(self._scale_tril, -1, -2))
+
+    @property
+    def mean(self):
+        from ..framework.core import Tensor
+
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        from ..framework.core import Tensor
+
+        return Tensor(jnp.sum(jnp.square(self._scale_tril), axis=-1))
+
+    def _sample(self, key, shape):
+        full = tuple(shape) + self._batch_shape + self._event_shape
+        eps = jax.random.normal(key, full)
+        return self.loc + jnp.einsum("...ij,...j->...i", self._scale_tril, eps)
+
+    def log_prob(self, value):
+        from ..framework.core import Tensor
+
+        v = jnp.asarray(_data(value), jnp.float32)
+        d = v.shape[-1]
+        diff = v - self.loc
+        Lb = jnp.broadcast_to(
+            self._scale_tril, diff.shape[:-1] + self._scale_tril.shape[-2:]
+        )
+        sol = jax.scipy.linalg.solve_triangular(Lb, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(jnp.square(sol), axis=-1)
+        logdet = 2.0 * jnp.sum(
+            jnp.log(jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)), axis=-1
+        )
+        return Tensor(-0.5 * (d * jnp.log(2.0 * jnp.pi) + logdet + maha))
+
+    def entropy(self):
+        from ..framework.core import Tensor
+
+        d = self._event_shape[0]
+        logdet = 2.0 * jnp.sum(
+            jnp.log(jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)), axis=-1
+        )
+        return Tensor(0.5 * (d * (1.0 + jnp.log(2.0 * jnp.pi)) + logdet))

@@ -58,6 +58,45 @@ def test_continuous_bernoulli_density_and_moments():
     assert np.isfinite(mid.log_prob(np.float32(0.25)).numpy()).all()
 
 
+def test_gradients_flow_into_parameters():
+    """The _track/_retrace contract: log_prob backprops into the ORIGINAL
+    parameter tensors (VAE/ELBO use case)."""
+    loc = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+    m = MultivariateNormal(loc, covariance_matrix=np.eye(3, dtype=np.float32))
+    m.log_prob(np.ones(3, np.float32)).sum().backward()
+    np.testing.assert_allclose(loc.grad.numpy(), np.ones(3))
+
+    df = paddle.to_tensor(np.float32(5.0), stop_gradient=False)
+    Chi2(df).log_prob(np.float32(3.0)).backward()
+    assert df.grad is not None and np.isfinite(df.grad.numpy()).all()
+
+    pr = paddle.to_tensor(np.float32(0.3), stop_gradient=False)
+    ContinuousBernoulli(pr).log_prob(np.float32(0.7)).backward()
+    assert pr.grad is not None and np.isfinite(pr.grad.numpy()).all()
+
+
+def test_mvn_batched_matrix():
+    covs = np.stack([np.eye(3, dtype=np.float32) * (i + 1) for i in range(5)])
+    mb = MultivariateNormal(np.zeros(3, np.float32), covariance_matrix=covs)
+    assert mb.batch_shape == [5]
+    paddle.seed(2)
+    assert list(np.asarray(mb.sample([7]).numpy()).shape) == [7, 5, 3]
+    lp = mb.log_prob(np.ones((5, 3), np.float32))
+    assert lp.shape == [5]
+    import scipy.stats as sst
+
+    for i in range(5):
+        np.testing.assert_allclose(
+            float(lp.numpy()[i]),
+            sst.multivariate_normal.logpdf(np.ones(3), np.zeros(3), covs[i]),
+            rtol=1e-4)
+
+
+def test_cb_mean_continuous_through_half():
+    m = float(ContinuousBernoulli(np.float32(0.4995)).mean.numpy())
+    assert abs(m - (0.5 + (0.4995 - 0.5) / 3)) < 1e-6  # Taylor, not a plateau
+
+
 def test_bilinear_initializer_stencil():
     from paddle_tpu.nn import initializer as I
 
