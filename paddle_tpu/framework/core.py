@@ -387,6 +387,88 @@ class Tensor:
         self._data = self._data * s
         return self
 
+    def _inplace_from(self, out):
+        self._data = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+        return self
+
+    def add_(self, y):
+        return self._inplace_from(self + y)
+
+    def subtract_(self, y):
+        return self._inplace_from(self - y)
+
+    def multiply_(self, y):
+        return self._inplace_from(self * y)
+
+    def clip_(self, min=None, max=None):
+        from ..tensor.math import clip
+
+        return self._inplace_from(clip(self, min, max))
+
+    def scatter_(self, index, updates, overwrite=True):
+        from ..tensor.manipulation import scatter
+
+        return self._inplace_from(scatter(self, index, updates, overwrite))
+
+    def masked_fill_(self, mask, value):
+        from ..tensor.manipulation import masked_fill
+
+        return self._inplace_from(masked_fill(self, mask, value))
+
+    def fill_diagonal_(self, value, offset=0, wrap=False):
+        n = min(self._data.shape[-2], self._data.shape[-1])
+        idx = jnp.arange(n)
+        self._data = self._data.at[..., idx, idx].set(value)
+        return self
+
+    def normal_(self, mean=0.0, std=1.0):
+        from . import random as prandom
+
+        self._data = (
+            mean + std * jax.random.normal(prandom.next_key(), self._data.shape)
+        ).astype(self.dtype)
+        return self
+
+    def uniform_(self, min=-1.0, max=1.0):
+        from . import random as prandom
+
+        self._data = jax.random.uniform(
+            prandom.next_key(), self._data.shape, minval=min, maxval=max
+        ).astype(self.dtype)
+        return self
+
+    def exponential_(self, lam=1.0):
+        from . import random as prandom
+
+        self._data = (
+            jax.random.exponential(prandom.next_key(), self._data.shape) / lam
+        ).astype(self.dtype)
+        return self
+
+    # -- torch-flavored trivia the reference also carries -------------------
+    @property
+    def mT(self):
+        from ..tensor.manipulation import transpose
+
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return transpose(self, perm)
+
+    def contiguous(self):
+        return self  # XLA arrays have no strided views
+
+    def is_contiguous(self):
+        return True
+
+    def element_size(self):
+        return int(jnp.dtype(self.dtype).itemsize)
+
+    def ndimension(self):
+        return self.ndim
+
+    def retain_grads(self):
+        return None  # non-leaf grads are already materialized by the tape
+
     def __setitem__(self, idx, value):
         idx = _index_data(idx)
         v = value._data if isinstance(value, Tensor) else value

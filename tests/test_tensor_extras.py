@@ -291,3 +291,41 @@ def test_enable_grad_context_and_decorator():
 
     with paddle.no_grad():
         assert inner()
+
+
+def test_inplace_method_family():
+    import paddle_tpu as paddle
+
+    t = paddle.to_tensor(np.ones((3, 3), np.float32))
+    t.add_(1.0)
+    assert t.numpy()[0, 0] == 2.0
+    t.clip_(0, 1.5)
+    assert t.numpy().max() == 1.5
+    t.masked_fill_(paddle.to_tensor(np.eye(3, dtype=bool)), 9.0)
+    assert t.numpy()[0, 0] == 9.0
+    t.fill_diagonal_(5.0)
+    assert t.numpy()[1, 1] == 5.0
+    paddle.seed(0)
+    t.normal_(0.0, 2.0)
+    assert np.isfinite(t.numpy()).all()
+    t.uniform_(0, 1)
+    assert (t.numpy() >= 0).all() and (t.numpy() <= 1).all()
+    t.exponential_(2.0)
+    assert (t.numpy() >= 0).all()
+    sc = paddle.to_tensor(np.zeros((4, 2), np.float32))
+    sc.scatter_(paddle.to_tensor(np.array([1, 3])),
+                paddle.to_tensor(np.ones((2, 2), np.float32)))
+    assert sc.numpy()[1, 0] == 1.0 and sc.numpy()[3, 1] == 1.0
+
+
+def test_torch_flavored_trivia():
+    import paddle_tpu as paddle
+
+    m = paddle.to_tensor(np.arange(6).reshape(2, 3).astype(np.float32))
+    assert m.mT.shape == [3, 2]
+    np.testing.assert_array_equal(m.mT.numpy(), m.numpy().T)
+    assert m.contiguous() is m
+    assert m.is_contiguous()
+    assert m.element_size() == 4
+    assert m.ndimension() == 2
+    m.retain_grads()  # no-op, must not raise
