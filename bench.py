@@ -11,6 +11,16 @@ process with a wall-clock budget, because an experimental TPU plugin can wedge
 *inside native code* during backend init — no in-process SIGALRM can interrupt
 that. On a rung timeout the backend is treated as wedged and we fall back to a
 CPU-forced rung so a JSON line is ALWAYS printed (parsed must never be null).
+
+Wedge-survival contract (VERDICT r4 item 1a): the observed failure mode is the
+remote compile helper dying ON THE FIRST BIG COMPILE and wedging the backend
+for the rest of the session (PROFILE.md r4 timeline: healthy 04:48, trivial
+matmul ok 04:49, dead 04:51 on rung 0). So the ladder now runs SMALLEST
+PROGRAM FIRST, banks every completed rung to BENCH_rungs.jsonl *as it
+completes*, and puts the differentiating kernel rungs (GQA/splash, decode,
+int8 decode) BEFORE the giant rung. A mid-ladder wedge therefore loses only
+the rungs not yet run — the final JSON line is selected from the banked
+results (largest successful training rung), never zeroed by a late wedge.
 """
 import json
 import os
@@ -254,93 +264,111 @@ def _run_rung(rung_idx, timeout_s, force_cpu=False):
 
 def _probe_backend():
     """Cheap child that just initializes the default jax backend. Returns
-    False if it hangs (wedged plugin) — saving the full rung-0 budget."""
+    (ok, backend_name) — ok=False if it hangs (wedged plugin), saving the
+    full rung budget."""
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
              "import jax; print(jax.default_backend(), len(jax.devices()))"],
             capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
         )
-        print(f"[bench] backend probe: {proc.stdout.strip()!r} rc={proc.returncode}",
+        out = proc.stdout.strip()
+        print(f"[bench] backend probe: {out!r} rc={proc.returncode}",
               file=sys.stderr, flush=True)
-        return proc.returncode == 0
+        backend = out.split()[0] if proc.returncode == 0 and out else None
+        return proc.returncode == 0, backend
     except subprocess.TimeoutExpired:
         print(f"[bench] backend probe hung >{PROBE_TIMEOUT_S}s — backend wedged",
               file=sys.stderr, flush=True)
-        return False
+        return False, None
+
+
+RUNGS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_rungs.jsonl")
+
+# Smallest-compile-first harvest order (VERDICT r4 item 1a). The kernel rungs
+# that differentiate the framework (splash GQA, KV-cache decode, int8 decode)
+# run BEFORE the big training compiles so they get on record before the
+# program most likely to kill the tunnel.
+HARVEST = [
+    ("tiny_h512", 5),
+    ("small_h1024", 4),
+    ("gqa_splash", -1),
+    ("decode", -2),
+    ("decode_int8", -3),
+    ("mid_b4_dots", 2),
+    ("big_b8_dots", 0),
+]
+# Only tried if the big rung fails WITHOUT a wedge (e.g. OOM): trade FLOPs or
+# batch for memory.
+MEM_FALLBACKS = [("big_b8_full", 3), ("mid_b4_none", 1)]
+# Final reported training rung: largest/preferred first.
+PREFERENCE = [0, 3, 2, 1, 4, 5]
+
+
+def _timeout_for(idx):
+    if idx == -1:
+        return GQA_RUNG_TIMEOUT_S
+    if idx in (-2, -3):
+        return DECODE_RUNG_TIMEOUT_S
+    return RUNG_TIMEOUT_S[idx]
+
+
+def _bank(name, result):
+    """Persist one completed rung to BENCH_rungs.jsonl IMMEDIATELY — a
+    mid-ladder wedge must not lose rungs that already ran."""
+    rec = {"rung": name, "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    rec.update(result or {"error": "no output"})
+    with open(RUNGS_PATH, "a") as f:
+        f.write(json.dumps(rec) + "\n")
 
 
 def main():
     errors = []
-    res = None
-    wedged = not _probe_backend()
+    banked = {}  # ladder idx -> successful result
+    ok, backend = _probe_backend()
+    wedged = not ok
     if wedged:
         errors.append(f"backend probe hung >{PROBE_TIMEOUT_S}s")
-    last = len(LADDER) - 1
-    for i in range(len(LADDER) if not wedged else 0):
-        print(f"[bench] rung {i}: {LADDER[i]}", file=sys.stderr, flush=True)
-        out, timed_out = _run_rung(i, RUNG_TIMEOUT_S[i])
-        if timed_out:
-            errors.append(f"rung{i}: timeout>{RUNG_TIMEOUT_S[i]}s (backend wedged?)")
-            if i < last:
-                # the compile helper has been observed to die on LARGE
-                # programs specifically (PROFILE.md r4 timeline) — try the
-                # smallest rung before surrendering to CPU: a small real-TPU
-                # number beats a CPU fallback
-                print(f"[bench] big-rung timeout — trying smallest rung {last}",
-                      file=sys.stderr, flush=True)
-                out, timed_out = _run_rung(last, RUNG_TIMEOUT_S[last])
-                if not timed_out and out is not None and "error" not in out:
-                    res = out
-                    res.setdefault("extra", {})["note"] = (
-                        f"smallest-rung fallback after: {'; '.join(errors)}"
-                    )
-                    break
-                errors.append(
-                    f"rung{last}: timeout" if timed_out
-                    else f"rung{last}: {(out or {}).get('error', 'unknown')[:160]}"
-                )
-            wedged = True
-            break  # backend wedged for small programs too — CPU fallback
-        if out is not None and "error" not in out:
-            res = out
-            if i:
-                res.setdefault("extra", {})["note"] = f"ladder rung {i} after: {'; '.join(errors)}"
+    else:
+        # On CPU every training rung collapses to the same smoke profile —
+        # run one of each kind instead of six identical smokes.
+        harvest = HARVEST if backend == "tpu" else [
+            ("tiny_h512", 5), ("gqa_splash", -1), ("decode", -2)]
+        for name, idx in harvest:
+            print(f"[bench] rung {name} (idx {idx})", file=sys.stderr, flush=True)
+            out, timed_out = _run_rung(idx, _timeout_for(idx))
+            if timed_out:
+                errors.append(f"{name}: timeout>{_timeout_for(idx)}s — wedged; ladder stopped")
+                _bank(name, {"error": f"timeout>{_timeout_for(idx)}s"})
+                wedged = True
+                break  # later rungs are bigger compiles; keep what's banked
+            _bank(name, out)
+            if out is not None and "error" not in out:
+                banked[idx] = out
+                continue
+            errors.append(f"{name}: {(out or {}).get('error', 'unknown')[:160]}")
+            if idx == 0:  # big rung failed w/o wedge (likely OOM) — memory ladder
+                for fname, fidx in MEM_FALLBACKS:
+                    print(f"[bench] mem fallback {fname}", file=sys.stderr, flush=True)
+                    fout, ft = _run_rung(fidx, _timeout_for(fidx))
+                    if ft:
+                        errors.append(f"{fname}: timeout — wedged")
+                        _bank(fname, {"error": "timeout"})
+                        wedged = True
+                        break
+                    _bank(fname, fout)
+                    if fout is not None and "error" not in fout:
+                        banked[fidx] = fout
+                        break
+                    errors.append(f"{fname}: {(fout or {}).get('error', 'unknown')[:160]}")
+    # primary = largest successful training rung among what got banked
+    res = None
+    for idx in PREFERENCE:
+        if idx in banked:
+            res = banked[idx]
             break
-        errors.append(f"rung{i}: {out.get('error', 'unknown')[:160]}")
-    if res is not None and not wedged:
-        # GQA/splash rung on record (VERDICT r3 item 8) — additional, never
-        # replaces the primary number
-        print(f"[bench] gqa rung: {GQA_RUNG}", file=sys.stderr, flush=True)
-        gqa, gqa_timeout = _run_rung(-1, GQA_RUNG_TIMEOUT_S)
-        if gqa is not None and "error" not in gqa:
-            res.setdefault("extra", {})["gqa"] = {
-                "tokens_per_sec": gqa["value"],
-                "mfu": gqa.get("extra", {}).get("mfu"),
-                "attn_impl": gqa.get("extra", {}).get("attn_impl"),
-                "config": gqa.get("extra", {}).get("config"),
-            }
-        else:
-            res.setdefault("extra", {})["gqa"] = {
-                "error": "timeout" if gqa_timeout else str((gqa or {}).get("error"))[:160]
-            }
-        # decode/serving rung (VERDICT r3 weak #7: the KV-cache decode path
-        # must appear in a driver-visible perf artifact)
-        print("[bench] decode rung", file=sys.stderr, flush=True)
-        dec, dec_timeout = _run_rung(-2, DECODE_RUNG_TIMEOUT_S)
-        if dec is not None and "error" not in dec:
-            res.setdefault("extra", {})["decode"] = {
-                "tokens_per_sec": dec["value"],
-                "config": dec.get("extra", {}).get("config"),
-            }
-            # int8 weight-only variant: the bandwidth-bound comparison point
-            di, _ = _run_rung(-3, DECODE_RUNG_TIMEOUT_S)
-            if di is not None and "error" not in di:
-                res["extra"]["decode"]["int8_tokens_per_sec"] = di["value"]
-        else:
-            res.setdefault("extra", {})["decode"] = {
-                "error": "timeout" if dec_timeout else str((dec or {}).get("error"))[:160]
-            }
+    if res is not None and errors:
+        res.setdefault("extra", {})["note"] = "; ".join(errors)[:400]
     if res is None:
         print("[bench] falling back to CPU-forced rung", file=sys.stderr, flush=True)
         # smallest rung: the CPU smoke profile shares its shape, and
@@ -349,12 +377,14 @@ def main():
         if not timed_out and out is not None and "error" not in out:
             res = out
             res.setdefault("extra", {})["note"] = (
-                ("tpu backend wedged; " if wedged else "") + f"cpu fallback after: {'; '.join(errors)}"
+                ("tpu backend wedged; " if wedged else "")
+                + f"cpu fallback after: {'; '.join(errors)}"
             )
+            _bank("cpu_fallback", out)
         elif timed_out:
             errors.append(f"cpu fallback: timeout>{CPU_FALLBACK_TIMEOUT_S}s")
         else:
-            errors.append(f"cpu fallback: {out.get('error', 'unknown')[:160]}")
+            errors.append(f"cpu fallback: {(out or {}).get('error', 'unknown')[:160]}")
     if res is None:
         res = {
             "metric": "tokens_per_sec_per_chip_llama_proxy",
@@ -363,6 +393,25 @@ def main():
             "vs_baseline": 0.0,
             "error": " | ".join(errors),
         }
+    # kernel-rung results attach to WHATEVER final line ships (incl. the CPU
+    # fallback): real-TPU splash/decode numbers must reach the driver artifact
+    # even when every training rung failed
+    if -1 in banked:
+        g = banked[-1]
+        res.setdefault("extra", {})["gqa"] = {
+            "tokens_per_sec": g["value"],
+            "mfu": g.get("extra", {}).get("mfu"),
+            "attn_impl": g.get("extra", {}).get("attn_impl"),
+            "config": g.get("extra", {}).get("config"),
+        }
+    if -2 in banked:
+        d = banked[-2]
+        res.setdefault("extra", {})["decode"] = {
+            "tokens_per_sec": d["value"],
+            "config": d.get("extra", {}).get("config"),
+        }
+        if -3 in banked:
+            res["extra"]["decode"]["int8_tokens_per_sec"] = banked[-3]["value"]
     print(json.dumps(res), flush=True)
 
 

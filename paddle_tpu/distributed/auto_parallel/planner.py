@@ -39,6 +39,102 @@ COLL_LATENCY_S = 5e-6
 DCN_BW_DEFAULT = 2.5e10
 DCN_LATENCY_S = 5e-5
 
+# Mutable cost-model constants, refittable from measured bench rungs
+# (reference: auto_parallel/static/cluster.py reads measured cluster specs;
+# here `calibrate_from_bench` fits them from BENCH_rungs.jsonl instead).
+# compute_efficiency is the measured MFU of the best real-TPU training rung:
+# the planner's compute term uses achievable FLOP/s, not datasheet peak, so
+# the compute/communication tradeoff reflects this chip as measured.
+CALIBRATION = {
+    "peak_flops": 197e12,  # bf16 FLOP/s per chip (v5e datasheet)
+    "ici_bw": 4e11,  # v5e aggregate per-chip ICI ≈ 400 GB/s
+    "compute_efficiency": 1.0,
+    "source": None,
+}
+
+
+def calibrate(records):
+    """Fit CALIBRATION from bench result dicts (rows of BENCH_rungs.jsonl
+    and/or a BENCH_r*.json top-level dict). Uses the best real-TPU training
+    rung's measured MFU as the achievable-compute efficiency. Returns the
+    updated CALIBRATION, or None if no TPU evidence exists (constants kept)."""
+    best = None
+    for r in records:
+        if not isinstance(r, dict):
+            continue
+        extra = r.get("extra") or {}
+        mfu = extra.get("mfu")
+        if extra.get("backend") == "tpu" and isinstance(mfu, (int, float)) and mfu > 0:
+            if best is None or mfu > best[0]:
+                best = (float(mfu), extra.get("config"))
+    if best is None:
+        return None
+    CALIBRATION["compute_efficiency"] = best[0]
+    CALIBRATION["source"] = best[1]
+    return dict(CALIBRATION)
+
+
+def calibrate_from_bench(path, save_path=None):
+    """Load a bench artifact (JSONL of rungs, or a single-JSON BENCH_r*.json
+    — possibly pretty-printed) and refit the cost-model constants. With
+    `save_path`, persist the fitted constants as JSON so other processes can
+    pick them up via `load_calibration` (or the PADDLE_TPU_CALIBRATION env
+    var at import). Returns the updated CALIBRATION or None."""
+    import json
+    import os
+
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        text = f.read().strip()
+    records = []
+    try:
+        # whole-file parse first: BENCH_r*.json artifacts are pretty-printed
+        whole = json.loads(text)
+        records = whole if isinstance(whole, list) else [whole]
+    except json.JSONDecodeError:
+        for line in text.splitlines():
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    fitted = calibrate(records)
+    if fitted is not None and save_path:
+        with open(save_path, "w") as f:
+            json.dump(fitted, f, indent=1)
+    return fitted
+
+
+def load_calibration(path):
+    """Adopt previously fitted constants (calibrate_from_bench save_path).
+    Returns the updated CALIBRATION, or None if the file is absent/invalid."""
+    import json
+    import os
+
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return None
+    for k in ("peak_flops", "ici_bw", "compute_efficiency"):
+        if isinstance(data.get(k), (int, float)) and data[k] > 0:
+            CALIBRATION[k] = float(data[k])
+    CALIBRATION["source"] = data.get("source")
+    return dict(CALIBRATION)
+
+
+def _autoload_calibration():
+    import os
+
+    p = os.environ.get("PADDLE_TPU_CALIBRATION")
+    if p:
+        load_calibration(p)
+
+
+_autoload_calibration()
+
 
 @dataclasses.dataclass
 class Plan:
@@ -89,6 +185,7 @@ def plan_mesh(
     min_axes=None,
     n_slices=1,
     dcn_bw=DCN_BW_DEFAULT,
+    vocab_size=None,
 ):
     """Pick (dp, mp, pp, sharding) for `n_params` on `n_devices` chips.
 
@@ -103,7 +200,7 @@ def plan_mesh(
         n_params, n_devices, seq_len=seq_len, batch_per_device=batch_per_device,
         hidden_size=hidden_size, num_layers=num_layers, hbm_bytes=hbm_bytes,
         max_mp=max_mp, dtype_bytes=dtype_bytes, min_axes=min_axes,
-        n_slices=n_slices, dcn_bw=dcn_bw,
+        n_slices=n_slices, dcn_bw=dcn_bw, vocab_size=vocab_size,
     )
     if not cands:
         raise ValueError(
@@ -126,6 +223,7 @@ def enumerate_plans(
     min_axes=None,
     n_slices=1,
     dcn_bw=DCN_BW_DEFAULT,
+    vocab_size=None,
 ):
     """All memory-feasible Plans, best modeled cost first (the candidate
     ladder the ProfilingTuner measures — reference: tuner/ enumerating
@@ -181,8 +279,9 @@ def enumerate_plans(
 
             # ---- per-step cost in SECONDS: comm bytes / ICI bandwidth,
             # bubble and per-tick latency charged against the step
-            ICI_BW = 4e11  # v5e aggregate per-chip ICI ≈ 400 GB/s
-            PEAK = 197e12  # bf16 FLOP/s per chip
+            ICI_BW = CALIBRATION["ici_bw"]
+            # achievable (not datasheet) FLOP/s: datasheet peak × measured MFU
+            PEAK = CALIBRATION["peak_flops"] * CALIBRATION["compute_efficiency"]
             tokens = B * seq_len
             compute_s = 6.0 * n_params * tokens / (n_devices * n_slices * PEAK)
             P = n_params * dtype_bytes
@@ -220,9 +319,21 @@ def enumerate_plans(
                 ticks = 2.0 * (n_micro + pp - 1)
                 cost += ticks * TICK_LATENCY_S
                 # bubble as lost compute: (pp−1)/(M + pp − 1) of the step,
-                # plus a 2%/stage imbalance tax (last stage carries the head)
+                # plus the tail-imbalance tax: the last stage's fused
+                # B_LAST tick costs bwd+head while peers' steady tick costs
+                # fwd+bwd; in forward-units (fwd=1, bwd=3) the lockstep
+                # gate pays max(0, 3·head_ratio − 1)/4 of compute on steady
+                # ticks (pipeline_schedules.Schedule.tick_flops model).
+                # Falls back to 2%/stage when vocab (head size) is unknown.
                 bubble = (pp - 1) / (n_micro + pp - 1.0)
-                cost += (bubble + 0.02 * (pp - 1)) * compute_s
+                if vocab_size is not None and pp > 1:
+                    layers_per_stage = max(num_layers / pp, 1e-9)
+                    stage_fwd = layers_per_stage * 12.0 * hidden_size * hidden_size
+                    head_ratio = 2.0 * hidden_size * vocab_size / stage_fwd
+                    imbalance_tax = max(0.0, (3.0 * head_ratio - 1.0) / 4.0)
+                else:
+                    imbalance_tax = 0.02 * (pp - 1)
+                cost += (bubble + imbalance_tax) * compute_s
             candidates.append(
                 Plan(dp, mp, pp, sh, cost, mem,
                      reason=f"mem {mem / 1e9:.1f}GB of {hbm_bytes / 1e9:.0f}GB, "
@@ -251,6 +362,7 @@ def plan_for_model(model, n_devices=None, seq_len=None, batch_per_device=1, **kw
     hid = getattr(cfg, "hidden_size", None)
     layers = getattr(cfg, "num_hidden_layers", None)
     seq = seq_len or getattr(cfg, "seq_length", 2048)
+    kw.setdefault("vocab_size", getattr(cfg, "vocab_size", None))
     return plan_mesh(n_params, n_devices, seq_len=seq, batch_per_device=batch_per_device,
                      hidden_size=hid, num_layers=layers, **kw)
 

@@ -84,9 +84,11 @@ class ProfilingTuner:
                 sharding_stage=plan.sharding_stage,
                 accumulate_steps=plan.accumulate_steps,
             )
+            loss = None
             for _ in range(self.warmup):
                 loss = step(*batch)
-            float(loss.numpy())  # sync compile + warmup
+            if loss is not None:
+                float(loss.numpy())  # sync compile + warmup
             t0 = time.perf_counter()
             for _ in range(self.steps):
                 loss = step(*batch)

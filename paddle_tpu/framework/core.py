@@ -416,9 +416,22 @@ class Tensor:
         return self._inplace_from(masked_fill(self, mask, value))
 
     def fill_diagonal_(self, value, offset=0, wrap=False):
-        n = min(self._data.shape[-2], self._data.shape[-1])
+        rows, cols = self._data.shape[-2], self._data.shape[-1]
+        if wrap and self._data.ndim == 2 and rows > cols:
+            # torch-style wrap: the diagonal restarts every cols+1 rows;
+            # same (i, i+offset) convention as the non-wrap branch
+            r = jnp.arange(rows)
+            c = (r + offset) % (cols + 1)
+            on = c < cols
+            self._data = self._data.at[r[on], c[on]].set(value)
+            return self
+        # offset >= 0: (i, i+offset); offset < 0: (i-offset, i)
+        n = min(rows, cols - offset) if offset >= 0 else min(rows + offset, cols)
+        if n <= 0:
+            return self
         idx = jnp.arange(n)
-        self._data = self._data.at[..., idx, idx].set(value)
+        ri, ci = (idx, idx + offset) if offset >= 0 else (idx - offset, idx)
+        self._data = self._data.at[..., ri, ci].set(value)
         return self
 
     def normal_(self, mean=0.0, std=1.0):

@@ -172,7 +172,8 @@ class GenerationMixin:
         S0b = prompt_bucket(int(lens.max()))
         aligned = np.full((B, S0b), pad_token_id, np.int32)
         for r in range(B):
-            aligned[r, S0b - lens[r]:] = ids[r, :lens[r]]
+            # gather by mask, not prefix-slice: callers pad on either side
+            aligned[r, S0b - lens[r]:] = ids[r][mask[r].astype(bool)]
         pad_lens = (S0b - lens).astype(np.int32)
 
         key = ("ragged", B, S0b, max_new_tokens, do_sample, float(temperature),

@@ -173,8 +173,6 @@ def convert_not(x):
     return not x
 
 
-_CALL_CACHE = {}
-
 # framework/library code is already traceable — converting it is at best a
 # waste and at worst wrong (their source may rely on module-local state the
 # re-exec'd copy does not see). Only USER functions convert.
@@ -205,15 +203,16 @@ def convert_call(fn):
     if (is_ignored(fn) or root in _FRAMEWORK_ROOTS
             or root in getattr(sys, "stdlib_module_names", ())):
         return fn
-    key = id(fn)
-    hit = _CALL_CACHE.get(key)
-    if hit is not None and hit[0] is fn:
-        return hit[1]
+    # cache ON the function object: no global table keeping every converted
+    # closure alive forever, and id-reuse after GC can't alias entries
+    hit = fn.__dict__.get("__dy2static_converted__")
+    if hit is not None:
+        return hit
     try:
         converted = convert_control_flow(fn)
     except Exception:
         converted = fn
-    _CALL_CACHE[key] = (fn, converted)
+    fn.__dy2static_converted__ = converted
     return converted
 
 

@@ -1,0 +1,78 @@
+"""Capture an on-TPU xprof trace of one small training rung (VERDICT r4
+missing #6: the jax.profiler integration exists but no TPU trace has ever
+been banked). Run only when the backend is healthy — tpu_watch invokes it as
+part of its recovery action, AFTER the bench ladder has banked its rungs.
+
+Writes the trace under xprof_traces/<backend>/ and prints one JSON line with
+the artifact path so the watch log records it.
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit_api import TrainStep
+    from paddle_tpu.models.llama import (
+        LlamaConfig,
+        LlamaForCausalLM,
+        LlamaPretrainingCriterion,
+    )
+    import numpy as np
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    # small-but-real shape: big enough that the MXU/fusion story is visible in
+    # the trace, small enough to stay under the compile-helper kill threshold
+    if on_tpu:
+        hidden, layers, heads, inter, vocab, seq, batch = 1024, 8, 16, 2816, 32000, 1024, 8
+    else:
+        hidden, layers, heads, inter, vocab, seq, batch = 256, 2, 4, 512, 1024, 256, 2
+
+    paddle.seed(0)
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+        num_hidden_layers=layers, num_attention_heads=heads,
+        max_position_embeddings=seq, dtype="bfloat16",
+        fuse_linear_cross_entropy=True,
+    )
+    model = LlamaForCausalLM(cfg)
+    model.bfloat16()
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    step = TrainStep(model, lambda *a: LlamaPretrainingCriterion()(*a), opt)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (batch, seq + 1)).astype(np.int32)
+    x, y = paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
+
+    for _ in range(2):  # compile + warm OUTSIDE the trace
+        loss = step(x, y)
+    float(loss.numpy())
+
+    logdir = os.path.join(REPO, "xprof_traces", backend,
+                          time.strftime("%Y%m%dT%H%M%S"))
+    os.makedirs(logdir, exist_ok=True)
+    with jax.profiler.trace(logdir):
+        for _ in range(5):
+            loss = step(x, y)
+        float(loss.numpy())  # sync inside the trace window
+
+    n_files = sum(len(fs) for _, _, fs in os.walk(logdir))
+    print(json.dumps({
+        "artifact": os.path.relpath(logdir, REPO),
+        "backend": backend,
+        "files": n_files,
+        "final_loss": round(float(loss.numpy()), 4),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

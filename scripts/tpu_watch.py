@@ -1,11 +1,19 @@
-"""TPU health watcher (VERDICT r3 item 2c: "run it whenever the backend
-answers — a probe loop retried across the round, not one attempt at the end").
+"""TPU health watcher (VERDICT r4 item 1b: "keep tpu_watch probing all round;
+its recovery action should run, in order: the TPU-marked test tier, the
+inverted ladder, an xprof trace capture of one rung, and planner-constant
+recalibration").
 
 Loops forever: every PERIOD seconds, probe the backend with a trivial compile
 in a child process (a wedged axon plugin hangs inside native code, so only a
-subprocess timeout can bound it). On a healthy probe, run the bench ladder
-rung 0 and the GQA rung, appending JSON results + timestamps to the log.
-Everything is timestamped so PROFILE.md can cite the health timeline.
+subprocess timeout can bound it — see memory/PROFILE.md). Every probe is
+appended to PROBE_r05.jsonl in the repo so the round carries a committed
+timeline proving backend state whether or not it ever answers.
+
+On the FIRST healthy probe the recovery pipeline runs:
+  1. `scripts/ci.sh --tpu`      — the 12 TPU-marked tests (splash/varlen/GQA)
+  2. `python bench.py`          — inverted ladder; banks each rung to BENCH_rungs.jsonl
+  3. `scripts/capture_trace.py` — xprof artifact of one small rung
+  4. planner recalibration      — fit cost-model constants from banked rungs
 
 Usage: nohup python scripts/tpu_watch.py >> /tmp/tpu_watch.log 2>&1 &
 """
@@ -17,8 +25,8 @@ import time
 
 PERIOD_S = 360
 PROBE_TIMEOUT_S = 75
-RUNG_TIMEOUT_S = 1500
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_LOG = os.path.join(REPO, "PROBE_r05.jsonl")
 
 PROBE = (
     "import jax, jax.numpy as jnp;"
@@ -26,9 +34,32 @@ PROBE = (
     "print('probe-ok', jax.jit(lambda x: (x@x).sum())(x), jax.devices()[0].platform)"
 )
 
+# (label, argv, timeout_s) — the recovery pipeline, smallest risk first.
+# TPU tier only (not the 20-min CPU suite): the healthy window is precious
+# and the default tier runs in every ci.sh gate anyway.
+RECOVERY = [
+    # PADDLE_TPU_TEST_PLATFORM=tpu keeps conftest.py from forcing the
+    # CPU/virtual-mesh platform so the tpu-marked tests see the real chip
+    ("tpu-tests", [sys.executable, "-m", "pytest", "tests/", "-q",
+                   "-p", "no:cacheprovider", "-m", "tpu"], 1800),
+    ("bench-ladder", [sys.executable, os.path.join(REPO, "bench.py")], 4800),
+    ("xprof-trace", [sys.executable, os.path.join(REPO, "scripts", "capture_trace.py")], 900),
+    ("planner-calibrate",
+     [sys.executable, "-c",
+      "from paddle_tpu.distributed.auto_parallel.planner import calibrate_from_bench;"
+      "print(calibrate_from_bench('BENCH_rungs.jsonl', save_path='CALIBRATION.json'))"],
+     300),
+]
+
 
 def log(msg):
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def bank_probe(ok, detail):
+    rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), "ok": ok, "detail": detail[:160]}
+    with open(PROBE_LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
 
 
 def probe():
@@ -36,55 +67,77 @@ def probe():
         p = subprocess.run([sys.executable, "-c", PROBE], capture_output=True,
                            text=True, timeout=PROBE_TIMEOUT_S)
         ok = p.returncode == 0 and "probe-ok" in p.stdout and "tpu" in p.stdout
-        log(f"probe rc={p.returncode} out={p.stdout.strip()[:80]!r}"
-            + (f" err={p.stderr.strip()[-120:]!r}" if p.returncode else ""))
+        detail = f"rc={p.returncode} out={p.stdout.strip()[:80]!r}"
+        log(f"probe {detail}" + (f" err={p.stderr.strip()[-120:]!r}" if p.returncode else ""))
+        bank_probe(ok, detail)
         return ok
     except subprocess.TimeoutExpired:
         log(f"probe TIMEOUT>{PROBE_TIMEOUT_S}s (wedged)")
+        bank_probe(False, f"timeout>{PROBE_TIMEOUT_S}s")
         return False
 
 
-def run_rung(idx):
-    t0 = time.time()
+def _tpu_rungs_banked(since_byte):
+    """True if BENCH_rungs.jsonl gained a successful real-TPU rung past the
+    given byte offset — bench.py always exits 0 (JSON-always contract), so
+    its exit code can NOT distinguish a real harvest from a CPU fallback."""
+    path = os.path.join(REPO, "BENCH_rungs.jsonl")
     try:
-        p = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py"), "--rung", str(idx)],
-            capture_output=True, text=True, timeout=RUNG_TIMEOUT_S, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        log(f"rung {idx}: TIMEOUT>{RUNG_TIMEOUT_S}s")
-        return None
-    dt = time.time() - t0
-    for line in reversed((p.stdout or "").strip().splitlines()):
+        with open(path) as f:
+            f.seek(since_byte)
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                extra = rec.get("extra") or {}
+                if "error" not in rec and extra.get("backend") == "tpu":
+                    return True
+    except OSError:
+        pass
+    return False
+
+
+def run_recovery():
+    """The backend answered — harvest everything, cheapest-compile first.
+    Each step is a bounded child; one step failing doesn't stop the next
+    (a mid-pipeline wedge must not lose the remaining cheap artifacts).
+    Returns True only when a REAL TPU rung got banked — a wedged/CPU-fallback
+    pass must leave the watcher retrying on later healthy probes."""
+    rungs_path = os.path.join(REPO, "BENCH_rungs.jsonl")
+    start_byte = os.path.getsize(rungs_path) if os.path.exists(rungs_path) else 0
+    for label, argv, timeout_s in RECOVERY:
+        t0 = time.time()
+        log(f"recovery step {label}: {' '.join(argv[:3])}...")
+        env = dict(os.environ)
+        if label == "tpu-tests":
+            env["PADDLE_TPU_TEST_PLATFORM"] = "tpu"
         try:
-            res = json.loads(line)
-            log(f"rung {idx} ({dt:.0f}s): {json.dumps(res)}")
-            return res if "error" not in res else None
-        except json.JSONDecodeError:
-            continue
-    log(f"rung {idx}: rc={p.returncode} no JSON; stderr tail: {(p.stderr or '')[-200:]!r}")
-    return None
+            p = subprocess.run(argv, capture_output=True, text=True,
+                               timeout=timeout_s, cwd=REPO, env=env)
+            tail = (p.stdout or "").strip().splitlines()[-3:]
+            log(f"{label} rc={p.returncode} ({time.time()-t0:.0f}s) tail={tail!r}")
+            if p.returncode != 0:
+                log(f"{label} stderr tail: {(p.stderr or '')[-300:]!r}")
+        except subprocess.TimeoutExpired:
+            log(f"{label}: TIMEOUT>{timeout_s}s — continuing pipeline")
+    return _tpu_rungs_banked(start_byte)
 
 
 def main():
-    log(f"tpu_watch start pid={os.getpid()} period={PERIOD_S}s")
-    best = None
+    log(f"tpu_watch start pid={os.getpid()} period={PERIOD_S}s probe_log={PROBE_LOG}")
+    harvested = False
     while True:
         if probe():
-            # SMALLEST programs first: the observed failure mode is the
-            # compile helper dying on a big program and wedging everything
-            # after — harvest maximum evidence before risking the big rung
-            log("backend HEALTHY — harvesting smallest-first")
-            for idx in (5, 4, -2, -1, 2, 0):
-                res = run_rung(idx)
-                if res is None:
-                    log(f"rung {idx} failed — stopping this harvest pass")
-                    break
-                mfu = res.get("extra", {}).get("mfu")
-                if mfu is not None and (best is None or mfu > best):
-                    best = mfu
-                    with open("/tmp/tpu_bench_best.json", "w") as f:
-                        json.dump(res, f)
-                    log(f"new best mfu={mfu} -> /tmp/tpu_bench_best.json")
+            if harvested:
+                # the full harvest already banked; keep probing (the PROBE log
+                # is the round's health timeline) but don't re-run the
+                # pipeline — each pass ends in the big compile most likely to
+                # re-wedge the backend
+                log("backend healthy — harvest already banked, probe only")
+            else:
+                log("backend HEALTHY — running recovery pipeline")
+                harvested = run_recovery()
         time.sleep(PERIOD_S)
 
 

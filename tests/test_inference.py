@@ -184,6 +184,29 @@ class TestRaggedBatchGenerate:
         m.eval()
         self._ragged(m, 128, 5, 9, 5)
 
+    def test_left_padded_mask_matches_right_padded(self):
+        """Callers pad on either side: the prompt must be gathered by the
+        mask, not prefix-sliced (ADVICE r4)."""
+        paddle.seed(21)
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        m = LlamaForCausalLM(llama_tiny(num_hidden_layers=2))
+        m.eval()
+        rng = np.random.RandomState(11)
+        V, l0, l1, new = 128, 5, 9, 4
+        r0 = rng.randint(0, V, (l0,)).astype(np.int32)
+        r1 = rng.randint(0, V, (l1,)).astype(np.int32)
+        S = max(l0, l1)
+        ids = np.zeros((2, S), np.int32)
+        mask = np.zeros((2, S), np.int32)
+        ids[0, S - l0:], ids[1, S - l1:] = r0, r1  # LEFT padded
+        mask[0, S - l0:], mask[1, S - l1:] = 1, 1
+        out = m.generate(ids, max_new_tokens=new, attention_mask=mask).numpy()
+        ref0 = m.generate(r0[None], max_new_tokens=new).numpy()[0, l0:]
+        ref1 = m.generate(r1[None], max_new_tokens=new).numpy()[0, l1:]
+        assert (out[0, S:] == ref0).all(), (out[0, S:], ref0)
+        assert (out[1, S:] == ref1).all(), (out[1, S:], ref1)
+
     def test_gpt_rows_match_single(self):
         paddle.seed(18)
         from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny

@@ -305,6 +305,26 @@ def test_inplace_method_family():
     assert t.numpy()[0, 0] == 9.0
     t.fill_diagonal_(5.0)
     assert t.numpy()[1, 1] == 5.0
+    # offset / wrap honor the torch semantics (oracle: np.fill_diagonal
+    # equivalents), not silently ignore the args
+    for off in (-2, -1, 0, 1, 2):
+        a = paddle.to_tensor(np.zeros((4, 5), np.float32))
+        a.fill_diagonal_(7.0, offset=off)
+        want = np.zeros((4, 5), np.float32)
+        ii = np.arange(4)[:, None]
+        jj = np.arange(5)[None, :]
+        want[jj == ii + off] = 7.0
+        np.testing.assert_array_equal(a.numpy(), want)
+    for off in (0, 1, -1):
+        w = paddle.to_tensor(np.zeros((7, 3), np.float32))
+        w.fill_diagonal_(4.0, offset=off, wrap=True)
+        tw = np.zeros((7, 3), np.float32)
+        r = np.arange(7)
+        # wrap keeps the (i, i+offset) convention, restarting every cols+1 rows
+        c = (r + off) % 4
+        on = c < 3
+        tw[r[on], c[on]] = 4.0
+        np.testing.assert_array_equal(w.numpy(), tw), off
     paddle.seed(0)
     t.normal_(0.0, 2.0)
     assert np.isfinite(t.numpy()).all()

@@ -55,6 +55,12 @@ class TestProfilingTuner:
         tuner = ProfilingTuner(model, _loss, lambda: optimizer.AdamW(
             learning_rate=1e-3, parameters=model.parameters()), steps=2, warmup=1)
         res = tuner.tune((x, y), top_k=3)
+        # warmup=0 is a settable config value and must not unbind the sync
+        # variable (ADVICE r4): trials still measure
+        t0 = ProfilingTuner(model, _loss, lambda: optimizer.AdamW(
+            learning_rate=1e-3, parameters=model.parameters()), steps=1, warmup=0)
+        res0 = t0.tune((x, y), top_k=1)
+        assert any(r.measured_s is not None for r in res0.records), res0.summary()
         ok = [r for r in res.records if r.measured_s is not None]
         assert len(ok) >= 2, res.summary()
         assert all(r.measured_s > 0 for r in ok)
