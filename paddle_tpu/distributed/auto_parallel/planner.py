@@ -328,7 +328,10 @@ def enumerate_plans(
                 bubble = (pp - 1) / (n_micro + pp - 1.0)
                 if vocab_size is not None and pp > 1:
                     layers_per_stage = max(num_layers / pp, 1e-9)
-                    stage_fwd = layers_per_stage * 12.0 * hidden_size * hidden_size
+                    # FLOP units on both sides: fwd flops/token ≈ 2×params,
+                    # per-layer params ≈ 12h² → 24h² flops/layer; head
+                    # matmul = 2·h·vocab flops/token
+                    stage_fwd = layers_per_stage * 24.0 * hidden_size * hidden_size
                     head_ratio = 2.0 * hidden_size * vocab_size / stage_fwd
                     imbalance_tax = max(0.0, (3.0 * head_ratio - 1.0) / 4.0)
                 else:

@@ -77,10 +77,17 @@ def probe():
         return False
 
 
-def _tpu_rungs_banked(since_byte):
-    """True if BENCH_rungs.jsonl gained a successful real-TPU rung past the
-    given byte offset — bench.py always exits 0 (JSON-always contract), so
-    its exit code can NOT distinguish a real harvest from a CPU fallback."""
+# the ladder runs these LAST (bench.py HARVEST order), so a successful TPU
+# row for any of them proves every earlier rung (tiny/small/gqa/decode/int8)
+# already ran — the latch condition for "harvest complete"
+_FINAL_RUNGS = ("big_b8_dots", "big_b8_full", "mid_b4_none")
+
+
+def _tpu_harvest_complete(since_byte):
+    """True only if the ladder reached its FINAL training rung on the real
+    chip past the given byte offset. bench.py always exits 0 (JSON-always
+    contract) and a partial harvest (tiny rung banked, then wedge) must NOT
+    latch — later healthy probes should retry the remaining rungs."""
     path = os.path.join(REPO, "BENCH_rungs.jsonl")
     try:
         with open(path) as f:
@@ -91,7 +98,8 @@ def _tpu_rungs_banked(since_byte):
                 except json.JSONDecodeError:
                     continue
                 extra = rec.get("extra") or {}
-                if "error" not in rec and extra.get("backend") == "tpu":
+                if ("error" not in rec and extra.get("backend") == "tpu"
+                        and rec.get("rung") in _FINAL_RUNGS):
                     return True
     except OSError:
         pass
@@ -121,7 +129,7 @@ def run_recovery():
                 log(f"{label} stderr tail: {(p.stderr or '')[-300:]!r}")
         except subprocess.TimeoutExpired:
             log(f"{label}: TIMEOUT>{timeout_s}s — continuing pipeline")
-    return _tpu_rungs_banked(start_byte)
+    return _tpu_harvest_complete(start_byte)
 
 
 def main():

@@ -29,15 +29,19 @@ def seq_mesh(n=4):
     return Mesh(np.asarray(jax.devices()[:n]), ("sep",))
 
 
+@pytest.mark.parametrize("block_k", [None, 2, 4])
 @pytest.mark.parametrize("causal", [False, True])
-def test_ring_attention_matches_full(causal):
+def test_ring_attention_matches_full(causal, block_k):
+    # block_k=2/4 forces multiple KV chunks per ring visit (S_local=8):
+    # the chunked online-softmax must still equal the full softmax
     B, H, S, D = 2, 3, 32, 8
     rng = np.random.RandomState(0)
     q, k, v = (jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) for _ in range(3))
     mesh = seq_mesh(4)
     f = jax.jit(
         shard_map(
-            functools.partial(ring_attention, axis_name="sep", causal=causal),
+            functools.partial(ring_attention, axis_name="sep", causal=causal,
+                              block_k=block_k),
             mesh=mesh,
             in_specs=(P(None, None, "sep", None),) * 3,
             out_specs=P(None, None, "sep", None),
@@ -47,6 +51,70 @@ def test_ring_attention_matches_full(causal):
     out = f(q, k, v)
     ref = reference_attention(q, k, v, causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_no_dense_scores_buffer():
+    """VERDICT r4 item 3 'done' criterion: the compiled ring program must
+    not materialize a [S_local, S_local] f32 scores buffer. At S_local=1024,
+    B=H=1, that buffer alone is 4 MB; the blockwise path peaks at
+    [S_local, block_k=256] (1 MB) + carries. Budget: well under the dense
+    temp footprint (old jnp path measured ~2x the scores buffer)."""
+    B, H, S, D = 1, 1, 4096, 128
+    mesh = seq_mesh(4)  # S_local = 1024
+    s_local = S // 4
+
+    def temp_bytes(bk):
+        f = shard_map(
+            functools.partial(ring_attention, axis_name="sep", causal=True,
+                              block_k=bk, impl="block"),
+            mesh=mesh,
+            in_specs=(P(None, None, "sep", None),) * 3,
+            out_specs=P(None, None, "sep", None),
+            check_rep=False,
+        )
+        q = jax.ShapeDtypeStruct((B, H, S, D), jnp.float32)
+        return jax.jit(f).lower(q, q, q).compile().memory_analysis().temp_size_in_bytes
+
+    chunked = temp_bytes(128)
+    whole_shard = temp_bytes(s_local)  # == the pre-blockwise behavior
+    dense_scores = s_local * s_local * 4
+    # the whole-shard program really holds the dense per-visit scores...
+    assert whole_shard > dense_scores, (whole_shard, dense_scores)
+    # ...and chunking removes them: only carries + one [S_local, 128] tile
+    assert chunked < 0.5 * whole_shard, (chunked, whole_shard)
+    assert chunked < dense_scores, (chunked, dense_scores)
+
+
+@pytest.mark.tpu
+def test_ring_kernel_tier_matches_block_tier():
+    """Kernel-backed ring (Pallas flash inner tile + online merge) equals
+    the blockwise math tier, fwd and grads, on the real chip."""
+    assert jax.devices()[0].platform == "tpu"
+    B, H, S, D = 1, 2, 512, 128  # S_local = 256 on a 2-ring... single chip:
+    # single-chip TPU: build a 1-device mesh (ring of 1 still exercises the
+    # kernel call + merge path end to end)
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("sep",))
+    rng = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) for _ in range(3))
+
+    def run(impl):
+        f = shard_map(
+            functools.partial(ring_attention, axis_name="sep", causal=True,
+                              impl=impl),
+            mesh=mesh,
+            in_specs=(P(None, None, "sep", None),) * 3,
+            out_specs=P(None, None, "sep", None),
+            check_rep=False,
+        )
+        out = jax.jit(f)(q, k, v)
+        g = jax.jit(jax.grad(lambda *a: (f(*a) ** 2).sum(), argnums=(0, 1, 2)))(q, k, v)
+        return out, g
+
+    out_k, g_k = run("kernel")
+    out_b, g_b = run("block")
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_b), rtol=2e-2, atol=2e-3)
+    for a, b in zip(g_k, g_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-3)
 
 
 def test_ring_attention_grads_match_full():
