@@ -220,6 +220,72 @@ def run_decode(hidden=2048, layers=12, heads=16, kv_heads=None, inter=5504,
     }
 
 
+def run_paged_serve(hidden=2048, layers=12, heads=16, kv_heads=None, inter=5504,
+                    vocab=32000, n_requests=12, max_seqs=4, max_new=128):
+    """Continuous-batching serving rung: mixed-length prompts through the
+    paged KV pool (kernel-backed paged attention on TPU). Reports decode
+    tokens/s/chip across the whole workload."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        hidden, layers, heads, inter, vocab = 256, 2, 4, 512, 1024
+        n_requests, max_seqs, max_new = 5, 2, 8
+
+    paddle.seed(0)
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+        num_hidden_layers=layers, num_attention_heads=heads,
+        num_key_value_heads=kv_heads, max_position_embeddings=1024,
+        dtype="bfloat16",
+    )
+    model = LlamaForCausalLM(cfg)
+    model.bfloat16()
+    model.eval()
+    rng = np.random.RandomState(0)
+    lens = rng.randint(32 if on_tpu else 8, 512 if on_tpu else 24, n_requests)
+    prompts = [rng.randint(1, vocab, (l,)).astype(np.int32) for l in lens]
+    eng = ContinuousBatchingEngine(model, max_seqs=max_seqs, page_size=64 if on_tpu else 8,
+                                   max_len=1024 if on_tpu else 64)
+    # compile warm: the prefill program is keyed per prompt BUCKET — warm one
+    # prompt of every bucket in the workload so the timed region pays zero
+    # compilation, plus the decode program
+    from paddle_tpu.generation import prompt_bucket
+
+    seen = set()
+    for p in prompts:
+        b = prompt_bucket(len(p))
+        if b not in seen:
+            seen.add(b)
+            eng.serve([p], max_new_tokens=4)
+    t0 = time.perf_counter()
+    outs = eng.serve(prompts, max_new_tokens=max_new)
+    dt = time.perf_counter() - t0
+    gen_tokens = sum(len(o) - len(p) for o, p in zip(outs, prompts))
+    from paddle_tpu.ops import paged_attention as pa
+
+    return {
+        "metric": "paged_serve_tokens_per_sec_per_chip",
+        "value": round(gen_tokens / dt, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "extra": {
+            "config": f"h{hidden}-L{layers}-req{n_requests}-slots{max_seqs}-n{max_new}",
+            "backend": jax.default_backend(),
+            "attn_impl": pa.LAST_IMPL,
+            "wall_s": round(dt, 3),
+            "decode_steps": eng.stats["decode_steps"],
+            "pool_mb": round(eng.pool_bytes() / 1e6, 1),
+        },
+    }
+
+
 def _child_main(rung_idx, force_cpu=False):
     """Run one ladder rung; ALWAYS print a JSON line (rc 0)."""
     if force_cpu:
@@ -230,7 +296,9 @@ def _child_main(rung_idx, force_cpu=False):
 
         jax.config.update("jax_platforms", "cpu")
     try:
-        if rung_idx == -3:
+        if rung_idx == -4:
+            res = run_paged_serve()
+        elif rung_idx == -3:
             res = run_decode(quantize="int8")
         elif rung_idx == -2:
             res = run_decode()
@@ -295,6 +363,7 @@ HARVEST = [
     ("gqa_splash", -1),
     ("decode", -2),
     ("decode_int8", -3),
+    ("paged_serve", -4),
     ("mid_b4_dots", 2),
     ("big_b8_dots", 0),
 ]
@@ -308,7 +377,7 @@ PREFERENCE = [0, 3, 2, 1, 4, 5]
 def _timeout_for(idx):
     if idx == -1:
         return GQA_RUNG_TIMEOUT_S
-    if idx in (-2, -3):
+    if idx in (-2, -3, -4):
         return DECODE_RUNG_TIMEOUT_S
     return RUNG_TIMEOUT_S[idx]
 
@@ -333,7 +402,8 @@ def main():
         # On CPU every training rung collapses to the same smoke profile —
         # run one of each kind instead of six identical smokes.
         harvest = HARVEST if backend == "tpu" else [
-            ("tiny_h512", 5), ("gqa_splash", -1), ("decode", -2)]
+            ("tiny_h512", 5), ("gqa_splash", -1), ("decode", -2),
+            ("paged_serve", -4)]
         for name, idx in harvest:
             print(f"[bench] rung {name} (idx {idx})", file=sys.stderr, flush=True)
             out, timed_out = _run_rung(idx, _timeout_for(idx))
@@ -412,6 +482,13 @@ def main():
         }
         if -3 in banked:
             res["extra"]["decode"]["int8_tokens_per_sec"] = banked[-3]["value"]
+    if -4 in banked:
+        ps = banked[-4]
+        res.setdefault("extra", {})["paged_serve"] = {
+            "tokens_per_sec": ps["value"],
+            "attn_impl": ps.get("extra", {}).get("attn_impl"),
+            "config": ps.get("extra", {}).get("config"),
+        }
     print(json.dumps(res), flush=True)
 
 

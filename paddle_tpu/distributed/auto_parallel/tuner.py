@@ -139,3 +139,41 @@ def _n_params(model):
     if hasattr(model, "num_parameters"):
         return model.num_parameters()
     return int(sum(np.prod(p.shape) for p in model.parameters()))
+
+
+def cross_check(result):
+    """Planner-vs-tuner ranking comparison on one TuneResult (VERDICT r4
+    item 6): does the closed-form cost model order candidates the way real
+    measurements do? Returns both orders plus the pairwise agreement count
+    and the disagreeing pairs — disagreements are the signal that the
+    CALIBRATION constants (planner.py) need a refit from measured rungs.
+    On the CPU virtual mesh this is direction-only evidence; rerun on TPU."""
+    ok = [r for r in result.records if r.measured_s is not None]
+
+    def tag(p):
+        return (f"dp{p.dp}-mp{p.mp}-pp{p.pp}-sh{p.sharding}"
+                + ("-z3" if p.sharding_stage == 3 else ""))
+
+    agree = disagree = ties = 0
+    pairs = []
+    for i in range(len(ok)):
+        for j in range(i + 1, len(ok)):
+            a, b = ok[i], ok[j]
+            dm = a.modeled_cost - b.modeled_cost
+            if abs(dm) <= 1e-6 * max(abs(a.modeled_cost), abs(b.modeled_cost)):
+                ties += 1  # model can't distinguish them — not a disagreement
+            elif dm * (a.measured_s - b.measured_s) > 0:
+                agree += 1
+            else:
+                disagree += 1
+                pairs.append([tag(a.plan), tag(b.plan)])
+    return {
+        "pairs_tied_in_model": ties,
+        "modeled_order": [tag(r.plan) for r in sorted(ok, key=lambda r: r.modeled_cost)],
+        "measured_order": [tag(r.plan) for r in sorted(ok, key=lambda r: r.measured_s)],
+        "measured_ms": {tag(r.plan): round(r.measured_s * 1e3, 2) for r in ok},
+        "modeled_ms": {tag(r.plan): round(r.modeled_cost * 1e3, 4) for r in ok},
+        "pairs_agree": agree,
+        "pairs_disagree": disagree,
+        "disagreements": pairs,
+    }

@@ -11,9 +11,10 @@ graph). The Predictor keeps the zero-copy handle API shape so deployment
 scripts port over.
 """
 from .config import Config
+from .continuous import ContinuousBatchingEngine
 from .predictor import Predictor, create_predictor
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+__all__ = ["Config", "ContinuousBatchingEngine", "Predictor", "create_predictor"]
 
 
 class PrecisionType:

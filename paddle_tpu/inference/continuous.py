@@ -1,0 +1,250 @@
+"""Continuous-batching serving engine over the paged KV pool (reference
+capability: paddle/fluid/inference AnalysisPredictor's serving class +
+PaddleNLP block-attention / vLLM-style continuous batching; PAPERS.md
+ragged-paged-attention).
+
+TPU-native shape: compute is two jitted programs with STATIC shapes —
+a bucketed PREFILL (compiled per prompt bucket, reusing the dense
+fixed-cache path) whose KV lands in pool pages via a jitted insert, and a
+single DECODE step over all `max_seqs` slots driving the model through
+`PagedLayerCache` entries (kernel-backed paged attention on TPU). The
+scheduler is plain host Python between jitted calls: retire finished
+sequences, free their pages, admit queued requests into freed slots
+mid-flight of everyone else — the continuous part. Memory is bounded by
+the page pool, not by max_seqs × max_len:
+
+- admission is reservation-based: a request enters only when
+  ceil((true_len + max_new) / page_size) pages (and the prefill bucket's
+  pages) are free, so decode can never deadlock on pool exhaustion;
+- page 0 is scratch: inactive slots' page tables point at it, their
+  writes land there harmlessly (lengths masks it out of every real row).
+
+v1 decodes greedily (the generate() samplers remain the dense path's).
+"""
+import math
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..generation import prompt_bucket
+from ..ops.paged_attention import PagedLayerCache
+
+
+class ContinuousBatchingEngine:
+    def __init__(self, model, max_seqs=4, page_size=16, num_pages=None,
+                 max_len=512):
+        cfg = model.config
+        self.model = model
+        model.eval()
+        self.max_seqs = max_seqs
+        self.page_size = page_size
+        self.max_len = max_len
+        self.pages_per_seq = -(-max_len // page_size)  # page-table width
+        # default pool = dense equivalent; callers size it down to the
+        # expected occupancy — that is the memory win
+        self.num_pages = num_pages or (1 + max_seqs * self.pages_per_seq)
+        if self.num_pages < 2:
+            raise ValueError("need at least one scratch + one real page")
+        dtype = next(iter(model.parameters())).dtype
+        Hkv, D, L = cfg.num_key_value_heads, cfg.head_dim, cfg.num_hidden_layers
+        self.pools = [
+            (jnp.zeros((Hkv, self.num_pages, page_size, D), dtype),
+             jnp.zeros((Hkv, self.num_pages, page_size, D), dtype))
+            for _ in range(L)
+        ]
+        self.free_pages = list(range(1, self.num_pages))  # page 0 = scratch
+        self.free_slots = list(range(max_seqs))
+        self.page_table = np.zeros((max_seqs, self.pages_per_seq), np.int32)
+        self.lengths = np.zeros(max_seqs, np.int32)
+        self._prefill_fns = {}
+        self._insert_fns = {}
+        self._decode_fn = None
+        # observability for tests/bench: peak pages in use, deferred admits
+        self.stats = {"peak_pages": 0, "deferred_admissions": 0, "decode_steps": 0}
+
+    # ---- jitted pieces ----------------------------------------------------
+    def _prefill(self, bucket):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        model = self.model
+
+        def prefill(state, ids_p, true_len):
+            overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
+            caches = model.init_cache(1, bucket)
+            wrapped = [(Tensor(kc), Tensor(vc)) for kc, vc in caches]
+            logits, presents = model.functional_call(
+                overrides, Tensor(ids_p), past_key_values=wrapped,
+                cache_position=Tensor(jnp.int32(0)), use_cache=True,
+                training=False,
+            )
+            last = jax.lax.dynamic_index_in_dim(logits._data, true_len - 1,
+                                                axis=1, keepdims=False)[0]
+            tok0 = jnp.argmax(last.astype(jnp.float32), axis=-1).astype(jnp.int32)
+            ks = jnp.stack([p[0]._data[0] for p in presents])  # [L, S0b, Hkv, D]
+            vs = jnp.stack([p[1]._data[0] for p in presents])
+            return tok0, ks, vs
+
+        fn = self._prefill_fns[bucket] = jax.jit(prefill)
+        return fn
+
+    @staticmethod
+    def _pages_for_bucket(bucket, bs):
+        return -(-bucket // bs)  # ceil: a bucket smaller than a page still needs one
+
+    def _insert(self, bucket):
+        """Scatter a bucket's dense prefill KV into this slot's pool pages.
+        The bucket is padded up to a whole number of pages (a 16-token
+        bucket under page_size=64 still writes one page; the pad region is
+        masked out by `lengths` everywhere)."""
+        fn = self._insert_fns.get(bucket)
+        if fn is not None:
+            return fn
+        bs = self.page_size
+        npg = self._pages_for_bucket(bucket, bs)
+        pad = npg * bs - bucket
+
+        def insert(pools, ks, vs, page_ids):
+            if pad:
+                ks = jnp.pad(ks, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                vs = jnp.pad(vs, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            out = []
+            for l, (kp, vp) in enumerate(pools):
+                for j in range(npg):
+                    chunk_k = jnp.swapaxes(ks[l, j * bs:(j + 1) * bs], 0, 1)
+                    chunk_v = jnp.swapaxes(vs[l, j * bs:(j + 1) * bs], 0, 1)
+                    kp = kp.at[:, page_ids[j]].set(chunk_k.astype(kp.dtype))
+                    vp = vp.at[:, page_ids[j]].set(chunk_v.astype(vp.dtype))
+                out.append((kp, vp))
+            return tuple(out)
+
+        # donate the pool: the engine discards the pre-insert buffers
+        # immediately, and without donation XLA copies the whole pool
+        fn = self._insert_fns[bucket] = jax.jit(insert, donate_argnums=(0,))
+        return fn
+
+    def _decode(self):
+        if self._decode_fn is not None:
+            return self._decode_fn
+        model = self.model
+
+        def decode(state, toks, pools, page_table, lengths):
+            overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
+            pkvs = [PagedLayerCache(kp, vp, page_table, lengths)
+                    for kp, vp in pools]
+            logits, presents = model.functional_call(
+                overrides, Tensor(toks),
+                position_ids=Tensor(lengths[:, None].astype(jnp.int32)),
+                past_key_values=pkvs, use_cache=True, training=False,
+            )
+            nxt = jnp.argmax(logits._data[:, -1].astype(jnp.float32), axis=-1)
+            return nxt.astype(jnp.int32), tuple(
+                (p.k_pages, p.v_pages) for p in presents
+            )
+
+        # donate the pools: a single-token decode must UPDATE the pool in
+        # place, not copy it — without donation every step pays a full-pool
+        # memcpy and doubles peak memory, against the engine's whole point
+        self._decode_fn = jax.jit(decode, donate_argnums=(2,))
+        return self._decode_fn
+
+    # ---- scheduler --------------------------------------------------------
+    def pool_bytes(self):
+        k, _ = self.pools[0]
+        return 2 * len(self.pools) * k.size * k.dtype.itemsize
+
+    def serve(self, prompts, max_new_tokens, eos_token_id=None):
+        """Serve a list of int32 prompt arrays; returns a list of
+        [len(prompt) + n_generated] arrays (greedy; stops at eos or
+        max_new_tokens). Requests beyond the pool/slot capacity queue and
+        join as earlier sequences retire — continuous batching."""
+        state = self.model.raw_state_dict()
+        queue = deque(enumerate(prompts))
+        results = [None] * len(prompts)
+        # slot -> [req_id, tokens_out(list), n_generated, last_token, pages(list)]
+        active = {}
+
+        def pages_in_use():
+            return self.num_pages - 1 - len(self.free_pages)
+
+        def try_admit():
+            admitted = False
+            while queue and self.free_slots:
+                rid, prompt = queue[0]
+                prompt = np.asarray(prompt, np.int32).reshape(-1)
+                true_len = len(prompt)
+                bucket = prompt_bucket(true_len)
+                if true_len + max_new_tokens > self.max_len or bucket > self.max_len:
+                    raise ValueError(
+                        f"request {rid}: len {true_len} (bucket {bucket}) + "
+                        f"{max_new_tokens} exceeds max_len={self.max_len}")
+                need = max(self._pages_for_bucket(bucket, self.page_size),
+                           -(-(true_len + max_new_tokens) // self.page_size))
+                if need > len(self.free_pages):
+                    self.stats["deferred_admissions"] += 1
+                    break  # FIFO: wait for pages instead of skipping ahead
+                queue.popleft()
+                slot = self.free_slots.pop()
+                pages = [self.free_pages.pop() for _ in range(need)]
+                self.stats["peak_pages"] = max(self.stats["peak_pages"], pages_in_use())
+                ids_p = np.zeros((1, bucket), np.int32)
+                ids_p[0, :true_len] = prompt
+                tok0, ks, vs = self._prefill(bucket)(
+                    state, jnp.asarray(ids_p), jnp.int32(true_len))
+                page_ids = jnp.asarray(
+                    pages[:self._pages_for_bucket(bucket, self.page_size)],
+                    jnp.int32)
+                self.pools = list(self._insert(bucket)(
+                    tuple(self.pools), ks, vs, page_ids))
+                row = np.zeros(self.pages_per_seq, np.int32)
+                row[:len(pages)] = pages
+                self.page_table[slot] = row
+                self.lengths[slot] = true_len
+                tok0 = int(tok0)
+                done = eos_token_id is not None and tok0 == eos_token_id
+                active[slot] = [rid, list(prompt) + [tok0], 1, tok0, pages]
+                if done or max_new_tokens == 1:
+                    retire(slot)
+                admitted = True
+            return admitted
+
+        def retire(slot):
+            rid, toks, _, _, pages = active.pop(slot)
+            results[rid] = np.asarray(toks, np.int32)
+            self.free_pages.extend(pages)
+            self.free_slots.append(slot)
+            self.page_table[slot] = 0
+            self.lengths[slot] = 0
+
+        try_admit()
+        decode = self._decode()
+        while active or queue:
+            if not active:
+                # pool too small for even one queued request
+                rid, prompt = queue[0]
+                raise RuntimeError(
+                    f"request {rid} needs more pages than the pool holds")
+            toks = np.zeros((self.max_seqs, 1), np.int32)
+            for slot, st in active.items():
+                toks[slot, 0] = st[3]
+            nxt, pools = decode(
+                state, jnp.asarray(toks), tuple(self.pools),
+                jnp.asarray(self.page_table), jnp.asarray(self.lengths))
+            self.pools = list(pools)
+            self.stats["decode_steps"] += 1
+            nxt = np.asarray(nxt)
+            for slot in list(active):
+                st = active[slot]
+                self.lengths[slot] += 1  # the fed token is now in cache
+                tok = int(nxt[slot])
+                st[1].append(tok)
+                st[2] += 1  # generated count, including the token just appended
+                st[3] = tok
+                if st[2] >= max_new_tokens or (
+                        eos_token_id is not None and tok == eos_token_id):
+                    retire(slot)
+            try_admit()
+        return results

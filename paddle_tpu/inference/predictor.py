@@ -111,6 +111,30 @@ class Predictor:
         out = self._layer.generate(to_tensor(input_ids), **kwargs)
         return np.asarray(out.numpy())
 
+    def serve(self, prompts, max_new_tokens=32, eos_token_id=None,
+              max_seqs=4, page_size=64, num_pages=None, max_len=None,
+              engine=None):
+        """Continuous-batching greedy serving over the paged KV pool
+        (inference.continuous.ContinuousBatchingEngine): variable-length
+        prompts queue, join mid-flight as slots/pages free, and each result
+        equals that prompt's dense generate(). Pass `engine` to reuse a warm
+        engine (compiled prefill/decode programs + pool) across calls."""
+        from .continuous import ContinuousBatchingEngine
+
+        if engine is None:
+            if max_len is None:
+                from ..generation import prompt_bucket
+
+                longest = max(len(np.asarray(p).reshape(-1)) for p in prompts)
+                # must cover BOTH the prefill bucket of the longest prompt
+                # and its full decode extent, rounded to whole pages
+                max_len = max(prompt_bucket(longest), longest + max_new_tokens)
+                max_len = -(-max_len // page_size) * page_size
+            engine = ContinuousBatchingEngine(
+                self._layer, max_seqs=max_seqs, page_size=page_size,
+                num_pages=num_pages, max_len=max_len)
+        return engine.serve(prompts, max_new_tokens, eos_token_id=eos_token_id)
+
     # -- AOT export (reference: save_optimized_model / Program serialization;
     # TPU-native: StableHLO via jax.export — the compiled artifact is
     # hardware-portable and reloadable without the model class) ------------

@@ -128,20 +128,25 @@ class LlamaAttention(Layer):
         - (k, v) without cache_position: legacy growing-concat cache (eager);
         - (k_cache, v_cache) [B, S_max, hk, D] WITH cache_position: the
           fixed-shape decode cache (XLA-friendly — dynamic_update_slice at
-          the write offset, full-cache attention under a position mask;
-          reference: flash_attn decode / PAPERS.md ragged-paged-attention is
-          the multi-sequence upgrade path)."""
+          the write offset, full-cache attention under a position mask);
+        - ops.paged_attention.PagedLayerCache: the paged serving cache
+          (page-pool scatter write + paged decode attention; kernel-backed
+          on TPU — reference: PaddleNLP block-attention serving /
+          PAPERS.md ragged-paged-attention). Decode-only (S == 1),
+          inference-only (no tape)."""
         import jax
 
         from ..framework.core import apply
+        from ..ops.paged_attention import PagedLayerCache
 
         B, S = hidden_states.shape[0], hidden_states.shape[1]
         q = manipulation.reshape(self.q_proj(hidden_states), [B, S, self.num_heads, self.head_dim])
         k = manipulation.reshape(self.k_proj(hidden_states), [B, S, self.num_kv_heads, self.head_dim])
         v = manipulation.reshape(self.v_proj(hidden_states), [B, S, self.num_kv_heads, self.head_dim])
+        paged = isinstance(past_key_value, PagedLayerCache)
         rope_kw = {}
-        if cache_position is not None:
-            if position_ids is None:
+        if cache_position is not None or paged:
+            if position_ids is None and cache_position is not None:
                 pos0 = cache_position if hasattr(cache_position, "_data") else Tensor(jnp.asarray(cache_position))
                 position_ids = apply(
                     lambda p: jnp.broadcast_to(p + jnp.arange(S), (B, S)), pos0, name="cache_pos"
@@ -149,7 +154,12 @@ class LlamaAttention(Layer):
             # rope table must cover absolute positions up to the cache end
             # (the default table is sized to the CURRENT q length — one row
             # during decode)
-            S_tab = past_key_value[0].shape[1] if past_key_value is not None else self.config.max_position_embeddings
+            if paged:
+                S_tab = past_key_value.page_indices.shape[1] * past_key_value.page_size
+            elif past_key_value is not None:
+                S_tab = past_key_value[0].shape[1]
+            else:
+                S_tab = self.config.max_position_embeddings
             D = self.head_dim
             inv = 1.0 / (self.config.rope_theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
             emb = jnp.concatenate([o := jnp.outer(jnp.arange(S_tab, dtype=jnp.float32), inv), o], axis=-1)
@@ -158,6 +168,23 @@ class LlamaAttention(Layer):
             q, k, None, position_ids=position_ids, rotary_emb_base=self.config.rope_theta,
             **rope_kw,
         )
+        if paged:
+            from ..ops.paged_attention import paged_decode_attention, write_token_kv
+
+            if S != 1:
+                raise ValueError("paged cache is decode-only: expected S == 1")
+            pc = past_key_value
+            k_pages = write_token_kv(pc.k_pages, pc.page_indices, pc.lengths,
+                                     k._data[:, 0])
+            v_pages = write_token_kv(pc.v_pages, pc.page_indices, pc.lengths,
+                                     v._data[:, 0])
+            out = paged_decode_attention(
+                q._data[:, 0], k_pages, v_pages, pc.lengths + 1, pc.page_indices
+            )
+            out = Tensor(out.reshape(B, 1, self.num_heads * self.head_dim),
+                         stop_gradient=True)
+            present = PagedLayerCache(k_pages, v_pages, pc.page_indices, pc.lengths)
+            return self.o_proj(out), present
         if past_key_value is not None and cache_position is not None:
             k_cache, v_cache = past_key_value
             pos_a = (cache_position._data if hasattr(cache_position, "_data")

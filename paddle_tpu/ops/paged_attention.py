@@ -1,0 +1,136 @@
+"""Paged KV-cache attention (reference capability: the serving engine class
+of paddle/fluid/inference AnalysisPredictor + PaddleNLP's block-attention
+serving; PAPERS.md ragged-paged-attention is the kernel blueprint).
+
+TPU-native design: the KV cache is a POOL of fixed-size pages shared by all
+sequences — [num_kv_heads, num_pages, page_size, head_dim], the exact layout
+of jax's Pallas TPU `paged_attention` kernel — plus a per-sequence page table
+(page_indices [B, pages_per_seq]) and lengths [B]. Memory is bounded by pool
+occupancy (sum of actual context lengths, page-granular), not by
+B × max_len as the dense fixed-shape cache is.
+
+Two decode tiers, chosen at trace time like ops/flash_attention.py:
+- kernel: `jax.experimental.pallas.ops.tpu.paged_attention` on TPU;
+- math: a lax.scan over page columns with online-softmax accumulation —
+  peak temp is one [B, page_size] gather per step, never the
+  [B, max_len] dense cache view.
+
+`PagedLayerCache` is the duck-typed per-layer cache entry the model's
+attention recognizes in `past_key_values` (models/llama.py) — the third
+cache protocol next to the growing-concat and fixed-shape ones.
+"""
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+LAST_IMPL = None  # "paged-kernel" | "paged-math" — set at trace time
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class PagedLayerCache:
+    """One layer's paged cache view.
+
+    k_pages/v_pages: [num_kv_heads, num_pages, page_size, head_dim]
+    page_indices:    [B, pages_per_seq] int32 rows into the pool
+    lengths:         [B] int32 — valid tokens per sequence BEFORE this step
+    """
+
+    k_pages: jax.Array
+    v_pages: jax.Array
+    page_indices: jax.Array
+    lengths: jax.Array
+
+    def tree_flatten(self):
+        return (self.k_pages, self.v_pages, self.page_indices, self.lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def page_size(self):
+        return self.k_pages.shape[2]
+
+
+def write_token_kv(pages, page_indices, lengths, new):
+    """Scatter one new token's K or V into the pool.
+
+    pages: [Hkv, P, bs, D]; new: [B, Hkv, D]; the token lands at logical
+    position `lengths[b]` → page page_indices[b, lengths[b]//bs], offset
+    lengths[b] % bs. Pages belong to exactly one sequence, so rows never
+    collide."""
+    bs = pages.shape[2]
+    page_of = jnp.take_along_axis(
+        page_indices, (lengths // bs)[:, None], axis=1
+    )[:, 0]  # [B]
+    off = lengths % bs  # [B]
+    # advanced-index scatter: for each b, all kv heads at once
+    return pages.at[:, page_of, off, :].set(
+        jnp.swapaxes(new, 0, 1).astype(pages.dtype)
+    )
+
+
+def _paged_math(q, k_pages, v_pages, lengths, page_indices, scale):
+    """Online-softmax over page columns; q: [B, Hq, D] (one decode token)."""
+    B, Hq, D = q.shape
+    Hkv, P, bs, _ = k_pages.shape
+    npages = page_indices.shape[1]
+    group = Hq // Hkv
+
+    qs = (q * scale).astype(jnp.float32).reshape(B, Hkv, group, D)
+    o0 = jnp.zeros((B, Hkv, group, D), jnp.float32)
+    l0 = jnp.zeros((B, Hkv, group), jnp.float32)
+    m0 = jnp.full((B, Hkv, group), -1e30, jnp.float32)
+
+    def body(carry, j):
+        o, l, m = carry
+        pid = page_indices[:, j]  # [B]
+        kb = jnp.swapaxes(k_pages[:, pid], 0, 1).astype(jnp.float32)  # [B,Hkv,bs,D]
+        vb = jnp.swapaxes(v_pages[:, pid], 0, 1).astype(jnp.float32)
+        s = jnp.einsum("bhgd,bhkd->bhgk", qs, kb)  # [B,Hkv,group,bs]
+        pos = j * bs + jnp.arange(bs)  # logical positions in this page
+        s = jnp.where(pos[None, None, None, :] < lengths[:, None, None, None], s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum("bhgk,bhkd->bhgd", p, vb)
+        return (o, l, m_new), None
+
+    (o, l, _), _ = jax.lax.scan(body, (o0, l0, m0), jnp.arange(npages))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(B, Hq, D).astype(q.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
+                           scale=None, pages_per_compute_block=None):
+    """One-token decode attention over the paged pool.
+
+    q: [B, Hq, D]; returns [B, Hq, D]. lengths must already INCLUDE the
+    just-written token (the query attends to itself)."""
+    global LAST_IMPL
+    from .flash_attention import _FORCE_XLA, _on_tpu
+
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    if _on_tpu() and not _FORCE_XLA:
+        try:
+            from jax.experimental.pallas.ops.tpu.paged_attention import (
+                paged_attention as _kernel,
+            )
+
+            blk = pages_per_compute_block or min(8, page_indices.shape[1])
+            while page_indices.shape[1] % blk:
+                blk -= 1
+            out = _kernel((q * scale).astype(k_pages.dtype), k_pages, v_pages,
+                          lengths, page_indices,
+                          pages_per_compute_block=max(blk, 1))
+            LAST_IMPL = "paged-kernel"
+            return out.astype(q.dtype)
+        except Exception:
+            pass
+    LAST_IMPL = "paged-math"
+    return _paged_math(q, k_pages, v_pages, lengths, page_indices, scale)

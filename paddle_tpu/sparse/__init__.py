@@ -233,7 +233,88 @@ expm1 = _value_unary(jnp.expm1)
 neg = _value_unary(jnp.negative)
 
 
+def _segment_softmax_attention(q, k, v, rows, cols, nrows, scale,
+                               kp_mask=None, addmask_vals=None):
+    """Sparse attention inner math on raw arrays: q/k/v [..., S, D], shared
+    nnz pattern (rows, cols). O(nnz·D) — the dense [S, S] score matrix is
+    never built. Softmax per query row via segment max/sum."""
+    s = jnp.einsum("...nd,...nd->...n", q[..., rows, :], k[..., cols, :]) * scale
+    if addmask_vals is not None:
+        s = s + addmask_vals
+    if kp_mask is not None:
+        # kp_mask: [..., S] True = valid key; broadcast over leading dims
+        s = jnp.where(kp_mask[..., cols], s, -1e30)
+    s = s.astype(jnp.float32)
+    # segment ops act on 1-D segment ids: flatten leading dims, vmap over them
+    lead = s.shape[:-1]
+    flat = s.reshape(-1, s.shape[-1])
+
+    def one(sf):
+        mx = jax.ops.segment_max(sf, rows, num_segments=nrows)
+        p = jnp.exp(sf - mx[rows])
+        l = jax.ops.segment_sum(p, rows, num_segments=nrows)
+        return p / jnp.maximum(l[rows], 1e-30)
+
+    p = jax.vmap(one)(flat).reshape(*lead, -1)
+    vf = v.reshape(-1, *v.shape[-2:]) if v.ndim > 2 else v[None]
+    pf = p.reshape(-1, p.shape[-1])
+    out = jax.vmap(
+        lambda pp, vv: jax.ops.segment_sum(pp[:, None] * vv[cols], rows,
+                                           num_segments=nrows)
+    )(pf, vf.astype(jnp.float32))
+    return out.reshape(*lead, nrows, v.shape[-1]).astype(v.dtype)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse attention (reference: paddle.sparse.nn.functional.attention /
+    phi sparse attention kernels, DSA): compute attention ONLY at the mask's
+    nnz positions. q/k/v: dense [B, H, S, D]; sparse_mask: a 2-D [S, S]
+    SparseCsrTensor/SparseCooTensor whose PATTERN is shared by every
+    (batch, head) — the block-sparse shape TPU kernels want (a per-head
+    dynamic pattern has no efficient static-shape XLA expression).
+
+    key_padding_mask: [B, S] (1 = valid key); attn_mask: [S, S] additive,
+    sampled at nnz positions. Returns dense [B, H, S, D]. Compute and
+    memory are O(nnz·D) via segment-softmax — never the dense [S, S]
+    scores (same treatment as ops/flash_attention varlen: SURVEY §2.1).
+    """
+    rcv = _rows_cols_vals(sparse_mask)
+    if rcv is None or len(sparse_mask._dense_shape) != 2:
+        raise ValueError("sparse_mask must be a 2-D sparse COO/CSR tensor")
+    rows, cols, _ = rcv
+    # don't re-wrap live Tensors: to_tensor copies and resets stop_gradient
+    q, k, v = (x if isinstance(x, Tensor) else to_tensor(x)
+               for x in (query, key, value))
+    S, D = q.shape[-2], q.shape[-1]
+    if tuple(sparse_mask._dense_shape) != (S, S):
+        # XLA's clamping gather would turn a mismatch into silently wrong
+        # output (indices clamp to the last row) — be loud instead
+        raise ValueError(
+            f"sparse_mask shape {tuple(sparse_mask._dense_shape)} must be "
+            f"(S, S) = ({S}, {S}) to match query/key sequence length")
+    nrows = sparse_mask._dense_shape[0]
+    scale = 1.0 / float(np.sqrt(D))
+    am = None
+    if attn_mask is not None:
+        am = to_tensor(attn_mask)._data[rows, cols]
+    kp = None
+    if key_padding_mask is not None:
+        kp_d = to_tensor(key_padding_mask)._data.astype(bool)
+        # [B, S] -> broadcast over heads: [B, 1, S]
+        kp = kp_d[:, None, :]
+
+    def fn(qd, kd, vd):
+        return _segment_softmax_attention(qd, kd, vd, rows, cols, nrows,
+                                          scale, kp_mask=kp, addmask_vals=am)
+
+    return apply(fn, q, k, v, name="sparse_attention")
+
+
 class nn:
     class ReLU:
         def __call__(self, x):
             return relu(x)
+
+    class functional:
+        attention = staticmethod(attention)

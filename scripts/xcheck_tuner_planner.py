@@ -1,0 +1,76 @@
+"""Record one planner-vs-tuner ranking comparison (VERDICT r4 item 6 'one
+recorded comparison'): tune 3 candidate mesh shapes on the 8-device virtual
+CPU mesh with real compiled steps, cross-check the measured order against
+the closed-form cost model's order, and write TUNER_PLANNER_XCHECK.json.
+
+CPU timings are direction-only evidence for a TPU cost model; the artifact
+exists so disagreements are on record and re-runnable (rerun on TPU after
+CALIBRATION refits — tpu_watch's recovery step writes CALIBRATION.json).
+
+Usage: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+       python scripts/xcheck_tuner_planner.py
+"""
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import optimizer  # noqa: E402
+from paddle_tpu.distributed.auto_parallel.tuner import (  # noqa: E402
+    ProfilingTuner,
+    cross_check,
+)
+from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny  # noqa: E402
+
+
+def main():
+    paddle.seed(0)
+    cfg = gpt_tiny(num_hidden_layers=2, hidden_dropout_prob=0.0,
+                   attention_probs_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (8, 17)).astype(np.int32)
+    batch = (paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:]))
+
+    def loss(out, labels):
+        import paddle_tpu.nn.functional as F
+
+        return F.cross_entropy(
+            out.reshape([-1, out.shape[-1]]), labels.reshape([-1]).unsqueeze(-1)
+        ).mean()
+
+    tuner = ProfilingTuner(
+        model, loss,
+        lambda: optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters()),
+        steps=3, warmup=1,
+    )
+    res = tuner.tune(batch, top_k=3)
+    xc = cross_check(res)
+    xc["backend"] = jax.default_backend()
+    xc["n_devices"] = len(jax.devices())
+    xc["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    xc["note"] = ("CPU virtual mesh: direction-only evidence; rerun on TPU "
+                  "after CALIBRATION refit (tpu_watch recovery step)")
+    out = os.path.join(REPO, "TUNER_PLANNER_XCHECK.json")
+    with open(out, "w") as f:
+        json.dump(xc, f, indent=1)
+    print(json.dumps(xc))
+
+
+if __name__ == "__main__":
+    main()
