@@ -19,7 +19,10 @@ the page pool, not by max_seqs × max_len:
 - page 0 is scratch: inactive slots' page tables point at it, their
   writes land there harmlessly (lengths masks it out of every real row).
 
-v1 decodes greedily (the generate() samplers remain the dense path's).
+Decoding is greedy by default; serve(do_sample=True, ...) runs the dense
+path's sampler math with per-request key streams (reproducible regardless
+of co-scheduling). kv_cache_dtype="int8" switches the pool to the
+QuantizedTensor layout the Pallas kernel consumes natively.
 """
 import math
 from collections import deque
@@ -29,13 +32,26 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.core import Tensor
-from ..generation import prompt_bucket
+from ..generation import _make_sampler, prompt_bucket
 from ..ops.paged_attention import PagedLayerCache
+
+
+def _row_sampler(do_sample, temperature, top_k, top_p):
+    """Per-ROW sampler: each slot consumes its own PRNG key stream, so a
+    sequence's sampled tokens do not depend on which other requests happen
+    to share the batch (continuous batching reorders co-tenants freely).
+    Reuses the dense path's sampler math (generation._make_sampler)."""
+    base = _make_sampler(do_sample, temperature, top_k, top_p,
+                         repetition_penalty=1.0, min_length=0,
+                         eos_token_id=None)
+    if not do_sample:
+        return lambda logits, keys: base(logits, None)
+    return jax.vmap(lambda lg, k: base(lg[None], k)[0])
 
 
 class ContinuousBatchingEngine:
     def __init__(self, model, max_seqs=4, page_size=16, num_pages=None,
-                 max_len=512):
+                 max_len=512, kv_cache_dtype=None):
         cfg = model.config
         self.model = model
         model.eval()
@@ -50,29 +66,49 @@ class ContinuousBatchingEngine:
             raise ValueError("need at least one scratch + one real page")
         dtype = next(iter(model.parameters())).dtype
         Hkv, D, L = cfg.num_key_value_heads, cfg.head_dim, cfg.num_hidden_layers
-        self.pools = [
-            (jnp.zeros((Hkv, self.num_pages, page_size, D), dtype),
-             jnp.zeros((Hkv, self.num_pages, page_size, D), dtype))
-            for _ in range(L)
-        ]
+        self.kv_cache_dtype = kv_cache_dtype
+        if kv_cache_dtype == "int8":
+            # int8 KV pool (jax paged_attention QuantizedTensor layout):
+            # ~4x fewer HBM bytes per decode step vs f32, ~2x vs bf16 —
+            # the decode-bandwidth lever; scales are per (head, page, row)
+            from jax.experimental.pallas.ops.tpu.paged_attention import (
+                quantization_utils as qu,
+            )
+
+            def zero_pool():
+                return qu.QuantizedTensor(
+                    weight=jnp.zeros((Hkv, self.num_pages, page_size, D), jnp.int8),
+                    scales=jnp.ones((Hkv, self.num_pages, page_size, 1), jnp.float32),
+                )
+
+            self.pools = [(zero_pool(), zero_pool()) for _ in range(L)]
+        elif kv_cache_dtype not in (None, "model"):
+            raise ValueError(f"unsupported kv_cache_dtype {kv_cache_dtype!r}")
+        else:
+            self.pools = [
+                (jnp.zeros((Hkv, self.num_pages, page_size, D), dtype),
+                 jnp.zeros((Hkv, self.num_pages, page_size, D), dtype))
+                for _ in range(L)
+            ]
         self.free_pages = list(range(1, self.num_pages))  # page 0 = scratch
         self.free_slots = list(range(max_seqs))
         self.page_table = np.zeros((max_seqs, self.pages_per_seq), np.int32)
         self.lengths = np.zeros(max_seqs, np.int32)
         self._prefill_fns = {}
         self._insert_fns = {}
-        self._decode_fn = None
+        self._decode_fns = {}
         # observability for tests/bench: peak pages in use, deferred admits
         self.stats = {"peak_pages": 0, "deferred_admissions": 0, "decode_steps": 0}
 
     # ---- jitted pieces ----------------------------------------------------
-    def _prefill(self, bucket):
-        fn = self._prefill_fns.get(bucket)
+    def _prefill(self, bucket, sampling):
+        fn = self._prefill_fns.get((bucket, sampling))
         if fn is not None:
             return fn
         model = self.model
+        sampler = _row_sampler(*sampling)
 
-        def prefill(state, ids_p, true_len):
+        def prefill(state, ids_p, true_len, key):
             overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
             caches = model.init_cache(1, bucket)
             wrapped = [(Tensor(kc), Tensor(vc)) for kc, vc in caches]
@@ -82,13 +118,13 @@ class ContinuousBatchingEngine:
                 training=False,
             )
             last = jax.lax.dynamic_index_in_dim(logits._data, true_len - 1,
-                                                axis=1, keepdims=False)[0]
-            tok0 = jnp.argmax(last.astype(jnp.float32), axis=-1).astype(jnp.int32)
+                                                axis=1, keepdims=False)  # [1, V]
+            tok0 = sampler(last, key[None])[0].astype(jnp.int32)
             ks = jnp.stack([p[0]._data[0] for p in presents])  # [L, S0b, Hkv, D]
             vs = jnp.stack([p[1]._data[0] for p in presents])
             return tok0, ks, vs
 
-        fn = self._prefill_fns[bucket] = jax.jit(prefill)
+        fn = self._prefill_fns[(bucket, sampling)] = jax.jit(prefill)
         return fn
 
     @staticmethod
@@ -107,6 +143,21 @@ class ContinuousBatchingEngine:
         npg = self._pages_for_bucket(bucket, bs)
         pad = npg * bs - bucket
 
+        from ..ops.paged_attention import is_quantized
+
+        def write_page(pool, pid, chunk):
+            if is_quantized(pool):
+                from jax.experimental.pallas.ops.tpu.paged_attention import (
+                    quantization_utils as qu,
+                )
+
+                qt = qu.quantize_to_int8(chunk.astype(jnp.float32))
+                return type(pool)(
+                    weight=pool.weight.at[:, pid].set(qt.weight),
+                    scales=pool.scales.at[:, pid].set(qt.scales),
+                )
+            return pool.at[:, pid].set(chunk.astype(pool.dtype))
+
         def insert(pools, ks, vs, page_ids):
             if pad:
                 ks = jnp.pad(ks, ((0, 0), (0, pad), (0, 0), (0, 0)))
@@ -116,8 +167,8 @@ class ContinuousBatchingEngine:
                 for j in range(npg):
                     chunk_k = jnp.swapaxes(ks[l, j * bs:(j + 1) * bs], 0, 1)
                     chunk_v = jnp.swapaxes(vs[l, j * bs:(j + 1) * bs], 0, 1)
-                    kp = kp.at[:, page_ids[j]].set(chunk_k.astype(kp.dtype))
-                    vp = vp.at[:, page_ids[j]].set(chunk_v.astype(vp.dtype))
+                    kp = write_page(kp, page_ids[j], chunk_k)
+                    vp = write_page(vp, page_ids[j], chunk_v)
                 out.append((kp, vp))
             return tuple(out)
 
@@ -126,12 +177,14 @@ class ContinuousBatchingEngine:
         fn = self._insert_fns[bucket] = jax.jit(insert, donate_argnums=(0,))
         return fn
 
-    def _decode(self):
-        if self._decode_fn is not None:
-            return self._decode_fn
+    def _decode(self, sampling):
+        fn = self._decode_fns.get(sampling)
+        if fn is not None:
+            return fn
         model = self.model
+        sampler = _row_sampler(*sampling)
 
-        def decode(state, toks, pools, page_table, lengths):
+        def decode(state, toks, pools, page_table, lengths, keys):
             overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
             pkvs = [PagedLayerCache(kp, vp, page_table, lengths)
                     for kp, vp in pools]
@@ -140,27 +193,49 @@ class ContinuousBatchingEngine:
                 position_ids=Tensor(lengths[:, None].astype(jnp.int32)),
                 past_key_values=pkvs, use_cache=True, training=False,
             )
-            nxt = jnp.argmax(logits._data[:, -1].astype(jnp.float32), axis=-1)
-            return nxt.astype(jnp.int32), tuple(
+            nxt = sampler(logits._data[:, -1], keys).astype(jnp.int32)
+            return nxt, tuple(
                 (p.k_pages, p.v_pages) for p in presents
             )
 
         # donate the pools: a single-token decode must UPDATE the pool in
         # place, not copy it — without donation every step pays a full-pool
         # memcpy and doubles peak memory, against the engine's whole point
-        self._decode_fn = jax.jit(decode, donate_argnums=(2,))
-        return self._decode_fn
+        fn = self._decode_fns[sampling] = jax.jit(decode, donate_argnums=(2,))
+        return fn
 
     # ---- scheduler --------------------------------------------------------
     def pool_bytes(self):
-        k, _ = self.pools[0]
-        return 2 * len(self.pools) * k.size * k.dtype.itemsize
+        import jax
 
-    def serve(self, prompts, max_new_tokens, eos_token_id=None):
+        return sum(l.size * l.dtype.itemsize
+                   for l in jax.tree_util.tree_leaves(self.pools))
+
+    def serve(self, prompts, max_new_tokens, eos_token_id=None,
+              do_sample=False, temperature=1.0, top_k=0, top_p=1.0, seed=0):
         """Serve a list of int32 prompt arrays; returns a list of
-        [len(prompt) + n_generated] arrays (greedy; stops at eos or
-        max_new_tokens). Requests beyond the pool/slot capacity queue and
-        join as earlier sequences retire — continuous batching."""
+        [len(prompt) + n_generated] arrays (stops at eos or max_new_tokens).
+        Requests beyond the pool/slot capacity queue and join as earlier
+        sequences retire — continuous batching.
+
+        Sampling (do_sample/temperature/top_k/top_p — the dense generate()
+        sampler math) draws each sequence from its OWN key stream
+        fold_in(fold_in(seed, request_id), token_index), so a request's
+        output is reproducible regardless of which co-tenants shared its
+        batch."""
+        # greedy ignores the sampler knobs: canonicalize so every greedy
+        # serve shares ONE compiled prefill/decode program
+        sampling = ((False, 1.0, 0, 1.0) if not do_sample else
+                    (True, float(temperature), int(top_k), float(top_p)))
+        base_key = jax.random.PRNGKey(seed)
+        # one jitted vmap builds the whole per-slot key batch per step —
+        # not 3 tiny device ops per slot on the decode hot path
+        keys_fn = jax.jit(jax.vmap(
+            lambda r, i: jax.random.fold_in(jax.random.fold_in(base_key, r), i)))
+
+        def req_key(rid, tok_idx):
+            return jax.random.fold_in(jax.random.fold_in(base_key, rid), tok_idx)
+
         state = self.model.raw_state_dict()
         queue = deque(enumerate(prompts))
         results = [None] * len(prompts)
@@ -192,8 +267,9 @@ class ContinuousBatchingEngine:
                 self.stats["peak_pages"] = max(self.stats["peak_pages"], pages_in_use())
                 ids_p = np.zeros((1, bucket), np.int32)
                 ids_p[0, :true_len] = prompt
-                tok0, ks, vs = self._prefill(bucket)(
-                    state, jnp.asarray(ids_p), jnp.int32(true_len))
+                tok0, ks, vs = self._prefill(bucket, sampling)(
+                    state, jnp.asarray(ids_p), jnp.int32(true_len),
+                    req_key(rid, 0))
                 page_ids = jnp.asarray(
                     pages[:self._pages_for_bucket(bucket, self.page_size)],
                     jnp.int32)
@@ -220,7 +296,7 @@ class ContinuousBatchingEngine:
             self.lengths[slot] = 0
 
         try_admit()
-        decode = self._decode()
+        decode = self._decode(sampling)
         while active or queue:
             if not active:
                 # pool too small for even one queued request
@@ -228,11 +304,15 @@ class ContinuousBatchingEngine:
                 raise RuntimeError(
                     f"request {rid} needs more pages than the pool holds")
             toks = np.zeros((self.max_seqs, 1), np.int32)
+            rids = np.zeros(self.max_seqs, np.int32)
+            idxs = np.zeros(self.max_seqs, np.int32)
             for slot, st in active.items():
                 toks[slot, 0] = st[3]
+                rids[slot], idxs[slot] = st[0], st[2]
+            keys = keys_fn(jnp.asarray(rids), jnp.asarray(idxs))
             nxt, pools = decode(
                 state, jnp.asarray(toks), tuple(self.pools),
-                jnp.asarray(self.page_table), jnp.asarray(self.lengths))
+                jnp.asarray(self.page_table), jnp.asarray(self.lengths), keys)
             self.pools = list(pools)
             self.stats["decode_steps"] += 1
             nxt = np.asarray(nxt)

@@ -52,31 +52,71 @@ class PagedLayerCache:
 
     @property
     def page_size(self):
-        return self.k_pages.shape[2]
+        k = self.k_pages
+        return (k.weight if is_quantized(k) else k).shape[2]
+
+
+def is_quantized(pages):
+    """True for the int8 pool form: a QuantizedTensor(weight, scales) pair
+    (jax's paged_attention quantization_utils layout — weight int8
+    [Hkv, P, bs, D], scales [Hkv, P, bs, 1] = per-row absmax)."""
+    return hasattr(pages, "weight") and hasattr(pages, "scales")
+
+
+def quantize_pages(pages_f):
+    """Float pool -> int8 QuantizedTensor pool (per-row absmax scales)."""
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        quantization_utils as qu,
+    )
+
+    return qu.quantize_to_int8(pages_f.astype(jnp.float32))
+
+
+def _dequantize(weight, scales, dtype=jnp.float32):
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        quantization_utils as qu,
+    )
+
+    return qu.from_int8(weight, scales, dtype=dtype)
 
 
 def write_token_kv(pages, page_indices, lengths, new):
     """Scatter one new token's K or V into the pool.
 
-    pages: [Hkv, P, bs, D]; new: [B, Hkv, D]; the token lands at logical
-    position `lengths[b]` → page page_indices[b, lengths[b]//bs], offset
-    lengths[b] % bs. Pages belong to exactly one sequence, so rows never
-    collide."""
-    bs = pages.shape[2]
+    pages: [Hkv, P, bs, D] float, or QuantizedTensor for the int8 pool
+    (the new row is quantized per (b, head) with its own absmax scale —
+    the HBM-bandwidth lever for decode). new: [B, Hkv, D]; the token lands
+    at logical position `lengths[b]` → page page_indices[b, lengths[b]//bs],
+    offset lengths[b] % bs. Pages belong to exactly one sequence, so rows
+    never collide."""
+    bs = (pages.weight if is_quantized(pages) else pages).shape[2]
     page_of = jnp.take_along_axis(
         page_indices, (lengths // bs)[:, None], axis=1
     )[:, 0]  # [B]
     off = lengths % bs  # [B]
+    new_hb = jnp.swapaxes(new, 0, 1)  # [Hkv, B, D]
+    if is_quantized(pages):
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            quantization_utils as qu,
+        )
+
+        qt = qu.quantize_to_int8(new_hb.astype(jnp.float32))
+        return type(pages)(
+            weight=pages.weight.at[:, page_of, off, :].set(qt.weight),
+            scales=pages.scales.at[:, page_of, off, :].set(
+                qt.scales.astype(pages.scales.dtype)),
+        )
     # advanced-index scatter: for each b, all kv heads at once
-    return pages.at[:, page_of, off, :].set(
-        jnp.swapaxes(new, 0, 1).astype(pages.dtype)
-    )
+    return pages.at[:, page_of, off, :].set(new_hb.astype(pages.dtype))
 
 
 def _paged_math(q, k_pages, v_pages, lengths, page_indices, scale):
-    """Online-softmax over page columns; q: [B, Hq, D] (one decode token)."""
+    """Online-softmax over page columns; q: [B, Hq, D] (one decode token).
+    int8 pools dequantize per gathered page chunk — the full-precision pool
+    is never materialized."""
     B, Hq, D = q.shape
-    Hkv, P, bs, _ = k_pages.shape
+    kq, vq = is_quantized(k_pages), is_quantized(v_pages)
+    Hkv, P, bs, _ = (k_pages.weight if kq else k_pages).shape
     npages = page_indices.shape[1]
     group = Hq // Hkv
 
@@ -85,11 +125,19 @@ def _paged_math(q, k_pages, v_pages, lengths, page_indices, scale):
     l0 = jnp.zeros((B, Hkv, group), jnp.float32)
     m0 = jnp.full((B, Hkv, group), -1e30, jnp.float32)
 
+    def gather(pages, quant, pid):
+        if quant:
+            return _dequantize(
+                jnp.swapaxes(pages.weight[:, pid], 0, 1),
+                jnp.swapaxes(pages.scales[:, pid], 0, 1),
+            )
+        return jnp.swapaxes(pages[:, pid], 0, 1).astype(jnp.float32)
+
     def body(carry, j):
         o, l, m = carry
         pid = page_indices[:, j]  # [B]
-        kb = jnp.swapaxes(k_pages[:, pid], 0, 1).astype(jnp.float32)  # [B,Hkv,bs,D]
-        vb = jnp.swapaxes(v_pages[:, pid], 0, 1).astype(jnp.float32)
+        kb = gather(k_pages, kq, pid)  # [B,Hkv,bs,D]
+        vb = gather(v_pages, vq, pid)
         s = jnp.einsum("bhgd,bhkd->bhgk", qs, kb)  # [B,Hkv,group,bs]
         pos = j * bs + jnp.arange(bs)  # logical positions in this page
         s = jnp.where(pos[None, None, None, :] < lengths[:, None, None, None], s, -1e30)
@@ -125,7 +173,8 @@ def paged_decode_attention(q, k_pages, v_pages, lengths, page_indices,
             blk = pages_per_compute_block or min(8, page_indices.shape[1])
             while page_indices.shape[1] % blk:
                 blk -= 1
-            out = _kernel((q * scale).astype(k_pages.dtype), k_pages, v_pages,
+            qdt = jnp.bfloat16 if is_quantized(k_pages) else k_pages.dtype
+            out = _kernel((q * scale).astype(qdt), k_pages, v_pages,
                           lengths, page_indices,
                           pages_per_compute_block=max(blk, 1))
             LAST_IMPL = "paged-kernel"

@@ -60,16 +60,21 @@ def _online_merge(o, l, m, o2, l2, m2):
 
 
 def _ring_block_impl(q, k, v, axis_name, causal, scale, block_k):
-    """Blockwise-math ring: every backend, AD-compatible, O(S·block_k) scores."""
+    """Blockwise-math ring: every backend, AD-compatible, O(S·block_k) scores.
+    GQA-aware: k/v may carry fewer (kv) heads than q — the ring messages
+    move the UNEXPANDED kv shard (Hkv heads of ICI bytes, not Hq)."""
     B, H, S, D = q.shape
+    Hkv = k.shape[1]
+    G = H // Hkv
+    qg = q.reshape(B, Hkv, G, S, D)
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
     bk = _pick_block_k(S, block_k)
     nblk = S // bk
 
-    o0 = jnp.zeros((B, H, S, D), jnp.float32)
-    l0 = jnp.zeros((B, H, S, 1), jnp.float32)
-    m0 = jnp.full((B, H, S, 1), -1e30, jnp.float32)
+    o0 = jnp.zeros((B, Hkv, G, S, D), jnp.float32)
+    l0 = jnp.zeros((B, Hkv, G, S, 1), jnp.float32)
+    m0 = jnp.full((B, Hkv, G, S, 1), -1e30, jnp.float32)
     back_perm = [(j, (j - 1) % n) for j in range(n)]  # kv block walks the ring
 
     qpos = my * S + jnp.arange(S)[:, None]
@@ -85,7 +90,7 @@ def _ring_block_impl(q, k, v, axis_name, causal, scale, block_k):
                 o, l, m = carry2
                 kb = jax.lax.dynamic_slice_in_dim(k_cur, j * bk, bk, axis=2)
                 vb = jax.lax.dynamic_slice_in_dim(v_cur, j * bk, bk, axis=2)
-                s = jnp.einsum("bhqd,bhkd->bhqk", q, kb).astype(jnp.float32) * scale
+                s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kb).astype(jnp.float32) * scale
                 if causal:
                     kpos = src * S + j * bk + jnp.arange(bk)[None, :]
                     s = jnp.where(qpos >= kpos, s, -1e30)
@@ -94,7 +99,7 @@ def _ring_block_impl(q, k, v, axis_name, causal, scale, block_k):
                 corr = jnp.exp(m - m_new)
                 l = l * corr + p.sum(axis=-1, keepdims=True)
                 o = o * corr + jnp.einsum(
-                    "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32)
+                    "bhgqk,bhkd->bhgqd", p, vb.astype(jnp.float32)
                 )
                 return (o, l, m_new), None
 
@@ -114,7 +119,7 @@ def _ring_block_impl(q, k, v, axis_name, causal, scale, block_k):
     # scan (not fori_loop): reverse-mode AD flows through it, and n is a
     # static mesh-axis size so the ring unrolls to a fixed trip count
     (o, l, m, _, _), _ = jax.lax.scan(body, (o0, l0, m0, k, v), jnp.arange(n))
-    return (o / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    return (o / jnp.maximum(l, 1e-30)).reshape(B, H, S, D).astype(q.dtype)
 
 
 def _ring_kernel_fwd_impl(q, k, v, axis_name, causal, scale):
@@ -134,7 +139,13 @@ def _ring_kernel_fwd_impl(q, k, v, axis_name, causal, scale):
         block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
     )
 
+    Hkv = k.shape[1]
+
     def fa_call(k_cur, v_cur, causal_flag):
+        if Hkv != H:  # GQA: expand at the kernel call only — the ring
+            # messages carry the unexpanded Hkv heads
+            k_cur = jnp.repeat(k_cur, H // Hkv, axis=1)
+            v_cur = jnp.repeat(v_cur, H // Hkv, axis=1)
         # save_residuals=True: (normalized o, l = sum-exp, m = row max)
         return _fa._flash_attention(
             q, k_cur, v_cur, None, None, True, causal_flag, scale, sizes, False

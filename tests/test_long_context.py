@@ -117,6 +117,31 @@ def test_ring_kernel_tier_matches_block_tier():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-3)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_gqa_matches_expanded(causal):
+    """kv_heads < heads: the ring carries unexpanded KV; result must equal
+    full attention with kv heads repeated (the GQA contract)."""
+    B, Hq, Hkv, S, D = 2, 6, 2, 32, 8
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(B, Hq, S, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, Hkv, S, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, Hkv, S, D).astype(np.float32))
+    mesh = seq_mesh(4)
+    f = jax.jit(
+        shard_map(
+            functools.partial(ring_attention, axis_name="sep", causal=causal),
+            mesh=mesh,
+            in_specs=(P(None, None, "sep", None),) * 3,
+            out_specs=P(None, None, "sep", None),
+            check_rep=False,
+        )
+    )
+    out = f(q, k, v)
+    ref = reference_attention(q, jnp.repeat(k, Hq // Hkv, 1),
+                              jnp.repeat(v, Hq // Hkv, 1), causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
 def test_ring_attention_grads_match_full():
     B, H, S, D = 1, 2, 16, 4
     rng = np.random.RandomState(1)

