@@ -1,3 +1,3 @@
 from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate, top_k_dispatch  # noqa: F401
 from .grad_clip import ClipGradForMOEByGlobalNorm  # noqa: F401
-from .moe_layer import MoE, ExpertStack, MoELayer  # noqa: F401
+from .moe_layer import MoE, ExpertStack, MoELayer, SwiGLUExpertStack  # noqa: F401

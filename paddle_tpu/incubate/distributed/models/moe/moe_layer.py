@@ -26,7 +26,12 @@ from .....tensor.einsum import einsum
 from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
 
 
-class ExpertStack(Layer):
+class _StackedExperts(Layer):
+    """Marker base for stacked-weight expert banks ([E, ...] arrays, one
+    batched einsum over all experts) — the TPU fast path MoELayer detects."""
+
+
+class ExpertStack(_StackedExperts):
     """Stacked-weight expert FFN bank — the TPU fast path. All E experts'
     weights live in single [E, ...] arrays sharded on the expert mesh axis,
     so the expert forward is one batched einsum on the MXU (no Python loop,
@@ -63,6 +68,40 @@ class ExpertStack(Layer):
         return apply(fn, dispatched, self.w1, self.b1, self.w2, self.b2, name="expert_stack")
 
 
+class SwiGLUExpertStack(_StackedExperts):
+    """Gated (LLaMA-style) expert FFN bank: silu(x@wg) * (x@wu) @ wd, all E
+    experts stacked in [E, ...] arrays sharded on the expert axis — same
+    one-batched-einsum MXU shape as ExpertStack, SwiGLU math."""
+
+    def __init__(self, num_expert, d_model, d_hidden, expert_axis="dp"):
+        super().__init__()
+        self.num_expert, self.d_model, self.d_hidden = num_expert, d_model, d_hidden
+        self.w_gate = self.create_parameter([num_expert, d_model, d_hidden],
+                                            default_initializer=I.XavierUniform())
+        self.w_up = self.create_parameter([num_expert, d_model, d_hidden],
+                                          default_initializer=I.XavierUniform())
+        self.w_down = self.create_parameter([num_expert, d_hidden, d_model],
+                                            default_initializer=I.XavierUniform())
+        if expert_axis:
+            self.w_gate.partition_spec = P(expert_axis, None, "mp")
+            self.w_up.partition_spec = P(expert_axis, None, "mp")
+            self.w_down.partition_spec = P(expert_axis, "mp", None)
+            for p in (self.w_gate, self.w_up, self.w_down):
+                p.is_distributed = True
+
+    def forward(self, dispatched):
+        """dispatched: [E, C, M] → [E, C, M]."""
+        import jax.nn as jnn
+
+        def fn(x, wg, wu, wd):
+            h = jnn.silu(jnp.einsum("ecm,emh->ech", x, wg)) * jnp.einsum(
+                "ecm,emh->ech", x, wu)
+            return jnp.einsum("ech,ehm->ecm", h, wd)
+
+        return apply(fn, dispatched, self.w_gate, self.w_up, self.w_down,
+                     name="swiglu_expert_stack")
+
+
 class MoELayer(Layer):
     """reference signature: MoELayer(d_model, experts, gate, moe_group,
     recompute_interval). `experts` is either an ExpertStack (fast path) or a
@@ -75,7 +114,7 @@ class MoELayer(Layer):
         self.d_model = d_model
         if isinstance(gate, dict):  # reference accepts a gate config dict
             gate_type = gate.get("type", "gshard")
-            default_n = experts.num_expert if isinstance(experts, ExpertStack) else (
+            default_n = experts.num_expert if isinstance(experts, _StackedExperts) else (
                 len(experts) if experts is not None else 1)
             num_expert = gate.get("num_expert", default_n)
             top_k = gate.get("top_k", 2)
@@ -87,7 +126,7 @@ class MoELayer(Layer):
             else:
                 gate = cls(d_model, num_expert, top_k=top_k)
         if gate is None:
-            num_expert = len(experts) if not isinstance(experts, ExpertStack) else experts.num_expert
+            num_expert = len(experts) if not isinstance(experts, _StackedExperts) else experts.num_expert
             gate = GShardGate(d_model, num_expert)
         self.gate = gate
         if isinstance(experts, (list, tuple)):
@@ -112,7 +151,7 @@ class MoELayer(Layer):
         remat = self.recompute_interval > 0
         if remat:
             from .....distributed.fleet.recompute import recompute
-        if isinstance(self.experts, ExpertStack):
+        if isinstance(self.experts, _StackedExperts):
             # pass the Layer itself so recompute lifts its parameters as
             # differentiable inputs of the checkpointed region
             expert_out = recompute(self.experts, dispatched) if remat else self.experts(dispatched)

@@ -35,6 +35,12 @@ from ..framework.core import Tensor
 from ..generation import _make_sampler, prompt_bucket
 from ..ops.paged_attention import PagedLayerCache
 
+# one module-level jitted key builder (jit cache survives across serve()
+# calls): key[slot] = fold_in(fold_in(base, request_id), token_index)
+_KEYS_FN = jax.jit(jax.vmap(
+    lambda base, r, i: jax.random.fold_in(jax.random.fold_in(base, r), i),
+    in_axes=(None, 0, 0)))
+
 
 def _row_sampler(do_sample, temperature, top_k, top_p):
     """Per-ROW sampler: each slot consumes its own PRNG key stream, so a
@@ -228,13 +234,9 @@ class ContinuousBatchingEngine:
         sampling = ((False, 1.0, 0, 1.0) if not do_sample else
                     (True, float(temperature), int(top_k), float(top_p)))
         base_key = jax.random.PRNGKey(seed)
-        # one jitted vmap builds the whole per-slot key batch per step —
-        # not 3 tiny device ops per slot on the decode hot path
-        keys_fn = jax.jit(jax.vmap(
-            lambda r, i: jax.random.fold_in(jax.random.fold_in(base_key, r), i)))
 
         def req_key(rid, tok_idx):
-            return jax.random.fold_in(jax.random.fold_in(base_key, rid), tok_idx)
+            return _KEYS_FN(base_key, jnp.asarray([rid]), jnp.asarray([tok_idx]))[0]
 
         state = self.model.raw_state_dict()
         queue = deque(enumerate(prompts))
@@ -309,7 +311,11 @@ class ContinuousBatchingEngine:
             for slot, st in active.items():
                 toks[slot, 0] = st[3]
                 rids[slot], idxs[slot] = st[0], st[2]
-            keys = keys_fn(jnp.asarray(rids), jnp.asarray(idxs))
+            if do_sample:
+                keys = _KEYS_FN(base_key, jnp.asarray(rids), jnp.asarray(idxs))
+            else:
+                # greedy ignores the keys entirely — skip the device work
+                keys = jnp.zeros((self.max_seqs, 2), jnp.uint32)
             nxt, pools = decode(
                 state, jnp.asarray(toks), tuple(self.pools),
                 jnp.asarray(self.page_table), jnp.asarray(self.lengths), keys)

@@ -48,6 +48,10 @@ class LlamaConfig:
         ce_chunk_size=None,
         dtype="float32",
         seq_length=2048,
+        num_experts=0,
+        moe_top_k=2,
+        moe_gate="gshard",
+        moe_aux_loss_weight=0.01,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -66,6 +70,14 @@ class LlamaConfig:
         self.ce_chunk_size = ce_chunk_size
         self.dtype = dtype
         self.seq_length = seq_length
+        # Mixtral-class sparse-MoE variant (reference ecosystem:
+        # incubate.distributed.models.moe atop the fleet EP axis): every
+        # decoder layer's MLP becomes num_experts SwiGLU experts behind a
+        # gshard/switch gate; the load-balance aux loss joins the CE loss.
+        self.num_experts = num_experts
+        self.moe_top_k = moe_top_k
+        self.moe_gate = moe_gate
+        self.moe_aux_loss_weight = moe_aux_loss_weight
 
     @property
     def head_dim(self):
@@ -247,7 +259,25 @@ class LlamaDecoderLayer(Layer):
         super().__init__()
         self.config = config
         self.self_attn = LlamaAttention(config)
-        self.mlp = LlamaMLP(config)
+        if config.num_experts > 1:
+            # Mixtral-class sparse MoE: SwiGLU expert bank behind a
+            # gshard/switch gate, experts sharded on the expert mesh axis
+            from ..incubate.distributed.models.moe import (
+                MoELayer,
+                SwiGLUExpertStack,
+            )
+
+            self.mlp = MoELayer(
+                config.hidden_size,
+                experts=SwiGLUExpertStack(
+                    config.num_experts, config.hidden_size,
+                    config.intermediate_size),
+                gate={"type": config.moe_gate,
+                      "num_expert": config.num_experts,
+                      "top_k": config.moe_top_k},
+            )
+        else:
+            self.mlp = LlamaMLP(config)
         self.input_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
         self.post_attention_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
@@ -290,7 +320,12 @@ class LlamaModel(Layer):
                 h, present = layer(h, attention_mask, position_ids,
                                    past_key_value=pkv, cache_position=cache_position)
                 presents.append(present)
-            elif self.config.use_recompute and self.training:
+            elif (self.config.use_recompute and self.training
+                  and self.config.num_experts <= 1):
+                # MoE layers skip block-level remat: the gate's aux loss is
+                # read off the layer afterwards (moe_aux_loss) and must stay
+                # on the primal tape; expert remat is MoELayer's own
+                # recompute_interval
                 from ..distributed.fleet.recompute import recompute
 
                 h = recompute(layer, h, attention_mask, position_ids,
@@ -301,6 +336,24 @@ class LlamaModel(Layer):
         if presents is not None and past_key_values is not None:
             return out, presents
         return out
+
+    def moe_aux_loss(self):
+        """Sum of the gates' load-balance losses from the LAST forward
+        (None when the model has no MoE layers).
+
+        Trace-scope contract: l_aux is a forward side-channel, so this is
+        valid only (a) eagerly, right after an eager forward, or (b) INSIDE
+        the same trace as the forward — which is exactly how a TrainStep
+        loss_fn runs (forward and loss trace as one program; see
+        LlamaForCausalLM.make_loss_fn). Reading it eagerly after a JITTED
+        forward raises jax's UnexpectedTracerError rather than returning a
+        stale value."""
+        total = None
+        for layer in self.layers:
+            aux = getattr(layer.mlp, "l_aux", None)
+            if aux is not None:
+                total = aux if total is None else total + aux
+        return total
 
 
 def _seq_shard(h):
@@ -458,6 +511,25 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         else:
             self.lm_head = _mk_linear(config.hidden_size, config.vocab_size, P(None, "mp"))
 
+    def make_loss_fn(self):
+        """loss_fn for TrainStep/DistributedTrainStep (loss_fn(logits,
+        labels)) that INCLUDES the MoE gate aux loss. The compiled step
+        traces the model forward and this closure in one program, so
+        reading moe_aux_loss() here sees the same-trace gate losses — the
+        supported way to train a num_experts>1 model through the compiled
+        paths (the bare criterion would silently drop the load-balance
+        pressure and let routing collapse)."""
+        crit = LlamaPretrainingCriterion(self.config)
+
+        def loss_fn(logits, labels):
+            loss = crit(logits, labels)
+            aux = self.llama.moe_aux_loss()
+            if aux is None or not self.config.moe_aux_loss_weight:
+                return loss
+            return loss + self.config.moe_aux_loss_weight * aux
+
+        return loss_fn
+
     def forward(self, input_ids, attention_mask=None, position_ids=None, labels=None,
                 past_key_values=None, cache_position=None, use_cache=False):
         if past_key_values is not None:
@@ -474,6 +546,15 @@ class LlamaForCausalLM(GenerationMixin, Layer):
                 logits = linalg.matmul(h, self.llama.embed_tokens.weight, transpose_y=True)
             return logits, presents
         h = self.llama(input_ids, attention_mask, position_ids)
+
+        def with_aux(loss):
+            # gate load-balance loss joins the CE loss (reference:
+            # moe_layer l_aux consumed by the trainer)
+            aux = self.llama.moe_aux_loss()
+            if aux is None or not self.config.moe_aux_loss_weight:
+                return loss
+            return loss + self.config.moe_aux_loss_weight * aux
+
         if self.config.fuse_linear_cross_entropy and (labels is not None or self.training):
             # hand (hidden, lm weight) to the fused CE so [B,S,vocab] logits
             # are never materialized (incubate fused_linear_cross_entropy);
@@ -486,7 +567,7 @@ class LlamaForCausalLM(GenerationMixin, Layer):
 
                 w = linalg.t(self.llama.embed_tokens.weight)
             if labels is not None:
-                return LlamaPretrainingCriterion(self.config)(h, w, labels)
+                return with_aux(LlamaPretrainingCriterion(self.config)(h, w, labels))
             return h, w
         if self.lm_head is not None:
             logits = self.lm_head(h)
@@ -495,7 +576,7 @@ class LlamaForCausalLM(GenerationMixin, Layer):
 
             logits = linalg.matmul(h, self.llama.embed_tokens.weight, transpose_y=True)
         if labels is not None:
-            return LlamaPretrainingCriterion(self.config)(logits, labels)
+            return with_aux(LlamaPretrainingCriterion(self.config)(logits, labels))
         return logits
 
     def num_parameters(self):

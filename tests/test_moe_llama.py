@@ -1,0 +1,95 @@
+"""Mixtral-class MoE LLaMA variant (reference ecosystem: incubate
+distributed.models.moe wired into a causal LM atop the fleet EP axis).
+
+Oracle strategy: the MoE model must train (loss falls, aux loss flows
+gradients into gate AND experts), and the expert-parallel step must match
+the single-device step on the same weights (SURVEY §4 parity)."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.models.llama import LlamaForCausalLM, LlamaPretrainingCriterion, llama_tiny
+
+
+def _moe_model(**kw):
+    paddle.seed(41)
+    cfg = llama_tiny(num_hidden_layers=2, num_experts=4, moe_top_k=2, **kw)
+    return LlamaForCausalLM(cfg), cfg
+
+
+def _batch(cfg, bs=8, seq=12, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, cfg.vocab_size, (bs, seq + 1)).astype(np.int32)
+    return paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
+
+
+class TestMoELlama:
+    def test_forward_loss_includes_aux_and_grads_reach_experts(self):
+        m, cfg = _moe_model()
+        x, y = _batch(cfg)
+        loss = m(x, labels=y)
+        assert np.isfinite(float(loss.numpy()))
+        aux = m.llama.moe_aux_loss()
+        assert aux is not None and np.isfinite(float(aux.numpy()))
+        # aux really joins the loss: zero-weight config gives a different loss
+        m2, cfg2 = _moe_model(moe_aux_loss_weight=0.0)
+        loss2 = m2(x, labels=y)
+        assert abs(float(loss.numpy()) - float(loss2.numpy())) > 0
+        loss.backward()
+        stack = m.llama.layers[0].mlp.experts
+        gate = m.llama.layers[0].mlp.gate
+        assert stack.w_gate.grad is not None
+        assert stack.w_down.grad is not None
+        assert any(p.grad is not None for p in gate.parameters())
+
+    def test_trains_loss_decreases(self):
+        m, cfg = _moe_model()
+        x, y = _batch(cfg, bs=8, seq=16, seed=3)
+        opt = optimizer.AdamW(learning_rate=3e-3, parameters=m.parameters())
+        losses = []
+        for _ in range(12):
+            loss = m(x, labels=y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_expert_parallel_step_matches_single_device(self):
+        """EP parity INCLUDING the gate aux loss: make_loss_fn reads the
+        same-trace gate losses inside the compiled step, so the distributed
+        first-step loss must equal the eager labeled forward (CE + aux)."""
+        from paddle_tpu.distributed import mesh as M
+        from paddle_tpu.distributed.train_step import DistributedTrainStep
+
+        m, cfg = _moe_model()
+        x, y = _batch(cfg, bs=8, seq=8, seed=5)
+        ref = float(m(x, labels=y).numpy())  # CE + aux (eager)
+
+        mesh = M.build_mesh(dp=4)  # experts + batch sharded on dp
+        with M.mesh_guard(mesh):
+            opt = optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+            step = DistributedTrainStep(m, m.make_loss_fn(), opt)
+            loss = step(x, y)
+        val = float(loss.numpy())
+        assert np.isfinite(val)
+        np.testing.assert_allclose(val, ref, rtol=2e-5, atol=2e-6)
+        # and the bare criterion really differs (aux dropped) — the trap
+        # make_loss_fn exists to avoid
+        m2, _ = _moe_model()
+        with M.mesh_guard(M.build_mesh(dp=4)):
+            opt2 = optimizer.AdamW(learning_rate=1e-3, parameters=m2.parameters())
+            bare = DistributedTrainStep(
+                m2, lambda out, labels: LlamaPretrainingCriterion()(out, labels), opt2)
+            bare_val = float(bare(x, y).numpy())
+        assert abs(bare_val - ref) > 1e-6
+
+    def test_generate_smoke(self):
+        m, cfg = _moe_model()
+        m.eval()
+        ids = np.random.RandomState(7).randint(1, cfg.vocab_size, (2, 7)).astype(np.int32)
+        out = m.generate(ids, max_new_tokens=4)
+        assert out.shape == [2, 11]
+        assert int(np.max(out.numpy())) < cfg.vocab_size
