@@ -100,9 +100,20 @@ class DistributedTrainStep(TrainStep):
     def _batch_spec(self, arr):
         if np.ndim(arr) == 0:
             return P()
+        # context parallelism: [B, S, ...] inputs additionally shard their
+        # SEQUENCE dim on the sep axis (the ring-attention island inside the
+        # model consumes exactly this layout; mesh.py sep row). Keyed on the
+        # MODEL's flag — a sep>1 mesh alone (e.g. Ulysses experiments) must
+        # not silently re-layout inputs the model consumes replicated.
+        sep = None
+        if (getattr(getattr(self.model, "config", None), "context_parallel", False)
+                and "sep" in self.mesh.axis_names and self.mesh.shape["sep"] > 1
+                and np.ndim(arr) >= 2
+                and np.shape(arr)[1] % self.mesh.shape["sep"] == 0):
+            sep = "sep"
         axes = tuple(a for a in self.batch_axes if a in self.mesh.axis_names and self.mesh.shape[a] > 1)
         if not axes:
-            return P()
+            return P(None, sep) if sep else P()
         total = int(np.prod([self.mesh.shape[a] for a in axes]))
         if np.shape(arr)[0] % total != 0:
             import warnings
@@ -112,8 +123,8 @@ class DistributedTrainStep(TrainStep):
                 "falling back to replicated input (no data parallelism for this array)",
                 stacklevel=3,
             )
-            return P()
-        return P(axes if len(axes) > 1 else axes[0])
+            return P(None, sep) if sep else P()
+        return P(axes if len(axes) > 1 else axes[0], sep)
 
     def _sharding_trees(self, batch_datas):
         p_spec = {k: self._param_spec(p) for k, p in self._trainable.items()}

@@ -52,6 +52,7 @@ class LlamaConfig:
         moe_top_k=2,
         moe_gate="gshard",
         moe_aux_loss_weight=0.01,
+        context_parallel=False,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -78,6 +79,12 @@ class LlamaConfig:
         self.moe_top_k = moe_top_k
         self.moe_gate = moe_gate
         self.moe_aux_loss_weight = moe_aux_loss_weight
+        # context/ring parallelism (SURVEY §5 long-context): the training
+        # attention runs as a ring over the sep mesh axis — sequence dim
+        # sharded across chips, KV shards rotating by ppermute
+        # (ops/ring_attention); DistributedTrainStep shards [B, S] inputs'
+        # seq dim on sep automatically.
+        self.context_parallel = context_parallel
 
     @property
     def head_dim(self):
@@ -231,6 +238,15 @@ class LlamaAttention(Layer):
             k = manipulation.concat([past_key_value[0], k], axis=1)
             v = manipulation.concat([past_key_value[1], v], axis=1)
         present = (k, v)
+        if self._use_context_parallel(past_key_value):
+            if attention_mask is not None:
+                raise ValueError(
+                    "context_parallel attention is causal-only: padding "
+                    "masks are not supported on the ring path (pack "
+                    "sequences instead)")
+            out = self._ring_attention(q, k, v)
+            out = manipulation.reshape(out, [B, S, self.num_heads * self.head_dim])
+            return self.o_proj(out), present
         # causal ALWAYS holds for the decoder; a user mask only adds padding.
         # [B, S] padding masks become additive [B, 1, 1, S].
         mask = attention_mask
@@ -240,6 +256,59 @@ class LlamaAttention(Layer):
                                              is_causal=True, training=self.training)
         out = manipulation.reshape(out, [B, S, self.num_heads * self.head_dim])
         return self.o_proj(out), present
+
+    def _use_context_parallel(self, past_key_value):
+        if not self.config.context_parallel or past_key_value is not None:
+            return False
+        from ..distributed.mesh import get_mesh, has_mesh
+
+        if not has_mesh():
+            return False
+        mesh = get_mesh()
+        if "sep" not in mesh.axis_names or mesh.shape["sep"] <= 1:
+            return False
+        return True
+
+    def _ring_attention(self, q, k, v):
+        """Ring/context-parallel attention island: the surrounding program
+        is GSPMD-global with the sequence dim sharded on sep
+        (DistributedTrainStep._batch_spec); this shard_map runs the
+        blockwise ring (ops/ring_attention — Pallas tier on TPU, causal by
+        GLOBAL positions) on the local shards. q/k/v: [B, S, H(kv), D]."""
+        import functools
+
+        import jax
+
+        from ..distributed.mesh import get_mesh
+        from ..framework.core import apply
+        from ..ops.ring_attention import ring_attention
+
+        mesh = get_mesh()
+        sep = mesh.shape["sep"]
+        if q.shape[1] % sep:
+            raise ValueError(
+                f"context_parallel: sequence length {q.shape[1]} is not "
+                f"divisible by the sep axis size {sep} — pad the sequence "
+                "or change the mesh")
+        # keep the batch axes and TP sharding INSIDE the island's layout:
+        # declaring them replicated would make GSPMD all-gather full-batch,
+        # all-head q/k/v and redo identical attention on every dp/mp rank
+        batch = tuple(a for a in ("dcn_dp", "dp", "sharding")
+                      if a in mesh.axis_names and mesh.shape[a] > 1)
+        bspec = batch if len(batch) != 1 else batch[0]
+        hspec = "mp" if ("mp" in mesh.axis_names and mesh.shape["mp"] > 1) else None
+        spec = P(bspec if batch else None, hspec, "sep", None)
+        ring = jax.shard_map(
+            functools.partial(ring_attention, axis_name="sep", causal=True),
+            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+        )
+
+        def fn(qd, kd, vd):
+            out = ring(jnp.swapaxes(qd, 1, 2), jnp.swapaxes(kd, 1, 2),
+                       jnp.swapaxes(vd, 1, 2))
+            return jnp.swapaxes(out, 1, 2)  # back to [B, S, H, D]
+
+        return apply(fn, q, k, v, name="ring_attention_cp")
 
 
 class LlamaMLP(Layer):
@@ -511,6 +580,15 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         else:
             self.lm_head = _mk_linear(config.hidden_size, config.vocab_size, P(None, "mp"))
 
+    def _apply_moe_aux(self, loss):
+        """Add the same-trace gate load-balance loss (reference: moe_layer
+        l_aux consumed by the trainer) — the ONE implementation shared by
+        the labeled forward and make_loss_fn."""
+        aux = self.llama.moe_aux_loss()
+        if aux is None or not self.config.moe_aux_loss_weight:
+            return loss
+        return loss + self.config.moe_aux_loss_weight * aux
+
     def make_loss_fn(self):
         """loss_fn for TrainStep/DistributedTrainStep (loss_fn(logits,
         labels)) that INCLUDES the MoE gate aux loss. The compiled step
@@ -522,11 +600,7 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         crit = LlamaPretrainingCriterion(self.config)
 
         def loss_fn(logits, labels):
-            loss = crit(logits, labels)
-            aux = self.llama.moe_aux_loss()
-            if aux is None or not self.config.moe_aux_loss_weight:
-                return loss
-            return loss + self.config.moe_aux_loss_weight * aux
+            return self._apply_moe_aux(crit(logits, labels))
 
         return loss_fn
 
@@ -546,15 +620,7 @@ class LlamaForCausalLM(GenerationMixin, Layer):
                 logits = linalg.matmul(h, self.llama.embed_tokens.weight, transpose_y=True)
             return logits, presents
         h = self.llama(input_ids, attention_mask, position_ids)
-
-        def with_aux(loss):
-            # gate load-balance loss joins the CE loss (reference:
-            # moe_layer l_aux consumed by the trainer)
-            aux = self.llama.moe_aux_loss()
-            if aux is None or not self.config.moe_aux_loss_weight:
-                return loss
-            return loss + self.config.moe_aux_loss_weight * aux
-
+        with_aux = self._apply_moe_aux
         if self.config.fuse_linear_cross_entropy and (labels is not None or self.training):
             # hand (hidden, lm weight) to the fused CE so [B,S,vocab] logits
             # are never materialized (incubate fused_linear_cross_entropy);
