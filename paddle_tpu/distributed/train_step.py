@@ -124,7 +124,10 @@ class DistributedTrainStep(TrainStep):
                 stacklevel=3,
             )
             return P(None, sep) if sep else P()
-        return P(axes if len(axes) > 1 else axes[0], sep)
+        base = axes if len(axes) > 1 else axes[0]
+        # no trailing None entry when sep is unused: a rank-1 input (e.g.
+        # [B] labels) cannot carry a length-2 spec
+        return P(base, sep) if sep else P(base)
 
     def _sharding_trees(self, batch_datas):
         p_spec = {k: self._param_spec(p) for k, p in self._trainable.items()}

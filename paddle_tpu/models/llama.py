@@ -79,11 +79,17 @@ class LlamaConfig:
         self.moe_top_k = moe_top_k
         self.moe_gate = moe_gate
         self.moe_aux_loss_weight = moe_aux_loss_weight
-        # context/ring parallelism (SURVEY §5 long-context): the training
-        # attention runs as a ring over the sep mesh axis — sequence dim
-        # sharded across chips, KV shards rotating by ppermute
-        # (ops/ring_attention); DistributedTrainStep shards [B, S] inputs'
-        # seq dim on sep automatically.
+        # context/sequence parallelism over the sep mesh axis (SURVEY §5
+        # long-context): True/"ring" = ring attention (KV shards rotate by
+        # ppermute, blockwise tiles); "ulysses" = DeepSpeed-Ulysses style
+        # (two all_to_alls swap seq-sharding for head-sharding around full
+        # attention — needs heads and kv heads divisible by sep).
+        # DistributedTrainStep shards [B, S] inputs' seq dim on sep
+        # automatically either way.
+        if context_parallel not in (False, True, "ring", "ulysses"):
+            raise ValueError(
+                f"context_parallel must be False/True/'ring'/'ulysses', "
+                f"got {context_parallel!r}")
         self.context_parallel = context_parallel
 
     @property
@@ -270,18 +276,19 @@ class LlamaAttention(Layer):
         return True
 
     def _ring_attention(self, q, k, v):
-        """Ring/context-parallel attention island: the surrounding program
-        is GSPMD-global with the sequence dim sharded on sep
-        (DistributedTrainStep._batch_spec); this shard_map runs the
+        """Context-parallel attention island: the surrounding program is
+        GSPMD-global with the sequence dim sharded on sep
+        (DistributedTrainStep._batch_spec); this shard_map runs either the
         blockwise ring (ops/ring_attention — Pallas tier on TPU, causal by
-        GLOBAL positions) on the local shards. q/k/v: [B, S, H(kv), D]."""
+        GLOBAL positions) or the Ulysses all-to-all pair on the local
+        shards. q/k/v: [B, S, H(kv), D]."""
         import functools
 
         import jax
 
         from ..distributed.mesh import get_mesh
         from ..framework.core import apply
-        from ..ops.ring_attention import ring_attention
+        from ..ops.ring_attention import ring_attention, ulysses_attention
 
         mesh = get_mesh()
         sep = mesh.shape["sep"]
@@ -290,25 +297,59 @@ class LlamaAttention(Layer):
                 f"context_parallel: sequence length {q.shape[1]} is not "
                 f"divisible by the sep axis size {sep} — pad the sequence "
                 "or change the mesh")
+        ulysses = self.config.context_parallel == "ulysses"
         # keep the batch axes and TP sharding INSIDE the island's layout:
         # declaring them replicated would make GSPMD all-gather full-batch,
         # all-head q/k/v and redo identical attention on every dp/mp rank
         batch = tuple(a for a in ("dcn_dp", "dp", "sharding")
                       if a in mesh.axis_names and mesh.shape[a] > 1)
         bspec = batch if len(batch) != 1 else batch[0]
-        hspec = "mp" if ("mp" in mesh.axis_names and mesh.shape["mp"] > 1) else None
-        spec = P(bspec if batch else None, hspec, "sep", None)
-        ring = jax.shard_map(
-            functools.partial(ring_attention, axis_name="sep", causal=True),
-            mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
-        )
+        mp = mesh.shape.get("mp", 1) if "mp" in mesh.axis_names else 1
+        hspec = "mp" if mp > 1 else None
+        if ulysses:
+            group = q.shape[2] // k.shape[2]  # GQA: kv expands before the a2a
+            hq_local = q.shape[2] // mp
+            if hq_local % sep:
+                raise ValueError(
+                    f"context_parallel='ulysses' needs per-mp-rank head "
+                    f"count divisible by sep={sep} (got {hq_local}) — use "
+                    "'ring' instead (which keeps kv heads unexpanded)")
+            # ulysses layout is [B, S, H, D]: seq on dim 1, heads on dim 2.
+            # attn_impl: the flash tier (Pallas kernel on TPU), NOT the
+            # dense default — full-sequence scores per head-group at long
+            # context is exactly what CP exists to avoid
+            from ..ops.flash_attention import flash_attention_fwd
 
-        def fn(qd, kd, vd):
-            out = ring(jnp.swapaxes(qd, 1, 2), jnp.swapaxes(kd, 1, 2),
-                       jnp.swapaxes(vd, 1, 2))
-            return jnp.swapaxes(out, 1, 2)  # back to [B, S, H, D]
+            island = jax.shard_map(
+                functools.partial(
+                    ulysses_attention, axis_name="sep", causal=True,
+                    attn_impl=lambda qq, kk, vv: flash_attention_fwd(
+                        qq, kk, vv, causal=True),
+                ),
+                mesh=mesh,
+                in_specs=(P(bspec if batch else None, "sep", hspec, None),) * 3,
+                out_specs=P(bspec if batch else None, "sep", hspec, None),
+                check_vma=False,
+            )
 
-        return apply(fn, q, k, v, name="ring_attention_cp")
+            def fn(qd, kd, vd):
+                if group > 1:
+                    kd = jnp.repeat(kd, group, axis=2)
+                    vd = jnp.repeat(vd, group, axis=2)
+                return island(qd, kd, vd)
+        else:
+            spec = P(bspec if batch else None, hspec, "sep", None)
+            island = jax.shard_map(
+                functools.partial(ring_attention, axis_name="sep", causal=True),
+                mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+            )
+
+            def fn(qd, kd, vd):
+                out = island(jnp.swapaxes(qd, 1, 2), jnp.swapaxes(kd, 1, 2),
+                             jnp.swapaxes(vd, 1, 2))
+                return jnp.swapaxes(out, 1, 2)  # back to [B, S, H, D]
+
+        return apply(fn, q, k, v, name="ulysses_cp" if ulysses else "ring_attention_cp")
 
 
 class LlamaMLP(Layer):

@@ -19,7 +19,8 @@ from paddle_tpu.models.llama import (
 
 def _setup(seq=32, bs=4, **cfg_kw):
     paddle.seed(51)
-    cfg = llama_tiny(num_hidden_layers=2, context_parallel=True, **cfg_kw)
+    cfg_kw.setdefault("context_parallel", True)
+    cfg = llama_tiny(num_hidden_layers=2, **cfg_kw)
     m = LlamaForCausalLM(cfg)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (bs, seq + 1)).astype(np.int32)
@@ -75,6 +76,21 @@ def test_cp_rejects_non_divisible_seq():
             m(x, labels=y)
 
 
+@pytest.mark.parametrize("kv_heads", [8, 4])
+def test_cp_ulysses_parity(kv_heads):
+    """context_parallel='ulysses': the all-to-all pair replaces the ring
+    (GQA kv heads expand before the a2a)."""
+    m, cfg, x, y, ref = _setup(num_attention_heads=8,
+                               num_key_value_heads=kv_heads,
+                               context_parallel="ulysses")
+    with M.mesh_guard(M.build_mesh(sep=4)):
+        opt = optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        step = DistributedTrainStep(
+            m, lambda out, labels: LlamaPretrainingCriterion()(out, labels), opt)
+        loss = step(x, y)
+    np.testing.assert_allclose(float(loss.numpy()), ref, rtol=2e-5, atol=2e-6)
+
+
 def test_cp_trains_to_descent():
     m, cfg, x, y, _ = _setup(seq=16)
     mesh = M.build_mesh(sep=4)
@@ -92,3 +108,19 @@ def test_cp_rejects_padding_mask():
     with M.mesh_guard(M.build_mesh(sep=4)):
         with pytest.raises(ValueError, match="causal-only"):
             m(x, attention_mask=mask)
+
+
+def test_batch_spec_rank1_inputs_unaffected():
+    """Regression: sep support must not give rank-1 batch inputs (e.g. [B]
+    labels) a length-2 PartitionSpec."""
+    from jax.sharding import PartitionSpec as P
+
+    m, cfg, x, y, _ = _setup(seq=16)
+    with M.mesh_guard(M.build_mesh(dp=4, sep=2)):
+        opt = optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        step = DistributedTrainStep(
+            m, lambda out, labels: LlamaPretrainingCriterion()(out, labels), opt)
+        assert step._batch_spec(np.zeros(8, np.float32)) == P("dp")
+        assert step._batch_spec(np.zeros((8, 16), np.int32)) == P("dp", "sep")
+        # odd seq dim: sep skipped, still a clean batch-only spec
+        assert step._batch_spec(np.zeros((8, 15), np.int32)) == P("dp")
