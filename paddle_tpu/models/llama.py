@@ -82,8 +82,9 @@ class LlamaConfig:
         # context/sequence parallelism over the sep mesh axis (SURVEY §5
         # long-context): True/"ring" = ring attention (KV shards rotate by
         # ppermute, blockwise tiles); "ulysses" = DeepSpeed-Ulysses style
-        # (two all_to_alls swap seq-sharding for head-sharding around full
-        # attention — needs heads and kv heads divisible by sep).
+        # (two all_to_alls swap seq-sharding for head-sharding around
+        # flash-tier attention — needs per-mp-rank Q heads divisible by
+        # sep; GQA kv heads ride the a2a unexpanded when also divisible).
         # DistributedTrainStep shards [B, S] inputs' seq dim on sep
         # automatically either way.
         if context_parallel not in (False, True, "ring", "ulysses"):
@@ -307,13 +308,19 @@ class LlamaAttention(Layer):
         mp = mesh.shape.get("mp", 1) if "mp" in mesh.axis_names else 1
         hspec = "mp" if mp > 1 else None
         if ulysses:
-            group = q.shape[2] // k.shape[2]  # GQA: kv expands before the a2a
             hq_local = q.shape[2] // mp
+            hkv_local = k.shape[2] // mp
             if hq_local % sep:
                 raise ValueError(
                     f"context_parallel='ulysses' needs per-mp-rank head "
                     f"count divisible by sep={sep} (got {hq_local}) — use "
                     "'ring' instead (which keeps kv heads unexpanded)")
+            # GQA: keep kv UNEXPANDED through the a2a when its head count
+            # splits over sep (flash_attention_fwd handles hq != hk natively
+            # — splash kernel on TPU); pre-expand only as the fallback,
+            # which costs group x the KV a2a bytes
+            group = q.shape[2] // k.shape[2]
+            pre_expand = group > 1 and hkv_local % sep != 0
             # ulysses layout is [B, S, H, D]: seq on dim 1, heads on dim 2.
             # attn_impl: the flash tier (Pallas kernel on TPU), NOT the
             # dense default — full-sequence scores per head-group at long
@@ -333,7 +340,7 @@ class LlamaAttention(Layer):
             )
 
             def fn(qd, kd, vd):
-                if group > 1:
+                if pre_expand:
                     kd = jnp.repeat(kd, group, axis=2)
                     vd = jnp.repeat(vd, group, axis=2)
                 return island(qd, kd, vd)

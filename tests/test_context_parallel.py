@@ -76,7 +76,7 @@ def test_cp_rejects_non_divisible_seq():
             m(x, labels=y)
 
 
-@pytest.mark.parametrize("kv_heads", [8, 4])
+@pytest.mark.parametrize("kv_heads", [8, 4, 2])
 def test_cp_ulysses_parity(kv_heads):
     """context_parallel='ulysses': the all-to-all pair replaces the ring
     (GQA kv heads expand before the a2a)."""
