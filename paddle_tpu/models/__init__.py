@@ -1,5 +1,5 @@
 """Model zoo — flagship LLM families (BASELINE configs 2-5)."""
-from . import bert, gpt, llama
+from . import bert, gpt, hf_compat, llama
 from .bert import BertConfig, BertForPretraining, BertForSequenceClassification, BertModel
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel
 from .llama import (

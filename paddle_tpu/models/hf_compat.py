@@ -1,0 +1,143 @@
+"""HuggingFace checkpoint interop for the flagship model (reference
+capability: PaddleNLP from_pretrained/save_pretrained conversion between
+ecosystems). Local tensors only — no hub access.
+
+Weight layout notes: torch nn.Linear stores [out, in]; this framework's
+Linear stores [in, out] → every projection transposes. The rope convention
+matches (rotate-half / NeoX-style, cos/sin tables over concatenated
+half-dims), so converted models agree with HF logits to float tolerance —
+asserted against the real transformers implementation in
+tests/test_hf_compat.py.
+"""
+import numpy as np
+
+
+
+def _t(w):
+    return np.asarray(w, np.float32).T
+
+
+def _same(w):
+    return np.asarray(w, np.float32)
+
+
+def hf_to_paddle_tpu_state(hf_state, tie_word_embeddings=False):
+    """Map a transformers LlamaForCausalLM state_dict (torch tensors or
+    arrays) onto this framework's parameter names/layouts. Returns a dict
+    name -> np.ndarray."""
+    def grab(k):
+        v = hf_state[k]
+        if hasattr(v, "detach"):
+            # .float() first: numpy cannot represent torch.bfloat16 (the
+            # standard dtype of modern Llama checkpoints)
+            return v.detach().float().cpu().numpy()
+        return np.asarray(v)
+
+    out = {"llama.embed_tokens.weight": _same(grab("model.embed_tokens.weight")),
+           "llama.norm.weight": _same(grab("model.norm.weight"))}
+    i = 0
+    while f"model.layers.{i}.self_attn.q_proj.weight" in hf_state:
+        pre = f"model.layers.{i}"
+        mine = f"llama.layers.{i}"
+        for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            out[f"{mine}.self_attn.{name}.weight"] = _t(
+                grab(f"{pre}.self_attn.{name}.weight"))
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            out[f"{mine}.mlp.{name}.weight"] = _t(grab(f"{pre}.mlp.{name}.weight"))
+        out[f"{mine}.input_layernorm.weight"] = _same(
+            grab(f"{pre}.input_layernorm.weight"))
+        out[f"{mine}.post_attention_layernorm.weight"] = _same(
+            grab(f"{pre}.post_attention_layernorm.weight"))
+        i += 1
+    if not tie_word_embeddings and "lm_head.weight" in hf_state:
+        out["lm_head.weight"] = _t(grab("lm_head.weight"))
+    return out
+
+
+def paddle_tpu_to_hf_state(model):
+    """Inverse mapping: this framework's LlamaForCausalLM -> an HF-layout
+    state dict of numpy arrays (load with torch.from_numpy +
+    hf_model.load_state_dict)."""
+    sd = {k: np.asarray(v._data, np.float32) for k, v in model.named_parameters()}
+    out = {"model.embed_tokens.weight": sd["llama.embed_tokens.weight"],
+           "model.norm.weight": sd["llama.norm.weight"]}
+    n = model.config.num_hidden_layers
+    for i in range(n):
+        pre = f"model.layers.{i}"
+        mine = f"llama.layers.{i}"
+        for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            out[f"{pre}.self_attn.{name}.weight"] = sd[f"{mine}.self_attn.{name}.weight"].T
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            out[f"{pre}.mlp.{name}.weight"] = sd[f"{mine}.mlp.{name}.weight"].T
+        out[f"{pre}.input_layernorm.weight"] = sd[f"{mine}.input_layernorm.weight"]
+        out[f"{pre}.post_attention_layernorm.weight"] = sd[f"{mine}.post_attention_layernorm.weight"]
+    if "lm_head.weight" in sd:
+        out["lm_head.weight"] = sd["lm_head.weight"].T
+    elif model.config.tie_word_embeddings:
+        out["lm_head.weight"] = sd["llama.embed_tokens.weight"]
+    return out
+
+
+def load_hf_llama(model, hf_model_or_state):
+    """Load a transformers LlamaForCausalLM (instance or state_dict) into
+    this framework's same-config LlamaForCausalLM, in place."""
+    state = (hf_model_or_state.state_dict()
+             if hasattr(hf_model_or_state, "state_dict") else hf_model_or_state)
+    mapped = hf_to_paddle_tpu_state(state, model.config.tie_word_embeddings)
+    params = dict(model.named_parameters())
+    missing = set(params) - set(mapped)
+    extra = set(mapped) - set(params)
+    if missing or extra:
+        raise ValueError(
+            f"HF checkpoint/model mismatch — missing from checkpoint: "
+            f"{sorted(missing)[:5]}, unexpected in checkpoint: "
+            f"{sorted(extra)[:5]} (layer count / tie_word_embeddings?)")
+    for name, arr in mapped.items():
+        p = params[name]
+        if tuple(p.shape) != arr.shape:
+            raise ValueError(
+                f"{name}: shape {arr.shape} != model {tuple(p.shape)} — "
+                "config mismatch?")
+        p.set_value(arr)
+    return model
+
+
+def config_from_hf(hf_config, **overrides):
+    """Build this framework's LlamaConfig from a transformers LlamaConfig."""
+    from .llama import LlamaConfig
+
+    # refuse what this framework does not model rather than silently
+    # diverging from HF logits (the module's parity contract)
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        raise NotImplementedError(
+            f"rope_scaling={scaling!r} is not supported — plain rope only")
+    if getattr(hf_config, "attention_bias", False) or getattr(
+            hf_config, "mlp_bias", False):
+        raise NotImplementedError(
+            "attention_bias/mlp_bias checkpoints are not supported (this "
+            "framework's llama projections are bias-free)")
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_hidden_layers=hf_config.num_hidden_layers,
+        num_attention_heads=hf_config.num_attention_heads,
+        num_key_value_heads=getattr(hf_config, "num_key_value_heads", None),
+        max_position_embeddings=hf_config.max_position_embeddings,
+        rms_norm_eps=hf_config.rms_norm_eps,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+    )
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def from_hf(hf_model, **config_overrides):
+    """One-call conversion: transformers LlamaForCausalLM -> this
+    framework's LlamaForCausalLM with the same weights."""
+    from .llama import LlamaForCausalLM
+
+    cfg = config_from_hf(hf_model.config, **config_overrides)
+    model = LlamaForCausalLM(cfg)
+    return load_hf_llama(model, hf_model)
