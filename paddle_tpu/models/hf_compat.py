@@ -117,6 +117,11 @@ def config_from_hf(hf_config, **overrides):
         raise NotImplementedError(
             "attention_bias/mlp_bias checkpoints are not supported (this "
             "framework's llama projections are bias-free)")
+    explicit_hd = getattr(hf_config, "head_dim", None)
+    if explicit_hd and explicit_hd != hf_config.hidden_size // hf_config.num_attention_heads:
+        raise NotImplementedError(
+            f"explicit head_dim={explicit_hd} != hidden/heads — this "
+            "framework derives head_dim and cannot honor the override")
     kw = dict(
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,

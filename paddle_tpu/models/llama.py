@@ -148,7 +148,7 @@ class LlamaAttention(Layer):
         self.o_proj = _mk_linear(self.num_heads * self.head_dim, h, P("mp", None))
 
     def forward(self, hidden_states, attention_mask=None, position_ids=None,
-                past_key_value=None, cache_position=None):
+                past_key_value=None, cache_position=None, segment_ids=None):
         """past_key_value:
         - None: plain causal attention;
         - (k, v) without cache_position: legacy growing-concat cache (eager);
@@ -170,6 +170,10 @@ class LlamaAttention(Layer):
         k = manipulation.reshape(self.k_proj(hidden_states), [B, S, self.num_kv_heads, self.head_dim])
         v = manipulation.reshape(self.v_proj(hidden_states), [B, S, self.num_kv_heads, self.head_dim])
         paged = isinstance(past_key_value, PagedLayerCache)
+        if segment_ids is not None and (past_key_value is not None
+                                        or cache_position is not None):
+            raise ValueError("packed segment_ids do not compose with a "
+                             "decode cache — packing is a training path")
         rope_kw = {}
         if cache_position is not None or paged:
             if position_ids is None and cache_position is not None:
@@ -245,6 +249,21 @@ class LlamaAttention(Layer):
             k = manipulation.concat([past_key_value[0], k], axis=1)
             v = manipulation.concat([past_key_value[1], v], axis=1)
         present = (k, v)
+        if segment_ids is not None:
+            if attention_mask is not None:
+                raise ValueError(
+                    "packed segment_ids and attention_mask are exclusive — "
+                    "give padding its own segment id instead")
+            from ..framework.core import apply
+            from ..ops.flash_attention import flash_attention_packed
+
+            out = apply(
+                lambda qd, kd, vd: flash_attention_packed(
+                    qd, kd, vd, segment_ids._data if hasattr(segment_ids, "_data")
+                    else segment_ids, causal=True),
+                q, k, v, name="flash_attention_packed")
+            out = manipulation.reshape(out, [B, S, self.num_heads * self.head_dim])
+            return self.o_proj(out), present
         if self._use_context_parallel(past_key_value):
             if attention_mask is not None:
                 raise ValueError(
@@ -399,11 +418,12 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, hidden_states, attention_mask=None, position_ids=None,
-                past_key_value=None, cache_position=None):
+                past_key_value=None, cache_position=None, segment_ids=None):
         residual = hidden_states
         h, present = self.self_attn(
             self.input_layernorm(hidden_states), attention_mask, position_ids,
             past_key_value=past_key_value, cache_position=cache_position,
+            segment_ids=segment_ids,
         )
         h = residual + h
         residual = h
@@ -426,7 +446,15 @@ class LlamaModel(Layer):
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, input_ids, attention_mask=None, position_ids=None,
-                past_key_values=None, cache_position=None, use_cache=False):
+                past_key_values=None, cache_position=None, use_cache=False,
+                segment_ids=None):
+        if segment_ids is not None and position_ids is None:
+            # rope restarts at every packed segment boundary
+            from ..framework.core import Tensor as _T
+            from ..ops.flash_attention import packed_position_ids
+
+            raw = segment_ids._data if hasattr(segment_ids, "_data") else segment_ids
+            position_ids = _T(packed_position_ids(raw), stop_gradient=True)
         h = self.embed_tokens(input_ids)
         if self.config.sequence_parallel:
             h = _seq_shard(h)
@@ -446,9 +474,11 @@ class LlamaModel(Layer):
                 from ..distributed.fleet.recompute import recompute
 
                 h = recompute(layer, h, attention_mask, position_ids,
-                              policy=self.config.recompute_policy)
+                              policy=self.config.recompute_policy,
+                              segment_ids=segment_ids)
             else:
-                h = layer(h, attention_mask, position_ids)
+                h = layer(h, attention_mask, position_ids,
+                          segment_ids=segment_ids)
         out = self.norm(h)
         if presents is not None and past_key_values is not None:
             return out, presents
@@ -653,8 +683,12 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         return loss_fn
 
     def forward(self, input_ids, attention_mask=None, position_ids=None, labels=None,
-                past_key_values=None, cache_position=None, use_cache=False):
+                past_key_values=None, cache_position=None, use_cache=False,
+                segment_ids=None):
         if past_key_values is not None:
+            if segment_ids is not None:
+                raise ValueError("packed segment_ids do not compose with a "
+                                 "decode cache — packing is a training path")
             h, presents = self.llama(
                 input_ids, attention_mask, position_ids,
                 past_key_values=past_key_values, cache_position=cache_position,
@@ -667,7 +701,8 @@ class LlamaForCausalLM(GenerationMixin, Layer):
 
                 logits = linalg.matmul(h, self.llama.embed_tokens.weight, transpose_y=True)
             return logits, presents
-        h = self.llama(input_ids, attention_mask, position_ids)
+        h = self.llama(input_ids, attention_mask, position_ids,
+                       segment_ids=segment_ids)
         with_aux = self._apply_moe_aux
         if self.config.fuse_linear_cross_entropy and (labels is not None or self.training):
             # hand (hidden, lm weight) to the fused CE so [B,S,vocab] logits

@@ -252,6 +252,74 @@ def _dense_varlen(q, k, v, cu_q, cu_k, causal, scale):
     return jnp.einsum("hqk,khd->qhd", probs, v)
 
 
+def flash_attention_packed(q, k, v, segment_ids, causal=True, scale=None):
+    """Packed-sequence ([B, S] segment ids, contiguous per row) attention in
+    the paddle [B, S, H, D] layout (reference capability: flash_mask /
+    attn_mask_startend_row_indices SFT packing). Tokens attend only within
+    their own segment, causally. TPU: the splash kernel with SegmentIds,
+    vmapped over the batch; fallback: dense same-segment ∧ causal mask."""
+    global LAST_IMPL
+    scale = scale if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    hq, hk = qt.shape[1], kt.shape[1]
+    seg = jnp.asarray(segment_ids, jnp.int32)
+    head_dim = qt.shape[-1]
+    dim_ok = head_dim % 128 == 0 or head_dim in (64, 96, 128, 256)
+    aligned = qt.shape[2] % 128 == 0
+    if _on_tpu() and dim_ok and aligned and not _FORCE_XLA:
+        try:
+            from jax.experimental.pallas.ops.tpu.splash_attention import (
+                splash_attention_kernel as sk,
+                splash_attention_mask as sm,
+            )
+
+            S = qt.shape[2]
+            key = ("packed", hq, S, causal)
+            kernel = _SPLASH_CACHE.get(key)
+            if kernel is None:
+                mk = sm.CausalMask if causal else (lambda shape: sm.FullMask(shape))
+                mask = sm.MultiHeadMask([mk((S, S)) for _ in range(hq)])
+                kernel = sk.make_splash_mha(mask=mask, head_shards=1,
+                                            q_seq_shards=1)
+                _SPLASH_CACHE[key] = kernel
+            # splash is GQA-native: kv heads stay unexpanded in kb/vb
+            def one(qb, kb, vb, sb):
+                return kernel((qb * scale).astype(vb.dtype), kb, vb,
+                              segment_ids=sk.SegmentIds(q=sb, kv=sb))
+
+            out = jax.vmap(one)(qt, kt, vt, seg)
+            LAST_IMPL = "splash-packed"
+            return jnp.swapaxes(out, 1, 2)
+        except Exception:
+            pass
+    # dense fallback: same-segment ∧ causal, per batch row
+    if hq != hk:
+        kt = jnp.repeat(kt, hq // hk, axis=1)
+        vt = jnp.repeat(vt, hq // hk, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt).astype(jnp.float32) * scale
+    mask = seg[:, None, :, None] == seg[:, None, None, :]
+    if causal:
+        S = qt.shape[2]
+        mask = mask & jnp.tril(jnp.ones((S, S), bool))[None, None]
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(qt.dtype)
+    LAST_IMPL = "xla-packed"
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", probs, vt), 1, 2)
+
+
+def packed_position_ids(segment_ids):
+    """[B, S] within-segment positions for rope: arange minus each token's
+    segment start (segments contiguous & ascending per packing contract)."""
+    seg = jnp.asarray(segment_ids, jnp.int32)
+    S = seg.shape[-1]
+
+    def row(sr):
+        start = jnp.searchsorted(sr, sr, side="left")
+        return jnp.arange(S, dtype=jnp.int32) - start.astype(jnp.int32)
+
+    return jax.vmap(row)(seg)
+
+
 def flash_attention_fwd(q, k, v, causal=False, scale=None):
     """q/k/v: [batch, seq, heads, head_dim] (paddle layout)."""
     global LAST_IMPL
