@@ -19,6 +19,15 @@ from paddle_tpu.ops.paged_attention import (
 )
 
 
+def _tiny_model():
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(31)
+    m = LlamaForCausalLM(llama_tiny(num_hidden_layers=2))
+    m.eval()
+    return m, m.config
+
+
 class TestPagedAttentionOp:
     def test_matches_dense_attention(self):
         rng = np.random.RandomState(0)
@@ -66,12 +75,7 @@ class TestPagedAttentionOp:
 
 class TestContinuousBatching:
     def _model(self):
-        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
-
-        paddle.seed(31)
-        m = LlamaForCausalLM(llama_tiny(num_hidden_layers=2))
-        m.eval()
-        return m, m.config
+        return _tiny_model()
 
     def test_matches_dense_generate_mixed_lengths(self):
         """5 mixed-length requests through 2 slots and a small pool must
@@ -226,11 +230,7 @@ class TestInt8KVPool:
                                    rtol=0.1, atol=0.05)
 
     def test_engine_serves_and_pool_is_smaller(self):
-        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
-
-        paddle.seed(31)
-        m = LlamaForCausalLM(llama_tiny(num_hidden_layers=2))
-        m.eval()
+        m, _ = _tiny_model()
         rng = np.random.RandomState(12)
         prompts = [rng.randint(1, m.config.vocab_size, (l,)).astype(np.int32)
                    for l in [5, 9]]
@@ -250,3 +250,41 @@ class TestInt8KVPool:
             # the FIRST generated token comes from the exact dense prefill
             # (before any int8 round-trip) — must match the float engine
             assert o[len(p)] == r[len(p)], (o, r)
+
+
+class TestServingFuzz:
+    def test_random_request_storms_match_dense(self):
+        """Fuzz the scheduler: random prompt lengths, request counts,
+        max_new, eos on/off, page sizes — every request's greedy output must
+        equal its dense generate() regardless of queueing/retire order."""
+        m, _ = _tiny_model()
+        V = m.config.vocab_size
+        rng = np.random.RandomState(99)
+        for trial in range(4):
+            n_req = int(rng.randint(1, 7))
+            prompts = [rng.randint(1, V, (int(rng.randint(3, 20)),)).astype(np.int32)
+                       for _ in range(n_req)]
+            new = int(rng.randint(1, 7))
+            eos = int(rng.randint(1, V)) if trial % 2 else None
+            eng = ContinuousBatchingEngine(
+                m, max_seqs=int(rng.randint(1, 4)),
+                page_size=int(rng.choice([4, 8, 16])),
+                max_len=64)
+            outs = eng.serve(prompts, max_new_tokens=new, eos_token_id=eos)
+            for i, (p, o) in enumerate(zip(prompts, outs)):
+                full = m.generate(p[None], max_new_tokens=new,
+                                  eos_token_id=eos).numpy()[0]
+                # dense generate pads AFTER eos; the engine stops — compare
+                # up to the engine's (possibly shorter) length
+                np.testing.assert_array_equal(
+                    o, full[:len(o)], err_msg=f"trial {trial} req {i}")
+                if eos is None:
+                    # no early stop possible: the engine must deliver every
+                    # requested token (prefix-match alone would let silent
+                    # truncation pass)
+                    assert len(o) == len(p) + new, (trial, i, len(o))
+                elif len(o) < len(full):
+                    assert o[-1] == eos  # engine stopped exactly at eos
+            # no leaks after every storm
+            assert len(eng.free_pages) == eng.num_pages - 1
+            assert sorted(eng.free_slots) == list(range(eng.max_seqs))
