@@ -146,3 +146,17 @@ def data_sharding(batch_axes=("dcn_dp", "dp", "sharding")):
     if not axes:
         return replicated()
     return NamedSharding(mesh, PartitionSpec(axes))
+
+
+def inside_manual_pp():
+    """True when tracing INSIDE the scheduled pipeline engine's shard_map
+    (the pp axis is bound as a manual axis). Sites that adapt behavior to
+    the engine (sequence-parallel hint, context-parallel guard) share this
+    single predicate."""
+    import jax
+
+    try:
+        jax.lax.axis_index("pp")
+        return True
+    except NameError:
+        return False

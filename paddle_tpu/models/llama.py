@@ -293,6 +293,16 @@ class LlamaAttention(Layer):
         mesh = get_mesh()
         if "sep" not in mesh.axis_names or mesh.shape["sep"] <= 1:
             return False
+        from ..distributed.mesh import inside_manual_pp
+
+        if inside_manual_pp():
+            # inside the scheduled pipeline engine the pp axis is manual and
+            # a nested sep shard_map cannot apply — refuse loudly rather
+            # than silently computing non-CP attention on CP-sharded inputs
+            raise ValueError(
+                "context_parallel does not compose with the scheduled "
+                "pipeline engine yet — run CP on the GSPMD path "
+                "(dp/mp/sharding x sep) or pipeline without CP")
         return True
 
     def _ring_attention(self, q, k, v):
@@ -517,14 +527,13 @@ def _seq_shard(h):
     mesh = get_mesh()
     if "mp" not in mesh.axis_names or mesh.shape["mp"] == 1:
         return h
-    try:
+    from ..distributed.mesh import inside_manual_pp
+
+    if inside_manual_pp():
         # inside the scheduled engine's shard_map the pp axis is manual and
         # a GSPMD constraint cannot apply to pp-varying values — SP sharding
         # there is GSPMD's job via the weight specs, so skip the hint
-        jax.lax.axis_index("pp")
         return h
-    except NameError:
-        pass
     sharding = jax.sharding.NamedSharding(mesh, P(None, "mp", None))
     return apply(lambda a: jax.lax.with_sharding_constraint(a, sharding), h, name="seq_shard")
 
@@ -599,6 +608,14 @@ class LlamaForCausalLMPipe(PipelineModule):
 
         if schedule not in self.SCHEDULES:
             raise ValueError(f"schedule must be one of {self.SCHEDULES}, got {schedule!r}")
+        if config.num_experts > 1 and config.moe_aux_loss_weight:
+            import warnings
+
+            warnings.warn(
+                "pipelined MoE trains the CE objective only: the gate "
+                "load-balance aux loss is not threaded through the "
+                "scheduled engine's hand-built loss yet (eager/GSPMD paths "
+                "include it via make_loss_fn)", stacklevel=2)
         if schedule == "fthenb" and virtual_pp_degree > 1:
             raise ValueError("virtual_pp_degree > 1 needs schedule '1f1b' or 'vpp'")
         tied = config.tie_word_embeddings

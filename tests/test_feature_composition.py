@@ -139,3 +139,49 @@ def test_moe_cp_together():
         step = DistributedTrainStep(m, m.make_loss_fn(), opt)
         loss = step(x, y)
     np.testing.assert_allclose(float(loss.numpy()), ref, rtol=2e-5, atol=2e-6)
+
+
+def test_moe_pipe_ce_parity_and_aux_warning():
+    """MoE layers run INSIDE the scheduled 1F1B engine (stacked expert
+    banks scan like any homogeneous block): CE loss parity vs the plain
+    MoE model; the un-threaded gate aux loss is a documented warning."""
+    import warnings as _w
+
+    from paddle_tpu.models.llama import LlamaForCausalLMPipe
+
+    paddle.seed(62)
+    cfg = llama_tiny(num_hidden_layers=4, num_experts=2,
+                     moe_aux_loss_weight=0.0)
+    plain = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(62)
+    ids = rng.randint(0, cfg.vocab_size, (4, 13)).astype(np.int32)
+    x, y = paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
+    ref = float(plain(x, labels=y).numpy())  # CE only (aux weight 0)
+
+    with M.mesh_guard(M.build_mesh(pp=2)):
+        pipe = LlamaForCausalLMPipe(cfg, pp_degree=2, schedule="1f1b")
+        pipe.load_from_causal_lm(plain)
+        val = float(pipe(x, y).numpy())
+    np.testing.assert_allclose(val, ref, rtol=2e-5, atol=2e-6)
+
+    cfg2 = llama_tiny(num_hidden_layers=4, num_experts=2)  # default aux weight
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        LlamaForCausalLMPipe(cfg2, pp_degree=2, schedule="1f1b")
+    assert any("aux loss" in str(r.message) for r in rec)
+
+
+def test_cp_inside_pipe_engine_raises():
+    """context_parallel cannot ride inside the scheduled pipe's manual pp
+    axis — must refuse loudly, not silently run non-CP attention."""
+    from paddle_tpu.models.llama import LlamaForCausalLMPipe
+
+    paddle.seed(63)
+    cfg = llama_tiny(num_hidden_layers=4, context_parallel=True)
+    rng = np.random.RandomState(63)
+    ids = rng.randint(0, cfg.vocab_size, (4, 17)).astype(np.int32)
+    x, y = paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
+    with M.mesh_guard(M.build_mesh(pp=2, sep=4)):
+        pipe = LlamaForCausalLMPipe(cfg, pp_degree=2, schedule="1f1b")
+        with pytest.raises(Exception, match="context_parallel does not compose"):
+            pipe(x, y)
