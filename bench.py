@@ -220,6 +220,57 @@ def run_decode(hidden=2048, layers=12, heads=16, kv_heads=None, inter=5504,
     }
 
 
+def run_spec_decode(hidden=2048, layers=12, heads=16, kv_heads=None, inter=5504,
+                    vocab=32000, batch=8, prompt_len=512, new_tokens=256,
+                    gamma=4):
+    """Speculative decoding rung: target vs a quarter-depth draft; the
+    output is exactly the target's greedy stream, the wall-clock gain is
+    the acceptance rate's doing."""
+    import numpy as np
+
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    on_tpu = jax.default_backend() == "tpu"
+    if not on_tpu:
+        hidden, layers, heads, inter, vocab = 256, 2, 4, 512, 1024
+        batch, prompt_len, new_tokens = 2, 32, 16
+
+    paddle.seed(0)
+    def mk(nl):
+        cfg = LlamaConfig(
+            vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+            num_hidden_layers=nl, num_attention_heads=heads,
+            num_key_value_heads=kv_heads,
+            max_position_embeddings=prompt_len + new_tokens + gamma + 1,
+            dtype="bfloat16")
+        m = LlamaForCausalLM(cfg)
+        m.bfloat16(); m.eval()
+        return m
+    model, draft = mk(layers), mk(max(layers // 4, 1))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (batch, prompt_len)).astype(np.int32)
+    out = model.generate_speculative(ids, draft, max_new_tokens=new_tokens, gamma=gamma)
+    out.numpy()  # compile + warm
+    t0 = time.perf_counter()
+    out = model.generate_speculative(ids, draft, max_new_tokens=new_tokens, gamma=gamma)
+    out.numpy()
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "speculative_decode_tokens_per_sec_per_chip",
+        "value": round(batch * new_tokens / dt, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "extra": {
+            "config": f"h{hidden}-L{layers}-d{max(layers // 4, 1)}-g{gamma}-b{batch}-n{new_tokens}",
+            "backend": jax.default_backend(),
+            "wall_s": round(dt, 3),
+        },
+    }
+
+
 def run_paged_serve(hidden=2048, layers=12, heads=16, kv_heads=None, inter=5504,
                     vocab=32000, n_requests=12, max_seqs=4, max_new=128):
     """Continuous-batching serving rung: mixed-length prompts through the
@@ -296,7 +347,9 @@ def _child_main(rung_idx, force_cpu=False):
 
         jax.config.update("jax_platforms", "cpu")
     try:
-        if rung_idx == -4:
+        if rung_idx == -5:
+            res = run_spec_decode()
+        elif rung_idx == -4:
             res = run_paged_serve()
         elif rung_idx == -3:
             res = run_decode(quantize="int8")
@@ -363,6 +416,7 @@ HARVEST = [
     ("gqa_splash", -1),
     ("decode", -2),
     ("decode_int8", -3),
+    ("decode_speculative", -5),
     ("paged_serve", -4),
     ("mid_b4_dots", 2),
     ("big_b8_dots", 0),
@@ -377,7 +431,7 @@ PREFERENCE = [0, 3, 2, 1, 4, 5]
 def _timeout_for(idx):
     if idx == -1:
         return GQA_RUNG_TIMEOUT_S
-    if idx in (-2, -3, -4):
+    if idx in (-2, -3, -4, -5):
         return DECODE_RUNG_TIMEOUT_S
     return RUNG_TIMEOUT_S[idx]
 
@@ -482,6 +536,12 @@ def main():
         }
         if -3 in banked:
             res["extra"]["decode"]["int8_tokens_per_sec"] = banked[-3]["value"]
+    if -5 in banked:
+        sp = banked[-5]
+        res.setdefault("extra", {})["speculative"] = {
+            "tokens_per_sec": sp["value"],
+            "config": sp.get("extra", {}).get("config"),
+        }
     if -4 in banked:
         ps = banked[-4]
         res.setdefault("extra", {})["paged_serve"] = {

@@ -66,6 +66,18 @@ def _make_sampler(do_sample, temperature, top_k, top_p, repetition_penalty,
     return sample
 
 
+def _cache_fwd(m, state, toks, caches, pos, **kw):
+    """THE functional_call wrapper every generate builder shares: overrides
+    from a raw state dict, fixed-shape KV caches, dynamic cache position."""
+    overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
+    wrapped = [(Tensor(kc), Tensor(vc)) for kc, vc in caches]
+    logits, presents = m.functional_call(
+        overrides, Tensor(toks), past_key_values=wrapped,
+        cache_position=Tensor(pos), use_cache=True, training=False, **kw,
+    )
+    return logits._data, tuple((p[0]._data, p[1]._data) for p in presents)
+
+
 def _prompt_seen_mask(ids, valid, n_vocab):
     """[B, V] bool: tokens present in the VALID prompt positions."""
     B = ids.shape[0]
@@ -201,14 +213,9 @@ class GenerationMixin:
         total = S0b + max_new
 
         def fwd(state, toks, caches, pos, amask, pos_ids):
-            overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
-            wrapped = [(Tensor(kc), Tensor(vc)) for kc, vc in caches]
-            logits, presents = model.functional_call(
-                overrides, Tensor(toks), attention_mask=Tensor(amask),
-                position_ids=Tensor(pos_ids), past_key_values=wrapped,
-                cache_position=Tensor(pos), use_cache=True, training=False,
-            )
-            return logits._data, tuple((p[0]._data, p[1]._data) for p in presents)
+            return _cache_fwd(model, state, toks, caches, pos,
+                              attention_mask=Tensor(amask),
+                              position_ids=Tensor(pos_ids))
 
         sample = _make_sampler(do_sample, temperature, top_k, top_p,
                                repetition_penalty, min_length, eos_token_id)
@@ -254,6 +261,132 @@ class GenerationMixin:
 
         return run
 
+    def generate_speculative(self, input_ids, draft_model, max_new_tokens=32,
+                             gamma=4, eos_token_id=None, pad_token_id=None):
+        """Speculative greedy decoding (reference ecosystem: PaddleNLP
+        speculative/draft-model decoding; Leviathan et al.): the small
+        draft model proposes `gamma` tokens autoregressively, the target
+        verifies them in ONE forward, the longest agreeing prefix is
+        accepted plus the target's own next token. Greedy acceptance makes
+        the output EXACTLY the target's greedy continuation — the draft
+        only changes how many target forwards it takes.
+
+        One jitted program: a lax.while_loop over draft-propose /
+        target-verify rounds on fixed-shape caches; per-round cache
+        positions are dynamic scalars (stale KV beyond the accepted point
+        is masked by the position mask until overwritten by the next
+        round's writes). Returns [B, S0 + max_new_tokens] ids.
+        """
+        ids = to_tensor(input_ids)._data.astype(jnp.int32)
+        B, S0 = ids.shape
+        if pad_token_id is None:
+            pad_token_id = eos_token_id if eos_token_id is not None else 0
+        S0b = prompt_bucket(S0)
+        key = ("spec", B, S0b, max_new_tokens, gamma, eos_token_id, pad_token_id,
+               id(draft_model))
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        run = cache.get(key)
+        if run is None:
+            run = cache[key] = jax.jit(self._build_speculative_fn(
+                draft_model, B, S0b, max_new_tokens, gamma,
+                eos_token_id, pad_token_id))
+        ids_p = jnp.pad(ids, ((0, 0), (0, S0b - S0)), constant_values=pad_token_id)
+        gen = run(self.raw_state_dict(), draft_model.raw_state_dict(),
+                  ids_p, jnp.int32(S0))
+        return Tensor(jnp.concatenate([ids, gen], axis=1), stop_gradient=True)
+
+    def _build_speculative_fn(self, draft_model, B, S0b, max_new, gamma,
+                              eos_token_id, pad_token_id):
+        model = self
+        total = S0b + max_new + gamma + 1  # cache headroom for one overshoot
+
+        fwd = _cache_fwd
+
+        def run(t_state, d_state, ids, true_len):
+            t_caches = model.init_cache(B, total)
+            d_caches = draft_model.init_cache(B, total)
+            # prefill both on the padded prompt
+            t_logits, t_caches = fwd(model, t_state, ids, t_caches, jnp.int32(0))
+            _, d_caches = fwd(draft_model, d_state, ids, d_caches, jnp.int32(0))
+            last = jax.lax.dynamic_index_in_dim(t_logits, true_len - 1, 1, False)
+            first = jnp.argmax(last.astype(jnp.float32), -1).astype(jnp.int32)  # [B]
+
+            out = jnp.full((B, max_new + gamma + 1), jnp.int32(pad_token_id))
+            out = out.at[:, 0].set(first)
+            done = (first == eos_token_id) if eos_token_id is not None else jnp.zeros((B,), bool)
+
+            # carry: n_gen = tokens generated so far (incl. their kv NOT yet
+            # written beyond position true_len + n_gen - 1)
+            def cond(c):
+                t_caches, d_caches, out, n_gen, done = c
+                return (n_gen < max_new) & ~jnp.all(done)
+
+            def body(c):
+                t_caches, d_caches, out, n_gen, done = c
+                pos = true_len + n_gen - 1  # cache position of out[:, n_gen-1]
+                # --- draft proposes gamma tokens from out[:, n_gen-1]
+                cur = jax.lax.dynamic_index_in_dim(out, n_gen - 1, 1, False)
+
+                def draft_step(carry, i):
+                    d_caches, tok = carry
+                    lg, d_caches = fwd(draft_model, d_state, tok[:, None],
+                                       d_caches, pos + i)
+                    nxt = jnp.argmax(lg[:, -1].astype(jnp.float32), -1).astype(jnp.int32)
+                    return (d_caches, nxt), nxt
+
+                (d_caches, _), proposals = jax.lax.scan(
+                    draft_step, (d_caches, cur), jnp.arange(gamma))
+                proposals = proposals.T  # [B, gamma]
+
+                # --- target verifies: one forward over [cur, proposals[:-1]]
+                # ... i.e. gamma tokens starting at cache position pos
+                block = jnp.concatenate([cur[:, None], proposals[:, :-1]], 1)
+                t_lg, t_caches = fwd(model, t_state, block, t_caches, pos)
+                t_choice = jnp.argmax(t_lg.astype(jnp.float32), -1).astype(jnp.int32)  # [B, gamma]
+                # accept while target agrees with the draft proposal
+                agree = t_choice[:, :-1] == proposals[:, :-1] if gamma > 1 else \
+                    jnp.ones((B, 0), bool)
+                n_acc = jnp.concatenate(
+                    [jnp.ones((B, 1), bool), agree], 1).cumprod(1).sum(1).astype(jnp.int32)
+                # accepted tokens: proposals[:, :n_acc-1] then target's pick
+                # at the first disagreement — uniformly: token i (0-based)
+                # of this round is proposals[:, i] while i < n_acc-1, and
+                # t_choice[:, n_acc-1] at i == n_acc-1
+                idx = jnp.arange(gamma)[None, :]
+                round_toks = jnp.where(idx < (n_acc - 1)[:, None], proposals,
+                                       jnp.take_along_axis(t_choice, (n_acc - 1)[:, None], 1))
+                # done rows emit pad forever
+                round_toks = jnp.where(done[:, None], jnp.int32(pad_token_id), round_toks)
+                if eos_token_id is not None:
+                    hit = (round_toks == eos_token_id) & (idx < n_acc[:, None])
+                    # truncate acceptance at the first eos
+                    eos_pos = jnp.where(hit.any(1), hit.argmax(1).astype(jnp.int32),
+                                        jnp.int32(gamma))
+                    n_acc = jnp.minimum(n_acc, eos_pos + 1)
+                    done = done | hit.any(1)
+                # a row emits pad beyond its OWN acceptance: a row that hit
+                # eos this round must not leak the model's post-eos
+                # continuation when the batch advances past its n_acc
+                round_toks = jnp.where(idx < n_acc[:, None], round_toks,
+                                       jnp.int32(pad_token_id))
+                # rows finish at different n_acc: advance by the BATCH MIN so
+                # every row's cache stays in lockstep (simple + correct;
+                # throughput loss only when rows diverge)
+                step_n = jnp.maximum(jnp.min(jnp.where(done, jnp.int32(gamma), n_acc)), 1)
+                out = jax.lax.dynamic_update_slice(
+                    out, jnp.where(idx < step_n, round_toks,
+                                   jax.lax.dynamic_slice(out, (0, n_gen), (B, gamma))),
+                    (0, n_gen))
+                return (t_caches, d_caches, out, n_gen + step_n, done)
+
+            t_caches, d_caches, out, n_gen, done = jax.lax.while_loop(
+                cond, body, (t_caches, d_caches, out, jnp.int32(1), done))
+            return out[:, :max_new]
+
+        return run
+
     def _generate_beam(self, input_ids, max_new_tokens, num_beams, length_penalty,
                        eos_token_id, pad_token_id):
         ids = to_tensor(input_ids)._data.astype(jnp.int32)
@@ -290,13 +423,7 @@ class GenerationMixin:
         NEG = jnp.float32(-1e9)
 
         def fwd(state, toks, caches, pos):
-            overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
-            wrapped = [(Tensor(kc), Tensor(vc)) for kc, vc in caches]
-            logits, presents = model.functional_call(
-                overrides, Tensor(toks), past_key_values=wrapped,
-                cache_position=Tensor(pos), use_cache=True, training=False,
-            )
-            return logits._data, tuple((p[0]._data, p[1]._data) for p in presents)
+            return _cache_fwd(model, state, toks, caches, pos)
 
         def lp_norm(length):
             if not length_penalty:
@@ -376,13 +503,7 @@ class GenerationMixin:
         total = S0b + max_new
 
         def fwd(state, toks, caches, pos):
-            overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
-            wrapped = [(Tensor(kc), Tensor(vc)) for kc, vc in caches]
-            logits, presents = model.functional_call(
-                overrides, Tensor(toks), past_key_values=wrapped,
-                cache_position=Tensor(pos), use_cache=True, training=False,
-            )
-            return logits._data, tuple((p[0]._data, p[1]._data) for p in presents)
+            return _cache_fwd(model, state, toks, caches, pos)
 
         sample = _make_sampler(do_sample, temperature, top_k, top_p,
                                repetition_penalty, min_length, eos_token_id)

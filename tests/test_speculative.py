@@ -1,0 +1,71 @@
+"""Speculative (draft-verify) greedy decoding: by construction the output
+must EXACTLY equal the target model's own greedy generate(), for ANY draft
+model — the draft only changes how many target forwards run. That identity
+is the whole test."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+
+def _models(seed=81):
+    paddle.seed(seed)
+    target = LlamaForCausalLM(llama_tiny(num_hidden_layers=2))
+    draft = LlamaForCausalLM(llama_tiny(num_hidden_layers=1))
+    target.eval()
+    draft.eval()
+    return target, draft
+
+
+@pytest.mark.parametrize("gamma", [1, 3, 4])
+@pytest.mark.parametrize("B", [1, 2])
+def test_exact_greedy_equivalence(gamma, B):
+    target, draft = _models()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, target.config.vocab_size, (B, 9)).astype(np.int32)
+    ref = target.generate(ids, max_new_tokens=10).numpy()
+    out = target.generate_speculative(ids, draft, max_new_tokens=10,
+                                      gamma=gamma).numpy()
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_draft_equals_target_accepts_everything():
+    """Identical draft: every proposal agrees, so rounds advance by the
+    full gamma (minus the final target pick) — still exactly greedy."""
+    target, _ = _models(seed=82)
+    rng = np.random.RandomState(1)
+    ids = rng.randint(1, target.config.vocab_size, (1, 7)).astype(np.int32)
+    ref = target.generate(ids, max_new_tokens=8).numpy()
+    out = target.generate_speculative(ids, target, max_new_tokens=8,
+                                      gamma=4).numpy()
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_eos_with_agreeing_draft_pads_distinctly():
+    """pad != eos AND draft == target (agrees past eos): the post-eos
+    continuation must NOT leak into the output (regression — confirmed
+    divergence before the per-row n_acc re-mask)."""
+    target, _ = _models(seed=84)
+    rng = np.random.RandomState(3)
+    ids = rng.randint(1, target.config.vocab_size, (1, 6)).astype(np.int32)
+    ref_free = target.generate(ids, max_new_tokens=8).numpy()[0]
+    eos = int(ref_free[6 + 1])
+    ref = target.generate(ids, max_new_tokens=8, eos_token_id=eos,
+                          pad_token_id=0).numpy()
+    out = target.generate_speculative(ids, target, max_new_tokens=8, gamma=4,
+                                      eos_token_id=eos, pad_token_id=0).numpy()
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_eos_padding_matches_generate():
+    target, draft = _models(seed=83)
+    rng = np.random.RandomState(2)
+    ids = rng.randint(1, target.config.vocab_size, (1, 6)).astype(np.int32)
+    # choose eos = the 3rd greedy token so both paths stop mid-stream
+    ref_free = target.generate(ids, max_new_tokens=8).numpy()[0]
+    eos = int(ref_free[6 + 2])
+    ref = target.generate(ids, max_new_tokens=8, eos_token_id=eos, pad_token_id=0).numpy()
+    out = target.generate_speculative(ids, draft, max_new_tokens=8, gamma=3,
+                                      eos_token_id=eos, pad_token_id=0).numpy()
+    np.testing.assert_array_equal(out, ref)
