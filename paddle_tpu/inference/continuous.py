@@ -218,7 +218,8 @@ class ContinuousBatchingEngine:
                    for l in jax.tree_util.tree_leaves(self.pools))
 
     def serve(self, prompts, max_new_tokens, eos_token_id=None,
-              do_sample=False, temperature=1.0, top_k=0, top_p=1.0, seed=0):
+              do_sample=False, temperature=1.0, top_k=0, top_p=1.0, seed=0,
+              on_token=None):
         """Serve a list of int32 prompt arrays; returns a list of
         [len(prompt) + n_generated] arrays (stops at eos or max_new_tokens).
         Requests beyond the pool/slot capacity queue and join as earlier
@@ -228,7 +229,11 @@ class ContinuousBatchingEngine:
         sampler math) draws each sequence from its OWN key stream
         fold_in(fold_in(seed, request_id), token_index), so a request's
         output is reproducible regardless of which co-tenants shared its
-        batch."""
+        batch.
+
+        on_token(request_id, token_id) streams each generated token (incl.
+        the prefill's first token) as soon as its decode step completes —
+        the serving-callback hook for SSE-style responses."""
         # greedy ignores the sampler knobs: canonicalize so every greedy
         # serve shares ONE compiled prefill/decode program
         sampling = ((False, 1.0, 0, 1.0) if not do_sample else
@@ -283,7 +288,11 @@ class ContinuousBatchingEngine:
                 self.lengths[slot] = true_len
                 tok0 = int(tok0)
                 done = eos_token_id is not None and tok0 == eos_token_id
+                # register BEFORE the user callback: if it raises, the
+                # finally-cleanup must see this slot to free its pages
                 active[slot] = [rid, list(prompt) + [tok0], 1, tok0, pages]
+                if on_token is not None:
+                    on_token(rid, tok0)
                 if done or max_new_tokens == 1:
                     retire(slot)
                 admitted = True
@@ -297,8 +306,22 @@ class ContinuousBatchingEngine:
             self.page_table[slot] = 0
             self.lengths[slot] = 0
 
-        try_admit()
         decode = self._decode(sampling)
+        try:
+            try_admit()
+            return self._serve_loop(decode, state, queue, active, results,
+                                    try_admit, retire, max_new_tokens,
+                                    eos_token_id, do_sample, base_key,
+                                    on_token)
+        finally:
+            # a raising on_token (or any mid-serve failure) must not leak a
+            # warm engine's pages/slots: retire whatever is still active
+            for slot in list(active):
+                retire(slot)
+
+    def _serve_loop(self, decode, state, queue, active, results, try_admit,
+                    retire, max_new_tokens, eos_token_id, do_sample, base_key,
+                    on_token):
         while active or queue:
             if not active:
                 # pool too small for even one queued request
@@ -329,6 +352,8 @@ class ContinuousBatchingEngine:
                 st[1].append(tok)
                 st[2] += 1  # generated count, including the token just appended
                 st[3] = tok
+                if on_token is not None:
+                    on_token(st[0], tok)
                 if st[2] >= max_new_tokens or (
                         eos_token_id is not None and tok == eos_token_id):
                     retire(slot)

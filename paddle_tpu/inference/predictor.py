@@ -111,9 +111,16 @@ class Predictor:
         out = self._layer.generate(to_tensor(input_ids), **kwargs)
         return np.asarray(out.numpy())
 
+    def generate_speculative(self, input_ids, draft_model, **kwargs):
+        """Draft-verify decoding through the predictor (exactly the target
+        model's greedy stream; see GenerationMixin.generate_speculative)."""
+        draft = draft_model._layer if isinstance(draft_model, Predictor) else draft_model
+        out = self._layer.generate_speculative(to_tensor(input_ids), draft, **kwargs)
+        return np.asarray(out.numpy())
+
     def serve(self, prompts, max_new_tokens=32, eos_token_id=None,
               max_seqs=4, page_size=64, num_pages=None, max_len=None,
-              engine=None):
+              engine=None, **serve_kwargs):
         """Continuous-batching greedy serving over the paged KV pool
         (inference.continuous.ContinuousBatchingEngine): variable-length
         prompts queue, join mid-flight as slots/pages free, and each result
@@ -133,7 +140,9 @@ class Predictor:
             engine = ContinuousBatchingEngine(
                 self._layer, max_seqs=max_seqs, page_size=page_size,
                 num_pages=num_pages, max_len=max_len)
-        return engine.serve(prompts, max_new_tokens, eos_token_id=eos_token_id)
+        # sampling knobs / on_token streaming pass straight through
+        return engine.serve(prompts, max_new_tokens, eos_token_id=eos_token_id,
+                            **serve_kwargs)
 
     # -- AOT export (reference: save_optimized_model / Program serialization;
     # TPU-native: StableHLO via jax.export — the compiled artifact is

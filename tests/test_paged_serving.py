@@ -288,3 +288,41 @@ class TestServingFuzz:
             # no leaks after every storm
             assert len(eng.free_pages) == eng.num_pages - 1
             assert sorted(eng.free_slots) == list(range(eng.max_seqs))
+
+
+def test_on_token_streams_every_token_in_order():
+    """The streaming callback delivers each request's tokens in generation
+    order, and exactly the tokens the final outputs contain."""
+    m, _ = _tiny_model()
+    rng = np.random.RandomState(13)
+    prompts = [rng.randint(1, m.config.vocab_size, (l,)).astype(np.int32)
+               for l in [5, 9, 7]]
+    streamed = {}
+    eng = ContinuousBatchingEngine(m, max_seqs=2, page_size=16, max_len=64)
+    outs = eng.serve(prompts, max_new_tokens=5,
+                     on_token=lambda rid, t: streamed.setdefault(rid, []).append(t))
+    for rid, (p, o) in enumerate(zip(prompts, outs)):
+        assert streamed[rid] == list(o[len(p):]), rid
+
+
+def test_raising_on_token_does_not_leak_warm_engine():
+    """A raising callback must not strand pages/slots: the engine stays
+    reusable after the exception (warm-engine contract)."""
+    m, _ = _tiny_model()
+    rng = np.random.RandomState(14)
+    prompts = [rng.randint(1, m.config.vocab_size, (l,)).astype(np.int32)
+               for l in [5, 9]]
+    eng = ContinuousBatchingEngine(m, max_seqs=2, page_size=16, max_len=64)
+
+    def boom(rid, tok):
+        raise RuntimeError("client disconnected")
+
+    with pytest.raises(RuntimeError, match="client disconnected"):
+        eng.serve(prompts, max_new_tokens=4, on_token=boom)
+    assert len(eng.free_pages) == eng.num_pages - 1
+    assert sorted(eng.free_slots) == [0, 1]
+    # and the warm engine still serves correctly afterwards
+    outs = eng.serve(prompts, max_new_tokens=4)
+    for p, o in zip(prompts, outs):
+        ref = m.generate(p[None], max_new_tokens=4).numpy()[0]
+        np.testing.assert_array_equal(o, ref)
