@@ -114,22 +114,36 @@ def _get_pallas_impl():
 _SPLASH_CACHE = {}
 
 
-def _splash_impl(qt, kt, vt, causal, scale):
-    """GQA/MQA-native Pallas splash-attention kernel — kv heads stay
-    unexpanded (the repeat-based fallback materializes hq/hk× more KV)."""
+def _splash_kernel(hq, sq, sk_len, causal, cache_tag=""):
+    """Build (and cache) a splash-attention kernel for static shapes.
+
+    Construction MUST stay concrete even when the cache miss happens inside
+    a jit trace: make_splash_mha tree_maps jnp.array over its MaskInfo, and
+    under omnistaging those become tracers of the ambient trace — cached,
+    they then leak into the NEXT trace (the custom-vjp backward traces
+    separately) and raise UnexpectedTracerError. ensure_compile_time_eval
+    keeps the mask arrays concrete so the cached kernel is trace-reusable.
+    (Found on real TPU: round-5 gqa_splash bench rung.)"""
     from jax.experimental.pallas.ops.tpu.splash_attention import (
         splash_attention_kernel as sk,
         splash_attention_mask as sm,
     )
 
-    hq, sq, sk_len = qt.shape[1], qt.shape[2], kt.shape[2]
-    key = (hq, sq, sk_len, causal)
+    key = (cache_tag, hq, sq, sk_len, causal)
     kernel = _SPLASH_CACHE.get(key)
     if kernel is None:
         mk = sm.CausalMask if causal else (lambda shape: sm.FullMask(shape))
         mask = sm.MultiHeadMask([mk((sq, sk_len)) for _ in range(hq)])
-        kernel = sk.make_splash_mha(mask=mask, head_shards=1, q_seq_shards=1)
+        with jax.ensure_compile_time_eval():
+            kernel = sk.make_splash_mha(mask=mask, head_shards=1, q_seq_shards=1)
         _SPLASH_CACHE[key] = kernel
+    return kernel
+
+
+def _splash_impl(qt, kt, vt, causal, scale):
+    """GQA/MQA-native Pallas splash-attention kernel — kv heads stay
+    unexpanded (the repeat-based fallback materializes hq/hk× more KV)."""
+    kernel = _splash_kernel(qt.shape[1], qt.shape[2], kt.shape[2], causal)
     out = jax.vmap(kernel)((qt * scale).astype(vt.dtype), kt, vt)
     return out
 
@@ -199,7 +213,6 @@ def _same_offsets(a, b):
 def _splash_varlen(q, k, v, cu_q, cu_k, causal, scale):
     from jax.experimental.pallas.ops.tpu.splash_attention import (
         splash_attention_kernel as sk,
-        splash_attention_mask as sm,
     )
 
     tq, hq, d = q.shape
@@ -220,13 +233,8 @@ def _splash_varlen(q, k, v, cu_q, cu_k, causal, scale):
     qt = jnp.swapaxes(qp, 0, 1)  # [H, T, D]
     kt = jnp.swapaxes(kp, 0, 1)
     vt = jnp.swapaxes(vp, 0, 1)
-    key = ("varlen", hq, qt.shape[1], kt.shape[1], causal)
-    kernel = _SPLASH_CACHE.get(key)
-    if kernel is None:
-        mk = sm.CausalMask if causal else (lambda shape: sm.FullMask(shape))
-        mask = sm.MultiHeadMask([mk((qt.shape[1], kt.shape[1])) for _ in range(hq)])
-        kernel = sk.make_splash_mha(mask=mask, head_shards=1, q_seq_shards=1)
-        _SPLASH_CACHE[key] = kernel
+    kernel = _splash_kernel(hq, qt.shape[1], kt.shape[1], causal,
+                            cache_tag="varlen")
     seg = sk.SegmentIds(q=seg_q, kv=seg_k)
     out = kernel((qt * scale).astype(vt.dtype), kt, vt, segment_ids=seg)
     return jnp.swapaxes(out, 0, 1)[:tq]
@@ -270,18 +278,10 @@ def flash_attention_packed(q, k, v, segment_ids, causal=True, scale=None):
         try:
             from jax.experimental.pallas.ops.tpu.splash_attention import (
                 splash_attention_kernel as sk,
-                splash_attention_mask as sm,
             )
 
             S = qt.shape[2]
-            key = ("packed", hq, S, causal)
-            kernel = _SPLASH_CACHE.get(key)
-            if kernel is None:
-                mk = sm.CausalMask if causal else (lambda shape: sm.FullMask(shape))
-                mask = sm.MultiHeadMask([mk((S, S)) for _ in range(hq)])
-                kernel = sk.make_splash_mha(mask=mask, head_shards=1,
-                                            q_seq_shards=1)
-                _SPLASH_CACHE[key] = kernel
+            kernel = _splash_kernel(hq, S, S, causal, cache_tag="packed")
             # splash is GQA-native: kv heads stay unexpanded in kb/vb
             def one(qb, kb, vb, sb):
                 return kernel((qb * scale).astype(vb.dtype), kb, vb,

@@ -112,9 +112,11 @@ def test_ring_kernel_tier_matches_block_tier():
 
     out_k, g_k = run("kernel")
     out_b, g_b = run("block")
-    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_b), rtol=2e-2, atol=2e-3)
+    # atol absorbs bf16 kernel-tier rounding vs the f32 math tier (measured
+    # on chip: worst |delta| 4.4e-3 over 0.008% of elements)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_b), rtol=2e-2, atol=1e-2)
     for a, b in zip(g_k, g_b):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-2, atol=1e-2)
 
 
 @pytest.mark.parametrize("causal", [False, True])

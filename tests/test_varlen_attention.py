@@ -129,3 +129,33 @@ class TestVarlenSplashOnTPU:
         assert mem.temp_size_in_bytes < dense_bytes / 4, (
             mem.temp_size_in_bytes, dense_bytes,
         )
+
+
+def test_splash_kernel_construction_is_trace_safe():
+    """Regression (round-5 TPU gqa_splash rung): make_splash_mha tree_maps
+    jnp.array over its MaskInfo; constructed inside a jit trace WITHOUT
+    ensure_compile_time_eval those become ambient-trace tracers, get cached,
+    and leak into the separately-traced custom-vjp backward as
+    UnexpectedTracerError. Construction is backend-independent, so assert on
+    CPU that a cache-miss inside a trace yields only concrete mask arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    built = {}
+
+    def f(x):
+        # unique shape so the cache misses inside THIS trace
+        built["k"] = fa._splash_kernel(2, 384, 384, True, cache_tag="regress")
+        return x * 2
+
+    jax.jit(f)(jnp.ones(()))
+    kernel = built["k"]
+    from jax.core import Tracer
+
+    leaves = []
+    for info in (kernel.fwd_mask_info, kernel.dq_mask_info, kernel.dkv_mask_info):
+        if info is not None:
+            leaves += [l for l in jax.tree_util.tree_leaves(info)]
+    assert leaves, "expected mask-info arrays"
+    bad = [l for l in leaves if isinstance(l, Tracer)]
+    assert not bad, f"tracer leaked out of splash kernel construction: {bad[:2]}"
