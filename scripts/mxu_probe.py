@@ -1,0 +1,55 @@
+"""Raw MXU ceiling probe: what bf16 matmul throughput can THIS chip
+actually deliver end-to-end (XLA through the axon tunnel)?
+
+Runs K chained big matmuls inside one jitted lax.scan dispatch (dispatch
+latency amortized) and reports achieved TF/s vs the nominal v5e peak
+(197 bf16 TF/s). The result is the denominator every model-level MFU
+number should be read against: if the raw ceiling is X%, a model at Y%
+MFU is using Y/X of what the chip will give anyone.
+
+Prints one JSON line; tpu_watch/bench sessions bank it to PROFILE.md.
+"""
+import json
+import sys
+import time
+
+
+def probe(n=4096, iters=64, dtype="bfloat16"):
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((n, n), dtype)
+    w = jnp.ones((n, n), dtype)
+
+    @jax.jit
+    def chain(x, w):
+        def body(c, _):
+            # data-dependent chain: XLA cannot elide or reorder the matmuls
+            return jnp.tanh(c @ w), None
+
+        out, _ = jax.lax.scan(body, x, None, length=iters)
+        return out
+
+    chain(x, w).block_until_ready()  # compile + warm
+    t0 = time.perf_counter()
+    chain(x, w).block_until_ready()
+    dt = time.perf_counter() - t0
+    flops = 2 * n * n * n * iters
+    tfs = flops / dt / 1e12
+    return {
+        "metric": "raw_matmul_tflops",
+        "value": round(tfs, 1),
+        "unit": "TF/s",
+        "extra": {
+            "n": n, "iters": iters, "dtype": dtype,
+            "wall_s": round(dt, 4),
+            "backend": jax.default_backend(),
+            "pct_of_v5e_peak": round(tfs / 197.0, 4),
+        },
+    }
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    print(json.dumps(probe(n, iters)), flush=True)
