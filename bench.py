@@ -135,10 +135,15 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
     ids = rng.randint(0, vocab, (batch, seq + 1)).astype(np.int32)
     x, y = paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
 
-    # warmup / compile
-    for _ in range(2):
-        loss = step(x, y)
-    float(loss.numpy())
+    # warmup / compile. Sync EVERY dispatch: two in-flight steps overlap the
+    # next step's uploaded args with the previous step's working set (~+4.4GB
+    # transient at this size through the tunnel) — measured to OOM configs
+    # whose single-step peak fits comfortably (b4-dots: 10.3GB predicted,
+    # RESOURCE_EXHAUSTED only when dispatches overlap).
+    if not scan_steps:
+        for _ in range(2):
+            loss = step(x, y)
+            float(loss.numpy())
 
     if scan_steps:
         # n steps per dispatch: measures the CHIP, not the ~1.3 s/dispatch
