@@ -42,8 +42,11 @@ RECOVERY = [
     # CPU/virtual-mesh platform so the tpu-marked tests see the real chip
     ("tpu-tests", [sys.executable, "-m", "pytest", "tests/", "-q",
                    "-p", "no:cacheprovider", "-m", "tpu"], 1800),
-    ("bench-ladder", [sys.executable, os.path.join(REPO, "bench.py")], 4800),
+    # trace BEFORE the ladder: the ladder ends in the big-dots compiles that
+    # wedged the backend twice (r4 04:51, r5 01:52) — the xprof artifact must
+    # be banked before the kill-zone programs run
     ("xprof-trace", [sys.executable, os.path.join(REPO, "scripts", "capture_trace.py")], 900),
+    ("bench-ladder", [sys.executable, os.path.join(REPO, "bench.py")], 4800),
     ("planner-calibrate",
      [sys.executable, "-c",
       "from paddle_tpu.distributed.auto_parallel.planner import calibrate_from_bench;"
@@ -80,7 +83,7 @@ def probe():
 # the ladder runs these LAST (bench.py HARVEST order), so a successful TPU
 # row for any of them proves every earlier rung (tiny/small/gqa/decode/int8)
 # already ran — the latch condition for "harvest complete"
-_FINAL_RUNGS = ("big_b8_dots", "big_b8_full", "mid_b4_none")
+_FINAL_RUNGS = ("big_b8_full_scan", "big_b8_dots", "mid_b4_dots", "mid_b4_none")
 
 
 def _tpu_harvest_complete(since_byte):
