@@ -147,11 +147,16 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
 
     if scan_steps:
         # n steps per dispatch: measures the CHIP, not the ~1.3 s/dispatch
-        # tunnel link (decode's single-dispatch while_loop proved the gap)
-        losses = step.run_steps(x, y, n=steps)  # compile scan program
+        # tunnel link (decode's single-dispatch while_loop proved the gap).
+        # stacked=True feeds a DIFFERENT batch to every scanned step — real
+        # training steps, not one batch repeated.
+        sids = rng.randint(0, vocab, (steps, batch, seq + 1)).astype(np.int32)
+        xs = paddle.to_tensor(sids[:, :, :-1])
+        ys = paddle.to_tensor(sids[:, :, 1:])
+        losses = step.run_steps(xs, ys, n=steps, stacked=True)  # compile
         losses.numpy()
         t0 = time.perf_counter()
-        losses = step.run_steps(x, y, n=steps)
+        losses = step.run_steps(xs, ys, n=steps, stacked=True)
         loss_arr = losses.numpy()
         dt = (time.perf_counter() - t0) / steps
         loss = paddle.to_tensor(loss_arr[-1])
@@ -381,6 +386,8 @@ def _child_main(rung_idx, force_cpu=False):
             res = run_decode(quantize="int8")
         elif rung_idx == -2:
             res = run_decode()
+        elif rung_idx == -6:
+            res = run(**GQA_RUNG, scan_steps=True)
         else:
             res = run(**(LADDER[rung_idx] if rung_idx >= 0 else GQA_RUNG))
     except Exception as e:  # noqa: BLE001 — report, never crash silently
@@ -440,6 +447,7 @@ HARVEST = [
     ("tiny_h512", 5),
     ("small_h1024", 4),
     ("gqa_splash", -1),
+    ("gqa_splash_scan", -6),
     ("decode", -2),
     ("decode_int8", -3),
     ("decode_speculative", -5),
@@ -458,11 +466,47 @@ PREFERENCE = [6, 0, 3, 2, 1, 4, 5]
 
 
 def _timeout_for(idx):
-    if idx == -1:
+    if idx in (-1, -6):
         return GQA_RUNG_TIMEOUT_S
     if idx in (-2, -3, -4, -5):
         return DECODE_RUNG_TIMEOUT_S
     return RUNG_TIMEOUT_S[idx]
+
+
+# Training rungs eligible as a prior-banked final line, best first.
+_PRIOR_RUNG_ORDER = [
+    "big_b8_full_scan", "big_b8_dots", "big_b8_full", "mid_b4_dots",
+    "mid_b4_none", "gqa_splash_scan", "small_h1024", "tiny_h512",
+]
+
+
+def _best_prior_tpu_rung():
+    """Best real-TPU training rung banked in BENCH_rungs.jsonl by an earlier
+    run this round (None if none exists)."""
+    best = None
+    try:
+        with open(RUNGS_PATH) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "error" in rec or (rec.get("extra") or {}).get("backend") != "tpu":
+                    continue
+                name = rec.get("rung")
+                if name not in _PRIOR_RUNG_ORDER:
+                    continue
+                if best is None or (_PRIOR_RUNG_ORDER.index(name)
+                                    < _PRIOR_RUNG_ORDER.index(best["rung"])):
+                    best = rec
+    except OSError:
+        return None
+    if best is None:
+        return None
+    res = {k: v for k, v in best.items() if k not in ("rung", "ts")}
+    res.setdefault("extra", {})["banked_rung"] = best["rung"]
+    res["extra"]["banked_ts"] = best.get("ts")
+    return res
 
 
 def _bank(name, result):
@@ -523,6 +567,19 @@ def main():
     if res is not None and errors:
         res.setdefault("extra", {})["note"] = "; ".join(errors)[:400]
     if res is None:
+        # This run produced no TPU training rung (wedged/dead backend) — but
+        # an earlier healthy window THIS ROUND may have banked one. The
+        # driver artifact should carry the best real measurement on record,
+        # labeled with its timestamp, not a CPU smoke number.
+        prior = _best_prior_tpu_rung()
+        if prior is not None:
+            res = prior
+            res.setdefault("extra", {})["note"] = (
+                f"backend unhealthy at report time ({'; '.join(errors)[:200]}); "
+                f"value is the banked real-TPU rung {prior.get('extra', {}).get('banked_rung')!r} "
+                f"from this round's healthy window at {prior.get('extra', {}).get('banked_ts')}"
+            )
+    if res is None:
         print("[bench] falling back to CPU-forced rung", file=sys.stderr, flush=True)
         # smallest rung: the CPU smoke profile shares its shape, and
         # recompute=none is the right default off-accelerator
@@ -549,8 +606,8 @@ def main():
     # kernel-rung results attach to WHATEVER final line ships (incl. the CPU
     # fallback): real-TPU splash/decode numbers must reach the driver artifact
     # even when every training rung failed
-    if -1 in banked:
-        g = banked[-1]
+    if -6 in banked or -1 in banked:
+        g = banked.get(-6) or banked[-1]
         res.setdefault("extra", {})["gqa"] = {
             "tokens_per_sec": g["value"],
             "mfu": g.get("extra", {}).get("mfu"),

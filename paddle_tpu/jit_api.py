@@ -265,41 +265,53 @@ class TrainStep:
     def _compile(self, step_fn):
         return jax.jit(step_fn, donate_argnums=(0, 1, 3, 4))
 
-    def _compile_multi(self, n):
+    def _compile_multi(self, n, stacked):
         """n training steps inside ONE compiled program (lax.scan over the
         step body, donated state carry). One host→device dispatch per n steps
         instead of per step — on dispatch-latency-heavy links (the axon
         tunnel measures ~1.3 s/dispatch) this is the difference between
         measuring the link and measuring the chip. lr is held constant across
-        the n steps (scheduler ticks once per call)."""
+        the n steps (scheduler ticks once per call). stacked=True scans a
+        [n, ...]-leading batch (a different micro-batch per step)."""
         step_fn = self._step_fn
 
         def multi_fn(params, buffers, frozen, opt_state, scaler_state, lr, key, batch):
-            def body(carry, k):
+            def body(carry, x):
                 p, b, o, s = carry
-                loss, p2, b2, o2, s2 = step_fn(p, b, frozen, o, s, lr, k, batch)
+                k, step_batch = (x, batch) if not stacked else x
+                loss, p2, b2, o2, s2 = step_fn(p, b, frozen, o, s, lr, k, step_batch)
                 return (p2, b2, o2, s2), loss
 
             keys = jax.random.split(key, n)
+            xs = (keys, batch) if stacked else keys
             (p, b, o, s), losses = jax.lax.scan(
-                body, (params, buffers, opt_state, scaler_state), keys
+                body, (params, buffers, opt_state, scaler_state), xs
             )
             return losses, p, b, o, s
 
         return jax.jit(multi_fn, donate_argnums=(0, 1, 3, 4))
 
-    def run_steps(self, *batch, n):
-        """Run n optimizer steps on one batch in a single device dispatch.
-        Returns the [n] per-step loss array (device-resident until read)."""
-        if n not in self._compiled_multi:
-            self._compiled_multi[n] = self._compile_multi(n)
+    def run_steps(self, *batch, n, stacked=False):
+        """Run n optimizer steps in a single device dispatch. With
+        stacked=False each batch array is reused for every step; with
+        stacked=True each batch array carries a leading [n] dim — one
+        micro-batch per step, real training in one dispatch. Returns the [n]
+        per-step loss array (device-resident until read)."""
+        key = (n, stacked)
+        if key not in self._compiled_multi:
+            self._compiled_multi[key] = self._compile_multi(n, stacked)
         params = {k: p._data for k, p in self._trainable.items()}
         buffers = {k: b._data for k, b in self._buffers.items()}
         frozen = {k: p._data for k, p in self._frozen.items()}
         lr = self.optimizer.get_lr()
         batch_data = tuple(to_tensor(b)._data for b in batch)
+        if stacked:
+            for b in batch_data:
+                if b.shape[0] != n:
+                    raise ValueError(
+                        f"stacked run_steps: leading dim {b.shape[0]} != n={n}")
         losses, new_params, new_buffers, self.opt_state, self._scaler_state = (
-            self._compiled_multi[n](
+            self._compiled_multi[key](
                 params, buffers, frozen, self.opt_state, self._scaler_state,
                 lr, prandom.next_key(), batch_data,
             )

@@ -116,9 +116,9 @@ class Optimizer:
             if master is not None:
                 new_slots = dict(new_slots)
                 new_slots["master_weight"] = new_p
-                p._data = new_p.astype(p.dtype)
-            else:
-                p._data = new_p
+            # same dtype contract as apply_gradients: never let update-math
+            # promotion (e.g. Adam's f32 bias correction) upcast the param
+            p._data = new_p.astype(p._data.dtype)
             self._accumulators[id(p)] = new_slots
 
     def _wd_in_grad(self, p):
@@ -180,9 +180,12 @@ class Optimizer:
             if master is not None:
                 ns = dict(ns)
                 ns["master_weight"] = np_
-                new_params[name] = np_.astype(pd.dtype)
-            else:
-                new_params[name] = np_
+            # ALWAYS land on the param's dtype: update math may promote to
+            # f32 (Adam's bias correction divides by f32 step powers) and a
+            # silent f32 param would poison every later forward — bf16
+            # models were measured training in f32 after step 1 (round-5
+            # on-chip memory forensics) before this cast.
+            new_params[name] = np_.astype(pd.dtype)
             new_slots[name] = ns
         new_state = {"step": step, "slots": new_slots}
         if skip_update is not None:
