@@ -57,7 +57,7 @@ def _row_sampler(do_sample, temperature, top_k, top_p):
 
 class ContinuousBatchingEngine:
     def __init__(self, model, max_seqs=4, page_size=16, num_pages=None,
-                 max_len=512, kv_cache_dtype=None):
+                 max_len=512, kv_cache_dtype=None, decode_block=8):
         cfg = model.config
         self.model = model
         model.eval()
@@ -103,6 +103,15 @@ class ContinuousBatchingEngine:
         self._prefill_fns = {}
         self._insert_fns = {}
         self._decode_fns = {}
+        self._decode_block_fns = {}
+        # decode_block: max decode steps fused into ONE device dispatch
+        # (lax.scan). Each dispatch costs a full host→device round trip —
+        # ~1.3s through the axon tunnel (PROFILE.md r5) — so per-token
+        # dispatch makes serving latency-bound at any model size. Trade-off:
+        # retirement/admission (and on_token streaming) happen at block
+        # boundaries, and a sequence hitting EOS mid-block wastes the rest of
+        # the block's compute for its slot. 1 restores per-token behavior.
+        self.decode_block = max(int(decode_block), 1)
         # observability for tests/bench: peak pages in use, deferred admits
         self.stats = {"peak_pages": 0, "deferred_admissions": 0, "decode_steps": 0}
 
@@ -210,6 +219,40 @@ class ContinuousBatchingEngine:
         fn = self._decode_fns[sampling] = jax.jit(decode, donate_argnums=(2,))
         return fn
 
+    def _decode_block_fn(self, sampling, k):
+        """k decode steps fused into one dispatch: lax.scan over the
+        single-step decode body, carrying (tokens, pools, lengths). Returns
+        the [k, max_seqs] token block + the updated pools."""
+        fn = self._decode_block_fns.get((sampling, k))
+        if fn is not None:
+            return fn
+        model = self.model
+        sampler = _row_sampler(*sampling)
+
+        def decode_block(state, toks, pools, page_table, lengths, keys):
+            overrides = {kk: Tensor(v, stop_gradient=True) for kk, v in state.items()}
+
+            def body(carry, step_keys):
+                toks_c, pools_c, lengths_c = carry
+                pkvs = [PagedLayerCache(kp, vp, page_table, lengths_c)
+                        for kp, vp in pools_c]
+                logits, presents = model.functional_call(
+                    overrides, Tensor(toks_c),
+                    position_ids=Tensor(lengths_c[:, None].astype(jnp.int32)),
+                    past_key_values=pkvs, use_cache=True, training=False,
+                )
+                nxt = sampler(logits._data[:, -1], step_keys).astype(jnp.int32)
+                new_pools = tuple((p.k_pages, p.v_pages) for p in presents)
+                return (nxt[:, None], new_pools, lengths_c + 1), nxt
+
+            (_, pools_out, _), toks_block = jax.lax.scan(
+                body, (toks, tuple(pools), lengths), keys)
+            return toks_block, pools_out
+
+        fn = self._decode_block_fns[(sampling, k)] = jax.jit(
+            decode_block, donate_argnums=(2,))
+        return fn
+
     # ---- scheduler --------------------------------------------------------
     def pool_bytes(self):
         import jax
@@ -306,10 +349,9 @@ class ContinuousBatchingEngine:
             self.page_table[slot] = 0
             self.lengths[slot] = 0
 
-        decode = self._decode(sampling)
         try:
             try_admit()
-            return self._serve_loop(decode, state, queue, active, results,
+            return self._serve_loop(sampling, state, queue, active, results,
                                     try_admit, retire, max_new_tokens,
                                     eos_token_id, do_sample, base_key,
                                     on_token)
@@ -319,15 +361,22 @@ class ContinuousBatchingEngine:
             for slot in list(active):
                 retire(slot)
 
-    def _serve_loop(self, decode, state, queue, active, results, try_admit,
+    def _serve_loop(self, sampling, state, queue, active, results, try_admit,
                     retire, max_new_tokens, eos_token_id, do_sample, base_key,
                     on_token):
+        decode = self._decode(sampling)
         while active or queue:
             if not active:
                 # pool too small for even one queued request
                 rid, prompt = queue[0]
                 raise RuntimeError(
                     f"request {rid} needs more pages than the pool holds")
+            # block size: never overshoot any active request's token budget
+            # (its page reservation covers exactly max_new_tokens); power of
+            # two so the compile cache stays at log2(decode_block) programs
+            remaining = min(max_new_tokens - st[2] for st in active.values())
+            k = min(self.decode_block, remaining)
+            k = 1 << (k.bit_length() - 1)
             toks = np.zeros((self.max_seqs, 1), np.int32)
             rids = np.zeros(self.max_seqs, np.int32)
             idxs = np.zeros(self.max_seqs, np.int32)
@@ -335,27 +384,39 @@ class ContinuousBatchingEngine:
                 toks[slot, 0] = st[3]
                 rids[slot], idxs[slot] = st[0], st[2]
             if do_sample:
-                keys = _KEYS_FN(base_key, jnp.asarray(rids), jnp.asarray(idxs))
+                rids_j, idxs_j = jnp.asarray(rids), jnp.asarray(idxs)
+                keys = jnp.stack([_KEYS_FN(base_key, rids_j, idxs_j + s)
+                                  for s in range(k)])
             else:
                 # greedy ignores the keys entirely — skip the device work
-                keys = jnp.zeros((self.max_seqs, 2), jnp.uint32)
-            nxt, pools = decode(
-                state, jnp.asarray(toks), tuple(self.pools),
-                jnp.asarray(self.page_table), jnp.asarray(self.lengths), keys)
+                keys = jnp.zeros((k, self.max_seqs, 2), jnp.uint32)
+            if k == 1:
+                nxt, pools = decode(
+                    state, jnp.asarray(toks), tuple(self.pools),
+                    jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+                    keys[0])
+                block = np.asarray(nxt)[None]
+            else:
+                block, pools = self._decode_block_fn(sampling, k)(
+                    state, jnp.asarray(toks), tuple(self.pools),
+                    jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+                    keys)
+                block = np.asarray(block)
             self.pools = list(pools)
-            self.stats["decode_steps"] += 1
-            nxt = np.asarray(nxt)
+            self.stats["decode_steps"] += k
             for slot in list(active):
                 st = active[slot]
-                self.lengths[slot] += 1  # the fed token is now in cache
-                tok = int(nxt[slot])
-                st[1].append(tok)
-                st[2] += 1  # generated count, including the token just appended
-                st[3] = tok
-                if on_token is not None:
-                    on_token(st[0], tok)
-                if st[2] >= max_new_tokens or (
-                        eos_token_id is not None and tok == eos_token_id):
-                    retire(slot)
+                for s in range(k):
+                    self.lengths[slot] += 1  # the fed token is now in cache
+                    tok = int(block[s, slot])
+                    st[1].append(tok)
+                    st[2] += 1  # generated count, incl. the token just appended
+                    st[3] = tok
+                    if on_token is not None:
+                        on_token(st[0], tok)
+                    if st[2] >= max_new_tokens or (
+                            eos_token_id is not None and tok == eos_token_id):
+                        retire(slot)  # mid-block EOS: rest of block discarded
+                        break
             try_admit()
         return results
