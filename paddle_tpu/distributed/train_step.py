@@ -213,3 +213,58 @@ class DistributedTrainStep(TrainStep):
                 self.metrics_bus.tokens_per_step = int(math.prod(batch_datas[0].shape))
             self.metrics_bus.on_step(loss=loss)
         return Tensor(loss)
+
+    def run_steps(self, *batch, n, stacked=False):
+        """n sharded steps in one dispatch: the same lax.scan program as
+        TrainStep.run_steps, jitted with the full in/out sharding trees so
+        GSPMD lays out params/opt-state/batch exactly like the single-step
+        path (stacked batches carry their per-step specs shifted one dim
+        right)."""
+        from ..framework import random as prandom
+        from ..framework.core import Tensor, to_tensor
+
+        batch_datas = tuple(to_tensor(b)._data for b in batch)
+        if stacked:
+            for b in batch_datas:
+                if np.shape(b)[0] != n:
+                    raise ValueError(
+                        f"stacked run_steps: leading dim {np.shape(b)[0]} != n={n}")
+        sig = ("multi", n, stacked,
+               tuple((tuple(np.shape(b)), str(b.dtype)) for b in batch_datas))
+        jitted = self._jitted.get(sig)
+        if jitted is None:
+            # per-step batch shapes decide the batch specs; stacked inputs
+            # prepend a replicated scan dim
+            inner = tuple(b[0] for b in batch_datas) if stacked else batch_datas
+            params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, batch_sh = (
+                self._sharding_trees(inner))
+            if stacked:
+                batch_sh = tuple(
+                    self._ns(P(None, *tuple(self._batch_spec(b)))) for b in inner)
+            jitted = jax.jit(
+                self._multi_fn(n, stacked),
+                in_shardings=(params_sh, buffers_sh, frozen_sh, opt_sh,
+                              scaler_sh, self._ns(P()), self._ns(P()), batch_sh),
+                out_shardings=(self._ns(P()), params_sh, buffers_sh, opt_sh,
+                               scaler_sh),
+                donate_argnums=(0, 1, 3, 4),
+            )
+            self._jitted[sig] = jitted
+        params = {k: p._data for k, p in self._trainable.items()}
+        buffers = {k: b._data for k, b in self._buffers.items()}
+        frozen = {k: p._data for k, p in self._frozen.items()}
+        lr = self.optimizer.get_lr()
+        with self.mesh:
+            losses, new_params, new_buffers, self.opt_state, self._scaler_state = jitted(
+                params, buffers, frozen, self.opt_state, self._scaler_state, lr,
+                prandom.next_key(), batch_datas
+            )
+        for k, v in new_params.items():
+            self._trainable[k]._data = v
+        for k, v in new_buffers.items():
+            self._buffers[k]._data = v
+        sched = self.optimizer._learning_rate_scheduler
+        if sched is not None:
+            sched.step()
+        self.optimizer._global_step += n
+        return Tensor(losses)

@@ -265,14 +265,14 @@ class TrainStep:
     def _compile(self, step_fn):
         return jax.jit(step_fn, donate_argnums=(0, 1, 3, 4))
 
-    def _compile_multi(self, n, stacked):
-        """n training steps inside ONE compiled program (lax.scan over the
-        step body, donated state carry). One host→device dispatch per n steps
-        instead of per step — on dispatch-latency-heavy links (the axon
-        tunnel measures ~1.3 s/dispatch) this is the difference between
-        measuring the link and measuring the chip. lr is held constant across
-        the n steps (scheduler ticks once per call). stacked=True scans a
-        [n, ...]-leading batch (a different micro-batch per step)."""
+    def _multi_fn(self, n, stacked):
+        """Pure n-steps-in-one-program function (lax.scan over the step
+        body). One host→device dispatch per n steps instead of per step — on
+        dispatch-latency-heavy links (the axon tunnel measures
+        ~1.3 s/dispatch) this is the difference between measuring the link
+        and measuring the chip. lr is held constant across the n steps
+        (scheduler ticks once per call). stacked=True scans a [n, ...]-leading
+        batch (a different micro-batch per step)."""
         step_fn = self._step_fn
 
         def multi_fn(params, buffers, frozen, opt_state, scaler_state, lr, key, batch):
@@ -289,7 +289,10 @@ class TrainStep:
             )
             return losses, p, b, o, s
 
-        return jax.jit(multi_fn, donate_argnums=(0, 1, 3, 4))
+        return multi_fn
+
+    def _compile_multi(self, n, stacked):
+        return jax.jit(self._multi_fn(n, stacked), donate_argnums=(0, 1, 3, 4))
 
     def run_steps(self, *batch, n, stacked=False):
         """Run n optimizer steps in a single device dispatch. With

@@ -168,3 +168,30 @@ class TestRunSteps:
         step(x, y)
         for k, p in m.named_parameters():
             assert str(p.dtype) in ("paddle.bfloat16", "bfloat16"), (k, p.dtype)
+
+
+def test_distributed_run_steps_matches_sequential():
+    """DistributedTrainStep.run_steps (sharded scan-of-steps) equals the
+    sequential sharded path on a dp2×sharding2 mesh."""
+    m = M.build_mesh(dp=2, sharding=2)
+    with M.mesh_guard(m):
+        def setup():
+            paddle.seed(11)
+            net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+            opt = optimizer.AdamW(learning_rate=1e-2, parameters=net.parameters())
+            return net, DistributedTrainStep(
+                net, lambda o, y: ((o - y) ** 2).mean(), opt, mesh=m,
+                sharding_stage=2)
+
+        rng = np.random.RandomState(3)
+        xs = rng.randn(3, 8, 8).astype(np.float32)
+        ys = rng.randn(3, 8, 4).astype(np.float32)
+        m1, s1 = setup()
+        losses = s1.run_steps(xs, ys, n=3, stacked=True)
+        m2, s2 = setup()
+        seq = [float(s2(xs[i], ys[i]).numpy()) for i in range(3)]
+        np.testing.assert_allclose(np.asarray(losses.numpy()), np.asarray(seq),
+                                   rtol=1e-5, atol=1e-6)
+        for (k1, p1), (k2, p2) in zip(m1.named_parameters(), m2.named_parameters()):
+            np.testing.assert_allclose(np.asarray(p1.numpy()), np.asarray(p2.numpy()),
+                                       rtol=1e-5, atol=1e-6, err_msg=k1)
