@@ -1,0 +1,58 @@
+"""Targeted healthy-window harvest: run ONLY the rungs not yet banked on
+real TPU this round, best-value-first, banking each to BENCH_rungs.jsonl
+as it completes (same wedge-survival contract as bench.py main()).
+
+Value order rationale (PROFILE.md): the b4 scan rungs are the north-star
+MFU candidates (no/cheap recompute, post-bf16-fix peaks 12.95/10.34 GB fit
+the ~15.7 GB chip); gqa_splash_scan puts the splash kernel's chip MFU on
+record with the tunnel amortized; mid_b4_dots re-tests the pre-fix OOM;
+big_b8_dots is last because its compile killed the tunnel at 01:18.
+"""
+import importlib.util
+import json
+import os
+import sys
+import time
+
+_spec = importlib.util.spec_from_file_location(
+    "bench", os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "bench.py"))
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+PLAN = [
+    # xprof trace is captured separately BEFORE this script runs (smallest
+    # program, never-banked artifact). Then: proven-compileable sizes first,
+    # kill-zone compiles (b4-none/b8-dots — PROFILE.md) last.
+    ("gqa_splash_scan", -6, 600),
+    ("mid_b4_dots", 2, 420),
+    ("b4_dots_scan", 8, 600),
+    ("b4_none_scan", 7, 600),
+    ("big_b8_dots", 0, 600),
+]
+
+
+def main():
+    only = set(sys.argv[1:])
+    for name, idx, budget in PLAN:
+        if only and name not in only:
+            continue
+        ok, backend = bench._probe_backend()
+        if not ok or backend != "tpu":
+            print(f"[harvest] backend gone before {name} (ok={ok} backend={backend}); stopping",
+                  flush=True)
+            bench._bank(name, {"error": f"skipped: backend unhealthy (ok={ok}, {backend})"})
+            break
+        print(f"[harvest] {name} (idx {idx}) budget={budget}s", flush=True)
+        t0 = time.time()
+        out, timed_out = bench._run_rung(idx, budget)
+        if timed_out:
+            print(f"[harvest] {name}: TIMEOUT after {budget}s — wedged; stopping", flush=True)
+            bench._bank(name, {"error": f"timeout>{budget}s"})
+            break
+        bench._bank(name, out)
+        print(f"[harvest] {name} done in {time.time()-t0:.0f}s: "
+              f"{json.dumps(out)[:300]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
