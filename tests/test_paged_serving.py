@@ -97,6 +97,37 @@ class TestContinuousBatching:
         # serial per-request decoding would need
         assert eng.stats["decode_steps"] < len(prompts) * (new - 1)
 
+    def test_warmup_compiles_ladder_and_preserves_streams(self):
+        """warmup() must compile the k=1 decode + every power-of-two block
+        program + each prompt bucket's prefill, and a post-warmup serve must
+        be token-identical to a fresh engine's (warmup mutates no state the
+        scheduler depends on)."""
+        m, cfg = self._model()
+        rng = np.random.RandomState(9)
+        lens = [5, 11, 37]
+        prompts = [rng.randint(1, cfg.vocab_size, (l,)).astype(np.int32)
+                   for l in lens]
+        new = 7
+        mk = lambda: ContinuousBatchingEngine(  # noqa: E731
+            m, max_seqs=2, page_size=16, num_pages=12, max_len=64,
+            decode_block=4)
+        warm, cold = mk(), mk()
+        warm.warmup(lens)
+        # every program the serve loop can hit is already compiled
+        from paddle_tpu.generation import prompt_bucket
+
+        sampling = (False, 1.0, 0, 1.0)
+        assert {b for b, s in warm._prefill_fns} >= {prompt_bucket(l) for l in lens}
+        assert sampling in warm._decode_fns  # k=1 program
+        assert {k for s, k in warm._decode_block_fns} == {2, 4}
+        before = dict(warm._prefill_fns), dict(warm._decode_block_fns)
+        outs = warm.serve(prompts, max_new_tokens=new)
+        refs = cold.serve(prompts, max_new_tokens=new)
+        for o, r in zip(outs, refs):
+            np.testing.assert_array_equal(o, r)
+        # the timed serve added no new programs
+        assert (dict(warm._prefill_fns), dict(warm._decode_block_fns)) == before
+
     def test_pool_smaller_than_dense_and_admission_defers(self):
         """The memory contract: pool bytes < the dense fixed-shape caches the
         same 5 concurrent requests would allocate, and a tight pool defers
