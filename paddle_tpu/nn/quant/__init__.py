@@ -74,12 +74,23 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     the weight never materializes in bf16 in HBM."""
     algo = "weight_only_int4" if str(weight_dtype) == "int4" else "weight_only_int8"
     xt = to_tensor(x)
-    k = xt.shape[-1]
 
     def fn(xa, qa, sa, *rest):
-        q = _unpack_int4(qa, k) if algo == "weight_only_int4" else qa
-        w = q.astype(xa.dtype) * sa.astype(xa.dtype)
-        y = xa @ w
+        if algo == "weight_only_int4":
+            # Do NOT interleave the nibbles back to [K, N] (stack+reshape =
+            # a full-weight relayout XLA cannot fuse into the GEMM — measured
+            # 8x slower than bf16 decode on v5e). Instead split the
+            # ACTIVATION into even/odd K columns and run two half-K matmuls
+            # against the lo/hi nibble planes; the shift-based sign-extend
+            # fuses into each GEMM's operand read.
+            hi = (qa >> 4).astype(xa.dtype)           # arithmetic: sign-extended
+            lo = ((qa << 4) >> 4).astype(xa.dtype)    # int8 shifts are modular
+            x_lo, x_hi = xa[..., 0::2], xa[..., 1::2]
+            y = x_lo @ lo[: x_lo.shape[-1]] + x_hi @ hi[: x_hi.shape[-1]]
+            y = y * sa.astype(xa.dtype)
+        else:
+            w = qa.astype(xa.dtype) * sa.astype(xa.dtype)
+            y = xa @ w
         if rest:
             y = y + rest[0].astype(xa.dtype)
         return y

@@ -36,6 +36,14 @@ def main():
         hidden, layers, heads, inter, vocab, seq, batch = 1024, 8, 16, 2816, 32000, 1024, 8
     else:
         hidden, layers, heads, inter, vocab, seq, batch = 256, 2, 4, 512, 1024, 256, 2
+    # TRACE_* env overrides: trace the exact headline-rung shape
+    hidden = int(os.environ.get("TRACE_HIDDEN", hidden))
+    layers = int(os.environ.get("TRACE_LAYERS", layers))
+    heads = int(os.environ.get("TRACE_HEADS", heads))
+    inter = int(os.environ.get("TRACE_INTER", inter))
+    vocab = int(os.environ.get("TRACE_VOCAB", vocab))
+    seq = int(os.environ.get("TRACE_SEQ", seq))
+    batch = int(os.environ.get("TRACE_BATCH", batch))
 
     paddle.seed(0)
     cfg = LlamaConfig(

@@ -57,12 +57,16 @@ class TestWeightOnlyLinear:
         assert np.abs(y - full).mean() < 0.05 * np.abs(full).mean()
 
     def test_int4_path(self):
-        w = _w(64, 16, seed=3)
-        x = np.random.RandomState(4).randn(2, 64).astype(np.float32)
-        q, s = weight_quantize(paddle.to_tensor(w), "weight_only_int4")
-        y = weight_only_linear(paddle.to_tensor(x), q, None, s, weight_dtype="int4").numpy()
-        ref = x @ weight_dequantize(q, s, algo="weight_only_int4", k=64).numpy()
-        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+        # even AND odd K: the split-activation matmul (x_even @ lo +
+        # x_odd @ hi) must slice the hi plane's pack-padding row off
+        for k in (64, 9):
+            w = _w(k, 16, seed=3)
+            x = np.random.RandomState(4).randn(2, k).astype(np.float32)
+            q, s = weight_quantize(paddle.to_tensor(w), "weight_only_int4")
+            y = weight_only_linear(paddle.to_tensor(x), q, None, s,
+                                   weight_dtype="int4").numpy()
+            ref = x @ weight_dequantize(q, s, algo="weight_only_int4", k=k).numpy()
+            np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
 
 
 class TestQuantizeForInference:
