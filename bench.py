@@ -402,6 +402,8 @@ def _child_main(rung_idx, force_cpu=False):
             res = run_paged_serve()
         elif rung_idx == -3:
             res = run_decode(quantize="int8")
+        elif rung_idx == -7:
+            res = run_decode(quantize="int4")
         elif rung_idx == -2:
             res = run_decode()
         elif rung_idx == -6:
@@ -468,6 +470,7 @@ HARVEST = [
     ("gqa_splash_scan", -6),
     ("decode", -2),
     ("decode_int8", -3),
+    ("decode_int4", -7),
     ("decode_speculative", -5),
     ("paged_serve", -4),
     ("big_b8_full", 3),
@@ -490,7 +493,7 @@ PREFERENCE = [9, 7, 8, 6, 0, 3, 2, 1, 4, 5]
 def _timeout_for(idx):
     if idx in (-1, -6):
         return GQA_RUNG_TIMEOUT_S
-    if idx in (-2, -3, -4, -5):
+    if idx in (-2, -3, -4, -5, -7):
         return DECODE_RUNG_TIMEOUT_S
     return RUNG_TIMEOUT_S[idx]
 
@@ -665,6 +668,8 @@ def main():
         }
         if -3 in banked:
             res["extra"]["decode"]["int8_tokens_per_sec"] = banked[-3]["value"]
+        if -7 in banked:
+            res["extra"]["decode"]["int4_tokens_per_sec"] = banked[-7]["value"]
     if -5 in banked:
         sp = banked[-5]
         res.setdefault("extra", {})["speculative"] = {
