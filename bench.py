@@ -100,7 +100,7 @@ def peak_flops_per_chip():
 
 
 def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, batch=8,
-        steps=12, recompute="dots", kv_heads=None, scan_steps=False):
+        steps=12, recompute="dots", kv_heads=None, scan_steps=False, ce_chunk=None):
     import numpy as np
 
     import jax
@@ -128,6 +128,7 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
         recompute_policy=recompute if recompute != "none" else "full",
         dtype="bfloat16",
         fuse_linear_cross_entropy=True,
+        **({"ce_chunk_size": ce_chunk} if ce_chunk else {}),
     )
     model = LlamaForCausalLM(cfg)
     model.bfloat16()
