@@ -225,10 +225,7 @@ class DistributedTrainStep(TrainStep):
 
         batch_datas = tuple(to_tensor(b)._data for b in batch)
         if stacked:
-            for b in batch_datas:
-                if np.shape(b)[0] != n:
-                    raise ValueError(
-                        f"stacked run_steps: leading dim {np.shape(b)[0]} != n={n}")
+            self._check_stacked(batch_datas, n)
         sig = ("multi", n, stacked,
                tuple((tuple(np.shape(b)), str(b.dtype)) for b in batch_datas))
         jitted = self._jitted.get(sig)
@@ -259,12 +256,4 @@ class DistributedTrainStep(TrainStep):
                 params, buffers, frozen, self.opt_state, self._scaler_state, lr,
                 prandom.next_key(), batch_datas
             )
-        for k, v in new_params.items():
-            self._trainable[k]._data = v
-        for k, v in new_buffers.items():
-            self._buffers[k]._data = v
-        sched = self.optimizer._learning_rate_scheduler
-        if sched is not None:
-            sched.step()
-        self.optimizer._global_step += n
-        return Tensor(losses)
+        return self._finish_run_steps(losses, new_params, new_buffers, n)

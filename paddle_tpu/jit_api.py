@@ -309,25 +309,40 @@ class TrainStep:
         lr = self.optimizer.get_lr()
         batch_data = tuple(to_tensor(b)._data for b in batch)
         if stacked:
-            for b in batch_data:
-                if b.shape[0] != n:
-                    raise ValueError(
-                        f"stacked run_steps: leading dim {b.shape[0]} != n={n}")
+            self._check_stacked(batch_data, n)
         losses, new_params, new_buffers, self.opt_state, self._scaler_state = (
             self._compiled_multi[key](
                 params, buffers, frozen, self.opt_state, self._scaler_state,
                 lr, prandom.next_key(), batch_data,
             )
         )
+        return self._finish_run_steps(losses, new_params, new_buffers, n)
+
+    def _finish_run_steps(self, losses, new_params, new_buffers, n):
+        """Shared run_steps epilogue (also used by DistributedTrainStep):
+        write back state and keep the LR schedule ALIGNED — the dispatch ran
+        n optimizer steps at the dispatch-start LR (schedule granularity is
+        per dispatch), so the scheduler must tick n times, landing on the
+        same schedule position as n sequential step() calls."""
         for k, v in new_params.items():
             self._trainable[k]._data = v
         for k, v in new_buffers.items():
             self._buffers[k]._data = v
         sched = self.optimizer._learning_rate_scheduler
         if sched is not None:
-            sched.step()
+            for _ in range(n):
+                sched.step()
         self.optimizer._global_step += n
         return Tensor(losses)
+
+    @staticmethod
+    def _check_stacked(batch_data, n):
+        import numpy as np
+
+        for b in batch_data:
+            if np.shape(b)[0] != n:
+                raise ValueError(
+                    f"stacked run_steps: leading dim {np.shape(b)[0]} != n={n}")
 
     def __call__(self, *batch):
         params = {k: p._data for k, p in self._trainable.items()}

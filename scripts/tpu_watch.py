@@ -10,9 +10,11 @@ appended to PROBE_r05.jsonl in the repo so the round carries a committed
 timeline proving backend state whether or not it ever answers.
 
 On the FIRST healthy probe the recovery pipeline runs:
-  1. `scripts/ci.sh --tpu`      — the 12 TPU-marked tests (splash/varlen/GQA)
-  2. `python bench.py`          — inverted ladder; banks each rung to BENCH_rungs.jsonl
-  3. `scripts/capture_trace.py` — xprof artifact of one small rung
+  1. pytest -m tpu              — the TPU-marked tests (splash/varlen/ring/GQA)
+  2. `scripts/capture_trace.py` — xprof artifact BEFORE the ladder (the ladder
+                                  ends in the compiles that have wedged the
+                                  backend; the trace must bank first)
+  3. `python bench.py`          — inverted ladder; banks each rung to BENCH_rungs.jsonl
   4. planner recalibration      — fit cost-model constants from banked rungs
 
 Usage: nohup python scripts/tpu_watch.py >> /tmp/tpu_watch.log 2>&1 &
@@ -80,10 +82,13 @@ def probe():
         return False
 
 
-# the ladder runs these LAST (bench.py HARVEST order), so a successful TPU
-# row for any of them proves every earlier rung (tiny/small/gqa/decode/int8)
-# already ran — the latch condition for "harvest complete"
-_FINAL_RUNGS = ("big_b8_full_scan", "big_b8_dots", "mid_b4_dots", "mid_b4_none")
+# the ladder runs these LAST (bench.py HARVEST order: ... b6_none_scan,
+# mid_b4_dots, big_b8_dots), so a successful TPU row for one of them proves
+# every earlier rung already ran — the latch condition for "harvest
+# complete". mid_b4_none is the OOM fallback for the final rung. Keeping
+# big_b8_full_scan here would latch with the north-star b4/b6 scan rungs
+# still unharvested (review finding).
+_FINAL_RUNGS = ("big_b8_dots", "mid_b4_dots", "mid_b4_none")
 
 
 def _tpu_harvest_complete(since_byte):

@@ -147,6 +147,24 @@ class TestRunSteps:
         np.testing.assert_allclose(np.asarray(losses.numpy()), np.asarray(seq),
                                    rtol=1e-5, atol=1e-6)
 
+    def test_scheduler_position_matches_sequential(self):
+        """Regression (review): run_steps(n) ticked the LR scheduler once but
+        _global_step by n, silently stretching any schedule ~n x. The
+        scheduler must land where n sequential step() calls would (LR is
+        held at the dispatch-start value WITHIN the dispatch — schedule
+        granularity is per dispatch)."""
+        paddle.seed(7)
+        m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        sched = optimizer.lr.StepDecay(learning_rate=1e-2, step_size=2, gamma=0.5)
+        opt = optimizer.AdamW(learning_rate=sched, parameters=m.parameters())
+        step = TrainStep(m, lambda out, y: ((out - y) ** 2).mean(), opt)
+        rng = np.random.RandomState(0)
+        x, y = rng.randn(6, 8).astype(np.float32), rng.randn(6, 4).astype(np.float32)
+        step.run_steps(x, y, n=4)
+        # 4 steps with step_size=2: schedule ticked 4 times -> 2 decays
+        assert opt.get_lr() == pytest.approx(1e-2 * 0.5 ** 2)
+        assert opt._global_step == 4
+
     def test_stacked_wrong_leading_dim_raises(self):
         _, s1, x, y = self._setup()
         with pytest.raises(ValueError):
