@@ -98,6 +98,39 @@ class TestConv3D:
         assert out.nnz() < np.prod(ref.shape[:4])  # genuinely sparse output
 
 
+def test_conv3d_fuzz_vs_torch():
+    """Random geometry fuzz: shapes, kernel sizes, strides, paddings, nnz —
+    sparse conv3d's densified output must always equal torch's dense conv
+    (no bias, so inactive sites are exactly zero in both)."""
+    rng = np.random.RandomState(42)
+    for trial in range(8):
+        N = rng.randint(1, 3)
+        D, H, W = rng.randint(4, 9, 3)
+        C, Co = rng.randint(1, 5), rng.randint(1, 5)
+        k = int(rng.choice([1, 2, 3]))
+        stride = int(rng.choice([1, 2]))
+        padding = int(rng.randint(0, k))
+        if D + 2 * padding < k or H + 2 * padding < k or W + 2 * padding < k:
+            continue
+        total = N * D * H * W
+        nnz = rng.randint(1, min(total, 40))
+        flat = rng.choice(total, size=nnz, replace=False)
+        b, rem = np.divmod(flat, D * H * W)
+        d, rem = np.divmod(rem, H * W)
+        h, w = np.divmod(rem, W)
+        x = sparse.sparse_coo_tensor(
+            np.stack([b, d, h, w]).astype(np.int32),
+            rng.randn(nnz, C).astype(np.float32), (N, D, H, W, C))
+        wt = rng.randn(k, k, k, C, Co).astype(np.float32)
+        out = sparse.nn.functional.conv3d(x, paddle.to_tensor(wt),
+                                          stride=stride, padding=padding)
+        ref = _torch_conv(x, wt, stride=stride, padding=padding)
+        np.testing.assert_allclose(
+            np.asarray(out.to_dense().numpy()), ref, rtol=1e-4, atol=1e-4,
+            err_msg=f"trial {trial}: N{N} D{D}H{H}W{W} C{C}->{Co} k{k} "
+                    f"s{stride} p{padding} nnz{nnz}")
+
+
 class TestSparsePool3D:
     def _np_pool(self, x_sp, k, s, mode):
         idx = np.asarray(x_sp.indices().numpy())
