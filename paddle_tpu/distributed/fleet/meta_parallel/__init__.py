@@ -3,6 +3,7 @@ from .mp_layers import (
     ParallelCrossEntropy,
     RowParallelLinear,
     VocabParallelEmbedding,
+    parallel_cross_entropy,
 )
 from .parallel_wrappers import PipelineParallel, ShardingParallel, TensorParallel
 from .segment_parallel import SegmentParallel, split_inputs_sequence_dim

@@ -102,3 +102,12 @@ def parallel_matmul(x, weight, transpose_y=False, tensor_parallel_output=True):
     from ....tensor import linalg
 
     return linalg.matmul(x, weight, transpose_y=transpose_y)
+
+
+def parallel_cross_entropy(input, label, ignore_index=-100, name=None):
+    """Functional alias of ParallelCrossEntropy (reference:
+    fleet.meta_parallel.parallel_cross_entropy / mp_ops.py
+    _c_softmax_with_cross_entropy); see the class docstring for the GSPMD
+    subsumption note."""
+    return F.cross_entropy(input, label, reduction="none",
+                           ignore_index=ignore_index)
