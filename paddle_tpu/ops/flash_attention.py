@@ -11,6 +11,7 @@ jax's kernel wants [batch, heads, seq, head_dim], so we transpose around it —
 XLA fuses the transposes into the surrounding ops.
 """
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -129,13 +130,26 @@ def _splash_kernel(hq, sq, sk_len, causal, cache_tag=""):
         splash_attention_mask as sm,
     )
 
-    key = (cache_tag, hq, sq, sk_len, causal)
+    # FLAGS_splash_block_q/kv: on-chip-tunable kernel tiles (same pattern as
+    # FLAGS_flash_block_q/k for the MHA kernel); None = library defaults
+    env_q = os.environ.get("FLAGS_splash_block_q")
+    env_kv = os.environ.get("FLAGS_splash_block_kv")
+    key = (cache_tag, hq, sq, sk_len, causal, env_q, env_kv)
     kernel = _SPLASH_CACHE.get(key)
     if kernel is None:
         mk = sm.CausalMask if causal else (lambda shape: sm.FullMask(shape))
         mask = sm.MultiHeadMask([mk((sq, sk_len)) for _ in range(hq)])
+        kw = {}
+        if env_q or env_kv:
+            bq = min(int(env_q or 512), sq)
+            bkv = min(int(env_kv or 512), sk_len)
+            kw["block_sizes"] = sk.BlockSizes(
+                block_q=bq, block_kv=bkv, block_kv_compute=bkv,
+                block_q_dkv=bq, block_kv_dkv=bkv, block_kv_dkv_compute=bkv,
+                block_q_dq=bq, block_kv_dq=bkv)
         with jax.ensure_compile_time_eval():
-            kernel = sk.make_splash_mha(mask=mask, head_shards=1, q_seq_shards=1)
+            kernel = sk.make_splash_mha(mask=mask, head_shards=1,
+                                        q_seq_shards=1, **kw)
         _SPLASH_CACHE[key] = kernel
     return kernel
 
