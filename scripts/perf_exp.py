@@ -51,6 +51,7 @@ def child():
     steps = int(os.environ.get("EXP_STEPS", "6"))
     block_q = int(os.environ.get("EXP_BLOCK_Q", "0")) or None
     block_k = int(os.environ.get("EXP_BLOCK_K", "0")) or None
+    kv_heads = int(os.environ.get("EXP_KV_HEADS", "0")) or None
 
     import paddle_tpu as paddle
     from paddle_tpu import optimizer
@@ -66,6 +67,7 @@ def child():
     cfg = LlamaConfig(
         vocab_size=CFG["vocab"], hidden_size=CFG["hidden"], intermediate_size=CFG["inter"],
         num_hidden_layers=CFG["layers"], num_attention_heads=CFG["heads"],
+        num_key_value_heads=kv_heads,
         max_position_embeddings=CFG["seq"],
         use_recompute=recompute != "none",
         recompute_policy=recompute if recompute != "none" else "full",
@@ -102,6 +104,7 @@ def child():
 
     print(json.dumps({
         "recompute": recompute, "fused_ce": fused_ce, "attn": fa.LAST_IMPL,
+        "kv_heads": kv_heads,
         "chunk": chunk, "batch": batch, "block_q": block_q, "block_k": block_k,
         "step_s": round(dt, 4), "tok_s": round(toks, 1), "mfu": round(mfu, 4),
         "compile_s": round(compile_s, 1), "backend": _jax.default_backend(),
