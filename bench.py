@@ -40,6 +40,14 @@ CPU_FALLBACK_TIMEOUT_S = 420
 # matches big_b8_full for a direct GQA-vs-MHA comparison.
 GQA_RUNG = dict(hidden=2048, layers=12, heads=16, kv_heads=4, inter=5504,
                 seq=2048, batch=8, recompute="full")
+# MoE rung: Mixtral-class 8-expert top-2 at a size whose expert banks +
+# AdamW f32 state fit one chip — the only rung exercising the gated
+# expert-dispatch compute path (capacity dispatch + SwiGLU expert bank
+# einsums) on hardware. MFU uses the dense-equivalent 6N accounting, so it
+# understates achieved utilization by ~the (1 - top_k/num_experts) unused-
+# expert fraction; tokens/s is the honest headline for this rung.
+MOE_RUNG = dict(hidden=1024, layers=8, heads=16, inter=2816, seq=1024,
+                batch=8, recompute="none", num_experts=8)
 # Frontier GQA rung: same knobs as the b6-none headline rung so splash-vs-
 # pallas MFU is apples-to-apples (the rfull GQA rung exists for the direct
 # big_b8_full comparison; its 29.9% vs 62.0% gap is mostly the recompute +
@@ -111,7 +119,8 @@ def peak_flops_per_chip():
 
 
 def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, batch=8,
-        steps=12, recompute="dots", kv_heads=None, scan_steps=False, ce_chunk=None):
+        steps=12, recompute="dots", kv_heads=None, scan_steps=False, ce_chunk=None,
+        num_experts=0):
     import numpy as np
 
     import jax
@@ -140,6 +149,7 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
         dtype="bfloat16",
         fuse_linear_cross_entropy=True,
         **({"ce_chunk_size": ce_chunk} if ce_chunk else {}),
+        **({"num_experts": num_experts} if num_experts else {}),
     )
     model = LlamaForCausalLM(cfg)
     model.bfloat16()
@@ -214,7 +224,8 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
             "params": n_params,
             "step_time_s": round(dt, 4),
             "config": (f"h{hidden}-L{layers}-a{heads}-i{inter}-v{vocab}-s{seq}-b{batch}"
-                       f"-r{recompute}" + (f"-kv{kv_heads}" if kv_heads else "")),
+                       f"-r{recompute}" + (f"-kv{kv_heads}" if kv_heads else "")
+                       + (f"-e{num_experts}" if num_experts else "")),
             "backend": jax.default_backend(),
             "attn_impl": fa.LAST_IMPL or "math-xla",
             "final_loss": round(float(loss.numpy()), 4),
@@ -427,6 +438,8 @@ def _child_main(rung_idx, force_cpu=False):
             res = run(**GQA_RUNG, scan_steps=True)
         elif rung_idx == -8:
             res = run(**GQA_FRONTIER_RUNG, scan_steps=True)
+        elif rung_idx == -9:
+            res = run(**MOE_RUNG, scan_steps=True)
         else:
             res = run(**(LADDER[rung_idx] if rung_idx >= 0 else GQA_RUNG))
     except Exception as e:  # noqa: BLE001 — report, never crash silently
@@ -488,6 +501,7 @@ HARVEST = [
     ("gqa_splash", -1),
     ("gqa_splash_scan", -6),
     ("gqa_b6_none_scan", -8),
+    ("moe_e8_scan", -9),
     ("decode", -2),
     ("decode_int8", -3),
     ("decode_int4", -7),
@@ -512,7 +526,7 @@ PREFERENCE = [9, 7, 8, 6, 0, 3, 2, 1, 4, 5]  # idx 10 (long-context) is evidence
 
 
 def _timeout_for(idx):
-    if idx in (-1, -6, -8):
+    if idx in (-1, -6, -8, -9):
         return GQA_RUNG_TIMEOUT_S
     if idx in (-2, -3, -4, -5, -7):
         return DECODE_RUNG_TIMEOUT_S
