@@ -16,3 +16,4 @@ from .ops import (
     scatter,
     send,
 )
+from .quantized import quantized_all_reduce, quantized_all_reduce_array

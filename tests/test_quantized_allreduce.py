@@ -1,0 +1,89 @@
+"""int8-wire ring all-reduce (PAPERS.md EQuARX capability; see
+communication/quantized.py). Oracle: exact f32 psum on the same shards."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.distributed.communication.quantized import (
+    quantized_all_reduce,
+    quantized_all_reduce_array,
+)
+
+
+def _mesh(n=8):
+    dev = jax.devices()[:n]
+    return Mesh(np.asarray(dev), ("x",))
+
+
+@pytest.mark.parametrize("m", [4096, 1000])  # aligned and ragged sizes
+def test_matches_exact_psum_within_quant_error(m):
+    n = 8
+    mesh = _mesh(n)
+    rng = np.random.RandomState(0)
+    shards = rng.randn(n, m).astype(np.float32)
+
+    qf = shard_map(
+        lambda x: quantized_all_reduce_array(x[0], "x", block=128)[None],
+        mesh=mesh, in_specs=P("x", None), out_specs=P("x", None),
+        check_rep=False,
+    )
+    res = np.asarray(jax.jit(qf)(jnp.asarray(shards)))
+    exact = shards.sum(axis=0)
+    for d in range(n):
+        np.testing.assert_array_equal(res[d], res[0])  # all devices agree
+
+    # error bound: each of the n-1 ring hops + the final gather re-quantizes
+    # once; per-element error per quantization <= block_max/254. Normalize
+    # by the max partial magnitude seen along the ring.
+    max_mag = np.abs(shards).cumsum(axis=0).max()
+    err = np.abs(res[0] - exact).max()
+    assert err < n * max_mag / 254 * 1.5, (err, max_mag)
+    # and the result is genuinely close in relative terms
+    rel = err / np.abs(exact).max()
+    assert rel < 0.05, rel
+
+
+def test_wire_format_is_int8():
+    """The compiled HLO's ring hops must carry s8 buffers — the entire
+    point. f32 collective-permutes may only be the tiny scale vectors."""
+    n = 8
+    mesh = _mesh(n)
+    m, block = 4096, 256
+    fn = shard_map(
+        lambda x: quantized_all_reduce_array(x[0], "x", block=block)[None],
+        mesh=mesh, in_specs=P("x", None), out_specs=P("x", None),
+        check_rep=False,
+    )
+    hlo = jax.jit(fn).lower(
+        jax.ShapeDtypeStruct((n, m), jnp.float32)).compile().as_text()
+    permutes = [l for l in hlo.splitlines() if "collective-permute" in l
+                and "start" not in l.split("=")[0]]
+    assert any("s8[" in l for l in hlo.splitlines()
+               if "collective-permute" in l), "no int8 wire hop in HLO"
+    # any f32 permute must be scale-sized (m/n/block elements), not payload
+    chunk = m // n
+    for l in hlo.splitlines():
+        if "collective-permute" in l and "f32[" in l:
+            import re
+
+            sizes = [int(s) for s in re.findall(r"f32\[(\d+)\]", l)]
+            assert all(sz <= chunk // block * 4 for sz in sizes), l
+
+
+def test_size_one_ring_is_identity_and_eager_wrapper():
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("x",))
+    x = jnp.arange(512, dtype=jnp.float32)
+    out = shard_map(lambda a: quantized_all_reduce_array(a, "x"),
+                    mesh=mesh, in_specs=P(), out_specs=P(),
+                    check_rep=False)(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    # eager single-controller: no bound axes -> identity (values global)
+    import paddle_tpu as paddle
+
+    t = paddle.to_tensor(np.ones(16, np.float32))
+    out_t = quantized_all_reduce(t)
+    np.testing.assert_array_equal(np.asarray(out_t.numpy()), np.ones(16))
