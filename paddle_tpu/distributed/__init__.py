@@ -6,6 +6,7 @@ from .communication import (
     ReduceOp,
     all_gather,
     all_reduce,
+    quantized_all_reduce,
     all_to_all,
     alltoall,
     barrier,

@@ -87,6 +87,7 @@ NAMESPACES = {
         alltoall_single destroy_process_group unshard_dtensor all_gather_object init_parallel_env get_rank get_world_size all_reduce
         all_gather all_gather_object all_to_all reduce broadcast scatter gather
         reduce_scatter send recv isend irecv batch_isend_irecv barrier new_group
+        quantized_all_reduce
         get_group wait shard_tensor reshard dtensor_from_fn shard_layer Shard Replicate
         Partial Placement ProcessMesh DistAttr fleet spawn launch rpc ParallelEnv
         split get_mesh auto_parallel""",
