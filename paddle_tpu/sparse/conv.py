@@ -28,7 +28,14 @@ def _triple(v):
 
 
 def _host_indices(x):
-    idx = np.asarray(x._indices)
+    try:
+        idx = np.asarray(x._indices)
+    except Exception as e:  # jax TracerArrayConversionError et al.
+        raise RuntimeError(
+            "sparse conv/pool builds its rulebook on host from CONCRETE "
+            "COO indices and cannot run under a jit trace — call it in "
+            "eager mode (the device-side gather-GEMM-scatter it emits is "
+            "itself jit-compiled per geometry)") from e
     if idx.shape[0] != 4:
         raise ValueError(
             "sparse conv3d expects a [N, D, H, W, C] SparseCooTensor with "
