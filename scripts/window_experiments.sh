@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Manual experiments for a healthy-TPU window, run AFTER tpu_watch's
+# automatic harvest (TPU tests -> trace -> ladder -> calibration) so they
+# don't contend for the chip. Each is a bounded perf_exp child; results
+# print as JSON lines (append interesting ones to PROFILE.md by hand).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== single-chunk fused-CE at b6 (no chunk loop: 8.2% of device time is loop control)"
+EXP_BATCH=6 EXP_RECOMPUTE=none EXP_CHUNK=12288 timeout 600 python scripts/perf_exp.py --child 2>/dev/null | tail -1
+
+echo "=== splash tile sweep on the GQA frontier config (kv4-b6-none)"
+for bq in 256 1024; do
+  echo "--- splash blocks ${bq}"
+  EXP_KV_HEADS=4 EXP_BATCH=6 EXP_RECOMPUTE=none \
+    FLAGS_splash_block_q=$bq FLAGS_splash_block_kv=$bq \
+    timeout 600 python scripts/perf_exp.py --child 2>/dev/null | tail -1
+done
+
+echo "=== GQA frontier, default splash blocks (baseline for the sweep)"
+EXP_KV_HEADS=4 EXP_BATCH=6 EXP_RECOMPUTE=none \
+  timeout 600 python scripts/perf_exp.py --child 2>/dev/null | tail -1
