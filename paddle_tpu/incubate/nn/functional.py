@@ -173,6 +173,21 @@ def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
     chunk logits live for the backward (faster when memory allows); True
     recomputes them, so peak is one chunk of logits fwd + one bwd.
     Chunked matmuls stay MXU-sized for chunk_size ≥ 512.
+
+    When the static chunk count is ≤ FLAGS_fused_ce_unroll (default 0 =
+    disabled) the chunk loop is unrolled into the trace instead of lowered
+    to an XLA while-loop: the r5 xprof trace of the headline training shape
+    billed 8.2% of device-busy time to while-loop control for a 3-iteration
+    CE loop (xprof_traces/tpu/20260731T043440). Each unrolled chunk is
+    chained through `lax.optimization_barrier` on the previous chunk's loss
+    so both the forward and the transposed backward schedule sequentially,
+    preserving the one-chunk live-logits bound. OPT-IN until measured on
+    chip: XLA *CPU* strips opt-barrier during optimization (verified — the
+    barriers are in the StableHLO but absent from the optimized module, and
+    unconstrained unrolled chunks overlap to 2.5× the loop's temp at the
+    8192×32000 probe shape), so the memory bound is only enforceable on
+    TPU, where opt-barrier is honored. scripts/perf_exp.py variants 11/12
+    measure it on the headline shape.
     """
     import os
 
@@ -208,7 +223,30 @@ def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
             hs = hs.reshape(-1, c, hd)
             ls = ls.reshape(-1, c)
             body = jax.checkpoint(chunk_fn) if checkpoint_chunks else chunk_fn
-            losses, valids = jax.lax.map(body, (hs, ls))
+            unroll_limit = int(os.environ.get("FLAGS_fused_ce_unroll", 0))
+            if hs.shape[0] <= unroll_limit:
+                # Unrolled chunks alone let XLA overlap them, holding several
+                # chunk-logits buffers live at once (measured 2.5x the loop's
+                # temp at the 8192x32000 probe shape — worse than the full
+                # logits fused-CE exists to avoid). Chaining each chunk's
+                # input through an optimization_barrier with the previous
+                # chunk's output forces sequential scheduling: while-loop
+                # gone, same one-chunk live-memory bound.
+                # The chain token must be DIFFERENTIABLE (the chunk loss):
+                # the barrier's transpose then also sequences the backward —
+                # chunk i's cotangent chain completes only after chunk i+1's
+                # remat+grad, which is where the peak actually lives.
+                outs = []
+                token = jnp.zeros((1,), jnp.float32)
+                for i in range(hs.shape[0]):
+                    hc, _ = jax.lax.optimization_barrier((hs[i], token))
+                    li, vi = body((hc, ls[i]))
+                    token = li[:1]
+                    outs.append((li, vi))
+                losses = jnp.stack([o[0] for o in outs])
+                valids = jnp.stack([o[1] for o in outs])
+            else:
+                losses, valids = jax.lax.map(body, (hs, ls))
         total = jnp.sum(losses)
         count = jnp.sum(valids)
         if reduction == "mean":

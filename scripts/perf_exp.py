@@ -124,6 +124,13 @@ VARIANTS = [
     {"EXP_BATCH": "4", "EXP_RECOMPUTE": "none"},
     {"EXP_BLOCK_Q": "1024", "EXP_BLOCK_K": "1024"},
     {"EXP_BLOCK_Q": "256", "EXP_BLOCK_K": "256"},
+    # barrier-chained CE chunk unroll (FLAGS_fused_ce_unroll): removes the
+    # while-loop the r5 xprof billed at 8.2% of device time. OPT-IN because
+    # XLA CPU strips opt-barrier so the one-chunk memory bound is only
+    # verifiable on TPU; measure here before flipping the default. b6-none
+    # is the headline shape (12288 tok / chunk 4096 = 3 chunks unrolled).
+    {"EXP_BATCH": "6", "EXP_RECOMPUTE": "none", "FLAGS_fused_ce_unroll": "4"},
+    {"EXP_BATCH": "6", "EXP_RECOMPUTE": "none"},  # paired baseline
 ]
 
 
