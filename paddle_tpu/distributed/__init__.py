@@ -61,6 +61,7 @@ from . import checkpoint
 from .checkpoint import load_state_dict, save_state_dict
 from . import sharding
 from .sharding import group_sharded_parallel, save_group_sharded_model
+from . import ps
 from . import rpc
 from . import utils
 from .utils import global_gather, global_scatter

@@ -1,0 +1,182 @@
+"""Parameter-server mode (reference analogue: paddle/fluid/distributed/ps/ —
+BrpcPsServer/BrpcPsClient services over MemorySparseTable, driven from
+python/paddle/incubate/distributed/fleet 'the_one_ps' via fleet.init() +
+PADDLE_TRAINING_ROLE env contract; the capability class is CTR training
+whose sparse embedding tables exceed device memory).
+
+TPU-native framing: dense compute (the MLP over pooled embeddings) runs on
+the device through the normal jit path; the sparse tier lives on HOSTS —
+hash-sharded `SparseTable`s behind socket services. Workers pull rows for a
+batch, run the device step, then push raw row gradients; servers apply the
+sparse optimizer (async-SGD composition across workers). This is the
+beyond-HBM capability; device-resident vocab-sharded embeddings over the
+mesh remain the collective-mode path.
+
+Env contract (same names the reference launcher exports):
+  PADDLE_TRAINING_ROLE      TRAINER | PSERVER
+  PADDLE_PSERVERS_IP_PORT_LIST  comma/semicolon list "ip:port,ip:port"
+  PADDLE_TRAINERS_NUM       worker world size
+  PADDLE_TRAINER_ID         this worker's rank
+  PADDLE_PORT / POD_IP      (server role) which endpoint this process serves
+
+Minimal user flow (mirrors the reference fleet PS flow):
+
+    role = ps.PsRoleMaker()                  # reads the env contract
+    if role.is_server():
+        ps.init_server(role); ps.run_server(role)       # blocks
+    else:
+        client = ps.init_worker(role)
+        emb = ps.SparseEmbedding(client, "emb", dim=8)
+        ... forward / loss.backward() ...
+        emb.push_grad()                      # ship row grads to the servers
+        ps.stop_worker(role, client)         # rank 0 stops the servers
+
+Deliberate descopes vs the reference PS (~80k LoC of brpc/CTR machinery):
+geo-async replication, ssd tables, feature-frequency accessors/shrink
+policies. Recorded in API_MANIFEST.md.
+"""
+import os
+
+from .service import PsClient, PsServer
+from .table import SparseTable
+
+__all__ = [
+    "SparseTable", "PsServer", "PsClient", "PsRoleMaker", "SparseEmbedding",
+    "init_server", "run_server", "init_worker", "stop_worker",
+]
+
+
+class PsRoleMaker:
+    """Role/topology from the PADDLE_* env contract (or explicit kwargs)."""
+
+    def __init__(self, role=None, server_endpoints=None, worker_num=None,
+                 worker_index=None, server_index=None):
+        self.role = (role or os.environ.get("PADDLE_TRAINING_ROLE", "TRAINER")).upper()
+        eps = server_endpoints or os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        if isinstance(eps, str):
+            eps = [e for e in eps.replace(";", ",").split(",") if e]
+        self.server_endpoints = list(eps)
+        self.worker_num = int(worker_num if worker_num is not None
+                              else os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self.worker_index = int(worker_index if worker_index is not None
+                                else os.environ.get("PADDLE_TRAINER_ID", 0))
+        if server_index is not None:
+            self.server_index = int(server_index)
+        else:
+            # locate this server's endpoint: prefer the exact POD_IP:PORT
+            # match (multi-host layouts reuse one port on every host), fall
+            # back to port-only for single-host multi-port runs
+            port = os.environ.get("PADDLE_PORT")
+            pod_ip = os.environ.get("POD_IP")
+            idx = 0
+            if port:
+                matches = [i for i, ep in enumerate(self.server_endpoints)
+                           if ep.endswith(":" + port)]
+                if pod_ip:
+                    exact = [i for i in matches
+                             if self.server_endpoints[i] == f"{pod_ip}:{port}"]
+                    matches = exact or matches
+                if matches:
+                    idx = matches[0]
+            self.server_index = idx
+
+    def is_server(self):
+        return self.role == "PSERVER"
+
+    def is_worker(self):
+        return self.role == "TRAINER"
+
+    def is_first_worker(self):
+        return self.is_worker() and self.worker_index == 0
+
+
+_server = None
+
+
+def init_server(role):
+    """Bind this process's PsServer on its endpoint from the role contract."""
+    global _server
+    host, port = role.server_endpoints[role.server_index].rsplit(":", 1)
+    _server = PsServer(host, int(port)).start()
+    return _server
+
+
+def run_server(role=None):
+    """Serve until a worker calls stop_worker (reference: fleet.run_server)."""
+    if _server is None:
+        raise RuntimeError("init_server() first")
+    _server.run()
+
+
+def init_worker(role):
+    """Connect to the server list; returns the sharded PsClient."""
+    client = PsClient(role.server_endpoints)
+    client.ping()
+    return client
+
+
+def stop_worker(role, client):
+    """Barrier the workers, then rank 0 stops the servers."""
+    client.barrier("stop_worker", role.worker_num)
+    if role.is_first_worker():
+        client.stop_servers()
+    client.close()
+
+
+class SparseEmbedding:
+    """Pull-compute-push embedding over a PS table (reference analogue:
+    paddle.static.nn.sparse_embedding backed by the distributed lookup
+    table).
+
+    forward(ids) pulls rows for the UNIQUE ids host-side, wraps them as a
+    differentiable leaf on the device, and gathers per-position rows through
+    the tape (so backward accumulates duplicate-id gradients densely on the
+    unique rows). After loss.backward(), push_grad() ships the accumulated
+    row gradients to the servers, where the sparse optimizer applies them.
+    """
+
+    def __init__(self, client, table_name, dim, optimizer="adagrad", lr=0.05, **table_kw):
+        self.client = client
+        self.name = table_name
+        self.dim = int(dim)
+        client.create_table(table_name, dim, optimizer=optimizer, lr=lr, **table_kw)
+        self._pulled = []  # [(leaf Tensor [n_unique, dim], unique ids), ...]
+
+    def __call__(self, ids):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from ...tensor import manipulation
+
+        ids_np = np.asarray(ids.numpy() if hasattr(ids, "numpy") else ids, np.int64)
+        uniq, inv = np.unique(ids_np.ravel(), return_inverse=True)
+        rows = self.client.pull(self.name, uniq)
+        leaf = paddle.to_tensor(rows, stop_gradient=False)
+        # accumulate: a model may look this embedding up several times per
+        # step (user slots, item slots); every pull's grads must ship
+        self._pulled.append((leaf, uniq))
+        gathered = manipulation.gather(leaf, paddle.to_tensor(inv.astype(np.int32)))
+        return manipulation.reshape(gathered, list(ids_np.shape) + [self.dim])
+
+    def push_grad(self):
+        """Ship d(loss)/d(rows) for every forward since the last push — as
+        ONE push: the server's sparse optimizer must see the step's summed
+        gradient per id (SparseTable.push sums duplicates within a push);
+        separate pushes would tick stateful optimizers (adagrad) once per
+        lookup and diverge from the dense-embedding oracle."""
+        import numpy as np
+
+        if not self._pulled:
+            raise RuntimeError("no forward recorded")
+        pulled, self._pulled = self._pulled, []
+        ids, grads = [], []
+        for leaf, uniq in pulled:
+            if leaf.grad is None:
+                raise RuntimeError("call loss.backward() before push_grad()")
+            ids.append(uniq)
+            grads.append(leaf.grad.numpy())
+        self.client.push(self.name, np.concatenate(ids), np.concatenate(grads))
+
+    def discard(self):
+        """Drop recorded pulls without pushing (eval-only forwards)."""
+        self._pulled = []

@@ -1,0 +1,329 @@
+"""PS server + sharded client (reference analogue:
+paddle/fluid/distributed/ps/service/ `BrpcPsServer`/`BrpcPsClient` — rpc
+services fronting the tables, clients hash-sharding requests across the
+server list).
+
+Transport is multiprocessing.connection (authenticated pickle over TCP) —
+the same substrate as distributed.rpc. One request = one connection round
+trip; requests against a server are handled by daemon threads, and the
+tables themselves are thread-safe, so concurrent workers interleave safely.
+Key sharding: id % n_servers (uniform for hashed CTR ids).
+"""
+import threading
+import pickle
+from multiprocessing.connection import Client, Listener
+
+import numpy as np
+
+from .table import SparseTable
+
+_AUTH = b"paddle-tpu-ps"
+
+
+class PsServer:
+    """Serves named SparseTables on one endpoint until stop()."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._listener = Listener((host, port), authkey=_AUTH)
+        self.host, self.port = self._listener.address
+        self._tables = {}
+        self._tables_lock = threading.Lock()
+        self._thread = None
+        self._stop = threading.Event()
+        # tag -> [generation, arrived]; a reusable generation barrier (a
+        # shared modulo count would deadlock on tag reuse when a fast worker
+        # re-enters before a slow one samples the count)
+        self._barriers = {}
+        self._barrier_cv = threading.Condition()
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    def create_table(self, name, dim, **kw):
+        with self._tables_lock:
+            existing = self._tables.get(name)
+            if existing is None:
+                self._tables[name] = SparseTable(dim, **kw)
+            else:
+                # idempotent ONLY for identical config — a silently-ignored
+                # mismatch would surface as a shape error (dim) or silently
+                # divergent training (optimizer/lr) far from the cause
+                want = SparseTable(dim, **kw)
+                for attr in ("dim", "optimizer", "lr", "init_scale", "seed",
+                             "adagrad_eps"):
+                    if getattr(existing, attr) != getattr(want, attr):
+                        raise ValueError(
+                            f"table {name!r} already exists with {attr}="
+                            f"{getattr(existing, attr)!r}, requested "
+                            f"{getattr(want, attr)!r}")
+            return self._tables[name]
+
+    def table(self, name):
+        return self._tables[name]
+
+    # -- request handlers ---------------------------------------------------
+    def _handle(self, op, args):
+        if op == "ping":
+            return "pong"
+        if op == "create_table":
+            name, dim, kw = args
+            self.create_table(name, dim, **kw)  # idempotent under its lock
+            return True
+        if op == "table_dim":
+            return self._tables[args[0]].dim
+        if op == "pull":
+            name, ids = args
+            return self._tables[name].pull(ids)
+        if op == "push":
+            name, ids, grads = args
+            self._tables[name].push(ids, grads)
+            return True
+        if op == "table_len":
+            return len(self._tables[args[0]])
+        if op == "state_dict":
+            return self._tables[args[0]].state_dict()
+        if op == "load_state_dict":
+            name, state = args
+            self._tables[name].load_state_dict(state)
+            return True
+        if op == "barrier":
+            tag, world = args
+            with self._barrier_cv:
+                gen, arrived = self._barriers.setdefault(tag, [0, 0])
+                my_gen = gen
+                self._barriers[tag][1] += 1
+                if self._barriers[tag][1] >= world:
+                    self._barriers[tag][0] += 1
+                    self._barriers[tag][1] = 0
+                    self._barrier_cv.notify_all()
+                else:
+                    while (self._barriers[tag][0] == my_gen
+                           and not self._stop.is_set()):
+                        self._barrier_cv.wait(timeout=0.1)
+                    if self._barriers[tag][0] == my_gen:
+                        # released by shutdown, not by the peers arriving —
+                        # an incomplete barrier must be an error, not True
+                        raise RuntimeError(
+                            f"barrier {tag!r} aborted by server shutdown "
+                            f"({self._barriers[tag][1]}/{world} arrived)")
+            return True
+        if op == "stop":
+            self._stop.set()
+            with self._barrier_cv:
+                self._barrier_cv.notify_all()
+            return True
+        raise ValueError(f"unknown ps op {op!r}")
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                try:
+                    op, args = pickle.loads(conn.recv_bytes())
+                except (EOFError, OSError):
+                    return
+                # handler threads are daemons: track in-flight requests so
+                # run() can drain pending REPLIES before the process exits
+                # (otherwise a worker's barrier reply can be cut off mid-send
+                # when another worker's "stop" releases the main thread)
+                with self._inflight_lock:
+                    self._inflight += 1
+                try:
+                    try:
+                        out = (True, self._handle(op, args))
+                    except Exception as e:  # deliver remote errors
+                        out = (False, e)
+                    conn.send_bytes(pickle.dumps(out))
+                finally:
+                    with self._inflight_lock:
+                        self._inflight -= 1
+                if op == "stop":
+                    return
+        finally:
+            conn.close()
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def run(self):
+        """Block until a client sends stop (fleet.run_server), then drain
+        in-flight replies so no worker's pending request is cut off."""
+        import time
+
+        if self._thread is None:
+            self.start()
+        self._stop.wait()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with self._inflight_lock:
+                # includes the stop request until its own reply is sent;
+                # any remaining count is a request still being served
+                if self._inflight == 0:
+                    break
+            time.sleep(0.01)
+        # small grace for the last reply's socket write to flush
+        time.sleep(0.05)
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+class PsClient:
+    """Shards table requests across the server list by id % n_servers.
+
+    One persistent connection per server (created lazily); per-shard
+    requests fan out on a small thread pool so a pull/push pays ~one round
+    trip of latency regardless of the server count (the reference's brpc
+    client stubs likewise issue the per-shard requests concurrently).
+    """
+
+    def __init__(self, endpoints, connect_timeout=60.0):
+        import concurrent.futures
+
+        if isinstance(endpoints, str):
+            endpoints = [e for e in endpoints.replace(";", ",").split(",") if e]
+        self.endpoints = list(endpoints)
+        self.connect_timeout = float(connect_timeout)
+        self._conns = [None] * len(self.endpoints)
+        self._locks = [threading.Lock() for _ in self.endpoints]
+        self._dims = {}  # table name -> row dim (known at create_table)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, len(self.endpoints)))
+
+    def _conn(self, s):
+        if self._conns[s] is None:
+            import time
+
+            host, port = self.endpoints[s].rsplit(":", 1)
+            deadline = time.monotonic() + self.connect_timeout
+            while True:
+                try:
+                    self._conns[s] = Client((host, int(port)), authkey=_AUTH)
+                    break
+                except (ConnectionRefusedError, OSError):
+                    # servers may still be starting (they import jax first);
+                    # spin until the bind, like the reference's client stubs
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.2)
+        return self._conns[s]
+
+    def _call(self, s, op, *args):
+        with self._locks[s]:
+            c = self._conn(s)
+            c.send_bytes(pickle.dumps((op, args)))
+            ok, out = pickle.loads(c.recv_bytes())
+        if not ok:
+            raise out
+        return out
+
+    def _call_all(self, op, *args):
+        futs = [self._pool.submit(self._call, s, op, *args)
+                for s in range(len(self.endpoints))]
+        return [f.result() for f in futs]
+
+    def ping(self):
+        return self._call_all("ping")
+
+    def create_table(self, name, dim, **kw):
+        self._dims[name] = int(dim)
+        self._call_all("create_table", name, dim, kw)
+
+    def pull(self, name, ids):
+        """[n] ids -> [n, dim] rows, gathered across shards concurrently."""
+        ids = np.asarray(ids, np.int64).ravel()
+        if ids.size == 0:
+            if name not in self._dims:
+                # attached client (table created by another worker): ask a
+                # server rather than requiring a local create_table
+                self._dims[name] = int(self._call(0, "table_dim", name))
+            return np.empty((0, self._dims[name]), np.float32)
+        n_srv = len(self.endpoints)
+        shard = (ids % n_srv).astype(np.int64)
+        masks = [shard == s for s in range(n_srv)]
+        futs = {s: self._pool.submit(self._call, s, "pull", name, ids[m])
+                for s, m in enumerate(masks) if m.any()}
+        out = None
+        for s, f in futs.items():
+            rows = f.result()
+            if out is None:
+                out = np.empty((ids.size, rows.shape[1]), np.float32)
+            out[masks[s]] = rows
+        return out
+
+    def push(self, name, ids, grads):
+        ids = np.asarray(ids, np.int64).ravel()
+        if ids.size == 0:
+            return
+        grads = np.asarray(grads, np.float32).reshape(ids.size, -1)
+        n_srv = len(self.endpoints)
+        shard = (ids % n_srv).astype(np.int64)
+        masks = [shard == s for s in range(n_srv)]
+        futs = [self._pool.submit(self._call, s, "push", name, ids[m], grads[m])
+                for s, m in enumerate(masks) if m.any()]
+        for f in futs:
+            f.result()
+
+    def table_len(self, name):
+        return sum(self._call_all("table_len", name))
+
+    def state_dict(self, name):
+        """Merged state across shards (for save_persistables)."""
+        merged = None
+        for st in self._call_all("state_dict", name):
+            if merged is None:
+                merged = st
+                merged.setdefault("g2", {})
+            else:
+                merged["rows"].update(st["rows"])
+                merged["g2"].update(st.get("g2", {}))
+        return merged
+
+    def load_state_dict(self, name, state):
+        """Reshard a merged state back onto the servers."""
+        n_srv = len(self.endpoints)
+        for s in range(n_srv):
+            part = {
+                "meta": state["meta"],
+                "rows": {k: v for k, v in state["rows"].items() if int(k) % n_srv == s},
+                "g2": {k: v for k, v in state.get("g2", {}).items()
+                       if int(k) % n_srv == s},
+            }
+            self._call(s, "load_state_dict", name, part)
+
+    def barrier(self, tag, world):
+        """All-worker barrier arbitrated by server 0."""
+        self._call(0, "barrier", tag, world)
+
+    def stop_servers(self):
+        for s in range(len(self.endpoints)):
+            try:
+                self._call(s, "stop")
+            except (OSError, EOFError):
+                pass
+
+    def close(self):
+        self._pool.shutdown(wait=False)
+        for c in self._conns:
+            if c is not None:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+        self._conns = [None] * len(self.endpoints)

@@ -1,0 +1,106 @@
+"""Host-resident sparse parameter table (reference analogue:
+paddle/fluid/distributed/ps/table/memory_sparse_table.cc `MemorySparseTable`
++ ctr accessors — hash-bucketed id->row storage with per-row optimizer
+state, rows created lazily on first pull).
+
+TPU-native framing: the PS tier exists for tables BIGGER than device HBM
+(CTR embeddings). Rows live on the host in numpy; the dense compute the
+pulled rows feed stays on the TPU via the normal jit path. Device-resident
+embeddings (vocab-sharded over the mesh) remain the collective-mode path —
+this table is the beyond-HBM capability class.
+"""
+import threading
+
+import numpy as np
+
+
+class SparseTable:
+    """id -> f32 row with a per-row sparse optimizer (sgd | adagrad).
+
+    Rows initialize lazily on first access (uniform [-scale, scale], seeded
+    per-id so every server shard is deterministic regardless of arrival
+    order). push() applies the optimizer server-side — workers ship raw
+    gradients, never updated rows, so concurrent workers compose like
+    async-SGD instead of last-writer-wins.
+    """
+
+    def __init__(self, dim, optimizer="adagrad", lr=0.05, init_scale=0.01,
+                 adagrad_eps=1e-8, seed=0):
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(f"unknown sparse optimizer {optimizer!r}")
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.init_scale = float(init_scale)
+        self.adagrad_eps = float(adagrad_eps)
+        self.seed = int(seed)
+        self._rows = {}
+        self._g2 = {}  # adagrad accumulators
+        self._lock = threading.Lock()
+
+    def _init_row(self, i):
+        rng = np.random.RandomState((self.seed * 0x9E3779B1 + int(i)) & 0x7FFFFFFF)
+        return rng.uniform(-self.init_scale, self.init_scale, self.dim).astype(np.float32)
+
+    def pull(self, ids):
+        """[n] int ids -> [n, dim] f32 rows (creating missing rows)."""
+        ids = np.asarray(ids, np.int64).ravel()
+        out = np.empty((ids.size, self.dim), np.float32)
+        with self._lock:
+            for k, i in enumerate(ids):
+                row = self._rows.get(int(i))
+                if row is None:
+                    row = self._rows[int(i)] = self._init_row(int(i))
+                out[k] = row
+        return out
+
+    def push(self, ids, grads):
+        """Apply the sparse optimizer to grads ([n, dim]) for ids ([n]).
+
+        Duplicate ids within one push are accumulated first (sum), matching
+        what a dense embedding gradient would produce.
+        """
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32).reshape(ids.size, self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        acc = np.zeros((uniq.size, self.dim), np.float32)
+        np.add.at(acc, inv, grads)
+        with self._lock:
+            for k, i in enumerate(uniq):
+                i = int(i)
+                row = self._rows.get(i)
+                if row is None:
+                    row = self._rows[i] = self._init_row(i)
+                g = acc[k]
+                if self.optimizer == "sgd":
+                    row -= self.lr * g
+                else:
+                    g2 = self._g2.get(i)
+                    if g2 is None:
+                        g2 = self._g2[i] = np.zeros(self.dim, np.float32)
+                    g2 += g * g
+                    row -= self.lr * g / (np.sqrt(g2) + self.adagrad_eps)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._rows)
+
+    def state_dict(self):
+        with self._lock:
+            return {
+                "meta": {"dim": self.dim, "optimizer": self.optimizer, "lr": self.lr,
+                         "init_scale": self.init_scale, "seed": self.seed},
+                "rows": {k: v.copy() for k, v in self._rows.items()},
+                "g2": {k: v.copy() for k, v in self._g2.items()},
+            }
+
+    def load_state_dict(self, state):
+        meta = state.get("meta", {})
+        for attr in ("dim", "optimizer", "lr", "init_scale", "seed"):
+            if attr in meta and meta[attr] != getattr(self, attr):
+                raise ValueError(
+                    f"checkpoint {attr}={meta[attr]!r} does not match table "
+                    f"{attr}={getattr(self, attr)!r}")
+        with self._lock:
+            self._rows = {int(k): np.asarray(v, np.float32) for k, v in state["rows"].items()}
+            self._g2 = {int(k): np.asarray(v, np.float32) for k, v in state.get("g2", {}).items()}
