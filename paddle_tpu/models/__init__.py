@@ -1,6 +1,12 @@
 """Model zoo — flagship LLM families (BASELINE configs 2-5)."""
-from . import bert, gpt, hf_compat, llama
+from . import bert, ernie, gpt, hf_compat, llama
 from .bert import BertConfig, BertForPretraining, BertForSequenceClassification, BertModel
+from .ernie import (
+    ErnieConfig,
+    ErnieForMaskedLM,
+    ErnieForSequenceClassification,
+    ErnieModel,
+)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel
 from .llama import (
     LlamaConfig,

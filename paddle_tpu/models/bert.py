@@ -13,6 +13,20 @@ from ..tensor import creation, manipulation
 from .llama import _mk_linear
 
 
+def _mk_biased_linear(in_f, out_f, spec, std=0.02):
+    """BERT/ERNIE projections carry biases, unlike LLaMA's."""
+    return _mk_linear(in_f, out_f, spec, std=std, bias=True)
+
+
+def expand_padding_mask(attention_mask):
+    """[B, S] 0/1 padding mask -> additive [B, 1, 1, S] mask (shared by the
+    BERT-family encoders: BertModel, ErnieModel)."""
+    if attention_mask is not None and attention_mask.ndim == 2:
+        m = manipulation.unsqueeze(attention_mask, [1, 2])
+        attention_mask = (1.0 - m.astype("float32")) * -1e9
+    return attention_mask
+
+
 class BertConfig:
     def __init__(self, vocab_size=30522, hidden_size=768, num_hidden_layers=12,
                  num_attention_heads=12, intermediate_size=3072, max_position_embeddings=512,
@@ -58,17 +72,22 @@ class BertEmbeddings(Layer):
         self.layer_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
         self.dropout = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+    def embed_sum(self, input_ids, token_type_ids=None, position_ids=None):
+        """word + position + token-type sum, before LN/dropout (subclass
+        hook: ERNIE adds its task-type table on top)."""
         S = input_ids.shape[1]
         if position_ids is None:
             position_ids = creation.arange(S, dtype="int32")
         if token_type_ids is None:
             token_type_ids = creation.zeros([S], dtype="int32")
-        e = (
+        return (
             self.word_embeddings(input_ids)
             + self.position_embeddings(position_ids)
             + self.token_type_embeddings(token_type_ids)
         )
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        e = self.embed_sum(input_ids, token_type_ids, position_ids)
         return self.dropout(self.layer_norm(e))
 
 
@@ -78,8 +97,8 @@ class BertSelfAttention(Layer):
         h = config.hidden_size
         self.num_heads = config.num_attention_heads
         self.head_dim = h // self.num_heads
-        self.qkv = _mk_linear(h, 3 * h, P(None, "mp"))
-        self.out = _mk_linear(h, h, P("mp", None))
+        self.qkv = _mk_biased_linear(h, 3 * h, P(None, "mp"))
+        self.out = _mk_biased_linear(h, h, P("mp", None))
         self.dropout_p = config.attention_probs_dropout_prob
 
     def forward(self, x, attention_mask=None):
@@ -97,8 +116,8 @@ class BertLayer(Layer):
         super().__init__()
         self.attention = BertSelfAttention(config)
         self.attn_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
-        self.intermediate = _mk_linear(config.hidden_size, config.intermediate_size, P(None, "mp"))
-        self.output = _mk_linear(config.intermediate_size, config.hidden_size, P("mp", None))
+        self.intermediate = _mk_biased_linear(config.hidden_size, config.intermediate_size, P(None, "mp"))
+        self.output = _mk_biased_linear(config.intermediate_size, config.hidden_size, P("mp", None))
         self.out_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
         self.dropout = Dropout(config.hidden_dropout_prob)
 
@@ -109,23 +128,25 @@ class BertLayer(Layer):
 
 
 class BertModel(Layer):
+    embeddings_cls = BertEmbeddings  # subclass hook (ERNIE swaps its own)
+
     def __init__(self, config: BertConfig):
         super().__init__()
         self.config = config
-        self.embeddings = BertEmbeddings(config)
+        self.embeddings = self.embeddings_cls(config)
         self.encoder = LayerList([BertLayer(config) for _ in range(config.num_hidden_layers)])
         self.pooler = Linear(config.hidden_size, config.hidden_size)
 
-    def forward(self, input_ids, token_type_ids=None, position_ids=None, attention_mask=None):
-        if attention_mask is not None and attention_mask.ndim == 2:
-            # [B, S] padding mask -> additive [B, 1, 1, S]
-            m = manipulation.unsqueeze(attention_mask, [1, 2])
-            attention_mask = (1.0 - m.astype("float32")) * -1e9
-        x = self.embeddings(input_ids, token_type_ids, position_ids)
+    def _encode(self, x, attention_mask):
         for layer in self.encoder:
             x = layer(x, attention_mask)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, attention_mask=None):
+        attention_mask = expand_padding_mask(attention_mask)
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        return self._encode(x, attention_mask)
 
 
 class BertForSequenceClassification(Layer):

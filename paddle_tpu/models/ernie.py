@@ -1,0 +1,173 @@
+"""ERNIE family — Baidu's flagship NLP model line (reference analogue:
+PaddleNLP ErnieModel / ERNIE 1.0-3.0; architecture as mirrored by
+transformers.ErnieModel): a post-LN BERT-style encoder whose embeddings add
+a task-type embedding table (multi-task pretraining, ERNIE 2.0+) gated by
+`use_task_id`.
+
+Reuses the BERT encoder blocks (same post-LN residual structure, fused-qkv
+SDPA attention with TP PartitionSpecs); `load_from_hf` transplants weights
+from a transformers ErnieModel for oracle-level parity tests."""
+import numpy as np
+
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..tensor import creation
+from .bert import BertEmbeddings, BertLayer, BertModel, expand_padding_mask
+
+
+class ErnieConfig:
+    def __init__(self, vocab_size=40000, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=3072,
+                 max_position_embeddings=2048, type_vocab_size=4,
+                 task_type_vocab_size=3, use_task_id=True,
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 layer_norm_eps=1e-12):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.task_type_vocab_size = task_type_vocab_size
+        self.use_task_id = use_task_id
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.layer_norm_eps = layer_norm_eps
+
+
+def ernie_base(**kw):
+    return ErnieConfig(**kw)
+
+
+def ernie_tiny(**kw):
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("max_position_embeddings", 64)
+    return ErnieConfig(**kw)
+
+
+class ErnieEmbeddings(BertEmbeddings):
+    """BERT embeddings + the ERNIE task-type table (use_task_id)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.use_task_id = config.use_task_id
+        if config.use_task_id:
+            self.task_type_embeddings = Embedding(config.task_type_vocab_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, task_type_ids=None):
+        e = self.embed_sum(input_ids, token_type_ids, position_ids)
+        if self.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = creation.zeros([input_ids.shape[1]], dtype="int32")
+            e = e + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(e))
+
+
+class ErnieModel(BertModel):
+    embeddings_cls = ErnieEmbeddings
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        attention_mask = expand_padding_mask(attention_mask)
+        x = self.embeddings(input_ids, token_type_ids, position_ids, task_type_ids)
+        return self._encode(x, attention_mask)
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, config: ErnieConfig, num_classes=2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids,
+                               attention_mask=attention_mask, task_type_ids=task_type_ids)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits
+
+
+class ErnieForMaskedLM(Layer):
+    """MLM head: transform + LN + decoder tied to word embeddings."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.mlm_bias = self.create_parameter([config.vocab_size], is_bias=True)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None, labels=None):
+        from ..tensor import linalg
+
+        seq_out, _ = self.ernie(input_ids, token_type_ids,
+                                attention_mask=attention_mask, task_type_ids=task_type_ids)
+        h = self.transform_norm(F.gelu(self.transform(seq_out)))
+        logits = linalg.matmul(h, self.ernie.embeddings.word_embeddings.weight,
+                               transpose_y=True) + self.mlm_bias
+        if labels is not None:
+            return F.cross_entropy(logits.astype("float32"), labels, ignore_index=-100)
+        return logits
+
+
+def load_from_hf(model: ErnieModel, hf_model):
+    """Transplant weights from a transformers ErnieModel (oracle interop;
+    pattern mirrors models/hf_compat.py for LLaMA). Raises on any size
+    mismatch rather than silently skipping."""
+
+    def t(x):
+        return np.asarray(x.detach().numpy(), np.float32)
+
+    def setw(param, value):
+        if tuple(param.shape) != tuple(value.shape):
+            raise ValueError(f"shape mismatch {tuple(param.shape)} vs {tuple(value.shape)}")
+        param.set_value(value.astype(np.float32))
+
+    he = hf_model.embeddings
+    me = model.embeddings
+    setw(me.word_embeddings.weight, t(he.word_embeddings.weight))
+    setw(me.position_embeddings.weight, t(he.position_embeddings.weight))
+    setw(me.token_type_embeddings.weight, t(he.token_type_embeddings.weight))
+    if model.embeddings.use_task_id:
+        setw(me.task_type_embeddings.weight, t(he.task_type_embeddings.weight))
+    setw(me.layer_norm.weight, t(he.LayerNorm.weight))
+    setw(me.layer_norm.bias, t(he.LayerNorm.bias))
+
+    if len(model.encoder) != len(hf_model.encoder.layer):
+        raise ValueError(
+            f"layer count mismatch: {len(model.encoder)} vs "
+            f"{len(hf_model.encoder.layer)}")
+    for ml, hl in zip(model.encoder, hf_model.encoder.layer):
+        sa = hl.attention.self
+        # fused qkv: [in, 3h] columns ordered (q | k | v) to match the
+        # [B,S,3,heads,hd] reshape in BertSelfAttention
+        qkv_w = np.concatenate([t(sa.query.weight).T, t(sa.key.weight).T,
+                                t(sa.value.weight).T], axis=1)
+        qkv_b = np.concatenate([t(sa.query.bias), t(sa.key.bias), t(sa.value.bias)])
+        setw(ml.attention.qkv.weight, qkv_w)
+        setw(ml.attention.qkv.bias, qkv_b)
+        setw(ml.attention.out.weight, t(hl.attention.output.dense.weight).T)
+        setw(ml.attention.out.bias, t(hl.attention.output.dense.bias))
+        setw(ml.attn_norm.weight, t(hl.attention.output.LayerNorm.weight))
+        setw(ml.attn_norm.bias, t(hl.attention.output.LayerNorm.bias))
+        setw(ml.intermediate.weight, t(hl.intermediate.dense.weight).T)
+        setw(ml.intermediate.bias, t(hl.intermediate.dense.bias))
+        setw(ml.output.weight, t(hl.output.dense.weight).T)
+        setw(ml.output.bias, t(hl.output.dense.bias))
+        setw(ml.out_norm.weight, t(hl.output.LayerNorm.weight))
+        setw(ml.out_norm.bias, t(hl.output.LayerNorm.bias))
+
+    setw(model.pooler.weight, t(hf_model.pooler.dense.weight).T)
+    setw(model.pooler.bias, t(hf_model.pooler.dense.bias))
+    return model

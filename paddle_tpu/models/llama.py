@@ -125,11 +125,17 @@ def llama_tiny(**kw):
     return LlamaConfig(**kw)
 
 
-def _mk_linear(in_f, out_f, spec, std=0.02):
-    l = Linear(in_f, out_f, weight_attr=None, bias_attr=False)
+def _mk_linear(in_f, out_f, spec, std=0.02, bias=False):
+    """TP-annotated Linear. bias=False for LLaMA-style projections; BERT/
+    ERNIE pass bias=True — a column-parallel ("mp" output dim) bias shards
+    on "mp", a row-parallel one replicates."""
+    l = Linear(in_f, out_f, weight_attr=None, bias_attr=None if bias else False)
     l.weight._data = I.Normal(0.0, std)((in_f, out_f), l.weight.dtype)
     l.weight.partition_spec = spec
     l.weight.is_distributed = True
+    if bias:
+        l.bias.partition_spec = P("mp") if spec[-1] == "mp" else P(None)
+        l.bias.is_distributed = True
     return l
 
 

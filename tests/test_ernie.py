@@ -1,0 +1,95 @@
+"""ERNIE family: shape/convergence tests + hidden-state parity against the
+REAL transformers.ErnieModel with transplanted weights (oracle pattern per
+SURVEY §4 and tests/test_hf_compat.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.models.ernie import (
+    ErnieForMaskedLM,
+    ErnieForSequenceClassification,
+    ErnieModel,
+    ernie_tiny,
+    load_from_hf,
+)
+
+
+def ids_batch(b, s, v, seed=0):
+    return np.random.RandomState(seed).randint(0, v, (b, s)).astype(np.int32)
+
+
+class TestErnie:
+    def test_classification_shapes_and_task_id(self):
+        paddle.seed(1)
+        cfg = ernie_tiny(hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+        model = ErnieForSequenceClassification(cfg, num_classes=3)
+        model.eval()
+        x = paddle.to_tensor(ids_batch(4, 16, cfg.vocab_size))
+        logits = model(x)
+        assert logits.shape == [4, 3]
+        # a different task id must change the output (the ERNIE-specific table)
+        task = paddle.to_tensor(np.full((4, 16), 2, np.int32))
+        logits_t2 = model(x, task_type_ids=task)
+        assert not np.allclose(logits.numpy(), logits_t2.numpy())
+
+    def test_no_task_id_config(self):
+        paddle.seed(2)
+        cfg = ernie_tiny(use_task_id=False)
+        model = ErnieModel(cfg)
+        assert not hasattr(model.embeddings, "task_type_embeddings")
+        seq, pooled = model(paddle.to_tensor(ids_batch(2, 8, cfg.vocab_size)))
+        assert seq.shape == [2, 8, cfg.hidden_size] and pooled.shape == [2, cfg.hidden_size]
+
+    def test_mlm_loss_converges(self):
+        paddle.seed(3)
+        cfg = ernie_tiny(hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+        model = ErnieForMaskedLM(cfg)
+        opt = optimizer.AdamW(learning_rate=5e-3, parameters=model.parameters())
+        ids = ids_batch(8, 16, cfg.vocab_size)
+        x, y = paddle.to_tensor(ids), paddle.to_tensor(ids.astype(np.int64))
+        losses = []
+        for _ in range(6):
+            loss = model(x, labels=y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestErnieHFParity:
+    def test_hidden_states_match_transformers(self):
+        torch = pytest.importorskip("torch")
+        from transformers import ErnieConfig as HFConfig
+        from transformers import ErnieModel as HFErnie
+
+        hf_cfg = HFConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=128,
+            max_position_embeddings=64, type_vocab_size=4,
+            task_type_vocab_size=3, use_task_id=True,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+            hidden_act="gelu",
+        )
+        torch.manual_seed(0)
+        hf = HFErnie(hf_cfg)
+        hf.eval()
+
+        cfg = ernie_tiny(hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+        paddle.seed(4)
+        model = ErnieModel(cfg)
+        load_from_hf(model, hf)
+        model.eval()
+
+        ids = ids_batch(2, 12, 128, seed=7)
+        task = np.ones((2, 12), np.int64)
+        with torch.no_grad():
+            hf_out = hf(input_ids=torch.tensor(ids.astype(np.int64)),
+                        task_type_ids=torch.tensor(task))
+        seq, pooled = model(paddle.to_tensor(ids),
+                            task_type_ids=paddle.to_tensor(task.astype(np.int32)))
+        np.testing.assert_allclose(
+            seq.numpy(), hf_out.last_hidden_state.numpy(), rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(
+            pooled.numpy(), hf_out.pooler_output.numpy(), rtol=2e-4, atol=2e-5)
