@@ -105,6 +105,7 @@ def child():
     print(json.dumps({
         "recompute": recompute, "fused_ce": fused_ce, "attn": fa.LAST_IMPL,
         "kv_heads": kv_heads,
+        "ce_unroll": int(os.environ.get("FLAGS_fused_ce_unroll", "0")),
         "chunk": chunk, "batch": batch, "block_q": block_q, "block_k": block_k,
         "step_s": round(dt, 4), "tok_s": round(toks, 1), "mfu": round(mfu, 4),
         "compile_s": round(compile_s, 1), "backend": _jax.default_backend(),
