@@ -150,9 +150,11 @@ class BertModel(Layer):
 
 
 class BertForSequenceClassification(Layer):
+    model_cls = BertModel  # subclass hook (ERNIE swaps its own encoder)
+
     def __init__(self, config: BertConfig, num_classes=2):
         super().__init__()
-        self.bert = BertModel(config)
+        self.bert = self.model_cls(config)
         self.dropout = Dropout(config.hidden_dropout_prob)
         self.classifier = Linear(config.hidden_size, num_classes)
 
@@ -164,24 +166,36 @@ class BertForSequenceClassification(Layer):
         return logits
 
 
+class MlmHead(Layer):
+    """transform + LN + tied-decoder MLM head (shared by BertForPretraining
+    and ErnieForMaskedLM — one copy so the families cannot drift)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.transform = Linear(config.hidden_size, config.hidden_size)
+        self.transform_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.mlm_bias = self.create_parameter([config.vocab_size], is_bias=True)
+
+    def forward(self, seq_out, word_embedding_weight):
+        from ..tensor import linalg
+
+        h = self.transform_norm(F.gelu(self.transform(seq_out)))
+        return linalg.matmul(h, word_embedding_weight, transpose_y=True) + self.mlm_bias
+
+
 class BertForPretraining(Layer):
     """MLM + NSP heads (reference: BertPretrainingHeads)."""
 
     def __init__(self, config: BertConfig):
         super().__init__()
         self.bert = BertModel(config)
-        self.transform = Linear(config.hidden_size, config.hidden_size)
-        self.transform_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.mlm_head = MlmHead(config)
         self.nsp = Linear(config.hidden_size, 2)
-        self.mlm_bias = self.create_parameter([config.vocab_size], is_bias=True)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 masked_lm_labels=None, next_sentence_labels=None):
-        from ..tensor import linalg
-
         seq_out, pooled = self.bert(input_ids, token_type_ids, attention_mask=attention_mask)
-        h = self.transform_norm(F.gelu(self.transform(seq_out)))
-        mlm_logits = linalg.matmul(h, self.bert.embeddings.word_embeddings.weight, transpose_y=True) + self.mlm_bias
+        mlm_logits = self.mlm_head(seq_out, self.bert.embeddings.word_embeddings.weight)
         nsp_logits = self.nsp(pooled)
         if masked_lm_labels is not None:
             loss = F.cross_entropy(mlm_logits.astype("float32"), masked_lm_labels, ignore_index=-100)

@@ -14,7 +14,8 @@ from ..nn.layer.common import Dropout, Embedding, Linear
 from ..nn.layer.layers import Layer
 from ..nn.layer.norm import LayerNorm
 from ..tensor import creation
-from .bert import BertEmbeddings, BertLayer, BertModel, expand_padding_mask
+from .bert import (BertEmbeddings, BertForSequenceClassification, BertLayer,
+                   BertModel, MlmHead, expand_padding_mask)
 
 
 class ErnieConfig:
@@ -80,17 +81,21 @@ class ErnieModel(BertModel):
         return self._encode(x, attention_mask)
 
 
-class ErnieForSequenceClassification(Layer):
-    def __init__(self, config: ErnieConfig, num_classes=2):
-        super().__init__()
-        self.ernie = ErnieModel(config)
-        self.dropout = Dropout(config.hidden_dropout_prob)
-        self.classifier = Linear(config.hidden_size, num_classes)
+class ErnieForSequenceClassification(BertForSequenceClassification):
+    """Bert classification head over the ERNIE encoder (model_cls hook);
+    only the task_type_ids pass-through is ERNIE-specific. The encoder is
+    reachable as either .bert (inherited) or .ernie (upstream name)."""
+
+    model_cls = ErnieModel
+
+    @property
+    def ernie(self):
+        return self.bert
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 task_type_ids=None, labels=None):
-        _, pooled = self.ernie(input_ids, token_type_ids,
-                               attention_mask=attention_mask, task_type_ids=task_type_ids)
+        _, pooled = self.bert(input_ids, token_type_ids,
+                              attention_mask=attention_mask, task_type_ids=task_type_ids)
         logits = self.classifier(self.dropout(pooled))
         if labels is not None:
             return F.cross_entropy(logits, labels)
@@ -98,24 +103,18 @@ class ErnieForSequenceClassification(Layer):
 
 
 class ErnieForMaskedLM(Layer):
-    """MLM head: transform + LN + decoder tied to word embeddings."""
+    """Shared MlmHead (bert.py) over the ERNIE encoder."""
 
     def __init__(self, config: ErnieConfig):
         super().__init__()
         self.ernie = ErnieModel(config)
-        self.transform = Linear(config.hidden_size, config.hidden_size)
-        self.transform_norm = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
-        self.mlm_bias = self.create_parameter([config.vocab_size], is_bias=True)
+        self.mlm_head = MlmHead(config)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 task_type_ids=None, labels=None):
-        from ..tensor import linalg
-
         seq_out, _ = self.ernie(input_ids, token_type_ids,
                                 attention_mask=attention_mask, task_type_ids=task_type_ids)
-        h = self.transform_norm(F.gelu(self.transform(seq_out)))
-        logits = linalg.matmul(h, self.ernie.embeddings.word_embeddings.weight,
-                               transpose_y=True) + self.mlm_bias
+        logits = self.mlm_head(seq_out, self.ernie.embeddings.word_embeddings.weight)
         if labels is not None:
             return F.cross_entropy(logits.astype("float32"), labels, ignore_index=-100)
         return logits

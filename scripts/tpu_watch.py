@@ -54,6 +54,11 @@ RECOVERY = [
       "from paddle_tpu.distributed.auto_parallel.planner import calibrate_from_bench;"
       "print(calibrate_from_bench('BENCH_rungs.jsonl', save_path='CALIBRATION.json'))"],
      300),
+    # CE chunk-unroll A/B on the headline shape (variants 11=unroll, 12=
+    # paired baseline) — decides whether FLAGS_fused_ce_unroll's default
+    # flips; runs LAST because it re-enters the big-compile kill zone
+    ("ce-unroll-ab",
+     [sys.executable, os.path.join(REPO, "scripts", "perf_exp.py"), "11", "12"], 1900),
 ]
 
 
