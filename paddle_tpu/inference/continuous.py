@@ -57,7 +57,8 @@ def _row_sampler(do_sample, temperature, top_k, top_p):
 
 class ContinuousBatchingEngine:
     def __init__(self, model, max_seqs=4, page_size=16, num_pages=None,
-                 max_len=512, kv_cache_dtype=None, decode_block=8):
+                 max_len=512, kv_cache_dtype=None, decode_block=8,
+                 enable_prefix_cache=False):
         cfg = model.config
         self.model = model
         model.eval()
@@ -104,6 +105,45 @@ class ContinuousBatchingEngine:
         self._insert_fns = {}
         self._decode_fns = {}
         self._decode_block_fns = {}
+        # ---- automatic prefix caching (vLLM-class; PAPERS.md ragged paged
+        # attention context). Content-addressed FULL prompt pages: a page
+        # holding tokens [j*bs, (j+1)*bs) of some prompt is indexed by the
+        # exact byte string of the prompt's first (j+1)*bs tokens, so a later
+        # request sharing that prefix points its page table at the SAME page
+        # (refcounted) and prefills only its suffix — attention over the
+        # shared prefix is served by a jitted page-gather instead of
+        # recompute. Pages with refcount 0 stay cached (LRU-evictable) until
+        # the allocator needs them. Shared pages are never written: decode
+        # writes at positions >= true_len and the match is capped at
+        # (true_len-1)//bs pages, so every write lands in a private page.
+        self.enable_prefix_cache = bool(enable_prefix_cache)
+        if self.enable_prefix_cache and kv_cache_dtype == "int8":
+            # a shared prefix would be re-read through the lossy int8
+            # pool while the no-cache path attends to exact float KV —
+            # silently divergent outputs near argmax ties; refuse rather
+            # than break the engine's exact-equality contract
+            raise ValueError("enable_prefix_cache does not compose with "
+                             "kv_cache_dtype='int8' (lossy prefix KV would "
+                             "change outputs vs the uncached path)")
+        self._prefix_index = {}   # prefix bytes -> page_id
+        self._page_hash = {}      # page_id -> prefix bytes (indexed pages)
+        self._page_refs = {}      # page_id -> refcount (in-use pages)
+        from collections import OrderedDict
+
+        self._evictable = OrderedDict()  # page_id -> None; LRU order
+        self._gather_fns = {}
+        self._prefill_suffix_fns = {}
+        self._cache_weights_version = None
+
+    def clear_prefix_cache(self):
+        """Drop all cached (refcount-0) prefix pages and their index. In-use
+        pages are untouched — they free normally on retire (their index
+        entries are already gone, so they cannot be matched again)."""
+        for pid in list(self._evictable):
+            self.free_pages.append(pid)
+        self._evictable.clear()
+        self._prefix_index.clear()
+        self._page_hash.clear()
         # decode_block: max decode steps fused into ONE device dispatch
         # (lax.scan). Each dispatch costs a full host→device round trip —
         # ~1.3s through the axon tunnel (PROFILE.md r5) — so per-token
@@ -113,7 +153,128 @@ class ContinuousBatchingEngine:
         # the block's compute for its slot. 1 restores per-token behavior.
         self.decode_block = max(int(decode_block), 1)
         # observability for tests/bench: peak pages in use, deferred admits
-        self.stats = {"peak_pages": 0, "deferred_admissions": 0, "decode_steps": 0}
+        self.stats = {"peak_pages": 0, "deferred_admissions": 0,
+                      "decode_steps": 0, "prefix_hit_pages": 0,
+                      "prefix_evictions": 0}
+
+    # ---- prefix-cache page accounting -------------------------------------
+    def _available_pages(self):
+        return len(self.free_pages) + len(self._evictable)
+
+    def _alloc_pages(self, n):
+        """Take n pages: free list first, then LRU-evict cached ones."""
+        out = []
+        for _ in range(n):
+            if self.free_pages:
+                out.append(self.free_pages.pop())
+                continue
+            pid, _ = self._evictable.popitem(last=False)  # LRU
+            key = self._page_hash.pop(pid)
+            self._prefix_index.pop(key, None)
+            self.stats["prefix_evictions"] += 1
+            out.append(pid)
+        return out
+
+    def _ref_pages(self, pages):
+        for p in pages:
+            self._page_refs[p] = self._page_refs.get(p, 0) + 1
+            self._evictable.pop(p, None)
+
+    def _unref_pages(self, pages):
+        for p in pages:
+            self._page_refs[p] -= 1
+            if self._page_refs[p] == 0:
+                del self._page_refs[p]
+                if p in self._page_hash:  # cached: keep KV, evict lazily
+                    self._evictable[p] = None
+                else:
+                    self.free_pages.append(p)
+
+    def _match_prefix(self, prompt, true_len):
+        """Longest run of indexed full pages, capped so >=1 suffix token
+        remains to prefill (its logits produce the first sampled token)."""
+        bs = self.page_size
+        p_max = (true_len - 1) // bs
+        shared = []
+        for j in range(p_max):
+            pid = self._prefix_index.get(prompt[:(j + 1) * bs].tobytes())
+            if pid is None:
+                break
+            shared.append(pid)
+        return len(shared), shared
+
+    def _index_prompt_pages(self, prompt, true_len, pages, start):
+        """Register this request's full prompt pages (from page `start` on;
+        earlier ones were matched, hence already indexed)."""
+        bs = self.page_size
+        for j in range(start, len(pages)):
+            if (j + 1) * bs > true_len:
+                break
+            key = prompt[:(j + 1) * bs].tobytes()
+            if key not in self._prefix_index:  # first writer wins
+                self._prefix_index[key] = pages[j]
+                self._page_hash[pages[j]] = key
+
+    # ---- prefix-cache jitted pieces ---------------------------------------
+    def _gather_prefix(self, n_pages):
+        """pools + page ids [n_pages] -> dense prefix KV [L, n*bs, Hkv, D]."""
+        fn = self._gather_fns.get(n_pages)
+        if fn is not None:
+            return fn
+        bs = self.page_size
+
+        def read(pool, page_ids):
+            # float pools only: int8 + prefix cache is refused in __init__
+            arr = pool[:, page_ids]
+            # [Hkv, n, bs, D] -> [n*bs, Hkv, D]
+            arr = jnp.transpose(arr, (1, 2, 0, 3))
+            return arr.reshape(n_pages * bs, arr.shape[2], arr.shape[3])
+
+        def gather(pools, page_ids):
+            ks = jnp.stack([read(kp, page_ids) for kp, _ in pools])
+            vs = jnp.stack([read(vp, page_ids) for _, vp in pools])
+            return ks, vs
+
+        fn = self._gather_fns[n_pages] = jax.jit(gather)
+        return fn
+
+    def _prefill_suffix(self, n_prefix_pages, suffix_bucket, sampling):
+        """Prefill ONLY the suffix, attending to the gathered prefix KV via
+        the model's fixed-cache path (cache_position = prefix length, whose
+        absolute-position mask handles the offset). Compiled per
+        (prefix-page-count, suffix bucket, sampling) — repeated system
+        prompts hit a handful of distinct prefix lengths, so the program
+        cache stays small."""
+        key3 = (n_prefix_pages, suffix_bucket, sampling)
+        fn = self._prefill_suffix_fns.get(key3)
+        if fn is not None:
+            return fn
+        model = self.model
+        sampler = _row_sampler(*sampling)
+        plen = n_prefix_pages * self.page_size
+
+        def prefill_suf(state, ks_pre, vs_pre, ids_suf, suf_len, key):
+            overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
+            caches = model.init_cache(1, plen + suffix_bucket)
+            wrapped = []
+            for l, (kc, vc) in enumerate(caches):
+                kc = kc.at[0, :plen].set(ks_pre[l].astype(kc.dtype))
+                vc = vc.at[0, :plen].set(vs_pre[l].astype(vc.dtype))
+                wrapped.append((Tensor(kc), Tensor(vc)))
+            logits, presents = model.functional_call(
+                overrides, Tensor(ids_suf), past_key_values=wrapped,
+                cache_position=Tensor(jnp.int32(plen)), use_cache=True,
+                training=False,
+            )
+            last = jax.lax.dynamic_index_in_dim(logits._data, suf_len - 1,
+                                                axis=1, keepdims=False)
+            tok0 = sampler(last, key[None])[0].astype(jnp.int32)
+            ks = jnp.stack([p[0]._data[0, plen:] for p in presents])
+            vs = jnp.stack([p[1]._data[0, plen:] for p in presents])
+            return tok0, ks, vs
+
+        fn = self._prefill_suffix_fns[key3] = jax.jit(prefill_suf)
+        return fn
 
     # ---- jitted pieces ----------------------------------------------------
     def _prefill(self, bucket, sampling):
@@ -254,7 +415,7 @@ class ContinuousBatchingEngine:
         return fn
 
     def warmup(self, prompt_lens, do_sample=False, temperature=1.0,
-               top_k=0, top_p=1.0):
+               top_k=0, top_p=1.0, shared_prefix_lens=()):
         """Compile every program serve() can hit for prompts of these
         lengths BEFORE latency-sensitive serving (reference:
         AnalysisPredictor warmup / TRT engine build-ahead): one dummy
@@ -267,6 +428,47 @@ class ContinuousBatchingEngine:
         kw = dict(do_sample=do_sample, temperature=temperature,
                   top_k=top_k, top_p=top_p)
         stats_before = dict(self.stats)  # warmup must not pollute diagnostics
+        # bypass the prefix cache during the dummy serves: the all-ones
+        # prompts would cross-hit each other, compiling suffix programs
+        # INSTEAD of the full-prefill programs real cache-miss requests need
+        # (the exact mid-serve compile stall warmup exists to prevent) and
+        # leaving junk ones-pages indexed
+        pfx, self.enable_prefix_cache = self.enable_prefix_cache, False
+        try:
+            self._warmup_serves(prompt_lens, kw)
+        finally:
+            self.enable_prefix_cache = pfx
+            self.stats = stats_before
+        if pfx and shared_prefix_lens:
+            # compile the cache-HIT programs too: for each expected shared
+            # prefix length, the page gather + suffix prefill a matching
+            # request will dispatch. Pure dummy calls — no cache state or
+            # pool contents are touched (gather reads, prefill returns).
+            sampling = ((False, 1.0, 0, 1.0) if not do_sample else
+                        (True, float(temperature), int(top_k), float(top_p)))
+            state = self.model.raw_state_dict()
+            bs = self.page_size
+            for sp in shared_prefix_lens:
+                for l in prompt_lens:
+                    if l <= sp:
+                        continue
+                    n_pre = min(int(sp) // bs, (int(l) - 1) // bs)
+                    while n_pre:
+                        sbucket = prompt_bucket(int(l) - n_pre * bs)
+                        if n_pre + self._pages_for_bucket(sbucket, bs) \
+                                <= self.pages_per_seq:
+                            break
+                        n_pre -= 1
+                    if not n_pre:
+                        continue
+                    ks, vs = self._gather_prefix(n_pre)(
+                        tuple(self.pools),
+                        jnp.zeros((n_pre,), jnp.int32))  # scratch page reads
+                    self._prefill_suffix(n_pre, sbucket, sampling)(
+                        state, ks, vs, jnp.zeros((1, sbucket), jnp.int32),
+                        jnp.int32(1), jax.random.PRNGKey(0))
+
+    def _warmup_serves(self, prompt_lens, kw):
         # Decode-program ladder on a length-1 dummy prompt: the decode/block
         # programs don't depend on prompt length, and the shortest prompt
         # maximizes the admissible walk under both the max_len check and the
@@ -277,7 +479,7 @@ class ContinuousBatchingEngine:
         # (plain per-token decode) program, which the even walk never hits.
         ladder_bucket = prompt_bucket(1)
         fit = min(self.max_len - 1,
-                  len(self.free_pages) * self.page_size - ladder_bucket)
+                  self._available_pages() * self.page_size - ladder_bucket)
         runs = [2]  # k=1 (plain per-token decode) program
         if self.decode_block > 1:
             runs.append(2 * self.decode_block - 1)  # k = decode_block..2
@@ -297,7 +499,6 @@ class ContinuousBatchingEngine:
         for b in sorted(rep):
             if b != ladder_bucket or not runs:
                 self.serve([np.ones(rep[b], np.int32)], max_new_tokens=1, **kw)
-        self.stats = stats_before
 
     # ---- scheduler --------------------------------------------------------
     def pool_bytes(self):
@@ -333,13 +534,22 @@ class ContinuousBatchingEngine:
             return _KEYS_FN(base_key, jnp.asarray([rid]), jnp.asarray([tok_idx]))[0]
 
         state = self.model.raw_state_dict()
+        if self.enable_prefix_cache:
+            # cached prefix KV is only valid under the weights it was
+            # computed with; jnp arrays are immutable, so any weight update
+            # rebinds new array objects and changes this id-tuple
+            version = tuple(id(v) for v in state.values())
+            if version != self._cache_weights_version:
+                if self._cache_weights_version is not None:
+                    self.clear_prefix_cache()
+                self._cache_weights_version = version
         queue = deque(enumerate(prompts))
         results = [None] * len(prompts)
         # slot -> [req_id, tokens_out(list), n_generated, last_token, pages(list)]
         active = {}
 
         def pages_in_use():
-            return self.num_pages - 1 - len(self.free_pages)
+            return self.num_pages - 1 - self._available_pages()
 
         def try_admit():
             admitted = False
@@ -352,25 +562,60 @@ class ContinuousBatchingEngine:
                     raise ValueError(
                         f"request {rid}: len {true_len} (bucket {bucket}) + "
                         f"{max_new_tokens} exceeds max_len={self.max_len}")
-                need = max(self._pages_for_bucket(bucket, self.page_size),
-                           -(-(true_len + max_new_tokens) // self.page_size))
-                if need > len(self.free_pages):
+                bs_ = self.page_size
+                if self.enable_prefix_cache:
+                    n_pre, shared = self._match_prefix(prompt, true_len)
+                else:
+                    n_pre, shared = 0, []
+                # shrink the hit until prefix + rounded suffix bucket fit the
+                # page-table row: the suffix bucket rounds up independently,
+                # so a full-width hit can otherwise need pages_per_seq+1
+                # pages (row overflow)
+                while n_pre:
+                    suffix_len = true_len - n_pre * bs_
+                    sbucket = prompt_bucket(suffix_len)
+                    if n_pre + self._pages_for_bucket(sbucket, bs_) \
+                            <= self.pages_per_seq:
+                        break
+                    n_pre -= 1
+                    shared = shared[:n_pre]
+                if not n_pre:
+                    suffix_len, sbucket = true_len, bucket
+                region = self._pages_for_bucket(sbucket, bs_)
+                total_need = max(n_pre + region,
+                                 -(-(true_len + max_new_tokens) // bs_))
+                # hold the shared pages BEFORE the availability check: shared
+                # pages sitting in _evictable would otherwise be double-
+                # counted as allocatable, letting _alloc_pages run dry
+                self._ref_pages(shared)
+                if total_need - n_pre > self._available_pages():
+                    self._unref_pages(shared)
                     self.stats["deferred_admissions"] += 1
                     break  # FIFO: wait for pages instead of skipping ahead
                 queue.popleft()
                 slot = self.free_slots.pop()
-                pages = [self.free_pages.pop() for _ in range(need)]
+                new_pages = self._alloc_pages(total_need - n_pre)
+                self._ref_pages(new_pages)
+                pages = shared + new_pages
                 self.stats["peak_pages"] = max(self.stats["peak_pages"], pages_in_use())
-                ids_p = np.zeros((1, bucket), np.int32)
-                ids_p[0, :true_len] = prompt
-                tok0, ks, vs = self._prefill(bucket, sampling)(
-                    state, jnp.asarray(ids_p), jnp.int32(true_len),
-                    req_key(rid, 0))
-                page_ids = jnp.asarray(
-                    pages[:self._pages_for_bucket(bucket, self.page_size)],
-                    jnp.int32)
-                self.pools = list(self._insert(bucket)(
+                ids_p = np.zeros((1, sbucket), np.int32)
+                ids_p[0, :suffix_len] = prompt[n_pre * bs_:]
+                if n_pre:
+                    self.stats["prefix_hit_pages"] += n_pre
+                    ks_pre, vs_pre = self._gather_prefix(n_pre)(
+                        tuple(self.pools), jnp.asarray(shared, jnp.int32))
+                    tok0, ks, vs = self._prefill_suffix(n_pre, sbucket, sampling)(
+                        state, ks_pre, vs_pre, jnp.asarray(ids_p),
+                        jnp.int32(suffix_len), req_key(rid, 0))
+                else:
+                    tok0, ks, vs = self._prefill(sbucket, sampling)(
+                        state, jnp.asarray(ids_p), jnp.int32(suffix_len),
+                        req_key(rid, 0))
+                page_ids = jnp.asarray(new_pages[:region], jnp.int32)
+                self.pools = list(self._insert(sbucket)(
                     tuple(self.pools), ks, vs, page_ids))
+                if self.enable_prefix_cache:
+                    self._index_prompt_pages(prompt, true_len, pages, n_pre)
                 row = np.zeros(self.pages_per_seq, np.int32)
                 row[:len(pages)] = pages
                 self.page_table[slot] = row
@@ -390,7 +635,7 @@ class ContinuousBatchingEngine:
         def retire(slot):
             rid, toks, _, _, pages = active.pop(slot)
             results[rid] = np.asarray(toks, np.int32)
-            self.free_pages.extend(pages)
+            self._unref_pages(pages)
             self.free_slots.append(slot)
             self.page_table[slot] = 0
             self.lengths[slot] = 0

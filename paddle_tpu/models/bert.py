@@ -183,8 +183,30 @@ class MlmHead(Layer):
         return linalg.matmul(h, word_embedding_weight, transpose_y=True) + self.mlm_bias
 
 
+def _remap_legacy_keys(state_dict, remap):
+    """Checkpoint compat: accept pre-refactor key spellings (prefix remap,
+    first match wins) without touching already-current keys."""
+    out = {}
+    for k, v in state_dict.items():
+        for old, new in remap:
+            if k == old or k.startswith(old + "."):
+                k = new + k[len(old):]
+                break
+        out[k] = v
+    return out
+
+
 class BertForPretraining(Layer):
     """MLM + NSP heads (reference: BertPretrainingHeads)."""
+
+    _LEGACY_KEYS = (("transform", "mlm_head.transform"),
+                    ("transform_norm", "mlm_head.transform_norm"),
+                    ("mlm_bias", "mlm_head.mlm_bias"))
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        return super().set_state_dict(
+            _remap_legacy_keys(state_dict, self._LEGACY_KEYS),
+            use_structured_name)
 
     def __init__(self, config: BertConfig):
         super().__init__()

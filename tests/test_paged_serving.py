@@ -378,3 +378,150 @@ def test_block_decode_matches_per_token():
             assert eng.stats["decode_steps"] > 0
     for a, b in zip(outs[1], outs[8]):
         np.testing.assert_array_equal(a, b)
+
+
+class TestPrefixCache:
+    """Automatic prefix caching: content-addressed shared pages, refcounts,
+    LRU eviction, suffix-only prefill (vLLM-class capability)."""
+
+    def _model(self):
+        return _tiny_model()
+
+    def test_shared_system_prompt_matches_dense_and_hits(self):
+        """Requests sharing a long system prefix must produce EXACTLY the
+        no-cache outputs while reusing the prefix pages."""
+        m, cfg = self._model()
+        rng = np.random.RandomState(7)
+        sys_prompt = rng.randint(1, cfg.vocab_size, (33,)).astype(np.int32)
+        prompts = [np.concatenate([sys_prompt,
+                                   rng.randint(1, cfg.vocab_size, (k,))
+                                   .astype(np.int32)])
+                   for k in (4, 9, 2, 6)]
+        new = 5
+        base = ContinuousBatchingEngine(m, max_seqs=2, page_size=8,
+                                        num_pages=32, max_len=96)
+        want = base.serve(prompts, max_new_tokens=new)
+        eng = ContinuousBatchingEngine(m, max_seqs=2, page_size=8,
+                                       num_pages=32, max_len=96,
+                                       enable_prefix_cache=True)
+        got = eng.serve(prompts, max_new_tokens=new)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+        # 33-token shared prefix @ page 8 = 4 full shared pages; requests
+        # 2..4 should each have hit them
+        assert eng.stats["prefix_hit_pages"] >= 3 * 4, eng.stats
+
+    def test_identical_prompts_second_serve_hits_cache(self):
+        """Cache persists across serve() calls on a warm engine."""
+        m, cfg = self._model()
+        rng = np.random.RandomState(8)
+        p = rng.randint(1, cfg.vocab_size, (20,)).astype(np.int32)
+        eng = ContinuousBatchingEngine(m, max_seqs=1, page_size=8,
+                                       num_pages=16, max_len=64,
+                                       enable_prefix_cache=True)
+        first = eng.serve([p], max_new_tokens=4)[0]
+        hits0 = eng.stats["prefix_hit_pages"]
+        second = eng.serve([p], max_new_tokens=4)[0]
+        np.testing.assert_array_equal(first, second)
+        # 20 tokens @ page 8 -> pages covering [0,8), [8,16) shareable
+        # ((20-1)//8 = 2 full-page cap)
+        assert eng.stats["prefix_hit_pages"] - hits0 == 2, eng.stats
+
+    def test_page_accounting_invariant_and_eviction(self):
+        """free + evictable + in-use = num_pages - 1 at every quiet point;
+        a tight pool evicts cached pages instead of deadlocking."""
+        m, cfg = self._model()
+        rng = np.random.RandomState(9)
+        eng = ContinuousBatchingEngine(m, max_seqs=1, page_size=8,
+                                       num_pages=8, max_len=64,
+                                       enable_prefix_cache=True)
+
+        def check():
+            in_use = len(eng._page_refs)
+            assert in_use + len(eng.free_pages) + len(eng._evictable) \
+                == eng.num_pages - 1
+            assert 0 not in eng._page_refs and 0 not in eng._evictable
+
+        for i in range(4):  # distinct prompts large enough to force evictions
+            p = rng.randint(1, cfg.vocab_size, (24,)).astype(np.int32)
+            eng.serve([p], max_new_tokens=4)
+            check()
+        assert eng.stats["prefix_evictions"] > 0, eng.stats
+
+    def test_sampling_stream_independent_of_cache(self):
+        """Sampled outputs depend only on (seed, request id, token index) —
+        prefix-cache on/off must not change them."""
+        m, cfg = self._model()
+        rng = np.random.RandomState(10)
+        sys_prompt = rng.randint(1, cfg.vocab_size, (17,)).astype(np.int32)
+        prompts = [np.concatenate([sys_prompt,
+                                   rng.randint(1, cfg.vocab_size, (k,))
+                                   .astype(np.int32)]) for k in (3, 5)]
+        kw = dict(max_new_tokens=4, do_sample=True, temperature=0.9,
+                  top_p=0.9, seed=3)
+        off = ContinuousBatchingEngine(m, max_seqs=2, page_size=8,
+                                       num_pages=24, max_len=64)
+        on = ContinuousBatchingEngine(m, max_seqs=2, page_size=8,
+                                      num_pages=24, max_len=64,
+                                      enable_prefix_cache=True)
+        for w, g in zip(off.serve(prompts, **kw), on.serve(prompts, **kw)):
+            np.testing.assert_array_equal(w, g)
+
+    def test_shared_evictable_pages_not_double_counted(self):
+        """Admission must not count a request's own shared pages (sitting in
+        _evictable) as allocatable — regression for a KeyError crash in
+        _alloc_pages on a warm tight pool."""
+        m, cfg = self._model()
+        rng = np.random.RandomState(11)
+        x = rng.randint(1, cfg.vocab_size, (24,)).astype(np.int32)
+        y = rng.randint(1, cfg.vocab_size, (24,)).astype(np.int32)
+        eng = ContinuousBatchingEngine(m, max_seqs=2, page_size=8,
+                                       num_pages=8, max_len=64,
+                                       enable_prefix_cache=True)
+        eng.serve([x], max_new_tokens=4)  # X's 2 indexed pages -> evictable
+        outs = eng.serve([y, x], max_new_tokens=4)  # must not crash
+        ref_eng = ContinuousBatchingEngine(m, max_seqs=2, page_size=8,
+                                           num_pages=8, max_len=64)
+        for o, r in zip(outs, ref_eng.serve([y, x], max_new_tokens=4)):
+            np.testing.assert_array_equal(o, r)
+
+    def test_hit_plus_suffix_bucket_fits_page_table_row(self):
+        """A prefix hit whose independently-rounded suffix bucket would
+        overflow pages_per_seq must shrink the hit — regression for a
+        page-table row broadcast crash."""
+        m, cfg = self._model()
+        rng = np.random.RandomState(12)
+        seed_p = rng.randint(1, cfg.vocab_size, (24,)).astype(np.int32)
+        big = np.concatenate([seed_p[:8],
+                              rng.randint(1, cfg.vocab_size, (65,))
+                              .astype(np.int32)])  # 73 tokens, shares page 1
+        eng = ContinuousBatchingEngine(m, max_seqs=1, page_size=8,
+                                       num_pages=40, max_len=128,
+                                       enable_prefix_cache=True)
+        eng.serve([seed_p], max_new_tokens=2)
+        out = eng.serve([big], max_new_tokens=2)[0]  # must not crash
+        ref = ContinuousBatchingEngine(m, max_seqs=1, page_size=8,
+                                       num_pages=40, max_len=128)
+        np.testing.assert_array_equal(out, ref.serve([big], max_new_tokens=2)[0])
+
+    def test_warmup_bypasses_prefix_cache(self):
+        """warmup() must compile the FULL-prefill programs (all-ones dummy
+        prompts would otherwise cross-hit the cache and compile suffix
+        programs instead) and must not leave junk pages indexed."""
+        m, cfg = self._model()
+        eng = ContinuousBatchingEngine(m, max_seqs=1, page_size=8,
+                                       num_pages=40, max_len=256,
+                                       enable_prefix_cache=True)
+        eng.warmup([20, 70])
+        from paddle_tpu.generation import prompt_bucket
+
+        assert prompt_bucket(20) in {k[0] for k in eng._prefill_fns}
+        assert prompt_bucket(70) in {k[0] for k in eng._prefill_fns}
+        assert not eng._prefix_index and not eng._evictable
+        assert eng.enable_prefix_cache  # restored
+
+    def test_int8_pool_refuses_prefix_cache(self):
+        m, cfg = self._model()
+        with pytest.raises(ValueError, match="int8"):
+            ContinuousBatchingEngine(m, max_seqs=1, kv_cache_dtype="int8",
+                                     enable_prefix_cache=True)
