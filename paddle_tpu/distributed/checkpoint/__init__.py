@@ -9,11 +9,20 @@ shard inventory comes from jax.Array.addressable_shards.
 """
 import json
 import os
+import zlib
 
 import jax
 import numpy as np
 
 from ...framework.core import Tensor, to_tensor
+from ...testing import chaos
+from ...utils.metrics_bus import counters
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A shard file is missing, truncated, or fails its manifest checksum.
+    Raised by load_state_dict BEFORE any tensor is mutated, so a partial
+    write (preempted saver) can never half-load into a live model."""
 
 
 _UINT_FOR_WIDTH = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
@@ -50,18 +59,26 @@ class _AsyncSaveHandle:
     """Future-like handle for async_save (reference pattern: Orbax-style
     async checkpointing — device→host transfer happens synchronously so
     training can mutate weights immediately; serialization runs in a
-    background thread)."""
+    background thread). A write failure in the background thread is held
+    and re-raised from wait() — a silently-vanished checkpoint is the worst
+    possible failure mode for a resume path."""
 
-    def __init__(self, thread):
+    def __init__(self, thread, errbox):
         self._thread = thread
+        self._errbox = errbox
 
     def wait(self, timeout=None):
         self._thread.join(timeout)
         if self._thread.is_alive():
             raise TimeoutError("async checkpoint save still running")
+        if self._errbox:
+            raise self._errbox[0]
 
     def done(self):
         return not self._thread.is_alive()
+
+    def error(self):
+        return self._errbox[0] if self._errbox else None
 
 
 _last_async_save = None
@@ -94,34 +111,110 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         }
 
     def _write():
-        np.savez(data_file, **blobs)
-        if pid == coordinator_rank:
-            with open(os.path.join(path, "metadata.json"), "w") as f:
-                json.dump(metadata, f)
+        # ATOMIC commit protocol (reference pattern: Orbax commit-file /
+        # torch.distributed.checkpoint temp+rename): serialize to a temp
+        # file, fsync, then os.replace into place — a saver killed mid-write
+        # (preemption, OOM-kill) leaves only a *.tmp the loader never reads,
+        # and the previous checkpoint at `path` stays loadable. The manifest
+        # (metadata.json) commits LAST and carries per-file size+crc32, so a
+        # torn final rename is detectable at load time.
+        final = data_file + ".npz"
+        tmp = final + ".tmp"
+        meta_tmp = os.path.join(path, "metadata.json.tmp")
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **blobs)
+                f.flush()
+                os.fsync(f.fileno())
+            # fingerprint the INTENDED bytes (pre-commit): any later tear —
+            # injected or real — mismatches the manifest at load time
+            metadata["files"] = {os.path.basename(final): _file_fingerprint(tmp)}
+            # chaos "ckpt.write": exc = die before commit (tmp discarded, old
+            # checkpoint intact); truncate = torn shard committed (load detects)
+            chaos.site("ckpt.write", path=tmp)
+            os.replace(tmp, final)
+            if pid == coordinator_rank:
+                with open(meta_tmp, "w") as f:
+                    json.dump(metadata, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                chaos.site("ckpt.manifest", path=meta_tmp)
+                os.replace(meta_tmp, os.path.join(path, "metadata.json"))
+        finally:
+            for leftover in (tmp, meta_tmp):  # a failed save leaves no litter
+                if os.path.exists(leftover):
+                    try:
+                        os.remove(leftover)
+                    except OSError:
+                        pass
+        counters.bump("ckpt.committed")
 
     if async_save:
         import threading
 
         if _last_async_save is not None and not _last_async_save.done():
             _last_async_save.wait()  # serialize overlapping saves
-        th = threading.Thread(target=_write, daemon=True)
+        errbox = []
+
+        def _guarded():
+            try:
+                _write()
+            except BaseException as e:  # surfaced by handle.wait()
+                counters.bump("fault.ckpt.async_save_failed")
+                errbox.append(e)
+
+        th = threading.Thread(target=_guarded, daemon=True)
         th.start()
-        _last_async_save = _AsyncSaveHandle(th)
+        _last_async_save = _AsyncSaveHandle(th, errbox)
         return _last_async_save
     _write()
     return None
 
 
+def _file_fingerprint(fpath):
+    crc = 0
+    with open(fpath, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return {"bytes": os.path.getsize(fpath), "crc32": crc}
+
+
 def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, unique_id=None, offload=False):
     """Fills `state_dict` tensors in place, resharding from saved layout to
-    each tensor's CURRENT sharding (cross-mesh resume)."""
-    with open(os.path.join(path, "metadata.json")) as f:
+    each tensor's CURRENT sharding (cross-mesh resume).
+
+    Integrity gate: every referenced shard archive is verified against the
+    manifest (size + crc32, when present) and must unzip cleanly BEFORE any
+    tensor is touched; a truncated/partial shard raises
+    CheckpointCorruptError instead of poisoning a live model."""
+    meta_path = os.path.join(path, "metadata.json")
+    if not os.path.exists(meta_path):
+        raise CheckpointCorruptError(
+            f"{path}: no metadata.json — checkpoint was never committed "
+            f"(a *.tmp left behind means the saver died mid-write)")
+    with open(meta_path) as f:
         metadata = json.load(f)
+    fingerprints = metadata.get("files", {})
     archives = {}
     for fname in os.listdir(path):
         if fname.endswith(".distcp.npz") or fname.endswith(".distcp"):
             full = os.path.join(path, fname)
-            archives[fname.replace(".npz", "")] = np.load(full if full.endswith(".npz") else full + ".npz")
+            if not full.endswith(".npz"):
+                full += ".npz"
+            base = os.path.basename(full)
+            want = fingerprints.get(base)
+            if want is not None:
+                got = _file_fingerprint(full)
+                if got != want:
+                    counters.bump("fault.ckpt.corrupt_shard")
+                    raise CheckpointCorruptError(
+                        f"{full}: manifest says {want}, file is {got} — "
+                        f"partial/torn shard write")
+            try:
+                archives[fname.replace(".npz", "")] = np.load(full)
+            except Exception as e:
+                counters.bump("fault.ckpt.corrupt_shard")
+                raise CheckpointCorruptError(f"{full}: unreadable archive: {e}") from e
     for name, t in state_dict.items():
         info = metadata["tensors"].get(name)
         if info is None:
@@ -131,8 +224,19 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
         dt = np.dtype(info["dtype"]) if info["dtype"] != "bfloat16" else ml_dtypes.bfloat16
         full = np.zeros(info["global_shape"], dt)
         for shard in info["shards"]:
-            arch = archives[shard["file"]]
-            block = _from_savable(arch[shard["key"]], np.dtype(dt))
+            arch = archives.get(shard["file"])
+            if arch is None:
+                counters.bump("fault.ckpt.corrupt_shard")
+                raise CheckpointCorruptError(
+                    f"{path}: shard file {shard['file']!r} for tensor "
+                    f"{name!r} is missing — incomplete checkpoint")
+            try:
+                block = _from_savable(arch[shard["key"]], np.dtype(dt))
+            except Exception as e:  # torn zip member past the directory
+                counters.bump("fault.ckpt.corrupt_shard")
+                raise CheckpointCorruptError(
+                    f"{shard['file']}[{shard['key']}]: unreadable shard: {e}"
+                ) from e
             slices = tuple(slice(a, b) for a, b in shard["index"])
             full[slices] = block
         target = t._data.sharding if hasattr(t._data, "sharding") else None

@@ -10,11 +10,70 @@ autoresume loop that the reference expects training scripts to implement
 by hand.
 """
 import os
+import signal
+import sys
+import threading
 import time
 
 from ....framework.native import TCPStore
+from ....utils.metrics_bus import counters
 
 ELASTIC_TIMEOUT = 30
+
+#: exit code of a trainer that received SIGTERM (preemption notice),
+#: checkpointed, and left cleanly. The launcher's watch loop restarts this
+#: code even when elastic_level is off — a preempted worker is not a bug.
+#: 143 = 128+SIGTERM, what the process would report if it had NOT handled
+#: the signal, so external supervisors classify it identically.
+PREEMPTED_EXIT_CODE = 143
+
+
+class GracefulPreemption:
+    """SIGTERM-as-preemption-notice (the contract of preemptible TPU/GPU
+    capacity: the platform sends SIGTERM, grants a grace window, then
+    SIGKILLs). The handler only sets a flag — no checkpoint I/O runs in
+    signal context; the training loop exits at the next CHECKPOINT BOUNDARY
+    via exit_if_requested(), so the saved state is always a consistent
+    step, never a mid-mutation snapshot."""
+
+    def __init__(self):
+        self._flag = threading.Event()
+        self._prev = None
+
+    def install(self, signals=(signal.SIGTERM,)):
+        try:
+            self._prev = [(s, signal.signal(s, self._on_signal)) for s in signals]
+        except ValueError:
+            # not the main thread (e.g. hapi fit in a worker thread):
+            # preemption handling is then the embedder's job
+            self._prev = None
+        return self
+
+    def uninstall(self):
+        """Restore the previous handlers — an embedder (a test runner, a
+        notebook) must get its own SIGTERM semantics back after training."""
+        if self._prev:
+            try:
+                for s, h in self._prev:
+                    signal.signal(s, h)
+            except ValueError:
+                pass
+            self._prev = None
+
+    def _on_signal(self, signum, frame):
+        self._flag.set()
+
+    @property
+    def requested(self):
+        return self._flag.is_set()
+
+    def exit_if_requested(self, exit_code=PREEMPTED_EXIT_CODE):
+        """Call right after a checkpoint commit. Exits the process with the
+        preemption code so the watch loop restarts it to resume."""
+        if not self._flag.is_set():
+            return
+        counters.bump("fault.preempted_exit")
+        sys.exit(exit_code)
 
 
 class ElasticStatus:
@@ -71,19 +130,28 @@ class ElasticManager:
 
 
 def autoresume(train_fn, checkpoint_dir, model=None, optimizer=None, max_attempts=3,
-               save_every=None):
+               save_every=None, handle_preemption=True):
     """Autoresume loop (reference pattern: elastic relaunch + script-level
     checkpoint resume; SURVEY.md §5 failure detection → TPU equivalent).
 
     Runs train_fn(start_step, save_cb); on failure, reloads the latest
     checkpoint and retries. train_fn calls save_cb(step) at checkpoint
-    boundaries."""
+    boundaries.
+
+    With handle_preemption (default), SIGTERM makes the NEXT save_cb both
+    the checkpoint and the exit point: state is saved, then the process
+    exits PREEMPTED_EXIT_CODE so the launcher restarts it and this same
+    loop resumes from that step. Saves are atomic (serialization.save is
+    temp+rename), so dying anywhere inside save_cb leaves the previous
+    checkpoint loadable; the resume marker commits last, after the state
+    files it points at exist."""
     import json
 
     from .... import serialization
 
     os.makedirs(checkpoint_dir, exist_ok=True)
     meta_path = os.path.join(checkpoint_dir, "resume.json")
+    preempt = GracefulPreemption().install() if handle_preemption else None
 
     def latest_step():
         if os.path.exists(meta_path):
@@ -96,8 +164,24 @@ def autoresume(train_fn, checkpoint_dir, model=None, optimizer=None, max_attempt
             serialization.save(model.state_dict(), os.path.join(checkpoint_dir, "model.pdparams"))
         if optimizer is not None:
             serialization.save(optimizer.state_dict(), os.path.join(checkpoint_dir, "opt.pdopt"))
-        with open(meta_path, "w") as f:
-            json.dump({"step": step, "ts": time.time()}, f)
+        # marker last + atomic: it must never point at state newer than what
+        # is actually on disk (a stale marker only redoes a step; a torn or
+        # early marker would resume from state that doesn't exist)
+        tmp = f"{meta_path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"step": step, "ts": time.time()}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, meta_path)
+        finally:
+            if os.path.exists(tmp):  # failed commit leaves no litter
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+        if preempt is not None:
+            preempt.exit_if_requested()
 
     def load():
         model_path = os.path.join(checkpoint_dir, "model.pdparams")
@@ -108,14 +192,20 @@ def autoresume(train_fn, checkpoint_dir, model=None, optimizer=None, max_attempt
             optimizer.set_state_dict(serialization.load(opt_path))
 
     last_err = None
-    for attempt in range(max_attempts):
-        try:
-            start = latest_step()
-            if attempt > 0 or start > 0:
-                load()
-            return train_fn(start, save_cb)
-        except (KeyboardInterrupt, SystemExit):
-            raise
-        except Exception as e:  # noqa: BLE001 — any trainer failure triggers resume
-            last_err = e
+    try:
+        for attempt in range(max_attempts):
+            try:
+                start = latest_step()
+                if attempt > 0 or start > 0:
+                    load()
+                return train_fn(start, save_cb)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — any trainer failure triggers resume
+                counters.bump("fault.autoresume_retry")
+                last_err = e
+    finally:
+        if preempt is not None:
+            preempt.uninstall()
+    counters.bump("fault.exhausted.autoresume")
     raise RuntimeError(f"autoresume: {max_attempts} attempts failed") from last_err

@@ -31,7 +31,11 @@ def build_parser():
     p.add_argument("--job_id", default="default")
     p.add_argument("--elastic_level", type=int, default=-1,
                    help="-1/0: fail whole job on worker failure; 1: restart failed workers in place")
-    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--max_restart", type=int, default=3,
+                   help="per-container restart cap for CRASH exits under elastic_level>=1")
+    p.add_argument("--max_total_restarts", type=int, default=None,
+                   help="pod-wide restart budget incl. preemption restarts; "
+                        "default 2*max_restart*nproc")
     p.add_argument("--dcn_dp", type=int, default=1,
                    help="TPU slice count for the hybrid ICI x DCN mesh: "
                         "build_mesh puts ONLY data parallelism on the "

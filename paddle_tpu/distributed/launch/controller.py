@@ -7,10 +7,14 @@ contract, then watch — restarting or aborting on failure per
 in: the restart path reassigns PADDLE_TRAINER_ID and relies on scripts
 resuming from checkpoints)."""
 import os
+import secrets
 import sys
 import time
 
 from ...framework.native import TCPStore
+from ...testing import chaos
+from ...utils.metrics_bus import counters
+from ..fleet.elastic import PREEMPTED_EXIT_CODE
 from .context import Context
 from .job import Container, Pod
 
@@ -68,6 +72,19 @@ class CollectiveController:
         world = nproc * nnodes
         pod = Pod(name=f"{args.job_id}-{self.node_rank}")
         trainer_endpoints = ",".join(self.endpoints)
+        # per-cluster PS/RPC pickle-auth secret (ADVICE: a source-public
+        # authkey authenticates nobody). Rank 0 generates it once and shares
+        # it through the rendezvous store; every worker env gets it. PS/RPC
+        # ports must still stay cluster-internal — see ps/service.py.
+        ps_authkey = os.environ.get("PADDLE_PS_AUTHKEY")
+        if not ps_authkey:
+            if self.node_rank == 0:
+                ps_authkey = secrets.token_hex(16)
+                self.store.set("__ps_authkey__", ps_authkey)
+            else:
+                raw = self.store.get("__ps_authkey__")
+                ps_authkey = raw.decode() if isinstance(raw, bytes) else str(raw)
+            os.environ["PADDLE_PS_AUTHKEY"] = ps_authkey  # controller-side PS use
         for local_rank in range(nproc):
             rank = self.node_rank * nproc + local_rank
             env = {
@@ -89,6 +106,7 @@ class CollectiveController:
                 "LOCAL_RANK": str(local_rank),
                 "MASTER_ADDR": self.ctx.master_host,
                 "MASTER_PORT": str(self.ctx.master_port),
+                "PADDLE_PS_AUTHKEY": ps_authkey,
             }
             if args.devices:
                 env["FLAGS_selected_devices"] = args.devices
@@ -99,24 +117,49 @@ class CollectiveController:
 
     # ---- watch loop ----
     def watch(self, pod):
+        """Restart policy, two budgets deep:
+
+        - CRASHES (nonzero exit other than PREEMPTED_EXIT_CODE) restart only
+          under --elastic_level >= 1, each container at most --max_restart
+          times — a deterministic crash loop must abort, not respawn forever.
+        - PREEMPTIONS (exit == PREEMPTED_EXIT_CODE: the trainer checkpointed
+          on SIGTERM and left cleanly) restart at ANY elastic level — losing
+          capacity is the platform's fault, not the job's — but draw from a
+          pod-wide --max_total_restarts budget so a flapping host still
+          terminates the job deterministically.
+        """
         args = self.ctx.args
+        total_restarts = 0
+        total_budget = args.max_total_restarts
+        if total_budget is None or total_budget < 0:
+            total_budget = max(1, args.max_restart) * len(pod.containers) * 2
         while True:
+            chaos.site("launch.watch")
             failed = pod.failed_containers()
             if not failed and pod.finished():
                 return 0 if pod.success() else 1
             if failed:
-                if args.elastic_level >= 1:
-                    restartable = [c for c in failed if c.restarts < args.max_restart]
-                    if len(restartable) < len(failed):
-                        pod.terminate()
-                        return 1
-                    for c in restartable:
-                        c.restarts += 1
-                        c.close_log()
-                        c.start()
-                else:
+                preempted = [c for c in failed if c.exit_code == PREEMPTED_EXIT_CODE]
+                crashed = [c for c in failed if c.exit_code != PREEMPTED_EXIT_CODE]
+                if crashed and args.elastic_level < 1:
                     pod.terminate()
                     return 1
+                restartable = [c for c in crashed if c.restarts < args.max_restart]
+                if len(restartable) < len(crashed):
+                    pod.terminate()
+                    return 1
+                to_restart = restartable + preempted
+                if total_restarts + len(to_restart) > total_budget:
+                    counters.bump("fault.exhausted.launch_restart")
+                    pod.terminate()
+                    return 1
+                for c in restartable:
+                    c.restarts += 1  # crashes count against the per-container cap
+                for c in to_restart:
+                    total_restarts += 1
+                    counters.bump("fault.launch_restart")
+                    c.close_log()
+                    c.start()
             time.sleep(0.3)
 
     def run(self):

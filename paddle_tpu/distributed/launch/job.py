@@ -7,6 +7,8 @@ import subprocess
 import sys
 import time
 
+from ...testing import chaos
+
 
 class Container:
     def __init__(self, cmd, env, log_path):
@@ -18,6 +20,7 @@ class Container:
         self.restarts = 0
 
     def start(self):
+        chaos.site("launch.spawn")
         os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
         self._log_f = open(self.log_path, "ab")
         full_env = {**os.environ, **self.env}

@@ -8,23 +8,40 @@ the same substrate as distributed.rpc. One request = one connection round
 trip; requests against a server are handled by daemon threads, and the
 tables themselves are thread-safe, so concurrent workers interleave safely.
 Key sharding: id % n_servers (uniform for hashed CTR ids).
+
+SECURITY: the transport is pickle, so connection auth is the ONLY guard
+against arbitrary-deserialization RCE — and auth only helps while the key
+is secret. The authkey is derived from PADDLE_PS_AUTHKEY (the launcher
+generates a per-cluster secret and propagates it to every worker env); the
+source-public default is a dev/test fallback for single-host runs only.
+Either way, PS ports must stay cluster-internal (bind on the cluster
+fabric, never expose beyond it) — auth hardens against a stray client, not
+against an attacker who can read the cluster's env.
 """
+import os
 import threading
 import pickle
 from multiprocessing.connection import Client, Listener
 
 import numpy as np
 
+from ...testing import chaos
+from ...utils.retry import RetryPolicy
 from .table import SparseTable
 
-_AUTH = b"paddle-tpu-ps"
+
+def _authkey():
+    """Per-cluster secret when the launcher provides one (see module
+    docstring); resolved at call time so servers forked before the env was
+    set still agree with late-joining clients."""
+    return os.environ.get("PADDLE_PS_AUTHKEY", "paddle-tpu-ps").encode()
 
 
 class PsServer:
     """Serves named SparseTables on one endpoint until stop()."""
 
     def __init__(self, host="127.0.0.1", port=0):
-        self._listener = Listener((host, port), authkey=_AUTH)
+        self._listener = Listener((host, port), authkey=_authkey())
         self.host, self.port = self._listener.address
         self._tables = {}
         self._tables_lock = threading.Lock()
@@ -150,6 +167,10 @@ class PsServer:
                 conn = self._listener.accept()
             except OSError:
                 return
+            except Exception:
+                # failed auth handshake (wrong PADDLE_PS_AUTHKEY, port scan)
+                # rejects THAT client; it must not kill the accept loop
+                continue
             threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
 
     def start(self):
@@ -214,7 +235,7 @@ class PsClient:
             deadline = time.monotonic() + self.connect_timeout
             while True:
                 try:
-                    self._conns[s] = Client((host, int(port)), authkey=_AUTH)
+                    self._conns[s] = Client((host, int(port)), authkey=_authkey())
                     break
                 except (ConnectionRefusedError, OSError):
                     # servers may still be starting (they import jax first);
@@ -224,14 +245,42 @@ class PsClient:
                     time.sleep(0.2)
         return self._conns[s]
 
+    #: ops safe to re-send after a transport failure. push (gradient apply)
+    #: and barrier are NOT here: a retry after the server applied the request
+    #: but the reply was lost would double-apply/double-arrive — those fail
+    #: fast and the caller's recovery tier (autoresume) owns the redo.
+    _IDEMPOTENT = frozenset({"ping", "pull", "table_dim", "table_len",
+                             "state_dict", "create_table", "load_state_dict"})
+    retry_policy = RetryPolicy(attempts=4, base_delay=0.05)
+
+    def _drop_conn_locked(self, s):
+        c, self._conns[s] = self._conns[s], None
+        if c is not None:
+            try:
+                c.close()
+            except OSError:
+                pass
+
     def _call(self, s, op, *args):
-        with self._locks[s]:
-            c = self._conn(s)
-            c.send_bytes(pickle.dumps((op, args)))
-            ok, out = pickle.loads(c.recv_bytes())
-        if not ok:
-            raise out
-        return out
+        def attempt():
+            with self._locks[s]:
+                chaos.site("ps.call")
+                try:
+                    c = self._conn(s)
+                    c.send_bytes(pickle.dumps((op, args)))
+                    ok, out = pickle.loads(c.recv_bytes())
+                except (ConnectionError, EOFError, OSError) as e:
+                    # poisoned connection: drop it so a retry redials
+                    self._drop_conn_locked(s)
+                    raise ConnectionError(
+                        f"ps {op} to {self.endpoints[s]} failed: {e}") from e
+            if not ok:
+                raise out
+            return out
+
+        if op in self._IDEMPOTENT:
+            return self.retry_policy.run(attempt, name=f"ps.{op}")
+        return attempt()
 
     def _call_all(self, op, *args):
         futs = [self._pool.submit(self._call, s, op, *args)

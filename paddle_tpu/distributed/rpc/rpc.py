@@ -14,7 +14,15 @@ import threading
 import time
 from multiprocessing.connection import Client, Listener
 
-_AUTH = b"paddle-tpu-rpc"
+from ...testing import chaos
+from ...utils.retry import with_retries
+
+
+def _authkey():
+    """Pickle transport ⇒ auth is the only deserialization guard (see
+    ps/service.py SECURITY note). The launcher's per-cluster secret
+    (PADDLE_PS_AUTHKEY) covers RPC too; ports stay cluster-internal."""
+    return os.environ.get("PADDLE_PS_AUTHKEY", "paddle-tpu-rpc").encode()
 
 
 def _advertise_ip(world_size):
@@ -85,7 +93,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     # routable address (endpoint env or resolved hostname), falling back to
     # loopback for single-host runs
     bind_ip = "127.0.0.1" if world_size <= 1 else "0.0.0.0"
-    _listener = Listener((bind_ip, 0), authkey=_AUTH)
+    _listener = Listener((bind_ip, 0), authkey=_authkey())
     port = _listener.address[1]
     _serving = threading.Thread(target=_serve, args=(_listener,), daemon=True)
     _serving.start()
@@ -126,7 +134,17 @@ def get_all_worker_infos():
 
 def _invoke(to, fn, args, kwargs, timeout):
     info = _workers[to]
-    with Client((info.ip, info.port), authkey=_AUTH) as conn:
+
+    # the DIAL is retried with bounded backoff (a restarting peer refuses
+    # connections for a moment); once the request is on the wire it is NOT —
+    # rpc calls arbitrary callables, and re-sending after a lost reply would
+    # double-execute a non-idempotent one. The caller's recovery tier owns
+    # any redo, with full knowledge of what fn does.
+    def dial():
+        chaos.site("rpc.invoke")
+        return Client((info.ip, info.port), authkey=_authkey())
+
+    with with_retries(dial, name="rpc.dial") as conn:
         conn.send_bytes(pickle.dumps((fn, args, kwargs)))
         if timeout and timeout > 0:
             if not conn.poll(timeout):

@@ -202,6 +202,9 @@ class DistributedTrainStep(TrainStep):
             self._trainable[k]._data = v
         for k, v in new_buffers.items():
             self._buffers[k]._data = v
+        from ..framework.core import _bump_mutation_version
+
+        _bump_mutation_version()  # direct rebinds must invalidate weight caches
         sched = self.optimizer._learning_rate_scheduler
         if sched is not None:
             sched.step()

@@ -113,6 +113,26 @@ def _is_inexact(d):
     return np.issubdtype(np.dtype(d), np.inexact) or np.dtype(d) == jnp.bfloat16
 
 
+# Monotonic count of in-place tensor mutations (set_value/copy_/fill_/zero_
+# and every load path, which funnels through set_value). Consumers that
+# cache anything derived from weights — the serving engine's prefix KV
+# cache — snapshot this and invalidate on change. Unlike identity (id())
+# tuples, a counter can never false-match when CPython recycles a freed
+# array's address (ADVICE r5 medium). Over-invalidation (a mutation of an
+# UNRELATED tensor also bumps it) is deliberate: a spurious cache clear
+# costs a recompute; a stale prefix KV silently serves wrong tokens.
+_MUTATION_VERSION = 0
+
+
+def _bump_mutation_version():
+    global _MUTATION_VERSION
+    _MUTATION_VERSION += 1  # GIL-atomic enough: races only over-invalidate
+
+
+def tensor_mutation_version():
+    return _MUTATION_VERSION
+
+
 class Tensor:
     """Imperative tensor over a jax.Array (reference: phi::DenseTensor +
     the eager Tensor in paddle/fluid/pybind/eager.cc).
@@ -370,6 +390,7 @@ class Tensor:
     def set_value(self, value):
         v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
         self._data = v.astype(self.dtype) if v.dtype != self.dtype else v
+        _bump_mutation_version()
         return self
 
     def copy_(self, other, *_):
@@ -377,10 +398,12 @@ class Tensor:
 
     def fill_(self, v):
         self._data = jnp.full_like(self._data, v)
+        _bump_mutation_version()
         return self
 
     def zero_(self):
         self._data = jnp.zeros_like(self._data)
+        _bump_mutation_version()
         return self
 
     def scale_(self, s):
@@ -526,7 +549,22 @@ def _tensor_flatten(t):
 def _tensor_unflatten(aux, children):
     cls, sg, name = aux
     t = cls.__new__(cls)
-    Tensor.__init__(t, children[0], stop_gradient=sg, name=name)
+    data = children[0]
+    if isinstance(data, (jax.Array, jax.core.Tracer, np.ndarray)):
+        Tensor.__init__(t, data, stop_gradient=sg, name=name)
+    else:
+        # pytree contract: unflatten must accept ARBITRARY leaf objects —
+        # jax internally rebuilds trees with placeholder leaves (make_jaxpr
+        # ArgInfo, tree_map sentinels). Coercing those through jnp.asarray
+        # (Tensor.__init__) raises; store them untouched instead.
+        t._data = data
+        t.stop_gradient = sg
+        t.grad = None
+        t._node = None
+        t._out_idx = 0
+        t._hooks = []
+        t.name = name
+        t._dist_attr = None
     if cls is Parameter:
         t.trainable = not sg
         t.optimize_attr = {"learning_rate": 1.0}

@@ -1,0 +1,40 @@
+"""Version-compat shims over jax API drift.
+
+The repo targets the current jax API; containers pinning an older jax must
+still import and run (robustness tier: the framework cannot be taken down
+by a substrate minor-version skew). Each shim resolves ONCE at call time to
+the native API when present and only translates when it must.
+
+shard_map: top-level `jax.shard_map(..., check_vma=, axis_names=)` landed
+after 0.4.37; older releases spell it `jax.experimental.shard_map.shard_map`
+with `check_rep=` and an inverted `auto=` (axes NOT manual) instead of
+`axis_names=` (axes manual).
+"""
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None, axis_names=None):
+    if hasattr(jax, "shard_map"):
+        kw = {}
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    # check_rep (the old replication checker) lacks rules for ops the new
+    # vma checker handles (sharding_constraint, psum-of-masked) — it is a
+    # lint, not a semantics switch, so default it OFF when translating.
+    #
+    # axis_names (partial-auto: named axes manual, the rest GSPMD-auto) is
+    # deliberately NOT translated to the old `auto=` parameter: 0.4.x
+    # partial-auto cannot lower axis_index/psum in manual-vs-auto mixes
+    # ("PartitionId is not supported for SPMD partitioning"). Full-manual is
+    # the sound fallback — axes unmentioned by in_specs are replicated into
+    # the body, which preserves numerics exactly and only forgoes GSPMD
+    # sharding over the auto axes inside the region (memory/perf, not
+    # semantics; real-accelerator builds run the native path anyway).
+    kw = {"check_rep": bool(check_vma) if check_vma is not None else False}
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

@@ -16,6 +16,9 @@ import struct
 import subprocess
 import threading
 
+from ..testing import chaos
+from ..utils.retry import RetryPolicy
+
 _LIB = None
 _TRIED = False
 
@@ -183,6 +186,15 @@ class _PyStoreServer:
             pass
 
 
+class AmbiguousOpError(RuntimeError):
+    """A non-idempotent store op (add) failed AFTER its request frame was
+    fully sent: the server may or may not have applied it, so a transparent
+    retry could double-apply (e.g. double-count rank assignment and hang the
+    rendezvous with rank 0 unclaimed). Deliberately NOT a ConnectionError —
+    the retry layer must not catch it; the caller's recovery tier owns the
+    redo with knowledge of the op's semantics."""
+
+
 class _PyStoreClient:
     def __init__(self, host, port, timeout_ms):
         import time
@@ -209,13 +221,25 @@ class _PyStoreClient:
             data += chunk
         return data
 
-    def _request(self, op, key, val=b""):
+    def _request(self, op, key, val=b"", non_idempotent=False):
         with self._lock:
             k = key.encode()
+            # send/recv failures are distinguished on purpose: a sendall
+            # failure means the length-prefixed frame never arrived whole —
+            # the server cannot have applied it, so retry is always safe. A
+            # recv failure after a complete send is AMBIGUOUS (applied, ack
+            # lost?); for non-idempotent ops that must not be retried.
             self._sock.sendall(op + struct.pack("<I", len(k)) + k + struct.pack("<I", len(val)) + val)
-            status = self._recv(1)
-            (rlen,) = struct.unpack("<I", self._recv(4))
-            out = self._recv(rlen) if rlen else b""
+            try:
+                status = self._recv(1)
+                (rlen,) = struct.unpack("<I", self._recv(4))
+                out = self._recv(rlen) if rlen else b""
+            except (ConnectionError, OSError) as e:
+                if non_idempotent:
+                    raise AmbiguousOpError(
+                        f"store {op!r} on {key!r}: reply lost after a "
+                        f"complete send — may or may not have applied") from e
+                raise
         return status, out
 
     def set(self, key, val):
@@ -227,7 +251,8 @@ class _PyStoreClient:
         return out if st == b"O" else None
 
     def add(self, key, delta):
-        st, out = self._request(b"A", key, struct.pack("<q", delta))
+        st, out = self._request(b"A", key, struct.pack("<q", delta),
+                                non_idempotent=True)
         return struct.unpack("<q", out)[0] if st == b"O" else -1
 
     def check(self, key):
@@ -246,12 +271,19 @@ class TCPStore:
     """reference: paddle.base.core.TCPStore(host, port, is_master, world_size,
     timeout). is_master starts the in-process server (rank 0)."""
 
+    #: store ops ride one shared bounded-backoff policy (utils/retry.py):
+    #: a transient RST/timeout redials and retries instead of failing the
+    #: rendezvous; attempts are capped so a genuinely dead master still
+    #: surfaces promptly. Chaos sites (testing/chaos.py "store.<op>") fire
+    #: INSIDE the retried op, so injected outages exercise this exact path.
+    retry_policy = RetryPolicy(attempts=4, base_delay=0.05)
+
     def __init__(self, host, port, is_master=False, world_size=1, timeout=900,
                  use_native=True):
         self._server = None
         self._native = use_native and native_available()
         self.host, self.port = host, port
-        timeout_ms = int(timeout * 1000)
+        self._timeout_ms = int(timeout * 1000)
         if is_master:
             if self._native:
                 lib = load_native()
@@ -263,58 +295,111 @@ class TCPStore:
                 self._server = _PyStoreServer(port)
                 self.port = self._server.port
             host = "127.0.0.1"
+        self._connect_host = host
         if self._native:
             lib = load_native()
-            self._client = lib.tcpstore_client_connect(host.encode(), self.port, timeout_ms)
+            self._client = lib.tcpstore_client_connect(host.encode(), self.port, self._timeout_ms)
             if not self._client:
                 raise TimeoutError(f"cannot connect to store at {host}:{self.port}")
         else:
-            self._client = _PyStoreClient(host, self.port, timeout_ms)
+            self._client = _PyStoreClient(host, self.port, self._timeout_ms)
+
+    def _reconnect(self, *_):
+        """Retry hook: drop the (possibly poisoned) connection and redial."""
+        if self._native:
+            lib = load_native()
+            if self._client:
+                try:
+                    lib.tcpstore_client_close(self._client)
+                except Exception:
+                    pass
+            self._client = lib.tcpstore_client_connect(
+                self._connect_host.encode(), self.port, 5000)
+            if not self._client:
+                raise ConnectionError(
+                    f"cannot reconnect to store at {self._connect_host}:{self.port}")
+        else:
+            try:
+                self._client.close()
+            except OSError:
+                pass
+            self._client = _PyStoreClient(self._connect_host, self.port, 5000)
+
+    def _retry(self, name, op):
+        return self.retry_policy.run(op, name=name, on_retry=self._reconnect)
 
     def set(self, key, value):
         if isinstance(value, str):
             value = value.encode()
-        if self._native:
-            lib = load_native()
-            if lib.tcpstore_set(self._client, key.encode(), value, len(value)) != 0:
-                raise RuntimeError(f"TCPStore.set({key}) failed")
-        else:
-            self._client.set(key, value)
+
+        def op():
+            chaos.site("store.set")
+            if self._native:
+                lib = load_native()
+                if lib.tcpstore_set(self._client, key.encode(), value, len(value)) != 0:
+                    raise ConnectionError(f"TCPStore.set({key}) failed")
+            elif not self._client.set(key, value):
+                raise ConnectionError(f"TCPStore.set({key}) failed")
+
+        self._retry("store.set", op)
 
     def get(self, key):
         """Blocking get (waits for the key)."""
-        if self._native:
-            lib = load_native()
-            out = ctypes.c_char_p()
-            n = lib.tcpstore_get(self._client, key.encode(), ctypes.byref(out))
-            if n < 0:
-                return None
-            data = ctypes.string_at(out, n)
-            lib.tcpstore_free(out)
-            return data
-        return self._client.get(key)
+
+        def op():
+            chaos.site("store.get")
+            if self._native:
+                lib = load_native()
+                out = ctypes.c_char_p()
+                n = lib.tcpstore_get(self._client, key.encode(), ctypes.byref(out))
+                if n < 0:
+                    return None
+                data = ctypes.string_at(out, n)
+                lib.tcpstore_free(out)
+                return data
+            return self._client.get(key)
+
+        return self._retry("store.get", op)
 
     def add(self, key, delta=1):
-        if self._native:
-            lib = load_native()
-            return int(lib.tcpstore_add(self._client, key.encode(), delta))
-        return self._client.add(key, delta)
+        # add is not idempotent, so only provably-unapplied failures retry:
+        # chaos faults and send-phase errors (frame never arrived whole).
+        # A reply lost AFTER a complete send raises AmbiguousOpError
+        # (a RuntimeError the retry filter does not catch) — a double-
+        # counted rank assignment would un-claim rank 0 and hang the whole
+        # rendezvous, which is strictly worse than failing the join fast.
+        def op():
+            chaos.site("store.add")
+            if self._native:
+                lib = load_native()
+                return int(lib.tcpstore_add(self._client, key.encode(), delta))
+            return self._client.add(key, delta)
+
+        return self._retry("store.add", op)
 
     def wait(self, keys, timeout=None):
         for k in keys if isinstance(keys, (list, tuple)) else [keys]:
             self.get(k)
 
     def check(self, key):
-        if self._native:
-            lib = load_native()
-            return lib.tcpstore_check(self._client, key.encode()) == 1
-        return self._client.check(key)
+        def op():
+            chaos.site("store.check")
+            if self._native:
+                lib = load_native()
+                return lib.tcpstore_check(self._client, key.encode()) == 1
+            return self._client.check(key)
+
+        return self._retry("store.check", op)
 
     def delete_key(self, key):
-        if self._native:
-            lib = load_native()
-            return lib.tcpstore_delete(self._client, key.encode()) == 0
-        return self._client.delete(key)
+        def op():
+            chaos.site("store.delete")
+            if self._native:
+                lib = load_native()
+                return lib.tcpstore_delete(self._client, key.encode()) == 0
+            return self._client.delete(key)
+
+        return self._retry("store.delete", op)
 
     def barrier(self, name, world_size, timeout=600):
         """All `world_size` participants block until everyone arrives."""

@@ -10,6 +10,41 @@ import jax.numpy as jnp
 from ...framework.core import Tensor, apply, to_tensor
 
 
+@jax.custom_vjp
+def _barrier_diff(xs):
+    return jax.lax.optimization_barrier(xs)
+
+
+def _barrier_diff_fwd(xs):
+    return jax.lax.optimization_barrier(xs), None
+
+
+def _barrier_diff_bwd(_, cts):
+    # upstream's rule exactly: the transpose is a barrier on the cotangents,
+    # which is what sequences the unrolled backward chunks
+    return (jax.lax.optimization_barrier(cts),)
+
+
+_barrier_diff.defvjp(_barrier_diff_fwd, _barrier_diff_bwd)
+_OPT_BARRIER = None  # resolved on first use
+
+
+def _opt_barrier(xs):
+    """lax.optimization_barrier with a differentiation fallback: releases
+    before ~0.5 ship the primitive without a grad rule, so the unrolled
+    fused-CE chain (differentiable chunk-loss token) would fail to
+    transpose there. The custom_vjp twin is semantically identical."""
+    global _OPT_BARRIER
+    if _OPT_BARRIER is None:
+        try:
+            jax.grad(lambda x: jax.lax.optimization_barrier((x,))[0].sum())(
+                jnp.ones((1,), jnp.float32))
+            _OPT_BARRIER = jax.lax.optimization_barrier
+        except NotImplementedError:
+            _OPT_BARRIER = _barrier_diff
+    return _OPT_BARRIER(xs)
+
+
 def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
@@ -239,7 +274,7 @@ def fused_linear_cross_entropy(hidden, weight, labels, ignore_index=-100,
                 outs = []
                 token = jnp.zeros((1,), jnp.float32)
                 for i in range(hs.shape[0]):
-                    hc, _ = jax.lax.optimization_barrier((hs[i], token))
+                    hc, _ = _opt_barrier((hs[i], token))
                     li, vi = body((hc, ls[i]))
                     token = li[:1]
                     outs.append((li, vi))

@@ -25,15 +25,20 @@ of co-scheduling). kv_cache_dtype="int8" switches the pool to the
 QuantizedTensor layout the Pallas kernel consumes natively.
 """
 import math
+import time
 from collections import deque
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..framework import core as _core
 from ..framework.core import Tensor
 from ..generation import _make_sampler, prompt_bucket
 from ..ops.paged_attention import PagedLayerCache
+from ..testing import chaos
+from ..utils.metrics_bus import counters
+from ..utils.retry import RetryPolicy
 
 # one module-level jitted key builder (jit cache survives across serve()
 # calls): key[slot] = fold_in(fold_in(base, request_id), token_index)
@@ -134,6 +139,24 @@ class ContinuousBatchingEngine:
         self._gather_fns = {}
         self._prefill_suffix_fns = {}
         self._cache_weights_version = None
+        # decode_block: max decode steps fused into ONE device dispatch
+        # (lax.scan). Each dispatch costs a full host→device round trip —
+        # ~1.3s through the axon tunnel (PROFILE.md r5) — so per-token
+        # dispatch makes serving latency-bound at any model size. Trade-off:
+        # retirement/admission (and on_token streaming) happen at block
+        # boundaries, and a sequence hitting EOS mid-block wastes the rest of
+        # the block's compute for its slot. 1 restores per-token behavior.
+        self.decode_block = max(int(decode_block), 1)
+        # observability for tests/bench: peak pages in use, deferred admits,
+        # and the degradation counters (failed/timed-out requests keep their
+        # co-tenants serving — see serve())
+        self.stats = {"peak_pages": 0, "deferred_admissions": 0,
+                      "decode_steps": 0, "prefix_hit_pages": 0,
+                      "prefix_evictions": 0, "failed_requests": 0,
+                      "timed_out_requests": 0}
+        # per-serve map rid -> exception for requests that failed in
+        # isolation (their results entry is None)
+        self.request_errors = {}
 
     def clear_prefix_cache(self):
         """Drop all cached (refcount-0) prefix pages and their index. In-use
@@ -144,18 +167,6 @@ class ContinuousBatchingEngine:
         self._evictable.clear()
         self._prefix_index.clear()
         self._page_hash.clear()
-        # decode_block: max decode steps fused into ONE device dispatch
-        # (lax.scan). Each dispatch costs a full host→device round trip —
-        # ~1.3s through the axon tunnel (PROFILE.md r5) — so per-token
-        # dispatch makes serving latency-bound at any model size. Trade-off:
-        # retirement/admission (and on_token streaming) happen at block
-        # boundaries, and a sequence hitting EOS mid-block wastes the rest of
-        # the block's compute for its slot. 1 restores per-token behavior.
-        self.decode_block = max(int(decode_block), 1)
-        # observability for tests/bench: peak pages in use, deferred admits
-        self.stats = {"peak_pages": 0, "deferred_admissions": 0,
-                      "decode_steps": 0, "prefix_hit_pages": 0,
-                      "prefix_evictions": 0}
 
     # ---- prefix-cache page accounting -------------------------------------
     def _available_pages(self):
@@ -507,13 +518,33 @@ class ContinuousBatchingEngine:
         return sum(l.size * l.dtype.itemsize
                    for l in jax.tree_util.tree_leaves(self.pools))
 
+    #: bounded retry for the decode dispatch: a transient dispatch failure
+    #: (injected outage, flaky transport to a remote backend) retries the
+    #: whole batch step; deterministic compile/shape errors are not
+    #: ConnectionErrors and still raise immediately.
+    retry_policy = RetryPolicy(attempts=3, base_delay=0.05)
+
     def serve(self, prompts, max_new_tokens, eos_token_id=None,
               do_sample=False, temperature=1.0, top_k=0, top_p=1.0, seed=0,
-              on_token=None):
+              on_token=None, request_timeout_s=None):
         """Serve a list of int32 prompt arrays; returns a list of
         [len(prompt) + n_generated] arrays (stops at eos or max_new_tokens).
         Requests beyond the pool/slot capacity queue and join as earlier
         sequences retire — continuous batching.
+
+        Degradation contract (one request must never kill the batch):
+
+        - a request whose PREFILL raises fails alone: its slot/pages free,
+          its results entry is None, the exception lands in
+          self.request_errors[rid], and every co-tenant keeps serving;
+        - a request that can NEVER fit the pool (needs more pages than
+          exist) likewise fails alone instead of raising out of serve() —
+          admission backpressure for merely-busy pools is unchanged
+          (FIFO deferral, stats["deferred_admissions"]);
+        - request_timeout_s bounds each request's wall-clock from admission:
+          on expiry it retires with the tokens generated so far
+          (stats["timed_out_requests"]) — the slot goes back to the queue's
+          next request instead of a straggler pinning it forever.
 
         Sampling (do_sample/temperature/top_k/top_p — the dense generate()
         sampler math) draws each sequence from its OWN key stream
@@ -536,13 +567,22 @@ class ContinuousBatchingEngine:
         state = self.model.raw_state_dict()
         if self.enable_prefix_cache:
             # cached prefix KV is only valid under the weights it was
-            # computed with; jnp arrays are immutable, so any weight update
-            # rebinds new array objects and changes this id-tuple
-            version = tuple(id(v) for v in state.values())
+            # computed with. Two-factor guard:
+            # - core.tensor_mutation_version: bumped by every set_value/
+            #   load path AND the optimizer/train-step direct-rebind
+            #   epilogues. A counter can never false-match when CPython
+            #   recycles a freed array's address (the id()-only guard's
+            #   failure mode, ADVICE r5 medium).
+            # - the id tuple: belt-and-braces for any future code that
+            #   rebinds p._data without bumping — a rebind only slips
+            #   through if EVERY new array also lands on its old address.
+            version = (_core.tensor_mutation_version(),
+                       tuple(id(v) for v in state.values()))
             if version != self._cache_weights_version:
                 if self._cache_weights_version is not None:
                     self.clear_prefix_cache()
                 self._cache_weights_version = version
+        self.request_errors = {}
         queue = deque(enumerate(prompts))
         results = [None] * len(prompts)
         # slot -> [req_id, tokens_out(list), n_generated, last_token, pages(list)]
@@ -559,9 +599,13 @@ class ContinuousBatchingEngine:
                 true_len = len(prompt)
                 bucket = prompt_bucket(true_len)
                 if true_len + max_new_tokens > self.max_len or bucket > self.max_len:
-                    raise ValueError(
+                    # invalid request — reject IT, not the whole batch
+                    queue.popleft()
+                    self._fail_request(rid, results, ValueError(
                         f"request {rid}: len {true_len} (bucket {bucket}) + "
-                        f"{max_new_tokens} exceeds max_len={self.max_len}")
+                        f"{max_new_tokens} exceeds max_len={self.max_len}"))
+                    admitted = True
+                    continue
                 bs_ = self.page_size
                 if self.enable_prefix_cache:
                     n_pre, shared = self._match_prefix(prompt, true_len)
@@ -600,20 +644,28 @@ class ContinuousBatchingEngine:
                 self.stats["peak_pages"] = max(self.stats["peak_pages"], pages_in_use())
                 ids_p = np.zeros((1, sbucket), np.int32)
                 ids_p[0, :suffix_len] = prompt[n_pre * bs_:]
-                if n_pre:
-                    self.stats["prefix_hit_pages"] += n_pre
-                    ks_pre, vs_pre = self._gather_prefix(n_pre)(
-                        tuple(self.pools), jnp.asarray(shared, jnp.int32))
-                    tok0, ks, vs = self._prefill_suffix(n_pre, sbucket, sampling)(
-                        state, ks_pre, vs_pre, jnp.asarray(ids_p),
-                        jnp.int32(suffix_len), req_key(rid, 0))
-                else:
-                    tok0, ks, vs = self._prefill(sbucket, sampling)(
-                        state, jnp.asarray(ids_p), jnp.int32(suffix_len),
-                        req_key(rid, 0))
-                page_ids = jnp.asarray(new_pages[:region], jnp.int32)
-                self.pools = list(self._insert(sbucket)(
-                    tuple(self.pools), ks, vs, page_ids))
+                try:
+                    chaos.site("serve.prefill")
+                    if n_pre:
+                        self.stats["prefix_hit_pages"] += n_pre
+                        ks_pre, vs_pre = self._gather_prefix(n_pre)(
+                            tuple(self.pools), jnp.asarray(shared, jnp.int32))
+                        tok0, ks, vs = self._prefill_suffix(n_pre, sbucket, sampling)(
+                            state, ks_pre, vs_pre, jnp.asarray(ids_p),
+                            jnp.int32(suffix_len), req_key(rid, 0))
+                    else:
+                        tok0, ks, vs = self._prefill(sbucket, sampling)(
+                            state, jnp.asarray(ids_p), jnp.int32(suffix_len),
+                            req_key(rid, 0))
+                    page_ids = jnp.asarray(new_pages[:region], jnp.int32)
+                    self.pools = list(self._insert(sbucket)(
+                        tuple(self.pools), ks, vs, page_ids))
+                except Exception as e:  # error isolation: fail THIS request
+                    self._unref_pages(pages)
+                    self.free_slots.append(slot)
+                    self._fail_request(rid, results, e)
+                    admitted = True  # the queue moved; keep admitting
+                    continue
                 if self.enable_prefix_cache:
                     self._index_prompt_pages(prompt, true_len, pages, n_pre)
                 row = np.zeros(self.pages_per_seq, np.int32)
@@ -624,7 +676,8 @@ class ContinuousBatchingEngine:
                 done = eos_token_id is not None and tok0 == eos_token_id
                 # register BEFORE the user callback: if it raises, the
                 # finally-cleanup must see this slot to free its pages
-                active[slot] = [rid, list(prompt) + [tok0], 1, tok0, pages]
+                active[slot] = [rid, list(prompt) + [tok0], 1, tok0, pages,
+                                time.monotonic()]
                 if on_token is not None:
                     on_token(rid, tok0)
                 if done or max_new_tokens == 1:
@@ -633,7 +686,8 @@ class ContinuousBatchingEngine:
             return admitted
 
         def retire(slot):
-            rid, toks, _, _, pages = active.pop(slot)
+            st = active.pop(slot)
+            rid, toks, pages = st[0], st[1], st[4]
             results[rid] = np.asarray(toks, np.int32)
             self._unref_pages(pages)
             self.free_slots.append(slot)
@@ -645,23 +699,35 @@ class ContinuousBatchingEngine:
             return self._serve_loop(sampling, state, queue, active, results,
                                     try_admit, retire, max_new_tokens,
                                     eos_token_id, do_sample, base_key,
-                                    on_token)
+                                    on_token, request_timeout_s)
         finally:
             # a raising on_token (or any mid-serve failure) must not leak a
             # warm engine's pages/slots: retire whatever is still active
             for slot in list(active):
                 retire(slot)
 
+    def _fail_request(self, rid, results, exc):
+        results[rid] = None
+        self.request_errors[rid] = exc
+        self.stats["failed_requests"] += 1
+        counters.bump("fault.serve.request_failed")
+
     def _serve_loop(self, sampling, state, queue, active, results, try_admit,
                     retire, max_new_tokens, eos_token_id, do_sample, base_key,
-                    on_token):
+                    on_token, request_timeout_s=None):
         decode = self._decode(sampling)
         while active or queue:
             if not active:
-                # pool too small for even one queued request
-                rid, prompt = queue[0]
-                raise RuntimeError(
-                    f"request {rid} needs more pages than the pool holds")
+                # nothing running and the head still can't admit: with the
+                # pool otherwise idle that means it NEVER fits (needs more
+                # pages than exist). Fail it alone, keep draining the queue.
+                rid, prompt = queue.popleft()
+                self._fail_request(rid, results, RuntimeError(
+                    f"request {rid} needs more pages than the pool holds "
+                    f"({len(prompt)}+{max_new_tokens} tokens vs "
+                    f"{(self.num_pages - 1) * self.page_size} pool tokens)"))
+                try_admit()
+                continue
             # block size: never overshoot any active request's token budget
             # (its page reservation covers exactly max_new_tokens); power of
             # two so the compile cache stays at log2(decode_block) programs
@@ -681,18 +747,25 @@ class ContinuousBatchingEngine:
             else:
                 # greedy ignores the keys entirely — skip the device work
                 keys = jnp.zeros((k, self.max_seqs, 2), jnp.uint32)
-            if k == 1:
-                nxt, pools = decode(
-                    state, jnp.asarray(toks), tuple(self.pools),
-                    jnp.asarray(self.page_table), jnp.asarray(self.lengths),
-                    keys[0])
-                block = np.asarray(nxt)[None]
-            else:
-                block, pools = self._decode_block_fn(sampling, k)(
+            # the chaos site fires BEFORE the jitted call, so an injected
+            # outage retries against intact pools; a real failure after the
+            # dispatch donated them is not retriable (the retry would read
+            # donated buffers) and raises out through the serve() cleanup
+            def dispatch():
+                chaos.site("serve.decode")
+                if k == 1:
+                    nxt, pools = decode(
+                        state, jnp.asarray(toks), tuple(self.pools),
+                        jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+                        keys[0])
+                    return np.asarray(nxt)[None], pools
+                blk, pools = self._decode_block_fn(sampling, k)(
                     state, jnp.asarray(toks), tuple(self.pools),
                     jnp.asarray(self.page_table), jnp.asarray(self.lengths),
                     keys)
-                block = np.asarray(block)
+                return np.asarray(blk), pools
+
+            block, pools = self.retry_policy.run(dispatch, name="serve.decode")
             self.pools = list(pools)
             self.stats["decode_steps"] += k
             for slot in list(active):
@@ -709,5 +782,13 @@ class ContinuousBatchingEngine:
                             eos_token_id is not None and tok == eos_token_id):
                         retire(slot)  # mid-block EOS: rest of block discarded
                         break
+            if request_timeout_s is not None:
+                now = time.monotonic()
+                for slot in list(active):
+                    if now - active[slot][5] > request_timeout_s:
+                        # deadline hit: return what it got, free the slot
+                        self.stats["timed_out_requests"] += 1
+                        counters.bump("fault.serve.request_timeout")
+                        retire(slot)
             try_admit()
         return results

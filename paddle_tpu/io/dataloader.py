@@ -20,6 +20,7 @@ import numpy as np
 
 from ..framework.core import Tensor, to_tensor
 from ..framework.native import BlockingQueue
+from ..testing import chaos
 from .dataset import IterableDataset
 from .sampler import BatchSampler, DistributedBatchSampler
 
@@ -151,72 +152,103 @@ class DataLoader:
             return {k: self._to_tensors(v) for k, v in obj.items()}
         return obj
 
+    #: a worker that dies mid-epoch (OOM-killed, injected crash) is re-forked
+    #: at the batch it owed, at most this many times per epoch — bounded so a
+    #: deterministically-crashing __getitem__ still fails the epoch instead
+    #: of fork-looping forever.
+    max_worker_respawns = 2
+
+    def _spawn_worker(self, w, start_bi, all_indices, custom_collate):
+        """Fork worker `w` producing batches start_bi, start_bi+W, ... into a
+        fresh pipe; returns (pid, BlockingQueue fed by a reader thread)."""
+        global _worker_info
+        W = self.num_workers
+        r, wr = os.pipe()
+        pid = os.fork()
+        if pid == 0:  # child
+            try:
+                os.close(r)
+                _worker_info = WorkerInfo(w, W, self.dataset)
+                if self.worker_init_fn is not None:
+                    self.worker_init_fn(w)
+                for bi in range(start_bi, len(all_indices), W):
+                    chaos.site("dataloader.worker")
+                    samples = [self.dataset[i] for i in all_indices[bi]]
+                    batch = self.collate_fn(samples) if custom_collate else self._np_collate(samples)
+                    blob = pickle.dumps(_tensors_to_numpy(batch), protocol=4)
+                    os.write(wr, struct.pack("<q", len(blob)))
+                    left = blob
+                    while left:
+                        n = os.write(wr, left)
+                        left = left[n:]
+                os.write(wr, struct.pack("<q", 0))
+                os.close(wr)
+            finally:
+                os._exit(0)
+        os.close(wr)
+        q = BlockingQueue(capacity=self.prefetch_factor)
+
+        def reader(fd=r, bq=q):
+            try:
+                while True:
+                    hdr = b""
+                    while len(hdr) < 8:
+                        chunk = os.read(fd, 8 - len(hdr))
+                        if not chunk:
+                            return
+                        hdr += chunk
+                    (n,) = struct.unpack("<q", hdr)
+                    if n == 0:
+                        return
+                    buf = bytearray()
+                    while len(buf) < n:
+                        chunk = os.read(fd, min(1 << 20, n - len(buf)))
+                        if not chunk:
+                            return
+                        buf.extend(chunk)
+                    bq.push(bytes(buf))
+            finally:
+                bq.close()
+                os.close(fd)
+
+        threading.Thread(target=reader, daemon=True).start()
+        return pid, q
+
     def _mp_iter(self):
         """Forked-worker path. Batch i is produced by worker i % W; the
         consumer round-robins pops so sampler order is preserved (same
-        ordering contract as the reference's _DataLoaderIterMultiProcess)."""
-        global _worker_info
+        ordering contract as the reference's _DataLoaderIterMultiProcess).
+        A worker whose pipe closes before its batches are delivered is
+        respawned at the owed batch (bounded; see max_worker_respawns)."""
         W = self.num_workers
         all_indices = list(self.batch_sampler)
         custom_collate = self.collate_fn is not default_collate_fn
-        pipes, pids, queues = [], [], []
+        pids, queues = [], []
+        respawns = [0] * W
         for w in range(W):
-            r, wr = os.pipe()
-            pid = os.fork()
-            if pid == 0:  # child
-                try:
-                    os.close(r)
-                    _worker_info = WorkerInfo(w, W, self.dataset)
-                    if self.worker_init_fn is not None:
-                        self.worker_init_fn(w)
-                    for bi in range(w, len(all_indices), W):
-                        samples = [self.dataset[i] for i in all_indices[bi]]
-                        batch = self.collate_fn(samples) if custom_collate else self._np_collate(samples)
-                        blob = pickle.dumps(_tensors_to_numpy(batch), protocol=4)
-                        os.write(wr, struct.pack("<q", len(blob)))
-                        left = blob
-                        while left:
-                            n = os.write(wr, left)
-                            left = left[n:]
-                    os.write(wr, struct.pack("<q", 0))
-                    os.close(wr)
-                finally:
-                    os._exit(0)
-            os.close(wr)
-            pipes.append(r)
+            pid, q = self._spawn_worker(w, w, all_indices, custom_collate)
             pids.append(pid)
-            q = BlockingQueue(capacity=self.prefetch_factor)
             queues.append(q)
-
-            def reader(fd=r, bq=q):
-                try:
-                    while True:
-                        hdr = b""
-                        while len(hdr) < 8:
-                            chunk = os.read(fd, 8 - len(hdr))
-                            if not chunk:
-                                return
-                            hdr += chunk
-                        (n,) = struct.unpack("<q", hdr)
-                        if n == 0:
-                            return
-                        buf = bytearray()
-                        while len(buf) < n:
-                            chunk = os.read(fd, min(1 << 20, n - len(buf)))
-                            if not chunk:
-                                return
-                            buf.extend(chunk)
-                        bq.push(bytes(buf))
-                finally:
-                    bq.close()
-                    os.close(fd)
-
-            threading.Thread(target=reader, daemon=True).start()
         try:
             for bi in range(len(all_indices)):
-                blob = queues[bi % W].pop()
-                if blob is None:
-                    raise RuntimeError(f"DataLoader worker {bi % W} exited early")
+                w = bi % W
+                blob = queues[w].pop()
+                while blob is None:
+                    if respawns[w] >= self.max_worker_respawns:
+                        raise RuntimeError(
+                            f"DataLoader worker {w} exited early at batch {bi} "
+                            f"({respawns[w]} respawns exhausted)")
+                    respawns[w] += 1
+                    from ..utils.metrics_bus import counters
+
+                    counters.bump("fault.dataloader_respawn")
+                    try:  # reap the dead fork before replacing it
+                        os.waitpid(pids[w], 0)
+                    except ChildProcessError:
+                        pass
+                    pids[w], queues[w] = self._spawn_worker(
+                        w, bi, all_indices, custom_collate)
+                    blob = queues[w].pop()
                 yield self._to_tensors(pickle.loads(blob))
         finally:
             for pid in pids:

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from .framework import random as prandom
-from .framework.core import Tensor, to_tensor
+from .framework.core import Tensor, _bump_mutation_version, to_tensor
 
 
 def jit(fn=None, static_argnums=None, donate_argnums=None, backend=None):
@@ -328,6 +328,7 @@ class TrainStep:
             self._trainable[k]._data = v
         for k, v in new_buffers.items():
             self._buffers[k]._data = v
+        _bump_mutation_version()  # direct rebinds must invalidate weight caches
         sched = self.optimizer._learning_rate_scheduler
         if sched is not None:
             for _ in range(n):
@@ -358,6 +359,7 @@ class TrainStep:
             self._trainable[k]._data = v
         for k, v in new_buffers.items():
             self._buffers[k]._data = v
+        _bump_mutation_version()  # direct rebinds must invalidate weight caches
         sched = self.optimizer._learning_rate_scheduler
         if sched is not None:
             sched.step()

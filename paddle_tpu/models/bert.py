@@ -203,10 +203,10 @@ class BertForPretraining(Layer):
                     ("transform_norm", "mlm_head.transform_norm"),
                     ("mlm_bias", "mlm_head.mlm_bias"))
 
-    def set_state_dict(self, state_dict, use_structured_name=True):
+    def set_state_dict(self, state_dict, use_structured_name=True, strict=False):
         return super().set_state_dict(
             _remap_legacy_keys(state_dict, self._LEGACY_KEYS),
-            use_structured_name)
+            use_structured_name, strict=strict)
 
     def __init__(self, config: BertConfig):
         super().__init__()

@@ -15,7 +15,8 @@ from ..nn.layer.layers import Layer
 from ..nn.layer.norm import LayerNorm
 from ..tensor import creation
 from .bert import (BertEmbeddings, BertForSequenceClassification, BertLayer,
-                   BertModel, MlmHead, expand_padding_mask)
+                   BertModel, MlmHead, _remap_legacy_keys,
+                   expand_padding_mask)
 
 
 class ErnieConfig:
@@ -83,19 +84,37 @@ class ErnieModel(BertModel):
 
 class ErnieForSequenceClassification(BertForSequenceClassification):
     """Bert classification head over the ERNIE encoder (model_cls hook);
-    only the task_type_ids pass-through is ERNIE-specific. The encoder is
-    reachable as either .bert (inherited) or .ernie (upstream name)."""
+    only the task_type_ids pass-through is ERNIE-specific.
+
+    The encoder attribute is named `ernie` — the name upstream
+    PaddleNLP/transformers checkpoints for this head use — so state_dict
+    keys are `ernie.*` and upstream classification checkpoints cross-load
+    directly. Checkpoints saved by earlier versions of THIS repo (keys
+    `bert.*`, from the inherited attribute name) remap on load; `.bert`
+    stays as a read-only alias for attribute access."""
 
     model_cls = ErnieModel
+    _LEGACY_KEYS = (("bert", "ernie"),)
+
+    def __init__(self, config, num_classes=2):
+        Layer.__init__(self)
+        self.ernie = self.model_cls(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
 
     @property
-    def ernie(self):
-        return self.bert
+    def bert(self):
+        return self.ernie
+
+    def set_state_dict(self, state_dict, use_structured_name=True, strict=False):
+        return super().set_state_dict(
+            _remap_legacy_keys(state_dict, self._LEGACY_KEYS),
+            use_structured_name, strict=strict)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 task_type_ids=None, labels=None):
-        _, pooled = self.bert(input_ids, token_type_ids,
-                              attention_mask=attention_mask, task_type_ids=task_type_ids)
+        _, pooled = self.ernie(input_ids, token_type_ids,
+                               attention_mask=attention_mask, task_type_ids=task_type_ids)
         logits = self.classifier(self.dropout(pooled))
         if labels is not None:
             return F.cross_entropy(logits, labels)

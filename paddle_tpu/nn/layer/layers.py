@@ -9,6 +9,7 @@ compiled train step, pjit sharding, and the auto-parallel engine all use it.
 from __future__ import annotations
 
 import collections
+import warnings
 
 import jax
 import numpy as np
@@ -270,7 +271,14 @@ class Layer:
                 dest[structured_name_prefix + full] = b
         return dest
 
-    def set_state_dict(self, state_dict, use_structured_name=True):
+    def set_state_dict(self, state_dict, use_structured_name=True, strict=False):
+        """Load `state_dict` into this layer's parameters/buffers.
+
+        Key drift is never silent: non-empty missing/unexpected sets warn
+        (checkpoint-format drift surfaces at LOAD time, not as mysteriously
+        divergent training later), and strict=True upgrades the warning to
+        a RuntimeError. Returns (missing, unexpected) as before.
+        """
         own = self.state_dict()
         missing, unexpected = [], []
         for k, v in state_dict.items():
@@ -282,6 +290,15 @@ class Layer:
         for k in own:
             if k not in state_dict:
                 missing.append(k)
+        if missing or unexpected:
+            msg = (f"{type(self).__name__}.set_state_dict: "
+                   f"{len(missing)} missing key(s) (stay at current init) "
+                   f"{missing[:5]}{'...' if len(missing) > 5 else ''}, "
+                   f"{len(unexpected)} unexpected key(s) (ignored) "
+                   f"{unexpected[:5]}{'...' if len(unexpected) > 5 else ''}")
+            if strict:
+                raise RuntimeError(msg)
+            warnings.warn(msg, stacklevel=2)
         return missing, unexpected
 
     set_dict = set_state_dict

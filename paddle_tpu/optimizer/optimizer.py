@@ -120,6 +120,11 @@ class Optimizer:
             # promotion (e.g. Adam's f32 bias correction) upcast the param
             p._data = new_p.astype(p._data.dtype)
             self._accumulators[id(p)] = new_slots
+        from ..framework.core import _bump_mutation_version
+
+        # weight-derived caches (serving prefix KV) key on this counter;
+        # a direct _data rebind must invalidate them like set_value does
+        _bump_mutation_version()
 
     def _wd_in_grad(self, p):
         # L2Decay folds into the gradient (reference: regularizer append path);

@@ -11,6 +11,7 @@ import pickle
 import numpy as np
 
 from .framework.core import Parameter, Tensor
+from .testing import chaos
 
 
 def _to_storable(obj):
@@ -56,8 +57,23 @@ def save(obj, path, protocol=4, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_storable(obj), f, protocol=protocol)
+    # atomic temp+rename: autoresume/ModelCheckpoint overwrite the SAME path
+    # every save — a trainer killed mid-write (preemption) must leave the
+    # previous checkpoint loadable, never a torn pickle at the final name
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(_to_storable(obj), f, protocol=protocol)
+            f.flush()
+            os.fsync(f.fileno())
+        chaos.site("save.write", path=tmp)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def load(path, return_numpy=False, **configs):

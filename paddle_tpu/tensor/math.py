@@ -132,7 +132,10 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
 def multiplex(inputs, index, name=None):
     stacked = jnp.stack([_t(i)._data for i in inputs], 1)
     idx = _t(index)._data.reshape(-1)
-    return Tensor(jnp.take_along_axis(stacked, idx[:, None, *([None] * (stacked.ndim - 2))], axis=1).squeeze(1))
+    # (slice/None tuple instead of star-unpacking in the subscript: that
+    # syntax needs py3.11, and the package must import on 3.10)
+    expand = (slice(None), None) + (None,) * (stacked.ndim - 2)
+    return Tensor(jnp.take_along_axis(stacked, idx[expand], axis=1).squeeze(1))
 
 
 # -- reductions --------------------------------------------------------------

@@ -1,0 +1,8 @@
+"""Testing utilities: deterministic fault injection (chaos) for exercising
+the stack's recovery paths. Import surface:
+
+    from paddle_tpu.testing import chaos
+    with chaos.FaultPlan().fail("store.get", times=2):
+        ...
+"""
+from . import chaos  # noqa: F401

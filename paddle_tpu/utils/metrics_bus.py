@@ -7,12 +7,48 @@ holds the (un-synced) loss array. Every `log_every` steps the bus syncs once,
 computes throughput/MFU/memory, and fans the record out to subscribers
 (stdout logger, JSONL, TensorBoard SummaryWriter, user callbacks).
 """
+import collections
 import json
 import logging
 import os
+import threading
 import time
 
 logger = logging.getLogger("paddle_tpu.metrics")
+
+
+class EventCounters:
+    """Process-wide named counters for fault/retry/recovery observability
+    (SURVEY.md §5 metrics row). The hot-path cost of `bump` is one dict
+    increment under a lock; recovery paths (store/RPC retries, checkpoint
+    rollbacks, serving-request failures, chaos injections) publish here so
+    tests and operators can assert *bounded* retry behavior instead of
+    grepping logs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = collections.Counter()
+
+    def bump(self, name, n=1):
+        with self._lock:
+            self._counts[name] += n
+
+    def get(self, name):
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self, prefix=""):
+        with self._lock:
+            return {k: v for k, v in self._counts.items() if k.startswith(prefix)}
+
+    def reset(self, prefix=""):
+        with self._lock:
+            for k in [k for k in self._counts if k.startswith(prefix)]:
+                del self._counts[k]
+
+
+#: module singleton — `from paddle_tpu.utils.metrics_bus import counters`
+counters = EventCounters()
 
 
 def device_peak_memory():
@@ -94,6 +130,9 @@ class StepMetricsBus:
         mem = device_peak_memory()
         if mem:
             record["peak_memory_bytes"] = mem
+        faults = counters.snapshot("fault.")
+        if faults:  # only present when something actually failed/retried
+            record["faults"] = faults
         self._intervals.append((steps, dt))
         self._last_emit_t = now
         self._last_emit_step = self._step

@@ -1,0 +1,63 @@
+"""Bounded exponential-backoff retry for transient transport faults
+(reference pattern: brpc channel retry policy / etcd client backoff — the
+reference PS and elastic stacks both retry transport errors with capped
+exponential backoff rather than failing the job on the first RST).
+
+One policy object shared by the TCPStore client, PS client, and RPC layer so
+"bounded" means the same thing everywhere and tests can assert it: attempts
+are capped, backoff is exponential with a deterministic (unjittered) base so
+chaos tests reproduce, and every retry bumps a `fault.retry.*` counter on
+the metrics bus.
+"""
+import time
+
+from .metrics_bus import counters
+
+#: transient transport failures worth retrying. TimeoutError/ConnectionError
+#: cover the py transports; OSError covers raw socket/ctypes paths.
+TRANSIENT_ERRORS = (ConnectionError, TimeoutError, OSError)
+
+
+class RetryPolicy:
+    def __init__(self, attempts=4, base_delay=0.05, max_delay=2.0,
+                 retry_on=TRANSIENT_ERRORS):
+        if attempts < 1:
+            raise ValueError("attempts must be >= 1")
+        self.attempts = int(attempts)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.retry_on = retry_on
+
+    def delay(self, attempt):
+        """Backoff before retry `attempt` (1-based): base * 2^(attempt-1)."""
+        return min(self.base_delay * (2 ** (attempt - 1)), self.max_delay)
+
+    def run(self, fn, *, name="op", on_retry=None):
+        """Call fn() with up to `attempts` tries. `on_retry(exc, attempt)`
+        runs before each retry — transports use it to drop a poisoned
+        connection so the retry redials instead of reusing a dead socket."""
+        last = None
+        for attempt in range(1, self.attempts + 1):
+            try:
+                return fn()
+            except self.retry_on as e:
+                last = e
+                if attempt == self.attempts:
+                    break
+                counters.bump(f"fault.retry.{name}")
+                if on_retry is not None:
+                    try:
+                        on_retry(e, attempt)
+                    except Exception:
+                        pass  # cleanup failure must not mask the real error
+                time.sleep(self.delay(attempt))
+        counters.bump(f"fault.exhausted.{name}")
+        raise last
+
+
+#: default used by the store/PS/RPC seams; ~0.35s worst-case added latency
+DEFAULT_POLICY = RetryPolicy()
+
+
+def with_retries(fn, name="op", policy=None, on_retry=None):
+    return (policy or DEFAULT_POLICY).run(fn, name=name, on_retry=on_retry)

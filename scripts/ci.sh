@@ -1,16 +1,28 @@
 #!/usr/bin/env bash
-# Pre-snapshot gate: the committed suite must be green before any commit
-# that closes a milestone. Run from the repo root:
-#   bash scripts/ci.sh          # default tier (CPU, 8 virtual devices)
+# CI gate, two tiers (VERDICT r5 weakness #8: round 5 shipped RED because a
+# snapshot commit landed source changes the suite never ran on — the full
+# suite had grown past what anyone runs per-commit, so it silently stopped
+# being run at all. The fix is structural: a FAST tier cheap enough that
+# there is no excuse to skip it on ANY commit, and a FULL tier that remains
+# mandatory before anything milestone-shaped):
+#
+#   bash scripts/ci.sh --fast   # commit gate: core-subsystem subset under a
+#                               # hard wall-clock budget (CI_FAST_BUDGET,
+#                               # default 600s). Run before EVERY commit.
+#   bash scripts/ci.sh          # full default tier (everything not slow/tpu).
+#                               # REQUIRED before any snapshot/milestone
+#                               # commit — a red full tier blocks the commit.
 #   bash scripts/ci.sh --tpu    # additionally run TPU-marked tests first
+#                               # (real accelerator required).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 # native tier (VERDICT r4 weak #8): rebuild the .so from sources so a drifted
 # tcp_store.cc/blocking_queue.cc fails HERE, not at runtime on a machine
-# without the toolchain; then the loader smoke-imports it.
+# without the toolchain; -B because a committed .so built against a different
+# libstdc++ is "up to date" by mtime yet unloadable. The loader smoke-imports.
 if command -v g++ >/dev/null; then
-  make -C native >/dev/null
+  make -B -C native >/dev/null
   python - <<'PY'
 from paddle_tpu.framework.native import load_native
 lib = load_native()
@@ -19,6 +31,26 @@ PY
 fi
 
 ARGS=(-q -p no:cacheprovider)
+
+# fast tier: the seams where an untested change does the most damage —
+# chaos/recovery paths, launcher+store+dataloader, serving engine, layers,
+# checkpoints. Budget-enforced so it stays a per-commit habit; if this set
+# outgrows the budget, PRUNE IT, don't skip it.
+FAST_TESTS=(
+  tests/test_chaos.py
+  tests/test_launch.py
+  tests/test_ps_mode.py
+  tests/test_dist_checkpoint.py
+  tests/test_nn.py
+  tests/test_inference.py
+)
+
+if [[ "${1:-}" == "--fast" ]]; then
+  shift
+  exec timeout -k 10 "${CI_FAST_BUDGET:-600}" \
+    python -m pytest "${FAST_TESTS[@]}" "${ARGS[@]}" -m 'not slow' "$@"
+fi
+
 if [[ "${1:-}" == "--tpu" ]]; then
   shift
   # exit code 5 = no tests collected — fine while the tpu tier is empty

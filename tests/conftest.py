@@ -19,6 +19,21 @@ import numpy as np
 import pytest
 
 
+def pytest_collection_modifyitems(config, items):
+    """tpu-marked tests SKIP (not fail) off-chip, regardless of how -m was
+    spelled: a CLI `-m 'not slow'` overrides the addopts marker filter and
+    would otherwise select them onto a CPU backend, where their
+    platform asserts fail by design. scripts/ci.sh --tpu sets
+    PADDLE_TPU_TEST_PLATFORM=tpu, which disables the skip."""
+    if os.environ.get("PADDLE_TPU_TEST_PLATFORM", "cpu") == "tpu":
+        return
+    skip = pytest.mark.skip(
+        reason="requires a real TPU backend (PADDLE_TPU_TEST_PLATFORM=tpu)")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu

@@ -6,6 +6,8 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from paddle_tpu.framework.jax_compat import shard_map
+
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu import nn, optimizer
@@ -67,7 +69,7 @@ class TestCollectives:
                 dist.all_reduce(t, group=grp)
                 return t._data
 
-            f = jax.shard_map(body, mesh=m, in_specs=P("dp"), out_specs=P("dp"), check_vma=False)
+            f = shard_map(body, mesh=m, in_specs=P("dp"), out_specs=P("dp"), check_vma=False)
             x = np.arange(8, dtype=np.float32)
             out = f(x)
             assert np.allclose(np.asarray(out), np.full(8, x.sum()))
@@ -82,7 +84,7 @@ class TestCollectives:
                 gathered = dist.all_gather(t, group=grp)
                 return gathered._data
 
-            f = jax.shard_map(body, mesh=m, in_specs=P("dp"), out_specs=P(None), check_vma=False)
+            f = shard_map(body, mesh=m, in_specs=P("dp"), out_specs=P(None), check_vma=False)
             x = np.arange(8, dtype=np.float32)
             out = f(x)
             assert np.allclose(np.asarray(out), x)
@@ -94,7 +96,7 @@ class TestCollectives:
             def body(x):
                 return dist.shift(paddle.to_tensor(x), "dp", offset=1)._data
 
-            f = jax.shard_map(body, mesh=m, in_specs=P("dp"), out_specs=P("dp"), check_vma=False)
+            f = shard_map(body, mesh=m, in_specs=P("dp"), out_specs=P("dp"), check_vma=False)
             x = np.arange(8, dtype=np.float32)
             out = np.asarray(f(x))
             assert np.allclose(out, np.roll(x, 1))
@@ -124,7 +126,11 @@ class TestParity:
         with M.mesh_guard(m):
             _, step_tp = build_model_and_step(mesh=m, stage=0)
             loss_tp = step_tp(paddle.to_tensor(x), paddle.to_tensor(y))
-        assert np.allclose(loss_single.numpy(), loss_tp.numpy(), atol=1e-5)
+        # 1e-4, not 1e-5: mp=8 splits every contraction 8 ways and the
+        # partitioner's reduction order varies by XLA version (older
+        # XLA:CPU lands ~9e-5 off the single-device sum). A wrong TP
+        # collective is an order-1 error, still far outside this bound.
+        assert np.allclose(loss_single.numpy(), loss_tp.numpy(), atol=1e-4)
 
     def test_zero_sharding_parity_multi_step(self):
         x, y = make_batch()

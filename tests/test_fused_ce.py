@@ -101,8 +101,19 @@ class TestChunkLoopUnroll:
         low_l, low_u = lowered(0), lowered(4)
         txt_l = low_l.compile().as_text()
         txt_u = low_u.compile().as_text()
-        assert " while(" in txt_l or "while (" in txt_l
-        assert " while(" not in txt_u and "while (" not in txt_u
+        # the CHUNK loop must be gone from the unrolled lowering. Older
+        # XLA:CPU additionally lowers the scatter-add inside
+        # take_along_axis's transpose as its own while-loop (absent on newer
+        # backends, and emitted once PER UNROLLED CHUNK here) — that is not
+        # the loop this knob eliminates, so filter whiles by their op
+        # metadata before asserting.
+        def chunk_whiles(txt):
+            return sum(1 for line in txt.splitlines()
+                       if (" while(" in line or "while (" in line)
+                       and "scatter" not in line)
+
+        assert chunk_whiles(txt_l) >= 1
+        assert chunk_whiles(txt_u) == 0, txt_u[:2000]
         # the sequencing chain must be in the lowered program (TPU honors it;
         # CPU strips it during optimization, hence asserting pre-optimization).
         # The loop path also carries a barrier or two from remat's own
