@@ -62,6 +62,7 @@ from . import distribution
 from . import quantization
 from . import audio
 from . import text
+from . import observability
 from . import profiler
 from . import sparse
 from . import linalg as _linalg_ns

@@ -9,12 +9,17 @@ shard inventory comes from jax.Array.addressable_shards.
 """
 import json
 import os
+import time
 import zlib
 
 import jax
 import numpy as np
 
 from ...framework.core import Tensor, to_tensor
+from ...observability import goodput as _goodput
+from ...observability import tracing as _tracing
+from ...observability import watchdog as _watchdog
+from ...observability.metrics import registry as _registry
 from ...testing import chaos
 from ...utils.metrics_bus import counters
 
@@ -87,28 +92,33 @@ _last_async_save = None
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, async_save=False):
     global _last_async_save
+    t_save0 = time.perf_counter()
+    # a long blocking save must not read as a rank hang: phase beats get the
+    # watchdog's startup-length leash until the next step beat
+    _watchdog.note_phase("checkpoint")
     os.makedirs(path, exist_ok=True)
     pid = jax.process_index()
     metadata = {"tensors": {}, "world": jax.process_count()}
     data_file = os.path.join(path, f"{pid}_0.distcp")
     blobs = {}
-    for name, t in state_dict.items():
-        t = to_tensor(t) if not isinstance(t, Tensor) else t
-        arr = t._data
-        shards = []
-        for i, (idx, shard) in enumerate(_shard_inventory(arr)):
-            # dedupe replicated shards: only the first device per index saves
-            if any(s["index"] == idx for s in shards):
-                continue
-            key = f"{name}__shard{i}"
-            # device→host copy happens NOW (so async writes see a snapshot)
-            blobs[key] = _to_savable(np.asarray(shard.data))
-            shards.append({"index": idx, "file": os.path.basename(data_file), "key": key})
-        metadata["tensors"][name] = {
-            "global_shape": list(arr.shape),
-            "dtype": str(np.dtype(arr.dtype)),
-            "shards": shards,
-        }
+    with _tracing.span("ckpt.save.snapshot", path=path):
+        for name, t in state_dict.items():
+            t = to_tensor(t) if not isinstance(t, Tensor) else t
+            arr = t._data
+            shards = []
+            for i, (idx, shard) in enumerate(_shard_inventory(arr)):
+                # dedupe replicated shards: only the first device per index saves
+                if any(s["index"] == idx for s in shards):
+                    continue
+                key = f"{name}__shard{i}"
+                # device→host copy happens NOW (so async writes see a snapshot)
+                blobs[key] = _to_savable(np.asarray(shard.data))
+                shards.append({"index": idx, "file": os.path.basename(data_file), "key": key})
+            metadata["tensors"][name] = {
+                "global_shape": list(arr.shape),
+                "dtype": str(np.dtype(arr.dtype)),
+                "shards": shards,
+            }
 
     def _write():
         # ATOMIC commit protocol (reference pattern: Orbax commit-file /
@@ -166,8 +176,19 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
         th = threading.Thread(target=_guarded, daemon=True)
         th.start()
         _last_async_save = _AsyncSaveHandle(th, errbox)
+        # only the BLOCKING portion (device→host snapshot) is training-thread
+        # badput; the background serialization overlaps compute by design
+        dt = time.perf_counter() - t_save0
+        if _tracing.enabled():
+            _goodput.note("checkpoint", dt)
+        _registry.histogram("ckpt.save_blocking_s").observe(dt)
         return _last_async_save
-    _write()
+    with _tracing.span("ckpt.save.write", path=path):
+        _write()
+    dt = time.perf_counter() - t_save0
+    if _tracing.enabled():
+        _goodput.note("checkpoint", dt)
+    _registry.histogram("ckpt.save_blocking_s").observe(dt)
     return None
 
 
@@ -187,6 +208,8 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
     manifest (size + crc32, when present) and must unzip cleanly BEFORE any
     tensor is touched; a truncated/partial shard raises
     CheckpointCorruptError instead of poisoning a live model."""
+    t_load0 = time.perf_counter()
+    _watchdog.note_phase("recovery")
     meta_path = os.path.join(path, "metadata.json")
     if not os.path.exists(meta_path):
         raise CheckpointCorruptError(
@@ -242,4 +265,10 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
         target = t._data.sharding if hasattr(t._data, "sharding") else None
         arr = jax.device_put(full, target) if target is not None else full
         t.set_value(Tensor(arr))
+    # resume loads are recovery badput: time spent getting BACK to where
+    # training already was (the chaos layer's preemptions land here)
+    dt = time.perf_counter() - t_load0
+    if _tracing.enabled():
+        _goodput.note("recovery", dt)
+    _registry.histogram("ckpt.load_s").observe(dt)
     return state_dict

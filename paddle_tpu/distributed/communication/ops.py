@@ -14,12 +14,32 @@ TPU-native semantics, two contexts:
 
 Mutating Paddle signatures (in-place tensor update) are honored.
 """
+import functools
+
 import jax
 import jax.numpy as jnp
 
 from ...framework.core import Tensor, apply, to_tensor
+from ...observability import tracing as _tracing
 from .. import env as _env
 from .group import get_axis_names
+
+
+def _spanned(name):
+    """Wrap a collective entry point in a telemetry span (free when
+    disabled). Caveat: under a jit trace the span measures TRACE time once —
+    per-execution device time for collectives lives in xprof; the span's
+    value is eager-path latency + call counts (span.<name>_s histograms)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _tracing.span(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 
 class ReduceOp:
@@ -56,6 +76,7 @@ def _reduce_fn(op):
     }[op]
 
 
+@_spanned("collective.all_reduce")
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     tensor = _t(tensor)
     axes = _bound_axes(group)
@@ -74,6 +95,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return all_reduce(tensor, op, group, sync_op)
 
 
+@_spanned("collective.all_gather")
 def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
     # functional form: all_gather(tensor, group=...) -> Tensor
     if tensor is None or not isinstance(tensor_list, list):
@@ -104,6 +126,7 @@ def all_gather_object(object_list, obj, group=None):
     return object_list
 
 
+@_spanned("collective.reduce_scatter")
 def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
     tensor = _t(tensor)
     src = tensor_or_tensor_list
@@ -126,6 +149,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, s
     return tensor
 
 
+@_spanned("collective.broadcast")
 def broadcast(tensor, src=0, group=None, sync_op=True):
     tensor = _t(tensor)
     axes = _bound_axes(group)
@@ -140,6 +164,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     return tensor
 
 
+@_spanned("collective.scatter")
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     tensor = _t(tensor)
     axes = _bound_axes(group)
@@ -162,6 +187,7 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     return all_gather(gather_list, tensor, group, sync_op)
 
 
+@_spanned("collective.all_to_all")
 def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
     # functional single-tensor form: all_to_all(tensor, group=...) -> Tensor
     if in_tensor_list is None or not isinstance(out_tensor_list, list):
@@ -196,6 +222,7 @@ def all_to_all(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
 alltoall = all_to_all
 
 
+@_spanned("collective.alltoall_single")
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
     t = _t(in_tensor)
     axes = _bound_axes(group)
@@ -248,11 +275,13 @@ def batch_isend_irecv(p2p_op_list):
     return [_Task() for _ in p2p_op_list]
 
 
+@_spanned("collective.ppermute")
 def ppermute(tensor, axis_name, perm):
     """collective_permute over a mesh axis — the ICI-native p2p primitive."""
     return apply(lambda a: jax.lax.ppermute(a, axis_name, perm), _t(tensor), name="ppermute")
 
 
+@_spanned("collective.shift")
 def shift(tensor, axis_name, offset=1):
     """Ring shift: rank i -> rank (i+offset) % n. Core of ring attention."""
     from ..mesh import axis_size as _mesh_axis_size
@@ -263,6 +292,7 @@ def shift(tensor, axis_name, offset=1):
     return apply(lambda a: jax.lax.ppermute(a, axis_name, perm), t, name="ring_shift")
 
 
+@_spanned("collective.barrier")
 def barrier(group=None):
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils

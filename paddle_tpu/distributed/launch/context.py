@@ -40,6 +40,12 @@ def build_parser():
                    help="TPU slice count for the hybrid ICI x DCN mesh: "
                         "build_mesh puts ONLY data parallelism on the "
                         "slice-crossing dcn_dp axis")
+    p.add_argument("--hang_deadline", type=float,
+                   default=float(os.environ.get("PADDLE_HANG_DEADLINE_S", "0") or 0),
+                   help="seconds without a rank step-heartbeat before the hang "
+                        "watchdog dumps all-rank stacks + last spans to "
+                        "<log_dir>/telemetry/hang_report.json (0 = off; env "
+                        "PADDLE_HANG_DEADLINE_S sets the default)")
     p.add_argument("--run_mode", default="collective")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)

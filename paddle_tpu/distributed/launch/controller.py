@@ -12,6 +12,7 @@ import sys
 import time
 
 from ...framework.native import TCPStore
+from ...observability.watchdog import HangWatchdog, heartbeat_path
 from ...testing import chaos
 from ...utils.metrics_bus import counters
 from ..fleet.elastic import PREEMPTED_EXIT_CODE
@@ -25,6 +26,9 @@ class CollectiveController:
         self.store = None
         self.node_rank = None
         self.endpoints = []
+        # shared telemetry dir: workers drop heartbeat/spans/stack files
+        # here; the hang watchdog (watch loop) monitors them
+        self.telemetry_dir = os.path.join(ctx.args.log_dir, "telemetry")
 
     # ---- rendezvous ----
     def build_store(self):
@@ -108,6 +112,14 @@ class CollectiveController:
                 "MASTER_PORT": str(self.ctx.master_port),
                 "PADDLE_PS_AUTHKEY": ps_authkey,
             }
+            # observability contract: train loops heartbeat + stream spans
+            # here (watchdog.maybe_beat / tracing autoconfigure). Exported
+            # only when something will READ it — the watchdog is armed or
+            # telemetry is on — so default launches keep per-step heartbeat
+            # I/O at exactly zero.
+            if (getattr(args, "hang_deadline", 0) or 0) > 0 \
+                    or os.environ.get("PADDLE_TELEMETRY"):
+                env["PADDLE_TELEMETRY_DIR"] = self.telemetry_dir
             if args.devices:
                 env["FLAGS_selected_devices"] = args.devices
             log = os.path.join(args.log_dir, f"workerlog.{rank}")
@@ -133,6 +145,23 @@ class CollectiveController:
         total_budget = args.max_total_restarts
         if total_budget is None or total_budget < 0:
             total_budget = max(1, args.max_restart) * len(pod.containers) * 2
+        watchdog = None
+        deadline = getattr(args, "hang_deadline", 0) or 0
+        if deadline > 0:
+            os.makedirs(self.telemetry_dir, exist_ok=True)
+            watchdog = HangWatchdog(
+                self.telemetry_dir, deadline,
+                on_hang=lambda p: print(
+                    f"[paddle_tpu.launch] rank heartbeat stalled past "
+                    f"{deadline}s; diagnosis written to {p}", file=sys.stderr),
+            ).start()
+        try:
+            return self._watch_loop(pod, args, total_restarts, total_budget)
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
+
+    def _watch_loop(self, pod, args, total_restarts, total_budget):
         while True:
             chaos.site("launch.watch")
             failed = pod.failed_containers()
@@ -158,6 +187,15 @@ class CollectiveController:
                 for c in to_restart:
                     total_restarts += 1
                     counters.bump("fault.launch_restart")
+                    # drop the dead incarnation's heartbeat: the restarted
+                    # rank re-registers when it beats again, so rendezvous +
+                    # recompile time cannot read as a hang to the watchdog
+                    rank = c.env.get("PADDLE_TRAINER_ID")
+                    if rank is not None:
+                        try:
+                            os.remove(heartbeat_path(self.telemetry_dir, rank))
+                        except OSError:
+                            pass
                     c.close_log()
                     c.start()
             time.sleep(0.3)

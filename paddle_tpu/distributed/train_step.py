@@ -22,6 +22,9 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..jit_api import TrainStep
+from ..observability import goodput as _goodput
+from ..observability import tracing as _tracing
+from ..observability import watchdog as _watchdog
 from .mesh import get_mesh
 
 
@@ -176,28 +179,36 @@ class DistributedTrainStep(TrainStep):
         from ..framework import random as prandom
         from ..framework.core import Tensor, to_tensor
 
-        batch_datas = tuple(to_tensor(b)._data for b in batch)
-        sig = tuple((tuple(np.shape(b)), str(np.asarray(b).dtype) if not hasattr(b, "dtype") else str(b.dtype)) for b in batch_datas)
+        with _tracing.span("train.step.host_prep"):
+            batch_datas = tuple(to_tensor(b)._data for b in batch)
+            sig = tuple((tuple(np.shape(b)), str(np.asarray(b).dtype) if not hasattr(b, "dtype") else str(b.dtype)) for b in batch_datas)
         jitted = self._jitted.get(sig)
-        if jitted is None:
-            shardings = self._sharding_trees(batch_datas)
-            params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, batch_sh = shardings
-            jitted = jax.jit(
-                self._step_fn,
-                in_shardings=(params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, self._ns(P()), self._ns(P()), batch_sh),
-                out_shardings=(self._ns(P()), params_sh, buffers_sh, opt_sh, scaler_sh),
-                donate_argnums=(0, 1, 3, 4),
-            )
-            self._jitted[sig] = jitted
+        first = jitted is None
+        if first:
+            with _tracing.span("train.step.compile_build"):
+                shardings = self._sharding_trees(batch_datas)
+                params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, batch_sh = shardings
+                jitted = jax.jit(
+                    self._step_fn,
+                    in_shardings=(params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, self._ns(P()), self._ns(P()), batch_sh),
+                    out_shardings=(self._ns(P()), params_sh, buffers_sh, opt_sh, scaler_sh),
+                    donate_argnums=(0, 1, 3, 4),
+                )
+                self._jitted[sig] = jitted
         params = {k: p._data for k, p in self._trainable.items()}
         buffers = {k: b._data for k, b in self._buffers.items()}
         frozen = {k: p._data for k, p in self._frozen.items()}
         lr = self.optimizer.get_lr()
-        with self.mesh:
-            loss, new_params, new_buffers, self.opt_state, self._scaler_state = jitted(
-                params, buffers, frozen, self.opt_state, self._scaler_state, lr,
-                prandom.next_key(), batch_datas
-            )
+        # a signature-miss dispatch pays XLA compile: goodput counts it as
+        # init/compile, not step time (the MPMD-scaling paper's
+        # bubble-vs-compute split needs the same discipline)
+        with _tracing.span("train.step.dispatch"), \
+                _goodput.account("init" if first else "step"):
+            with self.mesh:
+                loss, new_params, new_buffers, self.opt_state, self._scaler_state = jitted(
+                    params, buffers, frozen, self.opt_state, self._scaler_state, lr,
+                    prandom.next_key(), batch_datas
+                )
         for k, v in new_params.items():
             self._trainable[k]._data = v
         for k, v in new_buffers.items():
@@ -209,6 +220,7 @@ class DistributedTrainStep(TrainStep):
         if sched is not None:
             sched.step()
         self.optimizer._global_step += 1
+        _watchdog.maybe_beat(self.optimizer._global_step)
         if self.metrics_bus is not None:
             if self.metrics_bus.tokens_per_step is None and batch_datas:
                 import math
@@ -232,6 +244,7 @@ class DistributedTrainStep(TrainStep):
         sig = ("multi", n, stacked,
                tuple((tuple(np.shape(b)), str(b.dtype)) for b in batch_datas))
         jitted = self._jitted.get(sig)
+        first = jitted is None
         if jitted is None:
             # per-step batch shapes decide the batch specs; stacked inputs
             # prepend a replicated scan dim
@@ -254,9 +267,13 @@ class DistributedTrainStep(TrainStep):
         buffers = {k: b._data for k, b in self._buffers.items()}
         frozen = {k: p._data for k, p in self._frozen.items()}
         lr = self.optimizer.get_lr()
-        with self.mesh:
-            losses, new_params, new_buffers, self.opt_state, self._scaler_state = jitted(
-                params, buffers, frozen, self.opt_state, self._scaler_state, lr,
-                prandom.next_key(), batch_datas
-            )
+        # signature-miss dispatches pay XLA compile — init, not step (same
+        # discipline as the single-step path)
+        with _tracing.span("train.run_steps.dispatch"), \
+                _goodput.account("init" if first else "step"):
+            with self.mesh:
+                losses, new_params, new_buffers, self.opt_state, self._scaler_state = jitted(
+                    params, buffers, frozen, self.opt_state, self._scaler_state, lr,
+                    prandom.next_key(), batch_datas
+                )
         return self._finish_run_steps(losses, new_params, new_buffers, n)

@@ -10,6 +10,8 @@ import numpy as np
 from ..framework.core import Tensor, to_tensor
 from ..io import DataLoader
 from ..jit_api import TrainStep
+from ..observability import goodput as _goodput
+from ..observability import tracing as _tracing
 from .callbacks import CallbackList, ProgBarLogger
 
 
@@ -129,14 +131,24 @@ class Model:
                 m.reset()
             cbks.on_epoch_begin(epoch)
             logs = {}
-            for step, batch in enumerate(train_loader):
-                if num_iters is not None and step >= num_iters:
-                    break
+            # manual iteration so loader stalls are measured as data_wait
+            # badput (the train_batch step itself is spanned inside
+            # TrainStep) — telemetry disabled, both hooks are no-ops
+            data_iter = iter(train_loader)
+            step = 0
+            while num_iters is None or step < num_iters:
+                with _tracing.span("data.wait"), \
+                        _goodput.account("data_wait"):
+                    try:
+                        batch = next(data_iter)
+                    except StopIteration:
+                        break
                 cbks.on_batch_begin("train", step, logs)
                 ins, labs = self._split_batch(batch)
                 res = self.train_batch(ins, labs)
                 logs = self._to_logs(res)
                 cbks.on_batch_end("train", step, logs)
+                step += 1
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_res = self.evaluate(eval_loader, verbose=0)
                 logs.update({f"eval_{k}": v for k, v in eval_res.items()})

@@ -35,10 +35,29 @@ import numpy as np
 from ..framework import core as _core
 from ..framework.core import Tensor
 from ..generation import _make_sampler, prompt_bucket
+from ..observability import tracing as _trace
+from ..observability.metrics import registry as _registry
 from ..ops.paged_attention import PagedLayerCache
 from ..testing import chaos
 from ..utils.metrics_bus import counters
 from ..utils.retry import RetryPolicy
+
+# serving telemetry (the Gemma-on-TPU serving comparison's vocabulary,
+# PAPERS.md): TTFT = serve-entry → first token per request; TPOT = decode
+# dispatch wall / tokens in the block. Gauges carry high-water marks so a
+# post-hoc snapshot still shows peak pressure. Always-on: per-request /
+# per-dispatch observes are noise against a jitted model call.
+_M_TTFT = _registry.histogram("serve.ttft_s")
+_M_TPOT = _registry.histogram(
+    "serve.tpot_s",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1, 0.25, 0.5, 1.0, 2.5))
+_M_QUEUE = _registry.gauge("serve.queue_depth")
+_M_OCCUPANCY = _registry.gauge("serve.slot_occupancy")
+_M_TOKENS = _registry.counter("serve.tokens_out")
+_M_REQUESTS = _registry.counter("serve.requests")
+_M_PREFIX_HIT = _registry.counter("serve.prefix.hit_pages")
+_M_PREFIX_LOOKUP = _registry.counter("serve.prefix.lookup_pages")
 
 # one module-level jitted key builder (jit cache survives across serve()
 # calls): key[slot] = fold_in(fold_in(base, request_id), token_index)
@@ -583,7 +602,10 @@ class ContinuousBatchingEngine:
                     self.clear_prefix_cache()
                 self._cache_weights_version = version
         self.request_errors = {}
+        t_serve = time.monotonic()  # TTFT epoch: every request enters now
+        _M_REQUESTS.inc(len(prompts))
         queue = deque(enumerate(prompts))
+        _M_QUEUE.set(len(queue))  # records the load peak via the gauge hwm
         results = [None] * len(prompts)
         # slot -> [req_id, tokens_out(list), n_generated, last_token, pages(list)]
         active = {}
@@ -637,6 +659,12 @@ class ContinuousBatchingEngine:
                     self.stats["deferred_admissions"] += 1
                     break  # FIFO: wait for pages instead of skipping ahead
                 queue.popleft()
+                if self.enable_prefix_cache:
+                    # hit-rate denominator, counted once per ADMISSION (a
+                    # deferred head-of-queue request re-enters try_admit
+                    # every decode block and must not inflate it): every
+                    # full prompt page that could have come from cache
+                    _M_PREFIX_LOOKUP.inc((true_len - 1) // bs_)
                 slot = self.free_slots.pop()
                 new_pages = self._alloc_pages(total_need - n_pre)
                 self._ref_pages(new_pages)
@@ -645,21 +673,23 @@ class ContinuousBatchingEngine:
                 ids_p = np.zeros((1, sbucket), np.int32)
                 ids_p[0, :suffix_len] = prompt[n_pre * bs_:]
                 try:
-                    chaos.site("serve.prefill")
-                    if n_pre:
-                        self.stats["prefix_hit_pages"] += n_pre
-                        ks_pre, vs_pre = self._gather_prefix(n_pre)(
-                            tuple(self.pools), jnp.asarray(shared, jnp.int32))
-                        tok0, ks, vs = self._prefill_suffix(n_pre, sbucket, sampling)(
-                            state, ks_pre, vs_pre, jnp.asarray(ids_p),
-                            jnp.int32(suffix_len), req_key(rid, 0))
-                    else:
-                        tok0, ks, vs = self._prefill(sbucket, sampling)(
-                            state, jnp.asarray(ids_p), jnp.int32(suffix_len),
-                            req_key(rid, 0))
-                    page_ids = jnp.asarray(new_pages[:region], jnp.int32)
-                    self.pools = list(self._insert(sbucket)(
-                        tuple(self.pools), ks, vs, page_ids))
+                    with _trace.span("serve.prefill"):
+                        chaos.site("serve.prefill")
+                        if n_pre:
+                            self.stats["prefix_hit_pages"] += n_pre
+                            _M_PREFIX_HIT.inc(n_pre)
+                            ks_pre, vs_pre = self._gather_prefix(n_pre)(
+                                tuple(self.pools), jnp.asarray(shared, jnp.int32))
+                            tok0, ks, vs = self._prefill_suffix(n_pre, sbucket, sampling)(
+                                state, ks_pre, vs_pre, jnp.asarray(ids_p),
+                                jnp.int32(suffix_len), req_key(rid, 0))
+                        else:
+                            tok0, ks, vs = self._prefill(sbucket, sampling)(
+                                state, jnp.asarray(ids_p), jnp.int32(suffix_len),
+                                req_key(rid, 0))
+                        page_ids = jnp.asarray(new_pages[:region], jnp.int32)
+                        self.pools = list(self._insert(sbucket)(
+                            tuple(self.pools), ks, vs, page_ids))
                 except Exception as e:  # error isolation: fail THIS request
                     self._unref_pages(pages)
                     self.free_slots.append(slot)
@@ -673,6 +703,8 @@ class ContinuousBatchingEngine:
                 self.page_table[slot] = row
                 self.lengths[slot] = true_len
                 tok0 = int(tok0)
+                _M_TTFT.observe(time.monotonic() - t_serve)
+                _M_TOKENS.inc()
                 done = eos_token_id is not None and tok0 == eos_token_id
                 # register BEFORE the user callback: if it raises, the
                 # finally-cleanup must see this slot to free its pages
@@ -695,7 +727,10 @@ class ContinuousBatchingEngine:
             self.lengths[slot] = 0
 
         try:
-            try_admit()
+            with _trace.span("serve.admit"):
+                try_admit()
+            _M_QUEUE.set(len(queue))
+            _M_OCCUPANCY.set(len(active) / self.max_seqs)
             return self._serve_loop(sampling, state, queue, active, results,
                                     try_admit, retire, max_new_tokens,
                                     eos_token_id, do_sample, base_key,
@@ -765,23 +800,31 @@ class ContinuousBatchingEngine:
                     keys)
                 return np.asarray(blk), pools
 
-            block, pools = self.retry_policy.run(dispatch, name="serve.decode")
+            t_disp0 = time.monotonic()
+            with _trace.span("serve.decode"):
+                block, pools = self.retry_policy.run(dispatch, name="serve.decode")
+            # dispatch() syncs (np.asarray on the block), so this is real
+            # wall time; normalized per token it is the TPOT the serving
+            # comparison papers report
+            _M_TPOT.observe((time.monotonic() - t_disp0) / k)
             self.pools = list(pools)
             self.stats["decode_steps"] += k
-            for slot in list(active):
-                st = active[slot]
-                for s in range(k):
-                    self.lengths[slot] += 1  # the fed token is now in cache
-                    tok = int(block[s, slot])
-                    st[1].append(tok)
-                    st[2] += 1  # generated count, incl. the token just appended
-                    st[3] = tok
-                    if on_token is not None:
-                        on_token(st[0], tok)
-                    if st[2] >= max_new_tokens or (
-                            eos_token_id is not None and tok == eos_token_id):
-                        retire(slot)  # mid-block EOS: rest of block discarded
-                        break
+            with _trace.span("serve.emit"):
+                for slot in list(active):
+                    st = active[slot]
+                    for s in range(k):
+                        self.lengths[slot] += 1  # the fed token is now in cache
+                        tok = int(block[s, slot])
+                        st[1].append(tok)
+                        st[2] += 1  # generated count, incl. the token just appended
+                        st[3] = tok
+                        _M_TOKENS.inc()
+                        if on_token is not None:
+                            on_token(st[0], tok)
+                        if st[2] >= max_new_tokens or (
+                                eos_token_id is not None and tok == eos_token_id):
+                            retire(slot)  # mid-block EOS: rest of block discarded
+                            break
             if request_timeout_s is not None:
                 now = time.monotonic()
                 for slot in list(active):
@@ -790,5 +833,8 @@ class ContinuousBatchingEngine:
                         self.stats["timed_out_requests"] += 1
                         counters.bump("fault.serve.request_timeout")
                         retire(slot)
-            try_admit()
+            with _trace.span("serve.admit"):
+                try_admit()
+            _M_QUEUE.set(len(queue))
+            _M_OCCUPANCY.set(len(active) / self.max_seqs)
         return results

@@ -15,14 +15,22 @@ import pickle
 import queue
 import struct
 import threading
+import time
 
 import numpy as np
 
 from ..framework.core import Tensor, to_tensor
 from ..framework.native import BlockingQueue
+from ..observability.metrics import registry as _registry
 from ..testing import chaos
 from .dataset import IterableDataset
 from .sampler import BatchSampler, DistributedBatchSampler
+
+# consumer-side wait for the next batch: when this histogram's tail grows,
+# the step loop is data-starved (goodput category "data_wait") — per-batch
+# observe cost is a bisect + two adds, negligible against a batch
+_wait_hist = _registry.histogram("data.wait_s")
+_batches = _registry.counter("data.batches")
 
 
 class WorkerInfo:
@@ -232,6 +240,7 @@ class DataLoader:
         try:
             for bi in range(len(all_indices)):
                 w = bi % W
+                t0 = time.perf_counter()
                 blob = queues[w].pop()
                 while blob is None:
                     if respawns[w] >= self.max_worker_respawns:
@@ -249,6 +258,8 @@ class DataLoader:
                     pids[w], queues[w] = self._spawn_worker(
                         w, bi, all_indices, custom_collate)
                     blob = queues[w].pop()
+                _wait_hist.observe(time.perf_counter() - t0)
+                _batches.inc()
                 yield self._to_tensors(pickle.loads(blob))
         finally:
             for pid in pids:
@@ -281,11 +292,14 @@ class DataLoader:
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         while True:
+            t0 = time.perf_counter()
             item = q.get()
             if item is _SENTINEL:
                 if err:
                     raise err[0]
                 return
+            _wait_hist.observe(time.perf_counter() - t0)
+            _batches.inc()
             yield item
 
     @staticmethod

@@ -13,6 +13,9 @@ import jax.numpy as jnp
 
 from .framework import random as prandom
 from .framework.core import Tensor, _bump_mutation_version, to_tensor
+from .observability import goodput as _goodput
+from .observability import tracing as _tracing
+from .observability import watchdog as _watchdog
 
 
 def jit(fn=None, static_argnums=None, donate_argnums=None, backend=None):
@@ -162,6 +165,12 @@ class TrainStep:
         self._buffers = dict(model.named_buffers())
         self.opt_state = optimizer.init_state(self._trainable)
         self._scaler_state = scaler.init_state() if scaler is not None else None
+        # first dispatch pays XLA compile: goodput attributes it to "init"
+        self._dispatched = False
+        # register with the hang watchdog BEFORE the first step: a rank that
+        # wedges in its first compile/collective must still be diagnosable
+        # (the init beat gets the watchdog's longer startup deadline)
+        _watchdog.arm_from_env()
 
         opt = optimizer
         n_lab = n_labels
@@ -175,7 +184,10 @@ class TrainStep:
             overrides = {k: Tensor(v, stop_gradient=False) for k, v in params.items()}
             buf_over = {k: Tensor(v, stop_gradient=True) for k, v in buffers.items()}
             frozen_over = {k: Tensor(v, stop_gradient=True) for k, v in frozen.items()}
-            with prandom.rng_guard(key):
+            # named_scope (not host spans): fwd/bwd/opt are fused into ONE
+            # XLA program, so phase attribution lives in the HLO metadata and
+            # shows up in xprof device traces, where host clocks cannot reach
+            with prandom.rng_guard(key), jax.named_scope("forward"):
                 out = model.functional_call(
                     {**overrides, **buf_over, **frozen_over},
                     *[Tensor(b) for b in inputs],
@@ -183,12 +195,13 @@ class TrainStep:
                 )
                 outs = out if isinstance(out, (tuple, list)) else (out,)
                 loss = loss_fn(*outs, *[Tensor(b, stop_gradient=True) for b in labels])
-            if scale is not None:
-                # seed the cotangent with the loss scale (≡ scaling the loss)
-                loss.backward(Tensor(jnp.ones_like(loss._data) * scale))
-            else:
-                loss.backward()
-            grads = {k: t.grad._data for k, t in overrides.items() if t.grad is not None}
+            with jax.named_scope("backward"):
+                if scale is not None:
+                    # seed the cotangent with the loss scale (≡ scaling the loss)
+                    loss.backward(Tensor(jnp.ones_like(loss._data) * scale))
+                else:
+                    loss.backward()
+                grads = {k: t.grad._data for k, t in overrides.items() if t.grad is not None}
             new_buffers = {k: t._data for k, t in buf_over.items()}
             return loss._data, grads, new_buffers
 
@@ -250,12 +263,13 @@ class TrainStep:
                 skip = ~finite
                 new_scaler_state = scaler.update_state(scaler_state, finite)
 
-            if opt._grad_clip is not None:
-                pg = [(Tensor(params[k]), Tensor(g)) for k, g in grads.items()]
-                pg = opt._grad_clip(pg)
-                grads = {k: t._data for (k, _), (_, t) in zip(grads.items(), pg)}
+            with jax.named_scope("optimizer"):
+                if opt._grad_clip is not None:
+                    pg = [(Tensor(params[k]), Tensor(g)) for k, g in grads.items()]
+                    pg = opt._grad_clip(pg)
+                    grads = {k: t._data for (k, _), (_, t) in zip(grads.items(), pg)}
 
-            new_params, new_opt_state = opt.apply_gradients(params, grads, opt_state, lr, skip_update=skip)
+                new_params, new_opt_state = opt.apply_gradients(params, grads, opt_state, lr, skip_update=skip)
             return loss_data, new_params, new_buffers, new_opt_state, new_scaler_state
 
         self._step_fn = step_fn
@@ -334,6 +348,7 @@ class TrainStep:
             for _ in range(n):
                 sched.step()
         self.optimizer._global_step += n
+        _watchdog.maybe_beat(self.optimizer._global_step)
         return Tensor(losses)
 
     @staticmethod
@@ -346,14 +361,20 @@ class TrainStep:
                     f"stacked run_steps: leading dim {np.shape(b)[0]} != n={n}")
 
     def __call__(self, *batch):
-        params = {k: p._data for k, p in self._trainable.items()}
-        buffers = {k: b._data for k, b in self._buffers.items()}
-        frozen = {k: p._data for k, p in self._frozen.items()}
-        lr = self.optimizer.get_lr()
-        batch_data = tuple(to_tensor(b)._data for b in batch)
-        loss, new_params, new_buffers, self.opt_state, self._scaler_state = self._compiled(
-            params, buffers, frozen, self.opt_state, self._scaler_state, lr, prandom.next_key(), batch_data
-        )
+        first = not self._dispatched
+        with _tracing.span("train.step"), \
+                _goodput.account("init" if first else "step"):
+            with _tracing.span("train.step.host_prep"):
+                params = {k: p._data for k, p in self._trainable.items()}
+                buffers = {k: b._data for k, b in self._buffers.items()}
+                frozen = {k: p._data for k, p in self._frozen.items()}
+                lr = self.optimizer.get_lr()
+                batch_data = tuple(to_tensor(b)._data for b in batch)
+            with _tracing.span("train.step.dispatch"):
+                loss, new_params, new_buffers, self.opt_state, self._scaler_state = self._compiled(
+                    params, buffers, frozen, self.opt_state, self._scaler_state, lr, prandom.next_key(), batch_data
+                )
+        self._dispatched = True
         # write state back into the dygraph objects
         for k, v in new_params.items():
             self._trainable[k]._data = v
@@ -364,6 +385,7 @@ class TrainStep:
         if sched is not None:
             sched.step()
         self.optimizer._global_step += 1
+        _watchdog.maybe_beat(self.optimizer._global_step)
         if self.metrics_bus is not None:
             if self.metrics_bus.tokens_per_step is None and batch_data:
                 import math

@@ -1,0 +1,48 @@
+"""Unified telemetry (ISSUE 2 tentpole): metrics registry, span tracing,
+goodput accounting, and the distributed hang watchdog.
+
+One import, four capabilities:
+
+    from paddle_tpu import observability as obs
+
+    obs.enable()                              # or PADDLE_TELEMETRY=1
+    with obs.span("train.step.dispatch"):     # nested host spans
+        ...
+    obs.registry.counter("serve.requests").inc()
+    print(obs.registry.to_prometheus())       # scrape-ready snapshot
+    print(obs.goodput.report())               # {goodput_fraction, badput...}
+
+The package is stdlib-only (no jax import) so the launcher, forked
+dataloader workers, and test harnesses can use it without touching device
+runtimes. Metric publication (counters/gauges/histograms) is always on —
+it is the EventCounters cost model. Span tracing and goodput timers are
+**zero-overhead when disabled** (a shared no-op context manager); see
+docs/OBSERVABILITY.md for the metric/span taxonomy and env vars.
+"""
+from . import goodput  # noqa: F401
+from .goodput import GoodputAccountant  # noqa: F401
+from .metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    registry,
+)
+from .tracing import (  # noqa: F401
+    JsonlSpanSink,
+    add_jsonl_sink,
+    disable,
+    enable,
+    enabled,
+    last_spans,
+    span,
+)
+from .watchdog import HangWatchdog, Heartbeat, maybe_beat  # noqa: F401
+
+__all__ = [
+    "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "registry", "span", "enable", "disable", "enabled", "last_spans",
+    "add_jsonl_sink", "JsonlSpanSink", "goodput", "GoodputAccountant",
+    "HangWatchdog", "Heartbeat", "maybe_beat",
+]

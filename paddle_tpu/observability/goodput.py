@@ -1,0 +1,102 @@
+"""Goodput accounting: where did the wall clock actually go?
+
+Attributes elapsed time to a small fixed taxonomy —
+
+- ``init``       first-dispatch compile + state placement (pays once, or
+                 again after every elastic restart: restart badput)
+- ``step``       productive optimizer steps — THE goodput
+- ``data_wait``  input pipeline starvation (host blocked on the loader)
+- ``checkpoint`` save/serialize stalls on the training thread
+- ``recovery``   resume loads, restart rendezvous, watchdog-diagnosed stalls
+
+so the chaos layer's preemptions and the launcher's restarts show up as
+measured badput fractions, not vibes. ``report()`` divides by true wall
+clock since process start (or ``reset()``), so untracked time is visible
+too instead of silently inflating goodput.
+
+Gating: ``account(cat)`` is a no-op context manager unless span tracing is
+enabled (same switch: PADDLE_TELEMETRY / tracing.enable()) — hot loops carry
+it for free, and ALL categories share the gate so a report never shows
+badput-only fractions from a telemetry-off run. ``always=True`` exists for
+callers that need unconditional attribution.
+"""
+import threading
+import time
+
+from . import tracing
+
+__all__ = ["GoodputAccountant", "accountant", "account", "note", "report",
+           "reset", "CATEGORIES"]
+
+CATEGORIES = ("init", "step", "data_wait", "checkpoint", "recovery")
+
+
+class _Timer:
+    __slots__ = ("_acct", "_cat", "_t0")
+
+    def __init__(self, acct, cat):
+        self._acct = acct
+        self._cat = cat
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._acct.note(self._cat, time.perf_counter() - self._t0)
+        return False
+
+
+class GoodputAccountant:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._totals = {}
+        self._t0 = time.perf_counter()
+
+    def account(self, category, always=False):
+        """Context manager attributing the enclosed wall time to
+        ``category``. Free (shared no-op) when telemetry is disabled unless
+        ``always=True``."""
+        if not always and not tracing.enabled():
+            return tracing._NULL
+        return _Timer(self, category)
+
+    def note(self, category, seconds):
+        with self._lock:
+            self._totals[category] = self._totals.get(category, 0.0) + seconds
+
+    def totals(self):
+        with self._lock:
+            return dict(self._totals)
+
+    def report(self):
+        """{wall_s, tracked_s, untracked_s, categories, fractions,
+        goodput_fraction, badput}: fractions are of WALL clock, so they sum
+        (with untracked) to 1."""
+        wall = time.perf_counter() - self._t0
+        totals = self.totals()
+        tracked = sum(totals.values())
+        frac = {k: (v / wall if wall > 0 else 0.0) for k, v in totals.items()}
+        return {
+            "wall_s": wall,
+            "tracked_s": tracked,
+            "untracked_s": max(0.0, wall - tracked),
+            "categories": totals,
+            "fractions": frac,
+            "goodput_fraction": frac.get("step", 0.0),
+            "badput": {k: v for k, v in frac.items() if k != "step"},
+        }
+
+    def reset(self):
+        with self._lock:
+            self._totals = {}
+            self._t0 = time.perf_counter()
+
+
+#: process singleton + module-level conveniences
+accountant = GoodputAccountant()
+account = accountant.account
+note = accountant.note
+totals = accountant.totals
+report = accountant.report
+reset = accountant.reset

@@ -1,0 +1,309 @@
+"""Metrics registry: counters, gauges, fixed-bucket histograms.
+
+Unifies what used to be scattered (utils/metrics_bus.EventCounters, the
+serving engine's ad-hoc ``stats`` dict, per-script timing prints) behind one
+process-wide registry that every layer publishes into and that dumps two
+ways: JSONL (one record per metric, machine-diffable across runs) and a
+Prometheus-style text snapshot (scrape-ready, the operator-facing format the
+TPU-vs-GPU serving comparison in PAPERS.md reports against).
+
+Cost model (the same contract as testing/chaos.py): publishing is hot-path
+code. A counter ``inc`` is one lock + one float add; a histogram ``observe``
+is a bisect over a small tuple + two adds. Nothing here allocates per call,
+formats strings, or touches the filesystem — rendering happens only in the
+explicitly-invoked dump paths.
+"""
+import bisect
+import json
+import os
+import re
+import threading
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+           "DEFAULT_BUCKETS"]
+
+#: latency-oriented default bucket upper bounds, in seconds (an implicit
+#: +inf bucket is always appended): 0.5ms .. 60s covers a dispatch through a
+#: full checkpoint write.
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Counter:
+    """Monotonic named counter. ``inc`` only; ``reset`` exists for tests and
+    for the EventCounters compat shim's prefix reset."""
+
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def reset(self):
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Last-value gauge. Also tracks the high-water mark (``hwm``) since the
+    last reset — queue depth / slot occupancy are only interesting at their
+    peaks, and a scrape-time gauge alone misses transients."""
+
+    __slots__ = ("name", "help", "_lock", "_value", "_hwm")
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._hwm = 0.0
+
+    def set(self, v):
+        v = float(v)
+        with self._lock:
+            self._value = v
+            if v > self._hwm:
+                self._hwm = v
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+            if self._value > self._hwm:
+                self._hwm = self._value
+
+    def dec(self, n=1):
+        self.inc(-n)
+
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def hwm(self):
+        return self._hwm
+
+    def reset(self):
+        with self._lock:
+            self._value = 0.0
+            self._hwm = 0.0
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus-style cumulative export).
+
+    ``bounds`` are the finite bucket upper limits; an implicit +inf bucket
+    catches the tail. Per-``observe`` cost is a bisect over the bounds tuple
+    plus two adds under the lock — no per-call allocation.
+    """
+
+    __slots__ = ("name", "help", "bounds", "_lock", "_counts", "_sum", "_count")
+
+    def __init__(self, name, buckets=DEFAULT_BUCKETS, help=""):
+        self.name = name
+        self.help = help
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        if not self.bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v):
+        v = float(v)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    @property
+    def mean(self):
+        return self._sum / self._count if self._count else 0.0
+
+    def bucket_counts(self):
+        """Raw (non-cumulative) per-bucket counts, +inf bucket last."""
+        with self._lock:
+            return list(self._counts)
+
+    def cumulative(self):
+        """[(upper_bound, cumulative_count)], ending with (inf, count)."""
+        out, cum = [], 0
+        counts = self.bucket_counts()
+        for b, c in zip(self.bounds, counts[:-1]):
+            cum += c
+            out.append((b, cum))
+        out.append((float("inf"), cum + counts[-1]))
+        return out
+
+    def quantile(self, q):
+        """Bucket-resolution quantile estimate: the smallest upper bound
+        whose cumulative count reaches q*count (inf if it lands in the
+        overflow bucket). Good enough for p50/p99 dashboards; exact values
+        need a trace, not a histogram."""
+        if not 0 <= q <= 1:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        if not self._count:
+            return 0.0
+        target = q * self._count
+        for bound, cum in self.cumulative():
+            if cum >= target:
+                return bound
+        return float("inf")
+
+    def reset(self):
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+
+_PROM_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name):
+    n = _PROM_SANITIZE.sub("_", name)
+    return "_" + n if n[:1].isdigit() else n
+
+
+class MetricsRegistry:
+    """Process-wide name -> metric map. Metric creation is idempotent
+    (``counter("x")`` twice returns the same object); re-registering a name
+    as a different type is a bug and raises."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get_or_create(self, name, cls, **kw):
+        m = self._metrics.get(name)
+        if m is not None:
+            if not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, **kw)
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name, help=""):
+        return self._get_or_create(name, Counter, help=help)
+
+    def gauge(self, name, help=""):
+        return self._get_or_create(name, Gauge, help=help)
+
+    def histogram(self, name, buckets=DEFAULT_BUCKETS, help=""):
+        return self._get_or_create(name, Histogram, buckets=buckets, help=help)
+
+    def get(self, name):
+        """Existing metric or None — never creates."""
+        return self._metrics.get(name)
+
+    def names(self, prefix=""):
+        with self._lock:
+            return sorted(n for n in self._metrics if n.startswith(prefix))
+
+    # ---- snapshots ---------------------------------------------------------
+    def snapshot(self, prefix=""):
+        """{name: plain-python value} — counters/gauges as numbers,
+        histograms as {count, sum, mean, buckets}. Zero-valued counters are
+        omitted (the EventCounters contract: 'faults' is only present when
+        something actually fired)."""
+        out = {}
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in items:
+            if not name.startswith(prefix):
+                continue
+            if isinstance(m, Counter):
+                if m.value:
+                    out[name] = m.value
+            elif isinstance(m, Gauge):
+                out[name] = {"value": m.value, "hwm": m.hwm}
+            else:
+                out[name] = {
+                    "count": m.count, "sum": m.sum, "mean": m.mean,
+                    "buckets": [[b, c] for b, c in m.cumulative()],
+                }
+        return out
+
+    def dump_jsonl(self, path, extra=None):
+        """Append one JSON record per metric (plus the optional ``extra``
+        dict on each line — rank/step stamps). Atomic enough for a telemetry
+        sidecar: one write + flush per call."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        base = dict(extra) if extra else {}
+        lines = []
+        for name, val in self.snapshot().items():
+            rec = dict(base)
+            rec["name"] = name
+            rec["value"] = val
+            lines.append(json.dumps(rec))
+        with open(path, "a") as f:
+            f.write("\n".join(lines) + ("\n" if lines else ""))
+            f.flush()
+
+    def to_prometheus(self):
+        """Prometheus text exposition format. Dots in metric names become
+        underscores; histograms render the standard _bucket/_sum/_count
+        triplet with cumulative le labels."""
+        lines = []
+        with self._lock:
+            items = sorted(self._metrics.items())
+        for name, m in items:
+            pname = _prom_name(name)
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {pname} counter")
+                lines.append(f"{pname} {m.value}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {pname} gauge")
+                lines.append(f"{pname} {m.value}")
+                lines.append(f"{pname}_hwm {m.hwm}")
+            else:
+                lines.append(f"# TYPE {pname} histogram")
+                for bound, cum in m.cumulative():
+                    le = "+Inf" if bound == float("inf") else repr(bound)
+                    lines.append(f'{pname}_bucket{{le="{le}"}} {cum}')
+                lines.append(f"{pname}_sum {m.sum}")
+                lines.append(f"{pname}_count {m.count}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self, prefix=""):
+        """Zero every metric under ``prefix`` (objects and handles stay
+        valid — only values reset)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in items:
+            if name.startswith(prefix):
+                m.reset()
+
+
+#: the process-wide singleton every layer publishes into
+registry = MetricsRegistry()

@@ -1,0 +1,273 @@
+"""Span tracing: ``with span("name"):`` through the seams that matter.
+
+A span is a host-side timed region with parent/child nesting (per-thread
+stack). Completed spans fan out to:
+
+- a process-wide ring buffer (``last_spans``) — what the hang watchdog dumps
+  when a rank stalls;
+- the profiler's chrome-trace host-event buffer, when a Profiler is
+  recording — spans appear in the same timeline RecordEvent always fed;
+- any registered JSONL sinks (one json object per line, crash-safe: each
+  record is flushed as written);
+- a per-span-name duration histogram in the metrics registry
+  (``span.<name>_s``) — the per-phase step breakdown falls out of the same
+  data.
+
+Cost contract (asserted in tests/test_telemetry.py like chaos.site's):
+**disabled, an attr-less span is one module-global load + a None/False
+check** returning a shared no-op context manager — no allocation, no clock
+read. Spans called with ``**attrs`` pay the kwargs-dict build before the
+check runs (Python semantics), so per-step/per-dispatch hot paths use
+attr-less spans. Enable via ``enable()`` or ``PADDLE_TELEMETRY=1``.
+
+Caveat: a span opened inside a jax trace (jit compile) measures TRACE time
+once, not per-execution device time; device-side phase attribution rides
+``jax.named_scope`` into xprof instead (see jit_api's fwd_bwd/optimizer
+scopes and docs/OBSERVABILITY.md).
+"""
+import atexit
+import collections
+import json
+import os
+import sys
+import threading
+import time
+
+__all__ = ["span", "enable", "disable", "enabled", "last_spans",
+           "add_jsonl_sink", "clear_sinks", "JsonlSpanSink"]
+
+_ENABLED = None           # tri-state: None = resolve from env on first use
+_RING_DEFAULT = 512
+_ring = collections.deque(maxlen=_RING_DEFAULT)
+_sinks = []
+_local = threading.local()
+_tids = {}
+_tids_lock = threading.Lock()
+
+
+def _small_tid():
+    """Small, stable per-thread id (chrome-trace tid / span record tid).
+    Unlike ``get_ident() % 100000``, cannot collide: ids are assigned
+    sequentially per distinct live thread identity."""
+    ident = threading.get_ident()
+    tid = _tids.get(ident)
+    if tid is None:
+        with _tids_lock:
+            tid = _tids.setdefault(ident, len(_tids) + 1)
+    return tid
+
+
+def _resolve_enabled():
+    global _ENABLED
+    _ENABLED = os.environ.get("PADDLE_TELEMETRY", "").lower() in (
+        "1", "true", "yes", "on")
+    if _ENABLED:
+        _autoconfigure_sinks()
+    return _ENABLED
+
+
+def enabled():
+    """True when span tracing is on (env PADDLE_TELEMETRY or enable())."""
+    e = _ENABLED
+    return e if e is not None else _resolve_enabled()
+
+
+def enable(jsonl_path=None, ring=None):
+    """Turn span tracing on programmatically; optionally attach a JSONL sink
+    and resize the ring buffer. Env-configured sinks (PADDLE_TELEMETRY_DIR)
+    attach here too — a launcher-spawned worker that calls obs.enable()
+    itself still streams spans where the hang watchdog looks."""
+    global _ENABLED, _ring
+    _ENABLED = True
+    if ring is not None and ring != _ring.maxlen:
+        _ring = collections.deque(_ring, maxlen=int(ring))
+    if jsonl_path is not None and not any(
+            getattr(s, "path", None) == jsonl_path for s in _sinks):
+        add_jsonl_sink(jsonl_path)  # idempotent: re-enable ≠ duplicate sink
+    _autoconfigure_sinks()
+
+
+def disable():
+    """Turn tracing off. The ring buffer and sinks are kept (post-mortem
+    inspection of what was captured while enabled)."""
+    global _ENABLED
+    _ENABLED = False
+
+
+_autosink_path = None
+
+
+def _autoconfigure_sinks():
+    """Env-armed processes (launcher-spawned trainers) stream spans to
+    <PADDLE_TELEMETRY_DIR>/spans.<rank>.jsonl — the file the hang watchdog
+    tails for its per-rank last-N-spans report. Idempotent: repeated
+    enable() calls attach the sink once."""
+    global _autosink_path
+    d = os.environ.get("PADDLE_TELEMETRY_DIR")
+    if not d:
+        return
+    rank = os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0"))
+    path = os.path.join(d, f"spans.{rank}.jsonl")
+    if path == _autosink_path and any(
+            getattr(s, "path", None) == path for s in _sinks):
+        return
+    try:
+        add_jsonl_sink(path)
+        _autosink_path = path
+    except OSError:
+        pass
+
+
+class JsonlSpanSink:
+    """Crash-safe JSONL span sink: every record is written + flushed
+    immediately, the file handle closes idempotently at exit (atexit) or via
+    the context-manager protocol — a crash loses at most the record being
+    formatted, never the flushed tail."""
+
+    def __init__(self, path):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self.path = path
+        self._f = open(path, "a")
+        atexit.register(self.close)
+
+    def __call__(self, record):
+        f = self._f
+        if f is None:
+            return
+        try:
+            f.write(json.dumps(record) + "\n")
+            f.flush()
+        except ValueError:  # closed underneath us at interpreter teardown
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def close(self):
+        f, self._f = self._f, None
+        if f is not None:
+            try:
+                f.close()
+            except ValueError:
+                pass
+        try:
+            atexit.unregister(self.close)
+        except Exception:
+            pass
+
+
+def add_jsonl_sink(path):
+    sink = JsonlSpanSink(path)
+    _sinks.append(sink)
+    return sink
+
+
+def clear_sinks():
+    while _sinks:
+        s = _sinks.pop()
+        close = getattr(s, "close", None)
+        if close is not None:
+            close()
+
+
+def last_spans(n=64):
+    """Most recent completed span records (oldest first) — the watchdog's
+    'what was this rank doing' payload."""
+    buf = list(_ring)
+    return buf[-n:]
+
+
+def clear():
+    """Test hook: drop captured spans (sinks untouched)."""
+    _ring.clear()
+
+
+class _NullSpan:
+    """Shared no-op context manager — the entire disabled cost of span()."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "_t0", "_parent")
+
+    def __init__(self, name, attrs):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        stack = getattr(_local, "stack", None)
+        if stack is None:
+            stack = _local.stack = []
+        self._parent = stack[-1].name if stack else None
+        stack.append(self)
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        stack = _local.stack
+        if stack and stack[-1] is self:
+            stack.pop()
+        dur_us = (t1 - self._t0) / 1000.0
+        rec = {
+            "name": self.name,
+            "ts_us": self._t0 / 1000.0,   # perf_counter epoch (chrome-trace)
+            "dur_us": dur_us,
+            "time": time.time(),          # wall clock (cross-rank alignment)
+            "pid": os.getpid(),
+            "tid": _small_tid(),
+            "parent": self._parent,
+            "depth": len(stack),
+        }
+        if self.attrs:
+            rec["attrs"] = self.attrs
+        _emit(rec, dur_us)
+        return False
+
+
+def _emit(rec, dur_us):
+    _ring.append(rec)
+    # same timeline as RecordEvent: host spans land in the chrome trace when
+    # a Profiler is recording. sys.modules probe: never trigger a jax import
+    # from the telemetry layer.
+    prof = sys.modules.get("paddle_tpu.profiler")
+    if prof is not None:
+        try:
+            prof._record_host_event(rec["name"], rec["ts_us"], rec["dur_us"])
+        except Exception:
+            pass
+    from .metrics import registry
+
+    try:
+        registry.histogram(f"span.{rec['name']}_s").observe(dur_us / 1e6)
+    except ValueError:
+        pass  # name collision with a non-histogram metric: skip, don't kill
+    for sink in _sinks:
+        try:
+            sink(rec)
+        except Exception:
+            pass
+
+
+def span(name, **attrs):
+    """``with span("train.step.dispatch", step=i):`` — free when disabled."""
+    e = _ENABLED
+    if not (e if e is not None else _resolve_enabled()):
+        return _NULL
+    return _Span(name, attrs)

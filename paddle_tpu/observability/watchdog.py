@@ -1,0 +1,357 @@
+"""Distributed hang watchdog: turn "job is stuck" into a diagnosis.
+
+Two halves, meeting in a shared telemetry directory
+(``PADDLE_TELEMETRY_DIR``, set per-worker by the launcher):
+
+- **Heartbeat** (trainer side): ``beat(step)`` atomically rewrites
+  ``heartbeat.<rank>.json`` (rank, pid, step, wall time) once per optimizer
+  step — wired into jit_api.TrainStep via ``maybe_beat`` (cached no-op when
+  the env var is unset). Construction also registers a SIGUSR1 faulthandler
+  that dumps ALL thread stacks to ``stacks.<rank>.txt`` — faulthandler's
+  C-level handler fires even when the Python main thread is wedged inside a
+  blocking call, which is exactly the hang case.
+
+- **HangWatchdog** (launcher side, a monitor thread in
+  distributed/launch/controller.py): polls the heartbeat files; when any
+  rank's beat is staler than ``deadline_s`` it (1) signals EVERY rank's pid
+  with SIGUSR1 for a fresh stack dump, (2) collects each rank's stack file
+  and the tail of its span JSONL (what the rank was doing), and (3) commits
+  one ``hang_report.json`` — all-rank stacks + last-N spans + heartbeat
+  ages — before the launcher acts. Fires at most once.
+"""
+import json
+import os
+import re
+import signal
+import threading
+import time
+
+__all__ = ["Heartbeat", "HangWatchdog", "maybe_beat", "heartbeat_path",
+           "stacks_path", "spans_path", "REPORT_NAME", "DIR_ENV",
+           "DEADLINE_ENV"]
+
+DIR_ENV = "PADDLE_TELEMETRY_DIR"
+DEADLINE_ENV = "PADDLE_HANG_DEADLINE_S"
+REPORT_NAME = "hang_report.json"
+
+_HB_RE = re.compile(r"^heartbeat\.(\d+)\.json$")
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError, ValueError, OverflowError):
+        return True  # can't prove it's dead: keep treating it as live
+
+
+def heartbeat_path(d, rank):
+    return os.path.join(d, f"heartbeat.{rank}.json")
+
+
+def stacks_path(d, rank):
+    return os.path.join(d, f"stacks.{rank}.txt")
+
+
+def spans_path(d, rank):
+    return os.path.join(d, f"spans.{rank}.jsonl")
+
+
+class Heartbeat:
+    """Per-rank liveness file + SIGUSR1 stack-dump hook."""
+
+    def __init__(self, directory, rank, install_faulthandler=True):
+        os.makedirs(directory, exist_ok=True)
+        self.dir = directory
+        self.rank = int(rank)
+        self.path = heartbeat_path(directory, self.rank)
+        self._stack_f = None
+        if install_faulthandler and hasattr(signal, "SIGUSR1"):
+            import faulthandler
+
+            try:
+                # keep the handle open for the process lifetime: faulthandler
+                # writes to the raw fd from a signal context, repeated dumps
+                # append — the watchdog reads the accumulated file
+                self._stack_f = open(stacks_path(directory, self.rank), "w")
+                faulthandler.register(signal.SIGUSR1, file=self._stack_f,
+                                      all_threads=True)
+            except (ValueError, OSError, RuntimeError):
+                # non-main thread / exotic platform: liveness still works,
+                # only the stack dump is lost
+                if self._stack_f is not None:
+                    self._stack_f.close()
+                    self._stack_f = None
+        self.beat(step=None, phase="init")
+
+    def beat(self, step=None, **extra):
+        """Atomic heartbeat write (tmp + rename): the watchdog never reads a
+        torn json."""
+        rec = {"rank": self.rank, "pid": os.getpid(), "step": step,
+               "time": time.time()}
+        if extra:
+            rec.update(extra)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f)
+        os.replace(tmp, self.path)
+
+    def close(self):
+        if self._stack_f is not None:
+            import faulthandler
+
+            try:
+                faulthandler.unregister(signal.SIGUSR1)
+            except Exception:
+                pass
+            try:
+                self._stack_f.close()
+            except Exception:
+                pass
+            self._stack_f = None
+
+
+#: cached process heartbeat: False = env unset (permanent no-op),
+#: None = not yet resolved, Heartbeat = beating
+_process_hb = None
+_last_beat_t = 0.0
+#: liveness granularity: sub-second step loops don't need sub-second
+#: heartbeat writes (deadlines are seconds); throttling caps the hot-loop
+#: file I/O at ~2 writes/s no matter how fast steps are
+BEAT_INTERVAL_S = 0.5
+
+
+def _env_heartbeat():
+    """Resolve (once) the process heartbeat from PADDLE_TELEMETRY_DIR."""
+    global _process_hb
+    hb = _process_hb
+    if hb is not None:
+        return hb
+    d = os.environ.get(DIR_ENV)
+    if not d:
+        _process_hb = False
+        return False
+    rank = os.environ.get("PADDLE_TRAINER_ID",
+                          os.environ.get("RANK", "0")) or "0"
+    try:
+        hb = _process_hb = Heartbeat(d, int(rank))
+    except (OSError, ValueError):
+        hb = _process_hb = False
+    return hb
+
+
+def arm_from_env():
+    """Register this process with the watchdog BEFORE the first step: writes
+    the phase='init' beat (step=None), which the watchdog holds to the
+    longer startup deadline — so a rank that wedges in rendezvous, mesh
+    setup, or its first compile/collective still gets diagnosed instead of
+    never appearing in the heartbeat directory at all. Called from
+    TrainStep construction; free when telemetry is not configured."""
+    _env_heartbeat()
+
+
+def note_phase(phase):
+    """Stamp a step=None phase beat before known LONG blocking host work
+    (synchronous checkpoint save, resume load): the watchdog holds step-less
+    beats to the startup deadline, so a legitimate 90s save can't read as a
+    hang and burn the fire-once report. The next maybe_beat restores normal
+    step-deadline monitoring. Bypasses the beat throttle (rare calls)."""
+    hb = _env_heartbeat()
+    if hb is False:
+        return
+    try:
+        hb.beat(step=None, phase=phase)
+    except OSError:
+        pass
+
+
+def maybe_beat(step=None):
+    """The train-loop hook: one cached env check when telemetry is not
+    configured; at most ~2 small atomic file writes per second when it is."""
+    global _last_beat_t
+    hb = _env_heartbeat()
+    if hb is False:
+        return
+    now = time.monotonic()
+    if now - _last_beat_t < BEAT_INTERVAL_S:
+        return
+    _last_beat_t = now
+    try:
+        hb.beat(step=step)
+    except OSError:
+        pass  # a full disk must not kill the training step
+
+
+def _reset_process_heartbeat():
+    """Test hook: forget the cached heartbeat so env changes take effect."""
+    global _process_hb, _last_beat_t
+    if isinstance(_process_hb, Heartbeat):
+        _process_hb.close()
+    _process_hb = None
+    _last_beat_t = 0.0
+
+
+class HangWatchdog:
+    """Monitor thread over a telemetry directory's heartbeat files."""
+
+    def __init__(self, directory, deadline_s, interval_s=None, on_hang=None,
+                 last_n_spans=32, signal_grace_s=0.75,
+                 startup_deadline_s=None):
+        self.dir = directory
+        self.deadline_s = float(deadline_s)
+        # ranks that have only init-beaten (step=None: still in rendezvous /
+        # first compile) get a longer leash — first dispatches legitimately
+        # take many times a steady-state step
+        self.startup_deadline_s = (float(startup_deadline_s)
+                                   if startup_deadline_s is not None
+                                   else 10.0 * self.deadline_s)
+        self.interval_s = interval_s if interval_s is not None else max(
+            0.2, self.deadline_s / 4.0)
+        self.on_hang = on_hang
+        self.last_n_spans = int(last_n_spans)
+        self.signal_grace_s = float(signal_grace_s)
+        self.report_path = os.path.join(directory, REPORT_NAME)
+        self.fired = threading.Event()
+        self._stop = threading.Event()
+        self._thread = None
+        # staleness is measured from max(last beat, OUR start): a heartbeat
+        # left over from a previous incarnation of the job (reused log_dir)
+        # must not fire the first scan — it only counts as stalled once a
+        # full deadline has elapsed on THIS watchdog's watch without a fresh
+        # beat. The launcher additionally deletes a rank's heartbeat file
+        # when it restarts that rank (see controller.watch), so restart
+        # recompile time cannot masquerade as a hang.
+        self._start_time = time.time()
+
+    # ---- lifecycle --------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="paddle-hang-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                if self.scan_once():
+                    return  # fire once; the report is the product
+            except Exception:
+                pass  # a watchdog crash must never take the launcher down
+            self._stop.wait(self.interval_s)
+
+    # ---- scanning ---------------------------------------------------------
+    def _read_heartbeats(self):
+        hbs = {}
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return hbs
+        for name in names:
+            m = _HB_RE.match(name)
+            if not m:
+                continue
+            try:
+                with open(os.path.join(self.dir, name)) as f:
+                    hbs[int(m.group(1))] = json.load(f)
+            except (OSError, ValueError):
+                continue  # racing a writer: next tick sees it
+        return hbs
+
+    def scan_once(self):
+        """One poll; returns the report path if a hang was diagnosed."""
+        hbs = self._read_heartbeats()
+        if not hbs:
+            return None
+        now = time.time()
+        stalled = {}
+        for r, hb in hbs.items():
+            limit = (self.startup_deadline_s if hb.get("step") is None
+                     else self.deadline_s)
+            stale = now - max(hb.get("time", 0), self._start_time)
+            if stale <= limit:
+                continue
+            # a silent heartbeat with a DEAD pid is an exited rank, not a
+            # hang (clean early finishers, crashes the launcher already
+            # handles) — firing on it would burn the one report
+            pid = hb.get("pid")
+            if pid and not _pid_alive(pid):
+                continue
+            stalled[r] = stale
+        if not stalled:
+            return None
+        self._dump(hbs, stalled)
+        return self.report_path
+
+    def _dump(self, hbs, stalled):
+        # fresh stacks from EVERY rank — the straggler's peers show what the
+        # collective was waiting on
+        for hb in hbs.values():
+            pid = hb.get("pid")
+            if pid and hasattr(signal, "SIGUSR1"):
+                try:
+                    os.kill(pid, signal.SIGUSR1)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass  # dead rank: its last heartbeat tells the story
+        time.sleep(self.signal_grace_s)
+        now = time.time()
+        ranks = {}
+        for r, hb in sorted(hbs.items()):
+            ranks[str(r)] = {
+                "heartbeat": hb,
+                "stale_s": now - hb.get("time", 0),
+                "stalled": r in stalled,
+                "stacks": self._read_text(stacks_path(self.dir, r)),
+                "last_spans": self._tail_spans(spans_path(self.dir, r)),
+            }
+        report = {
+            "detected_at": now,
+            "deadline_s": self.deadline_s,
+            "stalled_ranks": sorted(stalled),
+            "stalled_for_s": {str(r): s for r, s in stalled.items()},
+            "ranks": ranks,
+        }
+        tmp = self.report_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(report, f, indent=2)
+        os.replace(tmp, self.report_path)
+        from .metrics import registry
+
+        registry.counter("fault.watchdog.hang").inc()
+        self.fired.set()
+        if self.on_hang is not None:
+            try:
+                self.on_hang(self.report_path)
+            except Exception:
+                pass
+
+    @staticmethod
+    def _read_text(path, limit=1 << 20):
+        try:
+            with open(path, errors="replace") as f:
+                return f.read(limit) or None
+        except OSError:
+            return None
+
+    def _tail_spans(self, path):
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - (1 << 18)))
+                lines = f.read().decode(errors="replace").splitlines()
+        except OSError:
+            return []
+        out = []
+        for line in lines[-self.last_n_spans:]:
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+        return out

@@ -67,6 +67,30 @@ _events_lock = threading.Lock()
 _recording = False
 
 
+def _record_host_event(name, ts_us, dur_us):
+    """Append one complete-event to the chrome-trace host buffer (shared
+    sink: RecordEvent AND observability.tracing spans land in the same
+    timeline). No-op unless a Profiler is recording."""
+    if not _recording:
+        return
+    from ..observability.tracing import _small_tid
+
+    with _events_lock:
+        _host_events.append(
+            {
+                "name": name,
+                "ph": "X",
+                "ts": ts_us,
+                "dur": dur_us,
+                "pid": os.getpid(),
+                # stable sequential per-thread id — the old
+                # `get_ident() % 100000` could collide two threads into one
+                # trace row, interleaving their events
+                "tid": _small_tid(),
+            }
+        )
+
+
 class RecordEvent:
     """Host-side RAII annotation (reference: platform/profiler/event_tracing.h
     RecordEvent). Also forwards to jax.profiler.TraceAnnotation so host spans
@@ -88,18 +112,12 @@ class RecordEvent:
     def end(self):
         if self._jax_ctx is not None:
             self._jax_ctx.__exit__(None, None, None)
-        if self._t0 is not None and _recording:
-            with _events_lock:
-                _host_events.append(
-                    {
-                        "name": self.name,
-                        "ph": "X",
-                        "ts": self._t0 / 1000.0,
-                        "dur": (time.perf_counter_ns() - self._t0) / 1000.0,
-                        "pid": os.getpid(),
-                        "tid": threading.get_ident() % 100000,
-                    }
-                )
+        if self._t0 is not None:
+            _record_host_event(
+                self.name,
+                self._t0 / 1000.0,
+                (time.perf_counter_ns() - self._t0) / 1000.0,
+            )
 
     def __enter__(self):
         self.begin()

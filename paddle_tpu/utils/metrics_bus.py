@@ -3,48 +3,57 @@
 this is the framework component that computes and publishes it.
 
 Design: the hot path stays async — `on_step` only stamps host wall-clock and
-holds the (un-synced) loss array. Every `log_every` steps the bus syncs once,
-computes throughput/MFU/memory, and fans the record out to subscribers
+buffers the (un-synced) loss arrays. Every `log_every` steps the bus syncs
+once, computes throughput/MFU/memory, and fans the record out to subscribers
 (stdout logger, JSONL, TensorBoard SummaryWriter, user callbacks).
+
+Counter storage now lives in paddle_tpu.observability.metrics — the unified
+registry every layer publishes into; `EventCounters` below is the compat
+shim keeping the historical `counters.bump/get/snapshot/reset` call sites
+(and their semantics) working unchanged.
 """
-import collections
-import json
 import logging
-import os
-import threading
 import time
+
+from ..observability.metrics import registry as _registry
+from ..observability.tracing import JsonlSpanSink
 
 logger = logging.getLogger("paddle_tpu.metrics")
 
 
 class EventCounters:
-    """Process-wide named counters for fault/retry/recovery observability
-    (SURVEY.md §5 metrics row). The hot-path cost of `bump` is one dict
-    increment under a lock; recovery paths (store/RPC retries, checkpoint
-    rollbacks, serving-request failures, chaos injections) publish here so
-    tests and operators can assert *bounded* retry behavior instead of
-    grepping logs."""
+    """Compat shim over the observability metrics registry (ISSUE 2: the
+    registry supersedes the scattered counter stores; EventCounters folds
+    in). Same API and semantics as before: `bump` is one lock + add;
+    `snapshot(prefix)` returns only counters that actually fired (zero
+    values are omitted, so `if counters.snapshot("fault."):` still means
+    "something failed"); `reset(prefix)` zeroes them."""
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counts = collections.Counter()
+    def __init__(self, registry=None):
+        self._registry = registry if registry is not None else _registry
 
     def bump(self, name, n=1):
-        with self._lock:
-            self._counts[name] += n
+        self._registry.counter(name).inc(n)
 
     def get(self, name):
-        with self._lock:
-            return self._counts.get(name, 0)
+        from ..observability.metrics import Counter
+
+        m = self._registry.get(name)
+        return m.value if isinstance(m, Counter) else 0
 
     def snapshot(self, prefix=""):
-        with self._lock:
-            return {k: v for k, v in self._counts.items() if k.startswith(prefix)}
+        # registry.snapshot renders Counters as bare numbers (zeros already
+        # omitted); gauges/histograms render as dicts and are filtered out
+        snap = self._registry.snapshot(prefix)
+        return {k: v for k, v in snap.items() if isinstance(v, (int, float))}
 
     def reset(self, prefix=""):
-        with self._lock:
-            for k in [k for k in self._counts if k.startswith(prefix)]:
-                del self._counts[k]
+        from ..observability.metrics import Counter
+
+        for name in self._registry.names(prefix):
+            m = self._registry.get(name)
+            if isinstance(m, Counter):
+                m.reset()
 
 
 #: module singleton — `from paddle_tpu.utils.metrics_bus import counters`
@@ -78,7 +87,7 @@ class StepMetricsBus:
         self._step = 0
         self._last_emit_t = None
         self._last_emit_step = 0
-        self._pending_loss = None
+        self._pending_losses = []  # EVERY step since the last emission
         self._intervals = []  # (steps, seconds) since previous emission
         self._t0 = None
 
@@ -92,14 +101,19 @@ class StepMetricsBus:
         Tensor/jax.Array — it is only synced at emission time."""
         now = time.perf_counter()
         self._step += 1
-        self._pending_loss = loss
         if tokens is not None:
             self.tokens_per_step = tokens
         if self._step <= self.skip_first:
-            # warmup/compile steps: restart the timing window after them
+            # warmup/compile steps: restart the timing window after them and
+            # keep their losses out of the first window's mean
+            self._pending_losses.clear()
             self._last_emit_t = now
             self._last_emit_step = self._step
             return
+        # buffer (not overwrite): the emission reports the WINDOW mean, not
+        # whichever loss happened to be last — sync still deferred to _emit
+        if loss is not None:
+            self._pending_losses.append(loss)
         if self._t0 is None:
             self._t0 = now
         if self._last_emit_t is None:
@@ -109,6 +123,20 @@ class StepMetricsBus:
         if (self._step - self._last_emit_step) >= self.log_every:
             self._emit(now)
 
+    def _window_loss(self):
+        """Mean of the buffered window losses. The device→host reads happen
+        here, once per emission window — by now the async dispatches have
+        long completed, so this is a copy, not a pipeline sync (same cost
+        profile as the old single-loss read)."""
+        vals = []
+        for loss in self._pending_losses:
+            try:
+                vals.append(float(loss.numpy() if hasattr(loss, "numpy") else loss))
+            except Exception:
+                pass
+        self._pending_losses.clear()
+        return sum(vals) / len(vals) if vals else None
+
     def _emit(self, now):
         steps = self._step - self._last_emit_step
         dt = now - self._last_emit_t
@@ -116,12 +144,9 @@ class StepMetricsBus:
             return
         step_time = dt / steps
         record = {"step": self._step, "step_time_s": round(step_time, 6)}
-        if self._pending_loss is not None:
-            try:
-                loss = self._pending_loss
-                record["loss"] = float(loss.numpy() if hasattr(loss, "numpy") else loss)
-            except Exception:
-                pass
+        loss = self._window_loss()
+        if loss is not None:
+            record["loss"] = loss
         if self.tokens_per_step:
             tps = self.tokens_per_step / step_time
             record["tokens_per_sec"] = round(tps, 2)
@@ -165,16 +190,9 @@ def stdout_logger(prefix="step"):
     return fn
 
 
-class JsonlWriter:
-    """Structured per-rank metrics log (SURVEY.md §5: per-rank workerlog.N)."""
+class JsonlWriter(JsonlSpanSink):
+    """Structured per-rank metrics log (SURVEY.md §5: per-rank workerlog.N).
 
-    def __init__(self, path):
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._f = open(path, "a")
-
-    def __call__(self, record):
-        self._f.write(json.dumps(record) + "\n")
-        self._f.flush()
-
-    def close(self):
-        self._f.close()
+    One implementation with the observability span sink: crash-safe
+    per-record flush, context-manager protocol, atexit-safe idempotent
+    close, writes after close silently dropped."""

@@ -30,6 +30,24 @@ assert lib is not None, "rebuilt libpaddle_tpu_native.so failed to load"
 PY
 fi
 
+# telemetry lint (ISSUE 2 satellite): hot-path files must not hand-roll
+# wall-clock timing or print diagnostics — that data belongs in
+# paddle_tpu/observability (spans, registry metrics) where every layer's
+# telemetry lands in ONE place. time.monotonic/perf_counter feeding the
+# registry are fine; raw time.time() and print() are not.
+HOT_PATHS=(
+  paddle_tpu/jit_api.py
+  paddle_tpu/distributed/train_step.py
+  paddle_tpu/inference/continuous.py
+  paddle_tpu/io/dataloader.py
+  paddle_tpu/distributed/communication/ops.py
+)
+if grep -nE '\btime\.time\(|(^|[^.[:alnum:]_])print\(' "${HOT_PATHS[@]}"; then
+  echo "lint: raw time.time()/print() in hot-path files above —" \
+       "route timing/diagnostics through paddle_tpu.observability" >&2
+  exit 1
+fi
+
 ARGS=(-q -p no:cacheprovider)
 
 # fast tier: the seams where an untested change does the most damage —
@@ -38,6 +56,7 @@ ARGS=(-q -p no:cacheprovider)
 # outgrows the budget, PRUNE IT, don't skip it.
 FAST_TESTS=(
   tests/test_chaos.py
+  tests/test_telemetry.py
   tests/test_launch.py
   tests/test_ps_mode.py
   tests/test_dist_checkpoint.py
