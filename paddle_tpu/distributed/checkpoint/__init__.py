@@ -24,10 +24,21 @@ from ...testing import chaos
 from ...utils.metrics_bus import counters
 
 
+from .atomic import atomic_write, atomic_write_json
+
+
 class CheckpointCorruptError(RuntimeError):
     """A shard file is missing, truncated, or fails its manifest checksum.
     Raised by load_state_dict BEFORE any tensor is mutated, so a partial
     write (preempted saver) can never half-load into a live model."""
+
+
+class CheckpointLayoutMismatch(CheckpointCorruptError):
+    """The checkpoint's recorded world size or a tensor's recorded global
+    shape does not match the live process group / target state_dict. Raised
+    by load_state_dict in a pre-pass BEFORE any tensor is mutated — the
+    alternative is an opaque broadcast shape error halfway through a load
+    that has already clobbered part of the model."""
 
 
 _UINT_FOR_WIDTH = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
@@ -71,12 +82,17 @@ class _AsyncSaveHandle:
     def __init__(self, thread, errbox):
         self._thread = thread
         self._errbox = errbox
+        self._surfaced = False
 
     def wait(self, timeout=None):
         self._thread.join(timeout)
         if self._thread.is_alive():
             raise TimeoutError("async checkpoint save still running")
-        if self._errbox:
+        if self._errbox and not self._surfaced:
+            # the held exception surfaces exactly once (here, or from the
+            # NEXT save_state_dict call — whichever comes first); error()
+            # keeps returning it for inspection
+            self._surfaced = True
             raise self._errbox[0]
 
     def done(self):
@@ -89,9 +105,30 @@ class _AsyncSaveHandle:
 _last_async_save = None
 
 
+def _surface_prior_async_save():
+    """Fail fast on a failed background save: the NEXT save_state_dict call
+    re-raises the held exception instead of silently queueing a second save
+    behind a corpse (a vanished checkpoint discovered only at resume time is
+    the worst failure mode). A still-running save is waited for — overlapping
+    writers to the same path would race the atomic commits."""
+    global _last_async_save
+    prev, _last_async_save = _last_async_save, None
+    if prev is None:
+        return
+    if not prev.done():
+        prev.wait()  # raises the background error if the save failed
+        return
+    err = prev.error() if not prev._surfaced else None
+    if err is not None:
+        prev._surfaced = True
+        counters.bump("fault.ckpt.async_save_failed_surfaced")
+        raise err
+
+
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                     unique_id=None, async_save=False):
     global _last_async_save
+    _surface_prior_async_save()
     t_save0 = time.perf_counter()
     # a long blocking save must not read as a rank hang: phase beats get the
     # watchdog's startup-length leash until the next step beat
@@ -122,49 +159,38 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 
     def _write():
         # ATOMIC commit protocol (reference pattern: Orbax commit-file /
-        # torch.distributed.checkpoint temp+rename): serialize to a temp
-        # file, fsync, then os.replace into place — a saver killed mid-write
-        # (preemption, OOM-kill) leaves only a *.tmp the loader never reads,
-        # and the previous checkpoint at `path` stays loadable. The manifest
-        # (metadata.json) commits LAST and carries per-file size+crc32, so a
-        # torn final rename is detectable at load time.
+        # torch.distributed.checkpoint temp+rename), via atomic.atomic_write:
+        # a saver killed mid-write (preemption, OOM-kill) leaves only a *.tmp
+        # the loader never reads, and the previous checkpoint at `path` stays
+        # loadable. The manifest (metadata.json) commits LAST and carries
+        # per-file size+crc32, so a torn final rename is detectable at load.
         final = data_file + ".npz"
-        tmp = final + ".tmp"
-        meta_tmp = os.path.join(path, "metadata.json.tmp")
-        try:
-            with open(tmp, "wb") as f:
-                np.savez(f, **blobs)
-                f.flush()
-                os.fsync(f.fileno())
+
+        def _fingerprint_then_chaos(tmp):
             # fingerprint the INTENDED bytes (pre-commit): any later tear —
-            # injected or real — mismatches the manifest at load time
-            metadata["files"] = {os.path.basename(final): _file_fingerprint(tmp)}
+            # injected or real — mismatches the manifest at load time.
             # chaos "ckpt.write": exc = die before commit (tmp discarded, old
-            # checkpoint intact); truncate = torn shard committed (load detects)
+            # checkpoint intact); truncate = torn shard committed (load
+            # detects via the crc gate)
+            metadata["files"] = {os.path.basename(final): _file_fingerprint(tmp)}
             chaos.site("ckpt.write", path=tmp)
-            os.replace(tmp, final)
-            if pid == coordinator_rank:
-                with open(meta_tmp, "w") as f:
-                    json.dump(metadata, f)
-                    f.flush()
-                    os.fsync(f.fileno())
-                chaos.site("ckpt.manifest", path=meta_tmp)
-                os.replace(meta_tmp, os.path.join(path, "metadata.json"))
-        finally:
-            for leftover in (tmp, meta_tmp):  # a failed save leaves no litter
-                if os.path.exists(leftover):
-                    try:
-                        os.remove(leftover)
-                    except OSError:
-                        pass
+
+        atomic_write(final, lambda f: np.savez(f, **blobs),
+                     before_commit=_fingerprint_then_chaos)
+        if pid == coordinator_rank:
+            atomic_write_json(
+                os.path.join(path, "metadata.json"), metadata,
+                before_commit=lambda tmp: chaos.site("ckpt.manifest", path=tmp))
         counters.bump("ckpt.committed")
 
     if async_save:
         import threading
 
-        if _last_async_save is not None and not _last_async_save.done():
-            _last_async_save.wait()  # serialize overlapping saves
         errbox = []
+        inflight = _registry.gauge(
+            "ckpt.async_inflight",
+            help="background checkpoint serializations currently running")
+        inflight.inc()
 
         def _guarded():
             try:
@@ -172,6 +198,8 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             except BaseException as e:  # surfaced by handle.wait()
                 counters.bump("fault.ckpt.async_save_failed")
                 errbox.append(e)
+            finally:
+                inflight.dec()
 
         th = threading.Thread(target=_guarded, daemon=True)
         th.start()
@@ -217,6 +245,31 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
             f"(a *.tmp left behind means the saver died mid-write)")
     with open(meta_path) as f:
         metadata = json.load(f)
+    # ---- layout pre-pass (BEFORE touching archives or tensors) ----------
+    # Cross-MESH resume is supported (shards reassemble to the global shape,
+    # then reshard to each target's live sharding); a different WORLD SIZE is
+    # not — shard files written by other processes aren't addressable here —
+    # and a mismatched global shape would otherwise surface as an opaque
+    # broadcast error halfway through a load that already mutated tensors.
+    saved_world = metadata.get("world")
+    if saved_world is not None and int(saved_world) != jax.process_count():
+        raise CheckpointLayoutMismatch(
+            f"{path}: checkpoint was saved by a world of {saved_world} "
+            f"processes but the live process group has "
+            f"{jax.process_count()} — reshard offline or relaunch at the "
+            f"recorded world size")
+    for name, t in state_dict.items():
+        info = metadata["tensors"].get(name)
+        if info is None:
+            continue
+        want = tuple(info["global_shape"])
+        have = tuple(getattr(t._data, "shape", np.shape(t._data)))
+        if want != have:
+            raise CheckpointLayoutMismatch(
+                f"{path}: tensor {name!r} was saved with global shape "
+                f"{list(want)} but the target state_dict expects "
+                f"{list(have)} — the checkpoint's sharding layout does not "
+                f"match the live model")
     fingerprints = metadata.get("files", {})
     archives = {}
     for fname in os.listdir(path):
@@ -238,6 +291,27 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
             except Exception as e:
                 counters.bump("fault.ckpt.corrupt_shard")
                 raise CheckpointCorruptError(f"{full}: unreadable archive: {e}") from e
+    # completeness pre-pass: EVERY shard archive (and member) a loaded
+    # tensor references must be present before the first tensor mutates —
+    # a missing file discovered mid-fill would leave the model half-loaded,
+    # which the recovery ladder's fall-through would then compound by
+    # reporting "nothing restored" over clobbered weights
+    for name, t in state_dict.items():
+        info = metadata["tensors"].get(name)
+        if info is None:
+            continue
+        for shard in info["shards"]:
+            arch = archives.get(shard["file"])
+            if arch is None:
+                counters.bump("fault.ckpt.corrupt_shard")
+                raise CheckpointCorruptError(
+                    f"{path}: shard file {shard['file']!r} for tensor "
+                    f"{name!r} is missing — incomplete checkpoint")
+            if shard["key"] not in arch.files:
+                counters.bump("fault.ckpt.corrupt_shard")
+                raise CheckpointCorruptError(
+                    f"{shard['file']}: member {shard['key']!r} for tensor "
+                    f"{name!r} is missing — incomplete checkpoint")
     for name, t in state_dict.items():
         info = metadata["tensors"].get(name)
         if info is None:
@@ -247,12 +321,7 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
         dt = np.dtype(info["dtype"]) if info["dtype"] != "bfloat16" else ml_dtypes.bfloat16
         full = np.zeros(info["global_shape"], dt)
         for shard in info["shards"]:
-            arch = archives.get(shard["file"])
-            if arch is None:
-                counters.bump("fault.ckpt.corrupt_shard")
-                raise CheckpointCorruptError(
-                    f"{path}: shard file {shard['file']!r} for tensor "
-                    f"{name!r} is missing — incomplete checkpoint")
+            arch = archives[shard["file"]]
             try:
                 block = _from_savable(arch[shard["key"]], np.dtype(dt))
             except Exception as e:  # torn zip member past the directory
@@ -272,3 +341,12 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
         _goodput.note("recovery", dt)
     _registry.histogram("ckpt.load_s").observe(dt)
     return state_dict
+
+
+# multi-tier resilient checkpointing (ISSUE 3): Tier-0 in-memory snapshot
+# ring, Tier-1 peer replication, Tier-2 durable retention/GC, and the
+# recovery ladder. Imported LAST — the submodules use the helpers above.
+from . import recovery, replica, tiers  # noqa: E402,F401
+from .recovery import RecoveryResult, resolve  # noqa: E402,F401
+from .replica import PeerReplicator  # noqa: E402,F401
+from .tiers import CheckpointManager, RetentionPolicy, Snapshot, SnapshotRing  # noqa: E402,F401

@@ -1,0 +1,363 @@
+"""The recovery ladder (ISSUE 3 tentpole): pick the newest VALID source.
+
+``resolve()`` walks the tiers fastest-first and falls through on any
+validation failure — a corrupt candidate costs a fallthrough counter, never
+a half-loaded model:
+
+1. **Tier 0 — local ring**: this process's in-memory snapshots (crc-verified;
+   survives an in-process autoresume attempt, dies with the process).
+2. **Tier 1 — peer replica**: a live peer's published snapshot
+   (``replica.PeerReplicator``; own-rank publications are never candidates).
+3. **Tier 2 — durable**: emergency SIGTERM flushes, then manifest-listed
+   checkpoints newest-first (``tiers.CheckpointManager``), each behind the
+   crc/layout gates of ``load_state_dict`` — a torn shard falls through to
+   the next-oldest valid checkpoint.
+
+Every resolution records first-class recovery telemetry: a
+``recovery.source.<tier>`` counter, the ``recovery.restore_s`` histogram
+(the measured recovery-time objective), a ``recovery.step`` gauge, and
+goodput ``recovery`` badput — so "how long does a preemption cost us, and
+which tier ate it" is a dashboard query, not archaeology.
+
+**Step consistency across ranks**: with a :class:`StepNegotiator` (rank 0's
+TCPStore), ranks agree per tier on the newest step EVERY rank can produce
+(max of the intersection of published available-step sets); a tier where no
+common step exists is skipped by all ranks in lockstep — no rank restores
+step 8 while its neighbor restores step 6.
+
+**Emergency saves**: ``register_emergency_hook`` + ``run_emergency_hooks``
+give the preemption path (``fleet.elastic.GracefulPreemption``) and the
+hang-watchdog's SIGTERM escalation a deadline-bounded, best-effort Tier-0 →
+durable flush (``emergency_flush_hook``). Hooks run in a worker thread and
+are abandoned — never killed mid-write; the atomic commit makes an
+abandoned flush invisible — when the deadline expires, so the grace window
+is honored and Tier 2 is never corrupted.
+"""
+import json
+import os
+import threading
+import time
+
+from ...observability import goodput as _goodput
+from ...observability import tracing as _tracing
+from ...observability import watchdog as _watchdog
+from ...observability.metrics import registry as _registry
+from ...utils.metrics_bus import counters
+
+__all__ = ["RecoveryResult", "resolve", "StepNegotiator",
+           "register_emergency_hook", "unregister_emergency_hook",
+           "run_emergency_hooks", "emergency_flush_hook",
+           "SOURCE_TIER0", "SOURCE_PEER", "SOURCE_DURABLE",
+           "SOURCE_EMERGENCY", "SOURCE_NONE", "EMERGENCY_DEADLINE_ENV"]
+
+SOURCE_TIER0 = "tier0.local"
+SOURCE_PEER = "tier1.peer"
+SOURCE_EMERGENCY = "tier2.emergency"
+SOURCE_DURABLE = "tier2.durable"
+SOURCE_NONE = "none"
+
+EMERGENCY_DEADLINE_ENV = "PADDLE_CKPT_EMERGENCY_DEADLINE_S"
+
+
+class RecoveryResult:
+    """What resolve() found: ``source`` (one of the SOURCE_* labels —
+    truthiness means *something was restored*), ``step`` (None when the
+    source carries no step, e.g. a bare ``durable_path`` load), ``latency_s``
+    (the restore-time objective actually measured), ``fallthroughs``
+    (candidates rejected by validation on the way)."""
+
+    __slots__ = ("step", "source", "latency_s", "fallthroughs")
+
+    def __init__(self, step, source, latency_s, fallthroughs):
+        self.step = step
+        self.source = source
+        self.latency_s = latency_s
+        self.fallthroughs = fallthroughs
+
+    def __bool__(self):
+        return self.source != SOURCE_NONE
+
+    def __repr__(self):
+        return (f"RecoveryResult(step={self.step}, source={self.source!r}, "
+                f"latency_s={self.latency_s:.3f}, "
+                f"fallthroughs={self.fallthroughs})")
+
+
+class StepNegotiator:
+    """Cross-rank agreement on which step to restore, per tier.
+
+    Each rank publishes the sorted list of steps it can produce for the
+    tier; after a barrier, every rank reads every list and takes the newest
+    COMMON step (max of the intersection), or None when the tiers don't
+    overlap — deterministic, and identical on every rank.
+
+    Construct ONE negotiator per recovery episode, on every rank, with the
+    same ``session`` id (e.g. the launcher restart counter or an agreed
+    incarnation token): store keys and barrier names derive from
+    (session, tier tag), so ranks rendezvous by WHAT they are negotiating,
+    never by how many times some long-lived object was called — a retrying
+    rank and a freshly restarted rank always meet at the same keys."""
+
+    def __init__(self, store, rank, world_size, timeout=60, session="0"):
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.timeout = timeout
+        self.session = str(session)
+
+    def agree(self, tag, steps):
+        """Never raises: a negotiation that cannot complete (store outage,
+        barrier timeout because peers already restored from an earlier tier
+        and left resolve()) returns None — this rank falls through locally
+        instead of crashing mid-recovery. Cross-rank source divergence after
+        such a failure is surfaced via ``recovery.negotiate_failed``; the
+        caller's job-level policy (elastic restart) is the backstop."""
+        steps = sorted(int(s) for s in steps)
+        if self.world_size <= 1 or self.store is None:
+            return steps[-1] if steps else None
+        key = f"__ckpt_recover__/{self.session}/{tag}"
+        try:
+            self.store.set(f"{key}/{self.rank}", json.dumps(steps))
+            self.store.barrier(f"ckpt_recover_{self.session}_{tag}",
+                               self.world_size, timeout=self.timeout)
+            common = None
+            for r in range(self.world_size):
+                raw = self.store.get(f"{key}/{r}")
+                theirs = set(json.loads(raw.decode() if isinstance(raw, bytes)
+                                        else str(raw)))
+                common = theirs if common is None else (common & theirs)
+        except Exception:
+            counters.bump("fault.ckpt.negotiate_failed")
+            _registry.counter("recovery.negotiate_failed").inc()
+            return None
+        return max(common) if common else None
+
+
+def _candidate_order(negotiator, tag, steps):
+    """Yield candidate steps to try for one tier, newest first.
+
+    Without a negotiator this is a plain sorted walk. With one, each round
+    agrees on the newest COMMON step; when THIS rank's attempt at the
+    agreed step fails (torn shard — usually shared, so every rank fails it
+    together and stays in lockstep), the step is dropped and the next round
+    renegotiates over what remains, preserving the fall-through-to-older
+    guarantee. If ranks genuinely diverge (one succeeded and left resolve),
+    the next round's barrier times out, agree() returns None, and the tier
+    is abandoned locally — slow, never wedged, never silently divergent."""
+    steps = set(steps)
+    if negotiator is None:
+        for s in sorted(steps, reverse=True):
+            yield s
+        return
+    rnd = 0
+    while steps:
+        agreed = negotiator.agree(f"{tag}.r{rnd}", steps)
+        rnd += 1
+        if agreed is None or agreed not in steps:
+            return
+        yield agreed
+        steps.discard(agreed)  # reaching here means the attempt failed
+
+
+def _record(source, step, t0, fallthroughs):
+    dt = time.perf_counter() - t0
+    label = {SOURCE_TIER0: "tier0", SOURCE_PEER: "tier1",
+             SOURCE_EMERGENCY: "emergency", SOURCE_DURABLE: "tier2",
+             SOURCE_NONE: "none"}[source]
+    _registry.counter(f"recovery.source.{label}").inc()
+    _registry.histogram("recovery.restore_s").observe(dt)
+    if step is not None:
+        _registry.gauge("recovery.step").set(step)
+    if fallthroughs:
+        _registry.counter("recovery.fallthrough").inc(fallthroughs)
+    if _tracing.enabled():
+        _goodput.note("recovery", dt)
+    return RecoveryResult(step, source, dt, fallthroughs)
+
+
+def resolve(state_dict, ring=None, replicator=None, manager=None,
+            durable_path=None, negotiator=None, min_step=0):
+    """Restore ``state_dict`` from the newest valid source; returns a
+    :class:`RecoveryResult` (falsy when no tier could serve — the caller
+    starts fresh). ``min_step`` discards candidates older than a step the
+    caller knows is already durable elsewhere."""
+    t0 = time.perf_counter()
+    _watchdog.note_phase("recovery")
+    fall = 0
+
+    with _tracing.span("recovery.resolve"):
+        # ---- Tier 0: local in-memory ring --------------------------------
+        if ring is not None:
+            snaps = {}
+            for s in ring.newest_first():
+                if s.step >= min_step and s.step not in snaps \
+                        and s.covers(state_dict):
+                    snaps[s.step] = s
+            # crc only the snapshot actually being restored (a ring of
+            # multi-GB states must not pay capacity× full-state crc passes
+            # on the fast path); a failed verify or restore falls through
+            for step in _candidate_order(negotiator, "tier0", set(snaps)):
+                s = snaps[step]
+                try:
+                    if s.verify():
+                        s.restore_into(state_dict)
+                        return _record(SOURCE_TIER0, s.step, t0, fall)
+                except Exception:
+                    pass
+                counters.bump("fault.ckpt.snapshot_corrupt")
+                fall += 1
+
+        # ---- Tier 1: live peer replica -----------------------------------
+        if replicator is not None and replicator.enabled:
+            bad0 = counters.get("fault.ckpt.peer_invalid")
+            candidates = [c for c in replicator.candidates()
+                          if c[0] >= min_step]
+            # publications rejected during enumeration (unreadable/torn in a
+            # directory scan) are fallthroughs too
+            fall += max(0, counters.get("fault.ckpt.peer_invalid") - bad0)
+            # negotiate on advertised steps; fetch only what is attempted —
+            # never pull every peer's full state blob up front
+            by_step = {}
+            for c in candidates:
+                by_step.setdefault(c[0], []).append(c)
+            for step in _candidate_order(negotiator, "tier1", set(by_step)):
+                for cand in by_step[step]:
+                    try:
+                        snap = replicator.fetch(cand)
+                        if not snap.covers(state_dict):
+                            fall += 1
+                            continue
+                        snap.restore_into(state_dict)
+                        return _record(SOURCE_PEER, snap.step, t0, fall)
+                    except Exception:
+                        counters.bump("fault.ckpt.peer_invalid")
+                        fall += 1
+
+        # ---- Tier 2: durable (emergency flushes, then manifest) ----------
+        if manager is not None:
+            from .tiers import Snapshot
+
+            # with partitioned replica groups, another group's emergency
+            # flush is NOT this rank's state — same guard Tier 1 enforces
+            group_ranks = replicator.group_ranks if replicator is not None \
+                else None
+            candidates = [(s, "emergency", p)
+                          for s, p in manager.emergency_snapshots(group_ranks)]
+            candidates += [(s, "durable", None) for s in manager.valid_steps()]
+            candidates = [c for c in candidates if c[0] >= min_step]
+            candidates.sort(key=lambda c: (-c[0], c[1] != "emergency"))
+            by_step = {}
+            for c in candidates:
+                by_step.setdefault(c[0], []).append(c)
+            for agreed in _candidate_order(negotiator, "tier2", set(by_step)):
+                for step, kind, path in by_step[agreed]:
+                    try:
+                        if kind == "emergency":
+                            with open(path, "rb") as f:
+                                snap = Snapshot.from_bytes(f.read())
+                            if not snap.covers(state_dict):
+                                fall += 1
+                                continue
+                            snap.restore_into(state_dict)
+                            return _record(SOURCE_EMERGENCY, step, t0, fall)
+                        manager.load(state_dict, step)
+                        return _record(SOURCE_DURABLE, step, t0, fall)
+                    except Exception:
+                        counters.bump("fault.ckpt.durable_invalid")
+                        fall += 1
+
+        # ---- bare durable path (no manager/manifest) ---------------------
+        if durable_path is not None:
+            from . import load_state_dict
+
+            try:
+                load_state_dict(state_dict, durable_path)
+                return _record(SOURCE_DURABLE, None, t0, fall)
+            except Exception:
+                counters.bump("fault.ckpt.durable_invalid")
+                fall += 1
+
+    return _record(SOURCE_NONE, None, t0, fall)
+
+
+# ---------------------------------------------------------------------------
+# emergency saves (SIGTERM / hang-watchdog escalation)
+# ---------------------------------------------------------------------------
+_EMERGENCY_HOOKS = []
+_EMERGENCY_LOCK = threading.Lock()
+
+
+def register_emergency_hook(fn):
+    """Register a zero-arg callable to run when the process is preempted
+    (``GracefulPreemption.exit_if_requested``) or SIGTERM'd by the hang
+    watchdog. Hooks must be best-effort and atomic-on-disk — they race a
+    SIGKILL."""
+    with _EMERGENCY_LOCK:
+        if fn not in _EMERGENCY_HOOKS:
+            _EMERGENCY_HOOKS.append(fn)
+    return fn
+
+
+def unregister_emergency_hook(fn):
+    with _EMERGENCY_LOCK:
+        if fn in _EMERGENCY_HOOKS:
+            _EMERGENCY_HOOKS.remove(fn)
+
+
+def emergency_flush_hook(ring, manager):
+    """The canonical emergency hook: flush the ring's NEWEST snapshot to the
+    manager's durable root (atomic sibling file — never inside a step_*
+    directory, so Tier 2 cannot be corrupted by a flush that loses the race
+    with SIGKILL). Registers itself; returns the hook for unregistering."""
+
+    def _flush():
+        snap = ring.latest()
+        if snap is not None:
+            manager.save_emergency(snap)
+
+    return register_emergency_hook(_flush)
+
+
+def run_emergency_hooks(deadline_s=None):
+    """Run every registered hook under one shared wall-clock deadline
+    (``PADDLE_CKPT_EMERGENCY_DEADLINE_S``, default 30s — the platform's
+    SIGTERM grace window). Each hook runs in a worker thread joined for the
+    REMAINING budget; an overrunning hook is abandoned (daemon thread, its
+    atomic write either commits or vanishes), and nothing here ever raises
+    — this runs on the way out of a dying process."""
+    with _EMERGENCY_LOCK:
+        hooks = list(_EMERGENCY_HOOKS)
+    if not hooks:
+        return 0
+    if deadline_s is None:
+        try:
+            deadline_s = float(os.environ.get(EMERGENCY_DEADLINE_ENV, "") or 30.0)
+        except ValueError:
+            deadline_s = 30.0
+    t_end = time.perf_counter() + deadline_s
+    ran = 0
+    for fn in hooks:
+        remaining = t_end - time.perf_counter()
+        if remaining <= 0:
+            counters.bump("fault.ckpt.emergency_deadline")
+            break
+        box = []
+
+        def _guard(fn=fn, box=box):
+            try:
+                fn()
+                box.append(True)
+            except Exception:
+                counters.bump("fault.ckpt.emergency_failed")
+
+        t0 = time.perf_counter()
+        th = threading.Thread(target=_guard, daemon=True)
+        th.start()
+        th.join(remaining)
+        if th.is_alive():
+            counters.bump("fault.ckpt.emergency_deadline")
+        elif box:
+            ran += 1
+            _registry.histogram("ckpt.emergency.save_s").observe(
+                time.perf_counter() - t0)
+    return ran

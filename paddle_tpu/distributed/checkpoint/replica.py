@@ -1,0 +1,236 @@
+"""Tier 1: peer replication of Tier-0 snapshots across data-parallel
+replicas (ISSUE 3 tentpole).
+
+Data-parallel replicas hold identical model/optimizer state, so a restarted
+rank does not need storage to recover — any LIVE peer in its replica group
+can serve its own Tier-0 snapshot. Mechanics, built on the two primitives
+the launcher already owns:
+
+- **Publication**: on the snapshot cadence, the first ``degree``
+  (``PADDLE_CKPT_REPLICA_DEGREE``, default 2) ranks of each replica group
+  atomically publish their newest snapshot's byte form to the shared
+  snapshot directory (``PADDLE_CKPT_SNAPSHOT_DIR``, exported per worker by
+  the launcher under ``<log_dir>/telemetry/snapshots``), and register
+  ``{step, crc, pid}`` in the rendezvous TCPStore — which outlives any
+  individual rank, exactly the property peer restore needs.
+- **Resolution**: a restarted rank lists peers' publications (store metas
+  when coordinated, directory scan otherwise), STRICTLY EXCLUDING ITS OWN
+  RANK — its pre-crash publication is the state that just died, not a peer
+  — newest step first, and crc-verifies each candidate before restoring.
+
+The launcher closes the remaining hole: on any rank restart it deletes that
+rank's snapshot file and store meta (controller.py), so a stale publication
+from a dead incarnation can't be served to OTHER ranks either.
+"""
+import json
+import os
+import re
+import time
+
+from ...observability import tracing as _tracing
+from ...observability.metrics import registry as _registry
+from ...testing import chaos
+from ...utils.metrics_bus import counters
+from . import atomic
+from .atomic import atomic_write_bytes
+from .tiers import Snapshot, _env_int
+
+__all__ = ["PeerReplicator", "snapshot_path", "peer_meta_key",
+           "SNAPSHOT_DIR_ENV", "REPLICA_DEGREE_ENV", "REPLICA_GROUP_ENV"]
+
+SNAPSHOT_DIR_ENV = "PADDLE_CKPT_SNAPSHOT_DIR"
+REPLICA_DEGREE_ENV = "PADDLE_CKPT_REPLICA_DEGREE"
+REPLICA_GROUP_ENV = "PADDLE_CKPT_REPLICA_GROUP"
+
+_SNAP_RE = re.compile(r"^snapshot\.(\d+)\.snap$")
+
+
+def snapshot_path(directory, rank):
+    """Canonical publication path for ``rank`` — the launcher's restart
+    cleanup and this module must agree on it."""
+    return os.path.join(directory, f"snapshot.{int(rank)}.snap")
+
+
+def sidecar_path(directory, rank):
+    """Small JSON meta next to the blob ({step, crc32, group, pid}) so
+    candidate enumeration never has to parse a full state payload just to
+    learn its step or replica group."""
+    return os.path.join(directory, f"snapshot.{int(rank)}.meta.json")
+
+
+def peer_meta_key(rank):
+    """TCPStore key carrying ``rank``'s publication meta — shared with the
+    launcher's restart cleanup."""
+    return f"__ckpt0__/{int(rank)}"
+
+
+class PeerReplicator:
+    """Publish this rank's Tier-0 snapshots; fetch live peers' on restart.
+
+    ``degree`` bounds publication traffic: only the ``degree`` lowest ranks
+    of the replica group write (every DP replica holds the same state — one
+    or two durable-ish copies per group is plenty). ``group`` labels ranks
+    whose state is interchangeable (default: one global group, the pure-DP
+    case); only same-group publications are ever candidates. When groups
+    partition the world, pass ``group_ranks`` — the ranks sharing THIS
+    rank's group — so publisher election counts within the group (group
+    membership of other ranks is the caller's knowledge: the training code
+    owns the DP grouping).
+    """
+
+    def __init__(self, directory=None, store=None, rank=None, world_size=None,
+                 degree=None, group=None, group_ranks=None):
+        self.dir = directory if directory is not None else \
+            os.environ.get(SNAPSHOT_DIR_ENV)
+        self.store = store
+        self.rank = rank if rank is not None else _env_int("PADDLE_TRAINER_ID", 0)
+        self.world_size = world_size if world_size is not None else \
+            _env_int("PADDLE_TRAINERS_NUM", 1)
+        self.degree = max(1, degree if degree is not None
+                          else _env_int(REPLICA_DEGREE_ENV, 2))
+        self.group = str(group if group is not None
+                         else os.environ.get(REPLICA_GROUP_ENV, "0"))
+        self.group_ranks = sorted(int(r) for r in group_ranks) \
+            if group_ranks is not None else list(range(self.world_size))
+        if self.rank not in self.group_ranks:
+            raise ValueError(
+                f"rank {self.rank} not in its own group_ranks "
+                f"{self.group_ranks}")
+
+    @property
+    def enabled(self):
+        return self.dir is not None
+
+    @property
+    def is_publisher(self):
+        return self.rank in self.group_ranks[: self.degree]
+
+    # ---- publish -----------------------------------------------------------
+    def publish(self, snapshot, force=False):
+        """Atomically publish ``snapshot`` for peers; no-op for non-publisher
+        ranks (unless forced) and when no snapshot dir is configured.
+        Returns the publication path or None."""
+        if not self.enabled or (not force and not self.is_publisher):
+            return None
+        t0 = time.perf_counter()
+        os.makedirs(self.dir, exist_ok=True)
+        # a previous incarnation of THIS rank SIGKILLed mid-publish left a
+        # pid-suffixed temp; only one incarnation per rank is ever live, so
+        # anything matching our prefix (bar our own in-flight write, which
+        # doesn't exist yet) is reclaimable garbage
+        atomic.sweep_orphan_tmps(self.dir, prefix=f"snapshot.{self.rank}.",
+                                 min_age_s=0)
+        path = snapshot_path(self.dir, self.rank)
+        meta = {"step": snapshot.step, "crc32": snapshot.crc32,
+                "group": self.group, "pid": os.getpid(), "ts": snapshot.ts}
+        with _tracing.span("ckpt.tier1.publish", step=snapshot.step):
+            payload = snapshot.to_bytes()
+            chaos.site("ckpt.peer.publish", path=path)
+            atomic_write_bytes(path, payload)
+            # sidecar commits AFTER the blob: a sidecar always points at a
+            # fully committed payload (a blob without a sidecar is just
+            # invisible to enumeration until the next publish)
+            from .atomic import atomic_write_json
+
+            atomic_write_json(sidecar_path(self.dir, self.rank), meta)
+        if self.store is not None:
+            try:
+                self.store.set(peer_meta_key(self.rank), json.dumps(meta))
+            except Exception:
+                # meta registration is an optimization; the directory scan
+                # still finds the publication
+                counters.bump("fault.ckpt.peer_meta_failed")
+        counters.bump("ckpt.tier1.publishes")
+        _registry.histogram("ckpt.tier1.publish_s").observe(
+            time.perf_counter() - t0)
+        _registry.gauge("ckpt.tier1.publish_bytes").set(len(payload))
+        return path
+
+    def withdraw(self):
+        """Remove this rank's publication (clean shutdown)."""
+        if not self.enabled:
+            return
+        for path in (sidecar_path(self.dir, self.rank),
+                     snapshot_path(self.dir, self.rank)):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        if self.store is not None:
+            try:
+                self.store.delete_key(peer_meta_key(self.rank))
+            except Exception:
+                pass
+
+    # ---- resolve -----------------------------------------------------------
+    def candidates(self):
+        """[(step, rank, path)] of same-group PEER publications (own rank
+        excluded — a restarted rank's pre-crash file is not peer state),
+        newest step first. Enumeration reads only the small metas (store
+        entries or sidecar files), NEVER a state payload — full parse + crc
+        verification happen once, in fetch(), for the chosen candidate."""
+        if not self.enabled:
+            return []
+        out = []
+        if self.store is not None:
+            for r in range(self.world_size):
+                if r == self.rank:
+                    continue
+                try:
+                    if not self.store.check(peer_meta_key(r)):
+                        continue
+                    raw = self.store.get(peer_meta_key(r))
+                    meta = json.loads(raw.decode() if isinstance(raw, bytes)
+                                      else str(raw))
+                except Exception:
+                    continue
+                if meta.get("group") != self.group:
+                    continue
+                out.append((int(meta["step"]), r, snapshot_path(self.dir, r)))
+        else:
+            try:
+                names = os.listdir(self.dir)
+            except OSError:
+                names = []
+            for name in names:
+                m = _SNAP_RE.match(name)
+                if not m or int(m.group(1)) == self.rank:
+                    continue
+                try:
+                    with open(sidecar_path(self.dir, int(m.group(1)))) as f:
+                        meta = json.load(f)
+                except (OSError, ValueError):
+                    # a blob without a readable sidecar is a half-published
+                    # or foreign file — not a candidate
+                    counters.bump("fault.ckpt.peer_invalid")
+                    continue
+                if meta.get("group") != self.group:
+                    continue
+                out.append((int(meta["step"]), int(m.group(1)),
+                            os.path.join(self.dir, name)))
+        out.sort(key=lambda e: (-e[0], e[1]))
+        return out
+
+    def fetch(self, candidate):
+        """Read + crc-verify one candidate ``(step, rank, path)`` →
+        Snapshot. Raises CheckpointCorruptError on a torn/tampered file OR
+        when the payload's step disagrees with the advertised meta (a
+        publisher replaced the blob between meta read and blob read, or
+        died between the two commits) — a negotiated step must never
+        silently restore as a different one."""
+        from . import CheckpointCorruptError
+
+        step, rank, path = candidate
+        chaos.site("ckpt.peer.fetch", path=path)
+        t0 = time.perf_counter()
+        with _tracing.span("ckpt.tier1.fetch", step=step, peer=rank):
+            with open(path, "rb") as f:
+                snap = Snapshot.from_bytes(f.read())
+        if snap.step != step:
+            counters.bump("fault.ckpt.peer_invalid")
+            raise CheckpointCorruptError(
+                f"{path}: advertised step {step} but payload holds step "
+                f"{snap.step} — publication replaced or torn mid-publish")
+        _registry.histogram("ckpt.tier1.fetch_s").observe(
+            time.perf_counter() - t0)
+        return snap

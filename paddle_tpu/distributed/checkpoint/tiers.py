@@ -1,0 +1,573 @@
+"""Checkpoint tiers 0 and 2 (ISSUE 3 tentpole).
+
+**Tier 0 — in-memory snapshot ring.** A per-rank device→host copy of the
+full training state, taken at a step boundary on a configurable cadence
+(``PADDLE_CKPT_SNAPSHOT_EVERY``) and held in a bounded ring
+(``PADDLE_CKPT_SNAPSHOT_KEEP`` slots, ``PADDLE_CKPT_SNAPSHOT_RAM_MB`` RAM
+budget). The train step pays ONLY the host copy + crc — no serialization,
+no filesystem. The payoff is the recovery fast path: a rank that merely
+re-execs (autoresume attempt, driver reset) restores from RAM in
+microseconds, and live peers serve their rings to restarted ranks (Tier 1,
+``replica.py``) so a preemption never touches durable storage at all —
+the in-memory/peer-restore discipline the MPMD scaling and cross-replica
+weight-sharding papers assume.
+
+**Tier 2 — durable retention.** :class:`CheckpointManager` drives the
+existing atomic ``save_state_dict`` into per-step directories under one
+root, commits a ``MANIFEST.json`` of *valid* (fully committed) checkpoints
+LAST, and applies a keep-last-K + keep-every-N retention policy
+(``PADDLE_CKPT_KEEP_LAST`` / ``PADDLE_CKPT_KEEP_EVERY``). GC trusts only
+the manifest: a save that died mid-write never made it in, so the newest
+*valid* checkpoint is structurally un-deletable.
+
+All durable bytes flow through ``atomic.py`` (lint-enforced).
+"""
+import io
+import json
+import os
+import shutil
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from ...observability import tracing as _tracing
+from ...observability.metrics import registry as _registry
+from ...testing import chaos
+from ...utils.metrics_bus import counters
+from . import _from_savable, _to_savable, save_state_dict
+from .atomic import atomic_write_bytes, atomic_write_json, sweep_orphan_tmps
+
+__all__ = ["Snapshot", "SnapshotRing", "RetentionPolicy", "CheckpointManager",
+           "SNAPSHOT_EVERY_ENV", "SNAPSHOT_KEEP_ENV", "SNAPSHOT_RAM_ENV",
+           "KEEP_LAST_ENV", "KEEP_EVERY_ENV"]
+
+SNAPSHOT_EVERY_ENV = "PADDLE_CKPT_SNAPSHOT_EVERY"
+SNAPSHOT_KEEP_ENV = "PADDLE_CKPT_SNAPSHOT_KEEP"
+SNAPSHOT_RAM_ENV = "PADDLE_CKPT_SNAPSHOT_RAM_MB"
+KEEP_LAST_ENV = "PADDLE_CKPT_KEEP_LAST"
+KEEP_EVERY_ENV = "PADDLE_CKPT_KEEP_EVERY"
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _host_copy(arr):
+    """Device→host copy as a contiguous OWNED numpy array (the ONLY blocking
+    work a Tier-0 snapshot does on the training thread). Must be a real copy,
+    never a view: on CPU backends np.asarray(jax_array) aliases the device
+    buffer, and the train step DONATES that buffer to XLA — a view would be
+    silently clobbered by the very next step."""
+    return np.asarray(arr).copy()
+
+
+def _crc_arrays(step, arrays):
+    """Deterministic fingerprint over (step, sorted names, raw bytes) —
+    recomputable after a byte round-trip (ml_dtypes stored as uint views).
+    Feeds each array's buffer to crc32 directly: a tobytes() here would
+    transiently DOUBLE the state's RAM on the snapshot hot path."""
+    crc = zlib.crc32(str(int(step)).encode())
+    for name in sorted(arrays):
+        crc = zlib.crc32(name.encode("utf-8"), crc)
+        crc = zlib.crc32(_to_savable(np.ascontiguousarray(arrays[name])).data,
+                         crc)
+    return crc
+
+
+class Snapshot:
+    """One consistent full-state copy at a step boundary: host arrays +
+    crc32 + provenance. The unit every tier trades in — the ring holds them,
+    peers exchange their byte form, emergency saves flush them to disk."""
+
+    __slots__ = ("step", "arrays", "crc32", "nbytes", "ts", "rank")
+
+    def __init__(self, step, arrays, crc32=None, ts=None, rank=None):
+        self.step = int(step)
+        self.arrays = arrays
+        self.crc32 = _crc_arrays(step, arrays) if crc32 is None else int(crc32)
+        self.nbytes = sum(a.nbytes for a in arrays.values())
+        self.ts = time.time() if ts is None else float(ts)
+        self.rank = int(rank) if rank is not None else _env_int("PADDLE_TRAINER_ID", 0)
+
+    @classmethod
+    def from_state_dict(cls, state_dict, step, rank=None):
+        """Device→host copy of every tensor NOW — training may mutate
+        weights the instant this returns."""
+        arrays = {}
+        for name, t in state_dict.items():
+            arrays[name] = _host_copy(getattr(t, "_data", t))
+        return cls(step, arrays, rank=rank)
+
+    # ---- integrity ---------------------------------------------------------
+    def verify(self):
+        """Recompute the crc — False means bit rot / tampering / a torn
+        byte round-trip. Recovery treats an unverifiable snapshot as absent."""
+        return _crc_arrays(self.step, self.arrays) == self.crc32
+
+    def covers(self, state_dict):
+        return all(name in self.arrays for name in state_dict)
+
+    # ---- byte round-trip (peer exchange, emergency flush) ------------------
+    def to_bytes(self):
+        meta = {"step": self.step, "crc32": self.crc32, "ts": self.ts,
+                "rank": self.rank,
+                "dtypes": {n: str(np.dtype(a.dtype))
+                           for n, a in self.arrays.items()}}
+        blobs = {f"t.{n}": _to_savable(a) for n, a in self.arrays.items()}
+        blobs["__meta__"] = np.frombuffer(
+            json.dumps(meta).encode("utf-8"), np.uint8)
+        buf = io.BytesIO()
+        np.savez(buf, **blobs)
+        return buf.getvalue()
+
+    @staticmethod
+    def peek_meta(path):
+        """Read ONLY the ``__meta__`` zip member of a serialized snapshot
+        ({step, crc32, ts, rank, dtypes}) — enumeration must never pay a
+        full state parse just to learn a candidate's step. Raises on a file
+        torn badly enough to lose the zip directory or the meta member."""
+        z = np.load(path, allow_pickle=False)
+        return json.loads(bytes(z["__meta__"]).decode("utf-8"))
+
+    @classmethod
+    def from_bytes(cls, data):
+        """Deserialize + crc-verify; raises CheckpointCorruptError on any
+        tear so a recovery tier can fall through instead of half-loading."""
+        from . import CheckpointCorruptError
+
+        try:
+            z = np.load(io.BytesIO(data), allow_pickle=False)
+            meta = json.loads(bytes(z["__meta__"]).decode("utf-8"))
+            arrays = {}
+            for key in z.files:
+                if not key.startswith("t."):
+                    continue
+                name = key[2:]
+                dt = meta["dtypes"][name]
+                if dt == "bfloat16":
+                    import ml_dtypes
+
+                    target = np.dtype(ml_dtypes.bfloat16)
+                else:
+                    target = np.dtype(dt)
+                arrays[name] = _from_savable(z[key], target)
+        except CheckpointCorruptError:
+            raise
+        except Exception as e:
+            counters.bump("fault.ckpt.snapshot_corrupt")
+            raise CheckpointCorruptError(f"unreadable snapshot bytes: {e}") from e
+        snap = cls(meta["step"], arrays, crc32=meta["crc32"], ts=meta["ts"],
+                   rank=meta.get("rank"))
+        if not snap.verify():
+            counters.bump("fault.ckpt.snapshot_corrupt")
+            raise CheckpointCorruptError(
+                f"snapshot step {snap.step}: crc mismatch — torn or "
+                f"tampered byte stream")
+        return snap
+
+    # ---- restore -----------------------------------------------------------
+    def restore_into(self, state_dict):
+        """Fill ``state_dict`` tensors in place, device_put-ing each host
+        array back onto the target tensor's CURRENT sharding. Shapes are
+        validated for EVERY key first — a stale snapshot from a differently
+        sized model (matching names, internally consistent crc) must raise
+        CheckpointLayoutMismatch before a single tensor mutates, the same
+        gate load_state_dict applies to durable checkpoints."""
+        import jax
+
+        from . import CheckpointLayoutMismatch
+        from ...framework.core import Tensor
+
+        for name, t in state_dict.items():
+            a = self.arrays.get(name)
+            if a is None:
+                continue
+            data = getattr(t, "_data", None)
+            have = tuple(getattr(data, "shape", np.shape(data)))
+            if tuple(a.shape) != have:
+                raise CheckpointLayoutMismatch(
+                    f"snapshot step {self.step}: tensor {name!r} has shape "
+                    f"{list(a.shape)} but the target expects {list(have)} — "
+                    f"snapshot is from a differently laid-out model")
+        # two phases: place EVERY array on-device first, rebind after — a
+        # device_put failure (OOM, backend error) midway must leave the
+        # model untouched, not a half-restored mix recovery then reports
+        # as "nothing restored"
+        placed = {}
+        for name, t in state_dict.items():
+            a = self.arrays.get(name)
+            if a is None:
+                continue
+            data = getattr(t, "_data", None)
+            target = getattr(data, "sharding", None) if data is not None else None
+            placed[name] = jax.device_put(a, target) if target is not None else a
+        for name, arr in placed.items():
+            state_dict[name].set_value(Tensor(arr))
+        return state_dict
+
+
+class SnapshotRing:
+    """Tier 0: a bounded ring of in-memory snapshots for this rank.
+
+    ``capacity`` slots (default 2) and an optional RAM budget bound memory;
+    eviction drops the oldest but ALWAYS keeps at least one snapshot — an
+    over-budget ring that silently held nothing would defeat the tier.
+    ``maybe_snapshot`` is the train-loop hook: a no-op except every
+    ``every`` steps (0 = disabled), so the hot path carries it for free.
+    """
+
+    def __init__(self, capacity=None, ram_budget_bytes=None, every=None,
+                 rank=None):
+        self.capacity = max(1, capacity if capacity is not None
+                            else _env_int(SNAPSHOT_KEEP_ENV, 2))
+        if ram_budget_bytes is None:
+            mb = _env_int(SNAPSHOT_RAM_ENV, 0)
+            ram_budget_bytes = mb * (1 << 20) if mb > 0 else None
+        self.ram_budget_bytes = ram_budget_bytes
+        self.every = every if every is not None else _env_int(SNAPSHOT_EVERY_ENV, 0)
+        self.rank = rank
+        self._snaps = []  # oldest → newest
+
+    def __len__(self):
+        return len(self._snaps)
+
+    @property
+    def nbytes(self):
+        return sum(s.nbytes for s in self._snaps)
+
+    def maybe_snapshot(self, state_dict, step):
+        """Cadence-gated snapshot; returns the new Snapshot or None.
+        ``state_dict`` may be a zero-arg callable — it is only invoked when
+        the cadence gate passes, so hot loops can defer building the state
+        mapping to the steps that actually snapshot."""
+        if self.every <= 0 or step % self.every != 0:
+            return None
+        if callable(state_dict):
+            state_dict = state_dict()
+        return self.snapshot(state_dict, step)
+
+    def snapshot(self, state_dict, step):
+        t0 = time.perf_counter()
+        chaos.site("ckpt.snapshot")
+        with _tracing.span("ckpt.tier0.snapshot", step=step):
+            snap = Snapshot.from_state_dict(state_dict, step, rank=self.rank)
+        self._snaps.append(snap)
+        self._evict()
+        counters.bump("ckpt.tier0.snapshots")
+        _registry.histogram("ckpt.tier0.snapshot_s").observe(
+            time.perf_counter() - t0)
+        _registry.gauge("ckpt.tier0.ram_bytes").set(self.nbytes)
+        return snap
+
+    def _evict(self):
+        while len(self._snaps) > self.capacity:
+            self._snaps.pop(0)
+        if self.ram_budget_bytes is not None:
+            while len(self._snaps) > 1 and self.nbytes > self.ram_budget_bytes:
+                self._snaps.pop(0)
+
+    def latest(self):
+        return self._snaps[-1] if self._snaps else None
+
+    def newest_first(self):
+        return list(reversed(self._snaps))
+
+    def find(self, step):
+        for s in reversed(self._snaps):
+            if s.step == step:
+                return s
+        return None
+
+    def clear(self):
+        self._snaps = []
+        _registry.gauge("ckpt.tier0.ram_bytes").set(0)
+
+
+class RetentionPolicy:
+    """keep-last-K + keep-every-N over VALID (manifest-committed) steps.
+    ``keep_last`` is clamped to ≥1: the newest valid checkpoint is never
+    GC-eligible, no matter how the policy is configured."""
+
+    def __init__(self, keep_last=None, keep_every=None):
+        self.keep_last = max(1, keep_last if keep_last is not None
+                             else _env_int(KEEP_LAST_ENV, 3))
+        self.keep_every = max(0, keep_every if keep_every is not None
+                              else _env_int(KEEP_EVERY_ENV, 0))
+
+    def retained(self, steps):
+        """Subset of ``steps`` (any order) the policy keeps."""
+        steps = sorted(set(int(s) for s in steps))
+        keep = set(steps[-self.keep_last:])
+        if self.keep_every:
+            keep.update(s for s in steps if s % self.keep_every == 0)
+        return keep
+
+
+class CheckpointManager:
+    """Tier 2: durable per-step checkpoints under ``root`` with a manifest
+    of valid checkpoints and retention-driven GC.
+
+    Layout::
+
+        root/
+          MANIFEST.json              # [{"step": N, "dir": "step_0000000N", ...}]
+          step_0000000N/             # one atomic save_state_dict checkpoint
+          emergency.rank<r>.snap     # SIGTERM Tier-0 flushes (recovery.py)
+
+    The manifest commits atomically AFTER the checkpoint's own commit — a
+    manager killed between the two leaves a valid-but-unlisted directory
+    that GC treats as garbage, never a listed-but-torn one.
+    """
+
+    MANIFEST = "MANIFEST.json"
+
+    def __init__(self, root, policy=None):
+        self.root = str(root)
+        self.policy = policy if policy is not None else RetentionPolicy()
+        os.makedirs(self.root, exist_ok=True)
+        self._pending_async = None  # (handle, step) awaiting manifest commit
+        # claims of _pending_async must be atomic: a training thread's next
+        # save() and a monitor thread's handle.wait() racing the claim
+        # would both run the manifest commit + GC
+        self._async_lock = threading.Lock()
+
+    # ---- paths -------------------------------------------------------------
+    def step_dir(self, step):
+        return os.path.join(self.root, f"step_{int(step):08d}")
+
+    def _manifest_path(self):
+        return os.path.join(self.root, self.MANIFEST)
+
+    # ---- manifest ----------------------------------------------------------
+    def manifest(self):
+        try:
+            with open(self._manifest_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {"checkpoints": []}
+
+    def valid_steps(self):
+        """Manifest-listed steps whose directory still holds a committed
+        metadata.json, newest first."""
+        out = []
+        for ent in self.manifest().get("checkpoints", []):
+            d = os.path.join(self.root, ent["dir"])
+            if os.path.exists(os.path.join(d, "metadata.json")):
+                out.append(int(ent["step"]))
+        return sorted(set(out), reverse=True)
+
+    @staticmethod
+    def _is_coordinator():
+        """Manifest commits and GC are single-writer operations: only the
+        coordinator process mutates them (save_state_dict already gates
+        metadata.json the same way); every rank may read."""
+        import jax
+
+        return jax.process_index() == 0
+
+    def _commit_manifest(self, step):
+        if not self._is_coordinator():
+            return
+        m = self.manifest()
+        ents = [e for e in m.get("checkpoints", []) if e["step"] != int(step)]
+        ents.append({"step": int(step),
+                     "dir": os.path.basename(self.step_dir(step)),
+                     "ts": time.time()})
+        ents.sort(key=lambda e: e["step"])
+        atomic_write_json(self._manifest_path(), {"checkpoints": ents})
+
+    # ---- save / load -------------------------------------------------------
+    def save(self, state_dict, step, async_save=False):
+        """Durable save of ``state_dict`` at ``step``; manifest + GC run
+        after the data commit (for async, on wait() or the next save)."""
+        self._drain_async()
+        d = self.step_dir(step)
+        handle = save_state_dict(state_dict, d, async_save=async_save)
+        if async_save:
+            with self._async_lock:
+                self._pending_async = (handle, int(step))
+            return _ManagedAsyncHandle(self, handle, int(step))
+        self._commit_manifest(step)
+        self.gc()
+        return None
+
+    def _claim_pending(self, handle=None):
+        """Atomically take ownership of the pending async save (optionally
+        only if it is ``handle``); exactly one thread gets to commit."""
+        with self._async_lock:
+            pending = self._pending_async
+            if pending is None or (handle is not None
+                                   and pending[0] is not handle):
+                return None
+            self._pending_async = None
+            return pending
+
+    def _drain_async(self):
+        pending = self._claim_pending()
+        if pending is None:
+            return
+        handle, step = pending
+        handle.wait()  # raises a background failure instead of queueing more
+        if handle.error() is not None:
+            # the failure was already surfaced via an earlier wait(): the
+            # dead save must STILL never reach the manifest
+            return
+        self._commit_manifest(step)
+        self.gc()
+
+    def load(self, state_dict, step=None):
+        from . import load_state_dict
+
+        if step is None:
+            steps = self.valid_steps()
+            if not steps:
+                from . import CheckpointCorruptError
+
+                raise CheckpointCorruptError(
+                    f"{self.root}: no valid checkpoints in manifest")
+            step = steps[0]
+        load_state_dict(state_dict, self.step_dir(step))
+        return step
+
+    # ---- retention ---------------------------------------------------------
+    def gc(self):
+        """Delete unretained checkpoint directories. Scope rules: only
+        manifest-listed VALID steps are policy input (so the newest valid
+        checkpoint survives any number of failed later saves), and only
+        step_* directories are touched. Deletion removes the manifest entry
+        FIRST — a GC killed mid-rmtree leaves an unlisted dir, not a listed
+        half-dir. Coordinator-only, like every manifest mutation."""
+        if not self._is_coordinator():
+            return []
+        valid = self.valid_steps()
+        if not valid:
+            return []
+        keep = self.policy.retained(valid)
+        drop = [s for s in valid if s not in keep]
+        # orphans: step_* dirs absent from the manifest are torn saves (the
+        # writer died between data commit and manifest commit, or mid-write)
+        # — garbage, except a still-in-flight async save's dir
+        pending = self._pending_async[1] if self._pending_async else None
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            names = []
+        for name in names:
+            if not name.startswith("step_") or not os.path.isdir(
+                    os.path.join(self.root, name)):
+                continue
+            try:
+                s = int(name[len("step_"):])
+            except ValueError:
+                continue
+            if s not in valid and s != pending and s not in drop:
+                drop.append(s)
+        if drop:
+            m = self.manifest()
+            m["checkpoints"] = [e for e in m.get("checkpoints", [])
+                                if e["step"] not in drop]
+            atomic_write_json(self._manifest_path(), m)
+        deleted = []
+        for s in drop:
+            try:
+                # an injected or real GC failure must not fail the save that
+                # triggered it — the manifest entry is already gone, so a
+                # later GC pass retries the orphaned directory
+                chaos.site("ckpt.gc", path=self.step_dir(s))
+                shutil.rmtree(self.step_dir(s))
+                deleted.append(s)
+                counters.bump("ckpt.gc.deleted")
+            except (OSError, ConnectionError):
+                counters.bump("fault.ckpt.gc_failed")
+        # emergency flushes superseded by durable checkpoints are reclaimed
+        # here — otherwise every incident leaks a full-state blob per rank
+        # forever. Threshold is the SECOND-newest manifest step: "valid"
+        # means listed, not crc-verified, so if the newest committed
+        # checkpoint later turns out torn, an emergency flush newer than
+        # the (older, attested-by-survival) fallback must still exist.
+        if len(valid) >= 2:
+            threshold = sorted(valid)[-2]
+            for step, path in self.emergency_snapshots():
+                if step <= threshold:
+                    try:
+                        os.remove(path)
+                        counters.bump("ckpt.gc.emergency_deleted")
+                    except OSError:
+                        pass
+        # SIGKILLed writers leave pid-suffixed temp litter no finally-block
+        # ever cleaned (manifest/emergency temps at the root)
+        sweep_orphan_tmps(self.root)
+        return deleted
+
+    # ---- emergency flush target (see recovery.py) --------------------------
+    def emergency_path(self, rank=None):
+        r = rank if rank is not None else _env_int("PADDLE_TRAINER_ID", 0)
+        return os.path.join(self.root, f"emergency.rank{int(r)}.snap")
+
+    def save_emergency(self, snapshot):
+        """Atomically flush one Tier-0 snapshot to durable storage. Writes a
+        sibling file — NEVER into a step_* directory — so a half-finished
+        emergency flush cannot corrupt Tier 2."""
+        path = self.emergency_path(snapshot.rank)
+        chaos.site("ckpt.emergency", path=path)
+        atomic_write_bytes(path, snapshot.to_bytes())
+        counters.bump("ckpt.emergency.saves")
+        return path
+
+    def emergency_snapshots(self, ranks=None):
+        """[(step, path)] of enumerable emergency flushes, newest step
+        first. Only the small ``__meta__`` member is read here (full parse
+        + crc verification happen at restore time); files torn badly enough
+        to lose even the meta lost the race with SIGKILL and are skipped.
+        ``ranks`` restricts to flushes FROM those ranks — with partitioned
+        replica groups, only same-group state is interchangeable, so
+        resolve() passes the replicator's group_ranks."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return out
+        ranks = None if ranks is None else {int(r) for r in ranks}
+        for name in names:
+            if not (name.startswith("emergency.") and name.endswith(".snap")):
+                continue
+            path = os.path.join(self.root, name)
+            try:
+                meta = Snapshot.peek_meta(path)
+            except Exception:
+                counters.bump("fault.ckpt.emergency_unreadable")
+                continue
+            if ranks is not None and int(meta.get("rank", -1)) not in ranks:
+                continue
+            out.append((int(meta["step"]), path))
+        out.sort(key=lambda e: e[0], reverse=True)
+        return out
+
+
+class _ManagedAsyncHandle:
+    """Wraps an _AsyncSaveHandle so wait() also commits the manifest + GC —
+    the manifest must never list a checkpoint whose data write is still in
+    flight (or dead)."""
+
+    def __init__(self, manager, handle, step):
+        self._manager = manager
+        self._handle = handle
+        self._step = step
+
+    def wait(self, timeout=None):
+        self._handle.wait(timeout)
+        if self._manager._claim_pending(self._handle) is not None \
+                and self._handle.error() is None:
+            self._manager._commit_manifest(self._step)
+            self._manager.gc()
+
+    def done(self):
+        return self._handle.done()
+
+    def error(self):
+        return self._handle.error()

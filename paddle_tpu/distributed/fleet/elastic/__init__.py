@@ -69,9 +69,20 @@ class GracefulPreemption:
 
     def exit_if_requested(self, exit_code=PREEMPTED_EXIT_CODE):
         """Call right after a checkpoint commit. Exits the process with the
-        preemption code so the watch loop restarts it to resume."""
+        preemption code so the watch loop restarts it to resume.
+
+        Before exiting, any registered emergency hooks run under the
+        SIGTERM grace deadline (checkpoint/recovery.py): a Tier-0 snapshot
+        flushes to durable storage best-effort — atomically, so losing the
+        race with SIGKILL can never corrupt Tier 2."""
         if not self._flag.is_set():
             return
+        from ...checkpoint import recovery as _ckpt_recovery
+
+        try:
+            _ckpt_recovery.run_emergency_hooks()
+        except Exception:  # noqa: BLE001 — a dying process must still die cleanly
+            pass
         counters.bump("fault.preempted_exit")
         sys.exit(exit_code)
 

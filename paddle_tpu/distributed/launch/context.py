@@ -46,6 +46,13 @@ def build_parser():
                         "watchdog dumps all-rank stacks + last spans to "
                         "<log_dir>/telemetry/hang_report.json (0 = off; env "
                         "PADDLE_HANG_DEADLINE_S sets the default)")
+    p.add_argument("--hang_preempt", action="store_true",
+                   default=bool(os.environ.get("PADDLE_HANG_PREEMPT")),
+                   help="after the hang watchdog commits its diagnosis, "
+                        "SIGTERM the stalled ranks so their preemption "
+                        "handlers emergency-flush Tier-0 snapshots and the "
+                        "watch loop restarts them into the checkpoint "
+                        "recovery ladder (requires --hang_deadline > 0)")
     p.add_argument("--run_mode", default="collective")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)

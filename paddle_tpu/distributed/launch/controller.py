@@ -29,6 +29,45 @@ class CollectiveController:
         # shared telemetry dir: workers drop heartbeat/spans/stack files
         # here; the hang watchdog (watch loop) monitors them
         self.telemetry_dir = os.path.join(ctx.args.log_dir, "telemetry")
+        # shared Tier-0/Tier-1 snapshot exchange dir (checkpoint/replica.py):
+        # ranks publish in-memory snapshots here so restarted peers can
+        # restore without touching durable storage
+        self.snapshot_dir = os.path.join(self.telemetry_dir, "snapshots")
+
+    def _clean_stale_worker_state(self, rank=None):
+        """Delete snapshot publications + heartbeat leftovers from a dead
+        incarnation — for one rank (restart path) or, at job start with a
+        reused log_dir, for every rank THIS node owns. A restarted rank
+        MUST NOT find its own pre-crash snapshot served back to it (or to
+        peers) as live "peer" state, and a stale heartbeat must not
+        masquerade as a live rank. Ownership-scoped on purpose: on a shared
+        snapshot dir, a slow-starting node must never wipe publications
+        another node's already-running workers just made."""
+        from ..checkpoint import replica as _replica
+
+        if rank is not None:
+            ranks = [rank]  # targeted restart scrub: that rank is dead
+        else:
+            base = self.node_rank * self.ctx.nproc
+            ranks = range(base, base + self.ctx.nproc)
+        from ..checkpoint.atomic import sweep_orphan_tmps
+
+        for r in ranks:
+            for path in (heartbeat_path(self.telemetry_dir, r),
+                         _replica.snapshot_path(self.snapshot_dir, r),
+                         _replica.sidecar_path(self.snapshot_dir, r)):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            # dead incarnations' half-written publications too
+            sweep_orphan_tmps(self.snapshot_dir, prefix=f"snapshot.{r}.",
+                              min_age_s=0)
+            if self.store is not None:
+                try:
+                    self.store.delete_key(_replica.peer_meta_key(r))
+                except Exception:
+                    pass
 
     # ---- rendezvous ----
     def build_store(self):
@@ -111,6 +150,10 @@ class CollectiveController:
                 "MASTER_ADDR": self.ctx.master_host,
                 "MASTER_PORT": str(self.ctx.master_port),
                 "PADDLE_PS_AUTHKEY": ps_authkey,
+                # Tier-1 peer-snapshot exchange dir (checkpoint/replica.py).
+                # Harmless when snapshots are off — nothing writes there
+                # until a SnapshotRing/PeerReplicator is armed.
+                "PADDLE_CKPT_SNAPSHOT_DIR": self.snapshot_dir,
             }
             # observability contract: train loops heartbeat + stream spans
             # here (watchdog.maybe_beat / tracing autoconfigure). Exported
@@ -148,9 +191,17 @@ class CollectiveController:
         watchdog = None
         deadline = getattr(args, "hang_deadline", 0) or 0
         if deadline > 0:
+            import signal as _signal
+
             os.makedirs(self.telemetry_dir, exist_ok=True)
+            # --hang_preempt: after the diagnosis commits, SIGTERM the
+            # stalled ranks — their preemption handlers run the emergency
+            # Tier-0 flush, exit PREEMPTED, and the watch loop restarts
+            # them into the recovery ladder
+            preempt = getattr(args, "hang_preempt", False)
             watchdog = HangWatchdog(
                 self.telemetry_dir, deadline,
+                signal_stalled=_signal.SIGTERM if preempt else None,
                 on_hang=lambda p: print(
                     f"[paddle_tpu.launch] rank heartbeat stalled past "
                     f"{deadline}s; diagnosis written to {p}", file=sys.stderr),
@@ -187,15 +238,14 @@ class CollectiveController:
                 for c in to_restart:
                     total_restarts += 1
                     counters.bump("fault.launch_restart")
-                    # drop the dead incarnation's heartbeat: the restarted
-                    # rank re-registers when it beats again, so rendezvous +
-                    # recompile time cannot read as a hang to the watchdog
+                    # drop the dead incarnation's heartbeat (rendezvous +
+                    # recompile time cannot read as a hang to the watchdog)
+                    # AND its Tier-0 snapshot publication + store meta — the
+                    # restarted rank resolves PEER state, never its own
+                    # pre-crash snapshot
                     rank = c.env.get("PADDLE_TRAINER_ID")
                     if rank is not None:
-                        try:
-                            os.remove(heartbeat_path(self.telemetry_dir, rank))
-                        except OSError:
-                            pass
+                        self._clean_stale_worker_state(int(rank))
                     c.close_log()
                     c.start()
             time.sleep(0.3)
@@ -203,6 +253,9 @@ class CollectiveController:
     def run(self):
         self.build_store()
         self.rendezvous()
+        # a reused log_dir may hold a DEAD incarnation's heartbeats and
+        # snapshot publications; scrub before any worker can resolve them
+        self._clean_stale_worker_state()
         pod = self.build_pod()
         pod.deploy()
         try:

@@ -17,7 +17,11 @@ Two halves, meeting in a shared telemetry directory
   with SIGUSR1 for a fresh stack dump, (2) collects each rank's stack file
   and the tail of its span JSONL (what the rank was doing), and (3) commits
   one ``hang_report.json`` — all-rank stacks + last-N spans + heartbeat
-  ages — before the launcher acts. Fires at most once.
+  ages — before the launcher acts. Diagnostic mode fires at most once; with
+  ``signal_stalled`` set (launcher ``--hang_preempt``) it additionally
+  SIGTERMs stalled ranks (emergency-save + preempted exit), SIGKILLs any
+  still wedged after ``kill_grace_s``, and re-arms to catch the NEXT hang
+  of the restarted job.
 """
 import json
 import os
@@ -198,7 +202,8 @@ class HangWatchdog:
 
     def __init__(self, directory, deadline_s, interval_s=None, on_hang=None,
                  last_n_spans=32, signal_grace_s=0.75,
-                 startup_deadline_s=None):
+                 startup_deadline_s=None, signal_stalled=None,
+                 kill_grace_s=30.0):
         self.dir = directory
         self.deadline_s = float(deadline_s)
         # ranks that have only init-beaten (step=None: still in rendezvous /
@@ -212,6 +217,18 @@ class HangWatchdog:
         self.on_hang = on_hang
         self.last_n_spans = int(last_n_spans)
         self.signal_grace_s = float(signal_grace_s)
+        # optional escalation AFTER the diagnosis is safely committed: send
+        # this signal (typically SIGTERM) to each STALLED rank, so its
+        # GracefulPreemption handler runs the emergency-save hooks
+        # (checkpoint/recovery.py — Tier-0 flush to durable under the grace
+        # deadline) and exits PREEMPTED, letting the launcher restart it
+        # into the recovery ladder. A rank wedged too hard to ever reach a
+        # checkpoint boundary (stuck inside a native collective) consumes
+        # neither the flag nor the flush — so after kill_grace_s a
+        # still-alive stalled pid is SIGKILLed: the launcher then restarts
+        # the crash and recovery resolves from a peer or durable tier.
+        self.signal_stalled = signal_stalled
+        self.kill_grace_s = float(kill_grace_s)
         self.report_path = os.path.join(directory, REPORT_NAME)
         self.fired = threading.Event()
         self._stop = threading.Event()
@@ -241,7 +258,17 @@ class HangWatchdog:
         while not self._stop.is_set():
             try:
                 if self.scan_once():
-                    return  # fire once; the report is the product
+                    if self.signal_stalled is None:
+                        return  # diagnostic mode: fire once, the report IS
+                        # the product
+                    # escalation mode keeps watching: the preempted/killed
+                    # ranks restart and may hang AGAIN — re-arm with a fresh
+                    # leash (restarted ranks get the full startup deadline;
+                    # the launcher deleted their heartbeats on restart). The
+                    # leash starts AFTER the kill grace window, so a rank
+                    # still dying under SIGTERM→SIGKILL is not re-diagnosed,
+                    # re-signaled, and re-reaped every deadline tick.
+                    self._start_time = time.time() + self.kill_grace_s
             except Exception:
                 pass  # a watchdog crash must never take the launcher down
             self._stop.wait(self.interval_s)
@@ -324,6 +351,37 @@ class HangWatchdog:
         from .metrics import registry
 
         registry.counter("fault.watchdog.hang").inc()
+        if self.signal_stalled is not None:
+            pids = []
+            for r in stalled:
+                pid = hbs.get(r, {}).get("pid")
+                if not pid:
+                    continue
+                try:
+                    os.kill(pid, self.signal_stalled)
+                    registry.counter("fault.watchdog.preempt").inc()
+                    pids.append(pid)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+            if pids:
+                # escalation backstop for ranks too wedged to honor the
+                # preemption flag: still alive after the grace window →
+                # SIGKILL, so the launcher's restart + recovery ladder take
+                # over instead of the job staying hung forever
+                def _reap(pids=pids):
+                    time.sleep(self.kill_grace_s)
+                    for pid in pids:
+                        if _pid_alive(pid):
+                            try:
+                                os.kill(pid, signal.SIGKILL)
+                                registry.counter(
+                                    "fault.watchdog.killed").inc()
+                            except (ProcessLookupError, PermissionError,
+                                    OSError):
+                                pass
+
+                threading.Thread(target=_reap, daemon=True,
+                                 name="paddle-hang-reaper").start()
         self.fired.set()
         if self.on_hang is not None:
             try:

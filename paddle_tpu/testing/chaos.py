@@ -30,6 +30,11 @@ ps.call                   distributed/ps/service.py PsClient._call
 rpc.invoke                distributed/rpc/rpc.py _invoke
 ckpt.write                distributed/checkpoint save (per-shard data write)
 ckpt.manifest             distributed/checkpoint metadata commit
+ckpt.snapshot             checkpoint/tiers.py Tier-0 ring snapshot
+ckpt.gc                   checkpoint/tiers.py retention GC, per deletion
+ckpt.emergency            checkpoint/tiers.py SIGTERM Tier-0→durable flush
+ckpt.peer.publish         checkpoint/replica.py Tier-1 snapshot publication
+ckpt.peer.fetch           checkpoint/replica.py Tier-1 peer snapshot fetch
 save.write                serialization.save (single-process checkpoints)
 launch.watch              distributed/launch/controller.py watch tick
 dataloader.worker         io/dataloader.py forked worker, per batch

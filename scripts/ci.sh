@@ -48,6 +48,21 @@ if grep -nE '\btime\.time\(|(^|[^.[:alnum:]_])print\(' "${HOT_PATHS[@]}"; then
   exit 1
 fi
 
+# checkpoint atomic-commit lint (ISSUE 3 satellite): every byte written into
+# a checkpoint directory must flow through checkpoint/atomic.py (temp+fsync+
+# rename) — a raw write-mode open() anywhere else in the checkpoint package
+# is a torn-file bug waiting for a preemption. The ckpt-atomic-ok marker is
+# the allowlist (the helper itself).
+# the mode may appear anywhere after open( — `open(os.path.join(d, "x"),
+# "wb")` has a ')' before the mode, so match the quoted mode token itself,
+# not "first argument then mode"
+if grep -nE 'open\(.*["'\''](w|wb|a|ab|x|xb|r\+|rb\+|w\+|wb\+|a\+|ab\+)["'\'']' \
+     paddle_tpu/distributed/checkpoint/*.py | grep -v 'ckpt-atomic-ok'; then
+  echo "lint: raw write-mode open() in the checkpoint package above —" \
+       "all checkpoint-directory writes go through checkpoint/atomic.py" >&2
+  exit 1
+fi
+
 ARGS=(-q -p no:cacheprovider)
 
 # fast tier: the seams where an untested change does the most damage —
@@ -57,6 +72,7 @@ ARGS=(-q -p no:cacheprovider)
 FAST_TESTS=(
   tests/test_chaos.py
   tests/test_telemetry.py
+  tests/test_checkpoint_tiers.py
   tests/test_launch.py
   tests/test_ps_mode.py
   tests/test_dist_checkpoint.py

@@ -2,7 +2,12 @@
 distributed/checkpoint save/load + fleet autoresume — SURVEY §5
 checkpoint/resume tiers): training N steps straight must equal training
 N/2, saving the FULL state (params + optimizer pytree) via the distributed
-checkpoint, rebuilding from scratch, loading, and training N/2 more."""
+checkpoint, rebuilding from scratch, loading, and training N/2 more.
+
+ISSUE 3 extends this to the multi-tier recovery ladder: resume through each
+tier — the Tier-0 in-memory ring, a Tier-1 peer publication, and the
+Tier-2 durable manager (through a torn-newest-shard fallthrough) — must be
+BIT-exact vs the uninterrupted run."""
 import tempfile
 
 import jax
@@ -10,10 +15,12 @@ import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu import optimizer
+from paddle_tpu.distributed import checkpoint as ckpt
 from paddle_tpu.distributed import mesh as M
 from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
 from paddle_tpu.distributed.train_step import DistributedTrainStep
 from paddle_tpu.models.llama import LlamaForCausalLMPipe, llama_tiny
+from paddle_tpu.testing import chaos
 
 
 def _batches(n, seed=0):
@@ -78,3 +85,82 @@ def test_resume_equals_uninterrupted():
         for k in ref
     )
     assert worst < 1e-5, f"resume diverged: worst param delta {worst:.3e}"
+
+
+def test_every_recovery_tier_resumes_bit_exact(tmp_path):
+    """The chaos-kill resume contract, per tier: train 6 steps straight;
+    separately train 3, capture that state into every tier (ring snapshot,
+    peer publication, durable checkpoints — the newest durable then torn by
+    injected truncation), "kill" the trainer (fresh build = dead process),
+    resolve from each tier in turn, and finish the remaining 3 steps. The
+    restored state and the final parameters must equal the uninterrupted
+    run BIT-exactly, with recovery source + restore latency recorded."""
+    chaos.disarm()
+    m = M.build_mesh(pp=2, mp=2, sharding=2)
+    n_total, n_half = 6, 3
+    with M.mesh_guard(m):
+        model, step = _build()
+        for x, y in _batches(n_total):
+            step(paddle.to_tensor(x), paddle.to_tensor(y))
+        ref = {k: np.asarray(v._data)
+               for k, v in dict(model.named_parameters()).items()}
+
+        # -- the "victim" run: 3 steps, state fanned out to every tier ----
+        model2, step2 = _build()
+        it = _batches(n_total)
+        for _ in range(n_half):
+            x, y = next(it)
+            step2(paddle.to_tensor(x), paddle.to_tensor(y))
+        at_half = {k: np.asarray(v._data)
+                   for k, v in dict(model2.named_parameters()).items()}
+        full2 = step2.full_state_dict()
+        ring = ckpt.SnapshotRing(capacity=2)
+        snap = ring.snapshot(full2, n_half)
+        peer_dir = str(tmp_path / "peers")
+        ckpt.PeerReplicator(directory=peer_dir, rank=0,
+                            world_size=2).publish(snap, force=True)
+        mgr = ckpt.CheckpointManager(str(tmp_path / "durable"),
+                                     ckpt.RetentionPolicy(keep_last=3))
+        mgr.save(full2, n_half)
+        # two more steps, then a save whose shard write is torn mid-flight:
+        # the manifest lists it, but the crc gate must reject it at resolve
+        # time and fall through to the step-3 checkpoint
+        for _ in range(2):
+            x, y = next(it)
+            step2(paddle.to_tensor(x), paddle.to_tensor(y))
+        with chaos.FaultPlan().truncate("ckpt.write", keep_bytes=64):
+            mgr.save(step2.full_state_dict(), n_half + 2)
+
+        # -- resume through each tier ------------------------------------
+        sources = []
+        for tier_kw, want_source, want_fall in (
+                ({"ring": ring}, "tier0.local", 0),
+                ({"replicator": ckpt.PeerReplicator(
+                    directory=peer_dir, rank=1, world_size=2)},
+                 "tier1.peer", 0),
+                ({"manager": mgr}, "tier2.durable", 1)):
+            model3, step3 = _build()
+            sd3 = step3.full_state_dict()
+            res = ckpt.resolve(sd3, **tier_kw)
+            assert res.source == want_source and res.step == n_half
+            assert res.fallthroughs >= want_fall and res.latency_s >= 0
+            step3.load_full_state_dict(sd3, step=res.step)
+            restored = {k: np.asarray(v._data)
+                        for k, v in dict(model3.named_parameters()).items()}
+            for k in at_half:  # the restore itself is bit-exact
+                np.testing.assert_array_equal(restored[k], at_half[k])
+            it3 = _batches(n_total)
+            for _ in range(n_half):  # already-trained batches
+                next(it3)
+            for _ in range(n_total - n_half):
+                x, y = next(it3)
+                step3(paddle.to_tensor(x), paddle.to_tensor(y))
+            out = {k: np.asarray(v._data)
+                   for k, v in dict(model3.named_parameters()).items()}
+            for k in ref:  # and so is the finished run
+                np.testing.assert_array_equal(out[k], ref[k])
+            sources.append(res.source)
+    assert sources == ["tier0.local", "tier1.peer", "tier2.durable"]
+    from paddle_tpu.observability.metrics import registry
+
+    assert registry.histogram("recovery.restore_s").count >= 3
