@@ -69,6 +69,7 @@ from . import linalg as _linalg_ns
 from . import fft
 from . import signal
 from . import inference
+from . import serving
 from . import static
 from .serialization import load, save
 

@@ -25,6 +25,7 @@ of co-scheduling). kv_cache_dtype="int8" switches the pool to the
 QuantizedTensor layout the Pallas kernel consumes natively.
 """
 import math
+import threading
 import time
 from collections import deque
 
@@ -59,11 +60,195 @@ _M_REQUESTS = _registry.counter("serve.requests")
 _M_PREFIX_HIT = _registry.counter("serve.prefix.hit_pages")
 _M_PREFIX_LOOKUP = _registry.counter("serve.prefix.lookup_pages")
 
-# one module-level jitted key builder (jit cache survives across serve()
-# calls): key[slot] = fold_in(fold_in(base, request_id), token_index)
-_KEYS_FN = jax.jit(jax.vmap(
-    lambda base, r, i: jax.random.fold_in(jax.random.fold_in(base, r), i),
-    in_axes=(None, 0, 0)))
+# one module-level jitted block-decode key builder (jit cache survives
+# across serve() calls) over PER-REQUEST key bases (online mode admits
+# requests with different seeds into one batch): bases [max_seqs, 2],
+# idxs [k, max_seqs] -> keys [k, max_seqs, 2]. fold_in(fold_in(base, rid), i)
+# == fold_in(key_base, i) with key_base = fold_in(base, rid), so the sampled
+# streams are bit-identical to the pre-online single-seed
+# fold_in(fold_in(seed_key, rid), i) scheme.
+_KEYS_FROM_BASE = jax.jit(jax.vmap(
+    jax.vmap(lambda kb, i: jax.random.fold_in(kb, i), in_axes=(0, 0)),
+    in_axes=(None, 0)))
+
+class _StampedRLock:
+    """RLock that remembers WHEN its current outermost hold began.
+
+    The serving monitor needs to tell apart two reasons a dispatcher's
+    heartbeat goes stale while the process-wide dispatch lock is busy:
+    the holder is legitimately inside a long first-compile (every other
+    dispatcher queues behind it — nobody is dead), or the holder is wedged
+    in a hung device call (nothing will ever progress — the stale replicas
+    ARE dead and their work must relocate). A bare try-acquire can't
+    distinguish them; the hold-start timestamp can: a hold younger than
+    the hang deadline reads as compiling, older reads as wedged.
+
+    It also tracks WHO participates — the holder's thread ident and the
+    idents blocked in acquire() — so the monitor only credits the lock for
+    a replica's silence when that replica's dispatcher is actually the
+    holder or a waiter. A dispatcher wedged somewhere ELSE (post-lock host
+    sync, a blocking user callback) must not ride out its death verdict on
+    other threads' healthy compiles."""
+
+    __slots__ = ("_lock", "_depth", "_since", "_holder", "_waiters")
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._depth = 0
+        self._since = None  # monotonic start of the current outermost hold
+        self._holder = None   # thread ident of the current holder
+        self._waiters = set()  # thread idents blocked in acquire()
+
+    def acquire(self, blocking=True, timeout=-1):
+        me = threading.get_ident()
+        if blocking and self._holder != me:  # a reentrant acquire can't block
+            self._waiters.add(me)  # set ops are atomic under the GIL
+            try:
+                # holder bookkeeping runs INSIDE the waiter window: a gap
+                # where the winning thread is neither waiter nor holder
+                # would let the monitor sample participants() in between
+                # and kill a healthy replica that just won the lock
+                return self._acquired(me, self._lock.acquire(blocking,
+                                                             timeout))
+            finally:
+                self._waiters.discard(me)
+        return self._acquired(me, self._lock.acquire(blocking, timeout))
+
+    def _acquired(self, me, ok):
+        if ok:
+            self._depth += 1
+            if self._depth == 1:
+                self._since = time.monotonic()
+                self._holder = me
+        return ok
+
+    def release(self):
+        # fields mutate only while the lock is held (single writer); the
+        # monitor's unlocked held_since()/participants() reads are benign
+        # torn-free races
+        self._depth -= 1
+        if self._depth == 0:
+            self._since = None
+            self._holder = None
+        self._lock.release()
+
+    __enter__ = acquire
+
+    def __exit__(self, *exc_info):
+        self.release()
+
+    def held_since(self):
+        """Monotonic timestamp of the current outermost acquire, or None
+        when free. Advisory (read without the lock)."""
+        return self._since
+
+    def participants(self):
+        """Thread idents currently holding OR blocked acquiring the lock.
+        Advisory snapshot (read without the lock)."""
+        out = set(self._waiters)
+        holder = self._holder
+        if holder is not None:
+            out.add(holder)
+        return out
+
+
+#: Process-wide device-dispatch lock shared by every engine: the serving
+#: frontend drives one engine per dispatcher THREAD, and concurrent jit
+#: TRACING of the shared model's programs leaks tracers through the
+#: framework's (thread-oblivious) Tensor state. Serializing the jitted
+#: sections is correct and cheap — in-process replicas time-share one
+#: accelerator anyway; the host-side scheduling around it stays concurrent.
+#: Production multi-host replicas live in separate processes and never
+#: contend.
+_DISPATCH_LOCK = _StampedRLock()
+
+#: canonical greedy sampling tuple — every greedy request shares ONE
+#: compiled prefill/decode program regardless of the knob values passed
+GREEDY_SAMPLING = (False, 1.0, 0, 1.0)
+
+
+def canonical_sampling(do_sample, temperature=1.0, top_k=0, top_p=1.0):
+    return (GREEDY_SAMPLING if not do_sample else
+            (True, float(temperature), int(top_k), float(top_p)))
+
+
+class EngineRequest:
+    """One request's full lifecycle state — the unit the online serving
+    control plane (paddle_tpu/serving) hands to the engine and the engine
+    hands back finished. ``serve()`` builds these internally, so the batch
+    path and the frontend path exercise the SAME admission/decode/retire
+    machinery.
+
+    Result surface (the per-request failure-reason contract): exactly one of
+    ``result`` (np.int32 array, prompt + generated tokens) or ``error`` (the
+    exception that failed the request; ``error_message`` is its rendered
+    string) is set once ``finished`` is True. ``timed_out`` requests retire
+    with a partial ``result``.
+    """
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
+                 "sampling", "seed", "timeout_s", "on_token", "tokens",
+                 "n_generated", "last_token", "pages", "slot", "key_base",
+                 "t_enqueue", "t_admit", "t_first_token", "t_done",
+                 "error", "result", "finished", "timed_out", "cancelled")
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
+                 sampling=GREEDY_SAMPLING, seed=0, timeout_s=None,
+                 on_token=None):
+        self.rid = int(rid)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            # admission always produces the prefill's first token, so a
+            # 0-token budget can't be honored — reject it at construction
+            # (submit()/serve() callers both reach this) instead of decoding
+            # past the page reservation
+            raise ValueError(
+                f"request {self.rid}: max_new_tokens must be >= 1, got "
+                f"{self.max_new_tokens}")
+        self.eos_token_id = eos_token_id
+        self.sampling = tuple(sampling)
+        self.seed = int(seed)
+        self.timeout_s = timeout_s
+        self.on_token = on_token
+        self.tokens = []          # prompt + generated, filled at admission
+        self.n_generated = 0
+        self.last_token = None
+        self.pages = []
+        self.slot = None
+        self.key_base = None      # np uint32[2], lazily built at admission
+        self.t_enqueue = time.monotonic()  # TTFT epoch
+        self.t_admit = None
+        self.t_first_token = None
+        self.t_done = None
+        self.error = None
+        self.result = None
+        self.finished = False
+        self.timed_out = False
+        self.cancelled = False    # set by the frontend; honored at the next
+        # block boundary (the request retires with a partial result)
+
+    @property
+    def error_message(self):
+        """Failure reason as a string, or None (satellite: rid -> reason)."""
+        if self.error is None:
+            return None
+        return f"{type(self.error).__name__}: {self.error}"
+
+    def clone_for_retry(self):
+        """A fresh, un-admitted copy for rerouting to another replica after
+        this one's replica died mid-flight. Keeps rid/seed so the sampled
+        key stream — hence the output — is identical on the new replica,
+        and t_enqueue so TTFT/queue-wait span the whole journey including
+        the time lost on the dead replica (the failover tail is exactly
+        what the per-SLO histograms exist to expose)."""
+        clone = EngineRequest(self.rid, self.prompt, self.max_new_tokens,
+                              eos_token_id=self.eos_token_id,
+                              sampling=self.sampling, seed=self.seed,
+                              timeout_s=self.timeout_s,
+                              on_token=self.on_token)
+        clone.t_enqueue = self.t_enqueue
+        return clone
 
 
 def _row_sampler(do_sample, temperature, top_k, top_p):
@@ -174,8 +359,27 @@ class ContinuousBatchingEngine:
                       "prefix_evictions": 0, "failed_requests": 0,
                       "timed_out_requests": 0}
         # per-serve map rid -> exception for requests that failed in
-        # isolation (their results entry is None)
+        # isolation (their results entry is None); the EngineRequest carries
+        # the same exception + its rendered string for the online path.
+        # Bounded so a long-running online engine can't grow it forever.
         self.request_errors = {}
+        self._request_errors_bound = 1024
+        # ---- online-serving state (frontend-driven mode) ------------------
+        # slot -> EngineRequest. serve() uses the same machinery, so batch
+        # and online requests share one admission/decode/retire path.
+        self._active = {}
+        # all co-scheduled requests share ONE sampling tuple (the sampler is
+        # a compile-time constant of the decode program); admission defers
+        # requests whose sampling differs from the running group's
+        self._active_sampling = None
+        # O(1) maintained pages-in-use counter (satellite: replaces the
+        # derived scan; tests assert it equals the scan at quiet points)
+        self._pages_in_use = 0
+        # (mutation_version, state_dict) captured at the last admission;
+        # step() reuses it so the TPOT-critical loop never pays the full
+        # parameter-tree walk per decode block (the batch path captured
+        # state once per serve() — this keeps the online path at parity)
+        self._decode_state_cache = None
 
     def clear_prefix_cache(self):
         """Drop all cached (refcount-0) prefix pages and their index. In-use
@@ -207,7 +411,10 @@ class ContinuousBatchingEngine:
 
     def _ref_pages(self, pages):
         for p in pages:
-            self._page_refs[p] = self._page_refs.get(p, 0) + 1
+            n = self._page_refs.get(p, 0)
+            if n == 0:
+                self._pages_in_use += 1
+            self._page_refs[p] = n + 1
             self._evictable.pop(p, None)
 
     def _unref_pages(self, pages):
@@ -215,10 +422,18 @@ class ContinuousBatchingEngine:
             self._page_refs[p] -= 1
             if self._page_refs[p] == 0:
                 del self._page_refs[p]
+                self._pages_in_use -= 1
                 if p in self._page_hash:  # cached: keep KV, evict lazily
                     self._evictable[p] = None
                 else:
                     self.free_pages.append(p)
+
+    def pages_in_use(self):
+        """Referenced (in-flight) pages, maintained O(1) at every ref/unref
+        transition — the admit loop's pressure signal and the router's load
+        input. Equals ``num_pages - 1 - free - evictable`` (asserted in
+        tests)."""
+        return self._pages_in_use
 
     def _match_prefix(self, prompt, true_len):
         """Longest run of indexed full pages, capped so >=1 suffix token
@@ -232,6 +447,17 @@ class ContinuousBatchingEngine:
                 break
             shared.append(pid)
         return len(shared), shared
+
+    def prefix_match_pages(self, prompt):
+        """How many full prompt pages this engine could serve from its
+        prefix cache right now (read-only: no refcounts taken, no state
+        touched). The router's affinity signal — dict probes only, safe to
+        call from the frontend's submit thread while the dispatcher runs."""
+        if not self.enable_prefix_cache:
+            return 0
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        n, _ = self._match_prefix(p, len(p))
+        return n
 
     def _index_prompt_pages(self, prompt, true_len, pages, start):
         """Register this request's full prompt pages (from page `start` on;
@@ -543,19 +769,409 @@ class ContinuousBatchingEngine:
     #: ConnectionErrors and still raise immediately.
     retry_policy = RetryPolicy(attempts=3, base_delay=0.05)
 
+
+    # ---- online request lifecycle -----------------------------------------
+    # The serving control plane (paddle_tpu/serving) drives the engine with
+    # these three hooks from a per-replica dispatcher thread:
+    #
+    #   try_admit_one(req)  non-blocking admission of ONE EngineRequest
+    #   step()              one fused decode dispatch; returns finished reqs
+    #   drain()             finish everything admitted, admit nothing
+    #
+    # serve() below is rebuilt ON TOP of the same hooks, so the batch path
+    # and the online path cannot drift. The engine is single-threaded by
+    # contract: all three hooks must be called from one thread (the
+    # dispatcher); the only cross-thread writes it tolerates are the
+    # EngineRequest.cancelled flags, honored at block boundaries.
+
+    def idle(self):
+        return not self._active
+
+    def active_count(self):
+        return len(self._active)
+
+    def has_free_slot(self):
+        return bool(self.free_slots)
+
+    def _refresh_cache_guard(self, state):
+        """Cached prefix KV is only valid under the weights it was computed
+        with. Two-factor guard:
+        - core.tensor_mutation_version: bumped by every set_value/load path
+          AND the optimizer/train-step direct-rebind epilogues. A counter can
+          never false-match when CPython recycles a freed array's address
+          (the id()-only guard's failure mode, ADVICE r5 medium).
+        - the id tuple: belt-and-braces for any future code that rebinds
+          p._data without bumping — a rebind only slips through if EVERY new
+          array also lands on its old address."""
+        version = (_core.tensor_mutation_version(),
+                   tuple(id(v) for v in state.values()))
+        if version != self._cache_weights_version:
+            if self._cache_weights_version is not None:
+                self.clear_prefix_cache()
+            self._cache_weights_version = version
+
+    def _fail_request(self, req, exc):
+        req.error = exc
+        req.result = None
+        req.finished = True
+        req.t_done = time.monotonic()
+        self.request_errors[req.rid] = exc
+        # online mode: bounded map. serve() raises the bound to its batch
+        # size for the duration — its docstring promises EVERY failed rid
+        # an entry, and a >1024-request batch must not silently evict its
+        # own early failures.
+        while len(self.request_errors) > self._request_errors_bound:
+            self.request_errors.pop(next(iter(self.request_errors)))
+        self.stats["failed_requests"] += 1
+        counters.bump("fault.serve.request_failed")
+
+    def _retire(self, slot):
+        req = self._active.pop(slot)
+        req.result = np.asarray(req.tokens, np.int32)
+        req.finished = True
+        req.t_done = time.monotonic()
+        self._unref_pages(req.pages)
+        self.free_slots.append(slot)
+        self.page_table[slot] = 0
+        self.lengths[slot] = 0
+        if not self._active:
+            self._active_sampling = None
+        return req
+
+    def _update_gauges(self):
+        _M_OCCUPANCY.set(len(self._active) / self.max_seqs)
+
+    def try_admit_one(self, req):
+        """Non-blocking admission of one :class:`EngineRequest`: page
+        reservation + bucketed prefill + pool insert. Returns
+
+        - ``"admitted"``  — prefilled into a slot; drive it with step()
+        - ``"done"``      — admitted AND retired (eos/max_new on the first
+                            token); ``req.result`` is set
+        - ``"failed"``    — terminally failed in isolation (``req.error``)
+        - ``"deferred"``  — try again later: no free slot, the running
+                            group's sampling differs, or the pool is busy
+
+        The caller owns the queue: pop the request on every status except
+        ``"deferred"``. A deferred request on an IDLE engine never happens —
+        a request the idle pool still cannot fit fails as impossible instead
+        (the degradation contract's "fail alone, never wedge the queue")."""
+        if not self.free_slots:
+            return "deferred"
+        if self._active and self._active_sampling != req.sampling:
+            # the sampler is a compile-time constant of the decode program:
+            # only requests sharing a sampling tuple can co-schedule
+            return "deferred"
+        # past the deferral gates the request is popped by the caller on
+        # every return below, so this counts each request exactly once —
+        # on BOTH the batch serve() path and the frontend's online path
+        _M_REQUESTS.inc()
+        prompt = req.prompt
+        true_len = len(prompt)
+        bucket = prompt_bucket(true_len)
+        if true_len + req.max_new_tokens > self.max_len or bucket > self.max_len:
+            # invalid request — reject IT, not the whole batch
+            self._fail_request(req, ValueError(
+                f"request {req.rid}: len {true_len} (bucket {bucket}) + "
+                f"{req.max_new_tokens} exceeds max_len={self.max_len}"))
+            return "failed"
+        # reuse the version-checked capture across admissions AND decode
+        # steps — the O(n_params) tree walk stays off the TTFT-critical
+        # path. Version read BEFORE the capture: a mutation landing in
+        # between tags fresh state with a stale version, which merely
+        # forces an extra refresh next time — never a stale serve.
+        ver = _core.tensor_mutation_version()
+        cache = self._decode_state_cache
+        if cache is not None and cache[0] == ver:
+            state = cache[1]
+        else:
+            state = self.model.raw_state_dict()
+            self._decode_state_cache = (ver, state)
+        bs_ = self.page_size
+        if self.enable_prefix_cache:
+            self._refresh_cache_guard(state)
+            n_pre, shared = self._match_prefix(prompt, true_len)
+        else:
+            n_pre, shared = 0, []
+        # shrink the hit until prefix + rounded suffix bucket fit the page-
+        # table row: the suffix bucket rounds up independently, so a
+        # full-width hit can otherwise need pages_per_seq+1 pages
+        while n_pre:
+            suffix_len = true_len - n_pre * bs_
+            sbucket = prompt_bucket(suffix_len)
+            if n_pre + self._pages_for_bucket(sbucket, bs_) \
+                    <= self.pages_per_seq:
+                break
+            n_pre -= 1
+            shared = shared[:n_pre]
+        if not n_pre:
+            suffix_len, sbucket = true_len, bucket
+        region = self._pages_for_bucket(sbucket, bs_)
+        total_need = max(n_pre + region,
+                         -(-(true_len + req.max_new_tokens) // bs_))
+        # hold the shared pages BEFORE the availability check: shared pages
+        # sitting in _evictable would otherwise be double-counted as
+        # allocatable, letting _alloc_pages run dry
+        self._ref_pages(shared)
+        if total_need - n_pre > self._available_pages():
+            self._unref_pages(shared)
+            if not self._active:
+                # nothing running and it still can't admit: with the pool
+                # otherwise idle that means it NEVER fits (needs more pages
+                # than exist). Fail it alone, keep the queue draining.
+                self._fail_request(req, RuntimeError(
+                    f"request {req.rid} needs more pages than the pool holds "
+                    f"({true_len}+{req.max_new_tokens} tokens vs "
+                    f"{(self.num_pages - 1) * self.page_size} pool tokens)"))
+                return "failed"
+            self.stats["deferred_admissions"] += 1
+            return "deferred"
+        if self.enable_prefix_cache:
+            # hit-rate denominator, counted once per ADMISSION (a deferred
+            # request re-enters try_admit_one every decode block and must
+            # not inflate it): every full prompt page that could have come
+            # from cache
+            _M_PREFIX_LOOKUP.inc((true_len - 1) // bs_)
+        slot = self.free_slots.pop()
+        new_pages = self._alloc_pages(total_need - n_pre)
+        self._ref_pages(new_pages)
+        pages = shared + new_pages
+        self.stats["peak_pages"] = max(self.stats["peak_pages"],
+                                       self._pages_in_use)
+        ids_p = np.zeros((1, sbucket), np.int32)
+        ids_p[0, :suffix_len] = prompt[n_pre * bs_:]
+        sampling = req.sampling
+        try:
+            with _DISPATCH_LOCK, _trace.span("serve.prefill"):
+                if sampling[0] and req.key_base is None:
+                    # key_base = fold_in(PRNGKey(seed), rid): the request's
+                    # own stream root, so its sampled tokens are independent
+                    # of which co-tenants (or which replica) it landed with
+                    req.key_base = np.asarray(
+                        jax.random.fold_in(jax.random.PRNGKey(req.seed),
+                                           req.rid))
+                k0 = (jax.random.fold_in(jnp.asarray(req.key_base), 0)
+                      if sampling[0]
+                      else jnp.zeros((2,), jnp.uint32))  # greedy ignores it
+                chaos.site("serve.prefill")
+                if n_pre:
+                    self.stats["prefix_hit_pages"] += n_pre
+                    _M_PREFIX_HIT.inc(n_pre)
+                    ks_pre, vs_pre = self._gather_prefix(n_pre)(
+                        tuple(self.pools), jnp.asarray(shared, jnp.int32))
+                    tok0, ks, vs = self._prefill_suffix(
+                        n_pre, sbucket, sampling)(
+                        state, ks_pre, vs_pre, jnp.asarray(ids_p),
+                        jnp.int32(suffix_len), k0)
+                else:
+                    tok0, ks, vs = self._prefill(sbucket, sampling)(
+                        state, jnp.asarray(ids_p), jnp.int32(suffix_len), k0)
+                page_ids = jnp.asarray(new_pages[:region], jnp.int32)
+                self.pools = list(self._insert(sbucket)(
+                    tuple(self.pools), ks, vs, page_ids))
+            # sync INSIDE the guard: device-side prefill errors surface at
+            # this host transfer, not at dispatch — outside the try they
+            # would leak the popped slot + reffed pages and (online) kill
+            # the whole replica instead of failing this request alone
+            tok0 = int(tok0)
+        except Exception as e:  # error isolation: fail THIS request alone
+            self._unref_pages(pages)
+            self.free_slots.append(slot)
+            self._fail_request(req, e)
+            return "failed"
+        if self.enable_prefix_cache:
+            self._index_prompt_pages(prompt, true_len, pages, n_pre)
+        row = np.zeros(self.pages_per_seq, np.int32)
+        row[:len(pages)] = pages
+        self.page_table[slot] = row
+        self.lengths[slot] = true_len
+        now = time.monotonic()
+        req.t_admit = now
+        req.t_first_token = now
+        _M_TTFT.observe(now - req.t_enqueue)
+        _M_TOKENS.inc()
+        req.tokens = list(prompt) + [tok0]
+        req.n_generated = 1
+        req.last_token = tok0
+        req.pages = pages
+        req.slot = slot
+        # register BEFORE the user callback: if it raises, the cleanup path
+        # must see this slot to free its pages
+        self._active[slot] = req
+        self._active_sampling = sampling
+        if req.on_token is not None:
+            req.on_token(req.rid, tok0)
+        if (req.eos_token_id is not None and tok0 == req.eos_token_id) \
+                or req.n_generated >= req.max_new_tokens:
+            self._retire(slot)
+            return "done"
+        return "admitted"
+
+    def _admit_from(self, queue):
+        """Admit from the head of ``queue`` (a deque of EngineRequests)
+        until one defers — FIFO, the batch path's no-skip-ahead contract
+        (the frontend's scheduler reorders BEFORE requests reach this
+        point). Pops every request that reached a terminal state."""
+        admitted = False
+        while queue and self.free_slots:
+            status = self.try_admit_one(queue[0])
+            if status == "deferred":
+                break
+            queue.popleft()
+            admitted = True
+        self._update_gauges()
+        return admitted
+
+    def step(self):
+        """One fused decode dispatch over the active slots, then retire
+        whatever finished (eos / token budget / timeout / cancellation).
+        Returns the list of EngineRequests that reached a terminal state
+        during this step; ``[]`` when idle. Never blocks beyond the device
+        dispatch itself — the frontend's dispatcher loop interleaves this
+        with admissions to keep slots full continuously."""
+        retired = []
+        # cancellation sweep first: no decode compute for a dead request
+        for slot in list(self._active):
+            if self._active[slot].cancelled:
+                retired.append(self._retire(slot))
+        if not self._active:
+            self._update_gauges()
+            return retired
+        sampling = self._active_sampling
+        ver = _core.tensor_mutation_version()
+        cache = self._decode_state_cache
+        if cache is None or cache[0] != ver:
+            cache = self._decode_state_cache = (
+                ver, self.model.raw_state_dict())
+        state = cache[1]
+        # block size: never overshoot any active request's token budget (its
+        # page reservation covers exactly max_new_tokens); power of two so
+        # the compile cache stays at log2(decode_block) programs
+        remaining = min(r.max_new_tokens - r.n_generated
+                        for r in self._active.values())
+        k = min(self.decode_block, remaining)
+        k = 1 << (k.bit_length() - 1)
+        toks = np.zeros((self.max_seqs, 1), np.int32)
+        bases = np.zeros((self.max_seqs, 2), np.uint32)
+        idxs = np.zeros(self.max_seqs, np.int32)
+        for slot, r in self._active.items():
+            toks[slot, 0] = r.last_token
+            if sampling[0]:
+                bases[slot] = r.key_base
+                idxs[slot] = r.n_generated
+        # the chaos site fires BEFORE the jitted call, so an injected outage
+        # retries against intact pools; a real failure after the dispatch
+        # donated them is not retriable (the retry would read donated
+        # buffers) and raises out through the caller's cleanup
+        def dispatch():
+            chaos.site("serve.decode")
+            if k == 1:
+                nxt, pools = decode(
+                    state, jnp.asarray(toks), tuple(self.pools),
+                    jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+                    keys[0])
+                return np.asarray(nxt)[None], pools
+            blk, pools = self._decode_block_fn(sampling, k)(
+                state, jnp.asarray(toks), tuple(self.pools),
+                jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+                keys)
+            return np.asarray(blk), pools
+
+        t_disp0 = time.monotonic()
+        with _DISPATCH_LOCK, _trace.span("serve.decode"):
+            if sampling[0]:
+                idx_mat = idxs[None, :] + np.arange(k, dtype=np.int32)[:, None]
+                keys = _KEYS_FROM_BASE(jnp.asarray(bases),
+                                       jnp.asarray(idx_mat))
+            else:
+                # greedy ignores the keys entirely — skip the device work
+                keys = jnp.zeros((k, self.max_seqs, 2), jnp.uint32)
+            decode = self._decode(sampling)
+            block, pools = self.retry_policy.run(dispatch, name="serve.decode")
+        # dispatch() syncs (np.asarray on the block), so this is real wall
+        # time; normalized per token it is the TPOT the serving comparison
+        # papers report
+        _M_TPOT.observe((time.monotonic() - t_disp0) / k)
+        self.pools = list(pools)
+        self.stats["decode_steps"] += k
+        with _trace.span("serve.emit"):
+            for slot in list(self._active):
+                r = self._active[slot]
+                for s in range(k):
+                    self.lengths[slot] += 1  # the fed token is now in cache
+                    tok = int(block[s, slot])
+                    r.tokens.append(tok)
+                    r.n_generated += 1
+                    r.last_token = tok
+                    _M_TOKENS.inc()
+                    if r.on_token is not None:
+                        r.on_token(r.rid, tok)
+                    if r.n_generated >= r.max_new_tokens or (
+                            r.eos_token_id is not None
+                            and tok == r.eos_token_id):
+                        # mid-block EOS: rest of the block is discarded
+                        retired.append(self._retire(slot))
+                        break
+        now = time.monotonic()
+        for slot in list(self._active):
+            r = self._active[slot]
+            if r.timeout_s is not None and now - r.t_admit > r.timeout_s:
+                # deadline hit: return what it got, free the slot
+                self.stats["timed_out_requests"] += 1
+                counters.bump("fault.serve.request_timeout")
+                r.timed_out = True
+                retired.append(self._retire(slot))
+        self._update_gauges()
+        return retired
+
+    def drain(self):
+        """Finish every admitted request WITHOUT admitting more; returns the
+        retired EngineRequests. The frontend's replica-drain building block,
+        and the escape hatch before calling batch serve() on an engine that
+        still has online work in flight."""
+        out = []
+        while self._active:
+            out.extend(self.step())
+        return out
+
+    @staticmethod
+    def _per_request(value, n, name):
+        """Scalar | per-rid list | complete {rid: v} dict -> per-rid list
+        (satellite: per-request max_new_tokens)."""
+        if isinstance(value, dict):
+            missing = [i for i in range(n) if i not in value]
+            if missing:
+                raise ValueError(
+                    f"per-request {name} dict missing rids {missing}")
+            return [int(value[i]) for i in range(n)]
+        if isinstance(value, (list, tuple, np.ndarray)):
+            if len(value) != n:
+                raise ValueError(f"per-request {name} has {len(value)} "
+                                 f"entries for {n} requests")
+            return [int(v) for v in value]
+        return [int(value)] * n
+
     def serve(self, prompts, max_new_tokens, eos_token_id=None,
               do_sample=False, temperature=1.0, top_k=0, top_p=1.0, seed=0,
-              on_token=None, request_timeout_s=None):
+              on_token=None, request_timeout_s=None, sampling_overrides=None):
         """Serve a list of int32 prompt arrays; returns a list of
         [len(prompt) + n_generated] arrays (stops at eos or max_new_tokens).
         Requests beyond the pool/slot capacity queue and join as earlier
         sequences retire — continuous batching.
 
+        ``max_new_tokens`` is a scalar, a per-request list, or a complete
+        {rid: n} dict. ``sampling_overrides`` (per-request list of dicts /
+        None, or a partial {rid: dict}) overrides do_sample/temperature/
+        top_k/top_p per request; requests sharing a sampling tuple
+        co-schedule, others wait for the running group (the sampler is a
+        compile-time constant of the decode program).
+
         Degradation contract (one request must never kill the batch):
 
         - a request whose PREFILL raises fails alone: its slot/pages free,
           its results entry is None, the exception lands in
-          self.request_errors[rid], and every co-tenant keeps serving;
+          self.request_errors[rid] (and on the EngineRequest's
+          error/error_message), and every co-tenant keeps serving;
         - a request that can NEVER fit the pool (needs more pages than
           exist) likewise fails alone instead of raising out of serve() —
           admission backpressure for merely-busy pools is unchanged
@@ -574,267 +1190,76 @@ class ContinuousBatchingEngine:
         on_token(request_id, token_id) streams each generated token (incl.
         the prefill's first token) as soon as its decode step completes —
         the serving-callback hook for SSE-style responses."""
-        # greedy ignores the sampler knobs: canonicalize so every greedy
-        # serve shares ONE compiled prefill/decode program
-        sampling = ((False, 1.0, 0, 1.0) if not do_sample else
-                    (True, float(temperature), int(top_k), float(top_p)))
-        base_key = jax.random.PRNGKey(seed)
-
-        def req_key(rid, tok_idx):
-            return _KEYS_FN(base_key, jnp.asarray([rid]), jnp.asarray([tok_idx]))[0]
-
+        if self._active:
+            raise RuntimeError(
+                "serve() on an engine with active online requests — drain() "
+                "the frontend-driven work first")
+        default_sampling = canonical_sampling(do_sample, temperature,
+                                              top_k, top_p)
+        per_new = self._per_request(max_new_tokens, len(prompts),
+                                    "max_new_tokens")
+        # sampling_overrides dicts may be sparse ({rid: ov} for just the
+        # requests that deviate), but a list must cover every request —
+        # fail like _per_request does, not with a bare IndexError mid-build
+        if (sampling_overrides is not None
+                and not isinstance(sampling_overrides, dict)
+                and len(sampling_overrides) != len(prompts)):
+            raise ValueError(
+                f"per-request sampling_overrides has "
+                f"{len(sampling_overrides)} entries for "
+                f"{len(prompts)} requests")
+        # every serve() batch starts from a FRESH capture (old-code parity):
+        # the version-keyed reuse below it only has to bridge admissions
+        # and decode blocks within one batch / online stretch
+        ver = _core.tensor_mutation_version()
         state = self.model.raw_state_dict()
+        self._decode_state_cache = (ver, state)
         if self.enable_prefix_cache:
-            # cached prefix KV is only valid under the weights it was
-            # computed with. Two-factor guard:
-            # - core.tensor_mutation_version: bumped by every set_value/
-            #   load path AND the optimizer/train-step direct-rebind
-            #   epilogues. A counter can never false-match when CPython
-            #   recycles a freed array's address (the id()-only guard's
-            #   failure mode, ADVICE r5 medium).
-            # - the id tuple: belt-and-braces for any future code that
-            #   rebinds p._data without bumping — a rebind only slips
-            #   through if EVERY new array also lands on its old address.
-            version = (_core.tensor_mutation_version(),
-                       tuple(id(v) for v in state.values()))
-            if version != self._cache_weights_version:
-                if self._cache_weights_version is not None:
-                    self.clear_prefix_cache()
-                self._cache_weights_version = version
+            self._refresh_cache_guard(state)
+        reqs = []
+        for rid, p in enumerate(prompts):
+            samp = default_sampling
+            if sampling_overrides is not None:
+                ov = (sampling_overrides.get(rid)
+                      if isinstance(sampling_overrides, dict)
+                      else sampling_overrides[rid])
+                if ov:
+                    samp = canonical_sampling(
+                        ov.get("do_sample", do_sample),
+                        ov.get("temperature", temperature),
+                        ov.get("top_k", top_k), ov.get("top_p", top_p))
+            reqs.append(EngineRequest(
+                rid, p, per_new[rid], eos_token_id=eos_token_id,
+                sampling=samp, seed=seed, timeout_s=request_timeout_s,
+                on_token=on_token))
+        # only after EVERY request constructed (construction validates and
+        # can raise): escalating the error bound or counting requests first
+        # would leak past the finally below, which only runs once the try
+        # is entered
         self.request_errors = {}
-        t_serve = time.monotonic()  # TTFT epoch: every request enters now
-        _M_REQUESTS.inc(len(prompts))
-        queue = deque(enumerate(prompts))
+        # every failed rid of THIS batch keeps its entry, however large
+        self._request_errors_bound = max(1024, len(prompts))
+        queue = deque(reqs)
         _M_QUEUE.set(len(queue))  # records the load peak via the gauge hwm
-        results = [None] * len(prompts)
-        # slot -> [req_id, tokens_out(list), n_generated, last_token, pages(list)]
-        active = {}
-
-        def pages_in_use():
-            return self.num_pages - 1 - self._available_pages()
-
-        def try_admit():
-            admitted = False
-            while queue and self.free_slots:
-                rid, prompt = queue[0]
-                prompt = np.asarray(prompt, np.int32).reshape(-1)
-                true_len = len(prompt)
-                bucket = prompt_bucket(true_len)
-                if true_len + max_new_tokens > self.max_len or bucket > self.max_len:
-                    # invalid request — reject IT, not the whole batch
-                    queue.popleft()
-                    self._fail_request(rid, results, ValueError(
-                        f"request {rid}: len {true_len} (bucket {bucket}) + "
-                        f"{max_new_tokens} exceeds max_len={self.max_len}"))
-                    admitted = True
-                    continue
-                bs_ = self.page_size
-                if self.enable_prefix_cache:
-                    n_pre, shared = self._match_prefix(prompt, true_len)
-                else:
-                    n_pre, shared = 0, []
-                # shrink the hit until prefix + rounded suffix bucket fit the
-                # page-table row: the suffix bucket rounds up independently,
-                # so a full-width hit can otherwise need pages_per_seq+1
-                # pages (row overflow)
-                while n_pre:
-                    suffix_len = true_len - n_pre * bs_
-                    sbucket = prompt_bucket(suffix_len)
-                    if n_pre + self._pages_for_bucket(sbucket, bs_) \
-                            <= self.pages_per_seq:
-                        break
-                    n_pre -= 1
-                    shared = shared[:n_pre]
-                if not n_pre:
-                    suffix_len, sbucket = true_len, bucket
-                region = self._pages_for_bucket(sbucket, bs_)
-                total_need = max(n_pre + region,
-                                 -(-(true_len + max_new_tokens) // bs_))
-                # hold the shared pages BEFORE the availability check: shared
-                # pages sitting in _evictable would otherwise be double-
-                # counted as allocatable, letting _alloc_pages run dry
-                self._ref_pages(shared)
-                if total_need - n_pre > self._available_pages():
-                    self._unref_pages(shared)
-                    self.stats["deferred_admissions"] += 1
-                    break  # FIFO: wait for pages instead of skipping ahead
-                queue.popleft()
-                if self.enable_prefix_cache:
-                    # hit-rate denominator, counted once per ADMISSION (a
-                    # deferred head-of-queue request re-enters try_admit
-                    # every decode block and must not inflate it): every
-                    # full prompt page that could have come from cache
-                    _M_PREFIX_LOOKUP.inc((true_len - 1) // bs_)
-                slot = self.free_slots.pop()
-                new_pages = self._alloc_pages(total_need - n_pre)
-                self._ref_pages(new_pages)
-                pages = shared + new_pages
-                self.stats["peak_pages"] = max(self.stats["peak_pages"], pages_in_use())
-                ids_p = np.zeros((1, sbucket), np.int32)
-                ids_p[0, :suffix_len] = prompt[n_pre * bs_:]
-                try:
-                    with _trace.span("serve.prefill"):
-                        chaos.site("serve.prefill")
-                        if n_pre:
-                            self.stats["prefix_hit_pages"] += n_pre
-                            _M_PREFIX_HIT.inc(n_pre)
-                            ks_pre, vs_pre = self._gather_prefix(n_pre)(
-                                tuple(self.pools), jnp.asarray(shared, jnp.int32))
-                            tok0, ks, vs = self._prefill_suffix(n_pre, sbucket, sampling)(
-                                state, ks_pre, vs_pre, jnp.asarray(ids_p),
-                                jnp.int32(suffix_len), req_key(rid, 0))
-                        else:
-                            tok0, ks, vs = self._prefill(sbucket, sampling)(
-                                state, jnp.asarray(ids_p), jnp.int32(suffix_len),
-                                req_key(rid, 0))
-                        page_ids = jnp.asarray(new_pages[:region], jnp.int32)
-                        self.pools = list(self._insert(sbucket)(
-                            tuple(self.pools), ks, vs, page_ids))
-                except Exception as e:  # error isolation: fail THIS request
-                    self._unref_pages(pages)
-                    self.free_slots.append(slot)
-                    self._fail_request(rid, results, e)
-                    admitted = True  # the queue moved; keep admitting
-                    continue
-                if self.enable_prefix_cache:
-                    self._index_prompt_pages(prompt, true_len, pages, n_pre)
-                row = np.zeros(self.pages_per_seq, np.int32)
-                row[:len(pages)] = pages
-                self.page_table[slot] = row
-                self.lengths[slot] = true_len
-                tok0 = int(tok0)
-                _M_TTFT.observe(time.monotonic() - t_serve)
-                _M_TOKENS.inc()
-                done = eos_token_id is not None and tok0 == eos_token_id
-                # register BEFORE the user callback: if it raises, the
-                # finally-cleanup must see this slot to free its pages
-                active[slot] = [rid, list(prompt) + [tok0], 1, tok0, pages,
-                                time.monotonic()]
-                if on_token is not None:
-                    on_token(rid, tok0)
-                if done or max_new_tokens == 1:
-                    retire(slot)
-                admitted = True
-            return admitted
-
-        def retire(slot):
-            st = active.pop(slot)
-            rid, toks, pages = st[0], st[1], st[4]
-            results[rid] = np.asarray(toks, np.int32)
-            self._unref_pages(pages)
-            self.free_slots.append(slot)
-            self.page_table[slot] = 0
-            self.lengths[slot] = 0
-
         try:
             with _trace.span("serve.admit"):
-                try_admit()
+                self._admit_from(queue)
             _M_QUEUE.set(len(queue))
-            _M_OCCUPANCY.set(len(active) / self.max_seqs)
-            return self._serve_loop(sampling, state, queue, active, results,
-                                    try_admit, retire, max_new_tokens,
-                                    eos_token_id, do_sample, base_key,
-                                    on_token, request_timeout_s)
+            while queue or self._active:
+                if not self._active:
+                    # an idle engine always resolves its queue head (admit
+                    # or fail-alone) — reaching here means the admission
+                    # invariant broke, and spinning would hang the caller
+                    raise AssertionError(
+                        "serve(): admission stalled with an idle engine")
+                self.step()
+                with _trace.span("serve.admit"):
+                    self._admit_from(queue)
+                _M_QUEUE.set(len(queue))
+            return [r.result for r in reqs]
         finally:
+            self._request_errors_bound = 1024
             # a raising on_token (or any mid-serve failure) must not leak a
             # warm engine's pages/slots: retire whatever is still active
-            for slot in list(active):
-                retire(slot)
-
-    def _fail_request(self, rid, results, exc):
-        results[rid] = None
-        self.request_errors[rid] = exc
-        self.stats["failed_requests"] += 1
-        counters.bump("fault.serve.request_failed")
-
-    def _serve_loop(self, sampling, state, queue, active, results, try_admit,
-                    retire, max_new_tokens, eos_token_id, do_sample, base_key,
-                    on_token, request_timeout_s=None):
-        decode = self._decode(sampling)
-        while active or queue:
-            if not active:
-                # nothing running and the head still can't admit: with the
-                # pool otherwise idle that means it NEVER fits (needs more
-                # pages than exist). Fail it alone, keep draining the queue.
-                rid, prompt = queue.popleft()
-                self._fail_request(rid, results, RuntimeError(
-                    f"request {rid} needs more pages than the pool holds "
-                    f"({len(prompt)}+{max_new_tokens} tokens vs "
-                    f"{(self.num_pages - 1) * self.page_size} pool tokens)"))
-                try_admit()
-                continue
-            # block size: never overshoot any active request's token budget
-            # (its page reservation covers exactly max_new_tokens); power of
-            # two so the compile cache stays at log2(decode_block) programs
-            remaining = min(max_new_tokens - st[2] for st in active.values())
-            k = min(self.decode_block, remaining)
-            k = 1 << (k.bit_length() - 1)
-            toks = np.zeros((self.max_seqs, 1), np.int32)
-            rids = np.zeros(self.max_seqs, np.int32)
-            idxs = np.zeros(self.max_seqs, np.int32)
-            for slot, st in active.items():
-                toks[slot, 0] = st[3]
-                rids[slot], idxs[slot] = st[0], st[2]
-            if do_sample:
-                rids_j, idxs_j = jnp.asarray(rids), jnp.asarray(idxs)
-                keys = jnp.stack([_KEYS_FN(base_key, rids_j, idxs_j + s)
-                                  for s in range(k)])
-            else:
-                # greedy ignores the keys entirely — skip the device work
-                keys = jnp.zeros((k, self.max_seqs, 2), jnp.uint32)
-            # the chaos site fires BEFORE the jitted call, so an injected
-            # outage retries against intact pools; a real failure after the
-            # dispatch donated them is not retriable (the retry would read
-            # donated buffers) and raises out through the serve() cleanup
-            def dispatch():
-                chaos.site("serve.decode")
-                if k == 1:
-                    nxt, pools = decode(
-                        state, jnp.asarray(toks), tuple(self.pools),
-                        jnp.asarray(self.page_table), jnp.asarray(self.lengths),
-                        keys[0])
-                    return np.asarray(nxt)[None], pools
-                blk, pools = self._decode_block_fn(sampling, k)(
-                    state, jnp.asarray(toks), tuple(self.pools),
-                    jnp.asarray(self.page_table), jnp.asarray(self.lengths),
-                    keys)
-                return np.asarray(blk), pools
-
-            t_disp0 = time.monotonic()
-            with _trace.span("serve.decode"):
-                block, pools = self.retry_policy.run(dispatch, name="serve.decode")
-            # dispatch() syncs (np.asarray on the block), so this is real
-            # wall time; normalized per token it is the TPOT the serving
-            # comparison papers report
-            _M_TPOT.observe((time.monotonic() - t_disp0) / k)
-            self.pools = list(pools)
-            self.stats["decode_steps"] += k
-            with _trace.span("serve.emit"):
-                for slot in list(active):
-                    st = active[slot]
-                    for s in range(k):
-                        self.lengths[slot] += 1  # the fed token is now in cache
-                        tok = int(block[s, slot])
-                        st[1].append(tok)
-                        st[2] += 1  # generated count, incl. the token just appended
-                        st[3] = tok
-                        _M_TOKENS.inc()
-                        if on_token is not None:
-                            on_token(st[0], tok)
-                        if st[2] >= max_new_tokens or (
-                                eos_token_id is not None and tok == eos_token_id):
-                            retire(slot)  # mid-block EOS: rest of block discarded
-                            break
-            if request_timeout_s is not None:
-                now = time.monotonic()
-                for slot in list(active):
-                    if now - active[slot][5] > request_timeout_s:
-                        # deadline hit: return what it got, free the slot
-                        self.stats["timed_out_requests"] += 1
-                        counters.bump("fault.serve.request_timeout")
-                        retire(slot)
-            with _trace.span("serve.admit"):
-                try_admit()
-            _M_QUEUE.set(len(queue))
-            _M_OCCUPANCY.set(len(active) / self.max_seqs)
-        return results
+            for slot in list(self._active):
+                self._retire(slot)

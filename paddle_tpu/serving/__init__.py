@@ -1,0 +1,66 @@
+"""Online serving control plane (ISSUE 4 tentpole): request frontend,
+SLO-aware scheduler, and multi-replica prefix-aware router over N
+``ContinuousBatchingEngine`` replicas.
+
+    from paddle_tpu import serving
+
+    frontend = serving.ServingFrontend([engine_a, engine_b])
+    h = frontend.submit(prompt, max_new_tokens=64,
+                        slo_class="interactive", deadline_s=2.0)
+    for tok in h.stream():
+        ...
+    print(frontend.serving_report())
+
+Layering (each file is one concern, unit-testable alone):
+
+- ``frontend.py``  — request lifecycle: submit/RequestHandle (result /
+  stream / cancel / status), per-replica dispatcher threads driving the
+  engines' non-blocking hooks, replica-death rerouting, drain, telemetry.
+- ``scheduler.py`` — policy: SLO classes (interactive/batch), EDF over
+  virtual deadlines (starvation-free), bounded-queue admission with
+  ``Overloaded`` load shedding, deadline expiry.
+- ``router.py``    — placement: prefix-cache affinity + session hints
+  blended with load; LIVE/DRAINING/DEAD replica health off heartbeats.
+
+Chaos sites ``serving.route`` / ``serving.replica_kill`` make the failure
+paths deterministically testable (tests/test_serving_frontend.py kills a
+replica under concurrent mixed-SLO load). docs/SERVING.md is the operator
+guide; every later serving PR (autoscaling, multi-model, disaggregated
+prefill) builds on this subsystem.
+"""
+from ..inference.continuous import EngineRequest, canonical_sampling  # noqa: F401
+from .frontend import (  # noqa: F401
+    CANCELLED,
+    DONE,
+    FAILED,
+    QUEUED,
+    RUNNING,
+    RequestCancelled,
+    RequestFailed,
+    RequestHandle,
+    ServingFrontend,
+)
+from .router import (  # noqa: F401
+    DEAD,
+    DRAINING,
+    LIVE,
+    NoLiveReplicas,
+    ReplicaHandle,
+    Router,
+)
+from .scheduler import (  # noqa: F401
+    BATCH,
+    INTERACTIVE,
+    DeadlineExceeded,
+    Overloaded,
+    SLOClass,
+    SLOScheduler,
+)
+
+__all__ = [
+    "ServingFrontend", "RequestHandle", "RequestFailed", "RequestCancelled",
+    "QUEUED", "RUNNING", "DONE", "FAILED", "CANCELLED",
+    "Router", "ReplicaHandle", "NoLiveReplicas", "LIVE", "DRAINING", "DEAD",
+    "SLOScheduler", "SLOClass", "Overloaded", "DeadlineExceeded",
+    "INTERACTIVE", "BATCH", "EngineRequest", "canonical_sampling",
+]

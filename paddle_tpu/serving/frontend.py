@@ -1,0 +1,823 @@
+"""Online request frontend: submit/stream/cancel over N engine replicas.
+
+This is the entry point the ROADMAP's "heavy traffic" north star needs and
+``ContinuousBatchingEngine.serve(prompts, ...)`` is not: requests arrive
+one at a time from many client threads, get an SLO class and an optional
+deadline, and are placed onto one of N engine replicas by the router —
+then a **per-replica dispatcher thread** drives that engine continuously
+through the non-blocking hooks (``try_admit_one`` / ``step``), so slots
+refill the moment they free instead of waiting for a batch boundary.
+
+Lifecycle of one request::
+
+    handle = frontend.submit(prompt, max_new_tokens=64,
+                             slo_class="interactive", deadline_s=2.0)
+    for tok in handle.stream():   # or: handle.result(timeout=...)
+        ...
+    handle.cancel()               # any time; frees the slot at the next
+                                  # block boundary
+
+    submit -> SLOScheduler.check_admission   (Overloaded = shed, fast)
+           -> Router.place                   (prefix affinity + load)
+           -> replica.pending                (EDF order, aging built in)
+    dispatcher: pick -> engine.try_admit_one -> engine.step loop
+           -> handle tokens stream out as each decode block lands
+
+Failure semantics (no hangs, no lost handles — the E2E chaos test's
+contract): a replica that dies mid-flight (chaos ``serving.replica_kill``,
+a wedged dispatcher caught by stale heartbeats, or an engine-fatal error)
+has its queued requests transparently re-routed to surviving replicas; its
+in-flight requests are re-routed too when their stream has not been
+consumed yet (identical output — the sampled key stream depends only on
+(seed, rid, index)), and cleanly failed with the replica's death reason
+when tokens were already observed (a spliced stream would be a silent
+correctness bug). Every handle always reaches a terminal state.
+
+Concurrency rules: ONE frontend lock guards routing state (pending lists,
+inflight maps, replica states); each engine is touched only by its own
+dispatcher thread; RequestHandle has its own condition + token queue so
+result()/stream() never contend with routing. The only dispatcher sleep is
+the wake-event wait when a replica is fully idle.
+"""
+import itertools
+import queue as _queue
+import threading
+import time
+
+from ..inference.continuous import (
+    _DISPATCH_LOCK as _ENGINE_DISPATCH_LOCK,
+    EngineRequest,
+    canonical_sampling,
+)
+from ..observability.metrics import registry as _registry
+from ..testing import chaos
+from .router import DEAD, DRAINING, LIVE, NoLiveReplicas, ReplicaHandle, Router
+from .scheduler import DeadlineExceeded, Overloaded, SLOScheduler
+
+__all__ = ["QUEUED", "RUNNING", "DONE", "FAILED", "CANCELLED",
+           "RequestFailed", "RequestCancelled", "RequestHandle",
+           "ServingFrontend"]
+
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+DONE = "DONE"
+FAILED = "FAILED"
+CANCELLED = "CANCELLED"
+_TERMINAL = (DONE, FAILED, CANCELLED)
+
+_M_SUBMITTED = _registry.counter("serving.submitted")
+_M_COMPLETED = _registry.counter("serving.completed")
+_M_FAILED = _registry.counter("serving.failed")
+_M_SHED = _registry.counter("serving.shed")
+_M_EXPIRED = _registry.counter("serving.deadline_expired")
+_M_CANCELLED = _registry.counter("serving.cancelled")
+_M_REROUTED = _registry.counter("serving.rerouted")
+_M_DRAIN_REQUEUED = _registry.counter("serving.drain_requeued")
+_M_REPLICA_DEAD = _registry.counter("serving.replica_dead")
+_M_QUEUE = _registry.gauge("serving.queue_depth")
+
+
+class RequestFailed(RuntimeError):
+    """result()/stream(): the request reached FAILED; the message carries
+    the per-request failure reason (satellite: rid -> exception string)."""
+
+
+class RequestCancelled(RuntimeError):
+    """result(): the request was cancelled before completing."""
+
+
+class _Entry:
+    """Routing-layer wrapper: one EngineRequest + its handle + SLO facts."""
+
+    __slots__ = ("req", "handle", "slo", "deadline_t", "virtual_deadline",
+                 "observed", "route_affinity")
+
+    def __init__(self, req, handle, slo, deadline_t, virtual_deadline):
+        self.req = req
+        self.handle = handle
+        self.slo = slo
+        self.deadline_t = deadline_t
+        self.virtual_deadline = virtual_deadline
+        self.observed = False   # queue_wait/ttft recorded (once per request)
+        self.route_affinity = False  # last place(): won by affinity/hint?
+
+
+class RequestHandle:
+    """The caller's view of one in-flight request. Thread-safe; every
+    accessor works from any thread. Exactly one terminal transition ever
+    happens (DONE / FAILED / CANCELLED) — late token pushes from a replica
+    that was declared dead mid-step are discarded by the generation stamp."""
+
+    def __init__(self, frontend, req, slo):
+        self._frontend = frontend
+        self._req = req
+        self.slo_class = slo.name
+        self.replica = None          # name of the replica serving it
+        self.timed_out = False
+        self._cond = threading.Condition()
+        self._status = QUEUED
+        self._result = None
+        self._error = None           # rendered failure reason (string)
+        self._tokens = []            # generated tokens observed so far
+        self._stream_q = _queue.Queue()
+        self._stream_consumed = False
+        self._gen = 0                # bumped on reroute; stale pushes drop
+        # set by cancel() BEFORE the frontend scans its queues, so a request
+        # in the admission transit window (in neither pending nor inflight)
+        # still sees the cancel when the dispatcher re-examines it
+        self._cancel_requested = False
+
+    # ---- caller surface ---------------------------------------------------
+    @property
+    def rid(self):
+        return self._req.rid
+
+    @property
+    def status(self):
+        with self._cond:
+            return self._status
+
+    @property
+    def error(self):
+        """Failure reason string (None unless FAILED)."""
+        with self._cond:
+            return self._error
+
+    def tokens_so_far(self):
+        with self._cond:
+            return list(self._tokens)
+
+    def done(self):
+        return self.status in _TERMINAL
+
+    def result(self, timeout=None):
+        """Block for the full token array (prompt + generated). Raises
+        RequestFailed (with the failure reason) / RequestCancelled /
+        TimeoutError. A timed-out request returns its partial result with
+        ``handle.timed_out`` set."""
+        with self._cond:
+            if not self._cond.wait_for(
+                    lambda: self._status in _TERMINAL, timeout):
+                raise TimeoutError(
+                    f"request {self.rid} not finished within {timeout}s")
+            if self._status == DONE:
+                return self._result
+            if self._status == CANCELLED:
+                raise RequestCancelled(f"request {self.rid} was cancelled")
+            raise RequestFailed(
+                f"request {self.rid} failed: {self._error}")
+
+    def stream(self, timeout=None):
+        """Iterator over generated token ids, yielding each one as soon as
+        its decode block lands. Ends at completion/cancellation; raises
+        RequestFailed on failure; ``timeout`` bounds the wait for EACH next
+        token. Consuming the stream pins the request to its replica — a
+        consumed stream cannot be transparently re-routed, only failed."""
+        with self._cond:
+            # under the lock so the flag and _reset_for_reroute's check are
+            # ordered: either the reroute sees it consumed and fails the
+            # handle, or this iterator only ever observes the replay
+            self._stream_consumed = True
+        while True:
+            try:
+                kind, val = self._stream_q.get(timeout=timeout)
+            except _queue.Empty:
+                raise TimeoutError(
+                    f"request {self.rid}: no token within {timeout}s") \
+                    from None
+            if kind == "tok":
+                yield val
+            elif kind == "end":
+                return
+            else:  # "err"
+                raise RequestFailed(f"request {self.rid} failed: {val}")
+
+    def cancel(self):
+        """Best-effort cancel: a queued request never runs; a running one
+        retires at the next block boundary. Idempotent; no-op once
+        terminal."""
+        self._frontend._cancel(self)
+
+    # ---- dispatcher surface (frontend internals only) ---------------------
+    def _push_token(self, tok, gen):
+        with self._cond:
+            if gen != self._gen or self._status in _TERMINAL:
+                return  # stale replica still stepping after reroute/failure
+            self._tokens.append(tok)
+            # the queue put stays INSIDE the lock: _reset_for_reroute drains
+            # the queue under the same lock, so a push that passed the gen
+            # check can't slip a stale token in after the drain
+            self._stream_q.put(("tok", tok))
+
+    def _mark_running(self, replica_name):
+        with self._cond:
+            if self._status == QUEUED:
+                self._status = RUNNING
+                self.replica = replica_name
+
+    def _mark_queued(self):
+        with self._cond:
+            if self._status == RUNNING:
+                self._status = QUEUED
+                self.replica = None
+
+    def _reset_for_reroute(self):
+        """Forget everything the dead replica produced; returns the new
+        generation stamp for the replacement on_token closure, or None when
+        the stream has been consumed (checked under the same lock stream()
+        sets the flag under — a replay after the consumer dequeued a token
+        would duplicate output)."""
+        with self._cond:
+            if self._stream_consumed:
+                return None
+            self._gen += 1
+            self._tokens = []
+            while True:
+                try:
+                    self._stream_q.get_nowait()
+                except _queue.Empty:
+                    break
+            self._status = QUEUED
+            self.replica = None
+            return self._gen
+
+    def _complete(self, req):
+        with self._cond:
+            if self._status in _TERMINAL:
+                return
+            self._result = req.result
+            self.timed_out = req.timed_out
+            self._status = DONE
+            self._cond.notify_all()
+        self._stream_q.put(("end", None))
+
+    def _fail(self, reason):
+        with self._cond:
+            if self._status in _TERMINAL:
+                return
+            self._error = str(reason)
+            self._status = FAILED
+            self._cond.notify_all()
+        self._stream_q.put(("err", str(reason)))
+
+    def _cancelled_now(self):
+        with self._cond:
+            if self._status in _TERMINAL:
+                return
+            self._status = CANCELLED
+            self._cond.notify_all()
+        self._stream_q.put(("end", None))
+
+
+class ServingFrontend:
+    """The online serving control plane over N ContinuousBatchingEngine
+    replicas. See the module docstring for the architecture; see
+    docs/SERVING.md for the operator view (SLO classes, routing policy,
+    drain semantics, env vars, metrics)."""
+
+    def __init__(self, engines, scheduler=None, router=None,
+                 poll_wait_s=0.005, heartbeat_deadline_s=30.0,
+                 monitor_interval_s=None, start=True):
+        # heartbeat_deadline_s must outlast the longest single engine call —
+        # a first-compile prefill through a remote-compile tunnel can take
+        # tens of seconds (PROFILE.md), and a false DEAD verdict reroutes a
+        # healthy replica's work. warmup() the engines, then tighten it.
+        if not engines:
+            raise ValueError("need at least one engine replica")
+        self.scheduler = scheduler or SLOScheduler()
+        self.router = router or Router()
+        self.poll_wait_s = float(poll_wait_s)
+        self.heartbeat_deadline_s = float(heartbeat_deadline_s)
+        self.monitor_interval_s = (float(monitor_interval_s)
+                                   if monitor_interval_s is not None
+                                   else max(0.05, self.heartbeat_deadline_s / 4))
+        # a FULLY idle replica (engine empty, nothing routed) waits longer
+        # than poll_wait_s — every transition that creates work sets the
+        # wake event, so the only reason to wake at all is the heartbeat;
+        # capped well under the deadline so idleness never reads as death
+        self.idle_wait_s = min(1.0, self.heartbeat_deadline_s / 4)
+        self.replicas = [ReplicaHandle(f"replica{i}", eng, index=i)
+                         for i, eng in enumerate(engines)]
+        self._by_name = {r.name: r for r in self.replicas}
+        self._lock = threading.Lock()
+        self._rid_counter = itertools.count()
+        self._wakes = {r.name: threading.Event() for r in self.replicas}
+        self._drained = {r.name: threading.Event() for r in self.replicas}
+        self._stop = threading.Event()
+        self._threads = []
+        self._started = False
+        self._class_hists = {}
+        if start:
+            self.start()
+
+    # ---- lifecycle --------------------------------------------------------
+    def start(self):
+        if self._started:
+            return self
+        self._started = True
+        for rep in self.replicas:
+            t = threading.Thread(target=self._run_replica, args=(rep,),
+                                 daemon=True,
+                                 name=f"paddle-serving-{rep.name}")
+            self._threads.append(t)
+            t.start()
+        m = threading.Thread(target=self._run_monitor, daemon=True,
+                             name="paddle-serving-monitor")
+        self._threads.append(m)
+        m.start()
+        return self
+
+    def shutdown(self, timeout=5.0):
+        """Stop dispatchers and the monitor. In-flight work stops at the
+        next block boundary; unfinished handles are failed (never lost)."""
+        self._stop.set()
+        for ev in self._wakes.values():
+            ev.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        with self._lock:
+            orphans = []
+            for rep in self.replicas:
+                orphans.extend(rep.pending)
+                orphans.extend(rep.inflight.values())
+                rep.pending = []
+                rep.inflight = {}
+        for e in orphans:
+            e.handle._fail("frontend shut down")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.shutdown()
+        return False
+
+    # ---- submission -------------------------------------------------------
+    def submit(self, prompt, max_new_tokens, slo_class="interactive",
+               deadline_s=None, eos_token_id=None, do_sample=False,
+               temperature=1.0, top_k=0, top_p=1.0, seed=0,
+               timeout_s=None):
+        """Enqueue one request; returns its RequestHandle immediately.
+
+        Raises Overloaded (load shed — the request was never queued) when
+        the scheduler's queue bound is hit, or NoLiveReplicas when every
+        replica is draining/dead. ``deadline_s`` is relative to now: it
+        tightens the EDF priority and, if it expires before the request
+        starts, the request fails fast with DeadlineExceeded instead of
+        wasting decode slots."""
+        slo = self.scheduler.resolve(slo_class)
+        sampling = canonical_sampling(do_sample, temperature, top_k, top_p)
+        rid = next(self._rid_counter)  # atomic under the GIL
+        req = EngineRequest(rid, prompt, max_new_tokens,
+                            eos_token_id=eos_token_id, sampling=sampling,
+                            seed=seed, timeout_s=timeout_s)
+        handle = RequestHandle(self, req, slo)
+        req.on_token = self._make_on_token(handle, gen=0)
+        deadline_t = (req.t_enqueue + float(deadline_s)
+                      if deadline_s is not None else None)
+        entry = _Entry(req, handle, slo, deadline_t,
+                       self.scheduler.virtual_deadline(
+                           req.t_enqueue, slo, deadline_s))
+        # advisory fast-path shed (unlocked reads): overload traffic must
+        # not pay the O(pages^2) placement probe per rejected submit. The
+        # authoritative check re-runs under the append lock below.
+        try:
+            self.scheduler.check_admission(
+                sum(len(r.pending) for r in self.replicas), slo)
+        except Overloaded:
+            _M_SHED.inc()
+            raise
+        exclude = set()
+        while True:
+            # placement runs OUTSIDE the frontend lock: the prefix-affinity
+            # probe hashes O(pages^2) prompt bytes per replica, and doing
+            # that under the one lock every dispatcher's admission pick
+            # needs would stall all replicas behind each long-prompt submit.
+            # Everything place() reads is advisory; the append below
+            # re-checks the decisions that matter under the lock.
+            rep = self.router.place(entry, self.replicas, exclude=exclude)
+            with self._lock:
+                # checked under the SAME lock shutdown's orphan sweep
+                # holds: an unlocked check could pass, the sweep run, and
+                # the append below then queue an entry no dispatcher will
+                # ever see — a handle that never reaches a terminal state
+                if self._stop.is_set():
+                    raise RuntimeError("frontend is shut down")
+                queued = sum(len(r.pending) for r in self.replicas)
+                try:
+                    # under the append lock so depth can't race past the
+                    # bound (the scheduler's check+enqueue contract)
+                    self.scheduler.check_admission(queued, slo)
+                except Overloaded:
+                    _M_SHED.inc()
+                    raise
+                if rep.state == LIVE:  # can change between place() and here
+                    rep.pending.append(entry)
+                    _M_SUBMITTED.inc()
+                    _M_QUEUE.set(queued + 1)
+                    break
+            exclude.add(rep.name)
+        self.router.committed(entry, rep)
+        self._wakes[rep.name].set()
+        return handle
+
+    def _make_on_token(self, handle, gen):
+        def on_token(rid, tok):
+            handle._push_token(tok, gen)
+        return on_token
+
+    def _cancel(self, handle):
+        # flag first: if the scan below misses the request because its
+        # dispatcher holds it in transit (popped from pending, not yet in
+        # inflight), the dispatcher honors the flag when it re-surfaces
+        handle._cancel_requested = True
+        with self._lock:
+            for rep in self.replicas:
+                for i, e in enumerate(rep.pending):
+                    if e.handle is handle:
+                        rep.pending.pop(i)
+                        _M_CANCELLED.inc()
+                        handle._cancelled_now()
+                        return
+                e = rep.inflight.get(handle.rid)
+                if e is not None and e.handle is handle:
+                    e.req.cancelled = True  # engine retires it next block
+                    self._wakes[rep.name].set()
+                    return
+        # already terminal or unknown: cancel() is idempotent
+
+    # ---- dispatcher -------------------------------------------------------
+    def _run_replica(self, rep):
+        eng = rep.engine
+        wake = self._wakes[rep.name]
+        rep.thread_ident = threading.get_ident()  # for the lock-probe
+        while not self._stop.is_set():
+            rep.beat()
+            rep.publish_gauges()
+            try:
+                # the chaos kill switch for E2E tests: an injected fault
+                # here is a replica crash (dispatcher dies mid-flight)
+                chaos.site("serving.replica_kill")
+            except BaseException as e:
+                self._replica_died(rep, e)
+                return
+            if rep.state == DEAD:
+                return
+            progressed = False
+            try:
+                if rep.state == LIVE:
+                    progressed |= self._admit_pending(rep)
+                if not eng.idle():
+                    for r in eng.step():
+                        self._finish(rep, r)
+                    progressed = True
+                elif rep.state == DRAINING and not rep.inflight:
+                    self._drained[rep.name].set()
+            except BaseException as e:
+                # anything escaping the engine hooks is replica-fatal (the
+                # hooks isolate request-level failures internally).
+                # BaseException, not Exception: _admit_pending re-raises
+                # BaseException after re-appending the in-transit entry, and
+                # a SystemExit/KeyboardInterrupt on this thread must mark
+                # the replica DEAD and relocate its work — a silently dead
+                # dispatcher would leave the replica LIVE and its requests
+                # hanging until the heartbeat deadline
+                self._replica_died(rep, e)
+                return
+            if not progressed:
+                # unlocked len() is a heuristic only: submit/_requeue append
+                # BEFORE setting the wake event, so a stale empty read still
+                # wakes immediately off the event
+                idle = eng.idle() and not rep.pending
+                wake.wait(self.idle_wait_s if idle else self.poll_wait_s)
+                wake.clear()
+
+    def _admit_pending(self, rep):
+        eng, moved = rep.engine, False
+        while rep.state == LIVE and eng.has_free_slot():
+            with self._lock:
+                i = self.scheduler.pick(rep.pending)
+                if i is None:
+                    break
+                entry = rep.pending.pop(i)
+                _M_QUEUE.set(sum(len(r.pending) for r in self.replicas))
+            if entry.handle._cancel_requested:
+                _M_CANCELLED.inc()
+                entry.handle._cancelled_now()
+                moved = True
+                continue
+            if self.scheduler.expired(entry):
+                _M_EXPIRED.inc()
+                _M_FAILED.inc()
+                entry.handle._fail(DeadlineExceeded(
+                    f"request {entry.req.rid} ({entry.slo.name}) spent "
+                    f"longer than its deadline queued"))
+                moved = True
+                continue
+            # while the entry is in neither pending nor inflight, a death/
+            # drain sweep cannot see it — every exit below must put it back
+            # somewhere sweepable (or hand it to the relocation path) before
+            # giving up the thread, or its handle would hang forever
+            try:
+                status = eng.try_admit_one(entry.req)
+            except BaseException:
+                # the raise is about to reach _run_replica, whose handler
+                # calls _replica_died -> sweeps pending. That sweep is a
+                # no-op if the monitor/kill() ALREADY declared the replica
+                # DEAD while we were stuck in the engine call — an entry
+                # re-appended then would never be swept again, so hand it
+                # straight to the relocation path instead
+                with self._lock:
+                    already_dead = rep.state == DEAD
+                    if not already_dead:
+                        rep.pending.append(entry)  # swept by _replica_died
+                if already_dead:
+                    self._requeue(entry, exclude={rep.name},
+                                  fail_reason=f"replica {rep.name} died "
+                                              f"during admission: "
+                                              f"{rep.death_reason}")
+                raise
+            if status == "deferred":
+                with self._lock:
+                    stranded = rep.state != LIVE
+                    if not stranded:
+                        rep.pending.append(entry)
+                if stranded:  # the sweep ran while we held the entry
+                    self._requeue(entry, exclude={rep.name},
+                                  fail_reason=f"{rep.name} became "
+                                              f"{rep.state} during admission")
+                elif self._stop.is_set():
+                    # shutdown's orphan sweep may have already swept this
+                    # pending list while the entry was in transit; failing
+                    # directly is idempotent with the sweep
+                    entry.handle._fail("frontend shut down")
+                break
+            moved = True
+            if status == "admitted":
+                with self._lock:
+                    dead = rep.state == DEAD
+                    if not dead:
+                        rep.inflight[entry.req.rid] = entry
+                entry.handle._mark_running(rep.name)
+                self._observe_admission(entry)
+                if entry.handle._cancel_requested:
+                    entry.req.cancelled = True  # retires at next block
+                if dead:  # death sweep missed the in-transit entry
+                    self._relocate_inflight(entry, rep,
+                                            f"replica {rep.name} died: "
+                                            f"{rep.death_reason}")
+                    break
+                if self._stop.is_set():
+                    # same transit race against shutdown's sweep
+                    entry.handle._fail("frontend shut down")
+                    break
+            elif status == "done":
+                entry.handle._mark_running(rep.name)
+                self._observe_admission(entry)
+                self._finish(rep, entry.req, entry=entry)
+            else:  # "failed"
+                _M_FAILED.inc()
+                entry.handle._fail(entry.req.error_message)
+        return moved
+
+    def _finish(self, rep, req, entry=None):
+        if entry is None:
+            with self._lock:
+                entry = rep.inflight.pop(req.rid, None)
+            if entry is None:
+                return  # already resolved (reroute/cancel race)
+        handle = entry.handle
+        if req.error is not None:
+            _M_FAILED.inc()
+            handle._fail(req.error_message)
+        elif req.cancelled:
+            _M_CANCELLED.inc()
+            handle._cancelled_now()
+        else:
+            _M_COMPLETED.inc()
+            self._observe_completion(entry)
+            handle._complete(req)
+
+    # ---- replica death / drain -------------------------------------------
+    def kill(self, replica, reason="killed by operator"):
+        """Declare a replica dead NOW (ops/test hook — the same path chaos
+        and the heartbeat monitor take)."""
+        self._replica_died(self._resolve_replica(replica),
+                           RuntimeError(reason))
+
+    def drain(self, replica, timeout=30.0):
+        """Stop routing to ``replica``, finish its in-flight requests, and
+        re-queue its pending (not-yet-admitted) requests onto the other
+        replicas. Returns True once the replica is idle (False on timeout).
+        The replica stays DRAINING — call revive() to return it to LIVE."""
+        rep = self._resolve_replica(replica)
+        with self._lock:
+            if rep.state == DEAD:
+                raise ValueError(f"{rep.name} is DEAD, nothing to drain")
+            rep.state = DRAINING
+            self._drained[rep.name].clear()
+            pending, rep.pending = rep.pending, []
+        for entry in pending:
+            _M_DRAIN_REQUEUED.inc()
+            self._requeue(entry, exclude={rep.name},
+                          fail_reason=f"{rep.name} draining")
+        self._wakes[rep.name].set()
+        # the DRAINED signal comes from the dispatcher thread only: it is
+        # the one thread that can hold an entry in transit between pending
+        # and inflight, so its own idle check can never fire early
+        return self._drained[rep.name].wait(timeout)
+
+    def revive(self, replica):
+        """DRAINING -> LIVE (a drained replica rejoining the pool)."""
+        rep = self._resolve_replica(replica)
+        with self._lock:
+            if rep.state == DEAD:
+                raise ValueError(f"{rep.name} is DEAD; build a new engine "
+                                 f"and frontend instead of reviving")
+            rep.state = LIVE
+        self._wakes[rep.name].set()
+
+    def _resolve_replica(self, replica):
+        if isinstance(replica, ReplicaHandle):
+            return replica
+        try:
+            return self._by_name[replica]
+        except KeyError:
+            raise ValueError(f"unknown replica {replica!r}; have "
+                             f"{sorted(self._by_name)}") from None
+
+    def _replica_died(self, rep, exc):
+        """Mark DEAD and relocate its work: queued + unconsumed in-flight
+        requests re-route (identical outputs — key streams are replica-
+        independent); consumed streams fail with the death reason."""
+        with self._lock:
+            if rep.state == DEAD:
+                return
+            rep.state = DEAD
+            rep.death_reason = f"{type(exc).__name__}: {exc}"
+            pending, rep.pending = rep.pending, []
+            inflight, rep.inflight = list(rep.inflight.values()), {}
+        _M_REPLICA_DEAD.inc()
+        self.router.forget_replica(rep.name)
+        reason = f"replica {rep.name} died: {rep.death_reason}"
+        for entry in pending:
+            self._requeue(entry, exclude={rep.name}, fail_reason=reason)
+        for entry in inflight:
+            self._relocate_inflight(entry, rep, reason)
+
+    def _relocate_inflight(self, entry, rep, reason):
+        """One in-flight entry whose replica just died: honor a racing
+        cancel, fail a consumed stream (a restart would duplicate or reorder
+        observed tokens), transparently re-route anything else (identical
+        output — key streams are replica-independent)."""
+        if entry.req.cancelled or entry.handle._cancel_requested:
+            # the cancel raced the death: honor it now instead of rerouting
+            # a request nobody wants (the clone would not carry the flag)
+            _M_CANCELLED.inc()
+            entry.handle._cancelled_now()
+            return
+        gen = entry.handle._reset_for_reroute()
+        if gen is None:  # stream consumed — only a clean failure is safe
+            _M_FAILED.inc()
+            entry.handle._fail(reason)
+            return
+        # the clone keeps t_enqueue so the NEXT admission's queue_wait/ttft
+        # samples span the whole journey including the dead replica's time
+        # (clone_for_retry's contract) — re-arm the once-only observation
+        entry.observed = False
+        entry.req = entry.req.clone_for_retry()
+        entry.req.on_token = self._make_on_token(entry.handle, gen)
+        self._requeue(entry, exclude={rep.name}, fail_reason=reason,
+                      rerouted=True)
+
+    def _requeue(self, entry, exclude, fail_reason, rerouted=False):
+        if entry.handle.done():
+            return
+        # status flips BEFORE the entry becomes visible in a pending list:
+        # flipping after the append races the target dispatcher, whose
+        # _mark_running could land first and be clobbered back to QUEUED
+        # for the rest of the request's run
+        entry.handle._mark_queued()
+        exclude = set(exclude)
+        while True:
+            try:
+                target = self.router.place(entry, self.replicas,
+                                           exclude=exclude)
+            except Exception as e:  # NoLiveReplicas, chaos faults, ...
+                _M_FAILED.inc()
+                entry.handle._fail(f"{fail_reason}; re-route failed: {e}")
+                return
+            with self._lock:
+                # re-check under the lock: the target can die or start
+                # draining between place() and here, and an entry appended
+                # to a swept pending list would never be seen again — same
+                # for shutdown's orphan sweep (the monitor thread can still
+                # be relocating a dead replica's work while it runs)
+                if self._stop.is_set():
+                    shut_down = True
+                else:
+                    shut_down = False
+                    if target.state == LIVE:
+                        target.pending.append(entry)
+                        break
+            if shut_down:
+                # idempotent with the sweep: _fail is once-only
+                _M_FAILED.inc()
+                entry.handle._fail("frontend shut down")
+                return
+            exclude.add(target.name)
+        self.router.committed(entry, target)
+        if rerouted:
+            _M_REROUTED.inc()
+        self._wakes[target.name].set()
+
+    def _run_monitor(self):
+        """Heartbeat watchdog over the dispatcher threads: a replica whose
+        dispatcher stops beating (wedged in a jitted call, killed by a
+        chaos fault that swallowed the thread) is declared DEAD so its
+        requests relocate instead of hanging their handles forever."""
+        while not self._stop.is_set():
+            now = time.monotonic()
+            for rep in self.replicas:
+                self._check_replica_liveness(rep, now)
+            self._stop.wait(self.monitor_interval_s)
+
+    def _check_replica_liveness(self, rep, now):
+        """One monitor verdict for one replica (factored out so tests can
+        drive it with crafted lock/beat states)."""
+        if rep.state == DEAD:
+            return
+        if now - rep.last_beat <= self.heartbeat_deadline_s:
+            return
+        # the process-wide dispatch lock serializes jitted calls across
+        # in-process replicas, so N serialized first-compiles can silence
+        # a dispatcher for the SUM of compile times — a replica queued
+        # behind a held lock is not dead; defer the (irreversible) verdict
+        # while THIS replica's dispatcher is a lock participant (holder or
+        # blocked acquirer) and the current hold is younger than the
+        # deadline. Both conditions matter: a dispatcher wedged OUTSIDE
+        # the lock (post-lock host sync, a blocking user callback) must
+        # not ride out its verdict on other threads' healthy compiles, and
+        # a hold OLDER than the deadline is itself a hung device call —
+        # deferring then would hang every handle forever, so the verdict
+        # proceeds and the work relocates (or, once every blocked replica
+        # is declared, fails cleanly).
+        if rep.thread_ident in _ENGINE_DISPATCH_LOCK.participants():
+            held = _ENGINE_DISPATCH_LOCK.held_since()
+            if held is None or now - held <= self.heartbeat_deadline_s:
+                return  # compiling, or queued behind a fresh hold
+        self._replica_died(rep, TimeoutError(
+            f"dispatcher heartbeat stale {now - rep.last_beat:.1f}s "
+            f"(> {self.heartbeat_deadline_s}s)"))
+
+    # ---- telemetry --------------------------------------------------------
+    def _class_hist(self, kind, slo_name):
+        key = (kind, slo_name)
+        with self._lock:  # dispatchers insert, serving_report() iterates
+            h = self._class_hists.get(key)
+            if h is None:
+                h = self._class_hists[key] = _registry.histogram(
+                    f"serving.{kind}.{slo_name}")
+            return h
+
+    def _observe_admission(self, entry):
+        if entry.observed:
+            return  # once per admission (reroutes re-arm the flag so the
+            # failover tail lands in the histograms)
+        entry.observed = True
+        req, name = entry.req, entry.slo.name
+        self._class_hist("queue_wait_s", name).observe(
+            req.t_admit - req.t_enqueue)
+        self._class_hist("ttft_s", name).observe(
+            req.t_first_token - req.t_enqueue)
+
+    def _observe_completion(self, entry):
+        req = entry.req
+        if req.n_generated > 1 and req.t_first_token is not None:
+            self._class_hist("tpot_s", entry.slo.name).observe(
+                (req.t_done - req.t_first_token) / (req.n_generated - 1))
+
+    def serving_report(self):
+        """One structured snapshot of the whole control plane: per-replica
+        health/occupancy, per-SLO-class latency summaries, and every
+        serving.* counter — the operator's `kubectl describe` for the
+        serving cell."""
+        def _summary(h):
+            return {"count": h.count, "mean": round(h.mean, 6),
+                    "p50": h.quantile(0.5), "p99": h.quantile(0.99)}
+
+        with self._lock:
+            hists = sorted(self._class_hists.items())
+            replicas = {r.name: r.snapshot() for r in self.replicas}
+        classes = {}
+        for (kind, name), h in hists:
+            classes.setdefault(name, {})[kind] = _summary(h)
+        counters = {n: _registry.get(n).value for n in _registry.names("serving.")
+                    if hasattr(_registry.get(n), "value")
+                    and not hasattr(_registry.get(n), "hwm")}
+        return {
+            "replicas": replicas,
+            "slo_classes": classes,
+            "counters": {k: v for k, v in counters.items() if v},
+            "queue_depth": sum(len(r.pending) for r in self.replicas),
+        }

@@ -1,0 +1,245 @@
+"""Multi-replica placement: prefix-cache affinity blended with load, plus
+replica health.
+
+The TPU serving comparison (PAPERS.md) and Ragged Paged Attention both make
+the same point: above a fast attention kernel, serving throughput is won in
+the layer that decides WHERE a request runs. Two requests sharing a system
+prompt served by the same replica cost one prefill and a page-table
+pointer; scattered across replicas they cost two full prefills and double
+the pool pressure. The router therefore scores every LIVE replica as
+
+    score = affinity_weight * prefix_fraction        # indexed pages NOW
+          + hint_weight     * session_hint           # where this prefix went
+          - load_weight     * replica.load()         # slots + pages + queue
+
+and places on the argmax. ``prefix_fraction`` probes the engine's
+content-addressed prefix index (read-only dict lookups — safe against the
+dispatcher thread). The *session hint* covers the race the index can't: the
+second request of a new prefix usually arrives before the first finishes
+prefilling, so the index is still empty — the hint table remembers which
+replica the prefix was last routed to and keeps the session sticky.
+
+Health is a three-state ladder per replica — ``LIVE`` (routable),
+``DRAINING`` (finishes in-flight work, admits nothing, receives no new
+placements), ``DEAD`` (gone; its queue is rerouted) — driven by the PR-2
+watchdog heartbeat mechanism: every dispatcher loop stamps
+:meth:`ReplicaHandle.beat` (and, when ``PADDLE_TELEMETRY_DIR`` is set,
+launcher-format ``serving/heartbeat.<idx>.json`` files — namespaced so
+replica indexes never clobber training ranks' files), and the frontend's
+monitor declares a replica DEAD when its beat goes stale.
+
+Chaos site ``serving.route`` fires on every placement decision so tests can
+inject routing outages; ``serving.replica_kill`` (in the frontend's
+dispatcher loop) kills a replica mid-flight.
+"""
+import os
+import threading
+import time
+
+from ..observability.metrics import registry as _registry
+from ..testing import chaos
+
+__all__ = ["LIVE", "DRAINING", "DEAD", "NoLiveReplicas", "ReplicaHandle",
+           "Router"]
+
+LIVE = "LIVE"
+DRAINING = "DRAINING"
+DEAD = "DEAD"
+
+_M_ROUTED = _registry.counter("serving.routed")
+_M_AFFINITY_PLACED = _registry.counter("serving.routed_by_affinity")
+
+
+class NoLiveReplicas(RuntimeError):
+    """Every replica is DRAINING or DEAD — nothing can take the request."""
+
+
+class ReplicaHandle:
+    """One engine replica as the control plane sees it: the engine, its
+    pending (routed-but-not-admitted) queue, health state, and liveness
+    beats. All mutable fields are guarded by the frontend's lock except
+    ``last_beat`` (a monotonic float stamped only by the dispatcher and read
+    by the monitor — a benign single-writer race)."""
+
+    def __init__(self, name, engine, index=0):
+        self.name = str(name)
+        self.engine = engine
+        self.index = int(index)
+        self.state = LIVE
+        self.pending = []          # routed Entry objects, scheduler-ordered
+        self.inflight = {}         # rid -> Entry, admitted into the engine
+        self.last_beat = time.monotonic()
+        self.thread_ident = None   # stamped by the dispatcher thread itself
+        self.death_reason = None
+        # PR-2 integration: when the launcher exports PADDLE_TELEMETRY_DIR,
+        # serving replicas publish launcher-format heartbeat files — in
+        # their OWN serving/ subdirectory, NOT the telemetry root: replica
+        # indexes overlap training rank numbers, and a replica beating
+        # heartbeat.<rank>.json would mask a genuinely hung trainer of the
+        # same rank from the pod HangWatchdog (and vice versa)
+        self._wd_heartbeat = None
+        self._wd_last_write = 0.0
+        d = os.environ.get("PADDLE_TELEMETRY_DIR")
+        if d:
+            try:
+                from ..observability.watchdog import Heartbeat
+
+                self._wd_heartbeat = Heartbeat(os.path.join(d, "serving"),
+                                               rank=self.index,
+                                               install_faulthandler=False)
+            except OSError:
+                self._wd_heartbeat = None
+        self._occ_gauge = _registry.gauge(
+            f"serving.replica.{self.name}.occupancy")
+        self._queue_gauge = _registry.gauge(
+            f"serving.replica.{self.name}.queue_depth")
+        self._pages_gauge = _registry.gauge(
+            f"serving.replica.{self.name}.pages_in_use")
+
+    def beat(self, step=None):
+        now = time.monotonic()
+        self.last_beat = now
+        # the in-memory stamp is per-loop; the FILE write (json + rename) is
+        # rate-limited — an idle dispatcher loops ~200x/s and the pod
+        # watchdog samples at whole-second granularity anyway
+        if self._wd_heartbeat is not None and now - self._wd_last_write >= 1.0:
+            self._wd_last_write = now
+            try:
+                self._wd_heartbeat.beat(step=step, role="serving")
+            except OSError:
+                pass  # full disk must not take the dispatcher down
+
+    def publish_gauges(self):
+        eng = self.engine
+        self._occ_gauge.set(eng.active_count() / eng.max_seqs)
+        self._queue_gauge.set(len(self.pending))
+        self._pages_gauge.set(eng.pages_in_use())
+
+    def load(self):
+        """0..~1 pressure blend: decode slots, pool pages, queue depth. Each
+        term saturates at 1 so one exhausted resource reads as heavy load
+        even when the others are idle."""
+        eng = self.engine
+        slots = eng.active_count() / eng.max_seqs
+        pages = eng.pages_in_use() / max(1, eng.num_pages - 1)
+        queue = min(1.0, len(self.pending) / max(1, eng.max_seqs * 2))
+        return (slots + pages + queue) / 3.0
+
+    def prefix_fraction(self, prompt):
+        """Fraction of this prompt's full pages already indexed here."""
+        total = max(1, (len(prompt) - 1) // self.engine.page_size)
+        return self.engine.prefix_match_pages(prompt) / total
+
+    def snapshot(self):
+        return {
+            "state": self.state,
+            "active": self.engine.active_count(),
+            "max_seqs": self.engine.max_seqs,
+            "pending": len(self.pending),
+            "pages_in_use": self.engine.pages_in_use(),
+            "load": round(self.load(), 4),
+            "death_reason": self.death_reason,
+        }
+
+    def __repr__(self):
+        return f"ReplicaHandle({self.name!r}, {self.state})"
+
+
+class Router:
+    """Placement policy over a replica set. ``policy='prefix'`` (default)
+    scores affinity+load as in the module docstring; ``policy='round_robin'``
+    is the baseline the E2E test compares hit rates against; ``policy='load'``
+    is pure least-loaded (affinity weights zeroed)."""
+
+    #: tokens hashed for the session-hint key — one engine page is the
+    #: natural sharing granularity, and 16 matches the default page_size
+    HINT_TOKENS = 16
+
+    def __init__(self, policy="prefix", affinity_weight=1.0, hint_weight=0.5,
+                 load_weight=1.0, max_hints=4096):
+        if policy not in ("prefix", "round_robin", "load"):
+            raise ValueError(f"unknown router policy {policy!r}")
+        self.policy = policy
+        self.affinity_weight = float(affinity_weight)
+        self.hint_weight = float(hint_weight)
+        self.load_weight = float(load_weight)
+        self.max_hints = int(max_hints)
+        self._hints = {}   # prefix-head bytes -> replica name (insertion LRU)
+        self._rr = 0
+        # place() is called from the submit path (under the frontend lock)
+        # AND from reroute/monitor paths (not under it) — the hint table and
+        # rr cursor need their own lock or a concurrent LRU-evict can pop
+        # the same head key twice (KeyError)
+        self._lock = threading.Lock()
+
+    def _hint_key(self, prompt):
+        return prompt[:self.HINT_TOKENS].tobytes()
+
+    def place(self, entry, replicas, exclude=()):
+        """Pick a LIVE replica for ``entry`` (an object with ``.req``).
+        ``exclude`` names replicas the request must avoid (the one that just
+        died under it). Raises NoLiveReplicas when nothing can take it.
+
+        Pure decision — no hint writes, no counters. The frontend calls
+        :meth:`committed` once the entry actually lands in a pending list,
+        so a submission that is subsequently SHED (or loses the append
+        race) cannot re-home a live session's hint to a replica it never
+        reached, and the routing counters count real placements only."""
+        chaos.site("serving.route")
+        live = [r for r in replicas
+                if r.state == LIVE and r.name not in exclude]
+        if not live:
+            raise NoLiveReplicas(
+                f"no LIVE replica for request {entry.req.rid} "
+                f"(states: {[(r.name, r.state) for r in replicas]})")
+        # no len(live)==1 shortcut for the scoring policies: the prefix
+        # policy must still score (and later record the session hint)
+        # while one replica has the pool to itself (a drain window), or
+        # every session re-homes blind when the drained replica returns
+        with self._lock:  # _hints read + rr cursor only — the O(pages^2)
+            # affinity probe below must not serialize concurrent submits
+            # or make a replica-death relocation queue behind them
+            if self.policy == "round_robin":
+                pick = live[self._rr % len(live)]
+                self._rr += 1
+                entry.route_affinity = False
+                return pick
+            prompt = entry.req.prompt
+            hinted = self._hints.get(self._hint_key(prompt))
+        best, best_score, best_aff = None, None, 0.0
+        for r in live:
+            if self.policy == "load":
+                aff = hint = 0.0
+            else:
+                aff = r.prefix_fraction(prompt)
+                hint = 1.0 if r.name == hinted else 0.0
+            score = (self.affinity_weight * aff + self.hint_weight * hint
+                     - self.load_weight * r.load())
+            if best_score is None or score > best_score:
+                best, best_score, best_aff = r, score, aff
+        entry.route_affinity = best_aff > 0.0 or hinted == best.name
+        return best
+
+    def committed(self, entry, rep):
+        """The placement landed: record it. Counters here (not in place())
+        so shed/raced submissions don't count, and the session hint only
+        re-homes for requests that will actually warm ``rep``'s cache."""
+        _M_ROUTED.inc()
+        if entry.route_affinity:
+            _M_AFFINITY_PLACED.inc()
+        if self.policy != "prefix":
+            return
+        # remember the session: the NEXT request with this prefix head
+        # goes to the same replica even before the index has its pages
+        key = self._hint_key(entry.req.prompt)
+        with self._lock:
+            self._hints.pop(key, None)
+            self._hints[key] = rep.name
+            while len(self._hints) > self.max_hints:
+                self._hints.pop(next(iter(self._hints)))
+
+    def forget_replica(self, name):
+        """Drop a dead replica's session hints so new traffic re-homes."""
+        with self._lock:
+            for k in [k for k, v in self._hints.items() if v == name]:
+                del self._hints[k]

@@ -41,10 +41,24 @@ HOT_PATHS=(
   paddle_tpu/inference/continuous.py
   paddle_tpu/io/dataloader.py
   paddle_tpu/distributed/communication/ops.py
+  paddle_tpu/serving/frontend.py
+  paddle_tpu/serving/scheduler.py
+  paddle_tpu/serving/router.py
 )
 if grep -nE '\btime\.time\(|(^|[^.[:alnum:]_])print\(' "${HOT_PATHS[@]}"; then
   echo "lint: raw time.time()/print() in hot-path files above —" \
        "route timing/diagnostics through paddle_tpu.observability" >&2
+  exit 1
+fi
+
+# serving hot-path lint (ISSUE 4 satellite): the control plane must never
+# blocking-sleep — the only legal wait is the dispatcher's wake-EVENT
+# timeout (threading.Event/Condition waits, which a submit or a shutdown
+# interrupts instantly). A time.sleep anywhere in paddle_tpu/serving/ is a
+# latency bug: it holds a dispatcher hostage for the full duration.
+if grep -nE '\btime\.sleep\(' paddle_tpu/serving/*.py; then
+  echo "lint: blocking time.sleep in paddle_tpu/serving/ above — wait on" \
+       "the dispatcher wake event (threading.Event.wait) instead" >&2
   exit 1
 fi
 
@@ -78,6 +92,7 @@ FAST_TESTS=(
   tests/test_dist_checkpoint.py
   tests/test_nn.py
   tests/test_inference.py
+  tests/test_serving_frontend.py
 )
 
 if [[ "${1:-}" == "--fast" ]]; then

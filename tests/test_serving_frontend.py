@@ -1,0 +1,979 @@
+"""Online serving control plane (ISSUE 4): frontend lifecycle, SLO-aware
+scheduling, prefix-aware multi-replica routing, and the failure contract.
+
+Two tiers of oracle:
+
+- a **FakeEngine** implementing the engine's online hook protocol
+  (try_admit_one/step/idle/...) with deterministic token emission, so every
+  control-plane decision — shedding, EDF fairness, drain, reroute,
+  heartbeat death, chaos sites — is tested in milliseconds without a model;
+- the **real** ContinuousBatchingEngine (tiny llama) for the satellites
+  that live in the engine (per-request max_new_tokens, per-request failure
+  reasons, the O(1) pages counter vs the scan) and the E2E chaos test:
+  2 replicas, concurrent mixed-SLO load, a chaos-killed replica mid-stream,
+  drain, and prefix-affinity routing beating round-robin on cache hits.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+from paddle_tpu.serving import (
+    BATCH,
+    CANCELLED,
+    DEAD,
+    DONE,
+    DRAINING,
+    FAILED,
+    INTERACTIVE,
+    LIVE,
+    NoLiveReplicas,
+    Overloaded,
+    RequestCancelled,
+    RequestFailed,
+    Router,
+    ServingFrontend,
+    SLOClass,
+    SLOScheduler,
+)
+from paddle_tpu.serving.frontend import _Entry  # noqa: F401  (repr sanity)
+from paddle_tpu.testing import chaos
+
+
+# ---------------------------------------------------------------------------
+# FakeEngine: the online hook protocol without a model
+# ---------------------------------------------------------------------------
+class FakeEngine:
+    """Deterministic double for the engine's online hooks. Admission emits
+    the prompt's last token as tok0; every step() repeats it, so a request's
+    full result is ``prompt + [prompt[-1]] * max_new_tokens`` on ANY replica
+    — exactly the replica-independence the reroute contract relies on."""
+
+    def __init__(self, max_seqs=2, page_size=8, num_pages=17,
+                 pages_per_req=2, step_delay=0.0, step_barrier=None):
+        self.max_seqs = max_seqs
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.pages_per_req = pages_per_req
+        self.step_delay = step_delay
+        self.step_barrier = step_barrier   # step() blocks until set
+        self.admit_paused = False          # True -> everything defers
+        self._active = {}
+        self._pages = 0
+        self._prefix_keys = set()
+        self.prefix_hits = 0
+        self.admitted = 0
+
+    # -- hook protocol ------------------------------------------------------
+    def idle(self):
+        return not self._active
+
+    def active_count(self):
+        return len(self._active)
+
+    def has_free_slot(self):
+        return len(self._active) < self.max_seqs
+
+    def pages_in_use(self):
+        return self._pages
+
+    def prefix_match_pages(self, prompt):
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        n = 0
+        for j in range((len(p) - 1) // self.page_size):
+            if p[:(j + 1) * self.page_size].tobytes() in self._prefix_keys:
+                n += 1
+            else:
+                break
+        return n
+
+    def try_admit_one(self, req):
+        if self.admit_paused or not self.has_free_slot():
+            return "deferred"
+        p = req.prompt
+        if len(p) + req.max_new_tokens > 10_000:  # "impossible" request
+            req.error = ValueError(
+                f"request {req.rid} exceeds fake capacity")
+            req.finished = True
+            req.t_done = time.monotonic()
+            return "failed"
+        self.prefix_hits += self.prefix_match_pages(p)
+        for j in range((len(p) - 1) // self.page_size):
+            self._prefix_keys.add(p[:(j + 1) * self.page_size].tobytes())
+        now = time.monotonic()
+        req.t_admit = now
+        req.t_first_token = now
+        tok0 = int(p[-1])
+        req.tokens = list(p) + [tok0]
+        req.n_generated = 1
+        req.last_token = tok0
+        self.admitted += 1
+        if req.on_token is not None:
+            req.on_token(req.rid, tok0)
+        if req.max_new_tokens == 1 or (req.eos_token_id is not None
+                                       and tok0 == req.eos_token_id):
+            self._retire(req)
+            return "done"
+        self._active[req.rid] = req
+        self._pages += self.pages_per_req
+        return "admitted"
+
+    def _retire(self, req):
+        if self._active.pop(req.rid, None) is not None:
+            self._pages -= self.pages_per_req
+        req.result = np.asarray(req.tokens, np.int32)
+        req.finished = True
+        req.t_done = time.monotonic()
+
+    def step(self):
+        if self.step_barrier is not None:
+            self.step_barrier.wait()
+        if self.step_delay:
+            time.sleep(self.step_delay)
+        retired = []
+        for req in list(self._active.values()):
+            if req.cancelled:
+                self._retire(req)
+                retired.append(req)
+                continue
+            tok = req.last_token
+            req.tokens.append(tok)
+            req.n_generated += 1
+            if req.on_token is not None:
+                req.on_token(req.rid, tok)
+            if req.n_generated >= req.max_new_tokens or (
+                    req.eos_token_id is not None and tok == req.eos_token_id):
+                self._retire(req)
+                retired.append(req)
+        return retired
+
+
+def _prompt(head, tail, page=8):
+    """[head]*page tokens of shared prefix + a distinguishing tail token."""
+    return np.asarray([head] * page + [tail], np.int32)
+
+
+def _expected(prompt, max_new):
+    p = np.asarray(prompt, np.int32)
+    return np.concatenate([p, np.full(max_new, p[-1], np.int32)])
+
+
+# ---------------------------------------------------------------------------
+# scheduler policy units
+# ---------------------------------------------------------------------------
+class TestScheduler:
+    def test_resolve_and_unknown_class(self):
+        s = SLOScheduler()
+        assert s.resolve("interactive") is INTERACTIVE
+        assert s.resolve(BATCH) is BATCH
+        with pytest.raises(ValueError, match="unknown slo_class"):
+            s.resolve("platinum")
+
+    def test_admission_reserve_protects_interactive(self):
+        s = SLOScheduler(max_queue_depth=4, interactive_reserve=0.25)
+        # batch sheds at int(4 * 0.75) = 3; interactive at the full 4
+        s.check_admission(2, BATCH)
+        with pytest.raises(Overloaded):
+            s.check_admission(3, BATCH)
+        s.check_admission(3, INTERACTIVE)
+        with pytest.raises(Overloaded):
+            s.check_admission(4, INTERACTIVE)
+
+    def test_virtual_deadline_takes_tighter_bound(self):
+        t0 = 100.0
+        assert SLOScheduler.virtual_deadline(t0, BATCH) == t0 + 2.0
+        assert SLOScheduler.virtual_deadline(t0, BATCH, deadline_s=0.5) \
+            == t0 + 0.5
+        assert SLOScheduler.virtual_deadline(t0, INTERACTIVE, deadline_s=9.0) \
+            == t0 + INTERACTIVE.target_wait_s
+
+    def test_edf_pick_is_starvation_free(self):
+        """The fairness core: a batch request that has waited past the gap
+        between the class targets sorts BEFORE any later interactive
+        arrival, so nothing submitted after it can overtake forever."""
+        class E:
+            def __init__(self, vd):
+                self.virtual_deadline = vd
+
+        t0 = 1000.0
+        batch = E(SLOScheduler.virtual_deadline(t0, BATCH))
+        # interactive arrivals keep flooding in AFTER the batch request:
+        # once their enqueue time passes t0 + (2.0 - 0.05), every one of
+        # them has a LATER virtual deadline than the aged batch request
+        late = [E(SLOScheduler.virtual_deadline(t0 + 2.0 + i, INTERACTIVE))
+                for i in range(50)]
+        pending = late[:25] + [batch] + late[25:]
+        assert pending[SLOScheduler.pick(pending)] is batch
+        # ... while an interactive request that arrived EARLY still wins
+        early = E(SLOScheduler.virtual_deadline(t0 + 0.1, INTERACTIVE))
+        pending = [batch, early]
+        assert pending[SLOScheduler.pick(pending)] is early
+        assert SLOScheduler.pick([]) is None
+
+
+# ---------------------------------------------------------------------------
+# router policy units
+# ---------------------------------------------------------------------------
+class TestRouter:
+    def _replicas(self, n=2, **kw):
+        from paddle_tpu.serving.router import ReplicaHandle
+
+        return [ReplicaHandle(f"replica{i}", FakeEngine(**kw), index=i)
+                for i in range(n)]
+
+    def _entry(self, prompt, rid=0):
+        from paddle_tpu.inference.continuous import EngineRequest
+
+        class E:
+            pass
+
+        e = E()
+        e.req = EngineRequest(rid, prompt, 4)
+        return e
+
+    def test_bad_policy_rejected(self):
+        with pytest.raises(ValueError, match="unknown router policy"):
+            Router(policy="random")
+
+    def test_no_live_replicas(self):
+        reps = self._replicas(2)
+        reps[0].state = DEAD
+        reps[1].state = DRAINING
+        with pytest.raises(NoLiveReplicas):
+            Router().place(self._entry(_prompt(1, 2)), reps)
+
+    def test_prefix_affinity_and_session_hint(self):
+        reps = self._replicas(2)
+        r = Router()
+        p = np.asarray([7] * 17, np.int32)
+        e0 = self._entry(p, 0)
+        first = r.place(e0, reps)
+        r.committed(e0, first)  # the frontend records once the entry lands
+        # the index is still empty, but the session hint must keep the
+        # same prefix sticky to wherever the first request went
+        assert r.place(self._entry(p, 1), reps) is first
+        # once the replica has the pages indexed, affinity (not just the
+        # hint) points there even when its load is higher
+        first.engine.try_admit_one(self._entry(p, 2).req)
+        assert first.engine.prefix_match_pages(p) > 0
+        assert r.place(self._entry(p, 3), reps) is first
+
+    def test_load_spreads_distinct_prefixes(self):
+        reps = self._replicas(2)
+        r = Router()
+        busy = r.place(self._entry(np.asarray([1] * 9, np.int32), 0), reps)
+        # fill the chosen replica's slots; an unrelated prefix must go to
+        # the idle one (load term dominates when affinity is zero)
+        for rid in range(busy.engine.max_seqs):
+            busy.engine.try_admit_one(
+                self._entry(np.asarray([1] * 9, np.int32), 10 + rid).req)
+        other = r.place(self._entry(np.asarray([2] * 9, np.int32), 1), reps)
+        assert other is not busy
+
+    def test_round_robin_alternates(self):
+        reps = self._replicas(2)
+        r = Router(policy="round_robin")
+        p = _prompt(3, 4)
+        picks = [r.place(self._entry(p, i), reps).name for i in range(4)]
+        assert picks == ["replica0", "replica1", "replica0", "replica1"]
+
+    def test_forget_replica_drops_hints(self):
+        reps = self._replicas(2)
+        r = Router()
+        p = np.asarray([9] * 17, np.int32)
+        e0 = self._entry(p, 0)
+        first = r.place(e0, reps)
+        r.committed(e0, first)
+        assert first.name in r._hints.values()
+        r.forget_replica(first.name)
+        assert first.name not in r._hints.values()
+
+    def test_uncommitted_place_records_no_hint(self):
+        """A placement that never lands (shed submit, lost append race)
+        must not re-home a session hint or count as a routed placement."""
+        reps = self._replicas(2)
+        r = Router()
+        p = np.asarray([11] * 17, np.int32)
+        e0 = self._entry(p, 0)
+        first = r.place(e0, reps)
+        r.committed(e0, first)
+        # a second request, placed but SHED before it reaches a queue,
+        # must leave the session's hint pointing at `first`
+        loser = self._entry(p, 1)
+        r.place(loser, reps)       # no committed(): the submit was shed
+        assert r._hints and all(v == first.name for v in r._hints.values())
+        assert r.place(self._entry(p, 2), reps) is first
+
+    def test_exclude_routes_elsewhere(self):
+        reps = self._replicas(2)
+        r = Router()
+        p = np.asarray([5] * 17, np.int32)
+        first = r.place(self._entry(p, 0), reps)
+        other = r.place(self._entry(p, 1), reps, exclude={first.name})
+        assert other is not first
+
+
+# ---------------------------------------------------------------------------
+# frontend lifecycle over fake replicas
+# ---------------------------------------------------------------------------
+class TestFrontendLifecycle:
+    def test_submit_result_roundtrip(self):
+        with ServingFrontend([FakeEngine(), FakeEngine()]) as fe:
+            hs = [fe.submit(_prompt(1, 10 + i), max_new_tokens=3,
+                            slo_class="interactive") for i in range(6)]
+            for i, h in enumerate(hs):
+                out = h.result(timeout=10)
+                np.testing.assert_array_equal(
+                    out, _expected(_prompt(1, 10 + i), 3))
+                assert h.status == DONE
+                assert h.error is None
+                assert h.replica in ("replica0", "replica1")
+
+    def test_stream_yields_every_token_then_ends(self):
+        with ServingFrontend([FakeEngine()]) as fe:
+            p = _prompt(2, 7)
+            h = fe.submit(p, max_new_tokens=4, slo_class="batch")
+            toks = list(h.stream(timeout=10))
+            assert toks == [7, 7, 7, 7]
+            assert h.status == DONE
+            np.testing.assert_array_equal(h.result(timeout=1),
+                                          _expected(p, 4))
+
+    def test_single_token_request_done_at_admission(self):
+        with ServingFrontend([FakeEngine()]) as fe:
+            h = fe.submit(_prompt(1, 3), max_new_tokens=1)
+            assert h.result(timeout=10)[-1] == 3
+
+    def test_cancel_queued_request_never_runs(self):
+        eng = FakeEngine()
+        eng.admit_paused = True
+        with ServingFrontend([eng]) as fe:
+            h = fe.submit(_prompt(1, 2), max_new_tokens=3)
+            h.cancel()
+            with pytest.raises(RequestCancelled):
+                h.result(timeout=10)
+            assert h.status == CANCELLED
+            assert eng.admitted == 0
+
+    def test_cancel_running_request_retires_at_block_boundary(self):
+        barrier = threading.Event()
+        eng = FakeEngine(step_barrier=barrier)
+        with ServingFrontend([eng]) as fe:
+            h = fe.submit(_prompt(1, 2), max_new_tokens=50)
+            deadline = time.monotonic() + 10
+            while h.status != "RUNNING" and time.monotonic() < deadline:
+                time.sleep(0.005)
+            h.cancel()
+            barrier.set()  # let the blocked step observe the flag
+            with pytest.raises(RequestCancelled):
+                h.result(timeout=10)
+            assert h.status == CANCELLED
+            assert eng.idle()
+
+    def test_failed_request_carries_reason_on_handle(self):
+        with ServingFrontend([FakeEngine()]) as fe:
+            h = fe.submit(_prompt(1, 2), max_new_tokens=99_999)
+            with pytest.raises(RequestFailed, match="fake capacity"):
+                h.result(timeout=10)
+            assert h.status == FAILED
+            assert "ValueError" in h.error and "fake capacity" in h.error
+            # the stream surfaces the same reason instead of hanging
+            with pytest.raises(RequestFailed, match="fake capacity"):
+                list(h.stream(timeout=1))
+
+    def test_shutdown_fails_orphans_instead_of_losing_them(self):
+        eng = FakeEngine()
+        eng.admit_paused = True
+        fe = ServingFrontend([eng])
+        h = fe.submit(_prompt(1, 2), max_new_tokens=3)
+        fe.shutdown()
+        with pytest.raises(RequestFailed, match="shut down"):
+            h.result(timeout=5)
+        with pytest.raises(RuntimeError, match="shut down"):
+            fe.submit(_prompt(1, 3), max_new_tokens=2)
+
+
+class TestAdmissionControl:
+    def test_overload_sheds_fast_with_reserve(self):
+        engs = [FakeEngine()]
+        engs[0].admit_paused = True
+        sched = SLOScheduler(max_queue_depth=4, interactive_reserve=0.25)
+        with ServingFrontend(engs, scheduler=sched) as fe:
+            for i in range(3):
+                fe.submit(_prompt(1, i + 1), 2, slo_class="batch")
+            t0 = time.monotonic()
+            with pytest.raises(Overloaded):
+                fe.submit(_prompt(1, 9), 2, slo_class="batch")
+            shed_latency = time.monotonic() - t0
+            # shedding is a fast refusal, not a timeout
+            assert shed_latency < 0.25
+            # the interactive reserve still has room ...
+            h = fe.submit(_prompt(1, 8), 2, slo_class="interactive")
+            # ... until the hard bound
+            with pytest.raises(Overloaded):
+                fe.submit(_prompt(1, 7), 2, slo_class="interactive")
+            assert h.status == "QUEUED"
+
+    def test_expired_deadline_fails_fast_at_pick_time(self):
+        eng = FakeEngine()
+        eng.admit_paused = True
+        with ServingFrontend([eng]) as fe:
+            h = fe.submit(_prompt(1, 2), 3, slo_class="interactive",
+                          deadline_s=0.05)
+            time.sleep(0.15)
+            eng.admit_paused = False
+            fe._wakes["replica0"].set()
+            with pytest.raises(RequestFailed, match="deadline"):
+                h.result(timeout=10)
+            assert eng.admitted == 0  # never wasted a decode slot
+
+    def test_mixed_load_batch_never_starved(self):
+        """Integration fairness (satellite): a single-slot replica under a
+        continuous interactive storm still finishes every batch request —
+        EDF over finite virtual deadlines ages batch to the front."""
+        eng = FakeEngine(max_seqs=1, step_delay=0.002)
+        # tight targets so the aging happens within test time
+        classes = (SLOClass("interactive", 0.005), SLOClass("batch", 0.1))
+        sched = SLOScheduler(max_queue_depth=512, classes=classes)
+        with ServingFrontend([eng], scheduler=sched) as fe:
+            batch = [fe.submit(_prompt(1, 50 + i), 4, slo_class="batch")
+                     for i in range(3)]
+            inter, stop = [], time.monotonic() + 0.8
+            while time.monotonic() < stop:
+                inter.append(fe.submit(_prompt(1, len(inter) % 40), 2,
+                                       slo_class="interactive"))
+                time.sleep(0.002)
+                if all(b.done() for b in batch):
+                    break
+            for b in batch:  # provably not starved: they complete while
+                b.result(timeout=30)   # the storm is still arriving
+                assert b.status == DONE
+            for h in inter:
+                h.result(timeout=30)
+            rep = fe.serving_report()
+            waits = rep["slo_classes"]["batch"]["queue_wait_s"]
+            assert waits["count"] >= 3  # registry histograms are global
+
+
+# ---------------------------------------------------------------------------
+# drain / kill / reroute
+# ---------------------------------------------------------------------------
+class TestDrainAndFailover:
+    def test_drain_finishes_inflight_and_requeues_pending(self):
+        slow = FakeEngine(max_seqs=1, step_delay=0.005)
+        other = FakeEngine()
+        with ServingFrontend([slow, other]) as fe:
+            p = np.asarray([4] * 17, np.int32)  # same prefix -> replica0
+            hs = [fe.submit(p, 8, slo_class="batch") for _ in range(3)]
+            assert fe.drain("replica0", timeout=20)
+            assert fe.replicas[0].state == DRAINING
+            for h in hs:
+                np.testing.assert_array_equal(h.result(timeout=20),
+                                              _expected(p, 8))
+            # drained replica receives no new work ...
+            h2 = fe.submit(p, 2)
+            h2.result(timeout=20)
+            assert h2.replica == "replica1"
+            report = fe.serving_report()
+            assert report["replicas"]["replica0"]["state"] == DRAINING
+            assert report["counters"].get("serving.drain_requeued", 0) >= 1
+            # ... until revived
+            fe.revive("replica0")
+            assert fe.replicas[0].state == LIVE
+
+    def test_drain_with_no_other_replica_fails_pending_not_hangs(self):
+        eng = FakeEngine(max_seqs=1, step_delay=0.005)
+        with ServingFrontend([eng]) as fe:
+            p = _prompt(1, 2)
+            hs = [fe.submit(p, 6) for _ in range(3)]
+            deadline = time.monotonic() + 10
+            while (not any(h.status == "RUNNING" for h in hs)
+                   and time.monotonic() < deadline):
+                time.sleep(0.002)
+            assert fe.drain("replica0", timeout=20)
+            terminal = {h.status for h in hs}
+            # in-flight finished; pending had nowhere to go and failed fast
+            assert DONE in terminal
+            for h in hs:
+                assert h.done()
+            with pytest.raises(ValueError, match="unknown replica"):
+                fe.drain("nope")
+            with pytest.raises(ValueError, match="unknown replica"):
+                fe.revive("nope")
+
+    def test_kill_reroutes_unconsumed_and_fails_consumed(self):
+        barrier = threading.Event()
+        wedged = FakeEngine(step_barrier=barrier)
+        healthy = FakeEngine()
+        with ServingFrontend([wedged, healthy]) as fe:
+            p = np.asarray([6] * 17, np.int32)  # both requests -> replica0
+            h_stream = fe.submit(p, 6)
+            h_plain = fe.submit(p, 6)
+            deadline = time.monotonic() + 10
+            while wedged.active_count() < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert wedged.active_count() == 2
+            # consume ONE token of h_stream: that pins it to replica0
+            it = h_stream.stream(timeout=10)
+            assert next(it) == 6
+            fe.kill("replica0", reason="test kill")
+            # unconsumed request transparently reroutes, identical result
+            np.testing.assert_array_equal(h_plain.result(timeout=20),
+                                          _expected(p, 6))
+            assert h_plain.replica == "replica1"
+            # consumed stream fails cleanly with the death reason
+            with pytest.raises(RequestFailed, match="test kill"):
+                list(it)
+            assert h_stream.status == FAILED and "died" in h_stream.error
+            barrier.set()  # release the wedged dispatcher for teardown
+            report = fe.serving_report()
+            assert report["replicas"]["replica0"]["state"] == DEAD
+            assert report["replicas"]["replica0"]["death_reason"]
+            assert report["counters"]["serving.rerouted"] >= 1
+            # late token pushes from the dead replica were discarded: the
+            # rerouted result above is exactly the fresh replica's output
+
+    def test_admission_raise_on_already_dead_replica_requeues(self):
+        """A dispatcher stuck inside try_admit_one holds the entry in
+        neither pending nor inflight; if the replica is declared DEAD
+        before the stuck call raises, the death sweep already ran — the
+        exception path must hand the entry to the relocation path itself
+        (a re-appended entry on a DEAD replica would never be swept and
+        its handle would hang forever)."""
+        entered, release = threading.Event(), threading.Event()
+
+        class _AdmitRaiser(FakeEngine):
+            def try_admit_one(self, req):
+                entered.set()
+                release.wait(10)
+                raise RuntimeError("device wedged during admission")
+
+        wedged, healthy = _AdmitRaiser(), FakeEngine()
+        with ServingFrontend([wedged, healthy]) as fe:
+            p = np.asarray([6] * 17, np.int32)
+            h = fe.submit(p, 3)
+            assert entered.wait(10)  # entry now in admission transit
+            fe.kill("replica0", reason="monitor verdict")  # sweep sees none
+            release.set()  # stuck call raises on the DEAD replica
+            np.testing.assert_array_equal(h.result(timeout=20),
+                                          _expected(p, 3))
+            assert h.replica == "replica1"
+
+    def test_stale_heartbeat_declares_replica_dead(self):
+        barrier = threading.Event()
+        wedged = FakeEngine(step_barrier=barrier)
+        healthy = FakeEngine()
+        fe = ServingFrontend([wedged, healthy],
+                             heartbeat_deadline_s=0.3,
+                             monitor_interval_s=0.05)
+        try:
+            p = np.asarray([3] * 17, np.int32)
+            h = fe.submit(p, 5)  # lands on replica0, wedges in step()
+            np.testing.assert_array_equal(h.result(timeout=20),
+                                          _expected(p, 5))
+            assert h.replica == "replica1"
+            assert fe.replicas[0].state == DEAD
+            assert "heartbeat" in fe.replicas[0].death_reason
+        finally:
+            barrier.set()
+            fe.shutdown()
+
+    def test_wedged_dispatch_lock_holder_still_declared_dead(self):
+        """A dispatcher hung INSIDE the process-wide dispatch lock (a stuck
+        device call) must still be declared dead — the lock probe that
+        defers death verdicts while a compile holds the lock cannot defer
+        forever, or every in-flight handle hangs with it."""
+        from paddle_tpu.inference.continuous import _DISPATCH_LOCK
+
+        barrier = threading.Event()
+
+        class LockWedgedEngine(FakeEngine):
+            def step(self):
+                with _DISPATCH_LOCK:  # hung holding the lock, like a real
+                    barrier.wait(20)  # jitted call that never returns
+                return super().step()
+
+        fe = ServingFrontend([LockWedgedEngine(), FakeEngine()],
+                             heartbeat_deadline_s=0.3,
+                             monitor_interval_s=0.05)
+        try:
+            p = np.asarray([4] * 17, np.int32)
+            h = fe.submit(p, 5)  # lands on replica0, wedges holding the lock
+            np.testing.assert_array_equal(h.result(timeout=20),
+                                          _expected(p, 5))
+            assert h.replica == "replica1"
+            assert fe.replicas[0].state == DEAD
+            assert "heartbeat" in fe.replicas[0].death_reason
+        finally:
+            barrier.set()
+            fe.shutdown()
+
+    def test_wedged_outside_lock_dies_despite_busy_dispatch_lock(self):
+        """A dispatcher wedged OUTSIDE the dispatch lock (post-lock host
+        sync, a blocking user callback) must not ride out its death verdict
+        on OTHER threads' healthy young lock holds — the deferral only
+        applies when the stale dispatcher itself holds or awaits the
+        lock."""
+        from paddle_tpu.inference.continuous import _DISPATCH_LOCK
+
+        barrier = threading.Event()
+        wedged = FakeEngine(step_barrier=barrier)  # wedge NOT in the lock
+        fe = ServingFrontend([wedged, FakeEngine()],
+                             heartbeat_deadline_s=0.3,
+                             monitor_interval_s=0.05)
+        release = threading.Event()
+
+        def busy_compiles():  # unrelated young holds, refreshed constantly
+            while not release.is_set():
+                with _DISPATCH_LOCK:
+                    release.wait(0.05)
+
+        holder = threading.Thread(target=busy_compiles, daemon=True)
+        holder.start()
+        try:
+            p = np.asarray([5] * 17, np.int32)
+            h = fe.submit(p, 5)  # lands on replica0, wedges in step()
+            np.testing.assert_array_equal(h.result(timeout=20),
+                                          _expected(p, 5))
+            assert h.replica == "replica1"
+            assert fe.replicas[0].state == DEAD
+        finally:
+            release.set()
+            holder.join()
+            barrier.set()
+            fe.shutdown()
+
+    def test_liveness_verdict_defers_for_lock_participants(self):
+        """Unit drive of the monitor verdict: a stale-beat replica whose
+        dispatcher HOLDS (or awaits) a young dispatch-lock hold is spared;
+        the same staleness with the dispatcher uninvolved is fatal."""
+        from paddle_tpu.inference.continuous import _DISPATCH_LOCK
+
+        fe = ServingFrontend([FakeEngine(), FakeEngine()], start=False)
+        rep = fe.replicas[0]
+        rep.last_beat = time.monotonic() - 60  # long stale
+        rep.thread_ident = threading.get_ident()
+        with _DISPATCH_LOCK:  # this thread = the replica's "dispatcher"
+            fe._check_replica_liveness(rep, time.monotonic())
+            assert rep.state == LIVE  # young own hold: compiling, spared
+        rep.thread_ident = -1  # staleness no longer attributable to the lock
+        with _DISPATCH_LOCK:
+            fe._check_replica_liveness(rep, time.monotonic())
+            assert rep.state == DEAD  # someone else's hold doesn't save it
+        fe.shutdown()
+
+    def test_chaos_replica_kill_site(self):
+        """PR-1 integration: a chaos fault at serving.replica_kill takes a
+        dispatcher down exactly like a crash; traffic keeps flowing on the
+        survivor."""
+        fe = ServingFrontend([FakeEngine(), FakeEngine()], start=False)
+        try:
+            with chaos.FaultPlan().fail("serving.replica_kill", times=1):
+                fe.start()
+                deadline = time.monotonic() + 10
+                while (sum(r.state == DEAD for r in fe.replicas) < 1
+                       and time.monotonic() < deadline):
+                    time.sleep(0.01)
+            dead = [r for r in fe.replicas if r.state == DEAD]
+            assert len(dead) == 1
+            assert "FaultInjected" in dead[0].death_reason
+            h = fe.submit(_prompt(1, 5), 3)
+            h.result(timeout=20)  # the survivor serves
+            assert h.replica != dead[0].name
+        finally:
+            fe.shutdown()
+
+    def test_chaos_route_site(self):
+        """An injected routing outage surfaces at submit() — never a
+        silently lost handle."""
+        with ServingFrontend([FakeEngine()]) as fe:
+            with chaos.FaultPlan().fail("serving.route", times=1):
+                with pytest.raises(ConnectionError):
+                    fe.submit(_prompt(1, 2), 2)
+            h = fe.submit(_prompt(1, 2), 2)  # plan exhausted: service back
+            h.result(timeout=20)
+
+
+class TestReport:
+    def test_serving_report_shape(self):
+        with ServingFrontend([FakeEngine()]) as fe:
+            fe.submit(_prompt(1, 2), 3).result(timeout=10)
+            rep = fe.serving_report()
+            r0 = rep["replicas"]["replica0"]
+            assert r0["state"] == LIVE and r0["max_seqs"] == 2
+            assert {"load", "active", "pending", "pages_in_use"} <= set(r0)
+            waits = rep["slo_classes"]["interactive"]
+            assert {"queue_wait_s", "ttft_s"} <= set(waits)
+            assert waits["ttft_s"]["count"] >= 1
+            assert rep["counters"]["serving.submitted"] >= 1
+            assert rep["queue_depth"] == 0
+
+
+# ---------------------------------------------------------------------------
+# real-engine satellites
+# ---------------------------------------------------------------------------
+def _tiny_model(layers=1):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(31)
+    m = LlamaForCausalLM(llama_tiny(num_hidden_layers=layers))
+    m.eval()
+    return m
+
+
+def _pages_scan(eng):
+    """The pre-satellite derivation of pages_in_use: everything that is
+    neither free nor sitting cached-but-unreferenced."""
+    return eng.num_pages - 1 - len(eng.free_pages) - len(eng._evictable)
+
+
+class TestEngineSatellites:
+    @pytest.fixture(scope="class")
+    def model(self):
+        return _tiny_model()
+
+    def test_per_request_max_new_tokens(self, model):
+        eng = ContinuousBatchingEngine(model, max_seqs=2, page_size=8,
+                                       max_len=64, decode_block=2)
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, 100, size=n).astype(np.int32)
+                   for n in (5, 7, 9)]
+        per = [1, 3, 5]
+        outs = eng.serve(prompts, max_new_tokens=per)
+        for p, o, n in zip(prompts, outs, per):
+            assert len(o) == len(p) + n
+        # dict form and scalar form agree with the list form (greedy is
+        # deterministic, so shorter budgets are prefixes of longer ones)
+        outs_dict = eng.serve(prompts, max_new_tokens={0: 1, 1: 3, 2: 5})
+        for a, b in zip(outs, outs_dict):
+            np.testing.assert_array_equal(a, b)
+        outs_scalar = eng.serve(prompts, max_new_tokens=5)
+        for o, s, n in zip(outs, outs_scalar, per):
+            np.testing.assert_array_equal(o, s[:len(o)])
+
+    def test_per_request_max_new_validation(self, model):
+        eng = ContinuousBatchingEngine(model, max_seqs=2, page_size=8,
+                                       max_len=64)
+        prompts = [np.ones(4, np.int32)] * 2
+        with pytest.raises(ValueError, match="3 entries for 2 requests"):
+            eng.serve(prompts, max_new_tokens=[1, 2, 4])
+        with pytest.raises(ValueError, match="missing rids"):
+            eng.serve(prompts, max_new_tokens={0: 2})
+        with pytest.raises(ValueError,
+                           match="sampling_overrides has 1 entries"):
+            eng.serve(prompts, max_new_tokens=2,
+                      sampling_overrides=[{"do_sample": True}])
+        # a ValueError raised while BUILDING requests must not leak the
+        # escalated per-batch error bound (the finally that restores it
+        # only guards the serve loop itself)
+        many = [np.ones(4, np.int32)] * 2000
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.serve(many, max_new_tokens=0)
+        assert eng._request_errors_bound == 1024
+
+    def test_per_request_sampling_overrides(self, model):
+        eng = ContinuousBatchingEngine(model, max_seqs=2, page_size=8,
+                                       max_len=64, decode_block=2)
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(1, 100, size=6).astype(np.int32)
+                   for _ in range(2)]
+        outs = eng.serve(prompts, max_new_tokens=3,
+                         sampling_overrides={1: {"do_sample": True,
+                                                 "temperature": 0.7}})
+        assert all(len(o) == 9 for o in outs)
+        # rid 0 stayed greedy: identical to an all-greedy serve
+        greedy = eng.serve(prompts, max_new_tokens=3)
+        np.testing.assert_array_equal(outs[0], greedy[0])
+
+    def test_failure_reason_per_request(self, model):
+        eng = ContinuousBatchingEngine(model, max_seqs=2, page_size=8,
+                                       max_len=32)
+        good = np.ones(4, np.int32)
+        impossible = np.ones(20, np.int32)  # 20 + 20 > max_len
+        outs = eng.serve([good, impossible], max_new_tokens=[4, 20])
+        assert outs[0] is not None and outs[1] is None
+        assert isinstance(eng.request_errors[1], ValueError)
+        assert "exceeds max_len" in str(eng.request_errors[1])
+        assert eng.stats["failed_requests"] == 1
+
+    def test_pages_counter_matches_scan(self, model):
+        """Satellite: the O(1) maintained counter equals the O(pool) scan
+        at every observable point — mid-flight (on_token), after retire,
+        and with cached prefix pages parked in the evictable set."""
+        eng = ContinuousBatchingEngine(model, max_seqs=2, page_size=8,
+                                       max_len=64, decode_block=2,
+                                       enable_prefix_cache=True)
+
+        def check(rid=None, tok=None):
+            assert eng.pages_in_use() == _pages_scan(eng) \
+                == len(eng._page_refs)
+
+        rng = np.random.RandomState(2)
+        shared = rng.randint(1, 100, size=16).astype(np.int32)
+        prompts = [np.concatenate([shared,
+                                   rng.randint(1, 100, size=4).astype(np.int32)])
+                   for _ in range(3)]
+        check()
+        eng.serve(prompts, max_new_tokens=4, on_token=check)
+        check()
+        assert eng.pages_in_use() == 0
+        assert eng.stats["prefix_hit_pages"] > 0  # cache engaged; counter
+        # survived the shared-page ref/unref churn
+        eng.serve(prompts, max_new_tokens=4, on_token=check)
+        check()
+        eng.clear_prefix_cache()
+        check()
+
+    def test_clone_for_retry_preserves_identity_and_enqueue_epoch(self):
+        """Reroute contract: the clone keeps rid/seed/sampling (bit-identical
+        key stream on the new replica) AND t_enqueue (TTFT/queue-wait span
+        the whole journey, including the time lost on the dead replica)."""
+        from paddle_tpu.inference.continuous import EngineRequest
+
+        req = EngineRequest(7, np.ones(4, np.int32), 8, seed=3,
+                            sampling=(True, 0.7, 5, 0.9), timeout_s=1.5)
+        time.sleep(0.01)
+        clone = req.clone_for_retry()
+        assert (clone.rid, clone.seed, clone.sampling, clone.timeout_s) == \
+            (7, 3, (True, 0.7, 5, 0.9), 1.5)
+        assert clone.t_enqueue == req.t_enqueue
+        assert not clone.cancelled and clone.t_admit is None
+
+    def test_online_hooks_match_batch_serve(self, model):
+        """try_admit_one/step/drain produce the same tokens serve() does
+        (they are the same machinery by construction; this pins it)."""
+        from paddle_tpu.inference.continuous import EngineRequest
+
+        eng = ContinuousBatchingEngine(model, max_seqs=2, page_size=8,
+                                       max_len=64, decode_block=2)
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(1, 100, size=6).astype(np.int32)
+                   for _ in range(2)]
+        batch = eng.serve(prompts, max_new_tokens=4)
+        reqs = [EngineRequest(i, p, 4) for i, p in enumerate(prompts)]
+        for r in reqs:
+            assert eng.try_admit_one(r) == "admitted"
+        eng.drain()
+        for r, b in zip(reqs, batch):
+            assert r.finished
+            np.testing.assert_array_equal(r.result, b)
+        with pytest.raises(RuntimeError, match="drain"):
+            reqs2 = EngineRequest(9, prompts[0], 4)
+            assert eng.try_admit_one(reqs2) == "admitted"
+            eng.serve(prompts, max_new_tokens=2)
+        eng.drain()
+
+
+# ---------------------------------------------------------------------------
+# E2E: 2 real replicas, mixed SLO load, chaos kill, drain, affinity vs RR
+# ---------------------------------------------------------------------------
+class TestEndToEnd:
+    def test_chaos_kill_drain_and_affinity_beats_round_robin(self):
+        """The acceptance scenario in one run: prefix-affinity routing
+        yields a measurably higher prefix-cache hit rate than round-robin
+        over the same request sequence; then, under concurrent mixed-SLO
+        load, a chaos-killed replica's requests reroute or fail cleanly (no
+        hangs, no lost handles) and drain() completes in-flight work."""
+        model = _tiny_model()
+        page = 8
+        rng = np.random.RandomState(7)
+        families = [rng.randint(1, 100, size=40).astype(np.int32)
+                    for _ in range(2)]
+
+        def mk_engines():
+            return [ContinuousBatchingEngine(
+                model, max_seqs=2, page_size=page, max_len=64,
+                decode_block=2, enable_prefix_cache=True) for _ in range(2)]
+
+        def run_sequence(policy, engines):
+            fe = ServingFrontend(engines, router=Router(policy=policy))
+            try:
+                # TWO requests per family per round: round-robin then lands
+                # each family on BOTH replicas (with one-per-family the
+                # alternation would accidentally reproduce perfect affinity)
+                for i in range(4):
+                    for fam in families:
+                        for _ in range(2):
+                            p = np.concatenate(
+                                [fam,
+                                 rng.randint(1, 100, 8).astype(np.int32)])
+                            fe.submit(p, 2, slo_class="interactive") \
+                              .result(timeout=120)
+            finally:
+                fe.shutdown()
+            return sum(e.stats["prefix_hit_pages"] for e in engines)
+
+        prefix_engines = mk_engines()
+        hits_affinity = run_sequence("prefix", prefix_engines)
+        hits_rr = run_sequence("round_robin", mk_engines())
+        # same request sequence, same engines-per-policy: affinity keeps a
+        # prefix family on one replica, round-robin splits it and re-pays
+        # the family's first-miss on the second replica
+        assert hits_affinity > hits_rr, (hits_affinity, hits_rr)
+
+        # ---- phase 2: concurrent mixed-SLO load + chaos replica kill ----
+        fe = ServingFrontend(prefix_engines, heartbeat_deadline_s=120.0)
+        try:
+            handles, errs = [], []
+            lock = threading.Lock()
+
+            def client(tid):
+                r = np.random.RandomState(100 + tid)
+                for j in range(3):
+                    p = np.concatenate(
+                        [families[tid % 2],
+                         r.randint(1, 100, 8).astype(np.int32)])
+                    try:
+                        h = fe.submit(
+                            p, 3,
+                            slo_class="interactive" if tid % 2 else "batch")
+                        with lock:
+                            handles.append(h)
+                    except Overloaded:
+                        continue
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            # kill one dispatcher mid-flight via the chaos site
+            with chaos.FaultPlan().fail("serving.replica_kill", times=1):
+                deadline = time.monotonic() + 60
+                while (not any(r.state == DEAD for r in fe.replicas)
+                       and time.monotonic() < deadline):
+                    time.sleep(0.01)
+            for t in threads:
+                t.join(timeout=120)
+            assert any(r.state == DEAD for r in fe.replicas)
+            survivor = next(r for r in fe.replicas if r.state == LIVE)
+            # every handle reaches a terminal state: rerouted-and-done or
+            # cleanly failed with the death reason — never a hang
+            done = failed = 0
+            for h in handles:
+                try:
+                    out = h.result(timeout=120)
+                    assert out is not None and len(out) == 48 + 3
+                    done += 1
+                except RequestFailed:
+                    assert "died" in h.error or "re-route" in h.error
+                    failed += 1
+            assert done + failed == len(handles) and done > 0
+
+            # drain() completes in-flight work on the survivor
+            p = np.concatenate([families[0],
+                                rng.randint(1, 100, 8).astype(np.int32)])
+            h_inflight = fe.submit(p, 6, slo_class="batch")
+            deadline = time.monotonic() + 60
+            while (h_inflight.status == "QUEUED"
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert fe.drain(survivor.name, timeout=120)
+            assert h_inflight.status == DONE
+            assert survivor.engine.idle()
+            rep = fe.serving_report()
+            assert rep["counters"]["serving.replica_dead"] >= 1
+        finally:
+            fe.shutdown()
