@@ -509,24 +509,37 @@ def _run_rung(rung_idx, timeout_s, force_cpu=False):
 
 
 def _probe_backend():
-    """Cheap child that just initializes the default jax backend. Returns
-    (ok, backend_name) — ok=False if it hangs (wedged plugin), saving the
-    full rung budget."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.default_backend(), len(jax.devices()))"],
-            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
-        )
+    """Cheap child that just initializes the default jax backend, in a
+    FRESH subprocess with a bounded timeout. BENCH_r05 regression: one hung
+    probe ("backend probe hung >90s") forced the whole run onto banked
+    values even though the plugin sometimes recovers after the first
+    wedged init — so a hung or crashed probe gets exactly ONE retry (a new
+    subprocess, a wedged child can't poison it) before the caller falls
+    back to the banked rung. Returns (ok, backend_name, info) where info
+    records which path was taken for the JSON ``extra`` ("first_try" /
+    "retry" / "wedged_after_retry" / "failed_after_retry")."""
+    info = {"attempts": 0, "path": None, "timeout_s": PROBE_TIMEOUT_S}
+    for attempt in (1, 2):
+        info["attempts"] = attempt
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend(), len(jax.devices()))"],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"[bench] backend probe hung >{PROBE_TIMEOUT_S}s "
+                  f"(attempt {attempt}/2)", file=sys.stderr, flush=True)
+            info["path"] = "wedged_after_retry"
+            continue
         out = proc.stdout.strip()
-        print(f"[bench] backend probe: {out!r} rc={proc.returncode}",
-              file=sys.stderr, flush=True)
-        backend = out.split()[0] if proc.returncode == 0 and out else None
-        return proc.returncode == 0, backend
-    except subprocess.TimeoutExpired:
-        print(f"[bench] backend probe hung >{PROBE_TIMEOUT_S}s — backend wedged",
-              file=sys.stderr, flush=True)
-        return False, None
+        print(f"[bench] backend probe: {out!r} rc={proc.returncode} "
+              f"(attempt {attempt}/2)", file=sys.stderr, flush=True)
+        if proc.returncode == 0 and out:
+            info["path"] = "first_try" if attempt == 1 else "retry"
+            return True, out.split()[0], info
+        info["path"] = "failed_after_retry"
+    return False, None, info
 
 
 RUNGS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_rungs.jsonl")
@@ -626,10 +639,15 @@ def _bank(name, result):
 def main():
     errors = []
     banked = {}  # ladder idx -> successful result
-    ok, backend = _probe_backend()
+    ok, backend, probe_info = _probe_backend()
     wedged = not ok
     if wedged:
-        errors.append(f"backend probe hung >{PROBE_TIMEOUT_S}s")
+        # "wedged_after_retry" = both attempts hung >PROBE_TIMEOUT_S;
+        # "failed_after_retry" = the probe child ran but exited nonzero —
+        # the hang-vs-crash distinction is the BENCH_r05 postmortem datum
+        errors.append(f"backend probe {probe_info['path']} "
+                      f"(timeout {PROBE_TIMEOUT_S}s, "
+                      f"attempts {probe_info['attempts']})")
     else:
         # On CPU every training rung collapses to the same smoke profile —
         # run one of each kind instead of six identical smokes.
@@ -758,6 +776,9 @@ def main():
             "attn_impl": ps.get("extra", {}).get("attn_impl"),
             "config": ps.get("extra", {}).get("config"),
         }
+    # which probe path ran (first_try / retry / wedged_after_retry /
+    # failed_after_retry) — the BENCH_r05 postmortem's missing datum
+    res.setdefault("extra", {})["probe"] = probe_info
     print(json.dumps(res), flush=True)
 
 

@@ -23,11 +23,39 @@ Decoding is greedy by default; serve(do_sample=True, ...) runs the dense
 path's sampler math with per-request key streams (reproducible regardless
 of co-scheduling). kv_cache_dtype="int8" switches the pool to the
 QuantizedTensor layout the Pallas kernel consumes natively.
+
+Data-plane pipeline (ISSUE 6): the engine overlaps host scheduling with
+device compute instead of ping-ponging between them —
+
+- **chunked prefill** (``prefill_chunk=``): a long prompt lands in
+  page-aligned chunks scheduled BETWEEN decode blocks (each chunk is the
+  prefix-cache machinery's gather + suffix-prefill over the pages already
+  inserted), so a 2048-token prompt no longer stalls every co-tenant's
+  TPOT for one monolithic bucketed dispatch, and the big prompt-bucket
+  programs are replaced by a handful of chunk-shaped ones. Mid-prefill
+  slots keep their page-table row at scratch, so concurrent decode
+  dispatches can't write into half-built pages.
+- **double-buffered async decode** (``async_decode=``): decode block k+1
+  is dispatched chained off block k's device-resident last-token row
+  BEFORE block k's tokens are read back; the host retire/admit/emit work
+  for block k runs under block k+1's device execution. Lengths and key
+  indices advance at dispatch time (identical to emit-time accounting for
+  every surviving slot — retired slots are zeroed anyway), retirement and
+  admission stay at readback points, and the in-flight depth is bounded
+  at ONE so pool donation stays a single-owner chain.
+- **lock decomposition**: jitted EXECUTION serializes per engine
+  (``engine.dispatch_lock``); only first-TRACE of a program key takes the
+  process-wide ``_COMPILE_LOCK`` (concurrent tracing of the shared
+  model's programs leaks tracers through the framework's thread-oblivious
+  Tensor state — executing already-compiled programs does not). N
+  in-process replicas therefore genuinely run concurrently once warm.
 """
+import hashlib
 import math
 import threading
 import time
 from collections import deque
+from contextlib import contextmanager
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +87,16 @@ _M_TOKENS = _registry.counter("serve.tokens_out")
 _M_REQUESTS = _registry.counter("serve.requests")
 _M_PREFIX_HIT = _registry.counter("serve.prefix.hit_pages")
 _M_PREFIX_LOOKUP = _registry.counter("serve.prefix.lookup_pages")
+# data-plane pipeline metrics (ISSUE 6): host time hidden under an
+# in-flight decode dispatch, prefill chunks landed between decode blocks,
+# and warmup()'s AOT compile wall (the spike the per-replica warmup keeps
+# out of first requests)
+_M_OVERLAP = _registry.histogram(
+    "serve.dispatch_overlap_s",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1, 0.25, 0.5, 1.0, 2.5))
+_M_CHUNKS = _registry.counter("serve.prefill_chunks")
+_M_WARMUP = _registry.histogram("serve.compile_warmup_s")
 
 # one module-level jitted block-decode key builder (jit cache survives
 # across serve() calls) over PER-REQUEST key bases (online mode admits
@@ -152,15 +190,20 @@ class _StampedRLock:
         return out
 
 
-#: Process-wide device-dispatch lock shared by every engine: the serving
-#: frontend drives one engine per dispatcher THREAD, and concurrent jit
-#: TRACING of the shared model's programs leaks tracers through the
-#: framework's (thread-oblivious) Tensor state. Serializing the jitted
-#: sections is correct and cheap — in-process replicas time-share one
-#: accelerator anyway; the host-side scheduling around it stays concurrent.
-#: Production multi-host replicas live in separate processes and never
-#: contend.
-_DISPATCH_LOCK = _StampedRLock()
+#: Process-wide COMPILE lock: the serving frontend drives one engine per
+#: dispatcher THREAD, and concurrent jit TRACING of the shared model's
+#: programs leaks tracers through the framework's (thread-oblivious)
+#: Tensor state. Only first-trace needs the global lock — each engine's
+#: program keys are explicit (bucket/sampling/k), so once a key has run
+#: successfully every later call is a jit cache hit executing compiled
+#: code, which is thread-safe. Execution serializes per engine on
+#: ``engine.dispatch_lock`` instead (the engine is single-threaded by
+#: contract; the per-engine lock exists so the frontend's liveness
+#: monitor can tell a dispatcher wedged in a device call from one queued
+#: behind a neighbor's compile). This replaces the pre-ISSUE-6
+#: process-wide ``_DISPATCH_LOCK`` that serialized every jitted call of
+#: every replica behind one lock.
+_COMPILE_LOCK = _StampedRLock()
 
 #: canonical greedy sampling tuple — every greedy request shares ONE
 #: compiled prefill/decode program regardless of the knob values passed
@@ -188,9 +231,10 @@ class EngineRequest:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "sampling", "seed", "timeout_s", "on_token", "tokens",
-                 "n_generated", "last_token", "pages", "slot", "key_base",
-                 "t_enqueue", "t_admit", "t_first_token", "t_done",
-                 "error", "result", "finished", "timed_out", "cancelled")
+                 "n_generated", "n_dispatched", "last_token", "pages",
+                 "slot", "key_base", "t_enqueue", "t_admit",
+                 "t_first_token", "t_done", "error", "result", "finished",
+                 "timed_out", "cancelled")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
                  sampling=GREEDY_SAMPLING, seed=0, timeout_s=None,
@@ -213,6 +257,12 @@ class EngineRequest:
         self.on_token = on_token
         self.tokens = []          # prompt + generated, filled at admission
         self.n_generated = 0
+        # tokens DISPATCHED to the device (>= n_generated while a decode
+        # block is in flight): the async pipeline builds block k+1's key
+        # indices and fed lengths from this before block k's tokens are
+        # read back. For surviving slots it always equals what emit-time
+        # accounting would produce; retired slots discard the overshoot.
+        self.n_dispatched = 0
         self.last_token = None
         self.pages = []
         self.slot = None
@@ -264,10 +314,44 @@ def _row_sampler(do_sample, temperature, top_k, top_p):
     return jax.vmap(lambda lg, k: base(lg[None], k)[0])
 
 
+class _PrefillState:
+    """One slot mid-chunked-prefill: the full page reservation plus how
+    many of those pages already hold valid KV. The engine's page_table row
+    and lengths entry stay ZERO until graduation, so decode dispatches
+    running between chunks write this slot's fed token to the scratch page
+    instead of into half-built pages."""
+
+    __slots__ = ("req", "pages", "filled_pages", "n_pre0", "digests")
+
+    def __init__(self, req, pages, n_pre, digests):
+        self.req = req
+        self.pages = pages          # full reservation (shared + new)
+        self.filled_pages = n_pre   # pages holding valid KV (page-aligned)
+        self.n_pre0 = n_pre         # prefix-cache hit width at admission
+        self.digests = digests      # prompt-page digest chain (for indexing)
+
+
+class _InflightBlock:
+    """One dispatched-but-not-read-back decode block: the device token
+    array, the slot→request mapping frozen at dispatch time, and the
+    device-resident last-step row the NEXT block's feed chains from."""
+
+    __slots__ = ("blk", "last", "k", "rows", "t0", "host")
+
+    def __init__(self, blk, last, k, rows, t0, host=None):
+        self.blk = blk      # device [k, max_seqs] token block
+        self.last = last    # device [max_seqs, 1] last-step tokens
+        self.k = k
+        self.rows = rows    # [(slot, req)] active at dispatch
+        self.t0 = t0
+        self.host = host    # sync mode: tokens already read back in-lock
+
+
 class ContinuousBatchingEngine:
     def __init__(self, model, max_seqs=4, page_size=16, num_pages=None,
                  max_len=512, kv_cache_dtype=None, decode_block=8,
-                 enable_prefix_cache=False):
+                 enable_prefix_cache=False, prefill_chunk=None,
+                 async_decode=True, dispatch_lock=None):
         cfg = model.config
         self.model = model
         model.eval()
@@ -325,6 +409,33 @@ class ContinuousBatchingEngine:
         # the allocator needs them. Shared pages are never written: decode
         # writes at positions >= true_len and the match is capped at
         # (true_len-1)//bs pages, so every write lands in a private page.
+        # ---- data-plane pipeline knobs (ISSUE 6) --------------------------
+        # prefill_chunk: page-aligned token count per prefill chunk; None/0
+        # disables chunking (monolithic bucketed prefill, the legacy path).
+        # Prompts whose post-prefix suffix fits one chunk still prefill
+        # monolithically — chunking only changes behavior for longer ones.
+        if prefill_chunk:
+            if kv_cache_dtype == "int8":
+                # chunk j re-reads earlier chunks' KV through the pool; an
+                # int8 pool would make that read lossy while the monolithic
+                # path attends to exact float KV — refuse rather than break
+                # the engine's exact-equality contract (same rule as the
+                # prefix cache)
+                raise ValueError("prefill_chunk does not compose with "
+                                 "kv_cache_dtype='int8' (lossy chunk "
+                                 "re-reads would change outputs vs the "
+                                 "monolithic path)")
+            prefill_chunk = max(int(prefill_chunk) // page_size, 1) * page_size
+        self.prefill_chunk = int(prefill_chunk or 0)
+        self.async_decode = bool(async_decode)
+        # per-engine execution lock (injectable so bench_serving.py can
+        # reproduce the pre-ISSUE-6 process-wide lock by sharing one
+        # instance across baseline engines); first-trace additionally takes
+        # the global _COMPILE_LOCK — see _locked_dispatch()
+        self.dispatch_lock = dispatch_lock or _StampedRLock()
+        self._warm = set()          # program keys that have run successfully
+        self._prefilling = {}       # slot -> _PrefillState (chunked prefill)
+        self._inflight = None       # the ONE in-flight _InflightBlock
         self.enable_prefix_cache = bool(enable_prefix_cache)
         if self.enable_prefix_cache and kv_cache_dtype == "int8":
             # a shared prefix would be re-read through the lossy int8
@@ -334,8 +445,17 @@ class ContinuousBatchingEngine:
             raise ValueError("enable_prefix_cache does not compose with "
                              "kv_cache_dtype='int8' (lossy prefix KV would "
                              "change outputs vs the uncached path)")
-        self._prefix_index = {}   # prefix bytes -> page_id
-        self._page_hash = {}      # page_id -> prefix bytes (indexed pages)
+        # hashed prefix-page index (ISSUE 6 satellite): keys are CHAINED
+        # 16-byte blake2b digests — digest[j] = H(digest[j-1] || page j's
+        # token bytes) — so indexing or probing a whole prompt costs
+        # O(prompt bytes) total instead of the old O(pages^2) re-hash of
+        # the full prefix per page (which made Router.place()'s affinity
+        # probe quadratic in prompt length). A digest collision would
+        # false-match foreign KV; at 128 bits that is beyond-cosmic-ray
+        # territory, and tests assert the probe equals a content-exact
+        # oracle over real workloads.
+        self._prefix_index = {}   # chained page digest -> page_id
+        self._page_hash = {}      # page_id -> digest (indexed pages)
         self._page_refs = {}      # page_id -> refcount (in-use pages)
         from collections import OrderedDict
 
@@ -435,38 +555,56 @@ class ContinuousBatchingEngine:
         tests)."""
         return self._pages_in_use
 
+    def _page_digests(self, prompt, n_pages):
+        """Chained per-page digests for the first ``n_pages`` full pages:
+        digest[j] identifies prompt[:(j+1)*bs] but costs O(bs) to extend,
+        so the whole chain is O(prompt bytes) — the index/probe key that
+        replaced the old quadratic full-prefix re-hash."""
+        bs = self.page_size
+        out, h = [], b""
+        for j in range(n_pages):
+            h = hashlib.blake2b(prompt[j * bs:(j + 1) * bs].tobytes(),
+                                key=h, digest_size=16).digest()
+            out.append(h)
+        return out
+
     def _match_prefix(self, prompt, true_len):
         """Longest run of indexed full pages, capped so >=1 suffix token
-        remains to prefill (its logits produce the first sampled token)."""
+        remains to prefill (its logits produce the first sampled token).
+        Returns (n, shared pages, the full digest chain — reused by
+        _index_prompt_pages so each admission hashes the prompt once)."""
         bs = self.page_size
         p_max = (true_len - 1) // bs
+        digests = self._page_digests(prompt, true_len // bs)
         shared = []
         for j in range(p_max):
-            pid = self._prefix_index.get(prompt[:(j + 1) * bs].tobytes())
+            pid = self._prefix_index.get(digests[j])
             if pid is None:
                 break
             shared.append(pid)
-        return len(shared), shared
+        return len(shared), shared, digests
 
     def prefix_match_pages(self, prompt):
         """How many full prompt pages this engine could serve from its
         prefix cache right now (read-only: no refcounts taken, no state
-        touched). The router's affinity signal — dict probes only, safe to
-        call from the frontend's submit thread while the dispatcher runs."""
+        touched). The router's affinity signal — O(prompt bytes) digest
+        chain + dict probes only, safe to call from the frontend's submit
+        thread while the dispatcher runs."""
         if not self.enable_prefix_cache:
             return 0
         p = np.asarray(prompt, np.int32).reshape(-1)
-        n, _ = self._match_prefix(p, len(p))
+        n, _, _ = self._match_prefix(p, len(p))
         return n
 
-    def _index_prompt_pages(self, prompt, true_len, pages, start):
+    def _index_prompt_pages(self, true_len, pages, start, digests):
         """Register this request's full prompt pages (from page `start` on;
-        earlier ones were matched, hence already indexed)."""
+        earlier ones were matched, hence already indexed). ``digests`` is
+        the chain _match_prefix computed at admission."""
         bs = self.page_size
         for j in range(start, len(pages)):
             if (j + 1) * bs > true_len:
                 break
-            key = prompt[:(j + 1) * bs].tobytes()
+            key = digests[j]
             if key not in self._prefix_index:  # first writer wins
                 self._prefix_index[key] = pages[j]
                 self._page_hash[pages[j]] = key
@@ -531,6 +669,46 @@ class ContinuousBatchingEngine:
 
         fn = self._prefill_suffix_fns[key3] = jax.jit(prefill_suf)
         return fn
+
+    # ---- dispatch locking -------------------------------------------------
+    @contextmanager
+    def _locked_dispatch(self, *keys):
+        """Guard a jitted section. Warm program keys take only this
+        engine's execution lock; any cold key additionally takes the
+        process-wide compile lock for the duration (first call = trace).
+        Keys are marked warm only after the section SUCCEEDS, so a
+        retried transient failure recompiles under the lock again."""
+        cold = [k for k in keys if k not in self._warm]
+        if not cold:
+            with self.dispatch_lock:
+                yield
+            return
+        with _COMPILE_LOCK, self.dispatch_lock:
+            yield
+        self._warm.update(cold)
+
+    def _captured_state(self):
+        """The version-checked raw_state_dict capture shared by admission
+        and decode — keeps the O(n_params) tree walk off the latency-
+        critical loop. Version read BEFORE the capture: a mutation landing
+        in between tags fresh state with a stale version, which merely
+        forces an extra refresh next time — never a stale serve.
+
+        The refresh happens under the COMPILE lock: a sibling replica
+        tracing the shared model temporarily rebinds its state through the
+        framework's thread-oblivious Tensor plumbing, and a concurrent
+        raw_state_dict() walk would capture those tracers (then feed them
+        to a compiled program — the exact leak the old process-wide
+        dispatch lock hid). Cache hits stay lock-free: a cached capture
+        was taken outside any trace window and holds real arrays."""
+        ver = _core.tensor_mutation_version()
+        cache = self._decode_state_cache
+        if cache is not None and cache[0] == ver:
+            return cache[1]
+        with _COMPILE_LOCK:
+            state = self.model.raw_state_dict()
+        self._decode_state_cache = (ver, state)
+        return state
 
     # ---- jitted pieces ----------------------------------------------------
     def _prefill(self, bucket, sampling):
@@ -609,6 +787,18 @@ class ContinuousBatchingEngine:
         fn = self._insert_fns[bucket] = jax.jit(insert, donate_argnums=(0,))
         return fn
 
+    # Per-row length CAPS (ISSUE 6): the block size is chosen from the
+    # LARGEST remaining token budget in the batch, so rows with smaller
+    # budgets ride past their budget inside the block (their overshoot
+    # tokens are discarded at emit). The cap — true_len + max_new - 1, the
+    # last page-reserved position — is clamped INSIDE the program so an
+    # overshooting row freezes its write position at its last reserved
+    # slot instead of writing past its reservation. For every row within
+    # budget the clamp is the identity, so outputs stay bit-identical to
+    # the uncapped program. Without this, one short-budget co-tenant drags
+    # the whole batch's block size down to its own remaining count (the
+    # k-fragmentation that measured 2x extra dispatches under staggered
+    # chunked-prefill admissions).
     def _decode(self, sampling):
         fn = self._decode_fns.get(sampling)
         if fn is not None:
@@ -616,13 +806,14 @@ class ContinuousBatchingEngine:
         model = self.model
         sampler = _row_sampler(*sampling)
 
-        def decode(state, toks, pools, page_table, lengths, keys):
+        def decode(state, toks, pools, page_table, lengths, caps, keys):
             overrides = {k: Tensor(v, stop_gradient=True) for k, v in state.items()}
-            pkvs = [PagedLayerCache(kp, vp, page_table, lengths)
+            lengths_e = jnp.minimum(lengths, caps)
+            pkvs = [PagedLayerCache(kp, vp, page_table, lengths_e)
                     for kp, vp in pools]
             logits, presents = model.functional_call(
                 overrides, Tensor(toks),
-                position_ids=Tensor(lengths[:, None].astype(jnp.int32)),
+                position_ids=Tensor(lengths_e[:, None].astype(jnp.int32)),
                 past_key_values=pkvs, use_cache=True, training=False,
             )
             nxt = sampler(logits._data[:, -1], keys).astype(jnp.int32)
@@ -646,21 +837,24 @@ class ContinuousBatchingEngine:
         model = self.model
         sampler = _row_sampler(*sampling)
 
-        def decode_block(state, toks, pools, page_table, lengths, keys):
+        def decode_block(state, toks, pools, page_table, lengths, caps, keys):
             overrides = {kk: Tensor(v, stop_gradient=True) for kk, v in state.items()}
 
             def body(carry, step_keys):
                 toks_c, pools_c, lengths_c = carry
-                pkvs = [PagedLayerCache(kp, vp, page_table, lengths_c)
+                # freeze an over-budget row at its last reserved position
+                # (identity for rows within budget — see caps note above)
+                lengths_e = jnp.minimum(lengths_c, caps)
+                pkvs = [PagedLayerCache(kp, vp, page_table, lengths_e)
                         for kp, vp in pools_c]
                 logits, presents = model.functional_call(
                     overrides, Tensor(toks_c),
-                    position_ids=Tensor(lengths_c[:, None].astype(jnp.int32)),
+                    position_ids=Tensor(lengths_e[:, None].astype(jnp.int32)),
                     past_key_values=pkvs, use_cache=True, training=False,
                 )
                 nxt = sampler(logits._data[:, -1], step_keys).astype(jnp.int32)
                 new_pools = tuple((p.k_pages, p.v_pages) for p in presents)
-                return (nxt[:, None], new_pools, lengths_c + 1), nxt
+                return (nxt[:, None], new_pools, lengths_e + 1), nxt
 
             (_, pools_out, _), toks_block = jax.lax.scan(
                 body, (toks, tuple(pools), lengths), keys)
@@ -670,17 +864,47 @@ class ContinuousBatchingEngine:
             decode_block, donate_argnums=(2,))
         return fn
 
-    def warmup(self, prompt_lens, do_sample=False, temperature=1.0,
-               top_k=0, top_p=1.0, shared_prefix_lens=()):
+    def warmup(self, prompt_lens=None, do_sample=False, temperature=1.0,
+               top_k=0, top_p=1.0, shared_prefix_lens=(), buckets=None,
+               sampling=None):
         """Compile every program serve() can hit for prompts of these
         lengths BEFORE latency-sensitive serving (reference:
         AnalysisPredictor warmup / TRT engine build-ahead): one dummy
-        request per prompt bucket (prefill + page-insert programs), and one
+        request per prompt bucket (prefill + page-insert programs — under
+        ``prefill_chunk`` the dummy serves walk the chunk ladder instead,
+        which is exactly the program set real traffic will hit), and one
         serve of 2*decode_block-1 tokens whose shrinking tail walks every
         power-of-two block-decode program (k = decode_block, ..., 2, 1).
         Found on real TPU: without this, the k=32/16/8 block programs
         compile through the remote-compile tunnel inside the serving loop —
-        ~1.5 s/compile dwarfing the ~80 ms dispatch they fuse."""
+        ~1.5 s/compile dwarfing the ~80 ms dispatch they fuse.
+
+        ``buckets`` is an alias for ``prompt_lens`` (the AOT-precompile
+        vocabulary the serving frontend uses at replica start).
+        ``sampling`` precompiles for a LIST of sampling configs in one
+        call — each entry is a ``(do_sample, temperature, top_k, top_p)``
+        tuple (or a single tuple) — since the sampler is a compile-time
+        constant of every prefill/decode program. Wall time lands in the
+        ``serve.compile_warmup_s`` histogram."""
+        if buckets is not None:
+            prompt_lens = buckets
+        if prompt_lens is None:
+            raise ValueError("warmup() needs prompt_lens= or buckets=")
+        if sampling is None:
+            configs = [(do_sample, temperature, top_k, top_p)]
+        elif sampling and not isinstance(sampling[0], (tuple, list)):
+            configs = [tuple(sampling)]
+        else:
+            configs = [tuple(s) for s in sampling]
+        t_warm0 = time.monotonic()
+        try:
+            for cfg in configs:
+                self._warmup_one(prompt_lens, shared_prefix_lens, *cfg)
+        finally:
+            _M_WARMUP.observe(time.monotonic() - t_warm0)
+
+    def _warmup_one(self, prompt_lens, shared_prefix_lens, do_sample,
+                    temperature, top_k, top_p):
         kw = dict(do_sample=do_sample, temperature=temperature,
                   top_k=top_k, top_p=top_p)
         stats_before = dict(self.stats)  # warmup must not pollute diagnostics
@@ -702,7 +926,8 @@ class ContinuousBatchingEngine:
             # pool contents are touched (gather reads, prefill returns).
             sampling = ((False, 1.0, 0, 1.0) if not do_sample else
                         (True, float(temperature), int(top_k), float(top_p)))
-            state = self.model.raw_state_dict()
+            with _COMPILE_LOCK:  # no tracer capture while a sibling traces
+                state = self.model.raw_state_dict()
             bs = self.page_size
             for sp in shared_prefix_lens:
                 for l in prompt_lens:
@@ -710,19 +935,55 @@ class ContinuousBatchingEngine:
                         continue
                     n_pre = min(int(sp) // bs, (int(l) - 1) // bs)
                     while n_pre:
-                        sbucket = prompt_bucket(int(l) - n_pre * bs)
-                        if n_pre + self._pages_for_bucket(sbucket, bs) \
-                                <= self.pages_per_seq:
+                        suffix_len = int(l) - n_pre * bs
+                        if self.prefill_chunk \
+                                and suffix_len > self.prefill_chunk:
+                            region = self._chunk_plan(suffix_len)[2]
+                        else:
+                            region = self._pages_for_bucket(
+                                prompt_bucket(suffix_len), bs)
+                        if n_pre + region <= self.pages_per_seq:
                             break
                         n_pre -= 1
                     if not n_pre:
                         continue
-                    ks, vs = self._gather_prefix(n_pre)(
-                        tuple(self.pools),
-                        jnp.zeros((n_pre,), jnp.int32))  # scratch page reads
-                    self._prefill_suffix(n_pre, sbucket, sampling)(
-                        state, ks, vs, jnp.zeros((1, sbucket), jnp.int32),
-                        jnp.int32(1), jax.random.PRNGKey(0))
+                    # the programs a HIT request will actually dispatch:
+                    # under chunking that is the chunk ladder shifted by
+                    # the hit width (gather+suffix at filled = n_pre,
+                    # n_pre + chunk_pages, ...), NOT the monolithic
+                    # cache-hit suffix program — warming the wrong one
+                    # leaves the real ladder to compile mid-serve
+                    suffix_len = int(l) - n_pre * bs
+                    if self.prefill_chunk \
+                            and suffix_len > self.prefill_chunk:
+                        n_full, flen, _ = self._chunk_plan(suffix_len)
+                        cpg = self.prefill_chunk // bs
+                        stages = [(n_pre + j * cpg, self.prefill_chunk)
+                                  for j in range(n_full)]
+                        stages.append((n_pre + n_full * cpg,
+                                       prompt_bucket(flen)))
+                    else:
+                        stages = [(n_pre, prompt_bucket(suffix_len))]
+                    for filled, cbucket in stages:
+                        with self._locked_dispatch(
+                                ("gather", filled),
+                                ("suffix", filled, cbucket, sampling),
+                                ("insert", cbucket)):
+                            ks, vs = self._gather_prefix(filled)(
+                                tuple(self.pools),
+                                jnp.zeros((filled,), jnp.int32))  # scratch
+                            _, cks, cvs = self._prefill_suffix(
+                                filled, cbucket, sampling)(
+                                state, ks, vs,
+                                jnp.zeros((1, cbucket), jnp.int32),
+                                jnp.int32(1), jax.random.PRNGKey(0))
+                            # dummy insert aimed at page 0: scratch absorbs
+                            # the writes, and the hit path's insert program
+                            # for this chunk shape is now warm too
+                            npg = self._pages_for_bucket(cbucket, bs)
+                            self.pools = list(self._insert(cbucket)(
+                                tuple(self.pools), cks, cvs,
+                                jnp.zeros((npg,), jnp.int32)))
 
     def _warmup_serves(self, prompt_lens, kw):
         # Decode-program ladder on a length-1 dummy prompt: the decode/block
@@ -746,15 +1007,25 @@ class ContinuousBatchingEngine:
         for n in runs:
             self.serve([np.ones(1, np.int32)], max_new_tokens=n, **kw)
         # Prefill + page-insert programs: one representative REAL length per
-        # bucket (a prompt of the bucket length itself may not be servable
-        # when the bucket touches max_len).
+        # PROGRAM SIGNATURE (a prompt of the bucket length itself may not be
+        # servable when the bucket touches max_len). Monolithic prompts
+        # share programs per bucket; chunked prompts share them per
+        # (full-chunk count, final-chunk bucket) — two prompts in the same
+        # bucket can walk different chunk ladders, and a ladder left cold
+        # here compiles inside the latency-sensitive serve instead.
         rep = {}
         for l in prompt_lens:
-            b = prompt_bucket(int(l))
-            rep[b] = min(rep.get(b, int(l)), int(l))
-        for b in sorted(rep):
-            if b != ladder_bucket or not runs:
-                self.serve([np.ones(rep[b], np.int32)], max_new_tokens=1, **kw)
+            l = int(l)
+            if self.prefill_chunk and l > self.prefill_chunk:
+                n_full, flen, _ = self._chunk_plan(l)
+                key = ("chunk", n_full, prompt_bucket(flen))
+            else:
+                key = ("mono", prompt_bucket(l))
+            rep[key] = min(rep.get(key, l), l)
+        for key in sorted(rep, key=str):
+            if key == ("mono", ladder_bucket) and runs:
+                continue  # the ladder serves above already compiled it
+            self.serve([np.ones(rep[key], np.int32)], max_new_tokens=1, **kw)
 
     # ---- scheduler --------------------------------------------------------
     def pool_bytes(self):
@@ -785,10 +1056,13 @@ class ContinuousBatchingEngine:
     # EngineRequest.cancelled flags, honored at block boundaries.
 
     def idle(self):
-        return not self._active
+        return (not self._active and not self._prefilling
+                and self._inflight is None)
 
     def active_count(self):
-        return len(self._active)
+        # mid-chunked-prefill requests occupy slots too — the router's
+        # load signal must see them
+        return len(self._active) + len(self._prefilling)
 
     def has_free_slot(self):
         return bool(self.free_slots)
@@ -834,12 +1108,34 @@ class ContinuousBatchingEngine:
         self.free_slots.append(slot)
         self.page_table[slot] = 0
         self.lengths[slot] = 0
-        if not self._active:
+        if not self._active and not self._prefilling:
+            self._active_sampling = None
+        return req
+
+    def _abort_prefill(self, slot, timed_out=False):
+        """Cancelled/timed-out mid-chunked-prefill: drop the remaining
+        chunks and retire with the prompt-only partial result (no token
+        was ever produced for it)."""
+        st = self._prefilling.pop(slot)
+        req = st.req
+        req.result = np.asarray(req.tokens, np.int32)
+        req.finished = True
+        req.timed_out = timed_out
+        req.t_done = time.monotonic()
+        self._unref_pages(st.pages)
+        self.free_slots.append(slot)
+        # mid-prefill slots keep their row at scratch by invariant, but
+        # reset defensively like _retire does — a future progressive-row
+        # install must not leak a stale row to the next tenant through
+        # this path
+        self.page_table[slot] = 0
+        self.lengths[slot] = 0
+        if not self._active and not self._prefilling:
             self._active_sampling = None
         return req
 
     def _update_gauges(self):
-        _M_OCCUPANCY.set(len(self._active) / self.max_seqs)
+        _M_OCCUPANCY.set(self.active_count() / self.max_seqs)
 
     def try_admit_one(self, req):
         """Non-blocking admission of one :class:`EngineRequest`: page
@@ -858,9 +1154,11 @@ class ContinuousBatchingEngine:
         (the degradation contract's "fail alone, never wedge the queue")."""
         if not self.free_slots:
             return "deferred"
-        if self._active and self._active_sampling != req.sampling:
+        if (self._active or self._prefilling) \
+                and self._active_sampling != req.sampling:
             # the sampler is a compile-time constant of the decode program:
-            # only requests sharing a sampling tuple can co-schedule
+            # only requests sharing a sampling tuple can co-schedule (a
+            # mid-prefill request will join the decode group too)
             return "deferred"
         # past the deferral gates the request is popped by the caller on
         # every return below, so this counts each request exactly once —
@@ -876,37 +1174,35 @@ class ContinuousBatchingEngine:
                 f"{req.max_new_tokens} exceeds max_len={self.max_len}"))
             return "failed"
         # reuse the version-checked capture across admissions AND decode
-        # steps — the O(n_params) tree walk stays off the TTFT-critical
-        # path. Version read BEFORE the capture: a mutation landing in
-        # between tags fresh state with a stale version, which merely
-        # forces an extra refresh next time — never a stale serve.
-        ver = _core.tensor_mutation_version()
-        cache = self._decode_state_cache
-        if cache is not None and cache[0] == ver:
-            state = cache[1]
-        else:
-            state = self.model.raw_state_dict()
-            self._decode_state_cache = (ver, state)
+        # steps — the O(n_params) tree walk stays off the TTFT-critical path
+        state = self._captured_state()
         bs_ = self.page_size
         if self.enable_prefix_cache:
             self._refresh_cache_guard(state)
-            n_pre, shared = self._match_prefix(prompt, true_len)
+            n_pre, shared, digests = self._match_prefix(prompt, true_len)
         else:
-            n_pre, shared = 0, []
-        # shrink the hit until prefix + rounded suffix bucket fit the page-
+            n_pre, shared, digests = 0, [], None
+
+        def _region_for(suffix_len):
+            # pages the PREFILL writes: the chunk ladder's exact page
+            # counts under chunking, the bucket-rounded region otherwise
+            if self.prefill_chunk and suffix_len > self.prefill_chunk:
+                return self._chunk_plan(suffix_len)[2]
+            return self._pages_for_bucket(prompt_bucket(suffix_len), bs_)
+
+        # shrink the hit until prefix + the prefill region fit the page-
         # table row: the suffix bucket rounds up independently, so a
         # full-width hit can otherwise need pages_per_seq+1 pages
+        suffix_len = true_len
         while n_pre:
             suffix_len = true_len - n_pre * bs_
-            sbucket = prompt_bucket(suffix_len)
-            if n_pre + self._pages_for_bucket(sbucket, bs_) \
-                    <= self.pages_per_seq:
+            if n_pre + _region_for(suffix_len) <= self.pages_per_seq:
                 break
             n_pre -= 1
             shared = shared[:n_pre]
         if not n_pre:
-            suffix_len, sbucket = true_len, bucket
-        region = self._pages_for_bucket(sbucket, bs_)
+            suffix_len = true_len
+        region = _region_for(suffix_len)
         total_need = max(n_pre + region,
                          -(-(true_len + req.max_new_tokens) // bs_))
         # hold the shared pages BEFORE the availability check: shared pages
@@ -915,7 +1211,7 @@ class ContinuousBatchingEngine:
         self._ref_pages(shared)
         if total_need - n_pre > self._available_pages():
             self._unref_pages(shared)
-            if not self._active:
+            if not self._active and not self._prefilling:
                 # nothing running and it still can't admit: with the pool
                 # otherwise idle that means it NEVER fits (needs more pages
                 # than exist). Fail it alone, keep the queue draining.
@@ -938,11 +1234,32 @@ class ContinuousBatchingEngine:
         pages = shared + new_pages
         self.stats["peak_pages"] = max(self.stats["peak_pages"],
                                        self._pages_in_use)
+        req.pages = pages
+        req.slot = slot
+        req.t_admit = time.monotonic()
+        sampling = req.sampling
+        if self.prefill_chunk and suffix_len > self.prefill_chunk:
+            # reserve-then-stream admission: the prompt lands chunk by
+            # chunk in step(), interleaved with everyone else's decode
+            # blocks, instead of one monolithic bucketed dispatch
+            req.tokens = list(prompt)  # tok0 appended at graduation
+            if n_pre:
+                self.stats["prefix_hit_pages"] += n_pre
+                _M_PREFIX_HIT.inc(n_pre)
+            self._prefilling[slot] = _PrefillState(req, pages, n_pre,
+                                                  digests)
+            self._active_sampling = sampling
+            # the FIRST chunk dispatches here — admission stays one
+            # bounded unit of device work, like a short prompt's prefill
+            return self._prefill_chunk_step(slot)
+        sbucket = prompt_bucket(suffix_len)
         ids_p = np.zeros((1, sbucket), np.int32)
         ids_p[0, :suffix_len] = prompt[n_pre * bs_:]
-        sampling = req.sampling
+        progs = ([("gather", n_pre), ("suffix", n_pre, sbucket, sampling)]
+                 if n_pre else [("prefill", sbucket, sampling)])
         try:
-            with _DISPATCH_LOCK, _trace.span("serve.prefill"):
+            with self._locked_dispatch(*progs, ("insert", sbucket)), \
+                    _trace.span("serve.prefill"):
                 if sampling[0] and req.key_base is None:
                     # key_base = fold_in(PRNGKey(seed), rid): the request's
                     # own stream root, so its sampled tokens are independent
@@ -969,36 +1286,46 @@ class ContinuousBatchingEngine:
                 page_ids = jnp.asarray(new_pages[:region], jnp.int32)
                 self.pools = list(self._insert(sbucket)(
                     tuple(self.pools), ks, vs, page_ids))
-            # sync INSIDE the guard: device-side prefill errors surface at
-            # this host transfer, not at dispatch — outside the try they
-            # would leak the popped slot + reffed pages and (online) kill
-            # the whole replica instead of failing this request alone
-            tok0 = int(tok0)
+                # sync INSIDE the guard: device-side prefill errors surface
+                # at this host transfer, not at dispatch — outside the try
+                # they would leak the popped slot + reffed pages and
+                # (online) kill the whole replica instead of failing this
+                # request alone. This is the prefill's designated readback
+                # (the first token gates admission bookkeeping).
+                tok0 = int(tok0)
         except Exception as e:  # error isolation: fail THIS request alone
             self._unref_pages(pages)
             self.free_slots.append(slot)
             self._fail_request(req, e)
             return "failed"
         if self.enable_prefix_cache:
-            self._index_prompt_pages(prompt, true_len, pages, n_pre)
+            self._index_prompt_pages(true_len, pages, n_pre, digests)
+        req.tokens = list(prompt)
+        return self._activate(slot, req, tok0)
+
+    def _activate(self, slot, req, tok0):
+        """Shared admission epilogue (monolithic prefill AND chunked
+        graduation — one copy, so the activation protocol cannot drift
+        between the two paths): install the page-table row, stamp the
+        first token, register the request in the decode group, fire the
+        callback, and retire immediately on a first-token eos / exhausted
+        budget. Returns "done" or "admitted"."""
         row = np.zeros(self.pages_per_seq, np.int32)
-        row[:len(pages)] = pages
+        row[:len(req.pages)] = req.pages
         self.page_table[slot] = row
-        self.lengths[slot] = true_len
+        self.lengths[slot] = len(req.prompt)
         now = time.monotonic()
-        req.t_admit = now
         req.t_first_token = now
         _M_TTFT.observe(now - req.t_enqueue)
         _M_TOKENS.inc()
-        req.tokens = list(prompt) + [tok0]
+        req.tokens.append(tok0)
         req.n_generated = 1
+        req.n_dispatched = 1
         req.last_token = tok0
-        req.pages = pages
-        req.slot = slot
         # register BEFORE the user callback: if it raises, the cleanup path
         # must see this slot to free its pages
         self._active[slot] = req
-        self._active_sampling = sampling
+        self._active_sampling = req.sampling
         if req.on_token is not None:
             req.on_token(req.rid, tok0)
         if (req.eos_token_id is not None and tok0 == req.eos_token_id) \
@@ -1006,6 +1333,125 @@ class ContinuousBatchingEngine:
             self._retire(slot)
             return "done"
         return "admitted"
+
+    def _chunk_plan(self, suffix_len):
+        """(full_chunks, final_len, region_pages) for a chunked suffix.
+        Non-final chunks are exactly ``prefill_chunk`` tokens (a whole
+        number of pages, so the next chunk's prefix gather reads no pad);
+        the final chunk keeps >=1 token so its logits produce the first
+        sampled token, and pads to its own prompt bucket like the
+        monolithic path."""
+        c = self.prefill_chunk
+        n_full = (suffix_len - 1) // c
+        final_len = suffix_len - n_full * c
+        region = (n_full * (c // self.page_size)
+                  + self._pages_for_bucket(prompt_bucket(final_len),
+                                           self.page_size))
+        return n_full, final_len, region
+
+    def _prefill_chunk_step(self, slot):
+        """Dispatch ONE prefill chunk for ``slot``. Chunk j is the prefix-
+        cache machinery applied to the engine's own partial work: gather
+        the pages already inserted, prefill the next chunk against them,
+        scatter its KV into the next pages. On the final chunk the request
+        graduates — samples tok0 with the same per-request key the
+        monolithic path uses (bit-identical first token), installs its
+        page-table row, and joins the decode group. Returns "admitted"
+        (still prefilling, or now decoding), "done" (graduated AND retired
+        on its first token), or "failed" (isolated failure; resources
+        freed, co-tenants unaffected)."""
+        st = self._prefilling[slot]
+        req = st.req
+        bs = self.page_size
+        prompt = req.prompt
+        true_len = len(prompt)
+        filled = st.filled_pages
+        done_tokens = filled * bs
+        rest = true_len - done_tokens
+        final = rest <= self.prefill_chunk
+        clen = rest if final else self.prefill_chunk
+        cbucket = prompt_bucket(clen) if final else clen
+        npg = self._pages_for_bucket(cbucket, bs)
+        sampling = req.sampling
+        state = self._captured_state()
+        ids = np.zeros((1, cbucket), np.int32)
+        ids[0, :clen] = prompt[done_tokens:done_tokens + clen]
+        progs = ([("gather", filled), ("suffix", filled, cbucket, sampling)]
+                 if filled else [("prefill", cbucket, sampling)])
+        try:
+            with self._locked_dispatch(*progs, ("insert", cbucket)), \
+                    _trace.span("serve.prefill"):
+                if final and sampling[0] and req.key_base is None:
+                    req.key_base = np.asarray(
+                        jax.random.fold_in(jax.random.PRNGKey(req.seed),
+                                           req.rid))
+                k0 = (jax.random.fold_in(jnp.asarray(req.key_base), 0)
+                      if final and sampling[0]
+                      else jnp.zeros((2,), jnp.uint32))
+                chaos.site("serve.prefill")
+                if filled:
+                    ks_pre, vs_pre = self._gather_prefix(filled)(
+                        tuple(self.pools),
+                        jnp.asarray(st.pages[:filled], jnp.int32))
+                    tok0, ks, vs = self._prefill_suffix(
+                        filled, cbucket, sampling)(
+                        state, ks_pre, vs_pre, jnp.asarray(ids),
+                        jnp.int32(clen), k0)
+                else:
+                    tok0, ks, vs = self._prefill(cbucket, sampling)(
+                        state, jnp.asarray(ids), jnp.int32(clen), k0)
+                page_ids = jnp.asarray(st.pages[filled:filled + npg],
+                                       jnp.int32)
+                self.pools = list(self._insert(cbucket)(
+                    tuple(self.pools), ks, vs, page_ids))
+                # readback INSIDE the try for EVERY chunk (the monolithic
+                # path's designated sync point, same rationale): a device-
+                # side chunk failure must surface here, where this
+                # request's resources free and it fails ALONE — deferred,
+                # it would materialize at a later unrelated decode
+                # readback, outside any per-request guard, and take the
+                # whole replica down. The wait itself costs little: this
+                # chunk chains behind the in-flight decode block whose
+                # readback happens later in the same step() anyway.
+                tok0 = int(tok0)
+        except Exception as e:
+            del self._prefilling[slot]
+            self._unref_pages(st.pages)
+            self.free_slots.append(slot)
+            if not self._active and not self._prefilling:
+                self._active_sampling = None
+            self._fail_request(req, e)
+            return "failed"
+        _M_CHUNKS.inc()
+        if not final:
+            st.filled_pages = filled + npg
+            return "admitted"
+        # ---- graduation: join the decode group -----------------------------
+        del self._prefilling[slot]
+        if self.enable_prefix_cache:
+            self._index_prompt_pages(true_len, st.pages, st.n_pre0,
+                                     st.digests)
+        return self._activate(slot, req, tok0)
+
+    def _advance_prefill(self):
+        """Land ONE pending prefill chunk per mid-prefill slot (called
+        between decode blocks, so a long prompt pays out its prefill
+        without ever monopolizing the device — each slot advances one
+        small chunk per decode block). Advancing every slot instead of
+        round-robining ONE keeps co-admitted long prompts graduating
+        nearly together: a decode block costs the same at any occupancy,
+        so staggered graduations that decode 1-2 rows at a time nearly
+        double the block count for the same tokens (measured 133 vs 76
+        steps on a 4-long + 12-short workload). Returns the requests that
+        reached a terminal state (graduated straight to done, or failed
+        in isolation)."""
+        out = []
+        for slot in list(self._prefilling):
+            req = self._prefilling[slot].req
+            status = self._prefill_chunk_step(slot)
+            if status in ("done", "failed"):
+                out.append(req)
+        return out
 
     def _admit_from(self, queue):
         """Admit from the head of ``queue`` (a deque of EngineRequests)
@@ -1023,62 +1469,148 @@ class ContinuousBatchingEngine:
         return admitted
 
     def step(self):
-        """One fused decode dispatch over the active slots, then retire
-        whatever finished (eos / token budget / timeout / cancellation).
-        Returns the list of EngineRequests that reached a terminal state
-        during this step; ``[]`` when idle. Never blocks beyond the device
-        dispatch itself — the frontend's dispatcher loop interleaves this
-        with admissions to keep slots full continuously."""
+        """One scheduling round: sweep cancellations, land at most one
+        prefill chunk, advance the decode pipeline, sweep timeouts.
+        Returns the EngineRequests that reached a terminal state during
+        this step; ``[]`` when idle.
+
+        Decode pipeline: under ``async_decode`` the engine keeps ONE block
+        in flight — block k+1 is dispatched chained off block k's device-
+        resident last-token row BEFORE block k's tokens are read back, so
+        the host emit/retire/admit work (and the caller's scheduling
+        between step() calls) runs under block k+1's device execution.
+        Retirement and admission stay at readback points; a slot whose
+        request finished mid-block simply has its overshoot tokens
+        discarded (its KV writes stay inside its still-held page
+        reservation, and any page later reallocated is fully rewritten by
+        the new tenant's prefill/decode before it is ever read). The sync
+        path (``async_decode=False``) dispatches and reads back in one
+        call — the pre-pipeline behavior, kept as the bench baseline."""
         retired = []
-        # cancellation sweep first: no decode compute for a dead request
+        # cancellation sweep first: no decode/prefill compute for a dead
+        # request
         for slot in list(self._active):
             if self._active[slot].cancelled:
                 retired.append(self._retire(slot))
+        for slot in list(self._prefilling):
+            if self._prefilling[slot].req.cancelled:
+                retired.append(self._abort_prefill(slot))
+        # one prefill chunk between decode blocks: long prompts pay out
+        # without stalling in-flight requests' TPOT
+        retired.extend(self._advance_prefill())
+        if self.async_decode:
+            prev = self._inflight
+            if prev is not None:
+                # overlap: enqueue block k+1 BEFORE block k's readback —
+                # the emit/retire work below runs under its execution
+                self._inflight = self._dispatch_decode(chain=prev)
+                retired.extend(self._process_block(prev))
+            if self._inflight is None and self._active:
+                self._inflight = self._dispatch_decode()
+        elif self._active:
+            rec = self._dispatch_decode()
+            if rec is not None:
+                retired.extend(self._process_block(rec))
+        now = time.monotonic()
+        for slot in list(self._active):
+            r = self._active[slot]
+            if r.timeout_s is not None and now - r.t_admit > r.timeout_s:
+                # deadline hit: return what it got, free the slot
+                self.stats["timed_out_requests"] += 1
+                counters.bump("fault.serve.request_timeout")
+                r.timed_out = True
+                retired.append(self._retire(slot))
+        for slot in list(self._prefilling):
+            r = self._prefilling[slot].req
+            if r.timeout_s is not None and now - r.t_admit > r.timeout_s:
+                self.stats["timed_out_requests"] += 1
+                counters.bump("fault.serve.request_timeout")
+                retired.append(self._abort_prefill(slot, timed_out=True))
+        self._update_gauges()
+        return retired
+
+    def _dispatch_decode(self, chain=None):
+        """Dispatch ONE decode block over the current active set WITHOUT
+        reading it back. ``chain`` is the still-in-flight previous block:
+        its device-resident last-token row feeds this block for every
+        slot it covered (the autoregressive dependency never round-trips
+        to the host); freshly admitted slots merge their host-known first
+        token in with one tiny fused select. Returns the new
+        _InflightBlock, or None when nothing can dispatch — empty active
+        set, or some row's token budget is fully dispatched (the caller
+        must read the in-flight block back first so those rows retire)."""
         if not self._active:
-            self._update_gauges()
-            return retired
+            return None
+        budgets = [r.max_new_tokens - r.n_dispatched
+                   for r in self._active.values()]
+        # Async pipeline: block size from the LARGEST remaining budget
+        # (power of two so the compile cache stays at log2(decode_block)
+        # programs) — short-budget rows ride along under their in-program
+        # length caps instead of dragging k down to the batch minimum,
+        # which under staggered admissions fragments every block to k=1-2
+        # and doubles dispatches. Sync mode keeps the pre-pipeline
+        # min-remaining policy verbatim (it IS the pre-PR engine — the
+        # bench baseline; the caps are the identity there since k never
+        # exceeds any row's budget).
+        remaining = max(budgets) if self.async_decode else min(budgets)
+        if remaining <= 0:
+            return None  # every row fully dispatched: read back, retire
         sampling = self._active_sampling
-        ver = _core.tensor_mutation_version()
-        cache = self._decode_state_cache
-        if cache is None or cache[0] != ver:
-            cache = self._decode_state_cache = (
-                ver, self.model.raw_state_dict())
-        state = cache[1]
-        # block size: never overshoot any active request's token budget (its
-        # page reservation covers exactly max_new_tokens); power of two so
-        # the compile cache stays at log2(decode_block) programs
-        remaining = min(r.max_new_tokens - r.n_generated
-                        for r in self._active.values())
+        state = self._captured_state()
         k = min(self.decode_block, remaining)
         k = 1 << (k.bit_length() - 1)
+        rows = list(self._active.items())
+        # a chained slot must still belong to the SAME request — a slot
+        # retired and re-admitted while the block was in flight feeds its
+        # new tenant's host-known token, not the dead tenant's device row
+        covered = ({s for s, r in chain.rows
+                    if self._active.get(s) is r} if chain is not None
+                   else ())
         toks = np.zeros((self.max_seqs, 1), np.int32)
+        fresh = np.zeros((self.max_seqs, 1), bool)
         bases = np.zeros((self.max_seqs, 2), np.uint32)
         idxs = np.zeros(self.max_seqs, np.int32)
-        for slot, r in self._active.items():
-            toks[slot, 0] = r.last_token
+        caps = np.zeros(self.max_seqs, np.int32)  # empty slots freeze at 0
+        for slot, r in rows:
+            # last page-reserved position: an over-budget row's writes
+            # freeze here inside the program (see _decode_block_fn)
+            caps[slot] = len(r.prompt) + r.max_new_tokens - 1
+            if slot not in covered:
+                toks[slot, 0] = r.last_token
+                fresh[slot, 0] = True
             if sampling[0]:
                 bases[slot] = r.key_base
-                idxs[slot] = r.n_generated
-        # the chaos site fires BEFORE the jitted call, so an injected outage
-        # retries against intact pools; a real failure after the dispatch
-        # donated them is not retriable (the retry would read donated
-        # buffers) and raises out through the caller's cleanup
+                idxs[slot] = r.n_dispatched
+        if chain is None:
+            feed = jnp.asarray(toks)
+        elif fresh.any():
+            feed = jnp.where(jnp.asarray(fresh), jnp.asarray(toks),
+                             chain.last)
+        else:
+            feed = chain.last
+        # the chaos site fires BEFORE the jitted call, so an injected
+        # outage retries against intact pools; a real failure after the
+        # dispatch donated them is not retriable (the retry would read
+        # donated buffers) and raises out through the caller's cleanup
         def dispatch():
             chaos.site("serve.decode")
             if k == 1:
-                nxt, pools = decode(
-                    state, jnp.asarray(toks), tuple(self.pools),
-                    jnp.asarray(self.page_table), jnp.asarray(self.lengths),
-                    keys[0])
-                return np.asarray(nxt)[None], pools
-            blk, pools = self._decode_block_fn(sampling, k)(
-                state, jnp.asarray(toks), tuple(self.pools),
+                nxt, pools = self._decode(sampling)(
+                    state, feed, tuple(self.pools),
+                    jnp.asarray(self.page_table),
+                    jnp.asarray(self.lengths), jnp.asarray(caps), keys[0])
+                return nxt[None], pools
+            return self._decode_block_fn(sampling, k)(
+                state, feed, tuple(self.pools),
                 jnp.asarray(self.page_table), jnp.asarray(self.lengths),
-                keys)
-            return np.asarray(blk), pools
+                jnp.asarray(caps), keys)
 
-        t_disp0 = time.monotonic()
-        with _DISPATCH_LOCK, _trace.span("serve.decode"):
+        progs = [("decode", sampling) if k == 1 else ("block", sampling, k)]
+        if sampling[0]:
+            progs.append(("keys", k))
+        host = None
+        t0 = time.monotonic()  # dispatch epoch: TPOT = readback - t0 per k
+        with self._locked_dispatch(*progs), _trace.span("serve.decode"):
             if sampling[0]:
                 idx_mat = idxs[None, :] + np.arange(k, dtype=np.int32)[:, None]
                 keys = _KEYS_FROM_BASE(jnp.asarray(bases),
@@ -1086,19 +1618,49 @@ class ContinuousBatchingEngine:
             else:
                 # greedy ignores the keys entirely — skip the device work
                 keys = jnp.zeros((k, self.max_seqs, 2), jnp.uint32)
-            decode = self._decode(sampling)
-            block, pools = self.retry_policy.run(dispatch, name="serve.decode")
-        # dispatch() syncs (np.asarray on the block), so this is real wall
-        # time; normalized per token it is the TPOT the serving comparison
-        # papers report
-        _M_TPOT.observe((time.monotonic() - t_disp0) / k)
+            blk, pools = self.retry_policy.run(dispatch, name="serve.decode")
+            if not self.async_decode:
+                # legacy sync semantics: the readback happens INSIDE the
+                # lock, exactly like the pre-pipeline engine — the lock
+                # covers the whole device round trip, which is what made
+                # replicas sharing a lock serialize their compute. The
+                # async path's readback is lock-free in _process_block.
+                host = np.asarray(blk)  # serve-readback-ok
         self.pools = list(pools)
-        self.stats["decode_steps"] += k
+        last = blk[k - 1][:, None]  # device row the NEXT block chains from
+        if hasattr(blk, "copy_to_host_async"):
+            blk.copy_to_host_async()  # transfer rides under the compute
+        # dispatch-time accounting: for every SURVIVING slot this equals
+        # what per-token emit accounting would produce (+k per block); a
+        # slot that turns out to have finished mid-block is zeroed at
+        # retire, so the overshoot never leaks
+        for slot, r in rows:
+            r.n_dispatched += k
+            self.lengths[slot] += k
+        return _InflightBlock(blk, last, k, rows, t0, host=host)
+
+    def _process_block(self, rec):
+        """The decode pipeline's designated readback point: block tokens
+        come to the host, per-request emit/retire runs, TPOT lands."""
+        if self.async_decode:
+            # host time that ran while the device executed this block —
+            # the latency the double-buffering hides per block
+            _M_OVERLAP.observe(time.monotonic() - rec.t0)
+        with _trace.span("serve.decode.sync"):
+            block = (rec.host if rec.host is not None
+                     else np.asarray(rec.blk))  # serve-readback-ok
+        # wall from dispatch to readback, normalized per token: the TPOT
+        # the serving comparison papers report
+        _M_TPOT.observe((time.monotonic() - rec.t0) / rec.k)
+        self.stats["decode_steps"] += rec.k
+        retired = []
         with _trace.span("serve.emit"):
-            for slot in list(self._active):
-                r = self._active[slot]
-                for s in range(k):
-                    self.lengths[slot] += 1  # the fed token is now in cache
+            for slot, r in rec.rows:
+                if r.finished or self._active.get(slot) is not r:
+                    # retired while in flight (cancel/timeout/reroute):
+                    # its overshoot tokens are discarded
+                    continue
+                for s in range(rec.k):
                     tok = int(block[s, slot])
                     r.tokens.append(tok)
                     r.n_generated += 1
@@ -1112,16 +1674,6 @@ class ContinuousBatchingEngine:
                         # mid-block EOS: rest of the block is discarded
                         retired.append(self._retire(slot))
                         break
-        now = time.monotonic()
-        for slot in list(self._active):
-            r = self._active[slot]
-            if r.timeout_s is not None and now - r.t_admit > r.timeout_s:
-                # deadline hit: return what it got, free the slot
-                self.stats["timed_out_requests"] += 1
-                counters.bump("fault.serve.request_timeout")
-                r.timed_out = True
-                retired.append(self._retire(slot))
-        self._update_gauges()
         return retired
 
     def drain(self):
@@ -1130,7 +1682,7 @@ class ContinuousBatchingEngine:
         and the escape hatch before calling batch serve() on an engine that
         still has online work in flight."""
         out = []
-        while self._active:
+        while self._active or self._prefilling or self._inflight is not None:
             out.extend(self.step())
         return out
 
@@ -1190,7 +1742,7 @@ class ContinuousBatchingEngine:
         on_token(request_id, token_id) streams each generated token (incl.
         the prefill's first token) as soon as its decode step completes —
         the serving-callback hook for SSE-style responses."""
-        if self._active:
+        if self._active or self._prefilling or self._inflight is not None:
             raise RuntimeError(
                 "serve() on an engine with active online requests — drain() "
                 "the frontend-driven work first")
@@ -1210,9 +1762,12 @@ class ContinuousBatchingEngine:
                 f"{len(prompts)} requests")
         # every serve() batch starts from a FRESH capture (old-code parity):
         # the version-keyed reuse below it only has to bridge admissions
-        # and decode blocks within one batch / online stretch
+        # and decode blocks within one batch / online stretch. Under the
+        # compile lock: a sibling replica tracing the shared model must
+        # not leak tracers into this walk (see _captured_state).
         ver = _core.tensor_mutation_version()
-        state = self.model.raw_state_dict()
+        with _COMPILE_LOCK:
+            state = self.model.raw_state_dict()
         self._decode_state_cache = (ver, state)
         if self.enable_prefix_cache:
             self._refresh_cache_guard(state)
@@ -1245,8 +1800,10 @@ class ContinuousBatchingEngine:
             with _trace.span("serve.admit"):
                 self._admit_from(queue)
             _M_QUEUE.set(len(queue))
-            while queue or self._active:
-                if not self._active:
+            while (queue or self._active or self._prefilling
+                   or self._inflight is not None):
+                if not (self._active or self._prefilling
+                        or self._inflight is not None):
                     # an idle engine always resolves its queue head (admit
                     # or fail-alone) — reaching here means the admission
                     # invariant broke, and spinning would hang the caller
@@ -1261,5 +1818,10 @@ class ContinuousBatchingEngine:
             self._request_errors_bound = 1024
             # a raising on_token (or any mid-serve failure) must not leak a
             # warm engine's pages/slots: retire whatever is still active
+            # (and drop any unprocessed in-flight block — its tokens are
+            # lost with the requests they belonged to)
+            self._inflight = None
             for slot in list(self._active):
                 self._retire(slot)
+            for slot in list(self._prefilling):
+                self._abort_prefill(slot)

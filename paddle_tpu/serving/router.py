@@ -126,7 +126,9 @@ class ReplicaHandle:
         return (slots + pages + queue) / 3.0
 
     def prefix_fraction(self, prompt):
-        """Fraction of this prompt's full pages already indexed here."""
+        """Fraction of this prompt's full pages already indexed here.
+        O(prompt bytes): the engine's prefix index is keyed by chained
+        per-page digests (ISSUE 6 satellite), not full-prefix re-hashes."""
         total = max(1, (len(prompt) - 1) // self.engine.page_size)
         return self.engine.prefix_match_pages(prompt) / total
 
@@ -196,8 +198,8 @@ class Router:
         # policy must still score (and later record the session hint)
         # while one replica has the pool to itself (a drain window), or
         # every session re-homes blind when the drained replica returns
-        with self._lock:  # _hints read + rr cursor only — the O(pages^2)
-            # affinity probe below must not serialize concurrent submits
+        with self._lock:  # _hints read + rr cursor only — the affinity
+            # probe below must not serialize concurrent submits
             # or make a replica-death relocation queue behind them
             if self.policy == "round_robin":
                 pick = live[self._rr % len(live)]

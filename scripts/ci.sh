@@ -62,6 +62,41 @@ if grep -nE '\btime\.sleep\(' paddle_tpu/serving/*.py; then
   exit 1
 fi
 
+# serving data-plane sync lint (ISSUE 6 satellite): the decode dispatch
+# critical section must never block on a host sync (np.asarray on device
+# values, block_until_ready, device_get) outside the designated readback
+# point — an accidental sync there un-hides exactly the dispatch latency
+# the double-buffered pipeline exists to hide. The allowlist is the
+# `serve-readback-ok` marker on the designated readback lines.
+python - <<'PY'
+import ast, re, sys
+
+SRC = "paddle_tpu/inference/continuous.py"
+DECODE_FNS = {"step", "_dispatch_decode", "_process_block",
+              "_advance_prefill", "drain"}
+# (?<!j) spares jnp.asarray — a host->device UPLOAD never blocks on the
+# device; the forbidden direction is device->host
+SYNC = re.compile(r"(?<!j)np\.asarray\(|block_until_ready|device_get")
+src = open(SRC).read()
+lines = src.splitlines()
+bad = []
+for node in ast.walk(ast.parse(src)):
+    if isinstance(node, ast.FunctionDef) and node.name in DECODE_FNS:
+        for ln in range(node.lineno, node.end_lineno + 1):
+            text = lines[ln - 1]
+            if "serve-readback-ok" in text:
+                continue
+            if SYNC.search(text):
+                bad.append((ln, text.strip()))
+if bad:
+    for ln, text in bad:
+        print(f"{SRC}:{ln}: {text}")
+    print("lint: blocking host sync inside the decode dispatch critical "
+          "section — move it to the designated readback point (or tag a "
+          "deliberate readback with  # serve-readback-ok)", file=sys.stderr)
+    sys.exit(1)
+PY
+
 # checkpoint atomic-commit lint (ISSUE 3 satellite): every byte written into
 # a checkpoint directory must flow through checkpoint/atomic.py (temp+fsync+
 # rename) — a raw write-mode open() anywhere else in the checkpoint package
@@ -93,6 +128,7 @@ FAST_TESTS=(
   tests/test_nn.py
   tests/test_inference.py
   tests/test_serving_frontend.py
+  tests/test_serving_perf.py
 )
 
 if [[ "${1:-}" == "--fast" ]]; then

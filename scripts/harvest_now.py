@@ -36,7 +36,7 @@ def main():
     for name, idx, budget in PLAN:
         if only and name not in only:
             continue
-        ok, backend = bench._probe_backend()
+        ok, backend, _probe = bench._probe_backend()
         if not ok or backend != "tpu":
             print(f"[harvest] backend gone before {name} (ok={ok} backend={backend}); stopping",
                   flush=True)

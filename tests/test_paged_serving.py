@@ -229,9 +229,11 @@ class TestContinuousBatching:
         toks = jnp.zeros((4, 1), jnp.int32)
         keys = jnp.stack([jax.random.PRNGKey(0)] * 4)
         decode = eng._decode((False, 1.0, 0, 1.0))
+        caps = jnp.full((4,), 63, jnp.int32)  # per-row length caps (ISSUE 6)
         lowered = decode.lower(
             state, toks, tuple(eng.pools),
-            jnp.asarray(eng.page_table), jnp.asarray(eng.lengths), keys)
+            jnp.asarray(eng.page_table), jnp.asarray(eng.lengths), caps,
+            keys)
         temp = lowered.compile().memory_analysis().temp_size_in_bytes
         # with donated pools the aliased outputs count toward temp in XLA's
         # accounting, so allow up to ~1.5x the pool itself; the failure mode

@@ -585,13 +585,13 @@ class TestDrainAndFailover:
         device call) must still be declared dead — the lock probe that
         defers death verdicts while a compile holds the lock cannot defer
         forever, or every in-flight handle hangs with it."""
-        from paddle_tpu.inference.continuous import _DISPATCH_LOCK
+        from paddle_tpu.inference.continuous import _COMPILE_LOCK
 
         barrier = threading.Event()
 
         class LockWedgedEngine(FakeEngine):
             def step(self):
-                with _DISPATCH_LOCK:  # hung holding the lock, like a real
+                with _COMPILE_LOCK:  # hung holding the lock, like a real
                     barrier.wait(20)  # jitted call that never returns
                 return super().step()
 
@@ -616,7 +616,7 @@ class TestDrainAndFailover:
         on OTHER threads' healthy young lock holds — the deferral only
         applies when the stale dispatcher itself holds or awaits the
         lock."""
-        from paddle_tpu.inference.continuous import _DISPATCH_LOCK
+        from paddle_tpu.inference.continuous import _COMPILE_LOCK
 
         barrier = threading.Event()
         wedged = FakeEngine(step_barrier=barrier)  # wedge NOT in the lock
@@ -627,7 +627,7 @@ class TestDrainAndFailover:
 
         def busy_compiles():  # unrelated young holds, refreshed constantly
             while not release.is_set():
-                with _DISPATCH_LOCK:
+                with _COMPILE_LOCK:
                     release.wait(0.05)
 
         holder = threading.Thread(target=busy_compiles, daemon=True)
@@ -649,19 +649,41 @@ class TestDrainAndFailover:
         """Unit drive of the monitor verdict: a stale-beat replica whose
         dispatcher HOLDS (or awaits) a young dispatch-lock hold is spared;
         the same staleness with the dispatcher uninvolved is fatal."""
-        from paddle_tpu.inference.continuous import _DISPATCH_LOCK
+        from paddle_tpu.inference.continuous import _COMPILE_LOCK
 
         fe = ServingFrontend([FakeEngine(), FakeEngine()], start=False)
         rep = fe.replicas[0]
         rep.last_beat = time.monotonic() - 60  # long stale
         rep.thread_ident = threading.get_ident()
-        with _DISPATCH_LOCK:  # this thread = the replica's "dispatcher"
+        with _COMPILE_LOCK:  # this thread = the replica's "dispatcher"
             fe._check_replica_liveness(rep, time.monotonic())
             assert rep.state == LIVE  # young own hold: compiling, spared
         rep.thread_ident = -1  # staleness no longer attributable to the lock
-        with _DISPATCH_LOCK:
+        with _COMPILE_LOCK:
             fe._check_replica_liveness(rep, time.monotonic())
             assert rep.state == DEAD  # someone else's hold doesn't save it
+        fe.shutdown()
+
+    def test_liveness_verdict_defers_for_engine_lock_participants(self):
+        """Lock decomposition (ISSUE 6): the monitor also spares a replica
+        whose dispatcher holds its OWN engine's per-engine dispatch lock
+        under a young hold (executing a long but live jitted call), while a
+        neighbor replica's hold of ITS engine lock spares nobody else."""
+        from paddle_tpu.inference.continuous import _StampedRLock
+
+        e0, e1 = FakeEngine(), FakeEngine()
+        e0.dispatch_lock = _StampedRLock()
+        e1.dispatch_lock = _StampedRLock()
+        fe = ServingFrontend([e0, e1], start=False)
+        rep = fe.replicas[0]
+        rep.last_beat = time.monotonic() - 60  # long stale
+        rep.thread_ident = threading.get_ident()
+        with e0.dispatch_lock:  # this thread = replica0's dispatcher
+            fe._check_replica_liveness(rep, time.monotonic())
+            assert rep.state == LIVE  # young own-engine hold: spared
+        with e1.dispatch_lock:  # the NEIGHBOR engine's lock is irrelevant
+            fe._check_replica_liveness(rep, time.monotonic())
+            assert rep.state == DEAD
         fe.shutdown()
 
     def test_chaos_replica_kill_site(self):
