@@ -228,6 +228,49 @@ def _run_mode(model, mode, knobs, rng_seed, vocab):
     return summary
 
 
+def _telemetry_snapshot(model, knobs, rng_seed, vocab):
+    """ISSUE 7 satellite: one telemetry block for the bench-contract JSON —
+    request-trace counts, dropped spans, and the MEASURED enabled-vs-
+    disabled tracing overhead on the same small load (best-of-3 per mode,
+    same reasoning as the main phases). Tracing state is restored."""
+    import numpy as np
+
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.observability.metrics import registry as _registry
+    from paddle_tpu.serving import ServingFrontend
+
+    rng = np.random.RandomState(rng_seed + 17)
+    shorts = [(rng.randint(1, vocab, (int(rng.randint(8, 24)),))
+               .astype(np.int32), knobs["inter_new"], "interactive")
+              for _ in range(4)]
+    was_enabled = tracing.enabled()
+    walls = {}
+    try:
+        for mode in ("disabled", "enabled"):
+            engines = _make_engines(model, "pipelined", 1, knobs)
+            for e in engines:
+                e.warmup(buckets=sorted({len(p) for p, _, _ in shorts}))
+            (tracing.enable if mode == "enabled" else tracing.disable)()
+            best = None
+            with ServingFrontend(engines, heartbeat_deadline_s=600.0) as fe:
+                for _ in range(3):
+                    _, wall = _run_load(fe, shorts)
+                    best = wall if best is None else min(best, wall)
+            walls[mode] = best
+    finally:
+        (tracing.enable if was_enabled else tracing.disable)()
+    delta = walls["enabled"] - walls["disabled"]
+    return {
+        "traces": int(getattr(_registry.get("rtrace.traces"), "value", 0)),
+        "dropped_spans": int(getattr(
+            _registry.get("rtrace.dropped_spans"), "value", 0)),
+        "wall_disabled_s": round(walls["disabled"], 4),
+        "wall_enabled_s": round(walls["enabled"], 4),
+        "overhead_delta_s": round(delta, 4),
+        "overhead_fraction": round(delta / max(walls["disabled"], 1e-9), 4),
+    }
+
+
 def run_bench(quick=False, seed=0):
     import jax
 
@@ -250,6 +293,7 @@ def run_bench(quick=False, seed=0):
                      batch_new=64, inter_new=32, repeats=4)
     base = _run_mode(model, "baseline", knobs, seed, vocab)
     pipe = _run_mode(model, "pipelined", knobs, seed, vocab)
+    telemetry = _telemetry_snapshot(model, knobs, seed, vocab)
     speedup = pipe["tokens_per_sec"] / max(base["tokens_per_sec"], 1e-9)
     b_ttft = base.get("ttft_under_prefill_p50_s") or 0.0
     p_ttft = pipe.get("ttft_under_prefill_p50_s") or 0.0
@@ -274,6 +318,9 @@ def run_bench(quick=False, seed=0):
                 "pipelined_p50_s": p_ttft,
                 "speedup": round(ttft_speedup, 3) if ttft_speedup else None,
             },
+            # ISSUE 7 satellite: request-trace counts + measured
+            # enabled-vs-disabled tracing overhead on the same load
+            "telemetry": telemetry,
         },
     }
 

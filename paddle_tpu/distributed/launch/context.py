@@ -53,6 +53,15 @@ def build_parser():
                         "handlers emergency-flush Tier-0 snapshots and the "
                         "watch loop restarts them into the checkpoint "
                         "recovery ladder (requires --hang_deadline > 0)")
+    p.add_argument("--statusz_port", type=int,
+                   default=(int(os.environ["PADDLE_STATUSZ_PORT"])
+                            if os.environ.get("PADDLE_STATUSZ_PORT")
+                            else None),
+                   help="serve the live introspection endpoint (/statusz, "
+                        "/varz Prometheus text, /tracez, /healthz — "
+                        "docs/OBSERVABILITY.md) from the launcher on this "
+                        "port (0 = pick a free one; env PADDLE_STATUSZ_PORT "
+                        "sets the default; unset = off)")
     p.add_argument("--run_mode", default="collective")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)

@@ -189,6 +189,19 @@ class CollectiveController:
         if total_budget is None or total_budget < 0:
             total_budget = max(1, args.max_restart) * len(pod.containers) * 2
         watchdog = None
+        statusz = None
+        statusz_port = getattr(args, "statusz_port", None)
+        if statusz_port is not None:
+            # live introspection for the whole pod (ISSUE 7): /healthz
+            # reads the same per-rank heartbeat files the hang watchdog
+            # does, /varz exposes the controller-side registry
+            from ...observability.statusz import StatusServer
+
+            os.makedirs(self.telemetry_dir, exist_ok=True)
+            statusz = StatusServer(port=statusz_port,
+                                   telemetry_dir=self.telemetry_dir).start()
+            print(f"[paddle_tpu.launch] statusz serving on "
+                  f"http://127.0.0.1:{statusz.port}/statusz", file=sys.stderr)
         deadline = getattr(args, "hang_deadline", 0) or 0
         if deadline > 0:
             import signal as _signal
@@ -211,6 +224,8 @@ class CollectiveController:
         finally:
             if watchdog is not None:
                 watchdog.stop()
+            if statusz is not None:
+                statusz.stop()
 
     def _watch_loop(self, pod, args, total_restarts, total_budget):
         while True:

@@ -55,7 +55,7 @@ import math
 import threading
 import time
 from collections import deque
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +64,7 @@ import numpy as np
 from ..framework import core as _core
 from ..framework.core import Tensor
 from ..generation import _make_sampler, prompt_bucket
+from ..observability import goodput as _goodput
 from ..observability import tracing as _trace
 from ..observability.metrics import registry as _registry
 from ..ops.paged_attention import PagedLayerCache
@@ -234,7 +235,7 @@ class EngineRequest:
                  "n_generated", "n_dispatched", "last_token", "pages",
                  "slot", "key_base", "t_enqueue", "t_admit",
                  "t_first_token", "t_done", "error", "result", "finished",
-                 "timed_out", "cancelled")
+                 "timed_out", "cancelled", "trace")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
                  sampling=GREEDY_SAMPLING, seed=0, timeout_s=None,
@@ -277,6 +278,11 @@ class EngineRequest:
         self.timed_out = False
         self.cancelled = False    # set by the frontend; honored at the next
         # block boundary (the request retires with a partial result)
+        # request-scoped tracing (ISSUE 7): the frontend's per-attempt span
+        # handle — the engine's admit/prefill/decode spans nest under it.
+        # None on the batch serve() path / when telemetry is off; a reroute
+        # clone gets the NEW attempt's span from the frontend.
+        self.trace = None
 
     @property
     def error_message(self):
@@ -336,15 +342,16 @@ class _InflightBlock:
     array, the slot→request mapping frozen at dispatch time, and the
     device-resident last-step row the NEXT block's feed chains from."""
 
-    __slots__ = ("blk", "last", "k", "rows", "t0", "host")
+    __slots__ = ("blk", "last", "k", "rows", "t0", "host", "cold")
 
-    def __init__(self, blk, last, k, rows, t0, host=None):
+    def __init__(self, blk, last, k, rows, t0, host=None, cold=False):
         self.blk = blk      # device [k, max_seqs] token block
         self.last = last    # device [max_seqs, 1] last-step tokens
         self.k = k
         self.rows = rows    # [(slot, req)] active at dispatch
         self.t0 = t0
         self.host = host    # sync mode: tokens already read back in-lock
+        self.cold = cold    # dispatched under a first-trace (compile) hold
 
 
 class ContinuousBatchingEngine:
@@ -434,6 +441,7 @@ class ContinuousBatchingEngine:
         # the global _COMPILE_LOCK — see _locked_dispatch()
         self.dispatch_lock = dispatch_lock or _StampedRLock()
         self._warm = set()          # program keys that have run successfully
+        self._last_dispatch_cold = False  # last _locked_dispatch traced?
         self._prefilling = {}       # slot -> _PrefillState (chunked prefill)
         self._inflight = None       # the ONE in-flight _InflightBlock
         self.enable_prefix_cache = bool(enable_prefix_cache)
@@ -677,8 +685,12 @@ class ContinuousBatchingEngine:
         engine's execution lock; any cold key additionally takes the
         process-wide compile lock for the duration (first call = trace).
         Keys are marked warm only after the section SUCCEEDS, so a
-        retried transient failure recompiles under the lock again."""
+        retried transient failure recompiles under the lock again.
+        ``_last_dispatch_cold`` records whether THIS section traced — the
+        serving-goodput split attributes cold sections to 'compile'
+        instead of prefill/decode."""
         cold = [k for k in keys if k not in self._warm]
+        self._last_dispatch_cold = bool(cold)
         if not cold:
             with self.dispatch_lock:
                 yield
@@ -686,6 +698,22 @@ class ContinuousBatchingEngine:
         with _COMPILE_LOCK, self.dispatch_lock:
             yield
         self._warm.update(cold)
+
+    def _xprof_annotation(self, req):
+        """Host-side profiler annotation carrying the request's trace_id
+        (``rtrace:<id>``): xprof's trace viewer shows it on the host
+        timeline aligned with the device ops this dispatch enqueued — the
+        join key between request traces and device profiles. Per-request
+        program metadata is impossible (programs are compiled once per
+        bucket and shared across requests), so the correlation is by host
+        timeline, not op name. No-op without a trace."""
+        if req.trace is None:
+            return nullcontext()
+        try:
+            return jax.profiler.TraceAnnotation(
+                f"rtrace:{req.trace.ctx.trace_id}")
+        except Exception:
+            return nullcontext()
 
     def _captured_state(self):
         """The version-checked raw_state_dict capture shared by admission
@@ -1164,6 +1192,9 @@ class ContinuousBatchingEngine:
         # every return below, so this counts each request exactly once —
         # on BOTH the batch serve() path and the frontend's online path
         _M_REQUESTS.inc()
+        # request-scoped trace (ISSUE 7): the admission span nests under
+        # the frontend's attempt span; every return below closes it
+        adm = req.trace.child("admit") if req.trace is not None else None
         prompt = req.prompt
         true_len = len(prompt)
         bucket = prompt_bucket(true_len)
@@ -1172,6 +1203,8 @@ class ContinuousBatchingEngine:
             self._fail_request(req, ValueError(
                 f"request {req.rid}: len {true_len} (bucket {bucket}) + "
                 f"{req.max_new_tokens} exceeds max_len={self.max_len}"))
+            if adm is not None:
+                adm.end("error", error=req.error_message)
             return "failed"
         # reuse the version-checked capture across admissions AND decode
         # steps — the O(n_params) tree walk stays off the TTFT-critical path
@@ -1219,8 +1252,12 @@ class ContinuousBatchingEngine:
                     f"request {req.rid} needs more pages than the pool holds "
                     f"({true_len}+{req.max_new_tokens} tokens vs "
                     f"{(self.num_pages - 1) * self.page_size} pool tokens)"))
+                if adm is not None:
+                    adm.end("error", error=req.error_message)
                 return "failed"
             self.stats["deferred_admissions"] += 1
+            if adm is not None:  # honest trace: each deferred probe shows
+                adm.end("deferred", need_pages=total_need - n_pre)
             return "deferred"
         if self.enable_prefix_cache:
             # hit-rate denominator, counted once per ADMISSION (a deferred
@@ -1249,6 +1286,9 @@ class ContinuousBatchingEngine:
             self._prefilling[slot] = _PrefillState(req, pages, n_pre,
                                                   digests)
             self._active_sampling = sampling
+            if adm is not None:
+                adm.end("ok", slot=slot, pages=len(pages),
+                        prefix_hit_pages=n_pre, chunked=True)
             # the FIRST chunk dispatches here — admission stays one
             # bounded unit of device work, like a short prompt's prefill
             return self._prefill_chunk_step(slot)
@@ -1257,9 +1297,10 @@ class ContinuousBatchingEngine:
         ids_p[0, :suffix_len] = prompt[n_pre * bs_:]
         progs = ([("gather", n_pre), ("suffix", n_pre, sbucket, sampling)]
                  if n_pre else [("prefill", sbucket, sampling)])
+        t_p0 = time.monotonic()
         try:
             with self._locked_dispatch(*progs, ("insert", sbucket)), \
-                    _trace.span("serve.prefill"):
+                    _trace.span("serve.prefill"), self._xprof_annotation(req):
                 if sampling[0] and req.key_base is None:
                     # key_base = fold_in(PRNGKey(seed), rid): the request's
                     # own stream root, so its sampled tokens are independent
@@ -1297,11 +1338,27 @@ class ContinuousBatchingEngine:
             self._unref_pages(pages)
             self.free_slots.append(slot)
             self._fail_request(req, e)
+            if adm is not None:
+                adm.end("error", error=req.error_message)
             return "failed"
+        dt = time.monotonic() - t_p0
+        if _trace.enabled():
+            # serving goodput split: a cold section is compile stall, not
+            # prefill throughput (ISSUE 7 satellite)
+            _goodput.serving_note(
+                "compile" if self._last_dispatch_cold else "prefill", dt)
+        if adm is not None:
+            adm.span_at("prefill", dt, dt, bucket=sbucket,
+                        prefix_hit_pages=n_pre,
+                        cold=self._last_dispatch_cold)
         if self.enable_prefix_cache:
             self._index_prompt_pages(true_len, pages, n_pre, digests)
         req.tokens = list(prompt)
-        return self._activate(slot, req, tok0)
+        status = self._activate(slot, req, tok0)
+        if adm is not None:
+            adm.end("ok", slot=slot, pages=len(pages),
+                    prefix_hit_pages=n_pre)
+        return status
 
     def _activate(self, slot, req, tok0):
         """Shared admission epilogue (monolithic prefill AND chunked
@@ -1317,6 +1374,9 @@ class ContinuousBatchingEngine:
         now = time.monotonic()
         req.t_first_token = now
         _M_TTFT.observe(now - req.t_enqueue)
+        if req.trace is not None:
+            req.trace.event("first_token",
+                            ttft_s=round(now - req.t_enqueue, 6))
         _M_TOKENS.inc()
         req.tokens.append(tok0)
         req.n_generated = 1
@@ -1378,9 +1438,10 @@ class ContinuousBatchingEngine:
         ids[0, :clen] = prompt[done_tokens:done_tokens + clen]
         progs = ([("gather", filled), ("suffix", filled, cbucket, sampling)]
                  if filled else [("prefill", cbucket, sampling)])
+        t_p0 = time.monotonic()
         try:
             with self._locked_dispatch(*progs, ("insert", cbucket)), \
-                    _trace.span("serve.prefill"):
+                    _trace.span("serve.prefill"), self._xprof_annotation(req):
                 if final and sampling[0] and req.key_base is None:
                     req.key_base = np.asarray(
                         jax.random.fold_in(jax.random.PRNGKey(req.seed),
@@ -1421,8 +1482,19 @@ class ContinuousBatchingEngine:
             if not self._active and not self._prefilling:
                 self._active_sampling = None
             self._fail_request(req, e)
+            if req.trace is not None:
+                req.trace.event("prefill_chunk_failed",
+                                error=req.error_message)
             return "failed"
         _M_CHUNKS.inc()
+        dt = time.monotonic() - t_p0
+        if _trace.enabled():
+            _goodput.serving_note(
+                "compile" if self._last_dispatch_cold else "prefill", dt)
+        if req.trace is not None:
+            req.trace.span_at("prefill_chunk", dt, dt,
+                              filled_pages=filled, tokens=clen, final=final,
+                              cold=self._last_dispatch_cold)
         if not final:
             st.filled_pages = filled + npg
             return "admitted"
@@ -1627,6 +1699,12 @@ class ContinuousBatchingEngine:
                 # async path's readback is lock-free in _process_block.
                 host = np.asarray(blk)  # serve-readback-ok
         self.pools = list(pools)
+        cold = self._last_dispatch_cold
+        if _trace.enabled() and cold:
+            # a cold decode dispatch spent its wall tracing, not decoding —
+            # the block's readback skips its 'decode' note (the cold flag
+            # rides the _InflightBlock) so the same wall isn't counted twice
+            _goodput.serving_note("compile", time.monotonic() - t0)
         last = blk[k - 1][:, None]  # device row the NEXT block chains from
         if hasattr(blk, "copy_to_host_async"):
             blk.copy_to_host_async()  # transfer rides under the compute
@@ -1637,7 +1715,7 @@ class ContinuousBatchingEngine:
         for slot, r in rows:
             r.n_dispatched += k
             self.lengths[slot] += k
-        return _InflightBlock(blk, last, k, rows, t0, host=host)
+        return _InflightBlock(blk, last, k, rows, t0, host=host, cold=cold)
 
     def _process_block(self, rec):
         """The decode pipeline's designated readback point: block tokens
@@ -1651,20 +1729,34 @@ class ContinuousBatchingEngine:
                      else np.asarray(rec.blk))  # serve-readback-ok
         # wall from dispatch to readback, normalized per token: the TPOT
         # the serving comparison papers report
-        _M_TPOT.observe((time.monotonic() - rec.t0) / rec.k)
+        block_wall = time.monotonic() - rec.t0
+        _M_TPOT.observe(block_wall / rec.k)
+        if _trace.enabled() and not rec.cold:
+            # serving goodput: dispatch→readback is the decode slice (under
+            # async overlap it runs concurrently with host_emit/admit — the
+            # split reports attribution, not a partition of wall clock). A
+            # cold block already landed in 'compile' at dispatch.
+            _goodput.serving_note("decode", block_wall)
         self.stats["decode_steps"] += rec.k
         retired = []
+        t_e0 = time.monotonic()
         with _trace.span("serve.emit"):
             for slot, r in rec.rows:
                 if r.finished or self._active.get(slot) is not r:
                     # retired while in flight (cancel/timeout/reroute):
                     # its overshoot tokens are discarded
                     continue
+                if r.trace is not None:
+                    # the request's view of this fused decode dispatch
+                    r.trace.span_at("decode_block", block_wall, block_wall,
+                                    k=rec.k)
+                emitted = 0
                 for s in range(rec.k):
                     tok = int(block[s, slot])
                     r.tokens.append(tok)
                     r.n_generated += 1
                     r.last_token = tok
+                    emitted += 1
                     _M_TOKENS.inc()
                     if r.on_token is not None:
                         r.on_token(r.rid, tok)
@@ -1674,6 +1766,11 @@ class ContinuousBatchingEngine:
                         # mid-block EOS: rest of the block is discarded
                         retired.append(self._retire(slot))
                         break
+                if r.trace is not None:
+                    r.trace.event("emit", tokens=emitted,
+                                  n_generated=r.n_generated)
+        if _trace.enabled():
+            _goodput.serving_note("host_emit", time.monotonic() - t_e0)
         return retired
 
     def drain(self):

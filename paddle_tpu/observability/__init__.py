@@ -20,6 +20,8 @@ it is the EventCounters cost model. Span tracing and goodput timers are
 docs/OBSERVABILITY.md for the metric/span taxonomy and env vars.
 """
 from . import goodput  # noqa: F401
+from . import request_trace  # noqa: F401
+from . import slo  # noqa: F401
 from .goodput import GoodputAccountant  # noqa: F401
 from .metrics import (  # noqa: F401
     DEFAULT_BUCKETS,
@@ -29,6 +31,8 @@ from .metrics import (  # noqa: F401
     MetricsRegistry,
     registry,
 )
+from .slo import SLOMonitor, SLOObjective  # noqa: F401
+from .statusz import StatusServer  # noqa: F401
 from .tracing import (  # noqa: F401
     JsonlSpanSink,
     add_jsonl_sink,
@@ -44,5 +48,6 @@ __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "registry", "span", "enable", "disable", "enabled", "last_spans",
     "add_jsonl_sink", "JsonlSpanSink", "goodput", "GoodputAccountant",
-    "HangWatchdog", "Heartbeat", "maybe_beat",
+    "HangWatchdog", "Heartbeat", "maybe_beat", "request_trace", "slo",
+    "SLOMonitor", "SLOObjective", "StatusServer",
 ]

@@ -26,9 +26,15 @@ import time
 from . import tracing
 
 __all__ = ["GoodputAccountant", "accountant", "account", "note", "report",
-           "reset", "CATEGORIES"]
+           "reset", "CATEGORIES", "SERVING_CATEGORIES", "serving",
+           "serving_note", "serving_report"]
 
 CATEGORIES = ("init", "step", "data_wait", "checkpoint", "recovery")
+
+#: serving-path taxonomy (ISSUE 7 satellite): engine wall clock classified
+#: into device-productive work (prefill, decode) vs host/emit, dispatcher
+#: idle, and compile stalls — the serving analogue of the training split
+SERVING_CATEGORIES = ("prefill", "decode", "host_emit", "idle", "compile")
 
 
 class _Timer:
@@ -48,7 +54,10 @@ class _Timer:
 
 
 class GoodputAccountant:
-    def __init__(self):
+    def __init__(self, goodput_categories=("step",)):
+        #: the categories that COUNT as goodput in report() — ("step",) for
+        #: the training accountant, ("prefill", "decode") for serving
+        self.goodput_categories = tuple(goodput_categories)
         self._lock = threading.Lock()
         self._totals = {}
         self._t0 = time.perf_counter()
@@ -77,14 +86,15 @@ class GoodputAccountant:
         totals = self.totals()
         tracked = sum(totals.values())
         frac = {k: (v / wall if wall > 0 else 0.0) for k, v in totals.items()}
+        good = self.goodput_categories
         return {
             "wall_s": wall,
             "tracked_s": tracked,
             "untracked_s": max(0.0, wall - tracked),
             "categories": totals,
             "fractions": frac,
-            "goodput_fraction": frac.get("step", 0.0),
-            "badput": {k: v for k, v in frac.items() if k != "step"},
+            "goodput_fraction": sum(frac.get(c, 0.0) for c in good),
+            "badput": {k: v for k, v in frac.items() if k not in good},
         }
 
     def reset(self):
@@ -100,3 +110,17 @@ note = accountant.note
 totals = accountant.totals
 report = accountant.report
 reset = accountant.reset
+
+#: the serving-path accountant: device work (prefill + decode) is the
+#: goodput; host_emit / idle / compile are the badput the data-plane
+#: pipelining (ISSUE 6) exists to hide. Fed by the engine's dispatch
+#: epilogues and the frontend's idle waits — gated on the same telemetry
+#: switch as every other timer (call sites check tracing.enabled()).
+#: Attribution caveat: N dispatcher threads each contribute their own
+#: time against ONE wall clock (reset at frontend start), so with N
+#: replicas an idle cell reports idle ≈ N×wall and fractions can exceed
+#: 1 — read the split as "where thread-seconds went", not a partition.
+serving = GoodputAccountant(goodput_categories=("prefill", "decode"))
+serving_note = serving.note
+serving_report = serving.report
+

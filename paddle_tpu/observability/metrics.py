@@ -20,7 +20,7 @@ import re
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
-           "DEFAULT_BUCKETS"]
+           "DEFAULT_BUCKETS", "metric_key"]
 
 #: latency-oriented default bucket upper bounds, in seconds (an implicit
 #: +inf bucket is always appended): 0.5ms .. 60s covers a dispatch through a
@@ -33,11 +33,13 @@ class Counter:
     """Monotonic named counter. ``inc`` only; ``reset`` exists for tests and
     for the EventCounters compat shim's prefix reset."""
 
-    __slots__ = ("name", "help", "_lock", "_value")
+    __slots__ = ("name", "help", "family", "labels", "_lock", "_value")
 
-    def __init__(self, name, help=""):
+    def __init__(self, name, help="", family=None, labels=None):
         self.name = name
         self.help = help
+        self.family = family or name
+        self.labels = labels
         self._lock = threading.Lock()
         self._value = 0
 
@@ -59,11 +61,13 @@ class Gauge:
     last reset — queue depth / slot occupancy are only interesting at their
     peaks, and a scrape-time gauge alone misses transients."""
 
-    __slots__ = ("name", "help", "_lock", "_value", "_hwm")
+    __slots__ = ("name", "help", "family", "labels", "_lock", "_value", "_hwm")
 
-    def __init__(self, name, help=""):
+    def __init__(self, name, help="", family=None, labels=None):
         self.name = name
         self.help = help
+        self.family = family or name
+        self.labels = labels
         self._lock = threading.Lock()
         self._value = 0.0
         self._hwm = 0.0
@@ -106,11 +110,15 @@ class Histogram:
     plus two adds under the lock — no per-call allocation.
     """
 
-    __slots__ = ("name", "help", "bounds", "_lock", "_counts", "_sum", "_count")
+    __slots__ = ("name", "help", "family", "labels", "bounds", "_lock",
+                 "_counts", "_sum", "_count")
 
-    def __init__(self, name, buckets=DEFAULT_BUCKETS, help=""):
+    def __init__(self, name, buckets=DEFAULT_BUCKETS, help="", family=None,
+                 labels=None):
         self.name = name
         self.help = help
+        self.family = family or name
+        self.labels = labels
         self.bounds = tuple(sorted(float(b) for b in buckets))
         if not self.bounds:
             raise ValueError("histogram needs at least one bucket bound")
@@ -184,45 +192,93 @@ def _prom_name(name):
     return "_" + n if n[:1].isdigit() else n
 
 
+def _escape_label(v):
+    """Prometheus label-value escaping: backslash, double quote, newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(s):
+    return str(s).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _label_suffix(labels, extra=None):
+    """``{k="v",...}`` rendered suffix (labels sorted, values escaped);
+    empty string when there is nothing to render."""
+    pairs = []
+    if labels:
+        pairs.extend(sorted(labels.items()))
+    if extra:
+        pairs.extend(extra)
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
+def metric_key(name, labels=None):
+    """Registry key for a (family, labels) pair — the family name alone when
+    unlabeled, ``family{k="v",...}`` otherwise (sorted, escaped — two label
+    dicts that render the same ARE the same series)."""
+    if not labels:
+        return name
+    return name + _label_suffix(labels)
+
+
 class MetricsRegistry:
     """Process-wide name -> metric map. Metric creation is idempotent
     (``counter("x")`` twice returns the same object); re-registering a name
-    as a different type is a bug and raises."""
+    as a different type is a bug and raises. ``labels={...}`` registers one
+    series of a metric FAMILY (keyed ``name{k="v"}``): the Prometheus
+    rendering groups series under one ``# TYPE``/``# HELP`` header, which is
+    what real scrapers require (a per-label-value metric NAME breaks every
+    aggregation over the family)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics = {}
+        self._family_types = {}
 
-    def _get_or_create(self, name, cls, **kw):
-        m = self._metrics.get(name)
+    def _get_or_create(self, name, cls, labels=None, **kw):
+        key = metric_key(name, labels)
+        m = self._metrics.get(key)
         if m is not None:
             if not isinstance(m, cls):
                 raise ValueError(
-                    f"metric {name!r} already registered as "
+                    f"metric {key!r} already registered as "
                     f"{type(m).__name__}, not {cls.__name__}")
             return m
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is None:
-                m = self._metrics[name] = cls(name, **kw)
+                fcls = self._family_types.setdefault(name, cls)
+                if fcls is not cls:
+                    # a family mixing types renders an unparseable exposition
+                    raise ValueError(
+                        f"metric family {name!r} already registered as "
+                        f"{fcls.__name__}, not {cls.__name__}")
+                m = self._metrics[key] = cls(
+                    key, family=name,
+                    labels=dict(labels) if labels else None, **kw)
             elif not isinstance(m, cls):
                 raise ValueError(
-                    f"metric {name!r} already registered as "
+                    f"metric {key!r} already registered as "
                     f"{type(m).__name__}, not {cls.__name__}")
             return m
 
-    def counter(self, name, help=""):
-        return self._get_or_create(name, Counter, help=help)
+    def counter(self, name, help="", labels=None):
+        return self._get_or_create(name, Counter, help=help, labels=labels)
 
-    def gauge(self, name, help=""):
-        return self._get_or_create(name, Gauge, help=help)
+    def gauge(self, name, help="", labels=None):
+        return self._get_or_create(name, Gauge, help=help, labels=labels)
 
-    def histogram(self, name, buckets=DEFAULT_BUCKETS, help=""):
-        return self._get_or_create(name, Histogram, buckets=buckets, help=help)
+    def histogram(self, name, buckets=DEFAULT_BUCKETS, help="", labels=None):
+        return self._get_or_create(name, Histogram, buckets=buckets,
+                                   help=help, labels=labels)
 
-    def get(self, name):
+    def get(self, name, labels=None):
         """Existing metric or None — never creates."""
-        return self._metrics.get(name)
+        return self._metrics.get(metric_key(name, labels))
 
     def names(self, prefix=""):
         with self._lock:
@@ -271,28 +327,53 @@ class MetricsRegistry:
             f.flush()
 
     def to_prometheus(self):
-        """Prometheus text exposition format. Dots in metric names become
-        underscores; histograms render the standard _bucket/_sum/_count
-        triplet with cumulative le labels."""
+        """Prometheus text exposition format (the text a real scraper must
+        parse — asserted against a strict parser in tests): dots in metric
+        names become underscores, every family gets ``# HELP``/``# TYPE``
+        headers and contiguous samples, label values are escaped, and
+        histograms render the standard cumulative ``_bucket{le=...}`` series
+        (``+Inf`` included) plus ``_sum``/``_count``."""
         lines = []
         with self._lock:
             items = sorted(self._metrics.items())
-        for name, m in items:
-            pname = _prom_name(name)
-            if isinstance(m, Counter):
-                lines.append(f"# TYPE {pname} counter")
-                lines.append(f"{pname} {m.value}")
-            elif isinstance(m, Gauge):
-                lines.append(f"# TYPE {pname} gauge")
-                lines.append(f"{pname} {m.value}")
-                lines.append(f"{pname}_hwm {m.hwm}")
+        families = {}
+        for _, m in items:
+            families.setdefault(m.family, []).append(m)
+
+        def _header(pname, ms, kind):
+            help_text = next((m.help for m in ms if m.help), "")
+            if help_text:
+                lines.append(f"# HELP {pname} {_escape_help(help_text)}")
+            lines.append(f"# TYPE {pname} {kind}")
+
+        for family in sorted(families):
+            ms = families[family]
+            pname = _prom_name(family)
+            if isinstance(ms[0], Counter):
+                _header(pname, ms, "counter")
+                for m in ms:
+                    lines.append(f"{pname}{_label_suffix(m.labels)} {m.value}")
+            elif isinstance(ms[0], Gauge):
+                _header(pname, ms, "gauge")
+                for m in ms:
+                    lines.append(f"{pname}{_label_suffix(m.labels)} {m.value}")
+                # the high-water mark is its own gauge family (a second
+                # sample under the same name would be a duplicate series)
+                lines.append(f"# TYPE {pname}_hwm gauge")
+                for m in ms:
+                    lines.append(
+                        f"{pname}_hwm{_label_suffix(m.labels)} {m.hwm}")
             else:
-                lines.append(f"# TYPE {pname} histogram")
-                for bound, cum in m.cumulative():
-                    le = "+Inf" if bound == float("inf") else repr(bound)
-                    lines.append(f'{pname}_bucket{{le="{le}"}} {cum}')
-                lines.append(f"{pname}_sum {m.sum}")
-                lines.append(f"{pname}_count {m.count}")
+                _header(pname, ms, "histogram")
+                for m in ms:
+                    for bound, cum in m.cumulative():
+                        le = "+Inf" if bound == float("inf") else repr(bound)
+                        suffix = _label_suffix(m.labels, extra=[("le", le)])
+                        lines.append(f"{pname}_bucket{suffix} {cum}")
+                    lines.append(
+                        f"{pname}_sum{_label_suffix(m.labels)} {m.sum}")
+                    lines.append(
+                        f"{pname}_count{_label_suffix(m.labels)} {m.count}")
         return "\n".join(lines) + ("\n" if lines else "")
 
     def reset(self, prefix=""):

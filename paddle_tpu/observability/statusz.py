@@ -1,0 +1,214 @@
+"""Live introspection HTTP endpoint (ISSUE 7): /statusz, /varz, /tracez,
+/healthz — zero dependencies (stdlib ``http.server``), served from the
+serving frontend (``ServingFrontend(statusz_port=...)``) and the launcher
+(``--statusz_port``), and startable standalone around any process that
+publishes into the telemetry registry.
+
+Routes (GET only):
+
+- ``/statusz``  — JSON overview: process facts, uptime, telemetry state,
+  training + serving goodput splits, the serving control-plane report
+  (replica health, per-SLO-class latency, SLO burn rates) when a frontend
+  is attached.
+- ``/varz``     — the metrics registry in Prometheus text exposition format
+  (``text/plain; version=0.0.4``) — point a real scraper at it.
+- ``/tracez``   — recent request traces: the slowest N and the errored N
+  (full span records — the live sibling of ``scripts/trace_view.py``).
+- ``/healthz``  — liveness: 200 with per-replica / per-rank heartbeat ages,
+  503 when nothing can serve (no LIVE replica) or every heartbeat is stale.
+
+The server binds 127.0.0.1 by default (introspection is an operator
+surface, not a public one) and ``port=0`` picks a free port (tests). All
+payload builders are plain methods, unit-testable without sockets.
+"""
+import json
+import os
+import threading
+import time
+
+from . import goodput, request_trace, tracing
+from .metrics import registry as _registry
+
+__all__ = ["StatusServer"]
+
+
+class StatusServer:
+    """One daemon HTTP server exposing the process's telemetry.
+
+    ``frontend`` (optional) is a ServingFrontend — /statusz gains its
+    ``serving_report()`` and /healthz its replica states. ``telemetry_dir``
+    (optional, defaults to ``PADDLE_TELEMETRY_DIR``) lets /healthz reuse
+    the PR-2 heartbeat files the watchdog reads."""
+
+    def __init__(self, port=0, host="127.0.0.1", frontend=None,
+                 telemetry_dir=None, heartbeat_stale_s=60.0,
+                 tracez_n=10):
+        self.host = host
+        self.port = int(port)
+        self.frontend = frontend
+        self.telemetry_dir = (telemetry_dir
+                              or os.environ.get("PADDLE_TELEMETRY_DIR"))
+        self.heartbeat_stale_s = float(heartbeat_stale_s)
+        self.tracez_n = int(tracez_n)
+        self._t0 = time.time()
+        self._httpd = None
+        self._thread = None
+
+    # ---- payload builders (plain methods: no sockets needed to test) ------
+    def statusz(self):
+        out = {
+            "pid": os.getpid(),
+            "time": time.time(),
+            "uptime_s": round(time.time() - self._t0, 3),
+            "telemetry_enabled": tracing.enabled(),
+            "telemetry_dir": self.telemetry_dir,
+            "goodput": goodput.report(),
+            "serving_goodput": goodput.serving.report(),
+            "traces": {
+                "started": getattr(_registry.get("rtrace.traces"),
+                                   "value", 0),
+                "open": getattr(_registry.get("rtrace.open"), "value", 0),
+                "dropped_spans": getattr(
+                    _registry.get("rtrace.dropped_spans"), "value", 0),
+                "recent": len(request_trace.recent()),
+            },
+            "metrics": len(_registry.names()),
+        }
+        fe = self.frontend
+        if fe is not None:
+            try:
+                out["serving"] = fe.serving_report()
+            except Exception as e:  # a shut-down frontend must not 500
+                out["serving"] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def varz(self):
+        return _registry.to_prometheus()
+
+    def tracez(self):
+        return {
+            "recent": len(request_trace.recent()),
+            "dropped_spans": getattr(
+                _registry.get("rtrace.dropped_spans"), "value", 0),
+            "slowest": request_trace.slowest(self.tracez_n),
+            "errored": request_trace.errored(self.tracez_n),
+        }
+
+    def _heartbeats(self):
+        """{rank: age_s} from the PR-2 heartbeat files, when a telemetry
+        dir is configured."""
+        d = self.telemetry_dir
+        if not d:
+            return {}
+        from .watchdog import _HB_RE
+
+        out = {}
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return out
+        now = time.time()
+        for name in names:
+            m = _HB_RE.match(name)
+            if not m:
+                continue
+            try:
+                with open(os.path.join(d, name)) as f:
+                    hb = json.load(f)
+                out[m.group(1)] = round(now - hb.get("time", 0), 3)
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def healthz(self):
+        """(http_status, payload). ``status`` is the worst verdict across
+        both signals — replica states and heartbeat ages — and the HTTP
+        code follows it (503 iff ``unhealthy``), so a probe keying on
+        either agrees with one keying on the other."""
+        payload = {"uptime_s": round(time.time() - self._t0, 3)}
+        status = "ok"
+        fe = self.frontend
+        if fe is not None:
+            states = {r.name: r.state for r in fe.replicas}
+            payload["replicas"] = states
+            if any(s == "DEAD" for s in states.values()):
+                status = "degraded"
+            if not any(s == "LIVE" for s in states.values()):
+                status = "unhealthy"
+        hbs = self._heartbeats()
+        if hbs:
+            payload["heartbeat_age_s"] = hbs
+            stale = {r: a for r, a in hbs.items()
+                     if a > self.heartbeat_stale_s}
+            if stale:
+                payload["stale_ranks"] = sorted(stale)
+                if len(stale) == len(hbs):
+                    status = "unhealthy"
+                elif status == "ok":
+                    status = "degraded"
+        payload["status"] = status
+        return (503 if status == "unhealthy" else 200), payload
+
+    # ---- HTTP ------------------------------------------------------------
+    def start(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # no stderr spam from scrapers
+                pass
+
+            def _send(self, code, body, ctype):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/") or "/statusz"
+                try:
+                    if path == "/varz":
+                        self._send(200, server.varz(),
+                                   "text/plain; version=0.0.4")
+                    elif path == "/statusz":
+                        self._send(200, json.dumps(server.statusz(),
+                                                   indent=1, default=str),
+                                   "application/json")
+                    elif path == "/tracez":
+                        self._send(200, json.dumps(server.tracez(),
+                                                   indent=1, default=str),
+                                   "application/json")
+                    elif path == "/healthz":
+                        code, payload = server.healthz()
+                        self._send(code, json.dumps(payload, indent=1),
+                                   "application/json")
+                    else:
+                        self._send(404, json.dumps(
+                            {"error": "not found", "routes": [
+                                "/statusz", "/varz", "/tracez", "/healthz"]}),
+                            "application/json")
+                except Exception as e:  # introspection must never crash
+                    self._send(500, json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}),
+                        "application/json")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="paddle-statusz")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

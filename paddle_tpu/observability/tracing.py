@@ -13,6 +13,10 @@ stack). Completed spans fan out to:
   (``span.<name>_s``) — the per-phase step breakdown falls out of the same
   data.
 
+Request-scoped trace records (observability/request_trace.py) ride the
+same ring and sinks via :func:`emit_record`, so one ``spans.<rank>.jsonl``
+file carries both streams and scripts/trace_view.py can join them.
+
 Cost contract (asserted in tests/test_telemetry.py like chaos.site's):
 **disabled, an attr-less span is one module-global load + a None/False
 check** returning a shared no-op context manager — no allocation, no clock
@@ -34,7 +38,7 @@ import threading
 import time
 
 __all__ = ["span", "enable", "disable", "enabled", "last_spans",
-           "add_jsonl_sink", "clear_sinks", "JsonlSpanSink"]
+           "add_jsonl_sink", "clear_sinks", "JsonlSpanSink", "emit_record"]
 
 _ENABLED = None           # tri-state: None = resolve from env on first use
 _RING_DEFAULT = 512
@@ -258,6 +262,32 @@ def _emit(rec, dur_us):
         registry.histogram(f"span.{rec['name']}_s").observe(dur_us / 1e6)
     except ValueError:
         pass  # name collision with a non-histogram metric: skip, don't kill
+    for sink in _sinks:
+        try:
+            sink(rec)
+        except Exception:
+            pass
+
+
+def emit_record(rec, profiler_name=None, profiler_ts_us=None,
+                profiler_dur_us=None):
+    """Route an externally-built record through the same fan-out completed
+    spans get — the watchdog's ring buffer, every JSONL sink, and (when the
+    optional profiler args are given and a Profiler is recording) the
+    chrome-trace host-event buffer. This is how request-scoped trace
+    records (observability/request_trace.py) land in the SAME
+    ``spans.<rank>.jsonl`` files as thread spans, so scripts/trace_view.py
+    and the hang watchdog see one stream. The span-duration histograms are
+    NOT fed — those are keyed by the thread-span taxonomy."""
+    _ring.append(rec)
+    if profiler_name is not None:
+        prof = sys.modules.get("paddle_tpu.profiler")
+        if prof is not None:
+            try:
+                prof._record_host_event(profiler_name, profiler_ts_us,
+                                        profiler_dur_us)
+            except Exception:
+                pass
     for sink in _sinks:
         try:
             sink(rec)

@@ -49,7 +49,11 @@ from ..inference.continuous import (
     EngineRequest,
     canonical_sampling,
 )
+from ..observability import goodput as _goodput
+from ..observability import request_trace as _rtrace
+from ..observability import tracing as _tracing
 from ..observability.metrics import registry as _registry
+from ..observability.slo import SLOMonitor
 from ..testing import chaos
 from .router import DEAD, DRAINING, LIVE, NoLiveReplicas, ReplicaHandle, Router
 from .scheduler import DeadlineExceeded, Overloaded, SLOScheduler
@@ -90,7 +94,8 @@ class _Entry:
     """Routing-layer wrapper: one EngineRequest + its handle + SLO facts."""
 
     __slots__ = ("req", "handle", "slo", "deadline_t", "virtual_deadline",
-                 "observed", "route_affinity")
+                 "observed", "route_affinity", "route_score", "trace",
+                 "attempt_span", "queue_span", "attempt_n")
 
     def __init__(self, req, handle, slo, deadline_t, virtual_deadline):
         self.req = req
@@ -100,6 +105,14 @@ class _Entry:
         self.virtual_deadline = virtual_deadline
         self.observed = False   # queue_wait/ttft recorded (once per request)
         self.route_affinity = False  # last place(): won by affinity/hint?
+        self.route_score = 0.0       # last place(): winning blended score
+        # request-scoped tracing (ISSUE 7): the trace context plus the open
+        # per-attempt spans — an attempt is one placement; a reroute closes
+        # it and opens the next, so the trace tree shows the failover
+        self.trace = None
+        self.attempt_span = None
+        self.queue_span = None
+        self.attempt_n = 0
 
 
 class RequestHandle:
@@ -114,6 +127,7 @@ class RequestHandle:
         self.slo_class = slo.name
         self.replica = None          # name of the replica serving it
         self.timed_out = False
+        self._trace = None           # TraceContext (None = telemetry off)
         self._cond = threading.Condition()
         self._status = QUEUED
         self._result = None
@@ -250,6 +264,8 @@ class RequestHandle:
             self._status = DONE
             self._cond.notify_all()
         self._stream_q.put(("end", None))
+        self._trace_finish("ok", n_generated=req.n_generated,
+                           timed_out=req.timed_out)
 
     def _fail(self, reason):
         with self._cond:
@@ -259,6 +275,7 @@ class RequestHandle:
             self._status = FAILED
             self._cond.notify_all()
         self._stream_q.put(("err", str(reason)))
+        self._trace_finish("error", error=str(reason))
 
     def _cancelled_now(self):
         with self._cond:
@@ -267,6 +284,16 @@ class RequestHandle:
             self._status = CANCELLED
             self._cond.notify_all()
         self._stream_q.put(("end", None))
+        self._trace_finish("cancelled")
+
+    def _trace_finish(self, status, **attrs):
+        """Terminal trace transition, tied to the handle's own once-only
+        terminal transition (whichever failure/completion path won): the
+        trace finishes exactly once, and finish() sweeps any spans a dead
+        replica's paths left open — structurally no orphan spans."""
+        tr, self._trace = self._trace, None
+        if tr is not None:
+            tr.finish(status, **attrs)
 
 
 class ServingFrontend:
@@ -277,7 +304,8 @@ class ServingFrontend:
 
     def __init__(self, engines, scheduler=None, router=None,
                  poll_wait_s=0.005, heartbeat_deadline_s=30.0,
-                 monitor_interval_s=None, start=True, warmup=None):
+                 monitor_interval_s=None, start=True, warmup=None,
+                 slo_monitor=None, statusz_port=None):
         # heartbeat_deadline_s must outlast the longest single engine call —
         # a first-compile prefill through a remote-compile tunnel can take
         # tens of seconds (PROFILE.md), and a false DEAD verdict reroutes a
@@ -313,6 +341,16 @@ class ServingFrontend:
         # so first requests don't eat the compile spikes. e.g.
         # warmup=dict(buckets=[64, 256, 1024], sampling=[(False,1,0,1)])
         self._warmup_kw = dict(warmup) if warmup else None
+        # SLO burn-rate accounting (ISSUE 7): objectives default from the
+        # scheduler's class declarations (ttft_slo_s/tpot_slo_s per class +
+        # a deadline-miss objective); fed by the same observation points
+        # as the per-class histograms, read via serving_report()//statusz
+        self.slo = slo_monitor or SLOMonitor(
+            classes=self.scheduler.classes.values())
+        # live introspection (ISSUE 7): statusz_port=0 picks a free port
+        self.statusz = None
+        if statusz_port is not None:
+            self.statusz = self.serve_statusz(statusz_port)
         if start:
             self.start()
 
@@ -321,6 +359,10 @@ class ServingFrontend:
         if self._started:
             return self
         self._started = True
+        # scope the (process-global) serving goodput split to this
+        # frontend's lifetime: without the reset, an hour of training
+        # before serving dilutes every serving fraction toward zero
+        _goodput.serving.reset()
         for rep in self.replicas:
             t = threading.Thread(target=self._run_replica, args=(rep,),
                                  daemon=True,
@@ -333,9 +375,20 @@ class ServingFrontend:
         m.start()
         return self
 
+    def serve_statusz(self, port=0, host="127.0.0.1"):
+        """Start (and return) a /statusz introspection server bound to this
+        frontend — /statusz, /varz, /tracez, /healthz (observability/
+        statusz.py). Stopped by shutdown()."""
+        from ..observability.statusz import StatusServer
+
+        return StatusServer(port=port, host=host, frontend=self).start()
+
     def shutdown(self, timeout=5.0):
         """Stop dispatchers and the monitor. In-flight work stops at the
         next block boundary; unfinished handles are failed (never lost)."""
+        if self.statusz is not None:
+            self.statusz.stop()
+            self.statusz = None
         self._stop.set()
         for ev in self._wakes.values():
             ev.set()
@@ -393,37 +446,59 @@ class ServingFrontend:
         except Overloaded:
             _M_SHED.inc()
             raise
+        # request-scoped trace (ISSUE 7): minted AFTER the advisory shed —
+        # a shed storm must not mint contexts — and finished by the
+        # handle's terminal transition, whichever path that is. None when
+        # telemetry is off (the zero-overhead contract).
+        handle._trace = entry.trace = _rtrace.start(
+            rid, slo=slo.name, prompt_len=len(req.prompt),
+            max_new_tokens=req.max_new_tokens,
+            deadline_s=float(deadline_s) if deadline_s is not None else None)
         exclude = set()
-        while True:
-            # placement runs OUTSIDE the frontend lock: the prefix-affinity
-            # probe hashes O(prompt bytes) per replica (the engine's
-            # chained-digest index), and doing even that under the one lock
-            # every dispatcher's admission pick needs would stall all
-            # replicas behind each long-prompt submit.
-            # Everything place() reads is advisory; the append below
-            # re-checks the decisions that matter under the lock.
-            rep = self.router.place(entry, self.replicas, exclude=exclude)
-            with self._lock:
-                # checked under the SAME lock shutdown's orphan sweep
-                # holds: an unlocked check could pass, the sweep run, and
-                # the append below then queue an entry no dispatcher will
-                # ever see — a handle that never reaches a terminal state
-                if self._stop.is_set():
-                    raise RuntimeError("frontend is shut down")
-                queued = sum(len(r.pending) for r in self.replicas)
-                try:
-                    # under the append lock so depth can't race past the
-                    # bound (the scheduler's check+enqueue contract)
-                    self.scheduler.check_admission(queued, slo)
-                except Overloaded:
-                    _M_SHED.inc()
-                    raise
-                if rep.state == LIVE:  # can change between place() and here
-                    rep.pending.append(entry)
-                    _M_SUBMITTED.inc()
-                    _M_QUEUE.set(queued + 1)
-                    break
-            exclude.add(rep.name)
+        try:
+            while True:
+                # placement runs OUTSIDE the frontend lock: the
+                # prefix-affinity probe hashes O(prompt bytes) per replica
+                # (the engine's chained-digest index), and doing even that
+                # under the one lock every dispatcher's admission pick needs
+                # would stall all replicas behind each long-prompt submit.
+                # Everything place() reads is advisory; the append below
+                # re-checks the decisions that matter under the lock.
+                rep = self.router.place(entry, self.replicas, exclude=exclude)
+                # spans open BEFORE the entry becomes dispatcher-visible: a
+                # dispatcher that pops it the instant the append lands must
+                # find the queue span already open
+                self._trace_commit(entry, rep)
+                with self._lock:
+                    # checked under the SAME lock shutdown's orphan sweep
+                    # holds: an unlocked check could pass, the sweep run, and
+                    # the append below then queue an entry no dispatcher will
+                    # ever see — a handle that never reaches a terminal state
+                    if self._stop.is_set():
+                        raise RuntimeError("frontend is shut down")
+                    queued = sum(len(r.pending) for r in self.replicas)
+                    try:
+                        # under the append lock so depth can't race past the
+                        # bound (the scheduler's check+enqueue contract)
+                        self.scheduler.check_admission(queued, slo)
+                    except Overloaded:
+                        _M_SHED.inc()
+                        raise
+                    if rep.state == LIVE:  # can change between place() & here
+                        rep.pending.append(entry)
+                        _M_SUBMITTED.inc()
+                        _M_QUEUE.set(queued + 1)
+                        break
+                self._trace_attempt_end(entry, "rerouted",
+                                        reason=f"{rep.name} not LIVE")
+                exclude.add(rep.name)
+        except BaseException as e:
+            if entry.trace is not None:
+                handle._trace = None
+                entry.trace.finish(
+                    "shed" if isinstance(e, Overloaded) else "error",
+                    error=f"{type(e).__name__}: {e}")
+            raise
         self.router.committed(entry, rep)
         self._wakes[rep.name].set()
         return handle
@@ -548,7 +623,14 @@ class ServingFrontend:
                 # BEFORE setting the wake event, so a stale empty read still
                 # wakes immediately off the event
                 idle = eng.idle() and not rep.pending
-                wake.wait(self.idle_wait_s if idle else self.poll_wait_s)
+                if _tracing.enabled():
+                    # serving goodput (ISSUE 7 satellite): dispatcher waits
+                    # are the 'idle' slice of the serving wall-clock split
+                    t_w = time.monotonic()
+                    wake.wait(self.idle_wait_s if idle else self.poll_wait_s)
+                    _goodput.serving_note("idle", time.monotonic() - t_w)
+                else:
+                    wake.wait(self.idle_wait_s if idle else self.poll_wait_s)
                 wake.clear()
 
     def _admit_pending(self, rep):
@@ -568,6 +650,7 @@ class ServingFrontend:
             if self.scheduler.expired(entry):
                 _M_EXPIRED.inc()
                 _M_FAILED.inc()
+                self.slo.observe_event(entry.slo.name, "deadline_miss", True)
                 entry.handle._fail(DeadlineExceeded(
                     f"request {entry.req.rid} ({entry.slo.name}) spent "
                     f"longer than its deadline queued"))
@@ -596,6 +679,12 @@ class ServingFrontend:
                                               f"during admission: "
                                               f"{rep.death_reason}")
                 raise
+            if status != "deferred" and entry.queue_span is not None:
+                # queueing ends the moment the engine resolved the
+                # admission (a deferred pick keeps waiting — span stays
+                # open); the engine's own admit/prefill spans carry on
+                entry.queue_span.end()
+                entry.queue_span = None
             if status == "deferred":
                 with self._lock:
                     stranded = rep.state != LIVE
@@ -660,6 +749,7 @@ class ServingFrontend:
         else:
             _M_COMPLETED.inc()
             self._observe_completion(entry)
+            self.slo.observe_event(entry.slo.name, "deadline_miss", False)
             handle._complete(req)
 
     # ---- replica death / drain -------------------------------------------
@@ -763,6 +853,10 @@ class ServingFrontend:
         # for the rest of the request's run
         entry.handle._mark_queued()
         exclude = set(exclude)
+        # the trace's reroute edge: the attempt on the excluded replica is
+        # over (death, drain, strand) — close it and stamp the edge before
+        # the replacement attempt opens
+        self._trace_reroute(entry, next(iter(exclude), None), fail_reason)
         while True:
             try:
                 target = self.router.place(entry, self.replicas,
@@ -771,6 +865,7 @@ class ServingFrontend:
                 _M_FAILED.inc()
                 entry.handle._fail(f"{fail_reason}; re-route failed: {e}")
                 return
+            self._trace_commit(entry, target)
             with self._lock:
                 # re-check under the lock: the target can die or start
                 # draining between place() and here, and an entry appended
@@ -789,6 +884,8 @@ class ServingFrontend:
                 _M_FAILED.inc()
                 entry.handle._fail("frontend shut down")
                 return
+            self._trace_attempt_end(entry, "rerouted",
+                                    reason=f"{target.name} not LIVE")
             exclude.add(target.name)
         self.router.committed(entry, target)
         if rerouted:
@@ -840,14 +937,64 @@ class ServingFrontend:
             f"dispatcher heartbeat stale {now - rep.last_beat:.1f}s "
             f"(> {self.heartbeat_deadline_s}s)"))
 
+    # ---- request-scoped tracing (ISSUE 7) ---------------------------------
+    def _trace_commit(self, entry, rep):
+        """One placement landed (or is about to): open the attempt subtree
+        — attempt span, place event (replica/score/affinity), queue span —
+        and hand the attempt span to the EngineRequest so the engine's
+        admit/prefill/decode spans nest under it."""
+        tr = entry.trace
+        if tr is None:
+            return
+        n = entry.attempt_n
+        entry.attempt_n = n + 1
+        entry.attempt_span = tr.root.child("attempt", n=n, replica=rep.name)
+        entry.attempt_span.event(
+            "place", replica=rep.name, affinity=entry.route_affinity,
+            score=round(entry.route_score, 4))
+        entry.queue_span = entry.attempt_span.child(
+            "queue",
+            slo=entry.slo.name,
+            virtual_deadline_in_s=round(
+                entry.virtual_deadline - entry.req.t_enqueue, 4))
+        entry.req.trace = entry.attempt_span
+
+    def _trace_attempt_end(self, entry, status, reason=None):
+        """Close the open attempt subtree (reroute, drain, lost placement
+        race). Idempotent; the handle's terminal finish() sweeps anything
+        this missed."""
+        if entry.trace is None or entry.attempt_span is None:
+            return
+        if entry.queue_span is not None:
+            entry.queue_span.end(status)
+            entry.queue_span = None
+        entry.attempt_span.end(
+            status, **({"reason": str(reason)} if reason else {}))
+        entry.attempt_span = None
+
+    def _trace_reroute(self, entry, from_replica, reason):
+        """The reroute edge: close the failed attempt, stamp the edge on
+        the root — trace_view renders failed attempt -> reroute -> replay
+        as one tree."""
+        if entry.trace is None:
+            return
+        self._trace_attempt_end(entry, "failed", reason=reason)
+        entry.trace.root.event("reroute", from_replica=from_replica,
+                               reason=str(reason))
+
     # ---- telemetry --------------------------------------------------------
-    def _class_hist(self, kind, slo_name):
-        key = (kind, slo_name)
+    def _class_hist(self, family, slo_name):
+        # short kind key for serving_report's per-class section
+        key = (family[len("serving."):], slo_name)
         with self._lock:  # dispatchers insert, serving_report() iterates
             h = self._class_hists.get(key)
             if h is None:
+                # labeled series (ISSUE 7 satellite): one family per kind,
+                # {slo_class=...} per class — scrapers aggregate across
+                # classes, which per-class metric NAMES made impossible
                 h = self._class_hists[key] = _registry.histogram(
-                    f"serving.{kind}.{slo_name}")
+                    family, labels={"slo_class": slo_name},
+                    help="per-SLO-class control-plane latency")
             return h
 
     def _observe_admission(self, entry):
@@ -859,16 +1006,18 @@ class ServingFrontend:
             # the dispatcher re-checks after every step()
         entry.observed = True
         req, name = entry.req, entry.slo.name
-        self._class_hist("queue_wait_s", name).observe(
+        self._class_hist("serving.queue_wait_s", name).observe(
             req.t_admit - req.t_enqueue)
-        self._class_hist("ttft_s", name).observe(
-            req.t_first_token - req.t_enqueue)
+        ttft = req.t_first_token - req.t_enqueue
+        self._class_hist("serving.ttft_s", name).observe(ttft)
+        self.slo.observe(name, "ttft", ttft)
 
     def _observe_completion(self, entry):
         req = entry.req
         if req.n_generated > 1 and req.t_first_token is not None:
-            self._class_hist("tpot_s", entry.slo.name).observe(
-                (req.t_done - req.t_first_token) / (req.n_generated - 1))
+            tpot = (req.t_done - req.t_first_token) / (req.n_generated - 1)
+            self._class_hist("serving.tpot_s", entry.slo.name).observe(tpot)
+            self.slo.observe(entry.slo.name, "tpot", tpot)
 
     def serving_report(self):
         """One structured snapshot of the whole control plane: per-replica
@@ -893,4 +1042,10 @@ class ServingFrontend:
             "slo_classes": classes,
             "counters": {k: v for k, v in counters.items() if v},
             "queue_depth": sum(len(r.pending) for r in self.replicas),
+            # SLO burn rates + multi-window alerts (ISSUE 7)
+            "slo": self.slo.report(),
+            # serving goodput split (ISSUE 7 satellite): engine wall clock
+            # classified {prefill, decode, host_emit, idle, compile};
+            # populated when telemetry is enabled (the goodput gate)
+            "goodput": _goodput.serving.report(),
         }

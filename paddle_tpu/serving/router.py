@@ -89,12 +89,18 @@ class ReplicaHandle:
                                                install_faulthandler=False)
             except OSError:
                 self._wd_heartbeat = None
+        # labeled series of one family each (ISSUE 7 satellite: a real
+        # scraper aggregates over {replica=...}, which per-replica metric
+        # NAMES made impossible)
         self._occ_gauge = _registry.gauge(
-            f"serving.replica.{self.name}.occupancy")
+            "serving.replica.occupancy", labels={"replica": self.name},
+            help="per-replica active slots / max_seqs")
         self._queue_gauge = _registry.gauge(
-            f"serving.replica.{self.name}.queue_depth")
+            "serving.replica.queue_depth", labels={"replica": self.name},
+            help="per-replica routed-but-not-admitted requests")
         self._pages_gauge = _registry.gauge(
-            f"serving.replica.{self.name}.pages_in_use")
+            "serving.replica.pages_in_use", labels={"replica": self.name},
+            help="per-replica KV pool pages referenced")
 
     def beat(self, step=None):
         now = time.monotonic()
@@ -205,6 +211,7 @@ class Router:
                 pick = live[self._rr % len(live)]
                 self._rr += 1
                 entry.route_affinity = False
+                entry.route_score = 0.0
                 return pick
             prompt = entry.req.prompt
             hinted = self._hints.get(self._hint_key(prompt))
@@ -220,6 +227,10 @@ class Router:
             if best_score is None or score > best_score:
                 best, best_score, best_aff = r, score, aff
         entry.route_affinity = best_aff > 0.0 or hinted == best.name
+        # trace attribution (ISSUE 7): the request's trace records WHY it
+        # landed where it did — the winning blended score and whether
+        # affinity (index hit or session hint) carried the decision
+        entry.route_score = best_score
         return best
 
     def committed(self, entry, rep):

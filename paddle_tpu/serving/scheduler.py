@@ -52,20 +52,34 @@ class SLOClass:
     in the EDF order. ``target_wait_s`` is the promise — interactive
     requests should start within ~this; batch requests tolerate this much
     delay but are guaranteed to start once it elapses (their virtual
-    deadline becomes the earliest in the queue)."""
+    deadline becomes the earliest in the queue).
 
-    __slots__ = ("name", "target_wait_s")
+    The optional SLO-objective fields (ISSUE 7) declare what the class
+    PROMISES externally — "``slo_objective`` of requests see TTFT within
+    ``ttft_slo_s`` / per-token latency within ``tpot_slo_s``" — and seed
+    the frontend's burn-rate monitor (observability/slo.py). None disables
+    that objective for the class; the deadline-miss objective always
+    exists."""
 
-    def __init__(self, name, target_wait_s):
+    __slots__ = ("name", "target_wait_s", "ttft_slo_s", "tpot_slo_s",
+                 "slo_objective")
+
+    def __init__(self, name, target_wait_s, ttft_slo_s=None, tpot_slo_s=None,
+                 slo_objective=0.99):
         self.name = str(name)
         self.target_wait_s = float(target_wait_s)
+        self.ttft_slo_s = float(ttft_slo_s) if ttft_slo_s else None
+        self.tpot_slo_s = float(tpot_slo_s) if tpot_slo_s else None
+        self.slo_objective = float(slo_objective)
 
     def __repr__(self):
         return f"SLOClass({self.name!r}, target_wait_s={self.target_wait_s})"
 
 
-INTERACTIVE = SLOClass("interactive", target_wait_s=0.05)
-BATCH = SLOClass("batch", target_wait_s=2.0)
+INTERACTIVE = SLOClass("interactive", target_wait_s=0.05,
+                       ttft_slo_s=1.0, tpot_slo_s=0.25)
+BATCH = SLOClass("batch", target_wait_s=2.0,
+                 ttft_slo_s=30.0, tpot_slo_s=1.0, slo_objective=0.95)
 
 
 class SLOScheduler:

@@ -97,6 +97,81 @@ if bad:
     sys.exit(1)
 PY
 
+# metric/span doc drift lint (ISSUE 7 satellite): every metric/span name
+# LITERAL registered in paddle_tpu/ must appear in a docs/OBSERVABILITY.md
+# table first cell, and every non-wildcard documented name must still be
+# registered — dashboards and scrapers can trust the doc tables. Dynamic
+# names (f-strings) are documented with <...> placeholders, which match as
+# wildcards forward and are exempt from the reverse check.
+python - <<'PY'
+import ast, os, re, sys
+
+REG_ATTRS = {"counter", "gauge", "histogram", "bump",   # metrics registry
+             "span",                                     # thread spans
+             "child", "event", "begin", "span_at",       # request-trace
+             "_class_hist"}                              # frontend families
+registered = {}
+for root, dirs, files in os.walk("paddle_tpu"):
+    for fn in files:
+        if not fn.endswith(".py"):
+            continue
+        path = os.path.join(root, fn)
+        try:
+            tree = ast.parse(open(path).read())
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            a0 = node.args[0]
+            if not (isinstance(a0, ast.Constant) and isinstance(a0.value, str)):
+                continue
+            f = node.func
+            attr = (f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else None)
+            if attr in REG_ATTRS:
+                registered.setdefault(a0.value, set()).add(path)
+
+NAME = re.compile(r"[a-z][a-z0-9_.<>*]*\Z")
+doc_names, doc_patterns = set(), []
+for line in open("docs/OBSERVABILITY.md"):
+    if not line.startswith("|"):
+        continue
+    first = line.split("|")[1]
+    for tok in re.findall(r"`([^`]+)`", first):
+        if not NAME.match(tok):
+            continue
+        if "<" in tok or "*" in tok:
+            part = re.sub(r"<[^>]+>", "WILDCARDMARK", tok)
+            pat = (re.escape(part)
+                   .replace("WILDCARDMARK", "[A-Za-z0-9_.]+")
+                   .replace(re.escape("*"), "[A-Za-z0-9_.]+"))
+            doc_patterns.append(re.compile(pat + r"\Z"))
+        else:
+            doc_names.add(tok)
+
+undocumented = sorted(
+    n for n in registered
+    if n not in doc_names and not any(p.match(n) for p in doc_patterns))
+stale = sorted(n for n in doc_names if n not in registered)
+ok = True
+if undocumented:
+    ok = False
+    for n in undocumented:
+        print(f"undocumented name {n!r} (registered in "
+              f"{sorted(registered[n])[0]}) — add it to a "
+              f"docs/OBSERVABILITY.md table")
+if stale:
+    ok = False
+    for n in stale:
+        print(f"documented name {n!r} is not registered anywhere in "
+              f"paddle_tpu/ — remove the row or fix the name")
+if not ok:
+    print("lint: docs/OBSERVABILITY.md metric/span tables drifted from "
+          "the registered names", file=sys.stderr)
+    sys.exit(1)
+PY
+
 # checkpoint atomic-commit lint (ISSUE 3 satellite): every byte written into
 # a checkpoint directory must flow through checkpoint/atomic.py (temp+fsync+
 # rename) — a raw write-mode open() anywhere else in the checkpoint package
@@ -129,6 +204,7 @@ FAST_TESTS=(
   tests/test_inference.py
   tests/test_serving_frontend.py
   tests/test_serving_perf.py
+  tests/test_request_trace.py
 )
 
 if [[ "${1:-}" == "--fast" ]]; then

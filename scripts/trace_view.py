@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Offline request-trace reconstructor (ISSUE 7): merge per-rank /
+per-replica telemetry JSONL files into one timeline per request.
+
+The serving stack streams request-scoped span records (see
+paddle_tpu/observability/request_trace.py) into the same JSONL sinks PR-2
+spans use — ``<PADDLE_TELEMETRY_DIR>/spans.<rank>.jsonl`` per process, or
+any sink a test attached. One request's records can span several files
+(submit process, dispatcher replicas, a reroute's second replica); the
+join key is the ``trace`` field. This tool groups records by trace id,
+rebuilds each tree from the ``span``/``parent`` ids, and renders it as an
+indented timeline (offsets relative to the root's start, wall-clock
+aligned across processes):
+
+    $ python scripts/trace_view.py log/telemetry/
+    trace 34c1fb32 rid=5 status=ok dur=0.412s spans=11
+      request                              +0.000s 0.412s ok
+        attempt {n=0, replica=replica0}    +0.000s 0.103s failed
+          place {replica=replica0, ...}    +0.000s
+          queue                            +0.000s 0.004s ok
+          admit                            +0.005s 0.021s ok
+            prefill {bucket=32}            +0.006s 0.020s ok
+          ...
+        reroute {from_replica=replica0}    +0.103s
+        attempt {n=1, replica=replica1}    +0.104s 0.308s ok
+          ...
+
+Exit status: 0, or 2 under ``--check`` when any trace is malformed
+(orphan spans, zero/multiple roots, duplicate span ids) — the structural
+contract the chaos reroute test asserts.
+
+Usage:
+    python scripts/trace_view.py PATH [PATH ...]
+        PATH: a .jsonl file, or a directory scanned for *.jsonl
+    --trace ID      only this trace id (prefixes accepted)
+    --rid N         only traces of this request id
+    --slowest N     only the N slowest traces (default: all, by start time)
+    --json          machine output: one JSON object per trace
+    --check         exit 2 if any selected trace is malformed
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def iter_records(paths):
+    """Yield every request-trace record found in the given files/dirs."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "**", "*.jsonl"),
+                                          recursive=True)))
+        else:
+            files.append(p)
+    for f in files:
+        try:
+            fh = open(f, errors="replace")
+        except OSError as e:
+            print(f"trace_view: skipping {f}: {e}", file=sys.stderr)
+            continue
+        with fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail of a crashed writer
+                if isinstance(rec, dict) and "trace" in rec \
+                        and "span" in rec:
+                    yield rec
+
+
+def load_traces(paths):
+    """{trace_id: [records]} — merged across every input file. Only
+    EXACT duplicate records (the same record landing in two sinks) are
+    collapsed; two DIFFERENT records sharing a span id survive, so
+    build_tree's duplicate-id check can actually flag them."""
+    traces = {}
+    seen = set()
+    for rec in iter_records(paths):
+        key = json.dumps(rec, sort_keys=True, default=str)
+        if key in seen:
+            continue
+        seen.add(key)
+        traces.setdefault(rec["trace"], []).append(rec)
+    return {tid: sorted(recs, key=lambda r: (r["t0"], r["span"]))
+            for tid, recs in traces.items()}
+
+
+def build_tree(records):
+    """(roots, problems): roots are nested {rec, children} nodes; problems
+    lists structural defects — orphan parents, multiple/zero roots."""
+    by_id = {}
+    problems = []
+    for r in records:
+        if r["span"] in by_id:
+            problems.append(f"duplicate span id {r['span']}")
+        by_id[r["span"]] = {"rec": r, "children": []}
+    roots = []
+    for node in by_id.values():
+        parent = node["rec"].get("parent")
+        if parent is None:
+            roots.append(node)
+        elif parent in by_id:
+            by_id[parent]["children"].append(node)
+        else:
+            problems.append(
+                f"orphan span {node['rec']['span']} "
+                f"({node['rec']['name']}): parent {parent} missing")
+    if not roots:
+        problems.append("no root span")
+    elif len(roots) > 1:
+        problems.append(
+            f"{len(roots)} roots: {[n['rec']['name'] for n in roots]}")
+    for node in by_id.values():
+        node["children"].sort(key=lambda n: (n["rec"]["t0"],
+                                             n["rec"]["span"]))
+    return roots, problems
+
+
+def _fmt_attrs(rec):
+    attrs = rec.get("attrs")
+    if not attrs:
+        return ""
+    inner = ", ".join(f"{k}={v}" for k, v in attrs.items())
+    return " {" + inner + "}"
+
+
+def render_tree(roots, t_base, out, indent=1):
+    for node in roots:
+        rec = node["rec"]
+        off = rec["t0"] - t_base
+        dur = rec.get("dur_s") or 0.0
+        line = (f"{'  ' * indent}{rec['name']}{_fmt_attrs(rec)}  "
+                f"+{off:.3f}s")
+        if dur:
+            line += f" {dur:.3f}s"
+        status = rec.get("status", "ok")
+        if status != "ok" or dur:
+            line += f" {status}"
+        out.append(line)
+        render_tree(node["children"], t_base, out, indent + 1)
+
+
+def summarize(tid, records):
+    roots, problems = build_tree(records)
+    root_rec = roots[0]["rec"] if roots else None
+    return {
+        "trace": tid,
+        "rid": records[0].get("rid") if records else None,
+        "status": root_rec.get("status") if root_rec else None,
+        "dur_s": (root_rec.get("dur_s") or 0.0) if root_rec else 0.0,
+        "t0": min(r["t0"] for r in records) if records else 0.0,
+        "n_spans": len(records),
+        "problems": problems,
+        "roots": roots,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge telemetry JSONL into per-request trace trees")
+    ap.add_argument("paths", nargs="+",
+                    help=".jsonl files or directories to scan")
+    ap.add_argument("--trace", help="only this trace id (prefix ok)")
+    ap.add_argument("--rid", type=int, help="only traces of this request id")
+    ap.add_argument("--slowest", type=int,
+                    help="only the N slowest traces")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON object per trace instead of trees")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 2 if any selected trace is malformed")
+    args = ap.parse_args(argv)
+
+    traces = load_traces(args.paths)
+    summaries = [summarize(tid, recs) for tid, recs in traces.items()]
+    if args.trace:
+        summaries = [s for s in summaries
+                     if s["trace"].startswith(args.trace)]
+    if args.rid is not None:
+        summaries = [s for s in summaries if s["rid"] == args.rid]
+    summaries.sort(key=lambda s: (-s["dur_s"] if args.slowest
+                                  else s["t0"]))
+    if args.slowest:
+        summaries = summaries[:args.slowest]
+
+    bad = 0
+    for s in summaries:
+        if args.json:
+            print(json.dumps({k: v for k, v in s.items() if k != "roots"}))
+        else:
+            print(f"trace {s['trace']} rid={s['rid']} status={s['status']} "
+                  f"dur={s['dur_s']:.3f}s spans={s['n_spans']}")
+            out = []
+            render_tree(s["roots"], s["t0"], out)
+            print("\n".join(out))
+            for p in s["problems"]:
+                print(f"  !! {p}")
+        if s["problems"]:
+            bad += 1
+    if not summaries:
+        print("no request traces found (is PADDLE_TELEMETRY on and a "
+              "JSONL sink attached?)", file=sys.stderr)
+    if args.check and bad:
+        print(f"trace_view: {bad} malformed trace(s)", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
