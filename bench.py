@@ -166,6 +166,8 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
     )
     step = TrainStep(model, lambda *a: LlamaPretrainingCriterion()(*a), opt, metrics_bus=bus)
 
+    from paddle_tpu.observability import compilemem as _compilemem
+
     rng = np.random.RandomState(0)
     ids = rng.randint(0, vocab, (batch, seq + 1)).astype(np.int32)
     x, y = paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
@@ -190,6 +192,7 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
         ys = paddle.to_tensor(sids[:, :, 1:])
         losses = step.run_steps(xs, ys, n=steps, stacked=True)  # compile
         losses.numpy()
+        comp_warm = _compilemem.ledger.counts()
         t0 = time.perf_counter()
         losses = step.run_steps(xs, ys, n=steps, stacked=True)
         loss_arr = losses.numpy()
@@ -201,11 +204,24 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
         # configs in warmup, and the timed loop runs 12x longer. This
         # measures sequential step latency (what a logging training loop
         # pays); the scan rungs measure the chip with overlap-free dispatch.
+        comp_warm = _compilemem.ledger.counts()
         t0 = time.perf_counter()
         for _ in range(steps):
             loss = step(x, y)
             float(loss.numpy())
         dt = (time.perf_counter() - t0) / steps
+
+    # steady-state compile contract (ISSUE 8 satellite): warm train steps
+    # must trigger ZERO recompiles — a nonzero delta means the timed number
+    # measured the compiler, not the chip, and the perf trajectory can't
+    # distinguish "slower code" from "compiling more"
+    comp_end = _compilemem.ledger.counts()
+    warm_recompiles = comp_end["events"] - comp_warm["events"]
+    if warm_recompiles:
+        raise RuntimeError(
+            f"steady-state compile contract violated: {warm_recompiles} "
+            f"compile(s) fired during the warm timed loop "
+            f"(ledger: {_compilemem.ledger.report(recent=4)['recent']})")
 
     from paddle_tpu.ops import flash_attention as fa
 
@@ -230,6 +246,14 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
             "attn_impl": fa.LAST_IMPL or "math-xla",
             "final_loss": round(float(loss.numpy()), 4),
             "steps_per_dispatch": steps if scan_steps else 1,
+            # compile ledger block (ISSUE 8 satellite): the perf
+            # trajectory can now split "slower code" from "compiling more"
+            "compile": {
+                "events": comp_end["events"],
+                "total_wall_s": comp_end["total_wall_s"],
+                "churn_alerts": comp_end["churn_alerts"],
+                "warm_recompiles": warm_recompiles,
+            },
             **({} if scan_steps else
                {"bus": {k: round(v, 4) for k, v in bus.summary().items()}}),
         },

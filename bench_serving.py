@@ -165,11 +165,13 @@ def _run_mode(model, mode, knobs, rng_seed, vocab):
 
     from paddle_tpu.serving import ServingFrontend
 
+    from paddle_tpu.observability import compilemem as _compilemem
     from paddle_tpu.observability.metrics import registry as _registry
 
     rng = np.random.RandomState(rng_seed)
     chunks0 = int(getattr(_registry.get("serve.prefill_chunks"),
                           "value", 0) or 0)
+    comp0 = _compilemem.ledger.counts()
     # ---- phase 1: mixed-SLO throughput over N replicas --------------------
     engines = _make_engines(model, mode, knobs["n_replicas"], knobs)
     load = _mixed_load(rng, vocab, knobs)
@@ -185,12 +187,27 @@ def _run_mode(model, mode, knobs, rng_seed, vocab):
     # tokens/s by tens of percent — best-of is the standard way to report
     # the configuration's capability rather than the noisiest run
     summary = None
+    comp_warm = None
     with ServingFrontend(engines, heartbeat_deadline_s=600.0) as fe:
         for _ in range(knobs["repeats"]):
             records, wall = _run_load(fe, load)
+            if comp_warm is None:
+                # snapshot after the FIRST repeat: anything warmup missed
+                # compiled there; later repeats must be compile-free
+                comp_warm = _compilemem.ledger.counts()
             s = _summarize(records, wall)
             if summary is None or s["tokens_per_sec"] > summary["tokens_per_sec"]:
                 summary = s
+    # steady-state compile contract (ISSUE 8 satellite): warm serving
+    # dispatch must trigger zero recompiles (needs >= 2 repeats to have a
+    # warm window to assert over — the --quick smoke has 1)
+    warm_recompiles = (_compilemem.ledger.counts()["events"]
+                       - comp_warm["events"])
+    if warm_recompiles and knobs["repeats"] > 1:
+        raise RuntimeError(
+            f"steady-state serving compile contract violated ({mode}): "
+            f"{warm_recompiles} compile(s) after the warm repeat "
+            f"(recent: {_compilemem.ledger.report(recent=4)['recent']})")
     # ---- phase 2: interactive TTFT while a long prompt prefills -----------
     engines2 = _make_engines(model, mode, 1, knobs)
     long_p = rng.randint(1, vocab, (knobs["long_hi"],)).astype(np.int32)
@@ -225,6 +242,13 @@ def _run_mode(model, mode, knobs, rng_seed, vocab):
         _registry.get("serve.prefill_chunks"), "value", 0) or 0) - chunks0
     summary["ttft_under_prefill_p50_s"] = (
         round(min(probes), 5) if probes else None)
+    comp1 = _compilemem.ledger.counts()
+    summary["compile"] = {
+        "events": comp1["events"] - comp0["events"],
+        "wall_s": round(comp1["total_wall_s"] - comp0["total_wall_s"], 3),
+        "churn_alerts": comp1["churn_alerts"] - comp0["churn_alerts"],
+        "warm_recompiles": warm_recompiles if knobs["repeats"] > 1 else None,
+    }
     return summary
 
 
@@ -321,6 +345,12 @@ def run_bench(quick=False, seed=0):
             # ISSUE 7 satellite: request-trace counts + measured
             # enabled-vs-disabled tracing overhead on the same load
             "telemetry": telemetry,
+            # ISSUE 8 satellite: per-mode compile ledger deltas — the
+            # trajectory can split "slower code" from "compiling more"
+            "compile": {
+                "baseline": base.get("compile"),
+                "pipelined": pipe.get("compile"),
+            },
         },
     }
 

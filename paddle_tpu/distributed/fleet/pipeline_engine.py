@@ -241,7 +241,12 @@ class PipelineStack(Layer):
 
         # shard_map with inner scan requires a jit scope even when the model
         # is driven eagerly; cache the jitted engine so eager loops compile once
-        engine_jit = jax.jit(engine)
+        from ...observability import compilemem as _compilemem
+
+        engine_jit = _compilemem.ledgered_jit(
+            engine, key=f"pp.eager_engine[pp{pp},leaves{n_leaf}]")
         self._jit_cache[cache_key] = engine_jit
+        _compilemem.ledger.note_cache_size(
+            "pp.eager_engine", len(self._jit_cache))
         return apply(engine_jit, x if isinstance(x, Tensor) else Tensor(x), *stacked,
                      *streams, name="pipeline")

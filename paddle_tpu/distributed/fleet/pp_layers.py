@@ -298,8 +298,14 @@ class PipelineModule(Layer):
             style = "1f1b" if self.schedule in ("1f1b", "vpp") else "fthenb"
             sched = build_schedule(M, self.pp_degree, num_chunks=V, style=style)
             fns = self._stage_fns(len(extras), stream_idx)
-            engine = jax.jit(make_pipeline_train_fn(sched, mesh, *fns))
+            from ...observability import compilemem as _compilemem
+
+            engine = _compilemem.ledgered_jit(
+                make_pipeline_train_fn(sched, mesh, *fns),
+                key=f"pp.schedule_engine[M{M},V{V},{self.schedule}]")
             self._sched_cache[key] = engine
+            _compilemem.ledger.note_cache_size(
+                "pp.schedule_engine", len(self._sched_cache))
 
         total = jnp.maximum(jnp.sum(lab_arr != self.ignore_index), 1)
         seed_ct = 1.0 / total.astype(jnp.float32)

@@ -22,9 +22,11 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..jit_api import TrainStep
+from ..observability import compilemem as _compilemem
 from ..observability import goodput as _goodput
 from ..observability import tracing as _tracing
 from ..observability import watchdog as _watchdog
+from ..testing import chaos
 from .mesh import get_mesh
 
 
@@ -279,13 +281,15 @@ class DistributedTrainStep(TrainStep):
             with _tracing.span("train.step.compile_build"):
                 shardings = self._sharding_trees(batch_datas)
                 params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, batch_sh = shardings
-                jitted = jax.jit(
-                    self._step_fn,
+                jitted = _compilemem.ledgered_jit(
+                    self._step_fn, key="train.step",
                     in_shardings=(params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, self._ns(P()), self._ns(P()), batch_sh),
                     out_shardings=(self._ns(P()), params_sh, buffers_sh, opt_sh, scaler_sh),
                     donate_argnums=(0, 1, 3, 4),
                 )
                 self._jitted[sig] = jitted
+                _compilemem.ledger.note_cache_size(
+                    "train.step.signatures", len(self._jitted))
         params = {k: p._data for k, p in self._trainable.items()}
         buffers = {k: b._data for k, b in self._buffers.items()}
         frozen = {k: p._data for k, p in self._frozen.items()}
@@ -296,10 +300,17 @@ class DistributedTrainStep(TrainStep):
         with _tracing.span("train.step.dispatch"), \
                 _goodput.account("init" if first else "step"):
             with self.mesh:
-                loss, new_params, new_buffers, self.opt_state, self._scaler_state = jitted(
-                    params, buffers, frozen, self.opt_state, self._scaler_state, lr,
-                    prandom.next_key(), batch_datas
-                )
+                # OOM-forensics seam (ISSUE 8) — same contract as the
+                # single-host TrainStep dispatch
+                try:
+                    chaos.site("obs.oom")
+                    loss, new_params, new_buffers, self.opt_state, self._scaler_state = jitted(
+                        params, buffers, frozen, self.opt_state, self._scaler_state, lr,
+                        prandom.next_key(), batch_datas
+                    )
+                except Exception as e:
+                    _compilemem.maybe_oom_report(e, program="train.step")
+                    raise
         for k, v in new_params.items():
             self._trainable[k]._data = v
         for k, v in new_buffers.items():
@@ -349,8 +360,9 @@ class DistributedTrainStep(TrainStep):
             if stacked:
                 batch_sh = tuple(
                     self._ns(P(None, *tuple(self._batch_spec(b)))) for b in inner)
-            jitted = jax.jit(
+            jitted = _compilemem.ledgered_jit(
                 self._multi_fn(n, stacked),
+                key=f"train.multi[n={n},stacked={stacked}]",
                 in_shardings=(params_sh, buffers_sh, frozen_sh, opt_sh,
                               scaler_sh, self._ns(P()), self._ns(P()), batch_sh),
                 out_shardings=(self._ns(P()), params_sh, buffers_sh, opt_sh,
@@ -358,6 +370,8 @@ class DistributedTrainStep(TrainStep):
                 donate_argnums=(0, 1, 3, 4),
             )
             self._jitted[sig] = jitted
+            _compilemem.ledger.note_cache_size(
+                "train.step.signatures", len(self._jitted))
         params = {k: p._data for k, p in self._trainable.items()}
         buffers = {k: b._data for k, b in self._buffers.items()}
         frozen = {k: p._data for k, p in self._frozen.items()}
@@ -367,8 +381,13 @@ class DistributedTrainStep(TrainStep):
         with _tracing.span("train.run_steps.dispatch"), \
                 _goodput.account("init" if first else "step"):
             with self.mesh:
-                losses, new_params, new_buffers, self.opt_state, self._scaler_state = jitted(
-                    params, buffers, frozen, self.opt_state, self._scaler_state, lr,
-                    prandom.next_key(), batch_datas
-                )
+                try:
+                    chaos.site("obs.oom")
+                    losses, new_params, new_buffers, self.opt_state, self._scaler_state = jitted(
+                        params, buffers, frozen, self.opt_state, self._scaler_state, lr,
+                        prandom.next_key(), batch_datas
+                    )
+                except Exception as e:
+                    _compilemem.maybe_oom_report(e, program="train.multi")
+                    raise
         return self._finish_run_steps(losses, new_params, new_buffers, n)

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from .framework.core import Tensor, to_tensor
+from .observability import compilemem as _compilemem
 
 _MIN_BUCKET = 16
 
@@ -154,11 +155,15 @@ class GenerationMixin:
             cache = self._gen_cache = {}
         run = cache.get(cache_key)
         if run is None:
-            run = cache[cache_key] = jax.jit(
+            # bucketed program variants are intended: B/S0b live in the
+            # ledger key, so a multi-bucket serve is not compile churn
+            run = cache[cache_key] = _compilemem.ledgered_jit(
                 self._build_generate_fn(B, S0b, max_new_tokens, do_sample, temperature,
                                         top_k, top_p, repetition_penalty, min_length,
-                                        eos_token_id, pad_token_id)
+                                        eos_token_id, pad_token_id),
+                key=f"generate.dense[B{B},S{S0b},n{max_new_tokens}]",
             )
+            _compilemem.ledger.note_cache_size("generate", len(cache))
         ids_p = jnp.pad(ids, ((0, 0), (0, S0b - S0)), constant_values=pad_token_id)
         state = self.raw_state_dict()
         gen = run(state, ids_p, jnp.int32(S0), jax.random.PRNGKey(seed))
@@ -196,11 +201,13 @@ class GenerationMixin:
             cache = self._gen_cache = {}
         run = cache.get(key)
         if run is None:
-            run = cache[key] = jax.jit(
+            run = cache[key] = _compilemem.ledgered_jit(
                 self._build_ragged_fn(B, S0b, max_new_tokens, do_sample, temperature,
                                       top_k, top_p, repetition_penalty, min_length,
-                                      eos_token_id, pad_token_id)
+                                      eos_token_id, pad_token_id),
+                key=f"generate.ragged[B{B},S{S0b},n{max_new_tokens}]",
             )
+            _compilemem.ledger.note_cache_size("generate", len(cache))
         gen = run(self.raw_state_dict(), jnp.asarray(aligned), jnp.asarray(pad_lens),
                   jax.random.PRNGKey(seed))
         return Tensor(jnp.concatenate([jnp.asarray(ids), gen], axis=1),
@@ -289,9 +296,13 @@ class GenerationMixin:
             cache = self._gen_cache = {}
         run = cache.get(key)
         if run is None:
-            run = cache[key] = jax.jit(self._build_speculative_fn(
-                draft_model, B, S0b, max_new_tokens, gamma,
-                eos_token_id, pad_token_id))
+            run = cache[key] = _compilemem.ledgered_jit(
+                self._build_speculative_fn(
+                    draft_model, B, S0b, max_new_tokens, gamma,
+                    eos_token_id, pad_token_id),
+                key=f"generate.speculative[B{B},S{S0b},n{max_new_tokens},"
+                    f"g{gamma}]")
+            _compilemem.ledger.note_cache_size("generate", len(cache))
         ids_p = jnp.pad(ids, ((0, 0), (0, S0b - S0)), constant_values=pad_token_id)
         gen = run(self.raw_state_dict(), draft_model.raw_state_dict(),
                   ids_p, jnp.int32(S0))
@@ -401,10 +412,13 @@ class GenerationMixin:
             cache = self._gen_cache = {}
         run = cache.get(key)
         if run is None:
-            run = cache[key] = jax.jit(
+            run = cache[key] = _compilemem.ledgered_jit(
                 self._build_beam_fn(B, S0b, max_new_tokens, num_beams,
-                                    length_penalty, eos_token_id, pad_token_id)
+                                    length_penalty, eos_token_id, pad_token_id),
+                key=f"generate.beam[B{B},S{S0b},n{max_new_tokens},"
+                    f"w{num_beams}]",
             )
+            _compilemem.ledger.note_cache_size("generate", len(cache))
         ids_p = jnp.pad(ids, ((0, 0), (0, S0b - S0)), constant_values=pad_token_id)
         gen = run(self.raw_state_dict(), ids_p, jnp.int32(S0))
         return Tensor(jnp.concatenate([ids, gen], axis=1), stop_gradient=True)

@@ -64,6 +64,7 @@ import numpy as np
 from ..framework import core as _core
 from ..framework.core import Tensor
 from ..generation import _make_sampler, prompt_bucket
+from ..observability import compilemem as _compilemem
 from ..observability import goodput as _goodput
 from ..observability import tracing as _trace
 from ..observability.metrics import registry as _registry
@@ -98,6 +99,23 @@ _M_OVERLAP = _registry.histogram(
              0.05, 0.1, 0.25, 0.5, 1.0, 2.5))
 _M_CHUNKS = _registry.counter("serve.prefill_chunks")
 _M_WARMUP = _registry.histogram("serve.compile_warmup_s")
+# page-pool fragmentation gauges (ISSUE 8): where the pool's pages are —
+# truly free, held by the prefix cache (evictable), or referenced by
+# in-flight requests — plus the cache-held fraction of reclaimable pages.
+# The HBM ledger's kv_pool component says how BIG the pool is; these say
+# how USED it is.
+_M_POOL_FREE = _registry.gauge(
+    "serve.pool_frag_free_pages", help="KV pool pages on the free list")
+_M_POOL_EVICT = _registry.gauge(
+    "serve.pool_frag_evictable_pages",
+    help="refcount-0 prefix-cache pages (reclaimable, LRU-evictable)")
+_M_POOL_USED = _registry.gauge(
+    "serve.pool_frag_used_pages",
+    help="pages referenced by in-flight requests")
+_M_POOL_FRAG = _registry.gauge(
+    "serve.pool_frag_ratio",
+    help="cache-held fraction of reclaimable pages "
+         "(evictable / (free + evictable))")
 
 # one module-level jitted block-decode key builder (jit cache survives
 # across serve() calls) over PER-REQUEST key bases (online mode admits
@@ -106,9 +124,9 @@ _M_WARMUP = _registry.histogram("serve.compile_warmup_s")
 # == fold_in(key_base, i) with key_base = fold_in(base, rid), so the sampled
 # streams are bit-identical to the pre-online single-seed
 # fold_in(fold_in(seed_key, rid), i) scheme.
-_KEYS_FROM_BASE = jax.jit(jax.vmap(
+_KEYS_FROM_BASE = _compilemem.ledgered_jit(jax.vmap(
     jax.vmap(lambda kb, i: jax.random.fold_in(kb, i), in_axes=(0, 0)),
-    in_axes=(None, 0)))
+    in_axes=(None, 0)), key="serve.keys_from_base")
 
 class _StampedRLock:
     """RLock that remembers WHEN its current outermost hold began.
@@ -508,6 +526,30 @@ class ContinuousBatchingEngine:
         # parameter-tree walk per decode block (the batch path captured
         # state once per serve() — this keeps the online path at parity)
         self._decode_state_cache = None
+        # HBM budget ledger + OOM forensics (ISSUE 8): the KV page pool is
+        # a first-class component of the device memory budget, and an OOM
+        # report must say what the engine was serving when it died. Both
+        # registrations are weak — a dropped engine vanishes from reports.
+        _compilemem.memory.register_component_provider(
+            "kv_pool", self, "pool_bytes")
+        _compilemem.register_oom_context(
+            "serving_engine", self, "_oom_context")
+
+    def _oom_context(self):
+        """Serving-state snapshot for telemetry/oom_report.json."""
+        return {
+            "active_slots": len(self._active),
+            "prefilling_slots": len(self._prefilling),
+            "max_seqs": self.max_seqs,
+            "pages_in_use": self._pages_in_use,
+            "free_pages": len(self.free_pages),
+            "evictable_pages": len(self._evictable),
+            "num_pages": self.num_pages,
+            "page_size": self.page_size,
+            "pool_bytes": self.pool_bytes(),
+            "inflight_block": self._inflight is not None,
+            "stats": dict(self.stats),
+        }
 
     def clear_prefix_cache(self):
         """Drop all cached (refcount-0) prefix pages and their index. In-use
@@ -637,7 +679,10 @@ class ContinuousBatchingEngine:
             vs = jnp.stack([read(vp, page_ids) for _, vp in pools])
             return ks, vs
 
-        fn = self._gather_fns[n_pages] = jax.jit(gather)
+        fn = self._gather_fns[n_pages] = _compilemem.ledgered_jit(
+            gather, key=f"serve.gather[p{n_pages}]")
+        _compilemem.ledger.note_cache_size("serve.gather",
+                                           len(self._gather_fns))
         return fn
 
     def _prefill_suffix(self, n_prefix_pages, suffix_bucket, sampling):
@@ -675,7 +720,12 @@ class ContinuousBatchingEngine:
             vs = jnp.stack([p[1]._data[0, plen:] for p in presents])
             return tok0, ks, vs
 
-        fn = self._prefill_suffix_fns[key3] = jax.jit(prefill_suf)
+        fn = self._prefill_suffix_fns[key3] = _compilemem.ledgered_jit(
+            prefill_suf,
+            key=f"serve.suffix[p{n_prefix_pages},b{suffix_bucket},"
+                f"s{sampling}]")
+        _compilemem.ledger.note_cache_size("serve.suffix",
+                                           len(self._prefill_suffix_fns))
         return fn
 
     # ---- dispatch locking -------------------------------------------------
@@ -691,13 +741,26 @@ class ContinuousBatchingEngine:
         instead of prefill/decode."""
         cold = [k for k in keys if k not in self._warm]
         self._last_dispatch_cold = bool(cold)
-        if not cold:
-            with self.dispatch_lock:
+        try:
+            if not cold:
+                with self.dispatch_lock:
+                    chaos.site("obs.oom")
+                    yield
+                return
+            with _COMPILE_LOCK, self.dispatch_lock:
+                chaos.site("obs.oom")
                 yield
-            return
-        with _COMPILE_LOCK, self.dispatch_lock:
-            yield
-        self._warm.update(cold)
+            self._warm.update(cold)
+        except Exception as e:
+            # OOM-forensics seam (ISSUE 8): every engine dispatch —
+            # prefill, gather/suffix, insert, decode — funnels through
+            # here, so one interception covers them all. The report
+            # commits (ledger + HBM budget + active slots/pages) before
+            # the exception continues into the per-request isolation /
+            # replica-death machinery.
+            _compilemem.maybe_oom_report(
+                e, program=str(keys[0]) if keys else None)
+            raise
 
     def _xprof_annotation(self, req):
         """Host-side profiler annotation carrying the request's trace_id
@@ -762,7 +825,10 @@ class ContinuousBatchingEngine:
             vs = jnp.stack([p[1]._data[0] for p in presents])
             return tok0, ks, vs
 
-        fn = self._prefill_fns[(bucket, sampling)] = jax.jit(prefill)
+        fn = self._prefill_fns[(bucket, sampling)] = _compilemem.ledgered_jit(
+            prefill, key=f"serve.prefill[b{bucket},s{sampling}]")
+        _compilemem.ledger.note_cache_size("serve.prefill",
+                                           len(self._prefill_fns))
         return fn
 
     @staticmethod
@@ -812,7 +878,10 @@ class ContinuousBatchingEngine:
 
         # donate the pool: the engine discards the pre-insert buffers
         # immediately, and without donation XLA copies the whole pool
-        fn = self._insert_fns[bucket] = jax.jit(insert, donate_argnums=(0,))
+        fn = self._insert_fns[bucket] = _compilemem.ledgered_jit(
+            insert, key=f"serve.insert[b{bucket}]", donate_argnums=(0,))
+        _compilemem.ledger.note_cache_size("serve.insert",
+                                           len(self._insert_fns))
         return fn
 
     # Per-row length CAPS (ISSUE 6): the block size is chosen from the
@@ -852,7 +921,10 @@ class ContinuousBatchingEngine:
         # donate the pools: a single-token decode must UPDATE the pool in
         # place, not copy it — without donation every step pays a full-pool
         # memcpy and doubles peak memory, against the engine's whole point
-        fn = self._decode_fns[sampling] = jax.jit(decode, donate_argnums=(2,))
+        fn = self._decode_fns[sampling] = _compilemem.ledgered_jit(
+            decode, key=f"serve.decode[s{sampling}]", donate_argnums=(2,))
+        _compilemem.ledger.note_cache_size("serve.decode",
+                                           len(self._decode_fns))
         return fn
 
     def _decode_block_fn(self, sampling, k):
@@ -888,8 +960,11 @@ class ContinuousBatchingEngine:
                 body, (toks, tuple(pools), lengths), keys)
             return toks_block, pools_out
 
-        fn = self._decode_block_fns[(sampling, k)] = jax.jit(
-            decode_block, donate_argnums=(2,))
+        fn = self._decode_block_fns[(sampling, k)] = _compilemem.ledgered_jit(
+            decode_block, key=f"serve.decode_block[k{k},s{sampling}]",
+            donate_argnums=(2,))
+        _compilemem.ledger.note_cache_size("serve.decode_block",
+                                           len(self._decode_block_fns))
         return fn
 
     def warmup(self, prompt_lens=None, do_sample=False, temperature=1.0,
@@ -926,8 +1001,12 @@ class ContinuousBatchingEngine:
             configs = [tuple(s) for s in sampling]
         t_warm0 = time.monotonic()
         try:
-            for cfg in configs:
-                self._warmup_one(prompt_lens, shared_prefix_lens, *cfg)
+            # ledger trigger scope (ISSUE 8): compiles inside warmup are
+            # deliberate AOT work, not cold-path stalls — /compilez and
+            # the bench contract separate them by this label
+            with _compilemem.ledger.trigger("warmup"):
+                for cfg in configs:
+                    self._warmup_one(prompt_lens, shared_prefix_lens, *cfg)
         finally:
             _M_WARMUP.observe(time.monotonic() - t_warm0)
 
@@ -1164,6 +1243,11 @@ class ContinuousBatchingEngine:
 
     def _update_gauges(self):
         _M_OCCUPANCY.set(self.active_count() / self.max_seqs)
+        free, evict = len(self.free_pages), len(self._evictable)
+        _M_POOL_FREE.set(free)
+        _M_POOL_EVICT.set(evict)
+        _M_POOL_USED.set(self._pages_in_use)
+        _M_POOL_FRAG.set(evict / (free + evict) if free + evict else 0.0)
 
     def try_admit_one(self, req):
         """Non-blocking admission of one :class:`EngineRequest`: page
@@ -1725,8 +1809,14 @@ class ContinuousBatchingEngine:
             # the latency the double-buffering hides per block
             _M_OVERLAP.observe(time.monotonic() - rec.t0)
         with _trace.span("serve.decode.sync"):
-            block = (rec.host if rec.host is not None
-                     else np.asarray(rec.blk))  # serve-readback-ok
+            try:
+                block = (rec.host if rec.host is not None
+                         else np.asarray(rec.blk))  # serve-readback-ok
+            except Exception as e:
+                # async-path OOM surfaces at readback, outside the
+                # dispatch lock — same forensics seam as _locked_dispatch
+                _compilemem.maybe_oom_report(e, program="serve.decode_block")
+                raise
         # wall from dispatch to readback, normalized per token: the TPOT
         # the serving comparison papers report
         block_wall = time.monotonic() - rec.t0

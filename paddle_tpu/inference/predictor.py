@@ -166,7 +166,11 @@ class Predictor:
             return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
 
         args = tuple(to_tensor(a)._data for a in example_inputs)
-        exp = jexport.export(jax.jit(pure))(state, *args)
+        from ..observability import compilemem as _compilemem
+
+        with _compilemem.record_compile("predictor.export_aot",
+                                        trigger="aot"):
+            exp = jexport.export(jax.jit(pure))(state, *args)  # compile-ledger-ok
         data = exp.serialize()
         with open(path, "wb") as f:
             f.write(data)

@@ -61,10 +61,13 @@ def save(layer, path, input_spec=None, **configs):
             outs = out if isinstance(out, (tuple, list)) else (out,)
             return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
 
-        exp = jexport.export(jax.jit(pure))(
-            jax.tree.map(lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), state),
-            *[aval(s) for s in input_spec],
-        )
+        from ..observability import compilemem as _compilemem
+
+        with _compilemem.record_compile("jit.save_export", trigger="aot"):
+            exp = jexport.export(jax.jit(pure))(  # compile-ledger-ok
+                jax.tree.map(lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), state),
+                *[aval(s) for s in input_spec],
+            )
         with open(path + ".pdmodel", "wb") as f:
             f.write(exp.serialize())
 

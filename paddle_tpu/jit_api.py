@@ -13,9 +13,11 @@ import jax.numpy as jnp
 
 from .framework import random as prandom
 from .framework.core import Tensor, _bump_mutation_version, to_tensor
+from .observability import compilemem as _compilemem
 from .observability import goodput as _goodput
 from .observability import tracing as _tracing
 from .observability import watchdog as _watchdog
+from .testing import chaos
 
 
 def jit(fn=None, static_argnums=None, donate_argnums=None, backend=None):
@@ -33,10 +35,15 @@ def jit(fn=None, static_argnums=None, donate_argnums=None, backend=None):
             nums = donate_argnums if isinstance(donate_argnums, (list, tuple)) else (donate_argnums,)
             kw["donate_argnums"] = tuple(a + 1 for a in nums)
 
-        @functools.partial(jax.jit, **kw)
-        def inner(key, *args, **kwargs):
+        def _inner(key, *args, **kwargs):
             with prandom.rng_guard(key):
                 return f(*args, **kwargs)
+
+        # the compile-ledger wrapper (ISSUE 8): records every (re)trace
+        # of this program — key'd per decorated function, so shape drift
+        # on ONE function reads as churn, not as distinct programs
+        inner = _compilemem.ledgered_jit(
+            _inner, key=f"jit.{getattr(f, '__name__', 'fn')}", **kw)
 
         @functools.wraps(f)
         def wrapper(*args, **kwargs):
@@ -101,7 +108,6 @@ class StaticLayer:
 
             layer.forward = types.MethodType(fwd_fn, layer)
 
-        @jax.jit
         def fwd(state, key, args, kwargs):
             with prandom.rng_guard(key):
                 out = layer.functional_call(
@@ -109,7 +115,11 @@ class StaticLayer:
                 )
             return out
 
-        self._fwd = fwd
+        # per-INSTANCE key (same convention as static.exec): N compiled
+        # instances of one class are N intended programs, not churn
+        self._fwd = _compilemem.ledgered_jit(
+            fwd, key=f"static_layer.{type(layer).__name__}"
+                     f"[{id(layer) & 0xffff:x}]")
 
     def __call__(self, *args, **kwargs):
         state = self._layer.raw_state_dict()
@@ -275,9 +285,29 @@ class TrainStep:
         self._step_fn = step_fn
         self._compiled = self._compile(step_fn)
         self._compiled_multi = {}  # n -> jitted scan-of-step program
+        # HBM budget ledger (ISSUE 8): params + optimizer state become
+        # weakly-bound byte providers — the silent bf16->f32 Adam upcast
+        # class of regression shows up in device.hbm_component_bytes
+        # instead of as an unexplained RESOURCE_EXHAUSTED
+        _compilemem.memory.register_component_provider(
+            "params", self, "_hbm_params_bytes")
+        _compilemem.memory.register_component_provider(
+            "optimizer", self, "_hbm_optimizer_bytes")
+
+    def _hbm_params_bytes(self):
+        return _compilemem.tree_nbytes(
+            [p._data for p in self._trainable.values()]
+            + [p._data for p in self._frozen.values()]
+            + [b._data for b in self._buffers.values()])
+
+    def _hbm_optimizer_bytes(self):
+        return _compilemem.tree_nbytes([self.opt_state, self._scaler_state])
 
     def _compile(self, step_fn):
-        return jax.jit(step_fn, donate_argnums=(0, 1, 3, 4))
+        # ONE logical program: recompiles mean the input signature
+        # drifted, which is exactly what the churn detector watches
+        return _compilemem.ledgered_jit(
+            step_fn, key="train.step", donate_argnums=(0, 1, 3, 4))
 
     def _multi_fn(self, n, stacked):
         """Pure n-steps-in-one-program function (lax.scan over the step
@@ -306,7 +336,12 @@ class TrainStep:
         return multi_fn
 
     def _compile_multi(self, n, stacked):
-        return jax.jit(self._multi_fn(n, stacked), donate_argnums=(0, 1, 3, 4))
+        # (n, stacked) are intended program variants — each gets its own
+        # ledger key so a legitimate multi-bucket run is not churn
+        return _compilemem.ledgered_jit(
+            self._multi_fn(n, stacked),
+            key=f"train.multi[n={n},stacked={stacked}]",
+            donate_argnums=(0, 1, 3, 4))
 
     def run_steps(self, *batch, n, stacked=False):
         """Run n optimizer steps in a single device dispatch. With
@@ -317,6 +352,10 @@ class TrainStep:
         key = (n, stacked)
         if key not in self._compiled_multi:
             self._compiled_multi[key] = self._compile_multi(n, stacked)
+            # the formerly-unbounded program cache (ISSUE 8 satellite):
+            # size exported per cache, warn past the configured bound
+            _compilemem.ledger.note_cache_size(
+                "train.multi", len(self._compiled_multi))
         params = {k: p._data for k, p in self._trainable.items()}
         buffers = {k: b._data for k, b in self._buffers.items()}
         frozen = {k: p._data for k, p in self._frozen.items()}
@@ -324,12 +363,17 @@ class TrainStep:
         batch_data = tuple(to_tensor(b)._data for b in batch)
         if stacked:
             self._check_stacked(batch_data, n)
-        losses, new_params, new_buffers, self.opt_state, self._scaler_state = (
-            self._compiled_multi[key](
-                params, buffers, frozen, self.opt_state, self._scaler_state,
-                lr, prandom.next_key(), batch_data,
+        try:
+            chaos.site("obs.oom")
+            losses, new_params, new_buffers, self.opt_state, self._scaler_state = (
+                self._compiled_multi[key](
+                    params, buffers, frozen, self.opt_state, self._scaler_state,
+                    lr, prandom.next_key(), batch_data,
+                )
             )
-        )
+        except Exception as e:
+            _compilemem.maybe_oom_report(e, program="train.multi")
+            raise
         return self._finish_run_steps(losses, new_params, new_buffers, n)
 
     def _finish_run_steps(self, losses, new_params, new_buffers, n):
@@ -371,9 +415,18 @@ class TrainStep:
                 lr = self.optimizer.get_lr()
                 batch_data = tuple(to_tensor(b)._data for b in batch)
             with _tracing.span("train.step.dispatch"):
-                loss, new_params, new_buffers, self.opt_state, self._scaler_state = self._compiled(
-                    params, buffers, frozen, self.opt_state, self._scaler_state, lr, prandom.next_key(), batch_data
-                )
+                # OOM-forensics seam (ISSUE 8): a RESOURCE_EXHAUSTED out
+                # of the dispatch commits telemetry/oom_report.json before
+                # re-raising; the obs.oom chaos site injects one
+                # deterministically for tests
+                try:
+                    chaos.site("obs.oom")
+                    loss, new_params, new_buffers, self.opt_state, self._scaler_state = self._compiled(
+                        params, buffers, frozen, self.opt_state, self._scaler_state, lr, prandom.next_key(), batch_data
+                    )
+                except Exception as e:
+                    _compilemem.maybe_oom_report(e, program="train.step")
+                    raise
         self._dispatched = True
         # write state back into the dygraph objects
         for k, v in new_params.items():

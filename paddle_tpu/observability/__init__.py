@@ -19,9 +19,16 @@ it is the EventCounters cost model. Span tracing and goodput timers are
 **zero-overhead when disabled** (a shared no-op context manager); see
 docs/OBSERVABILITY.md for the metric/span taxonomy and env vars.
 """
+from . import compilemem  # noqa: F401
 from . import goodput  # noqa: F401
 from . import request_trace  # noqa: F401
 from . import slo  # noqa: F401
+from .compilemem import (  # noqa: F401
+    CompileLedger,
+    MemoryLedger,
+    ledgered_jit,
+    record_compile,
+)
 from .goodput import GoodputAccountant  # noqa: F401
 from .metrics import (  # noqa: F401
     DEFAULT_BUCKETS,
@@ -49,5 +56,6 @@ __all__ = [
     "registry", "span", "enable", "disable", "enabled", "last_spans",
     "add_jsonl_sink", "JsonlSpanSink", "goodput", "GoodputAccountant",
     "HangWatchdog", "Heartbeat", "maybe_beat", "request_trace", "slo",
-    "SLOMonitor", "SLOObjective", "StatusServer",
+    "SLOMonitor", "SLOObjective", "StatusServer", "compilemem",
+    "CompileLedger", "MemoryLedger", "ledgered_jit", "record_compile",
 ]

@@ -14,6 +14,11 @@ Routes (GET only):
   (``text/plain; version=0.0.4``) — point a real scraper at it.
 - ``/tracez``   — recent request traces: the slowest N and the errored N
   (full span records — the live sibling of ``scripts/trace_view.py``).
+- ``/compilez`` — the XLA compile ledger (ISSUE 8): per-program compile
+  counts/wall, churned programs, in-flight compiles, cache sizes.
+- ``/memz``     — the HBM budget ledger: components (params/optimizer/KV
+  pool) vs device capacity, per-program ``memory_analysis()`` harvests
+  (``?analyze=1`` forces the lazy harvest).
 - ``/healthz``  — liveness: 200 with per-replica / per-rank heartbeat ages,
   503 when nothing can serve (no LIVE replica) or every heartbeat is stale.
 
@@ -26,7 +31,7 @@ import os
 import threading
 import time
 
-from . import goodput, request_trace, tracing
+from . import compilemem, goodput, request_trace, tracing
 from .metrics import registry as _registry
 
 __all__ = ["StatusServer"]
@@ -93,6 +98,18 @@ class StatusServer:
             "slowest": request_trace.slowest(self.tracez_n),
             "errored": request_trace.errored(self.tracez_n),
         }
+
+    def compilez(self):
+        """The compile ledger (ISSUE 8): per-key compile rollup, churned
+        programs, recent events, in-flight compiles, cache sizes."""
+        return compilemem.ledger.report()
+
+    def memz(self, analyze=False):
+        """The HBM budget ledger (ISSUE 8): components vs capacity, the
+        captured programs and their memory analyses. ``?analyze=1``
+        forces the lazy ``memory_analysis()`` harvest (one extra
+        off-device compile per un-analyzed program — operator opt-in)."""
+        return compilemem.memory.report(analyze=analyze)
 
     def _heartbeats(self):
         """{rank: age_s} from the PR-2 heartbeat files, when a telemetry
@@ -168,7 +185,8 @@ class StatusServer:
                 self.wfile.write(data)
 
             def do_GET(self):
-                path = self.path.split("?", 1)[0].rstrip("/") or "/statusz"
+                raw_path, _, query = self.path.partition("?")
+                path = raw_path.rstrip("/") or "/statusz"
                 try:
                     if path == "/varz":
                         self._send(200, server.varz(),
@@ -181,6 +199,15 @@ class StatusServer:
                         self._send(200, json.dumps(server.tracez(),
                                                    indent=1, default=str),
                                    "application/json")
+                    elif path == "/compilez":
+                        self._send(200, json.dumps(server.compilez(),
+                                                   indent=1, default=str),
+                                   "application/json")
+                    elif path == "/memz":
+                        analyze = "analyze=1" in query
+                        self._send(200, json.dumps(
+                            server.memz(analyze=analyze),
+                            indent=1, default=str), "application/json")
                     elif path == "/healthz":
                         code, payload = server.healthz()
                         self._send(code, json.dumps(payload, indent=1),
@@ -188,7 +215,8 @@ class StatusServer:
                     else:
                         self._send(404, json.dumps(
                             {"error": "not found", "routes": [
-                                "/statusz", "/varz", "/tracez", "/healthz"]}),
+                                "/statusz", "/varz", "/tracez", "/compilez",
+                                "/memz", "/healthz"]}),
                             "application/json")
                 except Exception as e:  # introspection must never crash
                     self._send(500, json.dumps(

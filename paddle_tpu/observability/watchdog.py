@@ -337,6 +337,13 @@ class HangWatchdog:
                 "stacks": self._read_text(stacks_path(self.dir, r)),
                 "last_spans": self._tail_spans(spans_path(self.dir, r)),
             }
+            # mid-compile diagnosis (ISSUE 8): the compile ledger writes a
+            # compiling.<rank>.json breadcrumb while a compile is in
+            # flight — a rank wedged inside XLA shows its program key and
+            # elapsed compile time instead of an opaque native stack
+            comp = self._read_compiling(r, now)
+            if comp is not None:
+                ranks[str(r)]["compiling"] = comp
         report = {
             "detected_at": now,
             "deadline_s": self.deadline_s,
@@ -388,6 +395,21 @@ class HangWatchdog:
                 self.on_hang(self.report_path)
             except Exception:
                 pass
+
+    def _read_compiling(self, rank, now):
+        """The rank's in-flight-compile breadcrumb, with elapsed times
+        stamped by the reader; None when no compile is in flight."""
+        from .compilemem import compiling_path
+
+        try:
+            with open(compiling_path(self.dir, rank)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            return None
+        for a in rec.get("active", []):
+            if "started_at" in a:
+                a["elapsed_s"] = round(now - a["started_at"], 3)
+        return rec
 
     @staticmethod
     def _read_text(path, limit=1 << 20):

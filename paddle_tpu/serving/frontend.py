@@ -49,6 +49,7 @@ from ..inference.continuous import (
     EngineRequest,
     canonical_sampling,
 )
+from ..observability import compilemem as _compilemem
 from ..observability import goodput as _goodput
 from ..observability import request_trace as _rtrace
 from ..observability import tracing as _tracing
@@ -1048,4 +1049,8 @@ class ServingFrontend:
             # classified {prefill, decode, host_emit, idle, compile};
             # populated when telemetry is enabled (the goodput gate)
             "goodput": _goodput.serving.report(),
+            # compile ledger + HBM budget (ISSUE 8): cold-program counts,
+            # churn alerts, and KV-pool/params bytes vs device capacity
+            "compile": _compilemem.ledger.report(recent=8),
+            "memory": _compilemem.memory.report(),
         }

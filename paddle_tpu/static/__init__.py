@@ -27,6 +27,7 @@ import numpy as np
 
 from ..framework import dtype as dtypes
 from ..framework.core import Tensor, init_tensor_slots, to_tensor
+from ..observability import compilemem as _compilemem
 
 _static_mode = False
 _var_counter = itertools.count()
@@ -258,7 +259,12 @@ class Executor:
 
                 return [ev(f) for f in fetch_list]
 
-            runner = program._exec_cache[key] = jax.jit(evaluate)
+            runner = program._exec_cache[key] = _compilemem.ledgered_jit(
+                evaluate,
+                key=f"static.exec[prog{id(program) & 0xffff:x},"
+                    f"fetch{len(fetch_list)}]")
+            _compilemem.ledger.note_cache_size(
+                "static.exec", len(program._exec_cache))
         outs = runner(feed)
         return [np.asarray(o) for o in outs]
 
@@ -318,7 +324,10 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
         return jax.ShapeDtypeStruct(tuple(shape), v._dtype)
 
     feeds = {v.name: aval(v) for v in feed_vars}
-    exp = jexport.export(jax.jit(_graph_fn(fetch_vars)))(feeds)
+    # AOT export site: jexport.export needs the raw jit-wrapped callable,
+    # so the ledger brackets the whole trace+lower explicitly
+    with _compilemem.record_compile("static.export", trigger="aot"):
+        exp = jexport.export(jax.jit(_graph_fn(fetch_vars)))(feeds)  # compile-ledger-ok
     header = {
         "feed": [
             {"name": v.name, "shape": v._shape, "dtype": str(np.dtype(v._dtype))}

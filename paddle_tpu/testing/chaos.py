@@ -40,6 +40,12 @@ launch.watch              distributed/launch/controller.py watch tick
 dataloader.worker         io/dataloader.py forked worker, per batch
 serve.prefill             inference/continuous.py per-request prefill
 serve.decode              inference/continuous.py per decode dispatch
+obs.oom                   the XLA dispatch seams (jit_api train-step
+                          dispatch, continuous._locked_dispatch): inject a
+                          synthetic RESOURCE_EXHAUSTED so OOM forensics
+                          (observability/compilemem.py oom_report.json) is
+                          testable deterministically — compilemem.is_oom
+                          recognizes a FaultInjected from this site
 trainer.step              user training loops (opt-in; autoresume docs)
 ========================  ===================================================
 

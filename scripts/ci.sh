@@ -97,6 +97,60 @@ if bad:
     sys.exit(1)
 PY
 
+# compile-ledger completeness lint (ISSUE 8 satellite): every XLA compile
+# site in paddle_tpu/ must flow through observability/compilemem.py —
+# ledgered_jit for jit sites, record_compile for AOT export sites — so the
+# compile ledger (/compilez, churn detection, OOM forensics) is complete by
+# CONSTRUCTION. A raw jax.jit reference or a .lower(...).compile() chain
+# anywhere else is a blind spot; the compile-ledger-ok marker is the
+# allowlist (the wrapper itself + AOT sites already bracketed by
+# record_compile on the same line).
+python - <<'PY'
+import ast, os, sys
+
+bad = []
+for root, dirs, files in os.walk("paddle_tpu"):
+    for fn in files:
+        if not fn.endswith(".py"):
+            continue
+        path = os.path.join(root, fn)
+        src = open(path).read()
+        lines = src.splitlines()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            hit = None
+            # any `jax.jit` reference (call, partial, decorator)
+            if (isinstance(node, ast.Attribute) and node.attr == "jit"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "jax"):
+                hit = "raw jax.jit"
+            # <expr>.lower(...).compile(...) AOT chains
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "compile"
+                  and isinstance(node.func.value, ast.Call)
+                  and isinstance(node.func.value.func, ast.Attribute)
+                  and node.func.value.func.attr == "lower"):
+                hit = ".lower(...).compile()"
+            if hit is None:
+                continue
+            line = lines[node.lineno - 1]
+            if "compile-ledger-ok" in line:
+                continue
+            bad.append((path, node.lineno, hit, line.strip()))
+if bad:
+    for path, ln, hit, text in bad:
+        print(f"{path}:{ln}: {hit}: {text}")
+    print("lint: compile site bypasses the compile ledger — use "
+          "observability.compilemem.ledgered_jit / record_compile (or tag "
+          "a deliberate exception with  # compile-ledger-ok)",
+          file=sys.stderr)
+    sys.exit(1)
+PY
+
 # metric/span doc drift lint (ISSUE 7 satellite): every metric/span name
 # LITERAL registered in paddle_tpu/ must appear in a docs/OBSERVABILITY.md
 # table first cell, and every non-wildcard documented name must still be
@@ -205,6 +259,7 @@ FAST_TESTS=(
   tests/test_serving_frontend.py
   tests/test_serving_perf.py
   tests/test_request_trace.py
+  tests/test_compile_memory_obs.py
 )
 
 if [[ "${1:-}" == "--fast" ]]; then

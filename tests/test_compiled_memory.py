@@ -8,17 +8,21 @@ the HLO).
 2. recompute (remat) shrinks a deep net's live activation footprint;
 3. the full 7B north-star-shaped program TRACES abstractly (eval_shape) —
    shape correctness at scale without allocating 7B params.
+
+The probes flow through the compile/memory ledger's
+``compilemem.analyze_function`` (ISSUE 8) — the same
+``memory_analysis()`` harvest /memz and the OOM report use, so these
+asymptotic assertions and the live HBM ledger can never diverge.
 """
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+from paddle_tpu.observability import compilemem
 
 
-def _temp_bytes(jitted, *args):
-    import jax
-
-    return jax.jit(jitted).lower(*args).compile().memory_analysis().temp_size_in_bytes
+def _temp_bytes(fn, *args):
+    return compilemem.analyze_function(fn, *args)["temp_bytes"]
 
 
 class TestFusedCEMemory:
