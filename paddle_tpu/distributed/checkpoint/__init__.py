@@ -38,7 +38,23 @@ class CheckpointLayoutMismatch(CheckpointCorruptError):
     shape does not match the live process group / target state_dict. Raised
     by load_state_dict in a pre-pass BEFORE any tensor is mutated — the
     alternative is an opaque broadcast shape error halfway through a load
-    that has already clobbered part of the model."""
+    that has already clobbered part of the model.
+
+    A WORLD-SIZE-ONLY mismatch (the elastic shrink/grow restore case) is
+    recoverable: ``load_state_dict(..., reshard=True)`` gathers the
+    recorded shards from every rank's archive and re-splits them onto the
+    live topology (``reshard.py``)."""
+
+
+def _np_dtype(tag):
+    """Metadata dtype tag -> numpy dtype (ml_dtypes' bfloat16 has no
+    numpy name). One resolver for BOTH restore paths (fixed-width and
+    reshard) so the special case can never drift between them."""
+    if tag == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(tag)
 
 
 _UINT_FOR_WIDTH = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
@@ -125,17 +141,37 @@ def _surface_prior_async_save():
         raise err
 
 
-def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
-                    unique_id=None, async_save=False):
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=None, unique_id=None, async_save=False):
+    from ..fleet.elastic import fencing as _fencing
+    from ..fleet.elastic import membership as _membership
+
     global _last_async_save
     _surface_prior_async_save()
+    # generation fence (ISSUE 9): a straggler from a superseded elastic
+    # generation must never overwrite the live job's checkpoints
+    _fencing.assert_writable("ckpt.save")
     t_save0 = time.perf_counter()
     # a long blocking save must not read as a rank hang: phase beats get the
     # watchdog's startup-length leash until the next step beat
     _watchdog.note_phase("checkpoint")
     os.makedirs(path, exist_ok=True)
-    pid = jax.process_index()
-    metadata = {"tensors": {}, "world": jax.process_count()}
+    # shard identity follows the ELASTIC contract (launcher-assigned rank /
+    # world) when present, the jax process group otherwise — so a shared
+    # checkpoint root holds one archive per trainer, not N colliding
+    # "0_0.distcp" files, and the recorded world is the one a restore must
+    # match (or reshard across)
+    pid = _membership.rank()
+    if coordinator_rank is None:
+        # default: with a SINGLE jax process (launcher workers, solo runs)
+        # this rank coordinates — a non-zero trainer saving into its own
+        # per-rank root must still commit metadata.json, or the checkpoint
+        # is unloadable; true multi-process jax keeps the process-0
+        # single-writer default. Shared elastic roots pass an explicit
+        # coordinator (CheckpointManager(coordinator_rank=0) does).
+        coordinator_rank = pid if jax.process_count() == 1 else 0
+    metadata = {"tensors": {}, "world": _membership.world_size(),
+                "rank": pid, "generation": _membership.generation()}
     data_file = os.path.join(path, f"{pid}_0.distcp")
     blobs = {}
     with _tracing.span("ckpt.save.snapshot", path=path):
@@ -177,6 +213,13 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 
         atomic_write(final, lambda f: np.savez(f, **blobs),
                      before_commit=_fingerprint_then_chaos)
+        if int(metadata["world"]) > 1:
+            # per-rank shard manifest: reshard-on-restore merges these back
+            # into the full cross-rank shard inventory (reshard.read_layout)
+            from .reshard import rank_manifest_name
+
+            atomic_write_json(os.path.join(path, rank_manifest_name(pid)),
+                              metadata)
         if pid == coordinator_rank:
             atomic_write_json(
                 os.path.join(path, "metadata.json"), metadata,
@@ -228,14 +271,23 @@ def _file_fingerprint(fpath):
     return {"bytes": os.path.getsize(fpath), "crc32": crc}
 
 
-def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, unique_id=None, offload=False):
+def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
+                    unique_id=None, offload=False, reshard=False):
     """Fills `state_dict` tensors in place, resharding from saved layout to
     each tensor's CURRENT sharding (cross-mesh resume).
 
     Integrity gate: every referenced shard archive is verified against the
     manifest (size + crc32, when present) and must unzip cleanly BEFORE any
     tensor is touched; a truncated/partial shard raises
-    CheckpointCorruptError instead of poisoning a live model."""
+    CheckpointCorruptError instead of poisoning a live model.
+
+    ``reshard=True`` opts into elastic world-size recovery: when the
+    recorded world size differs from the live one, the load delegates to
+    ``reshard.load_resharded`` — gather every rank's recorded shards from
+    shared storage and re-split onto the live topology — instead of
+    raising CheckpointLayoutMismatch."""
+    from ..fleet.elastic import membership as _membership
+
     t_load0 = time.perf_counter()
     _watchdog.note_phase("recovery")
     meta_path = os.path.join(path, "metadata.json")
@@ -247,17 +299,47 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
         metadata = json.load(f)
     # ---- layout pre-pass (BEFORE touching archives or tensors) ----------
     # Cross-MESH resume is supported (shards reassemble to the global shape,
-    # then reshard to each target's live sharding); a different WORLD SIZE is
-    # not — shard files written by other processes aren't addressable here —
-    # and a mismatched global shape would otherwise surface as an opaque
-    # broadcast error halfway through a load that already mutated tensors.
+    # then reshard to each target's live sharding); a different WORLD SIZE
+    # needs the opt-in reshard path — peers' shard files are only readable
+    # when `path` is SHARED storage, which is the elastic-restore case but
+    # not the general one. A mismatched global shape would otherwise surface
+    # as an opaque broadcast error halfway through a load that already
+    # mutated tensors.
     saved_world = metadata.get("world")
-    if saved_world is not None and int(saved_world) != jax.process_count():
-        raise CheckpointLayoutMismatch(
-            f"{path}: checkpoint was saved by a world of {saved_world} "
-            f"processes but the live process group has "
-            f"{jax.process_count()} — reshard offline or relaunch at the "
-            f"recorded world size")
+    live_world = _membership.world_size()
+    if saved_world is not None and int(saved_world) != live_world:
+        if reshard:
+            from .reshard import load_resharded
+
+            return load_resharded(state_dict, path)
+        if int(saved_world) != jax.process_count():
+            sample = next(iter(metadata.get("tensors", {}).items()), None)
+            example = (f" (e.g. tensor {sample[0]!r}, global shape "
+                       f"{sample[1]['global_shape']})" if sample else "")
+            raise CheckpointLayoutMismatch(
+                f"{path}: checkpoint was saved by a world of {saved_world} "
+                f"processes but the live job has {live_world}{example} — "
+                f"pass reshard=True to gather/re-split across the "
+                f"world-size change (handles world-size-only mismatches), "
+                f"or relaunch at the recorded world size")
+        # back-compat: pre-elastic builds recorded jax.process_count() (1
+        # per launcher worker), not the trainer world — a legacy per-rank
+        # checkpoint's shards ARE locally addressable, so it must keep
+        # loading fixed-width under a multi-worker launch instead of
+        # silently falling through the recovery ladder to step 0
+    if reshard:
+        # SAME-world restore from a shared elastic root: metadata.json only
+        # references the COORDINATOR's archive, so a fixed-width fill would
+        # silently hand every rank the coordinator's per-rank cursors. When
+        # the target carries perrank.* names and this rank's shard manifest
+        # exists, route through the reshard machinery — its identity
+        # mapping restores each rank's OWN cursor.
+        from .reshard import PERRANK_PREFIX, load_resharded, rank_manifest_name
+
+        if any(n.startswith(PERRANK_PREFIX) for n in state_dict) \
+                and os.path.exists(os.path.join(
+                    path, rank_manifest_name(_membership.rank()))):
+            return load_resharded(state_dict, path)
     for name, t in state_dict.items():
         info = metadata["tensors"].get(name)
         if info is None:
@@ -267,9 +349,11 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
         if want != have:
             raise CheckpointLayoutMismatch(
                 f"{path}: tensor {name!r} was saved with global shape "
-                f"{list(want)} but the target state_dict expects "
-                f"{list(have)} — the checkpoint's sharding layout does not "
-                f"match the live model")
+                f"{list(want)} (world {saved_world}) but the target "
+                f"state_dict expects {list(have)} (live world {live_world}) "
+                f"— the checkpoint's sharding layout does not match the "
+                f"live model; reshard=True cannot fix this (it handles "
+                f"world-size-only mismatches, not a resized model)")
     fingerprints = metadata.get("files", {})
     archives = {}
     for fname in os.listdir(path):
@@ -316,9 +400,7 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
         info = metadata["tensors"].get(name)
         if info is None:
             continue
-        import ml_dtypes
-
-        dt = np.dtype(info["dtype"]) if info["dtype"] != "bfloat16" else ml_dtypes.bfloat16
+        dt = _np_dtype(info["dtype"])
         full = np.zeros(info["global_shape"], dt)
         for shard in info["shards"]:
             arch = archives[shard["file"]]
@@ -345,8 +427,10 @@ def load_state_dict(state_dict, path, process_group=None, coordinator_rank=0, un
 
 # multi-tier resilient checkpointing (ISSUE 3): Tier-0 in-memory snapshot
 # ring, Tier-1 peer replication, Tier-2 durable retention/GC, and the
-# recovery ladder. Imported LAST — the submodules use the helpers above.
-from . import recovery, replica, tiers  # noqa: E402,F401
-from .recovery import RecoveryResult, resolve  # noqa: E402,F401
+# recovery ladder; elastic reshard-on-restore (ISSUE 9). Imported LAST —
+# the submodules use the helpers above.
+from . import recovery, replica, reshard, tiers  # noqa: E402,F401
+from .recovery import RecoveryResult, StepNegotiator, resolve  # noqa: E402,F401
 from .replica import PeerReplicator  # noqa: E402,F401
+from .reshard import ReshardPlan, load_resharded, plan_reshard, read_layout  # noqa: E402,F401
 from .tiers import CheckpointManager, RetentionPolicy, Snapshot, SnapshotRing  # noqa: E402,F401

@@ -92,18 +92,35 @@ class StepNegotiator:
     overlap — deterministic, and identical on every rank.
 
     Construct ONE negotiator per recovery episode, on every rank, with the
-    same ``session`` id (e.g. the launcher restart counter or an agreed
-    incarnation token): store keys and barrier names derive from
-    (session, tier tag), so ranks rendezvous by WHAT they are negotiating,
-    never by how many times some long-lived object was called — a retrying
-    rank and a freshly restarted rank always meet at the same keys."""
+    same ``session`` id (default: the elastic generation — a re-formed job
+    never rendezvouses with a dead generation's keys): store keys and
+    barrier names derive from (session, tier tag), so ranks rendezvous by
+    WHAT they are negotiating, never by how many times some long-lived
+    object was called — a retrying rank and a freshly restarted rank always
+    meet at the same keys.
 
-    def __init__(self, store, rank, world_size, timeout=60, session="0"):
+    **Membership (ISSUE 9)**: negotiation runs over the LIVE-RANK SET
+    (``ranks``; default: the launcher-published membership via
+    ``fleet.elastic.membership.live_ranks``), never ``range(world_size)`` —
+    after an elastic shrink, a barrier sized by the dead world would wait
+    on ranks that no longer exist and time every negotiation out."""
+
+    def __init__(self, store, rank, world_size=None, timeout=60,
+                 session=None, ranks=None):
+        from ..fleet.elastic import membership as _membership
+
         self.store = store
         self.rank = int(rank)
-        self.world_size = int(world_size)
+        if ranks is None:
+            ranks = _membership.live_ranks(world_size)
+        self.ranks = sorted(int(r) for r in ranks)
+        if self.rank not in self.ranks:
+            raise ValueError(
+                f"rank {self.rank} not in the live-rank set {self.ranks}")
+        self.world_size = len(self.ranks)  # membership CARDINALITY
         self.timeout = timeout
-        self.session = str(session)
+        self.session = str(session) if session is not None \
+            else f"g{_membership.generation()}"
 
     def agree(self, tag, steps):
         """Never raises: a negotiation that cannot complete (store outage,
@@ -113,15 +130,15 @@ class StepNegotiator:
         such a failure is surfaced via ``recovery.negotiate_failed``; the
         caller's job-level policy (elastic restart) is the backstop."""
         steps = sorted(int(s) for s in steps)
-        if self.world_size <= 1 or self.store is None:
+        if len(self.ranks) <= 1 or self.store is None:
             return steps[-1] if steps else None
         key = f"__ckpt_recover__/{self.session}/{tag}"
         try:
             self.store.set(f"{key}/{self.rank}", json.dumps(steps))
             self.store.barrier(f"ckpt_recover_{self.session}_{tag}",
-                               self.world_size, timeout=self.timeout)
+                               len(self.ranks), timeout=self.timeout)
             common = None
-            for r in range(self.world_size):
+            for r in self.ranks:  # the live set, never range(world)
                 raw = self.store.get(f"{key}/{r}")
                 theirs = set(json.loads(raw.decode() if isinstance(raw, bytes)
                                         else str(raw)))

@@ -90,8 +90,15 @@ class PeerReplicator:
                           else _env_int(REPLICA_DEGREE_ENV, 2))
         self.group = str(group if group is not None
                          else os.environ.get(REPLICA_GROUP_ENV, "0"))
-        self.group_ranks = sorted(int(r) for r in group_ranks) \
-            if group_ranks is not None else list(range(self.world_size))
+        if group_ranks is not None:
+            self.group_ranks = sorted(int(r) for r in group_ranks)
+        else:
+            # membership, not range(world): after an elastic shrink the
+            # launcher-published live-rank set is the only truth about who
+            # can publish or serve peer state (fleet.elastic.membership)
+            from ..fleet.elastic import membership as _membership
+
+            self.group_ranks = _membership.live_ranks(self.world_size)
         if self.rank not in self.group_ranks:
             raise ValueError(
                 f"rank {self.rank} not in its own group_ranks "
@@ -112,6 +119,11 @@ class PeerReplicator:
         Returns the publication path or None."""
         if not self.enabled or (not force and not self.is_publisher):
             return None
+        # generation fence (ISSUE 9): a dead generation's straggler must
+        # not publish state the live generation could restore
+        from ..fleet.elastic import fencing as _fencing
+
+        _fencing.assert_writable("ckpt.peer.publish")
         t0 = time.perf_counter()
         os.makedirs(self.dir, exist_ok=True)
         # a previous incarnation of THIS rank SIGKILLed mid-publish left a
@@ -173,7 +185,7 @@ class PeerReplicator:
             return []
         out = []
         if self.store is not None:
-            for r in range(self.world_size):
+            for r in self.group_ranks:  # the live set, never range(world)
                 if r == self.rank:
                     continue
                 try:
@@ -192,9 +204,13 @@ class PeerReplicator:
                 names = os.listdir(self.dir)
             except OSError:
                 names = []
+            live = set(self.group_ranks)
             for name in names:
                 m = _SNAP_RE.match(name)
-                if not m or int(m.group(1)) == self.rank:
+                # membership filter: a dead (shrunk-away) rank's leftover
+                # publication is not peer state even if the scrub missed it
+                if not m or int(m.group(1)) == self.rank \
+                        or int(m.group(1)) not in live:
                     continue
                 try:
                     with open(sidecar_path(self.dir, int(m.group(1)))) as f:

@@ -1,0 +1,307 @@
+"""Reshard-on-restore: map a checkpoint's recorded shard layout onto a
+DIFFERENT live world size (ISSUE 9 tentpole).
+
+The fixed-width loader (``load_state_dict``) hard-fails when the recorded
+world size differs from the live one, because in a multi-host world "the
+other ranks' shard files" are not generally readable. The elastic restore
+case is exactly the opposite situation: the job re-formed at a new world
+size and is restoring from DURABLE, SHARED storage — every rank's shard
+archive and manifest is right there. ``reshard=True`` opts into that
+assumption and this module does the work:
+
+- **Layout**: each elastic save (world > 1) writes, next to the
+  coordinator's ``metadata.json``, a per-rank shard manifest
+  ``metadata.rank<R>.json`` and archive ``<R>_0.distcp.npz``.
+  :func:`read_layout` merges the rank manifests back into one global shard
+  inventory.
+- **Replicated tensors** (a single shard box covering the full global
+  shape, usually published by several ranks): the lowest-rank committed
+  copy is taken — bit-exact at ANY world-size pair.
+- **Rank-sharded tensors** (disjoint index boxes spread across rank
+  archives — DP/sharding-degree optimizer shards): the boxes are gathered
+  into the global tensor and re-split onto the live target's sharding via
+  ``device_put``. Gather/re-split is streamed ONE TENSOR AT A TIME (npz
+  members decompress lazily), so peak host RAM is bounded by the largest
+  single tensor, never the full state.
+- **Per-rank cursors** (names under ``perrank.`` — RNG streams, dataloader
+  positions): never merged. Live rank ``r`` adopts saved rank ``map(r)``:
+  identity when ``r`` existed in the saved world, else ``r % saved_world``
+  (grow), falling back to the lowest present rank when the mapped archive
+  is missing. Cursors of dropped ranks are reported on the plan
+  (``dropped_perrank``), not restored — after a world change the data
+  sharding moved anyway, so cursors are advisory by contract
+  (docs/ELASTIC.md).
+
+Validation runs BEFORE any tensor mutates, same contract as the
+fixed-width loader: global shapes against the live targets (a shape
+mismatch means the MODEL changed — reshard only handles world-size
+mismatches), full shard coverage per tensor, manifest fingerprints and
+archive readability for every file the plan references.
+"""
+import json
+import os
+import re
+import time
+
+import jax
+import numpy as np
+
+from ...observability import goodput as _goodput
+from ...observability import tracing as _tracing
+from ...observability import watchdog as _watchdog
+from ...observability.metrics import registry as _registry
+from ...utils.metrics_bus import counters
+from ...framework.core import Tensor
+
+__all__ = ["PERRANK_PREFIX", "ReshardPlan", "read_layout", "plan_reshard",
+           "load_resharded", "rank_manifest_name"]
+
+#: state-dict names under this prefix are per-rank cursors, never merged
+PERRANK_PREFIX = "perrank."
+
+_RANK_META_RE = re.compile(r"^metadata\.rank(\d+)\.json$")
+
+
+def rank_manifest_name(rank):
+    """Per-rank shard manifest filename — save_state_dict and this module
+    must agree on it."""
+    return f"metadata.rank{int(rank)}.json"
+
+
+def read_layout(path):
+    """Merge a checkpoint directory's manifests into one layout view:
+    ``{world, ranks, generation, per_rank: {rank: metadata}, files}``.
+    ``files`` is the union of the per-file fingerprints every writer
+    recorded. Pre-elastic checkpoints (no rank manifests) degrade to a
+    single-rank layout built from ``metadata.json``."""
+    from . import CheckpointCorruptError
+
+    meta_path = os.path.join(path, "metadata.json")
+    if not os.path.exists(meta_path):
+        raise CheckpointCorruptError(
+            f"{path}: no metadata.json — checkpoint was never committed")
+    with open(meta_path) as f:
+        base = json.load(f)
+    per_rank = {}
+    try:
+        names = os.listdir(path)
+    except OSError as e:
+        raise CheckpointCorruptError(f"{path}: unreadable directory: {e}") from e
+    for name in sorted(names):
+        m = _RANK_META_RE.match(name)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(path, name)) as f:
+                per_rank[int(m.group(1))] = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                f"{path}/{name}: unreadable rank manifest: {e}") from e
+    if not per_rank:
+        per_rank = {int(base.get("rank", 0)): base}
+    files = {}
+    for meta in list(per_rank.values()) + [base]:
+        files.update(meta.get("files", {}))
+    return {"path": path, "world": int(base.get("world", len(per_rank))),
+            "ranks": sorted(per_rank), "generation": base.get("generation", 0),
+            "per_rank": per_rank, "files": files}
+
+
+class ReshardPlan:
+    """The per-tensor mapping from saved shard boxes to the live targets:
+    ``tensors[name] = {global_shape, dtype, kind, shards}`` with ``kind``
+    one of ``replicated | sharded | perrank``. Built entirely from
+    manifests — planning never opens a shard archive."""
+
+    __slots__ = ("saved_world", "live_world", "live_rank", "tensors",
+                 "dropped_perrank")
+
+    def __init__(self, saved_world, live_world, live_rank):
+        self.saved_world = int(saved_world)
+        self.live_world = int(live_world)
+        self.live_rank = int(live_rank)
+        self.tensors = {}
+        self.dropped_perrank = []
+
+    def kinds(self):
+        out = {}
+        for name, info in self.tensors.items():
+            out[info["kind"]] = out.get(info["kind"], 0) + 1
+        return out
+
+    def __repr__(self):
+        return (f"ReshardPlan({self.saved_world}->{self.live_world} "
+                f"rank={self.live_rank} {self.kinds()})")
+
+
+def _box_volume(index):
+    v = 1
+    for a, b in index:
+        v *= max(0, int(b) - int(a))
+    return v
+
+
+def _perrank_source(sources, live_rank, saved_world):
+    """Which saved rank's cursor a live rank adopts (module docstring)."""
+    if live_rank in sources:
+        return live_rank
+    mapped = live_rank % max(1, saved_world)
+    if mapped in sources:
+        return mapped
+    return min(sources)
+
+
+def plan_reshard(layout, state_dict, live_rank=None, live_world=None):
+    """Plan the restore of ``state_dict`` from ``layout`` (see
+    :func:`read_layout`). Raises CheckpointLayoutMismatch on a global-shape
+    change (not a world-size problem — reshard cannot fix a resized model)
+    and CheckpointCorruptError on incomplete shard coverage."""
+    from . import CheckpointCorruptError, CheckpointLayoutMismatch
+    from ..fleet.elastic import membership
+
+    live_rank = membership.rank() if live_rank is None else int(live_rank)
+    live_world = membership.world_size() if live_world is None \
+        else int(live_world)
+    plan = ReshardPlan(layout["world"], live_world, live_rank)
+    path = layout["path"]
+    adopted = {}
+    for name, t in state_dict.items():
+        sources = {r: meta["tensors"][name]
+                   for r, meta in layout["per_rank"].items()
+                   if name in meta.get("tensors", {})}
+        if not sources:
+            continue  # same contract as load_state_dict: left untouched
+        shapes = {tuple(i["global_shape"]) for i in sources.values()}
+        if len(shapes) > 1:
+            raise CheckpointCorruptError(
+                f"{path}: tensor {name!r} recorded with conflicting global "
+                f"shapes across rank manifests: {sorted(shapes)}")
+        want = shapes.pop()
+        data = getattr(t, "_data", t)
+        have = tuple(getattr(data, "shape", np.shape(data)))
+        if want != have:
+            raise CheckpointLayoutMismatch(
+                f"{path}: tensor {name!r} was saved with global shape "
+                f"{list(want)} (world {plan.saved_world}) but the live "
+                f"target expects {list(have)} (world {live_world}) — "
+                f"reshard=True only handles world-size mismatches, not a "
+                f"resized model")
+        dtype = next(iter(sources.values()))["dtype"]
+        if name.startswith(PERRANK_PREFIX):
+            src = _perrank_source(sources, live_rank, plan.saved_world)
+            adopted.setdefault(name, set()).add(src)
+            shards = [dict(s, rank=src) for s in sources[src]["shards"]]
+            kind = "perrank"
+        else:
+            # merge boxes across ranks; replicated copies (identical index)
+            # dedupe to the lowest committed rank — bit-exact by definition
+            seen = {}
+            for r in sorted(sources):
+                for s in sources[r]["shards"]:
+                    key = tuple(tuple(int(x) for x in ab) for ab in s["index"])
+                    if key not in seen:
+                        seen[key] = dict(s, rank=r)
+            shards = list(seen.values())
+            covered = sum(_box_volume(s["index"]) for s in shards)
+            total = int(np.prod(want)) if want else 1
+            if covered != total:
+                raise CheckpointCorruptError(
+                    f"{path}: tensor {name!r} has incomplete shard coverage "
+                    f"after merging rank manifests ({covered} of {total} "
+                    f"elements) — a rank's archive or manifest is missing "
+                    f"from the saved world of {plan.saved_world}")
+            kind = "replicated" if len(shards) == 1 \
+                and _box_volume(shards[0]["index"]) == total else "sharded"
+        plan.tensors[name] = {"global_shape": list(want), "dtype": dtype,
+                              "kind": kind, "shards": shards}
+    # report dropped per-rank cursors (shrink): saved ranks nobody adopted.
+    # Only THIS rank's adoptions are known locally; ranks >= live_world can
+    # never be adopted by any live rank under the identity/modulo map.
+    for name, srcs in adopted.items():
+        for r in layout["ranks"]:
+            if r >= live_world and r not in srcs:
+                plan.dropped_perrank.append((name, r))
+    return plan
+
+
+def load_resharded(state_dict, path, live_rank=None, plan=None):
+    """Restore ``state_dict`` in place from a checkpoint saved at a
+    DIFFERENT world size (entry point behind ``load_state_dict(...,
+    reshard=True)``). Validation — shapes, coverage, fingerprints, archive
+    readability — all happens before the first tensor mutates."""
+    from . import (CheckpointCorruptError, _file_fingerprint, _from_savable,
+                   _np_dtype)
+
+    t0 = time.perf_counter()
+    _watchdog.note_phase("recovery")
+    layout = read_layout(path)
+    if plan is None:
+        plan = plan_reshard(layout, state_dict, live_rank=live_rank)
+    # ---- pre-pass: every referenced archive exists, matches its recorded
+    # fingerprint, and opens cleanly ------------------------------------
+    needed = sorted({s["file"] for info in plan.tensors.values()
+                     for s in info["shards"]})
+    archives = {}
+    with _tracing.span("ckpt.reshard.verify", path=path):
+        for fname in needed:
+            full = os.path.join(path, fname)
+            if not full.endswith(".npz"):
+                full += ".npz"
+            base = os.path.basename(full)
+            want = layout["files"].get(base)
+            if not os.path.exists(full):
+                counters.bump("fault.ckpt.corrupt_shard")
+                raise CheckpointCorruptError(
+                    f"{path}: shard archive {base!r} referenced by the "
+                    f"reshard plan is missing — incomplete checkpoint")
+            if want is not None:
+                got = _file_fingerprint(full)
+                if got != want:
+                    counters.bump("fault.ckpt.corrupt_shard")
+                    raise CheckpointCorruptError(
+                        f"{full}: manifest says {want}, file is {got} — "
+                        f"partial/torn shard write")
+            try:
+                archives[fname] = np.load(full)
+            except Exception as e:
+                counters.bump("fault.ckpt.corrupt_shard")
+                raise CheckpointCorruptError(
+                    f"{full}: unreadable archive: {e}") from e
+        for name, info in plan.tensors.items():
+            for s in info["shards"]:
+                if s["key"] not in archives[s["file"]].files:
+                    counters.bump("fault.ckpt.corrupt_shard")
+                    raise CheckpointCorruptError(
+                        f"{s['file']}: member {s['key']!r} for tensor "
+                        f"{name!r} is missing — incomplete checkpoint")
+    # ---- streamed gather/re-split: one tensor at a time ----------------
+    with _tracing.span("ckpt.reshard.fill", path=path):
+        for name, t in state_dict.items():
+            info = plan.tensors.get(name)
+            if info is None:
+                continue
+            dt = _np_dtype(info["dtype"])
+            full = np.zeros(info["global_shape"], dt)
+            for s in info["shards"]:
+                try:
+                    block = _from_savable(archives[s["file"]][s["key"]], dt)
+                except Exception as e:  # torn zip member past the directory
+                    counters.bump("fault.ckpt.corrupt_shard")
+                    raise CheckpointCorruptError(
+                        f"{s['file']}[{s['key']}]: unreadable shard: {e}"
+                    ) from e
+                full[tuple(slice(int(a), int(b)) for a, b in s["index"])] = block
+            target = t._data.sharding if hasattr(t._data, "sharding") else None
+            arr = jax.device_put(full, target) if target is not None else full
+            t.set_value(Tensor(arr))
+            del full  # bounded peak RAM: never hold two global tensors
+    dt_s = time.perf_counter() - t0
+    _registry.counter("elastic.reshard_loads").inc()
+    _registry.histogram("ckpt.reshard_s").observe(dt_s)
+    _registry.histogram("ckpt.load_s").observe(dt_s)
+    if plan.dropped_perrank:
+        _registry.counter("elastic.perrank_dropped").inc(
+            len(plan.dropped_perrank))
+    if _tracing.enabled():
+        _goodput.note("recovery", dt_s)
+    return state_dict

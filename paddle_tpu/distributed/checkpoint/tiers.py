@@ -50,11 +50,8 @@ KEEP_LAST_ENV = "PADDLE_CKPT_KEEP_LAST"
 KEEP_EVERY_ENV = "PADDLE_CKPT_KEEP_EVERY"
 
 
-def _env_int(name, default):
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+# re-exported: replica.py and tests import it from here
+from ...utils.envs import env_int as _env_int  # noqa: E402
 
 
 def _host_copy(arr):
@@ -322,13 +319,25 @@ class CheckpointManager:
     The manifest commits atomically AFTER the checkpoint's own commit — a
     manager killed between the two leaves a valid-but-unlisted directory
     that GC treats as garbage, never a listed-but-torn one.
+
+    ``coordinator_rank`` selects who commits the manifest/metadata and runs
+    GC: ``None`` (default) means THIS rank coordinates — right for per-rank
+    roots, where every rank owns its own directory; a SHARED elastic root
+    (ISSUE 9: one checkpoint, per-rank shard archives, reshard-on-restore)
+    must pass the coordinating trainer rank (usually 0). ``reshard=True``
+    makes every ``load`` opt into world-size resharding — the recovery
+    ladder then restores across elastic shrink/grow without the caller
+    threading a flag through ``resolve()``.
     """
 
     MANIFEST = "MANIFEST.json"
 
-    def __init__(self, root, policy=None):
+    def __init__(self, root, policy=None, coordinator_rank=None,
+                 reshard=False):
         self.root = str(root)
         self.policy = policy if policy is not None else RetentionPolicy()
+        self.coordinator_rank = coordinator_rank
+        self.reshard = bool(reshard)
         os.makedirs(self.root, exist_ok=True)
         self._pending_async = None  # (handle, step) awaiting manifest commit
         # claims of _pending_async must be atomic: a training thread's next
@@ -361,14 +370,30 @@ class CheckpointManager:
                 out.append(int(ent["step"]))
         return sorted(set(out), reverse=True)
 
-    @staticmethod
-    def _is_coordinator():
+    def _my_rank(self):
+        from ..fleet.elastic import membership
+
+        return membership.rank()
+
+    def _coordinator(self):
+        """The rank that commits metadata/manifest and runs GC. Explicit
+        when configured (shared elastic root). Default: with a SINGLE jax
+        process (launcher sims, solo runs) this rank owns its root —
+        per-rank roots need every rank to commit its own manifest; with a
+        true multi-process jax runtime the pre-elastic single-writer
+        default (process 0) is kept, so a shared root never gets N
+        concurrent manifest/GC writers by accident."""
+        if self.coordinator_rank is not None:
+            return int(self.coordinator_rank)
+        import jax
+
+        return self._my_rank() if jax.process_count() == 1 else 0
+
+    def _is_coordinator(self):
         """Manifest commits and GC are single-writer operations: only the
         coordinator process mutates them (save_state_dict already gates
         metadata.json the same way); every rank may read."""
-        import jax
-
-        return jax.process_index() == 0
+        return self._my_rank() == self._coordinator()
 
     def _commit_manifest(self, step):
         if not self._is_coordinator():
@@ -387,7 +412,8 @@ class CheckpointManager:
         after the data commit (for async, on wait() or the next save)."""
         self._drain_async()
         d = self.step_dir(step)
-        handle = save_state_dict(state_dict, d, async_save=async_save)
+        handle = save_state_dict(state_dict, d, async_save=async_save,
+                                 coordinator_rank=self._coordinator())
         if async_save:
             with self._async_lock:
                 self._pending_async = (handle, int(step))
@@ -420,7 +446,7 @@ class CheckpointManager:
         self._commit_manifest(step)
         self.gc()
 
-    def load(self, state_dict, step=None):
+    def load(self, state_dict, step=None, reshard=None):
         from . import load_state_dict
 
         if step is None:
@@ -431,7 +457,8 @@ class CheckpointManager:
                 raise CheckpointCorruptError(
                     f"{self.root}: no valid checkpoints in manifest")
             step = steps[0]
-        load_state_dict(state_dict, self.step_dir(step))
+        load_state_dict(state_dict, self.step_dir(step),
+                        reshard=self.reshard if reshard is None else reshard)
         return step
 
     # ---- retention ---------------------------------------------------------
@@ -453,6 +480,21 @@ class CheckpointManager:
         # writer died between data commit and manifest commit, or mid-write)
         # — garbage, except a still-in-flight async save's dir
         pending = self._pending_async[1] if self._pending_async else None
+        # SHARED multi-writer root (explicit coordinator + elastic world>1,
+        # ISSUE 9): an unlisted dir NEWER than the newest valid step is
+        # usually a PEER's save still in flight — the coordinator commits
+        # its manifest before slower ranks finish their archives — and
+        # rmtree-ing it from under the peer crashes that rank's save. Such
+        # dirs survive GC; a genuinely torn newest save is reclaimed once a
+        # newer checkpoint commits and it falls behind max(valid).
+        # Single-writer roots keep the original collect-everything contract.
+        if self.coordinator_rank is not None:
+            from ..fleet.elastic import membership as _membership
+
+            multi_writer = _membership.world_size() > 1
+        else:
+            multi_writer = False
+        newest_valid = max(valid)
         try:
             names = os.listdir(self.root)
         except OSError:
@@ -466,6 +508,8 @@ class CheckpointManager:
             except ValueError:
                 continue
             if s not in valid and s != pending and s not in drop:
+                if multi_writer and s > newest_valid:
+                    continue  # a peer's in-flight save, not an orphan
                 drop.append(s)
         if drop:
             m = self.manifest()
@@ -513,6 +557,12 @@ class CheckpointManager:
         """Atomically flush one Tier-0 snapshot to durable storage. Writes a
         sibling file — NEVER into a step_* directory — so a half-finished
         emergency flush cannot corrupt Tier 2."""
+        # generation fence (ISSUE 9): an emergency flush is the classic
+        # straggler write — a SIGTERM'd old-generation rank racing the
+        # re-formed job must not land state the new world could restore
+        from ..fleet.elastic import fencing as _fencing
+
+        _fencing.assert_writable("ckpt.emergency")
         path = self.emergency_path(snapshot.rank)
         chaos.site("ckpt.emergency", path=path)
         atomic_write_bytes(path, snapshot.to_bytes())

@@ -17,6 +17,13 @@ import time
 
 from ....framework.native import TCPStore
 from ....utils.metrics_bus import counters
+from . import fencing, membership  # noqa: F401  (public submodules)
+from .fencing import GenerationFence, StaleGenerationError  # noqa: F401
+from .membership import (  # noqa: F401
+    generation as current_generation,
+    live_ranks,
+    scaled_per_rank_batch,
+)
 
 ELASTIC_TIMEOUT = 30
 
@@ -96,11 +103,25 @@ class ElasticStatus:
 
 
 class ElasticManager:
+    """Worker-side membership view of an elastic job (ISSUE 9 tentpole).
+
+    Membership is expressed as TCPStore LEASES: ``beat()`` renews this
+    rank's lease (a timestamp under a generation-scoped key), and
+    ``live_members()`` / ``dead_members()`` classify the launcher-published
+    live-rank set (``membership.live_ranks()``) by lease freshness.
+    Generation scoping means a straggler from a superseded incarnation
+    renewing its old lease is invisible to the live generation — the same
+    fencing discipline ``fencing.GenerationFence`` applies to checkpoint
+    writes (``fence()`` hands one out sharing this manager's store)."""
+
     def __init__(self, args=None, store=None, rank=None, world_size=None,
-                 heartbeat_interval=5, timeout=ELASTIC_TIMEOUT):
-        self.rank = rank if rank is not None else int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-        self.world_size = world_size if world_size is not None else int(
-            os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+                 heartbeat_interval=5, timeout=ELASTIC_TIMEOUT,
+                 generation=None):
+        self.rank = rank if rank is not None else membership.rank()
+        self.world_size = world_size if world_size is not None else \
+            membership.world_size()
+        self.generation = generation if generation is not None else \
+            membership.generation()
         self.heartbeat_interval = heartbeat_interval
         self.timeout = timeout
         self._store = store
@@ -114,27 +135,62 @@ class ElasticManager:
                     self._store = None
         self.enabled = self._store is not None and self.world_size > 1
 
+    def _lease_key(self, r):
+        # generation-scoped: a re-formed job never reads old-world leases
+        return f"__beat__/{self.generation}/{int(r)}"
+
     def beat(self):
+        """Renew this rank's membership lease."""
         if not self.enabled:
             return
-        self._store.set(f"__beat__/{self.rank}", str(time.time()))
+        self._store.set(self._lease_key(self.rank), str(time.time()))
+
+    # beat() IS the lease renewal; the alias documents the intent at call
+    # sites that think in lease terms
+    lease = beat
+
+    def _lease_age(self, r, now):
+        """Seconds since rank ``r`` last renewed; None when it never has."""
+        key = self._lease_key(r)
+        if not self._store.check(key):
+            return None  # never beat yet — still starting
+        return now - float(self._store.get(key))
 
     def dead_members(self):
-        """Ranks whose last heartbeat is older than `timeout` seconds."""
+        """Live-set ranks whose lease is older than `timeout` seconds."""
         if not self.enabled:
             return []
         now = time.time()
         dead = []
-        for r in range(self.world_size):
+        for r in membership.live_ranks(self.world_size):
             if r == self.rank:
                 continue
-            key = f"__beat__/{r}"
-            if not self._store.check(key):
-                continue  # never beat yet — still starting
-            ts = float(self._store.get(key))
-            if now - ts > self.timeout:
+            age = self._lease_age(r, now)
+            if age is not None and age > self.timeout:
                 dead.append(r)
         return dead
+
+    def live_members(self):
+        """Live-set ranks NOT known dead: fresh lease, or no lease yet
+        (still in rendezvous/first compile — the same live-but-starting
+        classification dead_members() uses, so the two always agree and a
+        startup-window quorum never undercounts healthy peers)."""
+        if not self.enabled:
+            return [self.rank]
+        now = time.time()
+        out = []
+        for r in membership.live_ranks(self.world_size):
+            if r == self.rank:
+                out.append(r)
+                continue
+            age = self._lease_age(r, now)
+            if age is None or age <= self.timeout:
+                out.append(r)
+        return out
+
+    def fence(self):
+        """A GenerationFence sharing this manager's store connection."""
+        return GenerationFence(store=self._store, generation=self.generation)
 
     def health(self):
         return ElasticStatus.RESTART if self.dead_members() else ElasticStatus.HOLD

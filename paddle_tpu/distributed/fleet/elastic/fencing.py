@@ -1,0 +1,132 @@
+"""Generation fencing (ISSUE 9 tentpole).
+
+When the launcher re-forms an elastic job it bumps the generation counter
+in the rendezvous TCPStore (``__elastic_gen__``) BEFORE deploying the new
+incarnation. A straggler from the old generation — a rank wedged in a
+collective that escapes SIGKILL on an unreachable host, an emergency-flush
+thread racing teardown — must not be able to write checkpoints or peer
+publications the live generation will then restore: its state is from a
+membership that no longer exists.
+
+Every durable-ish checkpoint write (``save_state_dict``, Tier-1
+``PeerReplicator.publish``, Tier-2 emergency flushes) calls
+:func:`assert_writable` first. The check is a no-op outside elastic
+launches (``PADDLE_ELASTIC_GENERATION`` unset — zero store traffic), and
+FAIL-OPEN when the store is unreachable: fencing is a defense against
+split-brain writes, not a new availability dependency for checkpointing —
+an unreachable store means the launcher (and its re-forms) are gone too,
+so there is no newer generation to protect.
+"""
+import os
+import threading
+
+from ....utils.metrics_bus import counters
+from .membership import GENERATION_ENV
+from .membership import generation as _membership_generation
+
+__all__ = ["StaleGenerationError", "GenerationFence", "GEN_STORE_KEY",
+           "process_fence", "assert_writable"]
+
+#: rendezvous-store key holding the newest generation (launcher-owned)
+GEN_STORE_KEY = "__elastic_gen__"
+
+
+class StaleGenerationError(RuntimeError):
+    """This process belongs to a superseded elastic generation; the write
+    it attempted was refused. The only correct reaction is to exit — the
+    launcher already re-formed the job without this rank."""
+
+
+class GenerationFence:
+    """Compare OUR generation against the newest one the store has seen.
+
+    ``check()`` raises :class:`StaleGenerationError` when the store holds a
+    newer generation; unreadable stores fail open (see module docstring).
+    """
+
+    def __init__(self, store=None, generation=None):
+        self.store = store
+        self.generation = int(generation) if generation is not None \
+            else _membership_generation()
+
+    def newest_generation(self):
+        """The newest generation visible: max(ours, store's). None-safe."""
+        newest = self.generation
+        if self.store is not None:
+            try:
+                if self.store.check(GEN_STORE_KEY):
+                    raw = self.store.get(GEN_STORE_KEY)
+                    newest = max(newest, int(
+                        raw.decode() if isinstance(raw, bytes) else raw))
+            except Exception:
+                counters.bump("fault.elastic.fence_check_failed")
+        return newest
+
+    def check(self, op="write"):
+        newest = self.newest_generation()
+        if newest > self.generation:
+            counters.bump("fault.elastic.fenced_write")
+            from ....observability.metrics import registry as _registry
+
+            _registry.counter("elastic.fenced_writes").inc()
+            raise StaleGenerationError(
+                f"{op}: this process is elastic generation "
+                f"{self.generation} but the job has re-formed at generation "
+                f"{newest} — a superseded incarnation must not write "
+                f"checkpoints; exiting is the only correct reaction")
+        return True
+
+
+# process-wide fence, resolved lazily exactly once (None = not yet
+# resolved, False = not an elastic launch — permanent no-op)
+_process_fence = None
+_fence_lock = threading.Lock()
+
+
+def process_fence():
+    """The env-configured fence for THIS process: generation from
+    ``PADDLE_ELASTIC_GENERATION``, store dialed once from
+    ``PADDLE_MASTER``. Returns False outside elastic launches."""
+    global _process_fence
+    f = _process_fence
+    if f is not None:
+        return f
+    with _fence_lock:
+        if _process_fence is not None:
+            return _process_fence
+        if not os.environ.get(GENERATION_ENV):
+            _process_fence = False
+            return False
+        store = None
+        master = os.environ.get("PADDLE_MASTER")
+        if master:
+            try:
+                from ....framework.native import TCPStore
+
+                host, port = master.rsplit(":", 1)
+                # SHORT dial timeout: an unreachable launcher host (the
+                # very host-loss scenario this module exists for) must
+                # fail the fence OPEN in seconds — a SIGTERM'd rank's
+                # 30s boundary-checkpoint grace cannot be spent blocked
+                # on the store's default 900s connect deadline
+                store = TCPStore(host, int(port), is_master=False, timeout=5)
+            except Exception:
+                counters.bump("fault.elastic.fence_check_failed")
+                store = None  # fail open: fencing never blocks recovery
+        _process_fence = GenerationFence(
+            store=store, generation=_membership_generation())
+        return _process_fence
+
+
+def assert_writable(op="ckpt.write"):
+    """The checkpoint-write gate: raises StaleGenerationError for a
+    superseded generation, free outside elastic launches."""
+    f = process_fence()
+    if f is not False:
+        f.check(op)
+
+
+def _reset():
+    """Test hook: forget the cached fence so env changes take effect."""
+    global _process_fence
+    _process_fence = None

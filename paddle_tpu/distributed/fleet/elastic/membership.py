@@ -1,0 +1,95 @@
+"""Elastic job membership (ISSUE 9 tentpole).
+
+One module answers "who is in the job RIGHT NOW" for every layer that used
+to assume ``range(world_size)``: the launcher (re-)forms the job and
+publishes the membership contract through three env vars —
+
+- ``PADDLE_TRAINERS_NUM``: the CURRENT world size (shrinks/grows across
+  generations; trainer ids are reassigned contiguously at each re-form);
+- ``PADDLE_ELASTIC_RANKS``: the live-rank set, comma separated (today
+  always ``0..world-1`` after reassignment; kept explicit so partial
+  memberships — a future hole-punched rank map — need no new plumbing);
+- ``PADDLE_ELASTIC_GENERATION``: the job incarnation counter, bumped on
+  every shrink/grow re-form. Checkpoint writes are fenced on it
+  (``fencing.py``) so a straggler from a dead generation cannot clobber
+  the live job's state.
+
+Checkpoint/recovery code MUST derive membership from here, never from
+``range(world_size)`` (ci.sh lints the checkpoint package for exactly
+that) — after a shrink, a dead rank enumerated by range would be waited on
+forever in step negotiation and peer discovery.
+"""
+import os
+
+from ....utils.envs import env_int as _env_int
+
+__all__ = ["RANK_ENV", "WORLD_ENV", "GENERATION_ENV", "LIVE_RANKS_ENV",
+           "ORIG_WORLD_ENV", "rank", "world_size", "generation",
+           "live_ranks", "original_world", "scaled_per_rank_batch"]
+
+RANK_ENV = "PADDLE_TRAINER_ID"
+WORLD_ENV = "PADDLE_TRAINERS_NUM"
+GENERATION_ENV = "PADDLE_ELASTIC_GENERATION"
+LIVE_RANKS_ENV = "PADDLE_ELASTIC_RANKS"
+ORIG_WORLD_ENV = "PADDLE_ELASTIC_ORIG_WORLD"
+
+
+def rank():
+    """This process's trainer rank: the launcher contract when present,
+    else the jax process index (single-process runs -> 0)."""
+    r = os.environ.get(RANK_ENV)
+    if r:
+        return int(r)
+    import jax
+
+    return jax.process_index()
+
+
+def world_size():
+    """The CURRENT job world size — the launcher contract when present
+    (it shrinks/grows across elastic generations), else jax's."""
+    w = os.environ.get(WORLD_ENV)
+    if w:
+        return int(w)
+    import jax
+
+    return jax.process_count()
+
+
+def generation():
+    """The elastic incarnation this process belongs to (0 = first launch)."""
+    return _env_int(GENERATION_ENV, 0)
+
+
+def live_ranks(world=None):
+    """Sorted live-rank set. The launcher-published set wins when present;
+    otherwise every rank of ``world`` (default: :func:`world_size`) is
+    assumed live — the fixed-width case."""
+    raw = os.environ.get(LIVE_RANKS_ENV)
+    if raw:
+        return sorted(int(r) for r in raw.split(",") if r.strip() != "")
+    return list(range(world if world is not None else world_size()))
+
+
+def original_world():
+    """The generation-0 world size (what the job was launched at) — the
+    denominator elastic batch rescaling keeps constant."""
+    return _env_int(ORIG_WORLD_ENV, world_size())
+
+
+def scaled_per_rank_batch(global_batch, world=None):
+    """Per-rank batch size that keeps ``global_batch`` constant at the
+    CURRENT world size — the launcher shrinks/grows the world, training
+    scripts call this each (re)start and the global batch never moves.
+    Raises when the global batch does not divide evenly: silently training
+    at a different effective batch would corrupt LR-schedule assumptions."""
+    w = int(world if world is not None else world_size())
+    gb = int(global_batch)
+    if w < 1 or gb < 1:
+        raise ValueError(f"need world>=1 and global_batch>=1, got {w}, {gb}")
+    if gb % w:
+        raise ValueError(
+            f"global batch {gb} does not divide by the live world size {w}; "
+            f"choose a global batch divisible by every world size the job "
+            f"may shrink to")
+    return gb // w

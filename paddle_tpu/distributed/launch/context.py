@@ -30,12 +30,25 @@ def build_parser():
     p.add_argument("--log_level", default="INFO")
     p.add_argument("--job_id", default="default")
     p.add_argument("--elastic_level", type=int, default=-1,
-                   help="-1/0: fail whole job on worker failure; 1: restart failed workers in place")
+                   help="-1/0: fail whole job on worker failure; 1: restart "
+                        "failed workers in place; 2: additionally RE-FORM "
+                        "the job at the surviving world size when a "
+                        "worker's restart budget is exhausted (elastic "
+                        "shrink; docs/ELASTIC.md), and grow back when "
+                        "capacity returns")
     p.add_argument("--max_restart", type=int, default=3,
                    help="per-container restart cap for CRASH exits under elastic_level>=1")
     p.add_argument("--max_total_restarts", type=int, default=None,
                    help="pod-wide restart budget incl. preemption restarts; "
                         "default 2*max_restart*nproc")
+    p.add_argument("--max_reforms", type=int, default=6,
+                   help="pod-wide budget of elastic shrink/grow re-forms "
+                        "under --elastic_level >= 2 — a flapping host must "
+                        "still terminate the job deterministically")
+    p.add_argument("--reform_grace", type=float, default=30.0,
+                   help="seconds survivors get to checkpoint at a step "
+                        "boundary (SIGTERM preemption contract) before an "
+                        "elastic re-form SIGKILLs them")
     p.add_argument("--dcn_dp", type=int, default=1,
                    help="TPU slice count for the hybrid ICI x DCN mesh: "
                         "build_mesh puts ONLY data parallelism on the "

@@ -5,19 +5,50 @@ register endpoints, derive ranks, build the Pod with the PADDLE_* env
 contract, then watch — restarting or aborting on failure per
 --elastic_level (fleet/elastic/manager.py ElasticManager semantics folded
 in: the restart path reassigns PADDLE_TRAINER_ID and relies on scripts
-resuming from checkpoints)."""
+resuming from checkpoints).
+
+Elastic shrink/grow (ISSUE 9, ``--elastic_level >= 2``): when a container
+is PERMANENTLY lost — its crash-restart budget is exhausted, or the
+``elastic.host_loss`` chaos site declares the host gone — the job is
+RE-FORMED at the surviving world size instead of aborted: survivors get a
+SIGTERM (the preemption contract: checkpoint at a step boundary, exit
+143), the elastic generation is bumped in the rendezvous store (fencing
+any old-generation straggler out of checkpoint writes), all stale per-rank
+state is scrubbed, and a new pod deploys with reassigned contiguous
+trainer ids and the shrunken ``PADDLE_TRAINERS_NUM``. Training scripts
+keep the GLOBAL batch constant by deriving their per-rank batch from
+``fleet.elastic.membership.scaled_per_rank_batch``. When capacity returns
+(the ``elastic.regrow`` chaos site, or a touch of the
+``PADDLE_ELASTIC_REGROW_PATH`` signal file), the job grows back the same
+way at the next checkpoint boundary — the graceful SIGTERM exit IS the
+boundary. Workers restore across world sizes via reshard-on-restore
+(``checkpoint.load_state_dict(reshard=True)``)."""
 import os
 import secrets
 import sys
 import time
 
 from ...framework.native import TCPStore
+from ...observability.metrics import registry as _registry
 from ...observability.watchdog import HangWatchdog, heartbeat_path
 from ...testing import chaos
 from ...utils.metrics_bus import counters
 from ..fleet.elastic import PREEMPTED_EXIT_CODE
+from ..fleet.elastic.fencing import GEN_STORE_KEY
+from ..fleet.elastic.membership import (
+    GENERATION_ENV,
+    LIVE_RANKS_ENV,
+    ORIG_WORLD_ENV,
+)
 from .context import Context
 from .job import Container, Pod
+
+#: signal file for returning capacity: touch it (or fire the
+#: ``elastic.regrow`` chaos site) and the watch loop grows the job back at
+#: the next checkpoint boundary. Exported to workers as
+#: PADDLE_ELASTIC_REGROW_PATH so a script (or an operator) can request the
+#: regrow from anywhere that sees the shared log dir.
+REGROW_SIGNAL = "elastic_regrow.signal"
 
 
 class CollectiveController:
@@ -33,6 +64,15 @@ class CollectiveController:
         # ranks publish in-memory snapshots here so restarted peers can
         # restore without touching durable storage
         self.snapshot_dir = os.path.join(self.telemetry_dir, "snapshots")
+        # elastic shrink/grow state (ISSUE 9)
+        self.generation = 0
+        self.world = None            # current world size (set by build_pod)
+        self.orig_world = None       # generation-0 world size
+        self.parked = 0              # permanently-lost slots awaiting regrow
+        self.reforms = 0
+        self.regrow_path = os.path.join(ctx.args.log_dir, REGROW_SIGNAL)
+        self._watchdog = None
+        self._pod = None  # the CURRENT generation's pod (re-forms rebind it)
 
     def _clean_stale_worker_state(self, rank=None):
         """Delete snapshot publications + heartbeat leftovers from a dead
@@ -46,10 +86,13 @@ class CollectiveController:
         from ..checkpoint import replica as _replica
 
         if rank is not None:
-            ranks = [rank]  # targeted restart scrub: that rank is dead
+            # targeted restart scrub (one dead rank), or a re-form's sweep
+            # of EVERY old-generation rank (iterable)
+            ranks = [rank] if isinstance(rank, int) else list(rank)
         else:
-            base = self.node_rank * self.ctx.nproc
-            ranks = range(base, base + self.ctx.nproc)
+            nproc = self.world if self.world is not None else self.ctx.nproc
+            base = self.node_rank * nproc
+            ranks = range(base, base + nproc)
         from ..checkpoint.atomic import sweep_orphan_tmps
 
         for r in ranks:
@@ -108,12 +151,19 @@ class CollectiveController:
             return "127.0.0.1"
 
     # ---- pod ----
-    def build_pod(self):
+    def build_pod(self, nproc=None):
+        """Build this node's worker pod at the CURRENT elastic generation.
+        ``nproc`` overrides the CLI worker count on re-forms (shrink/grow);
+        trainer ids are always assigned contiguously from the live world —
+        rank maps never have holes across generations."""
         args = self.ctx.args
-        nproc = self.ctx.nproc
+        nproc = self.ctx.nproc if nproc is None else int(nproc)
         nnodes = self.ctx.nnodes_min
         world = nproc * nnodes
-        pod = Pod(name=f"{args.job_id}-{self.node_rank}")
+        self.world = world
+        if self.orig_world is None:
+            self.orig_world = world
+        pod = Pod(name=f"{args.job_id}-{self.node_rank}-g{self.generation}")
         trainer_endpoints = ",".join(self.endpoints)
         # per-cluster PS/RPC pickle-auth secret (ADVICE: a source-public
         # authkey authenticates nobody). Rank 0 generates it once and shares
@@ -123,7 +173,8 @@ class CollectiveController:
         if not ps_authkey:
             if self.node_rank == 0:
                 ps_authkey = secrets.token_hex(16)
-                self.store.set("__ps_authkey__", ps_authkey)
+                if self.store is not None:
+                    self.store.set("__ps_authkey__", ps_authkey)
             else:
                 raw = self.store.get("__ps_authkey__")
                 ps_authkey = raw.decode() if isinstance(raw, bytes) else str(raw)
@@ -154,6 +205,17 @@ class CollectiveController:
                 # Harmless when snapshots are off — nothing writes there
                 # until a SnapshotRing/PeerReplicator is armed.
                 "PADDLE_CKPT_SNAPSHOT_DIR": self.snapshot_dir,
+                # elastic membership contract (ISSUE 9): the incarnation
+                # this worker belongs to (checkpoint writes fence on it),
+                # the live-rank set (membership.live_ranks — what step
+                # negotiation and peer discovery iterate instead of
+                # range(world)), the generation-0 world (batch rescaling
+                # keeps global batch / orig_world constant), and the
+                # regrow signal file
+                GENERATION_ENV: str(self.generation),
+                LIVE_RANKS_ENV: ",".join(str(r) for r in range(world)),
+                ORIG_WORLD_ENV: str(self.orig_world),
+                "PADDLE_ELASTIC_REGROW_PATH": self.regrow_path,
             }
             # observability contract: train loops heartbeat + stream spans
             # here (watchdog.maybe_beat / tracing autoconfigure). Exported
@@ -198,8 +260,19 @@ class CollectiveController:
             from ...observability.statusz import StatusServer
 
             os.makedirs(self.telemetry_dir, exist_ok=True)
-            statusz = StatusServer(port=statusz_port,
-                                   telemetry_dir=self.telemetry_dir).start()
+            statusz = StatusServer(
+                port=statusz_port, telemetry_dir=self.telemetry_dir,
+                # the launcher's LIVE elastic view (generation, world,
+                # parked capacity, re-form budget) — /statusz is how an
+                # operator sees which incarnation is actually running
+                elastic_info=lambda: {
+                    "generation": self.generation,
+                    "world_size": self.world,
+                    "orig_world": self.orig_world,
+                    "live_ranks": list(range(self.world or 0)),
+                    "parked": self.parked,
+                    "reforms": self.reforms,
+                }).start()
             print(f"[paddle_tpu.launch] statusz serving on "
                   f"http://127.0.0.1:{statusz.port}/statusz", file=sys.stderr)
         deadline = getattr(args, "hang_deadline", 0) or 0
@@ -215,13 +288,16 @@ class CollectiveController:
             watchdog = HangWatchdog(
                 self.telemetry_dir, deadline,
                 signal_stalled=_signal.SIGTERM if preempt else None,
+                generation=self.generation,
                 on_hang=lambda p: print(
                     f"[paddle_tpu.launch] rank heartbeat stalled past "
                     f"{deadline}s; diagnosis written to {p}", file=sys.stderr),
             ).start()
+        self._watchdog = watchdog
         try:
             return self._watch_loop(pod, args, total_restarts, total_budget)
         finally:
+            self._watchdog = None
             if watchdog is not None:
                 watchdog.stop()
             if statusz is not None:
@@ -233,14 +309,45 @@ class CollectiveController:
             failed = pod.failed_containers()
             if not failed and pod.finished():
                 return 0 if pod.success() else 1
+            # grow back (ISSUE 9): parked capacity has returned — re-form at
+            # the bigger world at the next checkpoint boundary (the graceful
+            # SIGTERM exit in _reform IS the boundary). Only from a healthy
+            # tick: a grow racing a crash would double-handle the failure.
+            if not failed and self.parked > 0 and args.elastic_level >= 2 \
+                    and self._can_reform(args) and self._regrow_requested():
+                grow = self.parked
+                pod = self._reform(pod, args, grow=grow, reason="regrow")
+                continue
             if failed:
                 preempted = [c for c in failed if c.exit_code == PREEMPTED_EXIT_CODE]
                 crashed = [c for c in failed if c.exit_code != PREEMPTED_EXIT_CODE]
+                # chaos 'elastic.host_loss': deterministically declare a
+                # crashed container's host permanently gone — the budget
+                # exhaustion below, without waiting out max_restart cycles
+                lost = []
+                for c in list(crashed):
+                    try:
+                        chaos.site("elastic.host_loss")
+                    except chaos.FaultInjected:
+                        lost.append(c)
+                        crashed.remove(c)
+                        _registry.counter("elastic.host_losses").inc()
                 if crashed and args.elastic_level < 1:
                     pod.terminate()
                     return 1
                 restartable = [c for c in crashed if c.restarts < args.max_restart]
-                if len(restartable) < len(crashed):
+                # restart budget exhausted = the host is effectively lost
+                lost += [c for c in crashed if c not in restartable]
+                if lost:
+                    if args.elastic_level >= 2 and self._can_reform(args) \
+                            and len(pod.containers) - len(lost) >= 1:
+                        # elastic SHRINK: re-form the job at the surviving
+                        # world size instead of aborting — the tentpole
+                        self.parked += len(lost)
+                        pod = self._reform(pod, args, lost=lost,
+                                           reason="shrink")
+                        continue
+                    counters.bump("fault.exhausted.launch_restart")
                     pod.terminate()
                     return 1
                 to_restart = restartable + preempted
@@ -265,21 +372,109 @@ class CollectiveController:
                     c.start()
             time.sleep(0.3)
 
+    # ---- elastic shrink/grow (ISSUE 9) ----------------------------------
+    def _can_reform(self, args):
+        """Single-node pods only (multi-node membership needs a cross-node
+        rendezvous round this controller doesn't own yet), and bounded by
+        --max_reforms so a flapping host still terminates the job."""
+        return self.ctx.nnodes_min == 1 and \
+            self.reforms < max(0, args.max_reforms)
+
+    def _regrow_requested(self):
+        """Capacity-returned signal: the ``elastic.regrow`` chaos site (for
+        deterministic tests) or a touch of the regrow signal file (for
+        operators / scripts). The file is consumed so one touch grows once."""
+        try:
+            chaos.site("elastic.regrow")
+        except chaos.FaultInjected:
+            return True
+        if os.path.exists(self.regrow_path):
+            try:
+                os.remove(self.regrow_path)
+            except OSError:
+                pass
+            return True
+        return False
+
+    def _reform(self, pod, args, lost=(), grow=0, reason="shrink"):
+        """Re-form the job at a new world size. Ordering is load-bearing:
+
+        1. gracefully stop survivors (SIGTERM = preemption notice: they
+           checkpoint at a step boundary and exit 143; SIGKILL after
+           --reform_grace) — their final checkpoints belong to the OLD
+           generation, so the fence must not exist yet;
+        2. bump the generation and publish it to the rendezvous store —
+           from here on, any straggler write from the old generation is
+           fenced (fleet.elastic.fencing);
+        3. scrub EVERY old rank's heartbeat/publication/store state — the
+           old rank numbering dies with the generation;
+        4. deploy a new pod with contiguous reassigned trainer ids at the
+           surviving (or regrown) world size. Workers resume from the
+           recovery ladder, resharding checkpoints across the world-size
+           change."""
+        old_world = len(pod.containers)
+        new_world = old_world - len(lost) + grow
+        self.reforms += 1
+        self.generation += 1
+        if grow:
+            self.parked -= grow
+        print(f"[paddle_tpu.launch] elastic {reason}: re-forming world "
+              f"{old_world} -> {new_world} (generation {self.generation}, "
+              f"reform {self.reforms}/{args.max_reforms})", file=sys.stderr)
+        grace = max(1.0, float(getattr(args, "reform_grace", 30.0) or 30.0))
+        # SIGTERM all survivors at once, ONE shared grace window: their
+        # boundary checkpoints run in parallel -> re-form latency is one
+        # grace, not n_survivors * grace
+        pod.graceful_stop(grace)  # SIGTERM -> boundary ckpt -> exit 143
+        pod.terminate()
+        # fence: published AFTER survivors exited (their boundary flush is
+        # wanted state), BEFORE the new generation deploys
+        if self.store is not None:
+            try:
+                self.store.set(GEN_STORE_KEY, str(self.generation))
+            except Exception:
+                counters.bump("fault.elastic.fence_publish_failed")
+        self._clean_stale_worker_state(range(old_world))
+        counters.bump(f"fault.elastic.{reason}")
+        if grow:
+            _registry.counter("elastic.regrows").inc()
+        else:
+            _registry.counter("elastic.shrinks").inc()
+        _registry.gauge("elastic.generation").set(self.generation)
+        _registry.gauge("elastic.world_size").set(new_world)
+        if self._watchdog is not None:
+            # heartbeats from the dead generation are invisible from here
+            self._watchdog.generation = self.generation
+        new_pod = self.build_pod(nproc=new_world)
+        # rebind BEFORE deploy: run()'s cleanup must always see the pod
+        # whose processes are actually alive (a KeyboardInterrupt after a
+        # re-form would otherwise terminate the dead old generation and
+        # orphan the new one)
+        self._pod = new_pod
+        new_pod.deploy()
+        return new_pod
+
     def run(self):
         self.build_store()
         self.rendezvous()
+        # publish generation 0 so worker fence checks resolve instantly
+        # (TCPStore.get on a missing key would block)
+        try:
+            self.store.set(GEN_STORE_KEY, str(self.generation))
+        except Exception:
+            counters.bump("fault.elastic.fence_publish_failed")
         # a reused log_dir may hold a DEAD incarnation's heartbeats and
         # snapshot publications; scrub before any worker can resolve them
         self._clean_stale_worker_state()
-        pod = self.build_pod()
+        self._pod = pod = self.build_pod()
         pod.deploy()
         try:
             rc = self.watch(pod)
         except KeyboardInterrupt:
-            pod.terminate()
+            self._pod.terminate()  # the CURRENT generation, not gen 0's
             rc = 130
         finally:
-            pod.terminate()
+            self._pod.terminate()
             if self.store is not None:
                 try:
                     self.store.barrier("teardown", self.ctx.nnodes_min, timeout=30)

@@ -77,6 +77,22 @@ class Pod:
     def success(self):
         return all(c.exit_code == 0 for c in self.containers)
 
+    def graceful_stop(self, grace=30.0):
+        """SIGTERM every live container AT ONCE, then wait them out under
+        ONE shared deadline (their boundary-checkpoint exits run in
+        parallel — sequential per-container grace would stack to
+        n*grace); SIGKILL whatever remains past the deadline."""
+        alive = [c for c in self.containers if c.alive()]
+        for c in alive:
+            c.proc.terminate()
+        t_end = time.time() + max(1.0, float(grace))
+        for c in alive:
+            try:
+                c.proc.wait(max(0.1, t_end - time.time()))
+            except subprocess.TimeoutExpired:
+                c.proc.kill()
+                c.proc.wait()
+
     def terminate(self):
         for c in self.containers:
             c.terminate()

@@ -67,12 +67,13 @@ class DistributedTrainStep(TrainStep):
 
     def __init__(self, model, loss_fn, optimizer, n_labels=1, scaler=None, mesh=None,
                  sharding_stage=1, batch_axes=("dcn_dp", "dp", "sharding"), metrics_bus=None,
-                 accumulate_steps=1):
+                 accumulate_steps=1, nonfinite_guard=None):
         self.mesh = mesh if mesh is not None else get_mesh()
         self.sharding_stage = sharding_stage
         self.batch_axes = batch_axes
         super().__init__(model, loss_fn, optimizer, n_labels=n_labels, scaler=scaler,
-                         metrics_bus=metrics_bus, accumulate_steps=accumulate_steps)
+                         metrics_bus=metrics_bus, accumulate_steps=accumulate_steps,
+                         nonfinite_guard=nonfinite_guard)
         self._place_state()
         # Tier-0 snapshot hook (distributed/checkpoint/tiers.py): detached by
         # default — the step path pays one attribute check
@@ -157,6 +158,14 @@ class DistributedTrainStep(TrainStep):
         )
         batch_sh = tuple(self._ns(self._batch_spec(b)) for b in batch_datas)
         return params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, batch_sh
+
+    def _nf_sharding(self):
+        """Replicated shardings for the non-finite sentinel counters (two
+        scalars), mirroring self._nf_state's pytree — None when the guard
+        is off."""
+        if self._nf_state is None:
+            return None
+        return {k: self._ns(P()) for k in self._nf_state}
 
     def _compile(self, step_fn):
         # deferred: in_shardings depend on batch shapes; compile lazily,
@@ -281,11 +290,12 @@ class DistributedTrainStep(TrainStep):
             with _tracing.span("train.step.compile_build"):
                 shardings = self._sharding_trees(batch_datas)
                 params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, batch_sh = shardings
+                nf_sh = self._nf_sharding()
                 jitted = _compilemem.ledgered_jit(
                     self._step_fn, key="train.step",
-                    in_shardings=(params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, self._ns(P()), self._ns(P()), batch_sh),
-                    out_shardings=(self._ns(P()), params_sh, buffers_sh, opt_sh, scaler_sh),
-                    donate_argnums=(0, 1, 3, 4),
+                    in_shardings=(params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, nf_sh, self._ns(P()), self._ns(P()), batch_sh),
+                    out_shardings=(self._ns(P()), params_sh, buffers_sh, opt_sh, scaler_sh, nf_sh),
+                    donate_argnums=(0, 1, 3, 4, 5),
                 )
                 self._jitted[sig] = jitted
                 _compilemem.ledger.note_cache_size(
@@ -304,8 +314,10 @@ class DistributedTrainStep(TrainStep):
                 # single-host TrainStep dispatch
                 try:
                     chaos.site("obs.oom")
-                    loss, new_params, new_buffers, self.opt_state, self._scaler_state = jitted(
-                        params, buffers, frozen, self.opt_state, self._scaler_state, lr,
+                    (loss, new_params, new_buffers, self.opt_state,
+                     self._scaler_state, self._nf_state) = jitted(
+                        params, buffers, frozen, self.opt_state,
+                        self._scaler_state, self._nf_state, lr,
                         prandom.next_key(), batch_datas
                     )
                 except Exception as e:
@@ -327,6 +339,7 @@ class DistributedTrainStep(TrainStep):
             # snapshot blocks only for the device→host copy
             self._maybe_snapshot(self.optimizer._global_step)
         _watchdog.maybe_beat(self.optimizer._global_step)
+        self._nf_check()
         if self.metrics_bus is not None:
             if self.metrics_bus.tokens_per_step is None and batch_datas:
                 import math
@@ -360,14 +373,16 @@ class DistributedTrainStep(TrainStep):
             if stacked:
                 batch_sh = tuple(
                     self._ns(P(None, *tuple(self._batch_spec(b)))) for b in inner)
+            nf_sh = self._nf_sharding()
             jitted = _compilemem.ledgered_jit(
                 self._multi_fn(n, stacked),
                 key=f"train.multi[n={n},stacked={stacked}]",
                 in_shardings=(params_sh, buffers_sh, frozen_sh, opt_sh,
-                              scaler_sh, self._ns(P()), self._ns(P()), batch_sh),
+                              scaler_sh, nf_sh, self._ns(P()), self._ns(P()),
+                              batch_sh),
                 out_shardings=(self._ns(P()), params_sh, buffers_sh, opt_sh,
-                               scaler_sh),
-                donate_argnums=(0, 1, 3, 4),
+                               scaler_sh, nf_sh),
+                donate_argnums=(0, 1, 3, 4, 5),
             )
             self._jitted[sig] = jitted
             _compilemem.ledger.note_cache_size(
@@ -383,8 +398,10 @@ class DistributedTrainStep(TrainStep):
             with self.mesh:
                 try:
                     chaos.site("obs.oom")
-                    losses, new_params, new_buffers, self.opt_state, self._scaler_state = jitted(
-                        params, buffers, frozen, self.opt_state, self._scaler_state, lr,
+                    (losses, new_params, new_buffers, self.opt_state,
+                     self._scaler_state, self._nf_state) = jitted(
+                        params, buffers, frozen, self.opt_state,
+                        self._scaler_state, self._nf_state, lr,
                         prandom.next_key(), batch_datas
                     )
                 except Exception as e:

@@ -17,7 +17,24 @@ from .observability import compilemem as _compilemem
 from .observability import goodput as _goodput
 from .observability import tracing as _tracing
 from .observability import watchdog as _watchdog
+from .observability.metrics import registry as _registry
 from .testing import chaos
+from .utils.envs import env_int as _env_int
+
+#: consecutive non-finite (NaN/Inf loss or grads) steps tolerated before
+#: the sentinel raises NonFiniteLossError; <= 0 disables the guard
+NONFINITE_TOLERANCE_ENV = "PADDLE_NONFINITE_TOLERANCE"
+#: host-side check cadence in dispatches (reading the device counters
+#: synchronizes on the step); default max(tolerance, 16)
+NONFINITE_CHECK_ENV = "PADDLE_NONFINITE_CHECK_EVERY"
+
+
+class NonFiniteLossError(FloatingPointError):
+    """The non-finite sentinel tripped: loss or gradients were NaN/Inf for
+    PADDLE_NONFINITE_TOLERANCE consecutive steps. Every one of those
+    updates was SKIPPED in-program (weights are uncorrupted) — but a model
+    that cannot produce a finite step anymore is not training, so the loop
+    is stopped instead of burning the rest of the job silently."""
 
 
 def jit(fn=None, static_argnums=None, donate_argnums=None, backend=None):
@@ -155,7 +172,7 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn, optimizer, n_labels=1, scaler=None, mesh_shardings=None,
-                 metrics_bus=None, accumulate_steps=1):
+                 metrics_bus=None, accumulate_steps=1, nonfinite_guard=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -175,6 +192,32 @@ class TrainStep:
         self._buffers = dict(model.named_buffers())
         self.opt_state = optimizer.init_state(self._trainable)
         self._scaler_state = scaler.init_state() if scaler is not None else None
+        # non-finite sentinel (ISSUE 9 satellite): an in-program guard
+        # skips the optimizer update when loss/grads go NaN/Inf — weights
+        # never absorb a poisoned step — and device-resident counters
+        # (consecutive + total skips) let the host raise after K
+        # consecutive skips instead of training garbage forever. Default
+        # ON without a scaler; with a DYNAMIC loss scaler the default is
+        # OFF — the scaler's warm-down legitimately produces runs of
+        # overflowed (skipped) steps while the scale adjusts, and killing
+        # those jobs would defeat the scaler (pass nonfinite_guard=True to
+        # arm it anyway, accepting that semantics).
+        # PADDLE_NONFINITE_TOLERANCE<=0 or nonfinite_guard=False disables
+        # it entirely (nf_state is None and the compiled program carries
+        # no counters).
+        self._nf_tolerance = _env_int(NONFINITE_TOLERANCE_ENV, 3)
+        nf_on = (nonfinite_guard if nonfinite_guard is not None
+                 else scaler is None) and self._nf_tolerance > 0
+        self._nf_state = {"consec": jnp.zeros((), jnp.int32),
+                          "total": jnp.zeros((), jnp.int32)} if nf_on else None
+        # reading the device counters synchronizes on the dispatch, so the
+        # host check is cadence-gated well above the tolerance; the consec
+        # counter is monotone WHILE stuck, so a model that stopped
+        # producing finite steps is still always caught at the next read
+        self._nf_check_every = max(1, _env_int(NONFINITE_CHECK_ENV,
+                                               max(self._nf_tolerance, 16)))
+        self._nf_reported = 0     # skips already counted to the registry
+        self._nf_since_check = 0  # dispatches since the last host read
         # first dispatch pays XLA compile: goodput attributes it to "init"
         self._dispatched = False
         # register with the hang watchdog BEFORE the first step: a rank that
@@ -215,7 +258,8 @@ class TrainStep:
             new_buffers = {k: t._data for k, t in buf_over.items()}
             return loss._data, grads, new_buffers
 
-        def step_fn(params, buffers, frozen, opt_state, scaler_state, lr, key, batch):
+        def step_fn(params, buffers, frozen, opt_state, scaler_state,
+                    nf_state, lr, key, batch):
             scale = scaler_state["scale"] if scaler is not None else None
             if acc == 1:
                 loss_data, grads, new_buffers = fwd_bwd(params, buffers, frozen, key, batch, scale)
@@ -266,12 +310,28 @@ class TrainStep:
 
             skip = None
             new_scaler_state = scaler_state
-            if scaler is not None:
-                finite = jnp.all(
+            finite_grads = None
+            if scaler is not None or nf_state is not None:
+                finite_grads = jnp.all(
                     jnp.stack([jnp.all(jnp.isfinite(g.astype(jnp.float32))) for g in grads.values()])
-                )
-                skip = ~finite
-                new_scaler_state = scaler.update_state(scaler_state, finite)
+                ) if grads else jnp.asarray(True)
+            if scaler is not None:
+                skip = ~finite_grads
+                new_scaler_state = scaler.update_state(scaler_state, finite_grads)
+            new_nf_state = nf_state
+            if nf_state is not None:
+                # non-finite sentinel: a NaN/Inf loss or gradient skips the
+                # whole update IN-PROGRAM (params, slots and opt step all
+                # hold), and the device-resident counters let the host
+                # detect a model that stopped producing finite steps
+                nf_skip = ~(finite_grads
+                            & jnp.all(jnp.isfinite(loss_data.astype(jnp.float32))))
+                skip = nf_skip if skip is None else (skip | nf_skip)
+                new_nf_state = {
+                    "consec": jnp.where(nf_skip, nf_state["consec"] + 1,
+                                        0).astype(jnp.int32),
+                    "total": nf_state["total"] + nf_skip.astype(jnp.int32),
+                }
 
             with jax.named_scope("optimizer"):
                 if opt._grad_clip is not None:
@@ -280,7 +340,8 @@ class TrainStep:
                     grads = {k: t._data for (k, _), (_, t) in zip(grads.items(), pg)}
 
                 new_params, new_opt_state = opt.apply_gradients(params, grads, opt_state, lr, skip_update=skip)
-            return loss_data, new_params, new_buffers, new_opt_state, new_scaler_state
+            return (loss_data, new_params, new_buffers, new_opt_state,
+                    new_scaler_state, new_nf_state)
 
         self._step_fn = step_fn
         self._compiled = self._compile(step_fn)
@@ -307,7 +368,7 @@ class TrainStep:
         # ONE logical program: recompiles mean the input signature
         # drifted, which is exactly what the churn detector watches
         return _compilemem.ledgered_jit(
-            step_fn, key="train.step", donate_argnums=(0, 1, 3, 4))
+            step_fn, key="train.step", donate_argnums=(0, 1, 3, 4, 5))
 
     def _multi_fn(self, n, stacked):
         """Pure n-steps-in-one-program function (lax.scan over the step
@@ -319,19 +380,21 @@ class TrainStep:
         batch (a different micro-batch per step)."""
         step_fn = self._step_fn
 
-        def multi_fn(params, buffers, frozen, opt_state, scaler_state, lr, key, batch):
+        def multi_fn(params, buffers, frozen, opt_state, scaler_state,
+                     nf_state, lr, key, batch):
             def body(carry, x):
-                p, b, o, s = carry
+                p, b, o, s, nf = carry
                 k, step_batch = (x, batch) if not stacked else x
-                loss, p2, b2, o2, s2 = step_fn(p, b, frozen, o, s, lr, k, step_batch)
-                return (p2, b2, o2, s2), loss
+                loss, p2, b2, o2, s2, nf2 = step_fn(
+                    p, b, frozen, o, s, nf, lr, k, step_batch)
+                return (p2, b2, o2, s2, nf2), loss
 
             keys = jax.random.split(key, n)
             xs = (keys, batch) if stacked else keys
-            (p, b, o, s), losses = jax.lax.scan(
-                body, (params, buffers, opt_state, scaler_state), xs
+            (p, b, o, s, nf), losses = jax.lax.scan(
+                body, (params, buffers, opt_state, scaler_state, nf_state), xs
             )
-            return losses, p, b, o, s
+            return losses, p, b, o, s, nf
 
         return multi_fn
 
@@ -341,7 +404,7 @@ class TrainStep:
         return _compilemem.ledgered_jit(
             self._multi_fn(n, stacked),
             key=f"train.multi[n={n},stacked={stacked}]",
-            donate_argnums=(0, 1, 3, 4))
+            donate_argnums=(0, 1, 3, 4, 5))
 
     def run_steps(self, *batch, n, stacked=False):
         """Run n optimizer steps in a single device dispatch. With
@@ -365,10 +428,11 @@ class TrainStep:
             self._check_stacked(batch_data, n)
         try:
             chaos.site("obs.oom")
-            losses, new_params, new_buffers, self.opt_state, self._scaler_state = (
+            (losses, new_params, new_buffers, self.opt_state,
+             self._scaler_state, self._nf_state) = (
                 self._compiled_multi[key](
                     params, buffers, frozen, self.opt_state, self._scaler_state,
-                    lr, prandom.next_key(), batch_data,
+                    self._nf_state, lr, prandom.next_key(), batch_data,
                 )
             )
         except Exception as e:
@@ -393,7 +457,43 @@ class TrainStep:
                 sched.step()
         self.optimizer._global_step += n
         _watchdog.maybe_beat(self.optimizer._global_step)
+        # one dispatch covered n steps — always worth the one host read
+        self._nf_check(force=True)
         return Tensor(losses)
+
+    def _nf_check(self, force=False):
+        """Host side of the non-finite sentinel: read the device-resident
+        skip counters every ``PADDLE_NONFINITE_CHECK_EVERY`` dispatches
+        (the read synchronizes on the step, so it is cadence-gated), bump
+        ``train.nonfinite_skips`` by the delta, and raise
+        :class:`NonFiniteLossError` once the CONSECUTIVE count reaches the
+        tolerance. The consecutive counter only grows while skipping, so a
+        stuck model is always detected within one cadence window; a
+        transient blip that recovers before the read was harmless by
+        construction (every skipped update left the weights untouched)."""
+        if self._nf_state is None:
+            return
+        self._nf_since_check += 1
+        if not force and self._nf_since_check < self._nf_check_every:
+            return
+        self._nf_since_check = 0
+        total = int(self._nf_state["total"])
+        consec = int(self._nf_state["consec"])
+        if total > self._nf_reported:
+            _registry.counter("train.nonfinite_skips").inc(
+                total - self._nf_reported)
+            self._nf_reported = total
+        if consec >= self._nf_tolerance:
+            from .utils.metrics_bus import counters as _counters
+
+            _counters.bump("fault.train.nonfinite_exhausted")
+            raise NonFiniteLossError(
+                f"loss/grads non-finite for {consec} consecutive steps "
+                f"(tolerance {self._nf_tolerance}, "
+                f"{total} skipped updates total, global step "
+                f"{self.optimizer._global_step}) — every skipped update "
+                f"left the weights uncorrupted; lower the LR / check the "
+                f"data, or raise {NONFINITE_TOLERANCE_ENV}")
 
     @staticmethod
     def _check_stacked(batch_data, n):
@@ -421,8 +521,11 @@ class TrainStep:
                 # deterministically for tests
                 try:
                     chaos.site("obs.oom")
-                    loss, new_params, new_buffers, self.opt_state, self._scaler_state = self._compiled(
-                        params, buffers, frozen, self.opt_state, self._scaler_state, lr, prandom.next_key(), batch_data
+                    (loss, new_params, new_buffers, self.opt_state,
+                     self._scaler_state, self._nf_state) = self._compiled(
+                        params, buffers, frozen, self.opt_state,
+                        self._scaler_state, self._nf_state, lr,
+                        prandom.next_key(), batch_data
                     )
                 except Exception as e:
                     _compilemem.maybe_oom_report(e, program="train.step")
@@ -439,6 +542,7 @@ class TrainStep:
             sched.step()
         self.optimizer._global_step += 1
         _watchdog.maybe_beat(self.optimizer._global_step)
+        self._nf_check()
         if self.metrics_bus is not None:
             if self.metrics_bus.tokens_per_step is None and batch_data:
                 import math

@@ -47,10 +47,14 @@ class StatusServer:
 
     def __init__(self, port=0, host="127.0.0.1", frontend=None,
                  telemetry_dir=None, heartbeat_stale_s=60.0,
-                 tracez_n=10):
+                 tracez_n=10, elastic_info=None):
         self.host = host
         self.port = int(port)
         self.frontend = frontend
+        # elastic membership provider (ISSUE 9): the launcher passes a
+        # callable with its live view (generation/world/parked); worker
+        # processes fall back to their env contract
+        self.elastic_info = elastic_info
         self.telemetry_dir = (telemetry_dir
                               or os.environ.get("PADDLE_TELEMETRY_DIR"))
         self.heartbeat_stale_s = float(heartbeat_stale_s)
@@ -78,6 +82,7 @@ class StatusServer:
                 "recent": len(request_trace.recent()),
             },
             "metrics": len(_registry.names()),
+            "elastic": self._elastic(),
         }
         fe = self.frontend
         if fe is not None:
@@ -85,6 +90,32 @@ class StatusServer:
                 out["serving"] = fe.serving_report()
             except Exception as e:  # a shut-down frontend must not 500
                 out["serving"] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def _elastic(self):
+        """Elastic membership view: the configured provider (launcher), or
+        this process's env contract (worker), or a fixed-width default."""
+        if self.elastic_info is not None:
+            try:
+                return self.elastic_info()
+            except Exception as e:
+                return {"error": f"{type(e).__name__}: {e}"}
+        # armored parses: /statusz must survive exactly the malformed env a
+        # misconfigured worker is being debugged FOR
+        from ..utils.envs import env_int
+
+        out = {
+            "generation": env_int("PADDLE_ELASTIC_GENERATION", 0),
+            "world_size": env_int("PADDLE_TRAINERS_NUM", 0) or None,
+            "live_ranks": None,
+        }
+        raw = os.environ.get("PADDLE_ELASTIC_RANKS")
+        if raw:
+            try:
+                out["live_ranks"] = [int(r) for r in raw.split(",")
+                                     if r.strip()]
+            except ValueError:
+                pass
         return out
 
     def varz(self):

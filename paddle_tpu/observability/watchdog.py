@@ -70,6 +70,9 @@ class Heartbeat:
         os.makedirs(directory, exist_ok=True)
         self.dir = directory
         self.rank = int(rank)
+        from ..utils.envs import env_int
+
+        self.generation = env_int("PADDLE_ELASTIC_GENERATION", 0)
         self.path = heartbeat_path(directory, self.rank)
         self._stack_f = None
         if install_faulthandler and hasattr(signal, "SIGUSR1"):
@@ -92,9 +95,10 @@ class Heartbeat:
 
     def beat(self, step=None, **extra):
         """Atomic heartbeat write (tmp + rename): the watchdog never reads a
-        torn json."""
+        torn json. Each beat is stamped with the elastic generation so a
+        re-formed job's watchdog can fence out old-incarnation stragglers."""
         rec = {"rank": self.rank, "pid": os.getpid(), "step": step,
-               "time": time.time()}
+               "time": time.time(), "generation": self.generation}
         if extra:
             rec.update(extra)
         tmp = self.path + ".tmp"
@@ -203,9 +207,14 @@ class HangWatchdog:
     def __init__(self, directory, deadline_s, interval_s=None, on_hang=None,
                  last_n_spans=32, signal_grace_s=0.75,
                  startup_deadline_s=None, signal_stalled=None,
-                 kill_grace_s=30.0):
+                 kill_grace_s=30.0, generation=0):
         self.dir = directory
         self.deadline_s = float(deadline_s)
+        # elastic generation fencing (ISSUE 9): the launcher bumps this on
+        # every shrink/grow re-form; heartbeats stamped by an OLDER
+        # generation are invisible — a straggler from a dead incarnation
+        # must not read as a live (or hung) rank of the new world
+        self.generation = int(generation)
         # ranks that have only init-beaten (step=None: still in rendezvous /
         # first compile) get a longer leash — first dispatches legitimately
         # take many times a steady-state step
@@ -286,9 +295,12 @@ class HangWatchdog:
                 continue
             try:
                 with open(os.path.join(self.dir, name)) as f:
-                    hbs[int(m.group(1))] = json.load(f)
+                    hb = json.load(f)
             except (OSError, ValueError):
                 continue  # racing a writer: next tick sees it
+            if int(hb.get("generation", self.generation)) < self.generation:
+                continue  # old-generation straggler: fenced out
+            hbs[int(m.group(1))] = hb
         return hbs
 
     def scan_once(self):
@@ -347,6 +359,7 @@ class HangWatchdog:
         report = {
             "detected_at": now,
             "deadline_s": self.deadline_s,
+            "generation": self.generation,
             "stalled_ranks": sorted(stalled),
             "stalled_for_s": {str(r): s for r, s in stalled.items()},
             "ranks": ranks,

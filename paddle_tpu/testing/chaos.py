@@ -37,6 +37,16 @@ ckpt.peer.publish         checkpoint/replica.py Tier-1 snapshot publication
 ckpt.peer.fetch           checkpoint/replica.py Tier-1 peer snapshot fetch
 save.write                serialization.save (single-process checkpoints)
 launch.watch              distributed/launch/controller.py watch tick
+elastic.host_loss         controller watch loop, probed once per crashed
+                          container: firing declares that container's host
+                          PERMANENTLY gone (restart budget exhausted
+                          deterministically) — under --elastic_level >= 2
+                          the job re-forms at the surviving world size
+elastic.regrow            controller watch loop capacity-return probe:
+                          firing simulates parked capacity coming back, so
+                          the shrink→grow path is testable without real
+                          hardware churn (production signal: touch the
+                          PADDLE_ELASTIC_REGROW_PATH file)
 dataloader.worker         io/dataloader.py forked worker, per batch
 serve.prefill             inference/continuous.py per-request prefill
 serve.decode              inference/continuous.py per decode dispatch

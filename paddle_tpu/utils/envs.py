@@ -1,0 +1,14 @@
+"""Tiny env-parsing helpers shared across the stack (no dependencies —
+importable from anywhere, including early-importing modules)."""
+import os
+
+__all__ = ["env_int"]
+
+
+def env_int(name, default):
+    """int(os.environ[name]) with ``default`` for unset/empty/garbage —
+    config knobs must never crash a process over a typo'd env var."""
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default

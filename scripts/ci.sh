@@ -241,6 +241,40 @@ if grep -nE 'open\(.*["'\''](w|wb|a|ab|x|xb|r\+|rb\+|w\+|wb\+|a\+|ab\+)["'\'']' 
   exit 1
 fi
 
+# elastic membership lint (ISSUE 9 satellite): checkpoint-package code must
+# never derive MEMBERSHIP from range(world_size) — after an elastic shrink,
+# a dead rank enumerated by range would be waited on (negotiation barriers)
+# or trusted (peer candidates) forever. Membership flows through
+# fleet.elastic.membership.live_ranks / the launcher-published live-rank
+# set; tag a deliberate exception with  # elastic-membership-ok
+python - <<'PY'
+import ast, glob, sys
+
+bad = []
+for path in sorted(glob.glob("paddle_tpu/distributed/checkpoint/*.py")):
+    src = open(path).read()
+    lines = src.splitlines()
+    for node in ast.walk(ast.parse(src)):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "range"):
+            continue
+        for arg in node.args:
+            name = (arg.id if isinstance(arg, ast.Name)
+                    else arg.attr if isinstance(arg, ast.Attribute)
+                    else None)
+            if name == "world_size" \
+                    and "elastic-membership-ok" not in lines[node.lineno - 1]:
+                bad.append((path, node.lineno, lines[node.lineno - 1].strip()))
+if bad:
+    for path, ln, text in bad:
+        print(f"{path}:{ln}: {text}")
+    print("lint: range(world_size) membership iteration in the checkpoint "
+          "package — enumerate fleet.elastic.membership.live_ranks() (the "
+          "negotiated live-rank set) instead", file=sys.stderr)
+    sys.exit(1)
+PY
+
 ARGS=(-q -p no:cacheprovider)
 
 # fast tier: the seams where an untested change does the most damage —
@@ -251,6 +285,7 @@ FAST_TESTS=(
   tests/test_chaos.py
   tests/test_telemetry.py
   tests/test_checkpoint_tiers.py
+  tests/test_elastic_reshard.py
   tests/test_launch.py
   tests/test_ps_mode.py
   tests/test_dist_checkpoint.py

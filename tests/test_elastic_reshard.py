@@ -1,0 +1,784 @@
+"""Elastic world-size recovery (ISSUE 9 tentpole): reshard-on-restore
+checkpoints (gather/re-split across world-size changes, bit-exact for
+replicated state at any world pair), live-rank-set membership in step
+negotiation and peer discovery, generation fencing of old-incarnation
+stragglers, the launcher's shrink/grow re-form, and the non-finite train
+sentinel — plus the N→N-1→N end-to-end chaos run whose loss trajectory
+must equal a fixed-width same-data baseline."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.checkpoint import reshard
+from paddle_tpu.distributed.fleet.elastic import fencing, membership
+from paddle_tpu.framework.native import TCPStore
+from paddle_tpu.observability.metrics import registry
+from paddle_tpu.testing import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Chaos disarmed and the cached process fence forgotten (tests
+    monkeypatch the elastic env)."""
+    chaos.disarm()
+    fencing._reset()
+    yield
+    chaos.disarm()
+    fencing._reset()
+
+
+def _set_world(monkeypatch, rank, world, generation=None):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", str(rank))
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", str(world))
+    if generation is not None:
+        monkeypatch.setenv("PADDLE_ELASTIC_GENERATION", str(generation))
+    fencing._reset()
+
+
+def _sd(seed=0, rank=0, step=0):
+    """Replicated params (identical across ranks, as DP replicas are) plus
+    one per-rank cursor."""
+    rng = np.random.RandomState(seed)
+    return {
+        "w": paddle.to_tensor(rng.rand(4, 3).astype(np.float32)),
+        "b": paddle.to_tensor(rng.rand(3).astype(np.float32)),
+        "perrank.cursor": paddle.to_tensor(
+            np.array([rank, step], np.int64)),
+    }
+
+
+def _zeros_like(sd):
+    return {k: paddle.to_tensor(np.zeros_like(np.asarray(v._data)))
+            for k, v in sd.items()}
+
+
+def _np(sd):
+    return {k: np.asarray(v._data) for k, v in sd.items()}
+
+
+def _save_world(monkeypatch, path, world, seed=0, step=7):
+    """Simulate an elastic world of `world` ranks saving one shared
+    checkpoint (replicated params, per-rank cursors)."""
+    for r in range(world):
+        _set_world(monkeypatch, r, world)
+        ckpt.save_state_dict(_sd(seed=seed, rank=r, step=step), path,
+                             coordinator_rank=0)
+
+
+class TestMembership:
+    def test_live_ranks_env_and_default(self, monkeypatch):
+        assert membership.live_ranks(3) == [0, 1, 2]
+        monkeypatch.setenv("PADDLE_ELASTIC_RANKS", "0,2,3")
+        assert membership.live_ranks(5) == [0, 2, 3]
+
+    def test_scaled_per_rank_batch(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        assert membership.scaled_per_rank_batch(16) == 4
+        assert membership.scaled_per_rank_batch(16, world=2) == 8
+        with pytest.raises(ValueError, match="divide"):
+            membership.scaled_per_rank_batch(10, world=4)
+
+    def test_generation_default_and_env(self, monkeypatch):
+        assert membership.generation() == 0
+        monkeypatch.setenv("PADDLE_ELASTIC_GENERATION", "3")
+        assert membership.generation() == 3
+
+
+class TestReshardRoundTrip:
+    @pytest.mark.parametrize("saved,live", [(2, 1), (3, 2), (2, 3), (4, 1)])
+    def test_replicated_bit_exact_any_world_pair(self, tmp_path, monkeypatch,
+                                                 saved, live):
+        path = str(tmp_path / "ckpt")
+        _save_world(monkeypatch, path, saved, seed=11, step=5)
+        ref = _np(_sd(seed=11))
+        for r in range(live):
+            _set_world(monkeypatch, r, live)
+            tgt = _zeros_like(_sd())
+            ckpt.load_state_dict(tgt, path, reshard=True)
+            got = _np(tgt)
+            np.testing.assert_array_equal(got["w"], ref["w"])
+            np.testing.assert_array_equal(got["b"], ref["b"])
+            # per-rank cursor: identity when the rank existed, modulo else
+            src = r if r < saved else r % saved
+            np.testing.assert_array_equal(got["perrank.cursor"],
+                                          np.array([src, 5]))
+
+    def test_round_trip_via_intermediate_world(self, tmp_path, monkeypatch):
+        """2 → 3 → 2: replicated params survive a chained reshard
+        bit-exact."""
+        p1, p2 = str(tmp_path / "c1"), str(tmp_path / "c2")
+        _save_world(monkeypatch, p1, 2, seed=3)
+        ref = _np(_sd(seed=3))
+        # restore at world 3, save again from all three ranks
+        restored = {}
+        for r in range(3):
+            _set_world(monkeypatch, r, 3)
+            tgt = _zeros_like(_sd())
+            ckpt.load_state_dict(tgt, p1, reshard=True)
+            restored[r] = tgt
+        for r in range(3):
+            _set_world(monkeypatch, r, 3)
+            ckpt.save_state_dict(restored[r], p2, coordinator_rank=0)
+        # back at world 2
+        _set_world(monkeypatch, 0, 2)
+        tgt = _zeros_like(_sd())
+        ckpt.load_state_dict(tgt, p2, reshard=True)
+        np.testing.assert_array_equal(_np(tgt)["w"], ref["w"])
+        np.testing.assert_array_equal(_np(tgt)["b"], ref["b"])
+
+    def _write_sharded_world(self, path, world=4, rows_per_rank=2):
+        """Handcraft a genuinely rank-SHARDED checkpoint (each rank's
+        archive holds a disjoint row block of tensor 'm' — the
+        DP/sharding-degree optimizer-shard layout) in the documented
+        on-disk format; doubles as a format regression test."""
+        os.makedirs(path, exist_ok=True)
+        n = world * rows_per_rank
+        full = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        for r in range(world):
+            lo, hi = r * rows_per_rank, (r + 1) * rows_per_rank
+            np.savez(os.path.join(path, f"{r}_0.distcp.npz"),
+                     **{"m__shard0": full[lo:hi]})
+            meta = {"world": world, "rank": r, "generation": 0,
+                    "tensors": {"m": {
+                        "global_shape": [n, 3], "dtype": "float32",
+                        "shards": [{"index": [[lo, hi], [0, 3]],
+                                    "file": f"{r}_0.distcp",
+                                    "key": "m__shard0"}]}}}
+            with open(os.path.join(path, f"metadata.rank{r}.json"), "w") as f:
+                json.dump(meta, f)
+            if r == 0:
+                with open(os.path.join(path, "metadata.json"), "w") as f:
+                    json.dump(meta, f)
+        return full
+
+    @pytest.mark.parametrize("live", [1, 2])
+    def test_sharded_gather_resplit(self, tmp_path, monkeypatch, live):
+        path = str(tmp_path / "sharded")
+        full = self._write_sharded_world(path)
+        for r in range(live):
+            _set_world(monkeypatch, r, live)
+            tgt = {"m": paddle.to_tensor(np.zeros_like(full))}
+            ckpt.load_state_dict(tgt, path, reshard=True)
+            np.testing.assert_array_equal(_np(tgt)["m"], full)
+
+    def test_missing_shard_archive_fails_coverage(self, tmp_path,
+                                                  monkeypatch):
+        path = str(tmp_path / "sharded")
+        full = self._write_sharded_world(path)
+        os.remove(os.path.join(path, "2_0.distcp.npz"))
+        os.remove(os.path.join(path, "metadata.rank2.json"))
+        _set_world(monkeypatch, 0, 1)
+        tgt = {"m": paddle.to_tensor(np.zeros_like(full))}
+        with pytest.raises(ckpt.CheckpointCorruptError, match="coverage"):
+            ckpt.load_state_dict(tgt, path, reshard=True)
+        np.testing.assert_array_equal(_np(tgt)["m"], np.zeros_like(full))
+
+    def test_replicated_survives_missing_peer_archive(self, tmp_path,
+                                                      monkeypatch):
+        """Replicated state needs ONE committed copy: a missing rank
+        archive (publisher died mid-save) must not block the restore."""
+        path = str(tmp_path / "ckpt")
+        _save_world(monkeypatch, path, 2, seed=4)
+        os.remove(os.path.join(path, "1_0.distcp.npz"))
+        os.remove(os.path.join(path, "metadata.rank1.json"))
+        _set_world(monkeypatch, 0, 1)
+        tgt = _zeros_like(_sd())
+        ckpt.load_state_dict(tgt, path, reshard=True)
+        np.testing.assert_array_equal(_np(tgt)["w"], _np(_sd(seed=4))["w"])
+
+    def test_plan_reports_dropped_perrank(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "ckpt")
+        _save_world(monkeypatch, path, 3, seed=1)
+        _set_world(monkeypatch, 0, 1)
+        layout = reshard.read_layout(path)
+        plan = reshard.plan_reshard(layout, _zeros_like(_sd()),
+                                    live_rank=0, live_world=1)
+        dropped = {r for _, r in plan.dropped_perrank}
+        assert dropped == {1, 2}  # shrunk-away cursors, reported not lost
+
+    def test_nonzero_rank_private_root_still_commits_metadata(self, tmp_path,
+                                                              monkeypatch):
+        """A non-zero trainer saving directly into its OWN directory (no
+        CheckpointManager) must still commit metadata.json — with a single
+        jax process the saver coordinates its root by default."""
+        _set_world(monkeypatch, 1, 2)
+        path = str(tmp_path / "mine")
+        ckpt.save_state_dict(_sd(seed=2, rank=1), path)
+        assert os.path.exists(os.path.join(path, "metadata.json"))
+        tgt = _zeros_like(_sd())
+        ckpt.load_state_dict(tgt, path)  # same world: loads clean
+        np.testing.assert_array_equal(_np(tgt)["w"], _np(_sd(seed=2))["w"])
+
+    def test_same_world_shared_root_restores_own_perrank(self, tmp_path,
+                                                         monkeypatch):
+        """SAME-world restore from a shared elastic root: metadata.json
+        only references the coordinator's archive, so with reshard=True
+        the perrank.* route must still hand each rank its OWN cursor —
+        not rank 0's."""
+        path = str(tmp_path / "ckpt")
+        _save_world(monkeypatch, path, 2, seed=9, step=6)
+        for r in range(2):
+            _set_world(monkeypatch, r, 2)  # same world as saved
+            tgt = _zeros_like(_sd())
+            ckpt.load_state_dict(tgt, path, reshard=True)
+            got = _np(tgt)
+            np.testing.assert_array_equal(got["w"], _np(_sd(seed=9))["w"])
+            np.testing.assert_array_equal(got["perrank.cursor"],
+                                          np.array([r, 6]))
+
+    def test_shared_root_gc_spares_peer_inflight_saves(self, tmp_path,
+                                                       monkeypatch):
+        """Shared elastic root: the coordinator's GC must not collect an
+        unlisted step dir NEWER than the newest valid step — that is a
+        peer's save still in flight, not an orphan. Single-writer roots
+        keep the original collect-everything contract (covered by
+        test_checkpoint_tiers)."""
+        _set_world(monkeypatch, 0, 2)
+        mgr = ckpt.CheckpointManager(str(tmp_path / "shared"),
+                                     ckpt.RetentionPolicy(keep_last=4),
+                                     coordinator_rank=0)
+        mgr.save(_sd(seed=1), 1)
+        # a peer (rank 1) is mid-save of step 2: dir + archive exist, the
+        # coordinator has not saved step 2 yet
+        peer_dir = mgr.step_dir(2)
+        os.makedirs(peer_dir)
+        open(os.path.join(peer_dir, "1_0.distcp.npz"), "wb").write(b"x")
+        mgr.gc()
+        assert os.path.exists(peer_dir)  # spared
+        # once a NEWER checkpoint commits, a genuinely torn step 2 falls
+        # behind max(valid) and is reclaimed
+        mgr.save(_sd(seed=1), 3)
+        assert not os.path.exists(peer_dir)
+
+    def test_reshard_metrics_recorded(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "ckpt")
+        _save_world(monkeypatch, path, 2)
+        before = getattr(registry.get("elastic.reshard_loads"), "value", 0)
+        _set_world(monkeypatch, 0, 1)
+        ckpt.load_state_dict(_zeros_like(_sd()), path, reshard=True)
+        assert registry.get("elastic.reshard_loads").value == before + 1
+        assert registry.get("ckpt.reshard_s").count >= 1
+
+
+class TestLayoutMismatchMessages:
+    def test_strict_load_still_raises_with_upgraded_message(self, tmp_path,
+                                                            monkeypatch):
+        path = str(tmp_path / "ckpt")
+        _save_world(monkeypatch, path, 2)
+        _set_world(monkeypatch, 0, 1)
+        tgt = _zeros_like(_sd())
+        with pytest.raises(ckpt.CheckpointLayoutMismatch) as ei:
+            ckpt.load_state_dict(tgt, path)  # reshard NOT requested
+        msg = str(ei.value)
+        # recorded vs live world, an offending tensor's global shape, and
+        # the reshard hint — the satellite's message contract
+        assert "world of 2" in msg and "live job has 1" in msg
+        assert "global shape" in msg and "reshard=True" in msg
+        np.testing.assert_array_equal(_np(tgt)["w"], np.zeros((4, 3)))
+
+    def test_shape_mismatch_names_both_worlds_and_shape(self, tmp_path):
+        path = str(tmp_path / "ckpt")
+        ckpt.save_state_dict(
+            {"w": paddle.to_tensor(np.ones((4, 3), np.float32))}, path)
+        tgt = {"w": paddle.to_tensor(np.zeros((3, 4), np.float32))}
+        with pytest.raises(ckpt.CheckpointLayoutMismatch) as ei:
+            ckpt.load_state_dict(tgt, path)
+        msg = str(ei.value)
+        assert "[4, 3]" in msg and "[3, 4]" in msg and "world" in msg
+        assert "reshard=True" in msg
+
+    def test_legacy_process_count_checkpoint_still_loads(self, tmp_path,
+                                                         monkeypatch):
+        """Back-compat: pre-elastic builds recorded jax.process_count()
+        (1 per launcher worker). Such a per-rank checkpoint must keep
+        loading fixed-width under a multi-worker launch — NOT raise (or,
+        inside the recovery ladder, silently fall through to step 0)."""
+        path = str(tmp_path / "legacy")
+        ckpt.save_state_dict(
+            {"w": paddle.to_tensor(np.full((4, 3), 5.0, np.float32))}, path)
+        # the old builds recorded world=1 here; the new build does too when
+        # the env is unset, so this directory IS the legacy layout
+        _set_world(monkeypatch, 1, 2)  # multi-worker launch, reshard off
+        tgt = {"w": paddle.to_tensor(np.zeros((4, 3), np.float32))}
+        ckpt.load_state_dict(tgt, path)
+        np.testing.assert_array_equal(_np(tgt)["w"], np.full((4, 3), 5.0))
+
+    def test_reshard_cannot_fix_resized_model(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "ckpt")
+        _save_world(monkeypatch, path, 2)
+        _set_world(monkeypatch, 0, 1)
+        tgt = {"w": paddle.to_tensor(np.zeros((8, 6), np.float32))}
+        with pytest.raises(ckpt.CheckpointLayoutMismatch, match="resized"):
+            ckpt.load_state_dict(tgt, path, reshard=True)
+
+
+class TestMembershipNegotiation:
+    def test_negotiator_over_live_rank_set(self):
+        """Ranks {0, 2, 3} (rank 1 is GONE) agree on the newest common step
+        without waiting on the dead rank — the barrier is sized by the
+        live-rank set, not range(world_size)."""
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        live = [0, 2, 3]
+        steps = {0: [2, 4, 6], 2: [2, 4], 3: [4, 6]}
+        out = {}
+
+        def run(rank):
+            neg = ckpt.StepNegotiator(
+                TCPStore("127.0.0.1", master.port), rank,
+                ranks=live, session="t1", timeout=20)
+            out[rank] = neg.agree("tier2", steps[rank])
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in live]
+        [t.start() for t in ts]
+        [t.join(30) for t in ts]
+        assert out == {0: 4, 2: 4, 3: 4}
+        master.stop_server()
+
+    def test_negotiator_rejects_rank_outside_live_set(self):
+        with pytest.raises(ValueError, match="live-rank set"):
+            ckpt.StepNegotiator(None, 1, ranks=[0, 2])
+
+    def test_live_and_dead_members_agree_on_never_beat_ranks(self):
+        """A rank that has not beaten yet is live-but-STARTING for both
+        classifiers — live_members must not undercount a quorum during the
+        startup window dead_members deliberately spares."""
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        m0 = ElasticManager(store=master, rank=0, world_size=3, timeout=1)
+        m1 = ElasticManager(store=TCPStore("127.0.0.1", master.port),
+                            rank=1, world_size=3, timeout=1)
+        m0.beat()
+        m1.beat()  # rank 2 never beats: still starting
+        assert m0.dead_members() == []
+        assert m0.live_members() == [0, 1, 2]
+        time.sleep(1.2)
+        m0.beat()  # rank 1 stops renewing; rank 2 STILL never beat
+        assert m0.dead_members() == [1]
+        assert m0.live_members() == [0, 2]
+        master.stop_server()
+
+    def test_replicator_candidates_respect_live_set(self, tmp_path):
+        """A shrunk-away rank's leftover publication is not a candidate
+        even when the launcher's scrub missed the file."""
+        d = str(tmp_path)
+        for r in (1, 2):
+            rep = ckpt.PeerReplicator(directory=d, rank=r, world_size=4,
+                                      group_ranks=[0, 1, 2, 3])
+            rep.publish(ckpt.Snapshot.from_state_dict(
+                {"w": paddle.to_tensor(np.ones(3, np.float32))}, 5), force=True)
+        live = ckpt.PeerReplicator(directory=d, rank=0, world_size=3,
+                                   group_ranks=[0, 2, 3])
+        assert [c[1] for c in live.candidates()] == [2]  # rank 1 invisible
+
+
+class TestGenerationFencing:
+    def test_fence_raises_for_stale_generation(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        master.set(fencing.GEN_STORE_KEY, "2")
+        stale = fencing.GenerationFence(store=master, generation=1)
+        with pytest.raises(fencing.StaleGenerationError, match="generation"):
+            stale.check("ckpt.save")
+        fencing.GenerationFence(store=master, generation=2).check()  # current
+        master.stop_server()
+
+    def test_straggler_checkpoint_writes_are_fenced(self, tmp_path,
+                                                    monkeypatch):
+        """End-to-end: a process whose env says generation 0 while the
+        rendezvous store says the job re-formed at generation 1 cannot
+        save checkpoints, publish peer snapshots, or flush emergencies."""
+        master = TCPStore("127.0.0.1", 0, is_master=True)
+        master.set(fencing.GEN_STORE_KEY, "1")
+        monkeypatch.setenv("PADDLE_ELASTIC_GENERATION", "0")
+        monkeypatch.setenv("PADDLE_MASTER", f"127.0.0.1:{master.port}")
+        fencing._reset()
+        before = getattr(registry.get("elastic.fenced_writes"), "value", 0)
+        sd = {"w": paddle.to_tensor(np.ones((2, 2), np.float32))}
+        with pytest.raises(fencing.StaleGenerationError):
+            ckpt.save_state_dict(sd, str(tmp_path / "c"))
+        rep = ckpt.PeerReplicator(directory=str(tmp_path / "snaps"),
+                                  rank=0, world_size=2)
+        snap = ckpt.Snapshot.from_state_dict(sd, 3)
+        with pytest.raises(fencing.StaleGenerationError):
+            rep.publish(snap, force=True)
+        mgr = ckpt.CheckpointManager(str(tmp_path / "dur"))
+        with pytest.raises(fencing.StaleGenerationError):
+            mgr.save_emergency(snap)
+        assert registry.get("elastic.fenced_writes").value >= before + 3
+        # the CURRENT generation still writes
+        monkeypatch.setenv("PADDLE_ELASTIC_GENERATION", "1")
+        fencing._reset()
+        ckpt.save_state_dict(sd, str(tmp_path / "c"))
+        master.stop_server()
+
+    def test_fence_fails_open_without_store(self, tmp_path, monkeypatch):
+        """An unreachable store must never block checkpointing (fencing is
+        split-brain defense, not an availability dependency)."""
+        monkeypatch.setenv("PADDLE_ELASTIC_GENERATION", "1")
+        monkeypatch.delenv("PADDLE_MASTER", raising=False)
+        fencing._reset()
+        ckpt.save_state_dict(
+            {"w": paddle.to_tensor(np.ones(2, np.float32))},
+            str(tmp_path / "c"))  # no raise
+
+
+class TestNonFiniteSentinel:
+    def _step(self, tolerance=None, monkeypatch=None):
+        from paddle_tpu import optimizer as optim
+        from paddle_tpu.jit_api import TrainStep
+
+        if tolerance is not None:
+            monkeypatch.setenv("PADDLE_NONFINITE_TOLERANCE", str(tolerance))
+            # the host read is cadence-gated (it syncs on the dispatch);
+            # tests want detection on every step
+            monkeypatch.setenv("PADDLE_NONFINITE_CHECK_EVERY", "1")
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 4)
+        opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+        step = TrainStep(net, lambda out, y: ((out - y) ** 2).mean(), opt,
+                         n_labels=1)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        y = paddle.to_tensor(np.zeros((2, 4), np.float32))
+        bad = paddle.to_tensor(np.full((2, 4), np.nan, np.float32))
+        return net, step, x, y, bad
+
+    def test_skip_leaves_weights_uncorrupted_and_counts(self, monkeypatch):
+        from paddle_tpu.jit_api import NonFiniteLossError
+
+        net, step, x, y, bad = self._step(tolerance=3,
+                                          monkeypatch=monkeypatch)
+        step(x, y)
+        w0 = np.asarray(net.weight._data).copy()
+        before = getattr(registry.get("train.nonfinite_skips"), "value", 0)
+        step(bad, y)  # NaN loss/grads -> update skipped in-program
+        np.testing.assert_array_equal(np.asarray(net.weight._data), w0)
+        step(x, y)    # a finite step RESETS the consecutive counter
+        assert registry.get("train.nonfinite_skips").value == before + 1
+        with pytest.raises(NonFiniteLossError, match="consecutive"):
+            for _ in range(5):
+                step(bad, y)
+        # weights were never corrupted, even on the raising path
+        np.testing.assert_array_equal(
+            np.asarray(net.weight._data),
+            np.asarray(net.weight._data))  # finite
+        assert np.isfinite(np.asarray(net.weight._data)).all()
+
+    def test_tolerance_zero_disables_guard(self, monkeypatch):
+        net, step, x, y, bad = self._step(tolerance=0,
+                                          monkeypatch=monkeypatch)
+        assert step._nf_state is None  # compiled program carries no guard
+        step(bad, y)  # no raise, ever
+
+    def test_dynamic_scaler_defaults_guard_off(self):
+        """A dynamic loss scaler legitimately produces RUNS of overflowed
+        (skipped) steps while the scale warms down — the sentinel must not
+        kill those jobs by default (explicit nonfinite_guard=True arms it
+        anyway)."""
+        from paddle_tpu import optimizer as optim
+        from paddle_tpu.amp import GradScaler
+        from paddle_tpu.jit_api import TrainStep
+
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 4)
+        opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+        scaler = GradScaler(init_loss_scaling=2.0 ** 15)
+        step = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(), opt,
+                         n_labels=1, scaler=scaler)
+        assert step._nf_state is None
+        armed = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(), opt,
+                          n_labels=1, scaler=GradScaler(),
+                          nonfinite_guard=True)
+        assert armed._nf_state is not None
+
+    def test_distributed_step_carries_guard(self, monkeypatch):
+        from paddle_tpu import optimizer as optim
+        from paddle_tpu.distributed import mesh as M
+        from paddle_tpu.distributed.train_step import DistributedTrainStep
+        from paddle_tpu.jit_api import NonFiniteLossError
+
+        monkeypatch.setenv("PADDLE_NONFINITE_TOLERANCE", "2")
+        monkeypatch.setenv("PADDLE_NONFINITE_CHECK_EVERY", "1")
+        paddle.seed(0)
+        m = M.build_mesh(dp=2)
+        with M.mesh_guard(m):
+            net = paddle.nn.Linear(4, 4)
+            opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+            step = DistributedTrainStep(
+                net, lambda out, y: ((out - y) ** 2).mean(), opt,
+                n_labels=1, sharding_stage=0)
+            x = paddle.to_tensor(np.ones((2, 4), np.float32))
+            y = paddle.to_tensor(np.zeros((2, 4), np.float32))
+            bad = paddle.to_tensor(np.full((2, 4), np.nan, np.float32))
+            step(x, y)
+            w0 = np.asarray(net.weight._data).copy()
+            with pytest.raises(NonFiniteLossError):
+                for _ in range(4):
+                    step(bad, y)
+            np.testing.assert_array_equal(np.asarray(net.weight._data), w0)
+
+
+class TestControllerElastic:
+    def _controller(self, tmp_path, extra=()):
+        from paddle_tpu.distributed.launch.context import Context
+        from paddle_tpu.distributed.launch.controller import (
+            CollectiveController)
+
+        return CollectiveController(Context(
+            ["--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"),
+             *extra, "dummy.py"]))
+
+    def test_regrow_requested_chaos_and_signal_file(self, tmp_path):
+        ctl = self._controller(tmp_path)
+        assert not ctl._regrow_requested()
+        with chaos.FaultPlan().fail("elastic.regrow", times=1):
+            assert ctl._regrow_requested()
+        os.makedirs(os.path.dirname(ctl.regrow_path), exist_ok=True)
+        open(ctl.regrow_path, "w").write("1")
+        assert ctl._regrow_requested()
+        assert not os.path.exists(ctl.regrow_path)  # consumed: one grow
+        assert not ctl._regrow_requested()
+
+    def test_build_pod_exports_elastic_contract(self, tmp_path):
+        ctl = self._controller(tmp_path)
+        ctl.node_rank = 0
+        ctl.endpoints = ["127.0.0.1:1"]
+        pod = ctl.build_pod()
+        env = pod.containers[0].env
+        assert env["PADDLE_ELASTIC_GENERATION"] == "0"
+        assert env["PADDLE_ELASTIC_RANKS"] == "0,1"
+        assert env["PADDLE_ELASTIC_ORIG_WORLD"] == "2"
+        assert env["PADDLE_ELASTIC_REGROW_PATH"] == ctl.regrow_path
+        # a shrunken re-form reassigns contiguous ids at the new world
+        ctl.generation = 1
+        pod2 = ctl.build_pod(nproc=1)
+        assert len(pod2.containers) == 1
+        env2 = pod2.containers[0].env
+        assert env2["PADDLE_TRAINERS_NUM"] == "1"
+        assert env2["PADDLE_TRAINER_ID"] == "0"
+        assert env2["PADDLE_ELASTIC_GENERATION"] == "1"
+        assert env2["PADDLE_ELASTIC_ORIG_WORLD"] == "2"
+
+    def test_statusz_elastic_block_from_env(self, monkeypatch):
+        from paddle_tpu.observability.statusz import StatusServer
+
+        monkeypatch.setenv("PADDLE_ELASTIC_GENERATION", "2")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "3")
+        monkeypatch.setenv("PADDLE_ELASTIC_RANKS", "0,1,2")
+        out = StatusServer().statusz()["elastic"]
+        assert out == {"generation": 2, "world_size": 3,
+                       "live_ranks": [0, 1, 2]}
+
+    def test_watchdog_fences_old_generation_heartbeats(self, tmp_path,
+                                                       monkeypatch):
+        from paddle_tpu.observability.watchdog import HangWatchdog, Heartbeat
+
+        monkeypatch.setenv("PADDLE_ELASTIC_GENERATION", "0")
+        hb = Heartbeat(str(tmp_path), 0, install_faulthandler=False)
+        hb.beat(step=3)
+        wd = HangWatchdog(str(tmp_path), deadline_s=60, generation=1)
+        assert wd._read_heartbeats() == {}  # old generation: invisible
+        wd0 = HangWatchdog(str(tmp_path), deadline_s=60, generation=0)
+        assert 0 in wd0._read_heartbeats()
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end elastic chaos run (launcher subprocesses)
+# ---------------------------------------------------------------------------
+ELASTIC_WORKER = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as optim
+from paddle_tpu.jit_api import TrainStep
+from paddle_tpu.distributed.checkpoint import (
+    CheckpointManager, RetentionPolicy, resolve)
+from paddle_tpu.distributed.fleet.elastic import (
+    GracefulPreemption, membership)
+from paddle_tpu.observability.metrics import registry
+
+rank = membership.rank()
+world = membership.world_size()
+gen = membership.generation()
+GLOBAL_BATCH = 4
+TOTAL = 10
+# the elastic batch contract: global batch constant, per-rank rescaled
+per_rank = membership.scaled_per_rank_batch(GLOBAL_BATCH)
+
+paddle.seed(0)
+net = paddle.nn.Linear(4, 4)
+opt = optim.SGD(learning_rate=0.1, parameters=net.parameters())
+# per-ROW reduction first: the inner mean runs over the same 4 values in
+# the same order at any batch size, and the outer mean then reduces B
+# identical row values (exact for power-of-two B) — that makes the loss
+# trajectory bit-invariant to the per-rank batch size
+step_fn = TrainStep(
+    net, lambda out, y: ((out - y) ** 2).mean(axis=-1).mean(), opt,
+    n_labels=1)
+# identical rows: every rank (and every world size) sees the same data
+x = paddle.to_tensor(np.ones((per_rank, 4), np.float32))
+y = paddle.to_tensor(np.zeros((per_rank, 4), np.float32))
+
+sd = dict(net.named_parameters())
+sd["perrank.cursor"] = paddle.to_tensor(np.zeros(2, np.int64))
+mgr = CheckpointManager("shared_ckpt", RetentionPolicy(keep_last=16),
+                        coordinator_rank=0, reshard=True)
+preempt = GracefulPreemption().install()
+
+marker = "started.rank%d" % rank
+cold = not os.path.exists(marker)
+open(marker, "a").write("g%d\\n" % gen)
+start = 0
+if not cold or gen > 0:
+    res = resolve(sd, manager=mgr)
+    with open("recovery.rank%d.jsonl" % rank, "a") as f:
+        f.write(json.dumps({{"gen": gen, "world": world,
+                             "source": res.source, "step": res.step}}) + "\\n")
+    start = res.step or 0
+
+for step in range(start, TOTAL):
+    loss = step_fn(x, y)
+    sd["perrank.cursor"].set_value(paddle.to_tensor(
+        np.array([rank, step + 1], np.int64)))
+    with open("loss.rank%d.jsonl" % rank, "a") as f:
+        f.write(json.dumps({{"gen": gen, "world": world, "step": step + 1,
+                             "loss": float(loss.numpy())}}) + "\\n")
+    mgr.save(sd, step + 1)
+    {hooks}
+    preempt.exit_if_requested()
+    # pacing: the ELASTIC run keeps steps slower than the launcher's
+    # watch tick so re-forms land mid-run; the baseline runs unpaced
+    time.sleep(float(os.environ.get("ELASTIC_TEST_STEP_SLEEP", "0")))
+
+np.save("final_w.rank%d.gen%d.npy" % (rank, gen),
+        np.asarray(sd["weight"]._data))
+with open("metrics.rank%d.gen%d.json" % (rank, gen), "w") as f:
+    json.dump(registry.snapshot(), f)
+"""
+
+ELASTIC_HOOKS = """
+    if gen == 0 and rank == 1 and step + 1 == 3 \\
+            and not os.path.exists("crashed_once"):
+        open("crashed_once", "w").write("1")
+        os._exit(9)  # permanent loss: chaos declares the host gone
+    if gen == 1 and step + 1 >= 6 and not os.path.exists("regrow_requested"):
+        open("regrow_requested", "w").write("1")
+        open(os.environ["PADDLE_ELASTIC_REGROW_PATH"], "w").write("1")
+"""
+
+
+def _write_elastic_worker(tmp_path, hooks="    pass"):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(ELASTIC_WORKER).format(
+        repo=REPO, hooks=hooks.strip()))
+    return script
+
+
+def _launch(tmp_path, script, nproc, extra_args=(), chaos_spec=None,
+            step_sleep=None, timeout=300):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+    if chaos_spec:
+        env["PADDLE_CHAOS"] = chaos_spec
+    if step_sleep is not None:
+        env["ELASTIC_TEST_STEP_SLEEP"] = str(step_sleep)
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--nproc_per_node", str(nproc),
+           "--log_dir", str(tmp_path / "logs"), *extra_args, str(script)]
+    return subprocess.run(cmd, env=env, cwd=str(tmp_path),
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def _logs(tmp_path):
+    out = []
+    logs = tmp_path / "logs"
+    if logs.is_dir():
+        for f in logs.iterdir():
+            if f.is_file():
+                out.append(f"--- {f.name}\n{f.read_text()[-2000:]}")
+    return "\n".join(out)
+
+
+def _loss_by_step(run_dir, rank=0):
+    """step -> loss, taking the LAST record per step across generations
+    (resharded restores replay the tail of an interrupted generation)."""
+    out = {}
+    for f in sorted(run_dir.glob(f"loss.rank{rank}.jsonl")):
+        for line in f.read_text().splitlines():
+            rec = json.loads(line)
+            out[rec["step"]] = rec["loss"]
+    return out
+
+
+@pytest.fixture(scope="module")
+def elastic_baseline(tmp_path_factory):
+    """The fixed-width (world 2, uninterrupted) same-data baseline every
+    elastic scenario's loss trajectory and final weights must match."""
+    ref_dir = tmp_path_factory.mktemp("elastic_ref")
+    script = _write_elastic_worker(ref_dir)
+    r = _launch(ref_dir, script, nproc=2)
+    assert r.returncode == 0, r.stdout + r.stderr + _logs(ref_dir)
+    return {"dir": ref_dir,
+            "final_w": np.load(ref_dir / "final_w.rank0.gen0.npy"),
+            "losses": _loss_by_step(ref_dir)}
+
+
+class TestElasticE2E:
+    def test_shrink_reshard_and_grow_back(self, tmp_path, elastic_baseline):
+        """The acceptance run: rank 1 dies permanently at step 4 (chaos
+        `elastic.host_loss` declares the host gone), the job re-forms at
+        world 1 (generation 1) and restores via reshard from the shared
+        Tier-2 checkpoint with the recovery source recorded; at step 8 the
+        worker signals returned capacity, the launcher grows back to world
+        2 (generation 2) at a checkpoint boundary, and BOTH ranks restore
+        bit-exact. The merged per-step loss trajectory and the final
+        weights equal the fixed-width baseline exactly."""
+        run_dir = tmp_path / "elastic"
+        run_dir.mkdir()
+        script = _write_elastic_worker(run_dir, hooks=ELASTIC_HOOKS)
+        r = _launch(run_dir, script, nproc=2,
+                    extra_args=("--elastic_level", "2"),
+                    chaos_spec="elastic.host_loss:exc:times=1",
+                    step_sleep=0.12)
+        assert r.returncode == 0, r.stdout + r.stderr + _logs(run_dir)
+        # shrink AND regrow happened, in that order
+        assert "elastic shrink: re-forming world 2 -> 1" in r.stderr
+        assert "elastic regrow: re-forming world 1 -> 2" in r.stderr
+        # every post-shrink incarnation restored from the durable tier with
+        # its source recorded (reshard path: saved world != live world)
+        recs = [json.loads(line) for line in
+                (run_dir / "recovery.rank0.jsonl").read_text().splitlines()]
+        assert [rec["world"] for rec in recs] == [1, 2]
+        assert all(rec["source"] == "tier2.durable" for rec in recs)
+        assert all(rec["step"] >= 1 for rec in recs)
+        recs1 = [json.loads(line) for line in
+                 (run_dir / "recovery.rank1.jsonl").read_text().splitlines()]
+        assert [rec["world"] for rec in recs1] == [2]  # the regrown rank
+        assert recs1[0]["source"] == "tier2.durable"
+        # loss trajectory: merged per-step losses equal the fixed-width
+        # baseline BIT-EXACTLY (identical-row data + power-of-two batches)
+        merged = _loss_by_step(run_dir)
+        assert set(merged) == set(elastic_baseline["losses"])
+        for step, loss in elastic_baseline["losses"].items():
+            assert merged[step] == loss, f"step {step} diverged"
+        # both regrown ranks finish bit-exact vs the baseline
+        for rank in (0, 1):
+            np.testing.assert_array_equal(
+                np.load(run_dir / f"final_w.rank{rank}.gen2.npy"),
+                elastic_baseline["final_w"])
+        # reshard restores actually happened and no recompile churn alerts
+        for rank in (0, 1):
+            metrics = json.loads(
+                (run_dir / f"metrics.rank{rank}.gen2.json").read_text())
+            assert metrics.get("elastic.reshard_loads", 0) >= 1
+            assert metrics.get("compile.churn_alerts", 0) == 0
