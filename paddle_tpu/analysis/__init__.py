@@ -1,0 +1,30 @@
+"""paddle_tpu.analysis — the project-native static-analysis engine
+(ISSUE 10).
+
+One shared parse (``index.ModuleIndex``), a rule-plugin registry
+(``engine.RULES``), findings as ``path:line: RULE_ID message`` with inline
+``# lint: <rule-id>-ok`` markers and a checked-in baseline file
+(``scripts/analysis_baseline.txt``), and a CLI::
+
+    python -m paddle_tpu.analysis --ci        # full tree, exit 1 on findings
+    python -m paddle_tpu.analysis --changed   # findings on touched lines only
+    python -m paddle_tpu.analysis --list      # rule catalogue
+
+The subpackage itself is dependency-free (ast + stdlib only) — the cost
+of ``python -m paddle_tpu.analysis`` is the parent package import plus
+ONE parse of the tree shared by every rule, which is what lets ci.sh
+replace five separate parse-the-world heredoc processes with a single
+invocation. See docs/ANALYSIS.md for the rule catalogue and suppression
+semantics.
+"""
+from . import rules  # noqa: F401  — registers every rule
+from .engine import RULES, Finding, run_rules  # noqa: F401
+from .index import ModuleIndex  # noqa: F401
+
+__all__ = ["RULES", "Finding", "ModuleIndex", "run_rules", "main"]
+
+
+def main(argv=None):
+    from .cli import main as _main
+
+    return _main(argv)

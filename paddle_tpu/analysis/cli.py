@@ -1,0 +1,132 @@
+"""CLI for the analysis engine (``python -m paddle_tpu.analysis``)."""
+import argparse
+import os
+import subprocess
+import sys
+
+from .engine import (DEFAULT_BASELINE, RULES, baseline_key,
+                     format_finding, load_baseline, run_rules)
+from .index import ModuleIndex
+from .rules import registries
+
+
+def _changed_lines(root, base):
+    """{path: set(linenos)} of working-tree lines added/modified vs the
+    merge-base with ``base`` (the --changed mode: incremental PRs are
+    judged on touched lines only, not the whole-file baseline)."""
+    def git(*args):
+        return subprocess.run(
+            ["git", *args], cwd=root, check=True, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL).stdout
+
+    mb = None
+    for candidate in ([base] if base else ["origin/main", "origin/master",
+                                           "main", "master"]):
+        try:
+            mb = git("merge-base", "HEAD", candidate).strip()
+            break
+        except subprocess.CalledProcessError:
+            continue
+    if mb is None:
+        mb = "HEAD"
+    out = {}
+    path = None
+    for line in git("diff", "-U0", mb, "--", "*.py").splitlines():
+        if line.startswith("+++ b/"):
+            path = line[6:]
+        elif line.startswith("@@") and path is not None:
+            # @@ -a,b +c,d @@ — the +c,d span is the new-side lines
+            new = line.split("+")[1].split(" ")[0]
+            start, _, count = new.partition(",")
+            start, count = int(start), int(count or 1)
+            out.setdefault(path, set()).update(
+                range(start, start + count))
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="project-native static analysis (docs/ANALYSIS.md)")
+    p.add_argument("--ci", action="store_true",
+                   help="run every rule over the whole tree (the ci.sh "
+                        "lint phase); exit 1 on findings")
+    p.add_argument("--changed", action="store_true",
+                   help="only report findings on lines changed vs the "
+                        "git merge-base (incremental PR mode)")
+    p.add_argument("--base", default=None,
+                   help="merge-base ref for --changed (default: "
+                        "origin/main, falling back to main)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids (default: all)")
+    p.add_argument("--root", default=None,
+                   help="repo root to analyze (default: the checkout "
+                        "this package was imported from)")
+    p.add_argument("--list", action="store_true",
+                   help="print the rule catalogue and exit")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore scripts/analysis_baseline.txt")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept every current finding into the baseline "
+                        "file")
+    p.add_argument("--write-envs-doc", action="store_true",
+                   help="regenerate docs/ENVS.md (preserves description "
+                        "cells) and exit")
+    args = p.parse_args(argv)
+
+    if args.list:
+        for rid, spec in RULES.items():
+            print(f"{rid:32s} {spec.description}")
+        return 0
+
+    rule_ids = args.rules.split(",") if args.rules else None
+    if rule_ids:
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            p.error(f"unknown rule(s) {unknown}; --list shows the "
+                    f"catalogue")
+    index = ModuleIndex(root=args.root)
+    for path, err in index.errors:
+        print(f"{path}:0: parse-error {err}", file=sys.stderr)
+
+    if args.write_envs_doc:
+        doc_path = os.path.join(index.root, registries.ENVS_DOC)
+        previous = index.doc(registries.ENVS_DOC)
+        text = registries.render_envs_doc(index, previous=previous)
+        with open(doc_path, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {registries.ENVS_DOC}")
+        return 0
+
+    if args.write_baseline:
+        # the accepted-debt set must be computed from scratch: filtering
+        # through the EXISTING baseline (or --changed) here would rewrite
+        # the file without the already-accepted entries, resurrecting
+        # them as hard failures on the next --ci run
+        findings, _, _ = run_rules(index, rule_ids, baseline=None,
+                                   changed_lines=None)
+        path = os.path.join(index.root, DEFAULT_BASELINE)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("# Accepted analysis debt — one rule|path|line-text "
+                    "key per line.\n# Regenerate: python -m "
+                    "paddle_tpu.analysis --write-baseline\n")
+            for fnd in findings:
+                f.write(baseline_key(index, fnd) + "\n")
+        print(f"wrote {len(findings)} entries to {DEFAULT_BASELINE}")
+        return 0
+
+    baseline = None if args.no_baseline else load_baseline(index.root)
+    changed = _changed_lines(index.root, args.base) if args.changed \
+        else None
+    findings, n_marked, n_base = run_rules(
+        index, rule_ids, baseline=baseline, changed_lines=changed)
+
+    for fnd in findings:
+        print(format_finding(fnd))
+    n_rules = len(rule_ids) if rule_ids else len(RULES)
+    status = "FAIL" if findings or index.errors else "ok"
+    print(f"analysis: {n_rules} rules over {len(index.files)} files — "
+          f"{len(findings)} findings ({n_marked} marker-suppressed, "
+          f"{n_base} baselined) [{status}]",
+          file=sys.stderr)
+    return 1 if findings or index.errors else 0

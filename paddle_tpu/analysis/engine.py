@@ -1,0 +1,111 @@
+"""Rule registry, finding model, suppression + baseline semantics.
+
+A *rule* is a function ``(ModuleIndex) -> [Finding]`` registered under a
+stable id. The engine runs every requested rule over ONE shared index and
+then applies the two suppression layers:
+
+* **inline markers** — a finding whose source line carries
+  ``lint: <rule-id>-ok`` (or one of the rule's declared legacy marker
+  aliases, e.g. ``serve-readback-ok``) is dropped. Markers are the
+  reviewed, justified-in-place escape hatch.
+* **baseline file** — ``scripts/analysis_baseline.txt`` holds findings
+  that predate a rule and are accepted as debt. Entries are keyed by
+  ``rule|path|stripped-line-text`` (not line numbers, which drift); a
+  baselined finding is reported only with ``--no-baseline``. The shipped
+  tree keeps this file EMPTY — new debt needs a reviewed inline marker.
+
+See docs/ANALYSIS.md for the rule catalogue and how to add a rule.
+"""
+import os
+from collections import namedtuple
+
+__all__ = ["Finding", "RuleSpec", "RULES", "rule", "run_rules",
+           "load_baseline", "baseline_key", "DEFAULT_BASELINE"]
+
+DEFAULT_BASELINE = "scripts/analysis_baseline.txt"
+
+Finding = namedtuple("Finding", "path line rule message")
+
+
+def format_finding(f):
+    return f"{f.path}:{f.line}: {f.rule} {f.message}"
+
+
+RuleSpec = namedtuple("RuleSpec", "rule_id fn markers description")
+
+#: rule_id -> RuleSpec, in registration order (rules/__init__ imports the
+#: rule modules, so importing paddle_tpu.analysis.rules populates this)
+RULES = {}
+
+
+def rule(rule_id, markers=(), description=""):
+    """Register ``fn(index) -> [Finding]`` as a rule.
+
+    ``markers`` are legacy inline tokens that suppress this rule in
+    addition to the canonical ``lint: <rule-id>-ok`` — they keep the
+    pre-ISSUE-10 in-tree annotations (``serve-readback-ok`` etc.) working
+    unchanged."""
+    def deco(fn):
+        RULES[rule_id] = RuleSpec(rule_id, fn, tuple(markers), description)
+        return fn
+    return deco
+
+
+def _suppressed(index, finding, spec):
+    fi = index.files.get(finding.path)
+    if fi is None:
+        return False
+    text = fi.line(finding.line)
+    if f"lint: {spec.rule_id}-ok" in text:
+        return True
+    return any(tok in text for tok in spec.markers)
+
+
+def baseline_key(index, finding):
+    fi = index.files.get(finding.path)
+    text = fi.line(finding.line).strip() if fi else ""
+    return f"{finding.rule}|{finding.path}|{text}"
+
+
+def load_baseline(root, path=DEFAULT_BASELINE):
+    """The accepted-debt set: one ``rule|path|line-text`` key per line,
+    ``#`` comments and blanks ignored. Missing file = empty baseline."""
+    entries = set()
+    try:
+        with open(os.path.join(root, path), encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    entries.add(line)
+    except OSError:
+        pass
+    return entries
+
+
+def run_rules(index, rule_ids=None, baseline=None, changed_lines=None):
+    """Run ``rule_ids`` (default: every registered rule) over ``index``.
+
+    Returns ``(findings, suppressed_count, baselined_count)`` with marker-
+    and baseline-suppressed findings removed. ``changed_lines`` (the
+    ``--changed`` mode): ``{path: set(linenos)}`` — findings outside it are
+    dropped, EXCEPT whole-tree registry findings reported at line 0
+    (doc-drift style rules), which always apply to the files they name.
+    """
+    if rule_ids is None:
+        rule_ids = list(RULES)
+    findings, n_marked, n_base = [], 0, 0
+    for rid in rule_ids:
+        spec = RULES[rid]
+        for f in spec.fn(index):
+            if _suppressed(index, f, spec):
+                n_marked += 1
+                continue
+            if baseline and baseline_key(index, f) in baseline:
+                n_base += 1
+                continue
+            if changed_lines is not None and f.line > 0:
+                if f.line not in changed_lines.get(f.path, ()):
+                    continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings, n_marked, n_base

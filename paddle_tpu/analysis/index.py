@@ -1,0 +1,218 @@
+"""One shared parse of the tree for every rule (ISSUE 10 tentpole).
+
+The five pre-ISSUE-10 lints each re-walked and re-parsed the repo inside a
+``scripts/ci.sh`` heredoc; the analysis engine parses every file exactly
+once into a :class:`ModuleIndex` — AST + per-module symbol table + import
+graph — and every registered rule reads from it. Rules therefore cost one
+AST walk each, not one filesystem walk each, and the whole lint phase is a
+single ``python -m paddle_tpu.analysis --ci`` process.
+
+The index is deliberately plain data: rules should stay small functions
+over it. Anything two rules both need (dotted-name rendering, module-level
+string constants, import alias resolution) belongs here, not copied into
+rule modules.
+"""
+import ast
+import os
+
+__all__ = ["FileInfo", "ModuleIndex", "dotted"]
+
+#: directories never worth indexing (generated/vendored/VCS)
+_SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules",
+              "telemetry", "xprof_traces"}
+
+
+def dotted(node):
+    """Render a Name/Attribute chain as ``"a.b.c"``; None for anything
+    else (calls, subscripts) anywhere in the chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class FileInfo:
+    """One parsed module: source, AST, and the symbol facts rules share."""
+
+    __slots__ = ("path", "module", "source", "lines", "tree", "is_package",
+                 "import_aliases", "str_constants", "functions", "classes")
+
+    def __init__(self, path, module, source, tree):
+        self.path = path          # repo-relative posix path
+        self.module = module      # dotted module name ("paddle_tpu.x.y")
+        self.is_package = path.endswith("__init__.py")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        #: local name -> absolute dotted target ("pkg.mod" for module
+        #: imports, "pkg.mod.attr" for from-imports)
+        self.import_aliases = {}
+        #: module-level NAME = "literal" string constants (env-var names,
+        #: chaos site prefixes, ...)
+        self.str_constants = {}
+        #: qualname -> ast.FunctionDef; methods are "Class.method"
+        self.functions = {}
+        #: class name -> ast.ClassDef
+        self.classes = {}
+        self._harvest()
+
+    def _harvest(self):
+        mod_parts = self.module.split(".")
+        # the package a relative import resolves against: for a module
+        # file, one level up is its own package
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                self.str_constants[node.targets[0].id] = node.value.value
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    # a package __init__'s module name IS its package
+                    # (".__init__" was stripped), so level 1 resolves
+                    # against the full name; a plain module drops its own
+                    # leaf first
+                    drop = node.level - (1 if self.is_package else 0)
+                    base = mod_parts[:len(mod_parts) - drop]
+                else:
+                    base = []
+                target = ".".join(base + (node.module.split(".")
+                                          if node.module else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.import_aliases[a.asname or a.name] = \
+                        f"{target}.{a.name}" if target else a.name
+        # functions/classes with class-qualified names (one level deep is
+        # all this codebase uses; nested defs keep their enclosing scope
+        # out of the qualname on purpose — they are not call targets)
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self.functions[f"{node.name}.{sub.name}"] = sub
+
+    def line(self, lineno):
+        """1-indexed source line ("" past EOF — decorators/multiline spans
+        can report a line the splitlines list lacks when a file ends
+        mid-statement)."""
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def resolve_str(self, node, index=None):
+        """Resolve an expression to a string literal if statically
+        possible: a Constant, a module-level NAME constant, or (given the
+        index) an imported NAME constant from another indexed module."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id in self.str_constants:
+                return self.str_constants[node.id]
+            target = self.import_aliases.get(node.id)
+            if index is not None and target and "." in target:
+                mod, _, name = target.rpartition(".")
+                fi = index.by_module.get(mod)
+                if fi is not None:
+                    return fi.str_constants.get(name)
+        return None
+
+
+class ModuleIndex:
+    """Every ``*.py`` under ``root``'s indexed packages, parsed once.
+
+    ``root`` defaults to the repo root (the directory holding the
+    ``paddle_tpu`` package this module was imported from), so the CLI works
+    from any cwd; tests hand it a fixture tree instead.
+    """
+
+    PACKAGES = ("paddle_tpu", "scripts", "tests")
+
+    def __init__(self, root=None, packages=None):
+        if root is None:
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        self.root = root
+        self.packages = tuple(packages or self.PACKAGES)
+        self.files = {}        # rel posix path -> FileInfo
+        self.by_module = {}    # dotted module -> FileInfo
+        self.errors = []       # (path, SyntaxError) — reported, not fatal
+        for pkg in self.packages:
+            top = os.path.join(root, pkg)
+            if not os.path.isdir(top):
+                continue
+            for dirpath, dirnames, filenames in os.walk(top):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        self._add(os.path.join(dirpath, fn))
+
+    def _add(self, abspath):
+        rel = os.path.relpath(abspath, self.root).replace(os.sep, "/")
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=rel)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            self.errors.append((rel, e))
+            return
+        module = rel[:-3].replace("/", ".")
+        if module.endswith(".__init__"):
+            module = module[:-len(".__init__")]
+        fi = FileInfo(rel, module, source, tree)
+        self.files[rel] = fi
+        self.by_module[module] = fi
+
+    # ---- queries rules share ---------------------------------------------
+    def iter_files(self, prefix="paddle_tpu/"):
+        """FileInfos whose path starts with ``prefix`` (or any of a tuple
+        of prefixes), sorted by path."""
+        if isinstance(prefix, str):
+            prefix = (prefix,)
+        for path in sorted(self.files):
+            if any(path.startswith(p) for p in prefix):
+                yield self.files[path]
+
+    def doc(self, rel):
+        """A non-indexed text file (docs/*.md) under root, or None."""
+        p = os.path.join(self.root, rel)
+        try:
+            with open(p, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def string_call_args(self, func_names, prefix=("paddle_tpu/",)):
+        """All statically-resolvable string first-arguments to calls whose
+        callee renders (by trailing attribute or bare name) to one of
+        ``func_names``: ``{value: [(path, lineno), ...]}``. The shared
+        harvest behind the registry-style rules (metric names, chaos
+        sites, env names)."""
+        out = {}
+        for fi in self.iter_files(prefix):
+            for node in ast.walk(fi.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                f = node.func
+                name = (f.attr if isinstance(f, ast.Attribute)
+                        else f.id if isinstance(f, ast.Name) else None)
+                if name not in func_names:
+                    continue
+                val = fi.resolve_str(node.args[0], index=self)
+                if val is not None:
+                    out.setdefault(val, []).append((fi.path, node.lineno))
+        return out

@@ -1,0 +1,12 @@
+"""Rule plugins. Importing this package registers every rule with the
+engine registry (``paddle_tpu.analysis.engine.RULES``) — a new rule module
+just needs an import line here and a ``@rule(...)`` decorator there."""
+from . import (  # noqa: F401  (imported for registration side effects)
+    checkpoint,
+    docs_drift,
+    hostsync,
+    ledger,
+    locks,
+    registries,
+    timing,
+)

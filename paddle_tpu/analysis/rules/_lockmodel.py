@@ -1,0 +1,350 @@
+"""Static lock model shared by the concurrency rules (ISSUE 10 tentpole).
+
+Builds, from the ModuleIndex:
+
+* a **lock table** — every ``threading.Lock/RLock/Condition`` (and
+  project lock wrappers like ``_StampedRLock``) bound to a module-level
+  name or a ``self.<attr>``, identified at CLASS granularity:
+  ``pkg.mod.NAME`` or ``pkg.mod.Class.attr``. Two instances of the same
+  class's lock attribute are the same *order class* — exactly what lock-
+  ordering discipline ranks.
+* a light **call graph** — calls resolvable statically: same-module
+  functions, ``self.method``, attributes whose class was inferred from
+  ``self.x = Cls(...)`` in ``__init__``, imported names, plus a
+  unique-method-name fallback for everything else.
+* per-function **acquire summaries** — the fixpoint closure of "locks
+  this function may take", so a ``with self._locked_dispatch(...)`` body
+  counts as holding whatever that contextmanager takes around its yield.
+
+The walkers (:func:`walk_held`) then replay each function with a held-lock
+stack, which is all the concurrency rules need: lock-order edges, calls
+made under a lock, writes made outside one.
+"""
+import ast
+
+from ..index import dotted
+
+__all__ = ["LockModel", "build", "walk_held"]
+
+#: constructor names that mint a lock-like object. Semaphores excluded on
+#: purpose: they are counting gates, not mutual-exclusion order members.
+LOCK_CTORS = {"Lock", "RLock", "Condition", "_StampedRLock", "StampedRLock"}
+
+#: method names too generic for the unique-method call-resolution
+#: fallback — resolving `x.get(...)` to some random class would poison
+#: the call graph with false edges
+_COMMON_METHODS = {
+    "get", "put", "set", "pop", "add", "clear", "wait", "join", "start",
+    "stop", "close", "run", "append", "extend", "items", "values", "keys",
+    "update", "copy", "read", "write", "send", "recv", "acquire",
+    "release", "step", "reset", "result", "next", "submit", "open",
+    "load", "save", "name", "info", "warning", "error", "debug", "beat",
+    "register", "observe", "inc", "dec", "report", "snapshot", "flush",
+}
+
+
+def _contains_lock_ctor(expr):
+    """True if any node in ``expr`` calls a lock constructor — covers
+    ``self.lock = lock or threading.RLock()`` style defaults."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else None)
+            if name in LOCK_CTORS:
+                return True
+    return False
+
+
+class LockModel:
+    def __init__(self, index):
+        self.index = index
+        self.module_locks = {}   # module -> {name: lock_id}
+        self.class_locks = {}    # (module, cls) -> {attr: lock_id}
+        self.attr_types = {}     # (module, cls) -> {attr: (module2, cls2)}
+        self.method_owners = {}  # method name -> [(module, cls)]
+        self.acquires = {}       # (module, qualname) -> {lock_id: lineno}
+        self._build_tables()
+        self._build_acquire_summaries()
+
+    # ---- lock + type tables ----------------------------------------------
+    def _build_tables(self):
+        for fi in self.index.iter_files(("paddle_tpu/", "scripts/",
+                                         "tests/")):
+            mod = fi.module
+            for node in fi.tree.body:
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and _contains_lock_ctor(node.value):
+                    self.module_locks.setdefault(mod, {})[
+                        node.targets[0].id] = f"{mod}.{node.targets[0].id}"
+            for cls_name, cls in fi.classes.items():
+                key = (mod, cls_name)
+                for fn in ast.walk(cls):
+                    if not isinstance(fn, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                        continue
+                    self.method_owners.setdefault(fn.name, []).append(key)
+                    for node in ast.walk(fn):
+                        if not (isinstance(node, ast.Assign)
+                                and len(node.targets) == 1):
+                            continue
+                        tgt = node.targets[0]
+                        if not (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            continue
+                        if _contains_lock_ctor(node.value):
+                            self.class_locks.setdefault(key, {})[tgt.attr] \
+                                = f"{mod}.{cls_name}.{tgt.attr}"
+                        t = self._infer_ctor_class(fi, node.value)
+                        if t is not None:
+                            self.attr_types.setdefault(key, {})[tgt.attr] = t
+
+    def _infer_ctor_class(self, fi, expr):
+        """``self.x = Cls(...)`` (possibly behind ``arg or Cls(...)``) ->
+        the (module, class) of Cls when it resolves inside the index."""
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            head, _, tail = name.rpartition(".")
+            # bare class name in this module / imported
+            if not head:
+                if name in fi.classes:
+                    return (fi.module, name)
+                target = fi.import_aliases.get(name)
+                if target and "." in target:
+                    m, _, c = target.rpartition(".")
+                    ofi = self.index.by_module.get(m)
+                    if ofi is not None and c in ofi.classes:
+                        return (m, c)
+            else:
+                target = fi.import_aliases.get(head, head)
+                ofi = self.index.by_module.get(target)
+                if ofi is not None and tail in ofi.classes:
+                    return (target, tail)
+        return None
+
+    # ---- name -> lock resolution -----------------------------------------
+    def lock_for_expr(self, fi, cls_name, expr):
+        """Resolve a with-item (or attribute receiver) expression to a
+        lock id, or None. Handles bare names (module lock, imported module
+        lock), ``self.attr``, ``mod.NAME``, and — for receivers like
+        ``entry.handle._cond`` — a unique-attr fallback: an attribute name
+        that is a lock attr of exactly ONE class in the index resolves to
+        that class's lock."""
+        if isinstance(expr, ast.Name):
+            mod_locks = self.module_locks.get(fi.module, {})
+            if expr.id in mod_locks:
+                return mod_locks[expr.id]
+            target = fi.import_aliases.get(expr.id)
+            if target and "." in target:
+                m, _, n = target.rpartition(".")
+                return self.module_locks.get(m, {}).get(n)
+            return None
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                    and cls_name is not None:
+                hit = self.class_locks.get((fi.module, cls_name),
+                                           {}).get(expr.attr)
+                if hit is not None:
+                    return hit
+            name = dotted(expr)
+            if name is not None and "." in name:
+                head, _, tail = name.rpartition(".")
+                target = fi.import_aliases.get(head, head)
+                hit = self.module_locks.get(target, {}).get(tail)
+                if hit is not None:
+                    return hit
+            # unique lock-attr fallback (rep._cond, handle._cond, ...)
+            owners = [(k, v[expr.attr]) for k, v in self.class_locks.items()
+                      if expr.attr in v]
+            if len(owners) == 1:
+                return owners[0][1]
+        return None
+
+    # ---- call resolution --------------------------------------------------
+    def resolve_call(self, fi, cls_name, call):
+        """Best-effort static callee: ``(module, qualname)`` or None."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in fi.functions:
+                return (fi.module, f.id)
+            target = fi.import_aliases.get(f.id)
+            if target and "." in target:
+                m, _, n = target.rpartition(".")
+                ofi = self.index.by_module.get(m)
+                if ofi is not None and n in ofi.functions:
+                    return (m, n)
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        # self.method()
+        if isinstance(f.value, ast.Name) and f.value.id == "self" \
+                and cls_name is not None:
+            q = f"{cls_name}.{f.attr}"
+            if q in fi.functions:
+                return (fi.module, q)
+            # self.<typed attr>.method()
+        # self.x.method() with inferred attr type
+        if isinstance(f.value, ast.Attribute) \
+                and isinstance(f.value.value, ast.Name) \
+                and f.value.value.id == "self" and cls_name is not None:
+            t = self.attr_types.get((fi.module, cls_name),
+                                    {}).get(f.value.attr)
+            if t is not None:
+                m, c = t
+                ofi = self.index.by_module.get(m)
+                if ofi is not None and f"{c}.{f.attr}" in ofi.functions:
+                    return (m, f"{c}.{f.attr}")
+        # module.func() / imported alias
+        name = dotted(f)
+        if name is not None and "." in name:
+            head, _, tail = name.rpartition(".")
+            target = fi.import_aliases.get(head, head)
+            ofi = self.index.by_module.get(target)
+            if ofi is not None and tail in ofi.functions:
+                return (target, tail)
+        # unique-method fallback
+        if f.attr not in _COMMON_METHODS and not f.attr.startswith("__"):
+            owners = self.method_owners.get(f.attr, [])
+            if len(owners) == 1:
+                m, c = owners[0]
+                return (m, f"{c}.{f.attr}")
+        return None
+
+    # ---- acquire summaries (fixpoint) ------------------------------------
+    def _direct_acquires(self, fi, qualname, fn):
+        cls_name = qualname.split(".")[0] if "." in qualname else None
+        out = {}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                lid = self.lock_for_expr(fi, cls_name, item.context_expr)
+                if lid is not None:
+                    out.setdefault(lid, item.context_expr.lineno)
+        return out
+
+    def _build_acquire_summaries(self):
+        direct, calls = {}, {}
+        for fi in self.index.iter_files(("paddle_tpu/", "scripts/",
+                                         "tests/")):
+            for qualname, fn in fi.functions.items():
+                key = (fi.module, qualname)
+                cls_name = qualname.split(".")[0] if "." in qualname \
+                    else None
+                direct[key] = self._direct_acquires(fi, qualname, fn)
+                out = set()
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        tgt = self.resolve_call(fi, cls_name, node)
+                        if tgt is not None and tgt != key:
+                            out.add(tgt)
+                calls[key] = out
+        self.acquires = {k: dict(v) for k, v in direct.items()}
+        # fixpoint: propagate callee acquires up (bounded by lattice height)
+        for _ in range(len(self.acquires)):
+            changed = False
+            for key, callees in calls.items():
+                acq = self.acquires[key]
+                for c in callees:
+                    for lid, line in self.acquires.get(c, {}).items():
+                        if lid not in acq:
+                            acq[lid] = line
+                            changed = True
+            if not changed:
+                break
+
+    def yield_holds(self, key):
+        """Locks a generator contextmanager holds AROUND ITS YIELD — the
+        set its caller's with-body runs under. Direct with-nesting only:
+        transient acquisitions before/after the yield are edges of the
+        cm function itself, not holds of the caller. Empty for
+        non-generators."""
+        cached = getattr(self, "_yield_holds", None)
+        if cached is None:
+            cached = self._yield_holds = {}
+        if key in cached:
+            return cached[key]
+        out = cached[key] = set()
+        fi = self.index.by_module.get(key[0])
+        fn = fi.functions.get(key[1]) if fi is not None else None
+        if fn is not None:
+            cls_name = key[1].split(".")[0] if "." in key[1] else None
+
+            def go(node, held):
+                if isinstance(node, ast.With):
+                    inner = list(held)
+                    for item in node.items:
+                        lid = self.lock_for_expr(fi, cls_name,
+                                                 item.context_expr)
+                        if lid is not None and lid not in inner:
+                            inner.append(lid)
+                    for stmt in node.body:
+                        go(stmt, inner)
+                    return
+                if isinstance(node, ast.Yield):
+                    out.update(held)
+                for child in ast.iter_child_nodes(node):
+                    if not isinstance(child, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.Lambda)):
+                        go(child, held)
+
+            for stmt in fn.body:
+                go(stmt, [])
+        return out
+
+    def with_item_locks(self, fi, cls_name, item):
+        """Locks a with-item holds over its body: the item itself if it
+        IS a lock, or — when it calls a contextmanager function — the
+        locks that cm holds around its yield (``with
+        self._locked_dispatch(...):`` holds the compile + dispatch
+        locks)."""
+        lid = self.lock_for_expr(fi, cls_name, item.context_expr)
+        if lid is not None:
+            return [lid]
+        if isinstance(item.context_expr, ast.Call):
+            tgt = self.resolve_call(fi, cls_name, item.context_expr)
+            if tgt is not None:
+                return sorted(self.yield_holds(tgt))
+        return []
+
+
+def walk_held(model, fi, qualname, fn, visit):
+    """Replay ``fn`` with a held-lock stack.
+
+    ``visit(node, held)`` is called for every statement/expression node in
+    source order with the tuple of lock ids held at that point. Nested
+    function defs and lambdas are walked with an EMPTY held stack (they
+    run later, on their own thread/stack)."""
+    cls_name = qualname.split(".")[0] if "." in qualname else None
+
+    def go(node, held):
+        if isinstance(node, ast.With):
+            inner = list(held)
+            for item in node.items:
+                visit(item.context_expr, tuple(inner))
+                for lid in model.with_item_locks(fi, cls_name, item):
+                    if lid not in inner:
+                        inner.append(lid)
+            for stmt in node.body:
+                go(stmt, tuple(inner))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for stmt in body:
+                go(stmt, ())
+            return
+        visit(node, held)
+        for child in ast.iter_child_nodes(node):
+            go(child, held)
+
+    for stmt in fn.body:
+        go(stmt, ())

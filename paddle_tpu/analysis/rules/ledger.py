@@ -1,0 +1,44 @@
+"""``compile-ledger`` — port of the ISSUE 8 completeness lint.
+
+Every XLA compile site in ``paddle_tpu/`` must flow through
+``observability/compilemem.py`` (``ledgered_jit`` for jit sites,
+``record_compile`` brackets for AOT export sites) so the compile ledger —
+/compilez, churn detection, OOM forensics — is complete by CONSTRUCTION.
+A raw ``jax.jit`` reference or a ``.lower(...).compile()`` chain anywhere
+else is a ledger blind spot.
+"""
+import ast
+
+from ..engine import Finding, rule
+
+
+@rule("compile-ledger",
+      markers=("compile-ledger-ok",),
+      description="every compile site goes through compilemem.ledgered_jit"
+                  " / record_compile")
+def compile_ledger(index):
+    findings = []
+    for fi in index.iter_files("paddle_tpu/"):
+        for node in ast.walk(fi.tree):
+            hit = None
+            # any `jax.jit` reference (call, partial, decorator)
+            if (isinstance(node, ast.Attribute) and node.attr == "jit"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "jax"):
+                hit = "raw jax.jit"
+            # <expr>.lower(...).compile(...) AOT chains
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "compile"
+                  and isinstance(node.func.value, ast.Call)
+                  and isinstance(node.func.value.func, ast.Attribute)
+                  and node.func.value.func.attr == "lower"):
+                hit = ".lower(...).compile()"
+            if hit is not None:
+                findings.append(Finding(
+                    fi.path, node.lineno, "compile-ledger",
+                    f"{hit} bypasses the compile ledger — use "
+                    f"observability.compilemem.ledgered_jit / "
+                    f"record_compile (or tag a deliberate exception with "
+                    f" # compile-ledger-ok)"))
+    return findings

@@ -1,0 +1,318 @@
+"""Concurrency rules (ISSUE 10 tentpole, part b).
+
+These are the rules the bugs PRs 4 and 9 fixed by hand would have hit in
+CI: the wedged-dispatcher work rebuilt the serving dispatch locking, and
+the shared-root GC race was an unguarded cross-thread mutation. All three
+rules read the shared static lock model (:mod:`._lockmodel`).
+
+* ``lock-order`` — build the static acquisition graph over every lock-like
+  attribute (``dispatch_lock``, ``_COMPILE_LOCK``, the frontend sweep
+  lock, router lock, checkpoint manager locks, ...) and fail on cycles.
+  The blessed global order is whatever the acyclic graph says; a new edge
+  that closes a cycle is a deadlock waiting for the right interleaving.
+* ``blocking-under-lock`` — no ``Event.wait`` / future ``result()`` /
+  device sync / ``subprocess`` / store dial inside a ``with <lock>`` body.
+  A blocked holder starves every waiter; the serving monitor can even
+  declare them dead (PR 4's wedged-dispatcher forensics). ``Condition``
+  waits on the HELD condition itself are the designed exception.
+* ``shared-mutation-without-lock`` — attributes written from thread entry
+  points (``threading.Thread(target=...)`` bodies and what they reach)
+  must be written under a lock or be ``_``-prefixed (private = owned by
+  one thread by this codebase's convention, e.g. the single-writer
+  heartbeat stamps).
+"""
+import ast
+
+from ..engine import Finding, rule
+from ..index import dotted
+from . import _lockmodel
+
+_SCOPES = ("paddle_tpu/",)
+
+#: call names that block the calling thread indefinitely (or for a device
+#: round-trip) — forbidden while holding a lock
+_BLOCKING_ATTRS = {"result", "block_until_ready", "device_get"}
+_STORE_CTORS = {"TCPStore"}
+
+
+def _model(index):
+    # one lock model per index, built lazily and shared by all three rules
+    m = getattr(index, "_lockmodel", None)
+    if m is None:
+        m = index._lockmodel = _lockmodel.LockModel(index)
+    return m
+
+
+@rule("lock-order",
+      description="static lock-acquisition graph over threading locks "
+                  "must be acyclic (a cycle is a deadlock schedule)")
+def lock_order(index):
+    model = _model(index)
+    edges = {}  # (src, dst) -> (path, line)
+
+    for fi in index.iter_files(_SCOPES):
+        for qualname, fn in fi.functions.items():
+            cls_name = qualname.split(".")[0] if "." in qualname else None
+
+            def visit(node, held, fi=fi, cls_name=cls_name):
+                if not held:
+                    return
+                acquired = ()
+                if isinstance(node, ast.Call):
+                    tgt = model.resolve_call(fi, cls_name, node)
+                    if tgt is not None:
+                        acquired = model.acquires.get(tgt, {})
+                else:
+                    lid = model.lock_for_expr(fi, cls_name, node) \
+                        if isinstance(node, (ast.Name, ast.Attribute)) \
+                        else None
+                    # with-item expressions arrive here via walk_held's
+                    # pre-visit; a bare attribute read is not an acquire
+                    acquired = {lid: node.lineno} if lid is not None \
+                        and getattr(node, "_pt_with_item", False) else ()
+                for dst in acquired:
+                    for src in held:
+                        if src != dst and (src, dst) not in edges:
+                            edges[(src, dst)] = (fi.path, node.lineno)
+
+            # mark with-items so visit() can tell an acquire from a read
+            for node in ast.walk(fn):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        item.context_expr._pt_with_item = True
+            _lockmodel.walk_held(model, fi, qualname, fn, visit)
+
+    # cycle detection: DFS over the edge graph
+    graph = {}
+    for (src, dst) in edges:
+        graph.setdefault(src, set()).add(dst)
+    findings, seen_cycles = [], set()
+
+    def dfs(node, path, on_path):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_path:
+                cycle = path[path.index(nxt):] + [nxt]
+                key = frozenset(cycle)
+                if key in seen_cycles:
+                    continue
+                seen_cycles.add(key)
+                # a `# lint: lock-order-ok` on ANY edge of the cycle
+                # suppresses it — the justification belongs on whichever
+                # acquisition the author deems the deliberate one (the
+                # engine's line-anchored suppression also applies, to the
+                # first edge's line)
+                if any("lint: lock-order-ok" in
+                       index.files[edges[(a, b)][0]].line(edges[(a, b)][1])
+                       for a, b in zip(cycle, cycle[1:])
+                       if edges[(a, b)][0] in index.files):
+                    continue
+                edge_sites = [
+                    f"{a} -> {b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+                    for a, b in zip(cycle, cycle[1:])]
+                path0, line0 = edges[(cycle[0], cycle[1])]
+                findings.append(Finding(
+                    path0, line0, "lock-order",
+                    "lock acquisition cycle: " + "; ".join(edge_sites) +
+                    " — pick one global order and restructure the "
+                    "inverted acquisition"))
+            elif nxt not in visited:
+                on_path.add(nxt)
+                dfs(nxt, path + [nxt], on_path)
+                on_path.discard(nxt)
+        visited.add(node)
+
+    visited = set()
+    for start in sorted(graph):
+        if start not in visited:
+            dfs(start, [start], {start})
+    return findings
+
+
+@rule("blocking-under-lock",
+      markers=("serve-readback-ok",),
+      description="no Event.wait/result()/device sync/subprocess/store "
+                  "dial while holding a lock")
+def blocking_under_lock(index):
+    model = _model(index)
+    findings = []
+
+    for fi in index.iter_files(_SCOPES):
+        for qualname, fn in fi.functions.items():
+            cls_name = qualname.split(".")[0] if "." in qualname else None
+
+            def visit(node, held, fi=fi, cls_name=cls_name):
+                if not held or not isinstance(node, ast.Call):
+                    return
+                name = dotted(node.func)
+                hit = None
+                if name in ("time.sleep",):
+                    hit = "time.sleep"
+                elif name is not None and (name.startswith("subprocess.")
+                                           or name.endswith(".Popen")
+                                           or name == "Popen"):
+                    hit = "subprocess"
+                elif name is not None and \
+                        name.split(".")[-1] in _STORE_CTORS:
+                    hit = "store dial"
+                elif name == "np.asarray":
+                    hit = "device sync (np.asarray)"
+                elif isinstance(node.func, ast.Attribute):
+                    a = node.func.attr
+                    if a in ("wait", "wait_for"):
+                        # Condition.wait on the HELD lock is the designed
+                        # pattern; waiting on anything else while holding
+                        # a lock starves the lock's other users
+                        rec = model.lock_for_expr(fi, cls_name,
+                                                  node.func.value)
+                        if rec is None or rec not in held:
+                            hit = f".{a}() on a non-held object"
+                    elif a in _BLOCKING_ATTRS:
+                        hit = f".{a}()"
+                if hit is not None:
+                    findings.append(Finding(
+                        fi.path, node.lineno, "blocking-under-lock",
+                        f"{hit} while holding {', '.join(held)} — move "
+                        f"the blocking call outside the lock (or justify "
+                        f"with  # lint: blocking-under-lock-ok)"))
+
+            _lockmodel.walk_held(model, fi, qualname, fn, visit)
+    return findings
+
+
+def _thread_entry_points(index, model):
+    """(module, qualname) of every function handed to
+    ``threading.Thread(target=...)``, resolved statically — including
+    nested defs (resolved within the enclosing function's scope)."""
+    entries = set()
+    for fi in index.iter_files(_SCOPES):
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if fname is None or fname.split(".")[-1] != "Thread":
+                continue
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                tgt = kw.value
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    for cls_name, cls in fi.classes.items():
+                        q = f"{cls_name}.{tgt.attr}"
+                        if q in fi.functions and any(
+                                n is node for n in ast.walk(cls)):
+                            entries.add((fi.module, q))
+                elif isinstance(tgt, ast.Name):
+                    if tgt.id in fi.functions:
+                        entries.add((fi.module, tgt.id))
+                    else:
+                        # nested def in the enclosing function: walk it
+                        # directly under its own (nested) qualname
+                        for q, fn in fi.functions.items():
+                            for sub in ast.walk(fn):
+                                if isinstance(sub, ast.FunctionDef) \
+                                        and sub.name == tgt.id and any(
+                                            n is node
+                                            for n in ast.walk(fn)):
+                                    entries.add((fi.module,
+                                                 f"{q}.<{tgt.id}>"))
+    return entries
+
+
+@rule("shared-mutation-without-lock",
+      description="thread entry points must lock-guard writes to shared "
+                  "(public) attributes, or mark them _-private "
+                  "single-writer fields")
+def shared_mutation(index):
+    model = _model(index)
+    entries = _thread_entry_points(index, model)
+
+    # transitively reachable statically-resolvable callees of each entry,
+    # plus — per callee — the locks held at EVERY resolvable call site: a
+    # helper only ever invoked under its owner's lock (chaos
+    # FaultRule._should_fire under FaultPlan._lock) starts its walk with
+    # that lock held instead of being flagged for its caller's discipline
+    reach = set(entries)
+    frontier = list(entries)
+    call_map = {}
+    callsite_held = {}
+    for fi in index.iter_files(_SCOPES):
+        for qualname, fn in fi.functions.items():
+            cls_name = qualname.split(".")[0] if "." in qualname else None
+            outs = set()
+
+            def note_call(node, held, fi=fi, cls_name=cls_name,
+                          outs=outs):
+                if isinstance(node, ast.Call):
+                    tgt = model.resolve_call(fi, cls_name, node)
+                    if tgt is not None:
+                        outs.add(tgt)
+                        callsite_held.setdefault(tgt, []).append(
+                            frozenset(held))
+
+            _lockmodel.walk_held(model, fi, qualname, fn, note_call)
+            call_map[(fi.module, qualname)] = outs
+    while frontier:
+        key = frontier.pop()
+        base = key[1].split(".<")[0]  # nested entries reach via enclosing
+        for tgt in call_map.get((key[0], base), ()):
+            if tgt not in reach:
+                reach.add(tgt)
+                frontier.append(tgt)
+
+    findings = []
+    for (mod, qualname) in sorted(reach):
+        fi = index.by_module.get(mod)
+        if fi is None:
+            continue
+        base, _, nested = qualname.partition(".<")
+        fn = fi.functions.get(base)
+        if fn is None:
+            continue
+        if nested:  # resolve the nested def node
+            want = nested.rstrip(">")
+            fn = next((n for n in ast.walk(fn)
+                       if isinstance(n, ast.FunctionDef)
+                       and n.name == want), None)
+            if fn is None:
+                continue
+        # locks provably held at every resolvable call site of this
+        # function (empty for the entry points themselves)
+        always_held = frozenset()
+        if (mod, qualname) not in entries:
+            sites = callsite_held.get((mod, base), [])
+            if sites:
+                always_held = frozenset.intersection(*sites)
+
+        def visit(node, held, fi=fi, always_held=always_held):
+            if held or always_held \
+                    or not isinstance(node, (ast.Assign, ast.AugAssign)):
+                return
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                if not isinstance(tgt, ast.Attribute) \
+                        or tgt.attr.startswith("_"):
+                    continue
+                base_name = dotted(tgt.value)
+                if base_name is None:
+                    continue
+                parts = base_name.split(".")
+                # only `self.<public chain>` is a SHARED write: parameter
+                # objects are request-scoped single-owner handoffs, and a
+                # _-prefixed holder (self._local.x — thread-locals, owned
+                # sub-objects) marks the container private to one thread
+                if parts[0] != "self" \
+                        or any(p.startswith("_") for p in parts[1:]):
+                    continue
+                findings.append(Finding(
+                    fi.path, tgt.lineno, "shared-mutation-without-lock",
+                    f"write to shared attribute "
+                    f"{base_name}.{tgt.attr} from a thread entry "
+                    f"path without holding a lock — guard it, or "
+                    f"_-prefix it if it is single-writer"))
+
+        _lockmodel.walk_held(model, fi, qualname if not nested else base,
+                             fn, visit)
+    return findings

@@ -1,0 +1,230 @@
+"""Registry-drift rules (ISSUE 10 tentpole part d).
+
+* ``env-registry`` — every ``PADDLE_*`` environment read in
+  ``paddle_tpu/`` goes through the ``utils/envs.py`` helpers (one place
+  to parse, default, and armor against garbage values), and every name
+  the helpers are called with appears in the generated ``docs/ENVS.md``
+  table — both directions, so the operator-facing doc can be trusted.
+  Writes (``os.environ[...] = ...`` — the launcher exporting contract
+  vars to children) are not reads and stay legal.
+* ``chaos-site-registry`` — every chaos site string armed in tests
+  (``plan.fail("ckpt.write")`` ...) exists at an injection seam
+  (``chaos.site("ckpt.write")``) somewhere — a typo'd site silently
+  injects NOTHING and the test passes vacuously; and every production
+  seam is referenced from tests or docs, so dead seams surface.
+
+``--write-envs-doc`` regenerates docs/ENVS.md from the same harvest,
+preserving hand-written description cells by variable name.
+"""
+import ast
+import re
+
+from ..engine import Finding, rule
+from ..index import dotted
+
+ENV_HELPERS = {"env_int", "env_float", "env_bool", "env_str"}
+ENVS_DOC = "docs/ENVS.md"
+_ENVS_FILE = "paddle_tpu/utils/envs.py"
+
+#: os.environ mutation methods that are not reads
+_ENV_WRITES = {"setdefault", "pop", "update", "clear"}
+
+
+def _env_reads(index):
+    """Raw PADDLE_* env reads in paddle_tpu/ outside utils/envs.py:
+    [(path, line, rendered-expr)]."""
+    out = []
+    for fi in index.iter_files("paddle_tpu/"):
+        if fi.path == _ENVS_FILE:
+            continue
+        for node in ast.walk(fi.tree):
+            # os.environ.get("PADDLE_X") / os.getenv("PADDLE_X")
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name in ("os.environ.get", "os.getenv") and node.args:
+                    val = fi.resolve_str(node.args[0], index=index)
+                    if val is not None and val.startswith("PADDLE_"):
+                        out.append((fi.path, node.lineno,
+                                    f"{name}({val!r})"))
+            # os.environ["PADDLE_X"] in Load context
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and dotted(node.value) == "os.environ":
+                val = fi.resolve_str(node.slice, index=index)
+                if val is not None and val.startswith("PADDLE_"):
+                    out.append((fi.path, node.lineno,
+                                f"os.environ[{val!r}]"))
+    return out
+
+
+def harvest_env_names(index):
+    """Every PADDLE_* name handed to an envs.py helper:
+    {name: {"helper": str, "default": str|None, "readers": [paths]}}."""
+    out = {}
+    for fi in index.iter_files(("paddle_tpu/", "scripts/")):
+        for node in ast.walk(fi.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            f = node.func
+            helper = (f.attr if isinstance(f, ast.Attribute)
+                      else f.id if isinstance(f, ast.Name) else None)
+            if helper is None:
+                continue
+            helper = helper.lstrip("_")
+            if helper not in ENV_HELPERS:
+                continue
+            name = fi.resolve_str(node.args[0], index=index)
+            if name is None or not name.startswith("PADDLE_"):
+                continue
+            default = None
+            if len(node.args) >= 2 and isinstance(node.args[1],
+                                                  ast.Constant):
+                default = repr(node.args[1].value)
+            rec = out.setdefault(name, {"helper": helper,
+                                        "default": default,
+                                        "readers": set()})
+            rec["readers"].add(fi.path)
+            if rec["default"] is None:
+                rec["default"] = default
+    return out
+
+
+def _doc_env_rows(text):
+    """{name: description} from the ENVS.md table."""
+    rows = {}
+    for line in text.splitlines():
+        if not line.startswith("|"):
+            continue
+        cells = [c.strip() for c in line.split("|")[1:-1]]
+        if len(cells) < 2:
+            continue
+        m = re.match(r"`(PADDLE_[A-Z0-9_]+)`", cells[0])
+        if m:
+            rows[m.group(1)] = cells[-1]
+    return rows
+
+
+@rule("env-registry",
+      description="PADDLE_* reads go through utils/envs.py and appear in "
+                  "the generated docs/ENVS.md table")
+def env_registry(index):
+    findings = [
+        Finding(path, line, "env-registry",
+                f"raw {expr} — read it through the paddle_tpu.utils.envs "
+                f"helpers (env_int/env_float/env_bool/env_str)")
+        for path, line, expr in _env_reads(index)
+    ]
+    registered = harvest_env_names(index)
+    doc = index.doc(ENVS_DOC)
+    if doc is None:
+        findings.append(Finding(
+            ENVS_DOC, 0, "env-registry",
+            "docs/ENVS.md is missing — generate it with "
+            "`python -m paddle_tpu.analysis --write-envs-doc`"))
+        return findings
+    doc_rows = _doc_env_rows(doc)
+    for name in sorted(registered):
+        if name not in doc_rows:
+            path = sorted(registered[name]["readers"])[0]
+            findings.append(Finding(
+                path, 0, "env-registry",
+                f"{name} is read but undocumented — regenerate the table "
+                f"with `python -m paddle_tpu.analysis --write-envs-doc` "
+                f"and fill in its description"))
+    for name in sorted(doc_rows):
+        if name not in registered:
+            findings.append(Finding(
+                ENVS_DOC, 0, "env-registry",
+                f"documented env var {name} is not read through the envs "
+                f"helpers anywhere — remove the row or fix the name"))
+    return findings
+
+
+def render_envs_doc(index, previous=None):
+    """The full docs/ENVS.md text, preserving descriptions from
+    ``previous`` (the current doc text) by variable name."""
+    registered = harvest_env_names(index)
+    old = _doc_env_rows(previous) if previous else {}
+    lines = [
+        "# Environment variables",
+        "",
+        "Generated by `python -m paddle_tpu.analysis --write-envs-doc` "
+        "from every",
+        "`utils/envs.py` helper call in the tree; the `env-registry` "
+        "analysis rule",
+        "fails CI when this table and the code drift (either direction). "
+        "Edit the",
+        "Description cells freely — regeneration preserves them by "
+        "variable name.",
+        "",
+        "| Variable | Parsed as | Default | Read by | Description |",
+        "|---|---|---|---|---|",
+    ]
+    for name in sorted(registered):
+        rec = registered[name]
+        readers = sorted(rec["readers"])
+        shown = ", ".join(f"`{r}`" for r in readers[:2])
+        if len(readers) > 2:
+            shown += f" +{len(readers) - 2}"
+        desc = old.get(name, "") or "(fill me in)"
+        lines.append(
+            f"| `{name}` | {rec['helper'][4:]} | "
+            f"{rec['default'] if rec['default'] is not None else '—'} | "
+            f"{shown} | {desc} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+# ---- chaos sites ----------------------------------------------------------
+
+#: FaultPlan arming methods whose first argument names a site
+_ARM_METHODS = {"fail", "exit", "truncate", "delay", "on_site"}
+
+
+@rule("chaos-site-registry",
+      description="chaos sites armed in tests exist at injection seams, "
+                  "and every production seam is referenced in tests/docs")
+def chaos_site_registry(index):
+    seams = index.string_call_args({"site"},
+                                   prefix=("paddle_tpu/", "tests/"))
+    # AST can't see seams inside triple-quoted subprocess scripts (the
+    # chaos E2E tests ship child programs as strings) — a textual scan
+    # catches those; it only ever ADDS seams, never removes
+    text_seams = set()
+    for fi in index.iter_files(("paddle_tpu/", "tests/")):
+        text_seams.update(re.findall(r"chaos\.site\(\s*\"([^\"]+)\"",
+                                     fi.source))
+    all_seams = set(seams) | text_seams
+    armed = index.string_call_args(_ARM_METHODS, prefix=("tests/",))
+    findings = []
+    for site in sorted(armed):
+        if site.endswith("*"):  # FaultRule.matches prefix pattern
+            if any(s.startswith(site[:-1]) for s in all_seams):
+                continue
+        elif site in all_seams:
+            continue
+        path, line = sorted(armed[site])[0]
+        findings.append(Finding(
+            path, line, "chaos-site-registry",
+            f"chaos site {site!r} is armed here but no chaos.site("
+            f"{site!r}) seam exists — the fault injects nothing and the "
+            f"test passes vacuously"))
+    # reverse: every production seam is exercised or documented somewhere
+    test_text = "".join(fi.source for fi in index.iter_files("tests/"))
+    doc_text = "\n".join(filter(None, (
+        index.doc(f"docs/{n}") for n in
+        ("CHAOS.md", "SERVING.md", "CHECKPOINTING.md", "ELASTIC.md",
+         "OBSERVABILITY.md", "ANALYSIS.md"))))
+    for site in sorted(seams):
+        paths = [p for p, _ in seams[site]]
+        if not any(p.startswith("paddle_tpu/") for p in paths):
+            continue  # test-local synthetic seams need no catalogue entry
+        if site in test_text or f"`{site}`" in doc_text:
+            continue
+        path, line = sorted(seams[site])[0]
+        findings.append(Finding(
+            path, line, "chaos-site-registry",
+            f"chaos seam {site!r} is neither exercised by any test nor "
+            f"documented — add it to the docs/CHAOS.md catalogue (or a "
+            f"test that arms it)"))
+    return findings

@@ -1,0 +1,66 @@
+"""Hot-path hygiene rules — ports of the ISSUE 2/4 ci.sh grep lints.
+
+AST-based where the greps were textual, so comments and docstrings no
+longer false-positive and string-embedded ``print(`` stops mattering.
+"""
+import ast
+
+from ..engine import Finding, rule
+from ..index import dotted
+
+#: files on the training/serving hot path: timing belongs in
+#: paddle_tpu.observability (spans + registry metrics), diagnostics in
+#: structured telemetry — never raw wall-clock reads or prints
+HOT_PATHS = (
+    "paddle_tpu/jit_api.py",
+    "paddle_tpu/distributed/train_step.py",
+    "paddle_tpu/inference/continuous.py",
+    "paddle_tpu/io/dataloader.py",
+    "paddle_tpu/distributed/communication/ops.py",
+    "paddle_tpu/serving/frontend.py",
+    "paddle_tpu/serving/scheduler.py",
+    "paddle_tpu/serving/router.py",
+)
+
+
+@rule("hot-path-timing",
+      description="no raw time.time()/print() in hot-path files — route "
+                  "timing/diagnostics through paddle_tpu.observability")
+def hot_path_timing(index):
+    findings = []
+    for path in HOT_PATHS:
+        fi = index.files.get(path)
+        if fi is None:
+            continue
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name == "time.time":
+                findings.append(Finding(
+                    fi.path, node.lineno, "hot-path-timing",
+                    "raw time.time() on a hot path — use time.monotonic/"
+                    "perf_counter feeding the observability registry"))
+            elif name == "print":
+                findings.append(Finding(
+                    fi.path, node.lineno, "hot-path-timing",
+                    "print() on a hot path — route diagnostics through "
+                    "paddle_tpu.observability"))
+    return findings
+
+
+@rule("serving-sleep",
+      description="no blocking time.sleep in the serving control plane — "
+                  "wait on the dispatcher wake event instead")
+def serving_sleep(index):
+    findings = []
+    for fi in index.iter_files("paddle_tpu/serving/"):
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Call) \
+                    and dotted(node.func) == "time.sleep":
+                findings.append(Finding(
+                    fi.path, node.lineno, "serving-sleep",
+                    "time.sleep holds a dispatcher hostage for the full "
+                    "duration — wait on the wake event "
+                    "(threading.Event.wait) instead"))
+    return findings

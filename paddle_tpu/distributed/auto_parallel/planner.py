@@ -126,9 +126,9 @@ def load_calibration(path):
 
 
 def _autoload_calibration():
-    import os
+    from ...utils.envs import env_str
 
-    p = os.environ.get("PADDLE_TPU_CALIBRATION")
+    p = env_str("PADDLE_TPU_CALIBRATION")
     if p:
         load_calibration(p)
 

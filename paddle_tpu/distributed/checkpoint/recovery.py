@@ -42,6 +42,7 @@ from ...observability import goodput as _goodput
 from ...observability import tracing as _tracing
 from ...observability import watchdog as _watchdog
 from ...observability.metrics import registry as _registry
+from ...utils.envs import env_float
 from ...utils.metrics_bus import counters
 
 __all__ = ["RecoveryResult", "resolve", "StepNegotiator",
@@ -347,10 +348,7 @@ def run_emergency_hooks(deadline_s=None):
     if not hooks:
         return 0
     if deadline_s is None:
-        try:
-            deadline_s = float(os.environ.get(EMERGENCY_DEADLINE_ENV, "") or 30.0)
-        except ValueError:
-            deadline_s = 30.0
+        deadline_s = env_float(EMERGENCY_DEADLINE_ENV, 30.0)
     t_end = time.perf_counter() + deadline_s
     ran = 0
     for fn in hooks:

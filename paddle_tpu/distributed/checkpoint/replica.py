@@ -33,6 +33,7 @@ from ...testing import chaos
 from ...utils.metrics_bus import counters
 from . import atomic
 from .atomic import atomic_write_bytes
+from ...utils.envs import env_str
 from .tiers import Snapshot, _env_int
 
 __all__ = ["PeerReplicator", "snapshot_path", "peer_meta_key",
@@ -81,7 +82,7 @@ class PeerReplicator:
     def __init__(self, directory=None, store=None, rank=None, world_size=None,
                  degree=None, group=None, group_ranks=None):
         self.dir = directory if directory is not None else \
-            os.environ.get(SNAPSHOT_DIR_ENV)
+            env_str(SNAPSHOT_DIR_ENV)
         self.store = store
         self.rank = rank if rank is not None else _env_int("PADDLE_TRAINER_ID", 0)
         self.world_size = world_size if world_size is not None else \
@@ -89,7 +90,7 @@ class PeerReplicator:
         self.degree = max(1, degree if degree is not None
                           else _env_int(REPLICA_DEGREE_ENV, 2))
         self.group = str(group if group is not None
-                         else os.environ.get(REPLICA_GROUP_ENV, "0"))
+                         else env_str(REPLICA_GROUP_ENV, "0"))
         if group_ranks is not None:
             self.group_ranks = sorted(int(r) for r in group_ranks)
         else:

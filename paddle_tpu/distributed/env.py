@@ -10,29 +10,31 @@ import os
 
 import jax
 
+from ..utils.envs import env_bool, env_str
+
 _initialized = False
 
 
 def get_rank():
-    return int(os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")))
+    return int(env_str("PADDLE_TRAINER_ID", os.environ.get("RANK", "0")) or 0)
 
 
 def get_world_size():
-    ws = os.environ.get("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE"))
+    ws = env_str("PADDLE_TRAINERS_NUM", os.environ.get("WORLD_SIZE"))
     if ws is not None:
         return int(ws)
     return 1
 
 
 def get_local_rank():
-    return int(os.environ.get("PADDLE_LOCAL_RANK", os.environ.get("LOCAL_RANK", "0")))
+    return int(env_str("PADDLE_LOCAL_RANK", os.environ.get("LOCAL_RANK", "0")) or 0)
 
 
 def get_master_endpoint():
-    ep = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ENDPOINT")
+    ep = env_str("PADDLE_MASTER") or os.environ.get("MASTER_ENDPOINT")
     if ep:
         return ep
-    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    eps = env_str("PADDLE_TRAINER_ENDPOINTS", "")
     if eps:
         return eps.split(",")[0]
     addr = os.environ.get("MASTER_ADDR")
@@ -53,7 +55,7 @@ def init_distributed(timeout_s=900):
     if _initialized:
         return
     world = get_world_size()
-    if world > 1 and os.environ.get("PADDLE_TPU_SKIP_JAX_DIST") != "1":
+    if world > 1 and not env_bool("PADDLE_TPU_SKIP_JAX_DIST"):
         coordinator = get_master_endpoint()
         jax.distributed.initialize(
             coordinator_address=coordinator,

@@ -16,6 +16,7 @@ import threading
 import time
 
 from ....framework.native import TCPStore
+from ....utils.envs import env_str
 from ....utils.metrics_bus import counters
 from . import fencing, membership  # noqa: F401  (public submodules)
 from .fencing import GenerationFence, StaleGenerationError  # noqa: F401
@@ -126,7 +127,7 @@ class ElasticManager:
         self.timeout = timeout
         self._store = store
         if self._store is None:
-            master = os.environ.get("PADDLE_MASTER")
+            master = env_str("PADDLE_MASTER")
             if master:
                 host, port = master.rsplit(":", 1)
                 try:

@@ -20,6 +20,7 @@ so there is no newer generation to protect.
 import os
 import threading
 
+from ....utils.envs import env_str
 from ....utils.metrics_bus import counters
 from .membership import GENERATION_ENV
 from .membership import generation as _membership_generation
@@ -94,11 +95,11 @@ def process_fence():
     with _fence_lock:
         if _process_fence is not None:
             return _process_fence
-        if not os.environ.get(GENERATION_ENV):
+        if not env_str(GENERATION_ENV):
             _process_fence = False
             return False
         store = None
-        master = os.environ.get("PADDLE_MASTER")
+        master = env_str("PADDLE_MASTER")
         if master:
             try:
                 from ....framework.native import TCPStore
@@ -109,7 +110,8 @@ def process_fence():
                 # fail the fence OPEN in seconds — a SIGTERM'd rank's
                 # 30s boundary-checkpoint grace cannot be spent blocked
                 # on the store's default 900s connect deadline
-                store = TCPStore(host, int(port), is_master=False, timeout=5)
+                store = TCPStore(  # lint: blocking-under-lock-ok (5s-bounded, once per process — the lock exists to dial exactly once)
+                    host, int(port), is_master=False, timeout=5)
             except Exception:
                 counters.bump("fault.elastic.fence_check_failed")
                 store = None  # fail open: fencing never blocks recovery

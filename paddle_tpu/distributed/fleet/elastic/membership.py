@@ -22,6 +22,7 @@ forever in step negotiation and peer discovery.
 import os
 
 from ....utils.envs import env_int as _env_int
+from ....utils.envs import env_str
 
 __all__ = ["RANK_ENV", "WORLD_ENV", "GENERATION_ENV", "LIVE_RANKS_ENV",
            "ORIG_WORLD_ENV", "rank", "world_size", "generation",
@@ -37,7 +38,7 @@ ORIG_WORLD_ENV = "PADDLE_ELASTIC_ORIG_WORLD"
 def rank():
     """This process's trainer rank: the launcher contract when present,
     else the jax process index (single-process runs -> 0)."""
-    r = os.environ.get(RANK_ENV)
+    r = env_str(RANK_ENV)
     if r:
         return int(r)
     import jax
@@ -48,7 +49,7 @@ def rank():
 def world_size():
     """The CURRENT job world size — the launcher contract when present
     (it shrinks/grows across elastic generations), else jax's."""
-    w = os.environ.get(WORLD_ENV)
+    w = env_str(WORLD_ENV)
     if w:
         return int(w)
     import jax
@@ -65,7 +66,7 @@ def live_ranks(world=None):
     """Sorted live-rank set. The launcher-published set wins when present;
     otherwise every rank of ``world`` (default: :func:`world_size`) is
     assumed live — the fixed-width case."""
-    raw = os.environ.get(LIVE_RANKS_ENV)
+    raw = env_str(LIVE_RANKS_ENV)
     if raw:
         return sorted(int(r) for r in raw.split(",") if r.strip() != "")
     return list(range(world if world is not None else world_size()))

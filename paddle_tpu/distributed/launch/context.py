@@ -5,6 +5,8 @@ import argparse
 import os
 import socket
 
+from ...utils.envs import env_bool, env_float, env_int, env_str
+
 
 def free_port():
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
@@ -54,21 +56,21 @@ def build_parser():
                         "build_mesh puts ONLY data parallelism on the "
                         "slice-crossing dcn_dp axis")
     p.add_argument("--hang_deadline", type=float,
-                   default=float(os.environ.get("PADDLE_HANG_DEADLINE_S", "0") or 0),
+                   default=env_float("PADDLE_HANG_DEADLINE_S", 0),
                    help="seconds without a rank step-heartbeat before the hang "
                         "watchdog dumps all-rank stacks + last spans to "
                         "<log_dir>/telemetry/hang_report.json (0 = off; env "
                         "PADDLE_HANG_DEADLINE_S sets the default)")
     p.add_argument("--hang_preempt", action="store_true",
-                   default=bool(os.environ.get("PADDLE_HANG_PREEMPT")),
+                   default=env_bool("PADDLE_HANG_PREEMPT"),
                    help="after the hang watchdog commits its diagnosis, "
                         "SIGTERM the stalled ranks so their preemption "
                         "handlers emergency-flush Tier-0 snapshots and the "
                         "watch loop restarts them into the checkpoint "
                         "recovery ladder (requires --hang_deadline > 0)")
     p.add_argument("--statusz_port", type=int,
-                   default=(int(os.environ["PADDLE_STATUSZ_PORT"])
-                            if os.environ.get("PADDLE_STATUSZ_PORT")
+                   default=(env_int("PADDLE_STATUSZ_PORT", 0)
+                            if env_str("PADDLE_STATUSZ_PORT") is not None
                             else None),
                    help="serve the live introspection endpoint (/statusz, "
                         "/varz Prometheus text, /tracez, /healthz — "

@@ -32,6 +32,7 @@ from ...framework.native import TCPStore
 from ...observability.metrics import registry as _registry
 from ...observability.watchdog import HangWatchdog, heartbeat_path
 from ...testing import chaos
+from ...utils.envs import env_str
 from ...utils.metrics_bus import counters
 from ..fleet.elastic import PREEMPTED_EXIT_CODE
 from ..fleet.elastic.fencing import GEN_STORE_KEY
@@ -169,7 +170,7 @@ class CollectiveController:
         # authkey authenticates nobody). Rank 0 generates it once and shares
         # it through the rendezvous store; every worker env gets it. PS/RPC
         # ports must still stay cluster-internal — see ps/service.py.
-        ps_authkey = os.environ.get("PADDLE_PS_AUTHKEY")
+        ps_authkey = env_str("PADDLE_PS_AUTHKEY")
         if not ps_authkey:
             if self.node_rank == 0:
                 ps_authkey = secrets.token_hex(16)
@@ -223,7 +224,7 @@ class CollectiveController:
             # telemetry is on — so default launches keep per-step heartbeat
             # I/O at exactly zero.
             if (getattr(args, "hang_deadline", 0) or 0) > 0 \
-                    or os.environ.get("PADDLE_TELEMETRY"):
+                    or env_str("PADDLE_TELEMETRY"):
                 env["PADDLE_TELEMETRY_DIR"] = self.telemetry_dir
             if args.devices:
                 env["FLAGS_selected_devices"] = args.devices

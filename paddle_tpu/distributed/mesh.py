@@ -26,6 +26,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ..utils.envs import env_int
+
 AXES = ("dcn_dp", "dp", "pp", "sharding", "sep", "mp")
 
 _global_mesh = None
@@ -65,7 +67,7 @@ def build_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, dcn_dp=None, slice_size=None
     devices = list(devices) if devices is not None else list(jax.devices())
     need = dp * mp * pp * sharding * sep
     if dcn_dp is None:
-        dcn_dp = int(os.environ.get("PADDLE_DCN_DP", "1"))
+        dcn_dp = env_int("PADDLE_DCN_DP", 1)
         if dcn_dp > 1 and need * dcn_dp > len(devices):
             if dp % dcn_dp == 0:
                 # a full-world dp request on a multi-slice system: dp and

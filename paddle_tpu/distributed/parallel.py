@@ -125,9 +125,9 @@ class ParallelEnv:
 
     @property
     def trainer_endpoints(self):
-        import os
+        from ..utils.envs import env_str
 
-        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        return (env_str("PADDLE_TRAINER_ENDPOINTS", "") or "").split(",")
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

@@ -37,6 +37,7 @@ policies. Recorded in API_MANIFEST.md.
 """
 import os
 
+from ...utils.envs import env_int, env_str
 from .service import PsClient, PsServer
 from .table import SparseTable
 
@@ -51,22 +52,22 @@ class PsRoleMaker:
 
     def __init__(self, role=None, server_endpoints=None, worker_num=None,
                  worker_index=None, server_index=None):
-        self.role = (role or os.environ.get("PADDLE_TRAINING_ROLE", "TRAINER")).upper()
-        eps = server_endpoints or os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self.role = (role or env_str("PADDLE_TRAINING_ROLE", "TRAINER")).upper()
+        eps = server_endpoints or env_str("PADDLE_PSERVERS_IP_PORT_LIST", "") or ""
         if isinstance(eps, str):
             eps = [e for e in eps.replace(";", ",").split(",") if e]
         self.server_endpoints = list(eps)
         self.worker_num = int(worker_num if worker_num is not None
-                              else os.environ.get("PADDLE_TRAINERS_NUM", 1))
+                              else env_int("PADDLE_TRAINERS_NUM", 1))
         self.worker_index = int(worker_index if worker_index is not None
-                                else os.environ.get("PADDLE_TRAINER_ID", 0))
+                                else env_int("PADDLE_TRAINER_ID", 0))
         if server_index is not None:
             self.server_index = int(server_index)
         else:
             # locate this server's endpoint: prefer the exact POD_IP:PORT
             # match (multi-host layouts reuse one port on every host), fall
             # back to port-only for single-host multi-port runs
-            port = os.environ.get("PADDLE_PORT")
+            port = env_str("PADDLE_PORT")
             pod_ip = os.environ.get("POD_IP")
             idx = 0
             if port:

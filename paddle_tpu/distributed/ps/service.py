@@ -26,6 +26,7 @@ from multiprocessing.connection import Client, Listener
 import numpy as np
 
 from ...testing import chaos
+from ...utils.envs import env_str
 from ...utils.retry import RetryPolicy
 from .table import SparseTable
 
@@ -34,7 +35,7 @@ def _authkey():
     """Per-cluster secret when the launcher provides one (see module
     docstring); resolved at call time so servers forked before the env was
     set still agree with late-joining clients."""
-    return os.environ.get("PADDLE_PS_AUTHKEY", "paddle-tpu-ps").encode()
+    return (env_str("PADDLE_PS_AUTHKEY", "paddle-tpu-ps") or "").encode()
 
 
 class PsServer:

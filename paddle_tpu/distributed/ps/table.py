@@ -101,6 +101,13 @@ class SparseTable:
                 raise ValueError(
                     f"checkpoint {attr}={meta[attr]!r} does not match table "
                     f"{attr}={getattr(self, attr)!r}")
+        # materialize OUTSIDE the lock (blocking-under-lock): the parse is
+        # O(rows) host work and `state` is caller-local, so only the two
+        # dict swaps below need to exclude concurrent pull/push
+        rows = {int(k): np.asarray(v, np.float32)
+                for k, v in state["rows"].items()}
+        g2 = {int(k): np.asarray(v, np.float32)
+              for k, v in state.get("g2", {}).items()}
         with self._lock:
-            self._rows = {int(k): np.asarray(v, np.float32) for k, v in state["rows"].items()}
-            self._g2 = {int(k): np.asarray(v, np.float32) for k, v in state.get("g2", {}).items()}
+            self._rows = rows
+            self._g2 = g2

@@ -15,6 +15,7 @@ import time
 from multiprocessing.connection import Client, Listener
 
 from ...testing import chaos
+from ...utils.envs import env_int, env_str
 from ...utils.retry import with_retries
 
 
@@ -22,7 +23,7 @@ def _authkey():
     """Pickle transport ⇒ auth is the only deserialization guard (see
     ps/service.py SECURITY note). The launcher's per-cluster secret
     (PADDLE_PS_AUTHKEY) covers RPC too; ports stay cluster-internal."""
-    return os.environ.get("PADDLE_PS_AUTHKEY", "paddle-tpu-rpc").encode()
+    return (env_str("PADDLE_PS_AUTHKEY", "paddle-tpu-rpc") or "").encode()
 
 
 def _advertise_ip(world_size):
@@ -30,7 +31,7 @@ def _advertise_ip(world_size):
     set, else the host's resolved address; loopback only for single-host."""
     if world_size <= 1:
         return "127.0.0.1"
-    ep = os.environ.get("PADDLE_CURRENT_ENDPOINT")
+    ep = env_str("PADDLE_CURRENT_ENDPOINT")
     if ep:
         return ep.rsplit(":", 1)[0]
     import socket
@@ -87,8 +88,9 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     also works.
     """
     global _current, _listener, _serving, _pool
-    rank = int(rank if rank is not None else os.environ.get("PADDLE_TRAINER_ID", 0))
-    world_size = int(world_size if world_size is not None else os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    rank = int(rank) if rank is not None else env_int("PADDLE_TRAINER_ID", 0)
+    world_size = (int(world_size) if world_size is not None
+                  else env_int("PADDLE_TRAINERS_NUM", 1))
     # bind all interfaces so cross-host peers can reach us; advertise a
     # routable address (endpoint env or resolved hostname), falling back to
     # loopback for single-host runs
@@ -106,7 +108,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         # rendezvous the launcher/init_parallel_env use)
         from ...framework.native import TCPStore
 
-        ep = master_endpoint or os.environ.get("PADDLE_MASTER") or os.environ.get(
+        ep = master_endpoint or env_str("PADDLE_MASTER") or os.environ.get(
             "MASTER_ENDPOINT", "127.0.0.1:49175"
         )
         host, p = ep.rsplit(":", 1)

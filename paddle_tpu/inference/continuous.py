@@ -149,8 +149,19 @@ class _StampedRLock:
 
     __slots__ = ("_lock", "_depth", "_since", "_holder", "_waiters")
 
-    def __init__(self):
+    def __init__(self, name=None):
         self._lock = threading.RLock()
+        if name is not None:
+            # label for the runtime lock-order sanitizer
+            # (testing/lockorder.py): the compile lock and every engine's
+            # dispatch lock are all born on the line above, and the
+            # sanitizer must keep them distinct order classes. A plain C
+            # RLock (sanitizer off) has no __dict__ — stamping is free to
+            # fail.
+            try:
+                self._lock._lo_name = name
+            except AttributeError:
+                pass
         self._depth = 0
         self._since = None  # monotonic start of the current outermost hold
         self._holder = None   # thread ident of the current holder
@@ -222,7 +233,7 @@ class _StampedRLock:
 #: behind a neighbor's compile). This replaces the pre-ISSUE-6
 #: process-wide ``_DISPATCH_LOCK`` that serialized every jitted call of
 #: every replica behind one lock.
-_COMPILE_LOCK = _StampedRLock()
+_COMPILE_LOCK = _StampedRLock(name="inference.compile_lock")
 
 #: canonical greedy sampling tuple — every greedy request shares ONE
 #: compiled prefill/decode program regardless of the knob values passed
@@ -457,7 +468,8 @@ class ContinuousBatchingEngine:
         # reproduce the pre-ISSUE-6 process-wide lock by sharing one
         # instance across baseline engines); first-trace additionally takes
         # the global _COMPILE_LOCK — see _locked_dispatch()
-        self.dispatch_lock = dispatch_lock or _StampedRLock()
+        self.dispatch_lock = dispatch_lock or _StampedRLock(
+            name="inference.dispatch_lock")
         self._warm = set()          # program keys that have run successfully
         self._last_dispatch_cold = False  # last _locked_dispatch traced?
         self._prefilling = {}       # slot -> _PrefillState (chunked prefill)
@@ -1024,8 +1036,8 @@ class ContinuousBatchingEngine:
         try:
             self._warmup_serves(prompt_lens, kw)
         finally:
-            self.enable_prefix_cache = pfx
-            self.stats = stats_before
+            self.enable_prefix_cache = pfx  # lint: shared-mutation-without-lock-ok (engine fields are dispatcher-owned — single-threaded by contract)
+            self.stats = stats_before  # lint: shared-mutation-without-lock-ok (same dispatcher-owned contract)
         if pfx and shared_prefix_lens:
             # compile the cache-HIT programs too: for each expected shared
             # prefix length, the page gather + suffix prefill a matching
@@ -1381,17 +1393,20 @@ class ContinuousBatchingEngine:
         ids_p[0, :suffix_len] = prompt[n_pre * bs_:]
         progs = ([("gather", n_pre), ("suffix", n_pre, sbucket, sampling)]
                  if n_pre else [("prefill", sbucket, sampling)])
+        if sampling[0] and req.key_base is None:
+            # key_base = fold_in(PRNGKey(seed), rid): the request's own
+            # stream root, so its sampled tokens are independent of which
+            # co-tenants (or which replica) it landed with. Materialized
+            # BEFORE the locked dispatch (blocking-under-lock): it depends
+            # only on (seed, rid) — pure jax, no framework Tensor state —
+            # and its 8-byte device->host pull must not extend the hold
+            # every sibling dispatcher queues behind
+            req.key_base = np.asarray(
+                jax.random.fold_in(jax.random.PRNGKey(req.seed), req.rid))
         t_p0 = time.monotonic()
         try:
             with self._locked_dispatch(*progs, ("insert", sbucket)), \
                     _trace.span("serve.prefill"), self._xprof_annotation(req):
-                if sampling[0] and req.key_base is None:
-                    # key_base = fold_in(PRNGKey(seed), rid): the request's
-                    # own stream root, so its sampled tokens are independent
-                    # of which co-tenants (or which replica) it landed with
-                    req.key_base = np.asarray(
-                        jax.random.fold_in(jax.random.PRNGKey(req.seed),
-                                           req.rid))
                 k0 = (jax.random.fold_in(jnp.asarray(req.key_base), 0)
                       if sampling[0]
                       else jnp.zeros((2,), jnp.uint32))  # greedy ignores it
@@ -1522,14 +1537,15 @@ class ContinuousBatchingEngine:
         ids[0, :clen] = prompt[done_tokens:done_tokens + clen]
         progs = ([("gather", filled), ("suffix", filled, cbucket, sampling)]
                  if filled else [("prefill", cbucket, sampling)])
+        if final and sampling[0] and req.key_base is None:
+            # same hoist as the unchunked admission path: (seed, rid)-only
+            # work plus an 8-byte pull stays outside the locked dispatch
+            req.key_base = np.asarray(
+                jax.random.fold_in(jax.random.PRNGKey(req.seed), req.rid))
         t_p0 = time.monotonic()
         try:
             with self._locked_dispatch(*progs, ("insert", cbucket)), \
                     _trace.span("serve.prefill"), self._xprof_annotation(req):
-                if final and sampling[0] and req.key_base is None:
-                    req.key_base = np.asarray(
-                        jax.random.fold_in(jax.random.PRNGKey(req.seed),
-                                           req.rid))
                 k0 = (jax.random.fold_in(jnp.asarray(req.key_base), 0)
                       if final and sampling[0]
                       else jnp.zeros((2,), jnp.uint32))
@@ -1782,7 +1798,7 @@ class ContinuousBatchingEngine:
                 # replicas sharing a lock serialize their compute. The
                 # async path's readback is lock-free in _process_block.
                 host = np.asarray(blk)  # serve-readback-ok
-        self.pools = list(pools)
+        self.pools = list(pools)  # lint: shared-mutation-without-lock-ok (engine fields are dispatcher-owned — single-threaded by contract)
         cold = self._last_dispatch_cold
         if _trace.enabled() and cold:
             # a cold decode dispatch spent its wall tracing, not decoding —
@@ -1978,7 +1994,7 @@ class ContinuousBatchingEngine:
         # can raise): escalating the error bound or counting requests first
         # would leak past the finally below, which only runs once the try
         # is entered
-        self.request_errors = {}
+        self.request_errors = {}  # lint: shared-mutation-without-lock-ok (serve() owns the engine for the batch — single caller by contract)
         # every failed rid of THIS batch keeps its entry, however large
         self._request_errors_bound = max(1024, len(prompts))
         queue = deque(reqs)

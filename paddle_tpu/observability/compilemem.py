@@ -56,6 +56,7 @@ import weakref
 from collections import OrderedDict, deque
 from contextlib import contextmanager, nullcontext
 
+from ..utils.envs import env_bool, env_int, env_str
 from .metrics import registry as _registry
 
 __all__ = [
@@ -92,8 +93,8 @@ _M_OOM = _registry.counter(
 
 
 def _rank():
-    return os.environ.get("PADDLE_TRAINER_ID",
-                          os.environ.get("RANK", "0")) or "0"
+    return env_str("PADDLE_TRAINER_ID",
+                   os.environ.get("RANK", "0")) or "0"
 
 
 def compiling_path(directory, rank):
@@ -123,14 +124,12 @@ class CompileLedger:
         self._active = {}
         self._counter = itertools.count(1)
         self._local = threading.local()
-        self.churn_threshold = int(
-            churn_threshold
-            if churn_threshold is not None
-            else os.environ.get("PADDLE_COMPILE_CHURN_THRESHOLD", "3"))
-        self.cache_warn_bound = int(
-            cache_warn_bound
-            if cache_warn_bound is not None
-            else os.environ.get("PADDLE_COMPILE_CACHE_WARN", "64"))
+        self.churn_threshold = (int(churn_threshold)
+                                if churn_threshold is not None
+                                else env_int("PADDLE_COMPILE_CHURN_THRESHOLD", 3))
+        self.cache_warn_bound = (int(cache_warn_bound)
+                                 if cache_warn_bound is not None
+                                 else env_int("PADDLE_COMPILE_CACHE_WARN", 64))
 
     # ---- trigger / suppression scopes ------------------------------------
     @contextmanager
@@ -280,7 +279,7 @@ class CompileLedger:
         the launcher-side hang watchdog can say 'rank 3 is 214 s into
         compiling train.step', cross-process. Removed when nothing is in
         flight. Never raises (a full disk must not kill a compile)."""
-        d = os.environ.get("PADDLE_TELEMETRY_DIR")
+        d = env_str("PADDLE_TELEMETRY_DIR")
         if not d:
             return
         path = compiling_path(d, _rank())
@@ -617,7 +616,7 @@ class MemoryLedger:
         """Device memory capacity: ``PADDLE_HBM_CAPACITY_BYTES`` env
         override first (CPU hosts have no HBM), else the backend's
         ``memory_stats()['bytes_limit']`` when it exposes one."""
-        env = os.environ.get("PADDLE_HBM_CAPACITY_BYTES")
+        env = env_str("PADDLE_HBM_CAPACITY_BYTES")
         if env:
             try:
                 return int(float(env))
@@ -854,7 +853,7 @@ def _collect_oom_contexts():
 
 
 def oom_report_path():
-    d = os.environ.get("PADDLE_TELEMETRY_DIR") or "telemetry"
+    d = env_str("PADDLE_TELEMETRY_DIR") or "telemetry"
     return os.path.join(d, OOM_REPORT_NAME)
 
 
@@ -866,8 +865,7 @@ def write_oom_report(exc, program=None, path=None, analyze=None):
     raises — forensics must not mask the original exception."""
     try:
         if analyze is None:
-            analyze = os.environ.get("PADDLE_OOM_ANALYZE", "1") not in (
-                "0", "false", "no")
+            analyze = env_bool("PADDLE_OOM_ANALYZE", True)
         if analyze:
             try:
                 memory.analyze()

@@ -31,6 +31,7 @@ import os
 import threading
 import time
 
+from ..utils.envs import env_str
 from . import compilemem, goodput, request_trace, tracing
 from .metrics import registry as _registry
 
@@ -56,7 +57,7 @@ class StatusServer:
         # processes fall back to their env contract
         self.elastic_info = elastic_info
         self.telemetry_dir = (telemetry_dir
-                              or os.environ.get("PADDLE_TELEMETRY_DIR"))
+                              or env_str("PADDLE_TELEMETRY_DIR"))
         self.heartbeat_stale_s = float(heartbeat_stale_s)
         self.tracez_n = int(tracez_n)
         self._t0 = time.time()
@@ -109,7 +110,7 @@ class StatusServer:
             "world_size": env_int("PADDLE_TRAINERS_NUM", 0) or None,
             "live_ranks": None,
         }
-        raw = os.environ.get("PADDLE_ELASTIC_RANKS")
+        raw = env_str("PADDLE_ELASTIC_RANKS")
         if raw:
             try:
                 out["live_ranks"] = [int(r) for r in raw.split(",")

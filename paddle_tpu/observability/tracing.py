@@ -37,6 +37,8 @@ import sys
 import threading
 import time
 
+from ..utils.envs import env_bool, env_str
+
 __all__ = ["span", "enable", "disable", "enabled", "last_spans",
            "add_jsonl_sink", "clear_sinks", "JsonlSpanSink", "emit_record"]
 
@@ -63,8 +65,7 @@ def _small_tid():
 
 def _resolve_enabled():
     global _ENABLED
-    _ENABLED = os.environ.get("PADDLE_TELEMETRY", "").lower() in (
-        "1", "true", "yes", "on")
+    _ENABLED = env_bool("PADDLE_TELEMETRY")
     if _ENABLED:
         _autoconfigure_sinks()
     return _ENABLED
@@ -107,10 +108,10 @@ def _autoconfigure_sinks():
     tails for its per-rank last-N-spans report. Idempotent: repeated
     enable() calls attach the sink once."""
     global _autosink_path
-    d = os.environ.get("PADDLE_TELEMETRY_DIR")
+    d = env_str("PADDLE_TELEMETRY_DIR")
     if not d:
         return
-    rank = os.environ.get("PADDLE_TRAINER_ID", os.environ.get("RANK", "0"))
+    rank = env_str("PADDLE_TRAINER_ID", os.environ.get("RANK", "0"))
     path = os.path.join(d, f"spans.{rank}.jsonl")
     if path == _autosink_path and any(
             getattr(s, "path", None) == path for s in _sinks):

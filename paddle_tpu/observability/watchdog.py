@@ -30,6 +30,8 @@ import signal
 import threading
 import time
 
+from ..utils.envs import env_str
+
 __all__ = ["Heartbeat", "HangWatchdog", "maybe_beat", "heartbeat_path",
            "stacks_path", "spans_path", "REPORT_NAME", "DIR_ENV",
            "DEADLINE_ENV"]
@@ -137,12 +139,12 @@ def _env_heartbeat():
     hb = _process_hb
     if hb is not None:
         return hb
-    d = os.environ.get(DIR_ENV)
+    d = env_str(DIR_ENV)
     if not d:
         _process_hb = False
         return False
-    rank = os.environ.get("PADDLE_TRAINER_ID",
-                          os.environ.get("RANK", "0")) or "0"
+    rank = env_str("PADDLE_TRAINER_ID",
+                   os.environ.get("RANK", "0")) or "0"
     try:
         hb = _process_hb = Heartbeat(d, int(rank))
     except (OSError, ValueError):

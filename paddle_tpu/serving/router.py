@@ -38,6 +38,7 @@ import time
 
 from ..observability.metrics import registry as _registry
 from ..testing import chaos
+from ..utils.envs import env_str
 
 __all__ = ["LIVE", "DRAINING", "DEAD", "NoLiveReplicas", "ReplicaHandle",
            "Router"]
@@ -79,7 +80,7 @@ class ReplicaHandle:
         # same rank from the pod HangWatchdog (and vice versa)
         self._wd_heartbeat = None
         self._wd_last_write = 0.0
-        d = os.environ.get("PADDLE_TELEMETRY_DIR")
+        d = env_str("PADDLE_TELEMETRY_DIR")
         if d:
             try:
                 from ..observability.watchdog import Heartbeat

@@ -291,8 +291,10 @@ def site(name, path=None):
         if _ENV_PARSED:
             return
         _ENV_PARSED = True
-        spec = os.environ.get("PADDLE_CHAOS")
+        from ..utils.envs import env_int, env_str
+
+        spec = env_str("PADDLE_CHAOS")
         if not spec:
             return
-        arm(parse_env_spec(spec, seed=int(os.environ.get("PADDLE_CHAOS_SEED", "0"))))
+        arm(parse_env_spec(spec, seed=env_int("PADDLE_CHAOS_SEED", 0)))
     _PLAN.on_site(name, path=path)

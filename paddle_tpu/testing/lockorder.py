@@ -1,0 +1,297 @@
+"""Runtime lock-order sanitizer (ISSUE 10 tentpole part e).
+
+The static ``lock-order`` rule sees ``with`` statements; it cannot see
+acquisition orders assembled through indirection — locks passed as
+arguments, factories, ExitStacks, callbacks. This module records the
+orders that ACTUALLY happen while the test suite runs and reports
+inversions: lock pairs observed nested in both directions, which is a
+deadlock waiting for the two threads to interleave.
+
+Opt-in and zero-cost when off: arm with ``PADDLE_LOCKORDER=1`` —
+``tests/conftest.py`` boot-loads this module BEFORE anything imports
+``paddle_tpu`` (module-level locks like the engine compile lock must be
+created through the patched factories) and fails the session on
+inversions. Only locks ALLOCATED from repo code (``paddle_tpu/`` or
+``tests/`` frames) are tracked; stdlib/jax internals keep real primitives.
+
+Lock identity is the allocation site (``file:line``), or an explicit
+label: a lock wrapper can stamp ``_lo_name`` on a tracked inner lock
+(see ``_StampedRLock(name=...)``) so the compile lock and the per-engine
+dispatch locks — born on the same source line — stay distinct order
+classes.
+
+No dependencies; importable standalone by path (the conftest boot
+requirement — importing the ``paddle_tpu`` package would create its
+locks before the patch lands).
+"""
+import json
+import os
+import sys
+import threading
+
+__all__ = ["Graph", "install", "installed", "graph", "report",
+           "wrap_lock"]
+
+_REPO_MARKERS = (os.sep + "paddle_tpu" + os.sep, os.sep + "tests" + os.sep)
+
+
+def _alloc_site():
+    """file:line of the nearest stack frame outside this module and
+    threading.py — where the lock was born (or acquired)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.endswith(("lockorder.py", "threading.py")):
+            return f"{os.path.basename(fn)}:{f.f_lineno}", fn
+        f = f.f_back
+    return "<unknown>", ""
+
+
+class Graph:
+    """The observed acquisition-order graph. Thread-safe via one private
+    REAL lock (allocated before install() patches the factories when used
+    as the global graph; explicitly real otherwise)."""
+
+    def __init__(self, lock_factory=threading.Lock):
+        self._mu = lock_factory()
+        self._tls = threading.local()
+        #: (a, b) -> {"count": n, "where": "file:line of b's acquire"}
+        self.edges = {}
+        #: (node, id_lo, id_hi) -> set of "asc"/"desc" — same-order-class
+        #: instance pairs (two engines' dispatch locks) nested both ways
+        #: are the classic peer-instance deadlock
+        self.instance_orders = {}
+
+    def _held(self):
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def note_acquired(self, node, inst):
+        held = self._held()
+        site, _ = _alloc_site()
+        new_edges = []
+        for (h_node, h_inst) in held:
+            if h_node != node:
+                new_edges.append((h_node, node))
+            elif h_inst != inst:
+                key = (node, min(h_inst, inst), max(h_inst, inst))
+                orient = "asc" if h_inst < inst else "desc"
+                with self._mu:
+                    self.instance_orders.setdefault(key, set()).add(orient)
+        if new_edges:
+            with self._mu:
+                for e in new_edges:
+                    rec = self.edges.get(e)
+                    if rec is None:
+                        self.edges[e] = {"count": 1, "where": site}
+                    else:
+                        rec["count"] += 1
+        held.append((node, inst))
+
+    def note_released(self, node, inst):
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == (node, inst):
+                del held[i]
+                return
+
+    # ---- reporting -------------------------------------------------------
+    def inversions(self):
+        """Lock-order violations observed so far: 2-cycles (and longer
+        cycles) in the node graph, plus peer-instance both-ways nestings
+        of one order class."""
+        with self._mu:
+            edges = {k: dict(v) for k, v in self.edges.items()}
+            inst = {k: set(v) for k, v in self.instance_orders.items()}
+        graph = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        out, seen = [], set()
+        # cycles via DFS (2-cycles dominate in practice; longer ones are
+        # reported from whichever node the DFS enters them)
+        def dfs(node, path, on_path):
+            for nxt in sorted(graph.get(node, ())):
+                if nxt in on_path:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    key = frozenset(cyc)
+                    if key not in seen:
+                        seen.add(key)
+                        out.append({
+                            "kind": "cycle",
+                            "nodes": cyc,
+                            "sites": [edges[(x, y)]["where"]
+                                      for x, y in zip(cyc, cyc[1:])],
+                        })
+                elif nxt not in visited:
+                    on_path.add(nxt)
+                    dfs(nxt, path + [nxt], on_path)
+                    on_path.discard(nxt)
+            visited.add(node)
+
+        visited = set()
+        for start in sorted(graph):
+            if start not in visited:
+                dfs(start, [start], {start})
+        for (node, lo, hi), orients in sorted(inst.items()):
+            if len(orients) > 1:
+                out.append({"kind": "instance-order",
+                            "nodes": [node, node],
+                            "sites": [f"two instances of {node} nested "
+                                      f"in both orders"]})
+        return out
+
+    def report(self):
+        with self._mu:
+            n_edges = len(self.edges)
+        return {"edges": n_edges, "inversions": self.inversions()}
+
+
+class _TrackedLock:
+    """Order-tracking proxy over a real Lock/RLock. Forwards everything
+    it doesn't instrument (``_is_owned`` etc. keep Condition working)."""
+
+    def __init__(self, inner, graph, name):
+        self._lo_inner = inner
+        self._lo_graph = graph
+        self._lo_name = name
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._lo_inner.acquire(blocking, timeout)
+        if ok:
+            self._lo_graph.note_acquired(self._lo_name, id(self))
+        return ok
+
+    def release(self):
+        self._lo_inner.release()
+        self._lo_graph.note_released(self._lo_name, id(self))
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, *exc_info):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lo_inner.locked()
+
+    def __getattr__(self, attr):
+        return getattr(self._lo_inner, attr)
+
+    def __repr__(self):
+        return f"<lockorder-tracked {self._lo_name} {self._lo_inner!r}>"
+
+
+class _TrackedCondition(_TrackedLock):
+    """Condition proxy: acquire/release tracked like a lock; wait/notify
+    forwarded (wait's internal release/re-acquire of the underlying lock
+    happens while this thread is blocked — it records nothing, so the
+    held stack stays consistent)."""
+
+    def wait(self, timeout=None):
+        return self._lo_inner.wait(timeout)
+
+    def wait_for(self, predicate, timeout=None):
+        return self._lo_inner.wait_for(predicate, timeout)
+
+    def notify(self, n=1):
+        return self._lo_inner.notify(n)
+
+    def notify_all(self):
+        return self._lo_inner.notify_all()
+
+
+_GLOBAL = None
+_ORIG = {}
+
+
+def installed():
+    return _GLOBAL is not None
+
+
+def graph():
+    return _GLOBAL
+
+
+def wrap_lock(inner, name, graph_=None):
+    """Explicitly wrap ``inner`` as a tracked lock named ``name`` —
+    the unit-test surface (works without install())."""
+    return _TrackedLock(inner, graph_ or _GLOBAL or Graph(), name)
+
+
+def install():
+    """Patch the threading lock factories; idempotent. Everything
+    allocated FROM REPO CODE after this call is tracked."""
+    global _GLOBAL
+    if _GLOBAL is not None:
+        return _GLOBAL
+    _GLOBAL = Graph(lock_factory=threading.Lock)  # real lock, pre-patch
+    _ORIG["Lock"] = threading.Lock
+    _ORIG["RLock"] = threading.RLock
+    _ORIG["Condition"] = threading.Condition
+
+    def _repo_alloc():
+        _, fn = _alloc_site()
+        return any(m in fn for m in _REPO_MARKERS)
+
+    def make_lock():
+        inner = _ORIG["Lock"]()
+        if not _repo_alloc():
+            return inner
+        site, _ = _alloc_site()
+        return _TrackedLock(inner, _GLOBAL, f"Lock@{site}")
+
+    def make_rlock():
+        inner = _ORIG["RLock"]()
+        if not _repo_alloc():
+            return inner
+        site, _ = _alloc_site()
+        return _TrackedLock(inner, _GLOBAL, f"RLock@{site}")
+
+    def make_condition(lock=None):
+        if isinstance(lock, _TrackedLock):
+            # the passed lock is already tracked — every cond acquire
+            # flows through its proxy; a second wrapper would double-count
+            return _ORIG["Condition"](lock)
+        if not _repo_alloc():
+            return _ORIG["Condition"](lock)
+        site, _ = _alloc_site()
+        # build over a REAL inner lock: tracking belongs to the condition
+        # node, not to a second shadow node for its internal lock
+        inner = _ORIG["Condition"](lock if lock is not None
+                                   else _ORIG["RLock"]())
+        return _TrackedCondition(inner, _GLOBAL, f"Condition@{site}")
+
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    threading.Condition = make_condition
+    return _GLOBAL
+
+
+def uninstall():
+    """Restore the real factories (test hook). Locks already created keep
+    their proxies; the global graph is dropped."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        return
+    threading.Lock = _ORIG.pop("Lock")
+    threading.RLock = _ORIG.pop("RLock")
+    threading.Condition = _ORIG.pop("Condition")
+    _GLOBAL = None
+
+
+def report(path=None):
+    """The global graph's report; optionally committed to ``path`` as
+    JSON. ``{"edges": 0, "inversions": []}`` when never installed."""
+    rep = _GLOBAL.report() if _GLOBAL is not None else \
+        {"edges": 0, "inversions": []}
+    if path:
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(rep, f, indent=1)
+        except OSError:
+            pass
+    return rep

@@ -1,8 +1,23 @@
 """Tiny env-parsing helpers shared across the stack (no dependencies —
-importable from anywhere, including early-importing modules)."""
+importable from anywhere, including early-importing modules).
+
+These are THE blessed readers for ``PADDLE_*`` configuration: the
+``env-registry`` analysis rule (docs/ANALYSIS.md) fails CI on any raw
+``os.environ``/``os.getenv`` read of a ``PADDLE_*`` name elsewhere in
+``paddle_tpu/``, and every name passed to these helpers must have a row
+in the generated docs/ENVS.md table. One choke point means one place
+that armors against garbage values (a typo'd env var must never crash a
+process), one place tests can reason about, and one registry the docs
+are generated from. Writes (``os.environ[...] = ...`` — the launcher
+exporting contract vars to children) are not reads and stay direct.
+"""
 import os
 
-__all__ = ["env_int"]
+__all__ = ["env_int", "env_float", "env_bool", "env_str"]
+
+#: truthy spellings for env_bool — everything else (including unset and
+#: garbage) is False unless a different default is passed
+_TRUE = ("1", "true", "yes", "on")
 
 
 def env_int(name, default):
@@ -12,3 +27,26 @@ def env_int(name, default):
         return int(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+def env_float(name, default):
+    """float(os.environ[name]) with ``default`` for unset/empty/garbage."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def env_bool(name, default=False):
+    """True for '1'/'true'/'yes'/'on' (case-insensitive), False for any
+    other SET value, ``default`` when unset/empty."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    return raw.strip().lower() in _TRUE
+
+
+def env_str(name, default=None):
+    """os.environ.get with empty-string treated as unset (a launcher that
+    exports ``PADDLE_X=`` to clear a knob means 'not set')."""
+    return os.environ.get(name, "") or default

@@ -23,257 +23,29 @@ cd "$(dirname "$0")/.."
 # libstdc++ is "up to date" by mtime yet unloadable. The loader smoke-imports.
 if command -v g++ >/dev/null; then
   make -B -C native >/dev/null
-  python - <<'PY'
-from paddle_tpu.framework.native import load_native
-lib = load_native()
-assert lib is not None, "rebuilt libpaddle_tpu_native.so failed to load"
-PY
+  python -c "from paddle_tpu.framework.native import load_native; \
+assert load_native() is not None, \
+'rebuilt libpaddle_tpu_native.so failed to load'"
 fi
 
-# telemetry lint (ISSUE 2 satellite): hot-path files must not hand-roll
-# wall-clock timing or print diagnostics — that data belongs in
-# paddle_tpu/observability (spans, registry metrics) where every layer's
-# telemetry lands in ONE place. time.monotonic/perf_counter feeding the
-# registry are fine; raw time.time() and print() are not.
-HOT_PATHS=(
-  paddle_tpu/jit_api.py
-  paddle_tpu/distributed/train_step.py
-  paddle_tpu/inference/continuous.py
-  paddle_tpu/io/dataloader.py
-  paddle_tpu/distributed/communication/ops.py
-  paddle_tpu/serving/frontend.py
-  paddle_tpu/serving/scheduler.py
-  paddle_tpu/serving/router.py
-)
-if grep -nE '\btime\.time\(|(^|[^.[:alnum:]_])print\(' "${HOT_PATHS[@]}"; then
-  echo "lint: raw time.time()/print() in hot-path files above —" \
-       "route timing/diagnostics through paddle_tpu.observability" >&2
+# static analysis (ISSUE 10): every lint that used to live here as a
+# grep/heredoc — hot-path timing, serving sleeps, decode host-syncs,
+# compile-ledger completeness, metric-doc drift, checkpoint atomic
+# writes, elastic membership — plus the concurrency rules (lock-order,
+# blocking-under-lock, shared-mutation) and the env/chaos registries is
+# now a rule plugin in paddle_tpu/analysis (ONE shared parse, testable,
+# suppressible — docs/ANALYSIS.md). The wall-clock budget guards the
+# "single shared parse is faster than five parse-the-world heredocs"
+# property: the old lint phase ran five python processes; if this one
+# invocation ever crawls past the budget, the engine regressed.
+lint_t0=$SECONDS
+JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --ci
+lint_wall=$((SECONDS - lint_t0))
+if (( lint_wall > ${CI_LINT_BUDGET:-60} )); then
+  echo "lint: analysis phase took ${lint_wall}s" \
+       "(budget ${CI_LINT_BUDGET:-60}s) — profile the engine" >&2
   exit 1
 fi
-
-# serving hot-path lint (ISSUE 4 satellite): the control plane must never
-# blocking-sleep — the only legal wait is the dispatcher's wake-EVENT
-# timeout (threading.Event/Condition waits, which a submit or a shutdown
-# interrupts instantly). A time.sleep anywhere in paddle_tpu/serving/ is a
-# latency bug: it holds a dispatcher hostage for the full duration.
-if grep -nE '\btime\.sleep\(' paddle_tpu/serving/*.py; then
-  echo "lint: blocking time.sleep in paddle_tpu/serving/ above — wait on" \
-       "the dispatcher wake event (threading.Event.wait) instead" >&2
-  exit 1
-fi
-
-# serving data-plane sync lint (ISSUE 6 satellite): the decode dispatch
-# critical section must never block on a host sync (np.asarray on device
-# values, block_until_ready, device_get) outside the designated readback
-# point — an accidental sync there un-hides exactly the dispatch latency
-# the double-buffered pipeline exists to hide. The allowlist is the
-# `serve-readback-ok` marker on the designated readback lines.
-python - <<'PY'
-import ast, re, sys
-
-SRC = "paddle_tpu/inference/continuous.py"
-DECODE_FNS = {"step", "_dispatch_decode", "_process_block",
-              "_advance_prefill", "drain"}
-# (?<!j) spares jnp.asarray — a host->device UPLOAD never blocks on the
-# device; the forbidden direction is device->host
-SYNC = re.compile(r"(?<!j)np\.asarray\(|block_until_ready|device_get")
-src = open(SRC).read()
-lines = src.splitlines()
-bad = []
-for node in ast.walk(ast.parse(src)):
-    if isinstance(node, ast.FunctionDef) and node.name in DECODE_FNS:
-        for ln in range(node.lineno, node.end_lineno + 1):
-            text = lines[ln - 1]
-            if "serve-readback-ok" in text:
-                continue
-            if SYNC.search(text):
-                bad.append((ln, text.strip()))
-if bad:
-    for ln, text in bad:
-        print(f"{SRC}:{ln}: {text}")
-    print("lint: blocking host sync inside the decode dispatch critical "
-          "section — move it to the designated readback point (or tag a "
-          "deliberate readback with  # serve-readback-ok)", file=sys.stderr)
-    sys.exit(1)
-PY
-
-# compile-ledger completeness lint (ISSUE 8 satellite): every XLA compile
-# site in paddle_tpu/ must flow through observability/compilemem.py —
-# ledgered_jit for jit sites, record_compile for AOT export sites — so the
-# compile ledger (/compilez, churn detection, OOM forensics) is complete by
-# CONSTRUCTION. A raw jax.jit reference or a .lower(...).compile() chain
-# anywhere else is a blind spot; the compile-ledger-ok marker is the
-# allowlist (the wrapper itself + AOT sites already bracketed by
-# record_compile on the same line).
-python - <<'PY'
-import ast, os, sys
-
-bad = []
-for root, dirs, files in os.walk("paddle_tpu"):
-    for fn in files:
-        if not fn.endswith(".py"):
-            continue
-        path = os.path.join(root, fn)
-        src = open(path).read()
-        lines = src.splitlines()
-        try:
-            tree = ast.parse(src)
-        except SyntaxError:
-            continue
-        for node in ast.walk(tree):
-            hit = None
-            # any `jax.jit` reference (call, partial, decorator)
-            if (isinstance(node, ast.Attribute) and node.attr == "jit"
-                    and isinstance(node.value, ast.Name)
-                    and node.value.id == "jax"):
-                hit = "raw jax.jit"
-            # <expr>.lower(...).compile(...) AOT chains
-            elif (isinstance(node, ast.Call)
-                  and isinstance(node.func, ast.Attribute)
-                  and node.func.attr == "compile"
-                  and isinstance(node.func.value, ast.Call)
-                  and isinstance(node.func.value.func, ast.Attribute)
-                  and node.func.value.func.attr == "lower"):
-                hit = ".lower(...).compile()"
-            if hit is None:
-                continue
-            line = lines[node.lineno - 1]
-            if "compile-ledger-ok" in line:
-                continue
-            bad.append((path, node.lineno, hit, line.strip()))
-if bad:
-    for path, ln, hit, text in bad:
-        print(f"{path}:{ln}: {hit}: {text}")
-    print("lint: compile site bypasses the compile ledger — use "
-          "observability.compilemem.ledgered_jit / record_compile (or tag "
-          "a deliberate exception with  # compile-ledger-ok)",
-          file=sys.stderr)
-    sys.exit(1)
-PY
-
-# metric/span doc drift lint (ISSUE 7 satellite): every metric/span name
-# LITERAL registered in paddle_tpu/ must appear in a docs/OBSERVABILITY.md
-# table first cell, and every non-wildcard documented name must still be
-# registered — dashboards and scrapers can trust the doc tables. Dynamic
-# names (f-strings) are documented with <...> placeholders, which match as
-# wildcards forward and are exempt from the reverse check.
-python - <<'PY'
-import ast, os, re, sys
-
-REG_ATTRS = {"counter", "gauge", "histogram", "bump",   # metrics registry
-             "span",                                     # thread spans
-             "child", "event", "begin", "span_at",       # request-trace
-             "_class_hist"}                              # frontend families
-registered = {}
-for root, dirs, files in os.walk("paddle_tpu"):
-    for fn in files:
-        if not fn.endswith(".py"):
-            continue
-        path = os.path.join(root, fn)
-        try:
-            tree = ast.parse(open(path).read())
-        except SyntaxError:
-            continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or not node.args:
-                continue
-            a0 = node.args[0]
-            if not (isinstance(a0, ast.Constant) and isinstance(a0.value, str)):
-                continue
-            f = node.func
-            attr = (f.attr if isinstance(f, ast.Attribute)
-                    else f.id if isinstance(f, ast.Name) else None)
-            if attr in REG_ATTRS:
-                registered.setdefault(a0.value, set()).add(path)
-
-NAME = re.compile(r"[a-z][a-z0-9_.<>*]*\Z")
-doc_names, doc_patterns = set(), []
-for line in open("docs/OBSERVABILITY.md"):
-    if not line.startswith("|"):
-        continue
-    first = line.split("|")[1]
-    for tok in re.findall(r"`([^`]+)`", first):
-        if not NAME.match(tok):
-            continue
-        if "<" in tok or "*" in tok:
-            part = re.sub(r"<[^>]+>", "WILDCARDMARK", tok)
-            pat = (re.escape(part)
-                   .replace("WILDCARDMARK", "[A-Za-z0-9_.]+")
-                   .replace(re.escape("*"), "[A-Za-z0-9_.]+"))
-            doc_patterns.append(re.compile(pat + r"\Z"))
-        else:
-            doc_names.add(tok)
-
-undocumented = sorted(
-    n for n in registered
-    if n not in doc_names and not any(p.match(n) for p in doc_patterns))
-stale = sorted(n for n in doc_names if n not in registered)
-ok = True
-if undocumented:
-    ok = False
-    for n in undocumented:
-        print(f"undocumented name {n!r} (registered in "
-              f"{sorted(registered[n])[0]}) — add it to a "
-              f"docs/OBSERVABILITY.md table")
-if stale:
-    ok = False
-    for n in stale:
-        print(f"documented name {n!r} is not registered anywhere in "
-              f"paddle_tpu/ — remove the row or fix the name")
-if not ok:
-    print("lint: docs/OBSERVABILITY.md metric/span tables drifted from "
-          "the registered names", file=sys.stderr)
-    sys.exit(1)
-PY
-
-# checkpoint atomic-commit lint (ISSUE 3 satellite): every byte written into
-# a checkpoint directory must flow through checkpoint/atomic.py (temp+fsync+
-# rename) — a raw write-mode open() anywhere else in the checkpoint package
-# is a torn-file bug waiting for a preemption. The ckpt-atomic-ok marker is
-# the allowlist (the helper itself).
-# the mode may appear anywhere after open( — `open(os.path.join(d, "x"),
-# "wb")` has a ')' before the mode, so match the quoted mode token itself,
-# not "first argument then mode"
-if grep -nE 'open\(.*["'\''](w|wb|a|ab|x|xb|r\+|rb\+|w\+|wb\+|a\+|ab\+)["'\'']' \
-     paddle_tpu/distributed/checkpoint/*.py | grep -v 'ckpt-atomic-ok'; then
-  echo "lint: raw write-mode open() in the checkpoint package above —" \
-       "all checkpoint-directory writes go through checkpoint/atomic.py" >&2
-  exit 1
-fi
-
-# elastic membership lint (ISSUE 9 satellite): checkpoint-package code must
-# never derive MEMBERSHIP from range(world_size) — after an elastic shrink,
-# a dead rank enumerated by range would be waited on (negotiation barriers)
-# or trusted (peer candidates) forever. Membership flows through
-# fleet.elastic.membership.live_ranks / the launcher-published live-rank
-# set; tag a deliberate exception with  # elastic-membership-ok
-python - <<'PY'
-import ast, glob, sys
-
-bad = []
-for path in sorted(glob.glob("paddle_tpu/distributed/checkpoint/*.py")):
-    src = open(path).read()
-    lines = src.splitlines()
-    for node in ast.walk(ast.parse(src)):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "range"):
-            continue
-        for arg in node.args:
-            name = (arg.id if isinstance(arg, ast.Name)
-                    else arg.attr if isinstance(arg, ast.Attribute)
-                    else None)
-            if name == "world_size" \
-                    and "elastic-membership-ok" not in lines[node.lineno - 1]:
-                bad.append((path, node.lineno, lines[node.lineno - 1].strip()))
-if bad:
-    for path, ln, text in bad:
-        print(f"{path}:{ln}: {text}")
-    print("lint: range(world_size) membership iteration in the checkpoint "
-          "package — enumerate fleet.elastic.membership.live_ranks() (the "
-          "negotiated live-rank set) instead", file=sys.stderr)
-    sys.exit(1)
-PY
 
 ARGS=(-q -p no:cacheprovider)
 
@@ -282,6 +54,7 @@ ARGS=(-q -p no:cacheprovider)
 # checkpoints. Budget-enforced so it stays a per-commit habit; if this set
 # outgrows the budget, PRUNE IT, don't skip it.
 FAST_TESTS=(
+  tests/test_analysis.py
   tests/test_chaos.py
   tests/test_telemetry.py
   tests/test_checkpoint_tiers.py

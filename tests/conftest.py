@@ -4,6 +4,26 @@ Must run before jax initializes a backend. Set PADDLE_TPU_TEST_PLATFORM=tpu
 (scripts/ci.sh --tpu does) to leave the real backend alone for tpu-marked
 tests."""
 import os
+import sys
+
+# Runtime lock-order sanitizer (ISSUE 10): must arm BEFORE anything
+# imports paddle_tpu (or jax) — module-level locks like the engine compile
+# lock are created at import time and only factory-patched creations are
+# tracked. Boot-loaded by PATH under the canonical module name so later
+# `import paddle_tpu.testing.lockorder` reuses this instance.
+_LOCKORDER = None
+if os.environ.get("PADDLE_LOCKORDER") == "1":
+    import importlib.util as _ilu
+
+    _p = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "paddle_tpu", "testing",
+        "lockorder.py")
+    _spec = _ilu.spec_from_file_location(
+        "paddle_tpu.testing.lockorder", _p)
+    _LOCKORDER = _ilu.module_from_spec(_spec)
+    sys.modules["paddle_tpu.testing.lockorder"] = _LOCKORDER
+    _spec.loader.exec_module(_LOCKORDER)
+    _LOCKORDER.install()
 
 if os.environ.get("PADDLE_TPU_TEST_PLATFORM", "cpu") == "cpu":
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -32,6 +52,25 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "tpu" in item.keywords:
             item.add_marker(skip)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """PADDLE_LOCKORDER=1 verdict: commit the observed acquisition graph
+    and FAIL the session on inversions — a lock pair nested in both
+    directions during the suite is a deadlock waiting for the right
+    interleaving, whichever test exposed it."""
+    if _LOCKORDER is None:
+        return
+    rep = _LOCKORDER.report(path=os.path.join(
+        "telemetry", "lockorder_report.json"))
+    inv = rep["inversions"]
+    print(f"\nPADDLE_LOCKORDER: {rep['edges']} acquisition-order edges, "
+          f"{len(inv)} inversions")
+    if inv:
+        for item in inv:
+            print(f"  {item['kind']}: {' -> '.join(item['nodes'])} "
+                  f"({'; '.join(item['sites'])})")
+        session.exitstatus = 3
 
 
 @pytest.fixture(autouse=True)
