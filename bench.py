@@ -803,6 +803,15 @@ def main():
     # which probe path ran (first_try / retry / wedged_after_retry /
     # failed_after_retry) — the BENCH_r05 postmortem's missing datum
     res.setdefault("extra", {})["probe"] = probe_info
+    # cluster health per run (ISSUE 11 satellite): snapshot count, worst
+    # cross-rank phase skew, straggler verdicts from the fleet plane
+    try:
+        from paddle_tpu.observability import fleet as _fleet
+
+        res.setdefault("extra", {})["fleet"] = _fleet.bench_block()
+    except Exception as e:  # noqa: BLE001 — the bench line must still land
+        res.setdefault("extra", {})["fleet"] = {
+            "error": f"{type(e).__name__}: {str(e)[:160]}"}
     print(json.dumps(res), flush=True)
 
 

@@ -295,6 +295,15 @@ def _telemetry_snapshot(model, knobs, rng_seed, vocab):
     }
 
 
+def _fleet_block():
+    try:
+        from paddle_tpu.observability import fleet as _fleet
+
+        return _fleet.bench_block()
+    except Exception as e:  # noqa: BLE001 — the bench line must still land
+        return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
+
 def run_bench(quick=False, seed=0):
     import jax
 
@@ -351,6 +360,9 @@ def run_bench(quick=False, seed=0):
                 "baseline": base.get("compile"),
                 "pipelined": pipe.get("compile"),
             },
+            # ISSUE 11 satellite: cluster health per run — snapshot
+            # count, worst cross-rank phase skew, straggler verdicts
+            "fleet": _fleet_block(),
         },
     }
 

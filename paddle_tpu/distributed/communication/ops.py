@@ -20,21 +20,25 @@ import jax
 import jax.numpy as jnp
 
 from ...framework.core import Tensor, apply, to_tensor
-from ...observability import tracing as _tracing
+from ...observability import fleet as _fleet
 from .. import env as _env
 from .group import get_axis_names
 
 
 def _spanned(name):
-    """Wrap a collective entry point in a telemetry span (free when
-    disabled). Caveat: under a jit trace the span measures TRACE time once —
-    per-execution device time for collectives lives in xprof; the span's
-    value is eager-path latency + call counts (span.<name>_s histograms)."""
+    """Wrap a collective entry point in the fleet collective seam (free
+    when disabled): the pre-collective WAIT is timed distinctly from the
+    collective BODY (ISSUE 11 — the split the cross-rank straggler
+    detector attributes with), and the body still runs under the existing
+    ``collective.<op>`` telemetry span. Caveat: under a jit trace the span
+    measures TRACE time once — per-execution device time for collectives
+    lives in xprof; the span's value is eager-path latency + call counts
+    (span.<name>_s histograms)."""
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            with _tracing.span(name):
+            with _fleet.collective_seam(name):
                 return fn(*args, **kwargs)
 
         return wrapper

@@ -32,7 +32,7 @@ from ...framework.native import TCPStore
 from ...observability.metrics import registry as _registry
 from ...observability.watchdog import HangWatchdog, heartbeat_path
 from ...testing import chaos
-from ...utils.envs import env_str
+from ...utils.envs import env_bool, env_str
 from ...utils.metrics_bus import counters
 from ..fleet.elastic import PREEMPTED_EXIT_CODE
 from ..fleet.elastic.fencing import GEN_STORE_KEY
@@ -73,6 +73,7 @@ class CollectiveController:
         self.reforms = 0
         self.regrow_path = os.path.join(ctx.args.log_dir, REGROW_SIGNAL)
         self._watchdog = None
+        self._fleet_agg = None  # launcher-hosted FleetAggregator (ISSUE 11)
         self._pod = None  # the CURRENT generation's pod (re-forms rebind it)
 
     def _clean_stale_worker_state(self, rank=None):
@@ -224,7 +225,7 @@ class CollectiveController:
             # telemetry is on — so default launches keep per-step heartbeat
             # I/O at exactly zero.
             if (getattr(args, "hang_deadline", 0) or 0) > 0 \
-                    or env_str("PADDLE_TELEMETRY"):
+                    or env_bool("PADDLE_TELEMETRY"):
                 env["PADDLE_TELEMETRY_DIR"] = self.telemetry_dir
             if args.devices:
                 env["FLAGS_selected_devices"] = args.devices
@@ -295,10 +296,29 @@ class CollectiveController:
                     f"{deadline}s; diagnosis written to {p}", file=sys.stderr),
             ).start()
         self._watchdog = watchdog
+        # fleet aggregator (ISSUE 11): hosted by the same monitor scope as
+        # the watchdog — merges the workers' fleetsnap publications into
+        # the cluster view /fleetz serves and the straggler advisory the
+        # restart decisions log. Armed whenever something reads the
+        # telemetry dir (watchdog, statusz, or telemetry-on workers).
+        fleet_agg = None
+        if deadline > 0 or statusz is not None \
+                or env_bool("PADDLE_TELEMETRY"):
+            from ...observability.fleet import FleetAggregator
+
+            os.makedirs(self.telemetry_dir, exist_ok=True)
+            fleet_agg = FleetAggregator(
+                self.telemetry_dir, generation=self.generation).start()
+            if statusz is not None:
+                statusz.fleet = fleet_agg
+        self._fleet_agg = fleet_agg
         try:
             return self._watch_loop(pod, args, total_restarts, total_budget)
         finally:
             self._watchdog = None
+            self._fleet_agg = None
+            if fleet_agg is not None:
+                fleet_agg.stop()
             if watchdog is not None:
                 watchdog.stop()
             if statusz is not None:
@@ -320,6 +340,16 @@ class CollectiveController:
                 pod = self._reform(pod, args, grow=grow, reason="regrow")
                 continue
             if failed:
+                # straggler advisory (ISSUE 11): before spending restart
+                # budget, record what the fleet view knew — "rank 2 was
+                # computing 1.9x the median for the last 8 windows" next
+                # to the restart decision is the difference between
+                # debugging a crash and debugging a cluster. Advisory
+                # only: the budgets below still decide.
+                if self._fleet_agg is not None:
+                    adv = self._fleet_agg.straggler_advisory()
+                    if adv:
+                        print(f"[paddle_tpu.launch] {adv}", file=sys.stderr)
                 preempted = [c for c in failed if c.exit_code == PREEMPTED_EXIT_CODE]
                 crashed = [c for c in failed if c.exit_code != PREEMPTED_EXIT_CODE]
                 # chaos 'elastic.host_loss': deterministically declare a
@@ -446,6 +476,10 @@ class CollectiveController:
         if self._watchdog is not None:
             # heartbeats from the dead generation are invisible from here
             self._watchdog.generation = self.generation
+        if self._fleet_agg is not None:
+            # fleet snapshots fence exactly like heartbeats: the re-formed
+            # world's aggregator never mixes incarnations
+            self._fleet_agg.generation = self.generation
         new_pod = self.build_pod(nproc=new_world)
         # rebind BEFORE deploy: run()'s cleanup must always see the pod
         # whose processes are actually alive (a KeyboardInterrupt after a

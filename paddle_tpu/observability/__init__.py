@@ -20,6 +20,7 @@ it is the EventCounters cost model. Span tracing and goodput timers are
 docs/OBSERVABILITY.md for the metric/span taxonomy and env vars.
 """
 from . import compilemem  # noqa: F401
+from . import fleet  # noqa: F401
 from . import goodput  # noqa: F401
 from . import request_trace  # noqa: F401
 from . import slo  # noqa: F401
@@ -29,6 +30,7 @@ from .compilemem import (  # noqa: F401
     ledgered_jit,
     record_compile,
 )
+from .fleet import FleetAggregator, SnapshotPublisher  # noqa: F401
 from .goodput import GoodputAccountant  # noqa: F401
 from .metrics import (  # noqa: F401
     DEFAULT_BUCKETS,
@@ -58,4 +60,5 @@ __all__ = [
     "HangWatchdog", "Heartbeat", "maybe_beat", "request_trace", "slo",
     "SLOMonitor", "SLOObjective", "StatusServer", "compilemem",
     "CompileLedger", "MemoryLedger", "ledgered_jit", "record_compile",
+    "fleet", "FleetAggregator", "SnapshotPublisher",
 ]

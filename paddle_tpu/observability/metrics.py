@@ -280,6 +280,15 @@ class MetricsRegistry:
         """Existing metric or None — never creates."""
         return self._metrics.get(metric_key(name, labels))
 
+    def remove(self, name, labels=None):
+        """Delete one series (the fleet aggregator retiring a departed
+        rank's labeled gauge — a rank that left the world must vanish
+        from the exposition, not report its last score forever). The
+        family's type registration is kept. Returns True if removed."""
+        with self._lock:
+            return self._metrics.pop(metric_key(name, labels),
+                                     None) is not None
+
     def names(self, prefix=""):
         with self._lock:
             return sorted(n for n in self._metrics if n.startswith(prefix))
@@ -307,6 +316,80 @@ class MetricsRegistry:
                     "buckets": [[b, c] for b, c in m.cumulative()],
                 }
         return out
+
+    def export(self, prefixes=None):
+        """Merge-ready structured series dump (the fleet snapshot payload,
+        ISSUE 11): unlike :meth:`snapshot`, every record carries the family,
+        type, labels, and — for histograms — the bucket BOUNDS alongside the
+        raw counts, so a cross-rank aggregator can rebuild exact mergeable
+        metrics instead of lossy summaries. Zero-valued counters and empty
+        histograms are omitted (the snapshot bound matters more than
+        registering silence)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        out = []
+        for name, m in items:
+            if prefixes and not m.family.startswith(tuple(prefixes)):
+                continue
+            rec = {"name": name, "family": m.family, "labels": m.labels,
+                   "help": m.help}
+            if isinstance(m, Counter):
+                if not m.value:
+                    continue
+                rec["type"] = "counter"
+                rec["value"] = m.value
+            elif isinstance(m, Gauge):
+                rec["type"] = "gauge"
+                rec["value"] = m.value
+                rec["hwm"] = m.hwm
+            else:
+                if not m.count:
+                    continue
+                rec["type"] = "histogram"
+                rec["bounds"] = list(m.bounds)
+                rec["counts"] = m.bucket_counts()
+                rec["sum"] = m.sum
+                rec["count"] = m.count
+            out.append(rec)
+        return out
+
+    def load_series(self, rec, extra_labels=None):
+        """Recreate one :meth:`export` record in THIS registry, optionally
+        widening its label set (the aggregator adds ``rank=``/``replica=``
+        so merged families stay one ``# TYPE`` with per-source series).
+        Returns the metric, or None when the record's family is already
+        registered here as a different type (conflicting sources must not
+        kill a merge)."""
+        labels = dict(rec.get("labels") or {})
+        if extra_labels:
+            labels.update(extra_labels)
+        kind = rec.get("type")
+        help_ = rec.get("help") or ""
+        family = rec["family"]
+        try:
+            if kind == "counter":
+                m = self.counter(family, help=help_, labels=labels)
+                m.inc(rec.get("value", 0))
+            elif kind == "gauge":
+                m = self.gauge(family, help=help_, labels=labels)
+                # set() tracks the high-water mark: replay hwm first so the
+                # merged gauge carries the source's peak, then the live value
+                m.set(rec.get("hwm", rec.get("value", 0.0)))
+                m.set(rec.get("value", 0.0))
+            elif kind == "histogram":
+                m = self.histogram(family, buckets=rec["bounds"],
+                                   help=help_, labels=labels)
+                counts = list(rec.get("counts") or ())
+                with m._lock:
+                    for i, c in enumerate(counts[:len(m._counts)]):
+                        m._counts[i] += int(c)
+                    m._sum += float(rec.get("sum", 0.0))
+                    m._count += int(rec.get("count", 0))
+            else:
+                return None
+        except ValueError:
+            return None
+        return m
 
     def dump_jsonl(self, path, extra=None):
         """Append one JSON record per metric (plus the optional ``extra``

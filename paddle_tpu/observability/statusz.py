@@ -19,8 +19,14 @@ Routes (GET only):
 - ``/memz``     — the HBM budget ledger: components (params/optimizer/KV
   pool) vs device capacity, per-program ``memory_analysis()`` harvests
   (``?analyze=1`` forces the lazy harvest).
+- ``/fleetz``   — the fleet view (ISSUE 11): merged per-rank/per-replica
+  snapshots — members, quorum, phase skew, straggler verdicts, serving
+  rollup (``?refresh=1`` forces a fresh merge).
 - ``/healthz``  — liveness: 200 with per-replica / per-rank heartbeat ages,
   503 when nothing can serve (no LIVE replica) or every heartbeat is stale.
+
+Dispatch is table-driven (``self.routes``): the 404 body's route listing
+derives from the same dict, so a new route can never be silently omitted.
 
 The server binds 127.0.0.1 by default (introspection is an operator
 surface, not a public one) and ``port=0`` picks a free port (tests). All
@@ -48,7 +54,7 @@ class StatusServer:
 
     def __init__(self, port=0, host="127.0.0.1", frontend=None,
                  telemetry_dir=None, heartbeat_stale_s=60.0,
-                 tracez_n=10, elastic_info=None):
+                 tracez_n=10, elastic_info=None, fleet=None):
         self.host = host
         self.port = int(port)
         self.frontend = frontend
@@ -56,6 +62,10 @@ class StatusServer:
         # callable with its live view (generation/world/parked); worker
         # processes fall back to their env contract
         self.elastic_info = elastic_info
+        # fleet aggregator (ISSUE 11): the launcher passes its live
+        # FleetAggregator; standalone servers build one lazily over
+        # telemetry_dir on the first /fleetz hit
+        self.fleet = fleet
         self.telemetry_dir = (telemetry_dir
                               or env_str("PADDLE_TELEMETRY_DIR"))
         self.heartbeat_stale_s = float(heartbeat_stale_s)
@@ -63,6 +73,36 @@ class StatusServer:
         self._t0 = time.time()
         self._httpd = None
         self._thread = None
+        # THE dispatch table: every route — handler, 404 listing, docs
+        # test — derives from this one dict, so a new route cannot be
+        # silently omitted from the listing (ISSUE 11 satellite). Each
+        # handler takes the query string and returns (code, body, ctype).
+        self.routes = {
+            "/statusz": self._route_json(lambda q: (200, self.statusz())),
+            "/varz": lambda q: (200, self.varz(),
+                                "text/plain; version=0.0.4"),
+            "/tracez": self._route_json(lambda q: (200, self.tracez())),
+            "/compilez": self._route_json(
+                lambda q: (200, self.compilez())),
+            "/memz": self._route_json(
+                lambda q: (200, self.memz(analyze="analyze=1" in q))),
+            "/fleetz": self._route_json(
+                lambda q: (200, self.fleetz(refresh="refresh=1" in q))),
+            "/healthz": self._route_json(lambda q: self.healthz()),
+        }
+
+    @staticmethod
+    def _route_json(fn):
+        def handler(query):
+            code, payload = fn(query)
+            return (code, json.dumps(payload, indent=1, default=str),
+                    "application/json")
+        return handler
+
+    def route_names(self):
+        """The live route listing (served in the 404 body) — derived from
+        the dispatch table, never hand-maintained."""
+        return sorted(self.routes)
 
     # ---- payload builders (plain methods: no sockets needed to test) ------
     def statusz(self):
@@ -143,6 +183,37 @@ class StatusServer:
         off-device compile per un-analyzed program — operator opt-in)."""
         return compilemem.memory.report(analyze=analyze)
 
+    def fleetz(self, refresh=False):
+        """The fleet view (ISSUE 11): merged per-rank/per-replica
+        snapshots — members, quorum, cross-rank phase skew, straggler
+        verdicts, serving rollup. A launcher-hosted aggregator serves its
+        monitor thread's last view (``?refresh=1`` forces a fresh merge);
+        a standalone server lazily builds an aggregator over its
+        telemetry dir."""
+        agg = self.fleet
+        if agg is None:
+            if not self.telemetry_dir:
+                return {"error": "no telemetry dir configured "
+                                 "(PADDLE_TELEMETRY_DIR or telemetry_dir=)"}
+            from .fleet import FleetAggregator
+            from .metrics import MetricsRegistry
+
+            # scratch registry: a scrape-driven merge must not inject
+            # cluster-level fleet.* gauges into THIS process's live
+            # registry (its own snapshot publisher would re-export them
+            # as if they were local series)
+            agg = self.fleet = FleetAggregator(
+                self.telemetry_dir, registry=MetricsRegistry())
+        if callable(agg) and not hasattr(agg, "view"):
+            return agg()  # provider callable (tests / custom hosts)
+        # a launcher-hosted aggregator refreshes on its own monitor
+        # cadence — serve its last view; a lazily-built standalone one has
+        # no thread, so every scrape must merge fresh or the view freezes
+        # at the first-ever request
+        if getattr(agg, "_thread", None) is None:
+            refresh = True
+        return agg.view(refresh=refresh)
+
     def _heartbeats(self):
         """{rank: age_s} from the PR-2 heartbeat files, when a telemetry
         dir is configured."""
@@ -219,37 +290,18 @@ class StatusServer:
             def do_GET(self):
                 raw_path, _, query = self.path.partition("?")
                 path = raw_path.rstrip("/") or "/statusz"
+                handler = server.routes.get(path)
                 try:
-                    if path == "/varz":
-                        self._send(200, server.varz(),
-                                   "text/plain; version=0.0.4")
-                    elif path == "/statusz":
-                        self._send(200, json.dumps(server.statusz(),
-                                                   indent=1, default=str),
-                                   "application/json")
-                    elif path == "/tracez":
-                        self._send(200, json.dumps(server.tracez(),
-                                                   indent=1, default=str),
-                                   "application/json")
-                    elif path == "/compilez":
-                        self._send(200, json.dumps(server.compilez(),
-                                                   indent=1, default=str),
-                                   "application/json")
-                    elif path == "/memz":
-                        analyze = "analyze=1" in query
-                        self._send(200, json.dumps(
-                            server.memz(analyze=analyze),
-                            indent=1, default=str), "application/json")
-                    elif path == "/healthz":
-                        code, payload = server.healthz()
-                        self._send(code, json.dumps(payload, indent=1),
-                                   "application/json")
-                    else:
+                    if handler is None:
+                        # the listing IS the dispatch table: a route added
+                        # above appears here by construction
                         self._send(404, json.dumps(
-                            {"error": "not found", "routes": [
-                                "/statusz", "/varz", "/tracez", "/compilez",
-                                "/memz", "/healthz"]}),
+                            {"error": "not found",
+                             "routes": server.route_names()}),
                             "application/json")
+                    else:
+                        code, body, ctype = handler(query)
+                        self._send(code, body, ctype)
                 except Exception as e:  # introspection must never crash
                     self._send(500, json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}),

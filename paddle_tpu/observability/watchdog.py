@@ -179,7 +179,9 @@ def note_phase(phase):
 
 def maybe_beat(step=None):
     """The train-loop hook: one cached env check when telemetry is not
-    configured; at most ~2 small atomic file writes per second when it is."""
+    configured; at most ~2 small atomic file writes per second when it is.
+    Fleet snapshot publication (ISSUE 11) piggybacks on the same cadence:
+    inside the throttled block, so the disabled path stays one check."""
     global _last_beat_t
     hb = _env_heartbeat()
     if hb is False:
@@ -192,6 +194,9 @@ def maybe_beat(step=None):
         hb.beat(step=step)
     except OSError:
         pass  # a full disk must not kill the training step
+    from . import fleet
+
+    fleet.maybe_publish(step)
 
 
 def _reset_process_heartbeat():
@@ -201,6 +206,9 @@ def _reset_process_heartbeat():
         _process_hb.close()
     _process_hb = None
     _last_beat_t = 0.0
+    from . import fleet
+
+    fleet._reset_process_publisher()
 
 
 class HangWatchdog:

@@ -50,6 +50,7 @@ from ..inference.continuous import (
     canonical_sampling,
 )
 from ..observability import compilemem as _compilemem
+from ..observability import fleet as _fleet
 from ..observability import goodput as _goodput
 from ..observability import request_trace as _rtrace
 from ..observability import tracing as _tracing
@@ -1038,17 +1039,24 @@ class ServingFrontend:
         counters = {n: _registry.get(n).value for n in _registry.names("serving.")
                     if hasattr(_registry.get(n), "value")
                     and not hasattr(_registry.get(n), "hwm")}
+        slo_report = self.slo.report()
+        goodput_report = _goodput.serving.report()
         return {
             "replicas": replicas,
             "slo_classes": classes,
             "counters": {k: v for k, v in counters.items() if v},
             "queue_depth": sum(len(r.pending) for r in self.replicas),
             # SLO burn rates + multi-window alerts (ISSUE 7)
-            "slo": self.slo.report(),
+            "slo": slo_report,
             # serving goodput split (ISSUE 7 satellite): engine wall clock
             # classified {prefill, decode, host_emit, idle, compile};
             # populated when telemetry is enabled (the goodput gate)
-            "goodput": _goodput.serving.report(),
+            "goodput": goodput_report,
+            # cluster serving rollup (ISSUE 11): live replicas, cluster
+            # queue/occupancy, worst multi-window burn, and ONE blended
+            # pressure/scale_hint signal — what an autoscaler reads
+            "fleet": _fleet.serving_rollup(replicas, slo_report,
+                                           goodput_report),
             # compile ledger + HBM budget (ISSUE 8): cold-program counts,
             # churn alerts, and KV-pool/params bytes vs device capacity
             "compile": _compilemem.ledger.report(recent=8),

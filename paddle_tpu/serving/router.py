@@ -80,6 +80,11 @@ class ReplicaHandle:
         # same rank from the pod HangWatchdog (and vice versa)
         self._wd_heartbeat = None
         self._wd_last_write = 0.0
+        # fleet snapshot publication (ISSUE 11): dispatchers publish
+        # fleetsnap files in the same serving/ namespace as their
+        # heartbeats, carrying the replica's control-plane state so the
+        # cluster aggregator can roll up serving cells it never imported
+        self._fleet_pub = None
         d = env_str("PADDLE_TELEMETRY_DIR")
         if d:
             try:
@@ -90,6 +95,27 @@ class ReplicaHandle:
                                                install_faulthandler=False)
             except OSError:
                 self._wd_heartbeat = None
+            try:
+                from ..observability.fleet import (
+                    SnapshotPublisher,
+                    process_instance,
+                )
+
+                # instance=host+pid: replica INDEXES repeat across
+                # frontend processes (and pids repeat across hosts)
+                # sharing one telemetry dir — the instance keeps their
+                # snapshot files (and tmp paths) from colliding. Only
+                # replica 0 carries the (process-shared) registry export;
+                # the others publish identity + control-plane state, so N
+                # dispatchers don't each serialize the full registry per
+                # cadence just for the aggregator to collapse N-1 of them
+                self._fleet_pub = SnapshotPublisher(
+                    os.path.join(d, "serving"), rank=self.index,
+                    role="replica", instance=process_instance(),
+                    include_metrics=(self.index == 0),
+                    extra_provider=lambda: {"replica": self.snapshot()})
+            except OSError:
+                self._fleet_pub = None
         # labeled series of one family each (ISSUE 7 satellite: a real
         # scraper aggregates over {replica=...}, which per-replica metric
         # NAMES made impossible)
@@ -115,6 +141,8 @@ class ReplicaHandle:
                 self._wd_heartbeat.beat(step=step, role="serving")
             except OSError:
                 pass  # full disk must not take the dispatcher down
+            if self._fleet_pub is not None:
+                self._fleet_pub.maybe_publish(step=step)
 
     def publish_gauges(self):
         eng = self.engine
@@ -141,6 +169,7 @@ class ReplicaHandle:
 
     def snapshot(self):
         return {
+            "name": self.name,
             "state": self.state,
             "active": self.engine.active_count(),
             "max_seqs": self.engine.max_seqs,

@@ -30,7 +30,7 @@ import sys
 import threading
 
 __all__ = ["Graph", "install", "installed", "graph", "report",
-           "wrap_lock"]
+           "report_path", "wrap_lock"]
 
 _REPO_MARKERS = (os.sep + "paddle_tpu" + os.sep, os.sep + "tests" + os.sep)
 
@@ -280,6 +280,23 @@ def uninstall():
     threading.RLock = _ORIG.pop("RLock")
     threading.Condition = _ORIG.pop("Condition")
     _GLOBAL = None
+
+
+def report_path():
+    """Where the sanitizer's report belongs: inside ``PADDLE_TELEMETRY_DIR``
+    when it is set (next to the other telemetry artifacts), else
+    ``telemetry/`` under the CWD — so a tier-1 run with a configured
+    telemetry dir never litters the repo root. Read via the blessed env
+    helper when ``paddle_tpu`` is importable (report time — the package is
+    long loaded); the boot-time standalone constraint only applies to
+    module import, not to this call."""
+    try:
+        from paddle_tpu.utils.envs import env_str
+
+        d = env_str("PADDLE_TELEMETRY_DIR")
+    except Exception:
+        d = None
+    return os.path.join(d or "telemetry", "lockorder_report.json")
 
 
 def report(path=None):

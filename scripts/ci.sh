@@ -68,6 +68,7 @@ FAST_TESTS=(
   tests/test_serving_perf.py
   tests/test_request_trace.py
   tests/test_compile_memory_obs.py
+  tests/test_fleet_obs.py
 )
 
 if [[ "${1:-}" == "--fast" ]]; then

@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Offline fleet-view merger (ISSUE 11): merge per-rank / per-replica
+``fleetsnap.*.json`` telemetry snapshots into one cluster view — the
+offline twin of the live ``/fleetz`` route, in the mold of
+``scripts/trace_view.py``.
+
+Every rank publishes a generation-stamped snapshot (metrics series,
+goodput split, compile counts, collective wait/body accumulators) into
+``PADDLE_TELEMETRY_DIR`` on the heartbeat cadence; serving dispatchers
+publish under ``serving/``. This tool loads a snapshot set, fences it to
+one generation, and renders members, quorum, cross-rank phase skew,
+straggler verdicts (compute-slow vs waiting-on-a-collective), and the
+serving rollup:
+
+    $ python scripts/fleet_view.py log/telemetry/
+    fleet generation 1 (snapshots 4, fenced 0)
+    members:
+      rank:0  step=120 age=1.2s
+      ...
+    straggler: rank 2 compute 1.9x median [compute]
+
+Exit status: 0, or 2 under ``--check`` when the snapshot set is
+generation-MIXED (stragglers from a dead incarnation are still
+publishing) or QUORUM-MISSING (fewer ranks present than the recorded —
+or ``--expect``-ed — world size).
+
+Usage:
+    python scripts/fleet_view.py PATH [PATH ...]
+        PATH: a fleetsnap .json file, or a telemetry dir (scanned at the
+        top level and under serving/)
+    --expect N      quorum check against N ranks (default: the max world
+                    size recorded in the snapshots)
+    --json          machine output: the full merged view as one JSON doc
+    --prom          print the merged Prometheus exposition instead
+                    (every series labeled rank=/replica=)
+    --check         exit 2 on generation-mixed or quorum-missing sets
+    --window W / --threshold R    straggler detector knobs
+"""
+import argparse
+import json
+import os
+import sys
+
+
+def _import_fleet():
+    """The aggregator lives in paddle_tpu.observability.fleet; when the
+    tool is invoked from outside the repo (operator on a log dir), fall
+    back to the checkout this script sits in."""
+    try:
+        from paddle_tpu.observability import fleet, metrics
+    except ImportError:
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from paddle_tpu.observability import fleet, metrics
+    return fleet, metrics
+
+
+def render(view, out=print):
+    q = view["quorum"]
+    out(f"fleet generation {view['generation']} "
+        f"(snapshots {len(view['members'])}, "
+        f"fenced {view['fenced_out']}, "
+        f"generations seen {view['generations_seen']})")
+    out("members:")
+    for key, m in sorted(view["members"].items()):
+        out(f"  {key}  step={m['step']} age={m['age_s']}s "
+            f"gen={m['generation']}")
+    out(f"quorum: expected {q['expected_world']}, "
+        f"present {q['present']}"
+        + (f", MISSING {q['missing']}" if q["missing"] else ""))
+    phases = view.get("phases") or {}
+    if phases:
+        out("phases (per-rank mean skew):")
+        for fam, e in sorted(phases.items(), key=lambda kv: -kv[1]["skew"]):
+            line = (f"  {fam}  skew={e['skew']}x "
+                    f"(max rank {e['max_rank']}, "
+                    f"median {e['median_rank_mean']}s)")
+            if "p99" in e:
+                line += f" p50={e['p50']}s p99={e['p99']}s"
+            out(line)
+    strag = view.get("straggler") or {}
+    for r, info in sorted((strag.get("ranks") or {}).items()):
+        if info["verdict"] != "ok":
+            out(f"straggler: rank {r} [{info['verdict']}] "
+                f"compute {info['compute_ratio']}x median, "
+                f"collective wait {info['collective_wait_per_step_s']}s"
+                f"/step")
+    if strag.get("persistent"):
+        out(f"persistent stragglers (window {strag['window']}): "
+            f"{strag['persistent']}")
+    serving = view.get("serving")
+    if serving:
+        out(f"serving: {len(serving['replicas'])} replicas, "
+            f"queue_depth={serving['queue_depth']}, "
+            f"occupancy_mean={serving['occupancy_mean']}")
+    for err in view.get("errors") or ():
+        out(f"  !! {err}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge fleetsnap telemetry into one cluster view")
+    ap.add_argument("paths", nargs="+",
+                    help="fleetsnap .json files or telemetry dirs")
+    ap.add_argument("--expect", type=int,
+                    help="quorum check against this world size")
+    ap.add_argument("--json", action="store_true",
+                    help="full merged view as JSON")
+    ap.add_argument("--prom", action="store_true",
+                    help="merged Prometheus exposition text")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 2 on generation-mixed or quorum-missing "
+                         "snapshot sets")
+    ap.add_argument("--window", type=int, default=None,
+                    help="straggler sliding-window rounds")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="straggler ratio threshold vs the median")
+    args = ap.parse_args(argv)
+
+    fleet, metrics = _import_fleet()
+    FleetAggregator, load_snapshots = (fleet.FleetAggregator,
+                                       fleet.load_snapshots)
+    MetricsRegistry = metrics.MetricsRegistry
+
+    snaps, errors = load_snapshots(args.paths)
+    if not snaps:
+        print("no fleet snapshots found (is PADDLE_TELEMETRY_DIR set and "
+              "the job heartbeating?)", file=sys.stderr)
+        for e in errors:
+            print(f"  !! {e}", file=sys.stderr)
+        return 2 if args.check else 0
+    # offline aggregation must not pollute the live process registry —
+    # gauges land in a scratch registry the CLI throws away
+    agg = FleetAggregator(window=args.window, threshold=args.threshold,
+                          expected_world=args.expect,
+                          registry=MetricsRegistry())
+    # the merged view is computed even under --prom: the --check gate
+    # reads generations/quorum from it, and '--prom --check' must still
+    # honor the exit-2 contract
+    view = agg.merge(snaps, errors=errors)
+    if args.prom:
+        sys.stdout.write(agg.to_prometheus(snaps))
+    elif args.json:
+        print(json.dumps(view, indent=1, default=str))
+    else:
+        render(view)
+
+    bad = []
+    if len(view["generations_seen"]) > 1:
+        bad.append(f"generation-mixed snapshot set: "
+                   f"{view['generations_seen']} (old-incarnation "
+                   f"stragglers are still publishing)")
+    if view["quorum"]["missing"]:
+        bad.append(f"quorum missing: expected "
+                   f"{view['quorum']['expected_world']} ranks, absent "
+                   f"{view['quorum']['missing']}")
+    for b in bad:
+        print(f"fleet_view: {b}", file=sys.stderr)
+    if args.check and bad:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

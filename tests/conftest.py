@@ -61,8 +61,9 @@ def pytest_sessionfinish(session, exitstatus):
     interleaving, whichever test exposed it."""
     if _LOCKORDER is None:
         return
-    rep = _LOCKORDER.report(path=os.path.join(
-        "telemetry", "lockorder_report.json"))
+    # honor PADDLE_TELEMETRY_DIR (ISSUE 11 satellite): the report lands
+    # with the rest of the telemetry artifacts, not in the CWD
+    rep = _LOCKORDER.report(path=_LOCKORDER.report_path())
     inv = rep["inversions"]
     print(f"\nPADDLE_LOCKORDER: {rep['edges']} acquisition-order edges, "
           f"{len(inv)} inversions")

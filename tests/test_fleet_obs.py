@@ -1,0 +1,723 @@
+"""Fleet-wide observability plane (ISSUE 11): snapshot publication,
+cross-rank aggregation, straggler/skew attribution, generation fencing,
+the /fleetz route, the offline fleet_view merger, the serving rollup, and
+the two load-bearing bounds — disabled publication is a cached check, and
+the merged Prometheus output survives the strict exposition parser with
+``rank``/``replica`` labels added."""
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import fleet, tracing, watchdog
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.observability.metrics import registry as global_registry
+from paddle_tpu.observability.statusz import StatusServer
+from paddle_tpu.testing import chaos
+from test_request_trace import parse_prometheus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_fleet_view():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fleet_view", os.path.join(REPO, "scripts", "fleet_view.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+fleet_view = _load_fleet_view()
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry_state(monkeypatch):
+    """Every test starts with tracing off and the cached heartbeat /
+    publisher resolution forgotten (env changes must take effect)."""
+    monkeypatch.delenv("PADDLE_TELEMETRY_DIR", raising=False)
+    watchdog._reset_process_heartbeat()
+    yield
+    tracing.disable()
+    chaos.disarm()
+    watchdog._reset_process_heartbeat()
+
+
+def _rank_registry(rank, steps=8, compute_s=0.01, wait_s=0.0,
+                   labeled=False):
+    """A per-rank registry shaped like a real training rank's: the step
+    dispatch phase histogram, the collective wait/body split, a counter,
+    and (optionally) a labeled family."""
+    reg = MetricsRegistry()
+    h = reg.histogram("span.train.step.dispatch_s")
+    cs = fleet.CollectiveStats(registry=reg)
+    for _ in range(steps):
+        h.observe(compute_s + wait_s)
+        cs.note("all_reduce", wait_s, 0.001)
+    reg.counter("train.steps", help="steps").inc(steps)
+    if labeled:
+        reg.histogram("serving.ttft_s",
+                      labels={"slo_class": "interactive"}).observe(0.05)
+        reg.histogram("serving.ttft_s",
+                      labels={"slo_class": "batch"}).observe(0.5)
+    return reg, cs
+
+
+def _publish(tmp_path, rank, reg, cs, generation=0, world=None, step=8,
+             role="rank"):
+    pub = fleet.SnapshotPublisher(
+        str(tmp_path), rank=rank, role=role, registry=reg,
+        collectives_stats=cs, min_interval_s=0.0,
+        generation=generation, world=world)
+    return pub.publish(step=step)
+
+
+def _fleet_dir(tmp_path, compute=(0.01, 0.01, 0.03), wait=(0.02, 0.02, 0.0),
+               generation=0):
+    """Publish a 3-rank snapshot set: rank 2 computes slowly, ranks 0/1
+    wait on it at the collective — the canonical straggler shape."""
+    for r in range(3):
+        reg, cs = _rank_registry(r, compute_s=compute[r], wait_s=wait[r])
+        _publish(tmp_path, r, reg, cs, generation=generation, world=3)
+    return str(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# registry export / merge-ready series
+# ---------------------------------------------------------------------------
+class TestRegistryExport:
+    def test_export_structure(self):
+        reg = MetricsRegistry()
+        reg.counter("a.count", help="c").inc(3)
+        reg.counter("a.zero")  # zero counters are omitted (bound > silence)
+        g = reg.gauge("a.depth")
+        g.set(5)
+        g.set(2)
+        reg.histogram("a.lat_s", buckets=(0.1, 1.0)).observe(0.5)
+        reg.histogram("a.empty_s", buckets=(0.1,))  # empty: omitted
+        recs = {r["name"]: r for r in reg.export()}
+        assert set(recs) == {"a.count", "a.depth", "a.lat_s"}
+        assert recs["a.count"]["type"] == "counter"
+        assert recs["a.count"]["value"] == 3
+        assert recs["a.depth"]["value"] == 2 and recs["a.depth"]["hwm"] == 5
+        h = recs["a.lat_s"]
+        assert h["bounds"] == [0.1, 1.0]
+        assert h["counts"] == [0, 1, 0] and h["count"] == 1
+        assert h["sum"] == pytest.approx(0.5)
+
+    def test_load_series_round_trip_adds_labels(self):
+        src = MetricsRegistry()
+        src.counter("x.reqs").inc(7)
+        src.histogram("x.lat_s", buckets=(0.1, 1.0),
+                      labels={"slo_class": "interactive"}).observe(0.05)
+        dst = MetricsRegistry()
+        for rec in src.export():
+            assert dst.load_series(rec, extra_labels={"rank": "3"})
+        assert dst.get("x.reqs", {"rank": "3"}).value == 7
+        h = dst.get("x.lat_s", {"slo_class": "interactive", "rank": "3"})
+        assert h is not None and h.count == 1
+        parse_prometheus(dst.to_prometheus())
+
+    def test_load_series_type_conflict_returns_none(self):
+        dst = MetricsRegistry()
+        dst.gauge("y.v")
+        assert dst.load_series({"name": "y.v", "family": "y.v",
+                                "type": "counter", "value": 1}) is None
+
+
+# ---------------------------------------------------------------------------
+# the collective seam: wait timed distinctly from the body
+# ---------------------------------------------------------------------------
+class TestCollectiveSeam:
+    def test_disabled_is_shared_noop(self):
+        assert fleet.collective_seam("collective.all_reduce") is tracing._NULL
+
+    def test_seam_splits_wait_from_body(self):
+        from paddle_tpu.distributed.communication import ops
+        from paddle_tpu.framework.core import to_tensor
+
+        tracing.enable()
+        fleet.collectives.reset()
+        # no chaos: the wait side of the split is ~free
+        ops.all_reduce(to_tensor(np.ones(4, np.float32)))
+        baseline = fleet.collectives.export()["all_reduce"]
+        assert baseline["wait_s"] < 0.015
+        fleet.collectives.reset()
+        # deterministic "waiting on a slow peer": the chaos seam inside
+        # the wait probe sleeps — the delay must land in wait_s, not in
+        # the collective body
+        with chaos.FaultPlan().delay("fleet.collective_wait", 0.02,
+                                     times=None):
+            ops.all_reduce(to_tensor(np.ones(4, np.float32)))
+        stats = fleet.collectives.export()
+        assert stats["all_reduce"]["count"] == 1
+        assert stats["all_reduce"]["wait_s"] >= 0.015
+        h = global_registry.get("collective.wait_s", {"op": "all_reduce"})
+        assert h is not None and h.count >= 1
+        # the body still feeds the existing span histogram
+        assert global_registry.get(
+            "span.collective.all_reduce_s").count >= 1
+
+
+# ---------------------------------------------------------------------------
+# snapshot publication
+# ---------------------------------------------------------------------------
+class TestSnapshotPublisher:
+    def test_publish_schema_and_atomicity(self, tmp_path):
+        reg, cs = _rank_registry(0, wait_s=0.005)
+        path = _publish(tmp_path, 0, reg, cs, generation=4, world=3)
+        assert not os.path.exists(path + ".tmp")  # committed via rename
+        snap = json.load(open(path))
+        assert snap["kind"] == "fleet_snapshot"
+        assert snap["generation"] == 4 and snap["world"] == 3
+        assert snap["role"] == "rank" and snap["rank"] == 0
+        assert snap["step"] == 8
+        names = {r["name"] for r in snap["metrics"]}
+        assert "span.train.step.dispatch_s" in names
+        assert snap["collectives"]["all_reduce"]["count"] == 8
+        assert "goodput" in snap and "compile" in snap
+
+    def test_throttle_and_series_cap(self, tmp_path):
+        reg, cs = _rank_registry(0)
+        pub = fleet.SnapshotPublisher(str(tmp_path), rank=0, registry=reg,
+                                      collectives_stats=cs,
+                                      min_interval_s=60.0, max_series=1)
+        assert pub.maybe_publish() is not None
+        assert pub.maybe_publish() is None  # throttled
+        snap = json.load(open(pub.path))
+        assert len(snap["metrics"]) == 1
+        assert snap["dropped_series"] >= 1
+        # priority ordering: the span phase survives the cap
+        assert snap["metrics"][0]["family"].startswith("span.")
+
+    def test_maybe_beat_piggyback(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TELEMETRY_DIR", str(tmp_path))
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+        watchdog._reset_process_heartbeat()
+        global_registry.histogram("span.train.step.dispatch_s").observe(0.01)
+        watchdog.maybe_beat(5)
+        assert os.path.exists(watchdog.heartbeat_path(str(tmp_path), 1))
+        snap_file = fleet.snapshot_path(str(tmp_path), 1)
+        assert os.path.exists(snap_file)
+        assert json.load(open(snap_file))["step"] == 5
+
+    def test_disabled_cost_is_one_cached_check(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TELEMETRY_DIR", raising=False)
+        fleet._reset_process_publisher()
+        fleet.maybe_publish(0)  # cache the env-unset decision
+        n = 50_000
+
+        def measure():
+            t0 = time.perf_counter()
+            for i in range(n):
+                fleet.maybe_publish(i)
+            return (time.perf_counter() - t0) / n
+
+        per_call = min(measure() for _ in range(3))
+        assert per_call < 2e-6, (
+            f"disabled fleet publication costs {per_call * 1e9:.0f}ns")
+
+
+# ---------------------------------------------------------------------------
+# aggregation: fencing, quorum, skew, stragglers
+# ---------------------------------------------------------------------------
+class TestFleetAggregator:
+    def test_generation_fencing(self, tmp_path):
+        # gen-1 world of 2 re-formed from a gen-0 world of 3; rank 2's
+        # old-incarnation snapshot is still on disk
+        for r in range(3):
+            reg, cs = _rank_registry(r)
+            _publish(tmp_path, r, reg, cs, generation=0, world=3)
+        for r in range(2):
+            reg, cs = _rank_registry(r)
+            _publish(tmp_path, r, reg, cs, generation=1, world=2)
+        agg = fleet.FleetAggregator(str(tmp_path),
+                                    registry=MetricsRegistry())
+        view = agg.collect()
+        assert view["generation"] == 1
+        assert view["generations_seen"] == [0, 1]
+        assert view["fenced_out"] == 1  # rank 2's gen-0 straggler
+        assert sorted(view["members"]) == ["rank:0", "rank:1"]
+        assert view["quorum"]["missing"] == []
+
+    def test_launcher_pinned_generation_wins(self, tmp_path):
+        _fleet_dir(tmp_path, generation=3)
+        agg = fleet.FleetAggregator(str(tmp_path), generation=4,
+                                    registry=MetricsRegistry())
+        view = agg.collect()
+        assert view["generation"] == 4
+        assert view["members"] == {} and view["fenced_out"] == 3
+
+    def test_quorum_missing(self, tmp_path):
+        reg, cs = _rank_registry(0)
+        _publish(tmp_path, 0, reg, cs, world=4)
+        view = fleet.FleetAggregator(
+            str(tmp_path), registry=MetricsRegistry()).collect()
+        assert view["quorum"]["expected_world"] == 4
+        assert view["quorum"]["missing"] == [1, 2, 3]
+
+    def test_phase_skew_and_merged_quantiles(self, tmp_path):
+        d = _fleet_dir(tmp_path)
+        scratch = MetricsRegistry()
+        view = fleet.FleetAggregator(d, registry=scratch).collect()
+        phase = view["phases"]["span.train.step.dispatch_s"]
+        # every rank's step WALL is ~equal (the waiters' collective wait
+        # hides the slow rank's compute) — the skew lives in the split
+        assert set(phase["ranks"]) == {"0", "1", "2"}
+        assert phase["skew"] == pytest.approx(1.0, abs=0.01)
+        assert "p50" in phase and "p99" in phase
+        wait = view["phases"]["collective.wait_s"]
+        # rank 2 waits ~nothing while the others wait on it: the LOW
+        # outlier shows in the spread, not in max/median skew
+        assert wait["spread"] > 0.9
+        assert scratch.get("fleet.snapshots.merged").value == 3
+        assert scratch.get("fleet.phase_skew",
+                           {"phase": "collective.wait_s"}) is not None
+
+    def test_straggler_compute_attribution(self, tmp_path):
+        d = _fleet_dir(tmp_path)  # rank 2: slow compute, zero wait
+        scratch = MetricsRegistry()
+        agg = fleet.FleetAggregator(d, window=4, threshold=1.5,
+                                    registry=scratch)
+        for _ in range(4):
+            view = agg.collect()
+        ranks = view["straggler"]["ranks"]
+        assert ranks["2"]["verdict"] == "compute"
+        assert ranks["2"]["compute_ratio"] >= 1.5
+        assert ranks["0"]["verdict"] == "ok"  # waiting victims, same wait
+        assert view["straggler"]["persistent"] == [2]
+        assert scratch.get("fleet.straggler.alerts").value == 1
+        # repeated rounds do not re-fire the transition counter
+        agg.collect()
+        assert scratch.get("fleet.straggler.alerts").value == 1
+        assert "rank 2" in agg.straggler_advisory()
+
+    def test_mid_run_degradation_detected_via_round_deltas(self, tmp_path):
+        # a rank that turns slow AFTER a long healthy history: lifetime
+        # means would dilute the regression below threshold for
+        # thousands of steps — the detector must difference successive
+        # snapshots and judge the steps since the last round
+        regs = {}
+        for r in range(3):
+            reg = MetricsRegistry()
+            regs[r] = (reg, fleet.CollectiveStats(registry=reg))
+            h = reg.histogram("span.train.step.dispatch_s")
+            for _ in range(100):
+                h.observe(0.01)  # long healthy history, every rank
+            _publish(tmp_path, r, reg, regs[r][1], world=3, step=100)
+        agg = fleet.FleetAggregator(str(tmp_path), window=4, threshold=1.5,
+                                    registry=MetricsRegistry())
+        agg.collect()  # baseline round records per-rank totals
+        for r, per_step in ((0, 0.01), (1, 0.05), (2, 0.01)):  # 1 degrades
+            reg, cs = regs[r]
+            h = reg.histogram("span.train.step.dispatch_s")
+            for _ in range(10):
+                h.observe(per_step)
+            _publish(tmp_path, r, reg, cs, world=3, step=110)
+        view = agg.collect()
+        ranks = view["straggler"]["ranks"]
+        # lifetime ratio would be ~1.3 (under threshold); the delta
+        # ratio vs the healthy median is ~5x and flags immediately
+        assert ranks["1"]["verdict"] == "compute"
+        assert ranks["1"]["compute_ratio"] >= 3.0
+        assert ranks["0"]["verdict"] == "ok"
+        assert ranks["2"]["verdict"] == "ok"
+
+    def test_departed_rank_clears_persistence(self, tmp_path):
+        d = _fleet_dir(tmp_path)  # rank 2 is the compute straggler
+        agg = fleet.FleetAggregator(d, window=4, threshold=1.5,
+                                    registry=MetricsRegistry())
+        for _ in range(4):
+            agg.collect()
+        assert agg.view()["straggler"]["persistent"] == [2]
+        # the world shrinks to ONE publisher (rank 2's host died): the
+        # stale verdict must clear even though <2 ranks remain to score
+        snaps, _ = fleet.load_snapshots([d])
+        survivors = [s for s in snaps if s["rank"] == 0]
+        view = agg.merge(survivors)
+        assert view["straggler"]["persistent"] == []
+        assert agg.straggler_advisory() is None
+
+    def test_lone_waiter_attributed_to_collective_not_compute(self,
+                                                              tmp_path):
+        # rank 1 alone waits (slow wire INTO it / late peer): high wait,
+        # normal compute — must read collective_wait, never compute
+        for r, (c, w) in enumerate([(0.01, 0.001), (0.01, 0.03),
+                                    (0.01, 0.001)]):
+            reg, cs = _rank_registry(r, compute_s=c, wait_s=w)
+            _publish(tmp_path, r, reg, cs, world=3)
+        view = fleet.FleetAggregator(
+            str(tmp_path), registry=MetricsRegistry()).collect()
+        ranks = view["straggler"]["ranks"]
+        assert ranks["1"]["verdict"] == "collective_wait"
+        assert view["straggler"]["persistent"] == []
+
+    def test_stale_snapshots_fenced_relative_to_newest(self, tmp_path):
+        # a publisher that STOPPED publishing (dead frontend pid, crashed
+        # rank) must drop out of the merged view instead of inflating
+        # members/quorum forever; staleness is relative to the NEWEST
+        # snapshot so post-mortem dirs still merge
+        for r in range(2):
+            reg, cs = _rank_registry(r)
+            _publish(tmp_path, r, reg, cs, world=2)
+        dead = json.load(open(fleet.snapshot_path(str(tmp_path), 1)))
+        dead["time"] -= 600.0
+        json.dump(dead, open(fleet.snapshot_path(str(tmp_path), 1), "w"))
+        agg = fleet.FleetAggregator(str(tmp_path), stale_s=120.0,
+                                    registry=MetricsRegistry())
+        view = agg.collect()
+        assert view["stale_out"] == 1
+        assert sorted(view["members"]) == ["rank:0"]
+        assert view["quorum"]["missing"] == [1]  # visible as absent, not live
+        # disabled fence keeps everything (offline archaeology)
+        agg_off = fleet.FleetAggregator(str(tmp_path), stale_s=0,
+                                        registry=MetricsRegistry())
+        assert agg_off.collect()["stale_out"] == 0
+
+    def test_view_refresh_does_not_advance_straggler_window(self, tmp_path):
+        d = _fleet_dir(tmp_path)
+        scratch = MetricsRegistry()
+        agg = fleet.FleetAggregator(d, window=4, threshold=1.5,
+                                    registry=scratch)
+        # a fast scraper refreshing the view must not fabricate
+        # persistence out of ONE real slow round
+        for _ in range(6):
+            view = agg.view(refresh=True)
+        assert view["straggler"]["rounds"] == 0
+        assert view["straggler"]["persistent"] == []
+        assert scratch.get("fleet.straggler.alerts") is None
+        # the monitor cadence (collect) is what advances the window
+        for _ in range(4):
+            agg.collect()
+        assert agg.view()["straggler"]["persistent"] == [2]
+
+    def test_merged_prometheus_round_trip(self, tmp_path):
+        # the PR 7 strict parser must accept the aggregator's merged
+        # /varz output: labeled families stay grouped under ONE
+        # # HELP/# TYPE, rank labels added correctly
+        for r in range(2):
+            reg, cs = _rank_registry(r, labeled=True)
+            _publish(tmp_path, r, reg, cs, world=2)
+        agg = fleet.FleetAggregator(str(tmp_path),
+                                    registry=MetricsRegistry())
+        text = agg.to_prometheus()
+        fams = parse_prometheus(text)
+        assert text.count("# TYPE serving_ttft_s histogram") == 1
+        assert text.count("# TYPE span_train_step_dispatch_s histogram") == 1
+        buckets = [(labels, v) for n, labels, v in
+                   fams["serving_ttft_s"]["samples"]
+                   if n == "serving_ttft_s_bucket"]
+        label_sets = {(l["slo_class"], l["rank"]) for l, _ in buckets}
+        assert label_sets == {("interactive", "0"), ("interactive", "1"),
+                              ("batch", "0"), ("batch", "1")}
+        # counters merge per rank, not summed into one anonymous series
+        steps = {l["rank"]: int(v) for n, l, v in
+                 fams["train_steps"]["samples"]}
+        assert steps == {"0": 8, "1": 8}
+
+    def test_shared_registry_publishes_merge_once(self, tmp_path):
+        # N in-process publishers over ONE registry (the serving replica
+        # shape): the merged view must not N-fold the counters
+        reg, cs = _rank_registry(0)
+        for r in range(2):
+            fleet.SnapshotPublisher(str(tmp_path), rank=r, registry=reg,
+                                    collectives_stats=cs,
+                                    min_interval_s=0.0).publish()
+        agg = fleet.FleetAggregator(str(tmp_path),
+                                    registry=MetricsRegistry())
+        fams = parse_prometheus(agg.to_prometheus())
+        totals = [int(v) for _, _, v in fams["train_steps"]["samples"]]
+        assert sum(totals) == 8  # once, not 16
+
+    def test_identity_only_twin_does_not_shadow_metrics_carrier(
+            self, tmp_path):
+        # the replica-0 publisher carries the shared registry; its
+        # include_metrics=False siblings publish identity only — even
+        # when a sibling's snapshot is NEWER, the merge must keep the
+        # metrics payload
+        reg, cs = _rank_registry(0)
+        fleet.SnapshotPublisher(str(tmp_path), rank=0, registry=reg,
+                                collectives_stats=cs,
+                                min_interval_s=0.0).publish()
+        fleet.SnapshotPublisher(str(tmp_path), rank=1, registry=reg,
+                                collectives_stats=cs, min_interval_s=0.0,
+                                include_metrics=False).publish()
+        empty = json.load(open(fleet.snapshot_path(str(tmp_path), 1)))
+        assert empty["metrics"] == []
+        agg = fleet.FleetAggregator(str(tmp_path),
+                                    registry=MetricsRegistry())
+        fams = parse_prometheus(agg.to_prometheus())
+        assert sum(int(v) for _, _, v in
+                   fams["train_steps"]["samples"]) == 8
+
+
+# ---------------------------------------------------------------------------
+# acceptance: a chaos-slowed rank in a multi-rank world, end to end
+# ---------------------------------------------------------------------------
+class TestChaosSlowedRankE2E:
+    SLOW_RANK = 2
+
+    def _run_world(self, tmp_path, n_ranks=4, steps=5):
+        """Four simulated ranks stepping in lockstep through a real
+        barrier collective; the chaos-delayed rank computes slowly, so
+        every OTHER rank's measured pre-collective wait grows while the
+        slow rank arrives last and waits ~nothing — the exact signature
+        the detector must attribute."""
+        barrier = threading.Barrier(n_ranks)
+        registries = {r: MetricsRegistry() for r in range(n_ranks)}
+        stats = {r: fleet.CollectiveStats(registry=registries[r])
+                 for r in range(n_ranks)}
+
+        def rank_loop(r):
+            h = registries[r].histogram("span.train.step.dispatch_s")
+            for _ in range(steps):
+                t0 = time.perf_counter()
+                # every rank pays a uniform compute floor so the fast
+                # ranks' compute ratios stay ~1 (scheduler jitter over a
+                # near-zero median would flake the verdict)
+                time.sleep(0.002)
+                if r == self.SLOW_RANK:
+                    chaos.site("fleet.slow_rank.compute")  # delay-armed
+                t_wait = time.perf_counter()
+                barrier.wait(timeout=10)  # the collective
+                t_done = time.perf_counter()
+                stats[r].note("all_reduce", t_done - t_wait, 0.0)
+                h.observe(t_done - t0)
+
+        with chaos.FaultPlan().delay("fleet.slow_rank.compute", 0.02,
+                                     times=None):
+            threads = [threading.Thread(target=rank_loop, args=(r,))
+                       for r in range(n_ranks)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+        for r in range(n_ranks):
+            _publish(tmp_path, r, registries[r], stats[r], world=n_ranks)
+        return str(tmp_path)
+
+    def test_straggler_identified_with_attribution(self, tmp_path):
+        d = self._run_world(tmp_path)
+        agg = fleet.FleetAggregator(d, window=4, threshold=1.5,
+                                    registry=MetricsRegistry())
+        for _ in range(4):
+            view = agg.collect()
+        ranks = view["straggler"]["ranks"]
+        slow = ranks[str(self.SLOW_RANK)]
+        assert slow["verdict"] == "compute"
+        assert view["straggler"]["persistent"] == [self.SLOW_RANK]
+        # attribution: the slow rank waited ~nothing; its peers waited
+        for r, info in ranks.items():
+            if r == str(self.SLOW_RANK):
+                continue
+            assert info["collective_wait_per_step_s"] > \
+                slow["collective_wait_per_step_s"]
+            assert info["verdict"] != "compute"
+
+    def test_fleetz_serves_the_verdict_live(self, tmp_path):
+        d = self._run_world(tmp_path)
+        srv = StatusServer(port=0, telemetry_dir=d).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urllib.request.urlopen(f"{base}/fleetz?refresh=1",
+                                        timeout=10) as resp:
+                view = json.loads(resp.read().decode())
+            assert str(self.SLOW_RANK) in view["straggler"]["ranks"]
+            assert view["straggler"]["ranks"][str(self.SLOW_RANK)][
+                "verdict"] == "compute"
+            assert view["quorum"]["missing"] == []
+        finally:
+            srv.stop()
+
+    def test_fleet_view_offline_merger(self, tmp_path, capsys):
+        d = self._run_world(tmp_path)
+        assert fleet_view.main([d, "--check"]) == 0
+        out = capsys.readouterr().out
+        assert f"straggler: rank {self.SLOW_RANK} [compute]" in out
+        # --prom round-trips the strict parser
+        assert fleet_view.main([d, "--prom"]) == 0
+        parse_prometheus(capsys.readouterr().out)
+
+    def test_fleet_view_check_fails_on_mixed_generations(self, tmp_path,
+                                                         capsys):
+        d = self._run_world(tmp_path)
+        reg, cs = _rank_registry(9)
+        _publish(tmp_path, 9, reg, cs, generation=1, world=1)
+        assert fleet_view.main([d, "--check"]) == 2
+        assert "generation-mixed" in capsys.readouterr().err
+
+    def test_fleet_view_check_fails_on_missing_quorum(self, tmp_path,
+                                                      capsys):
+        d = self._run_world(tmp_path)
+        assert fleet_view.main([d, "--check", "--expect", "6"]) == 2
+        assert "quorum missing" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# serving fleet rollup
+# ---------------------------------------------------------------------------
+class TestServingRollup:
+    def test_rollup_unit_grow_on_alert(self):
+        reps = {"replica0": {"state": "LIVE", "active": 2, "max_seqs": 2,
+                             "pending": 6, "load": 0.9},
+                "replica1": {"state": "DEAD", "active": 0, "max_seqs": 2,
+                             "pending": 0, "load": 0.0}}
+        slo = {"objectives": {"interactive.ttft<1.0s":
+                              {"fast": 20.0, "slow": 16.0}},
+               "alerts": [{"objective": "interactive.ttft<1.0s"}]}
+        out = fleet.serving_rollup(reps, slo, {"fractions": {}})
+        assert out["live_replicas"] == 1
+        assert out["queue_depth"] == 6
+        assert out["slo"]["worst_burn"] == 16.0  # min(fast, slow)
+        assert out["scale_hint"] == "grow"
+        assert out["pressure"] == 1.0
+
+    def test_rollup_occupancy_ignores_dead_replicas(self):
+        # 2 of 3 replicas DEAD, the survivor saturated: averaging the
+        # dead zeros in would dilute pressure to 0.33 and hide the
+        # exact moment an autoscaler must grow
+        reps = {"replica0": {"state": "LIVE", "active": 2, "max_seqs": 2,
+                             "pending": 0, "load": 1.0},
+                "replica1": {"state": "DEAD", "active": 0, "max_seqs": 2,
+                             "pending": 0, "load": 0.0},
+                "replica2": {"state": "DEAD", "active": 0, "max_seqs": 2,
+                             "pending": 0, "load": 0.0}}
+        out = fleet.serving_rollup(
+            reps, {"objectives": {}, "alerts": []}, {"fractions": {}})
+        assert out["occupancy_mean"] == 1.0
+        assert out["pressure"] == 1.0
+        assert out["scale_hint"] == "grow"
+
+    def test_rollup_unit_shrink_when_idle(self):
+        reps = {f"replica{i}": {"state": "LIVE", "active": 0,
+                                "max_seqs": 4, "pending": 0, "load": 0.0}
+                for i in range(3)}
+        out = fleet.serving_rollup(
+            reps, {"objectives": {}, "alerts": []}, {"fractions": {}})
+        assert out["scale_hint"] == "shrink"
+        assert out["pressure"] == 0.0
+
+    def test_serving_agg_sums_across_processes(self, tmp_path):
+        # two frontend PROCESSES sharing the telemetry dir: their
+        # identically-named series must SUM in the cluster rollup, and
+        # their replica-0s are distinct members (identity = rank@pid)
+        snaps = []
+        for pid in (111, 222):
+            reg = MetricsRegistry()
+            reg.gauge("serving.replica.queue_depth",
+                      labels={"replica": "replica0"}).set(3)
+            reg.gauge("serving.replica.occupancy",
+                      labels={"replica": "replica0"}).set(0.5)
+            reg.counter("serving.submitted").inc(5)
+            pub = fleet.SnapshotPublisher(str(tmp_path), rank=0,
+                                          role="replica", registry=reg,
+                                          min_interval_s=0.0, instance=pid)
+            snap = pub.build(step=1)
+            snap["pid"] = pid
+            snap["replica"] = {"state": "LIVE", "pending": 3, "active": 1,
+                               "load": 0.5}
+            snaps.append(snap)
+        agg = fleet.FleetAggregator(registry=MetricsRegistry())
+        view = agg.merge(snaps)
+        assert sorted(view["members"]) == ["replica:0@111",
+                                           "replica:0@222"]
+        serving = view["serving"]
+        assert serving["queue_depth"] == 6          # 3 + 3, not first-wins
+        assert serving["occupancy_mean"] == 0.5
+        assert serving["counters"]["serving.submitted"] == 10
+        # the merged exposition keeps BOTH processes' pre-labeled series,
+        # disambiguated under the secondary origin label
+        fams = parse_prometheus(agg.to_prometheus(snaps))
+        origins = {labels["origin"] for name, labels, _ in
+                   fams["serving_replica_queue_depth"]["samples"]
+                   if name == "serving_replica_queue_depth"}
+        assert origins == {"0@111", "0@222"}
+
+    def test_rollup_in_serving_report(self):
+        from paddle_tpu.serving import ServingFrontend
+        from test_serving_frontend import FakeEngine
+
+        with ServingFrontend([FakeEngine(), FakeEngine()]) as fe:
+            h = fe.submit(np.asarray([3, 1, 4, 1, 5], np.int32),
+                          max_new_tokens=3)
+            h.result(timeout=10)
+            rep = fe.serving_report()
+        block = rep["fleet"]
+        assert block["replicas"] == 2 and block["live_replicas"] == 2
+        assert block["scale_hint"] in ("grow", "hold", "shrink")
+        assert 0.0 <= block["pressure"] <= 1.0
+        assert global_registry.get("fleet.serving.live_replicas") is not None
+
+    def test_replica_publishes_fleet_snapshot(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TELEMETRY_DIR", str(tmp_path))
+        from paddle_tpu.serving import ServingFrontend
+        from test_serving_frontend import FakeEngine
+
+        with ServingFrontend([FakeEngine()]) as fe:
+            rep = fe.replicas[0]
+            assert rep._fleet_pub is not None
+            rep._fleet_pub.min_interval_s = 0.0
+            rep._fleet_pub.publish(step=1)
+        # the filename carries the host+pid instance: two frontend
+        # processes sharing a telemetry dir (even across hosts) must not
+        # collide on replica index 0
+        inst = fleet.process_instance()
+        snap_file = fleet.snapshot_path(
+            os.path.join(str(tmp_path), "serving"), 0, instance=inst)
+        snap = json.load(open(snap_file))
+        assert snap["role"] == "replica"
+        assert snap["replica"]["state"] in ("LIVE", "DRAINING", "DEAD")
+        # the aggregator picks serving/ snapshots up from the root dir;
+        # replica identity is rank@instance
+        view = fleet.FleetAggregator(
+            str(tmp_path), registry=MetricsRegistry()).collect()
+        assert f"replica:0@{inst}" in view["members"]
+        assert view["serving"] is not None
+
+
+# ---------------------------------------------------------------------------
+# statusz: the dispatch-table-derived route listing (satellite)
+# ---------------------------------------------------------------------------
+class TestStatuszRoutes:
+    def test_404_listing_derives_from_dispatch_table(self):
+        srv = StatusServer(port=0).start()
+        try:
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/nope", timeout=10)
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+                body = json.loads(e.read().decode())
+            # the listing IS the dispatch table — every implemented route,
+            # including /fleetz, appears by construction
+            assert body["routes"] == srv.route_names()
+            assert set(body["routes"]) == set(srv.routes)
+            assert "/fleetz" in body["routes"]
+        finally:
+            srv.stop()
+
+    def test_fleetz_without_dir_reports_not_configured(self):
+        srv = StatusServer(port=0)
+        assert "error" in srv.fleetz()
+
+
+# ---------------------------------------------------------------------------
+# bench contract block (satellite)
+# ---------------------------------------------------------------------------
+class TestBenchBlock:
+    def test_bench_block_shape(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TELEMETRY_DIR", raising=False)
+        global_registry.histogram("span.train.step.dispatch_s").observe(0.01)
+        block = fleet.bench_block()
+        assert "error" not in block
+        assert block["snapshots"] == 1
+        assert block["fenced_out"] == 0
+        assert isinstance(block["stragglers"], dict)
+        assert block["max_skew"] >= 0.0
