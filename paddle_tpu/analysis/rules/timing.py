@@ -50,8 +50,12 @@ def hot_path_timing(index):
 
 
 @rule("serving-sleep",
-      description="no blocking time.sleep in the serving control plane — "
-                  "wait on the dispatcher wake event instead")
+      description="no blocking time.sleep anywhere in the serving control "
+                  "plane — dispatchers wait on their wake event, and the "
+                  "supervisor's decision loop (serving/supervisor.py, "
+                  "ISSUE 12) waits on its cadence event; a sleeping "
+                  "control loop can neither be woken by a death nor "
+                  "stopped promptly")
 def serving_sleep(index):
     findings = []
     for fi in index.iter_files("paddle_tpu/serving/"):
@@ -60,7 +64,7 @@ def serving_sleep(index):
                     and dotted(node.func) == "time.sleep":
                 findings.append(Finding(
                     fi.path, node.lineno, "serving-sleep",
-                    "time.sleep holds a dispatcher hostage for the full "
-                    "duration — wait on the wake event "
-                    "(threading.Event.wait) instead"))
+                    "time.sleep holds a dispatcher/supervisor loop hostage "
+                    "for the full duration — wait on the wake/cadence "
+                    "event (threading.Event.wait) instead"))
     return findings

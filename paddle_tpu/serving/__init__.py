@@ -20,15 +20,34 @@ Layering (each file is one concern, unit-testable alone):
   virtual deadlines (starvation-free), bounded-queue admission with
   ``Overloaded`` load shedding, deadline expiry.
 - ``router.py``    — placement: prefix-cache affinity + session hints
-  blended with load; LIVE/DRAINING/DEAD replica health off heartbeats.
+  blended with load; LIVE/PROBATION/DRAINING/DEAD replica health off
+  flap-damped heartbeats.
+- ``brownout.py``  — overload brownout ladder (ISSUE 12): declared
+  degradation steps with hysteresis, machine-readable ``Overloaded``
+  rejections, and the per-class anti-retry-storm retry budget.
+- ``breaker.py``   — per-replica circuit breaking (ISSUE 12): windowed
+  error/latency scoring trips a sick replica into PROBATION (half-open
+  probes only) before it fails hard.
+- ``supervisor.py``— the self-healing actor (ISSUE 12 tentpole):
+  replaces dead replicas (per-domain restart budget + backoff +
+  generation fencing) and autoscales the fleet from the PR-11
+  pressure/scale_hint rollup, always via drain(). Default-off
+  (``PADDLE_SUPERVISOR``): zero threads unless armed.
 
-Chaos sites ``serving.route`` / ``serving.replica_kill`` make the failure
-paths deterministically testable (tests/test_serving_frontend.py kills a
-replica under concurrent mixed-SLO load). docs/SERVING.md is the operator
-guide; every later serving PR (autoscaling, multi-model, disaggregated
+Chaos sites ``serving.route`` / ``serving.replica_kill`` /
+``serving.replica_slow`` / ``serving.spawn_fail`` / ``supervisor.decision``
+make the failure paths deterministically testable (tests/
+test_serving_frontend.py, tests/test_supervisor.py). docs/SERVING.md is
+the operator guide; every later serving PR (multi-model, disaggregated
 prefill) builds on this subsystem.
 """
 from ..inference.continuous import EngineRequest, canonical_sampling  # noqa: F401
+from .breaker import BreakerPolicy, CircuitBreaker  # noqa: F401
+from .brownout import (  # noqa: F401
+    BrownoutLadder,
+    BrownoutStep,
+    RetryBudget,
+)
 from .frontend import (  # noqa: F401
     CANCELLED,
     DONE,
@@ -38,12 +57,14 @@ from .frontend import (  # noqa: F401
     RequestCancelled,
     RequestFailed,
     RequestHandle,
+    ResultTimeout,
     ServingFrontend,
 )
 from .router import (  # noqa: F401
     DEAD,
     DRAINING,
     LIVE,
+    PROBATION,
     NoLiveReplicas,
     ReplicaHandle,
     Router,
@@ -56,11 +77,17 @@ from .scheduler import (  # noqa: F401
     SLOClass,
     SLOScheduler,
 )
+from .supervisor import ReplicaFence, ReplicaSupervisor  # noqa: F401
 
 __all__ = [
     "ServingFrontend", "RequestHandle", "RequestFailed", "RequestCancelled",
+    "ResultTimeout",
     "QUEUED", "RUNNING", "DONE", "FAILED", "CANCELLED",
-    "Router", "ReplicaHandle", "NoLiveReplicas", "LIVE", "DRAINING", "DEAD",
+    "Router", "ReplicaHandle", "NoLiveReplicas",
+    "LIVE", "PROBATION", "DRAINING", "DEAD",
     "SLOScheduler", "SLOClass", "Overloaded", "DeadlineExceeded",
     "INTERACTIVE", "BATCH", "EngineRequest", "canonical_sampling",
+    "BrownoutLadder", "BrownoutStep", "RetryBudget",
+    "CircuitBreaker", "BreakerPolicy",
+    "ReplicaSupervisor", "ReplicaFence",
 ]

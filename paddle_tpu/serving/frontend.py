@@ -57,12 +57,23 @@ from ..observability import tracing as _tracing
 from ..observability.metrics import registry as _registry
 from ..observability.slo import SLOMonitor
 from ..testing import chaos
-from .router import DEAD, DRAINING, LIVE, NoLiveReplicas, ReplicaHandle, Router
+from .breaker import CircuitBreaker
+from .brownout import BrownoutLadder
+from .router import (
+    ADMITTING,
+    DEAD,
+    DRAINING,
+    LIVE,
+    PROBATION,
+    NoLiveReplicas,
+    ReplicaHandle,
+    Router,
+)
 from .scheduler import DeadlineExceeded, Overloaded, SLOScheduler
 
 __all__ = ["QUEUED", "RUNNING", "DONE", "FAILED", "CANCELLED",
-           "RequestFailed", "RequestCancelled", "RequestHandle",
-           "ServingFrontend"]
+           "RequestFailed", "RequestCancelled", "ResultTimeout",
+           "RequestHandle", "ServingFrontend"]
 
 QUEUED = "QUEUED"
 RUNNING = "RUNNING"
@@ -81,6 +92,14 @@ _M_REROUTED = _registry.counter("serving.rerouted")
 _M_DRAIN_REQUEUED = _registry.counter("serving.drain_requeued")
 _M_REPLICA_DEAD = _registry.counter("serving.replica_dead")
 _M_QUEUE = _registry.gauge("serving.queue_depth")
+_M_FLAPS = _registry.counter(
+    "serving.replica_flaps",
+    help="stale-heartbeat observations that recovered before the miss "
+         "budget ran out (damped — no reroute storm)")
+_M_CLAMPED = _registry.counter(
+    "brownout.tokens_clamped",
+    help="batch-class submits whose max_new_tokens the brownout ladder "
+         "clamped")
 
 
 class RequestFailed(RuntimeError):
@@ -92,12 +111,20 @@ class RequestCancelled(RuntimeError):
     """result(): the request was cancelled before completing."""
 
 
+class ResultTimeout(TimeoutError):
+    """result(timeout=)/stream(timeout=): the caller's wait bound expired
+    (ISSUE 12 satellite). The REQUEST is untouched — it keeps running and
+    a later result()/stream() can still observe it; only the caller's
+    blocking wait is bounded, so a wedged fleet can't hold every client
+    thread hostage. Subclasses TimeoutError for drop-in compatibility."""
+
+
 class _Entry:
     """Routing-layer wrapper: one EngineRequest + its handle + SLO facts."""
 
     __slots__ = ("req", "handle", "slo", "deadline_t", "virtual_deadline",
-                 "observed", "route_affinity", "route_score", "trace",
-                 "attempt_span", "queue_span", "attempt_n")
+                 "observed", "route_affinity", "route_score", "probe",
+                 "trace", "attempt_span", "queue_span", "attempt_n")
 
     def __init__(self, req, handle, slo, deadline_t, virtual_deadline):
         self.req = req
@@ -108,6 +135,7 @@ class _Entry:
         self.observed = False   # queue_wait/ttft recorded (once per request)
         self.route_affinity = False  # last place(): won by affinity/hint?
         self.route_score = 0.0       # last place(): winning blended score
+        self.probe = False           # last place(): half-open breaker probe?
         # request-scoped tracing (ISSUE 7): the trace context plus the open
         # per-attempt spans — an attempt is one placement; a reroute closes
         # it and opens the next, so the trace tree shows the failover
@@ -169,13 +197,17 @@ class RequestHandle:
     def result(self, timeout=None):
         """Block for the full token array (prompt + generated). Raises
         RequestFailed (with the failure reason) / RequestCancelled /
-        TimeoutError. A timed-out request returns its partial result with
-        ``handle.timed_out`` set."""
+        ResultTimeout. The timeout bounds only THIS caller's wait — the
+        request itself keeps running (call cancel() to abandon it), so a
+        wedged fleet can't hold the caller hostage forever. (A request the
+        ENGINE timed out per its own ``timeout_s`` still returns its
+        partial result with ``handle.timed_out`` set.)"""
         with self._cond:
             if not self._cond.wait_for(
                     lambda: self._status in _TERMINAL, timeout):
-                raise TimeoutError(
-                    f"request {self.rid} not finished within {timeout}s")
+                raise ResultTimeout(
+                    f"request {self.rid} not finished within {timeout}s "
+                    f"(the request is still running — not cancelled)")
             if self._status == DONE:
                 return self._result
             if self._status == CANCELLED:
@@ -187,8 +219,10 @@ class RequestHandle:
         """Iterator over generated token ids, yielding each one as soon as
         its decode block lands. Ends at completion/cancellation; raises
         RequestFailed on failure; ``timeout`` bounds the wait for EACH next
-        token. Consuming the stream pins the request to its replica — a
-        consumed stream cannot be transparently re-routed, only failed."""
+        token (ResultTimeout — the request is NOT cancelled; the iterator
+        can be resumed by calling stream() again). Consuming the stream
+        pins the request to its replica — a consumed stream cannot be
+        transparently re-routed, only failed."""
         with self._cond:
             # under the lock so the flag and _reset_for_reroute's check are
             # ordered: either the reroute sees it consumed and fails the
@@ -198,8 +232,9 @@ class RequestHandle:
             try:
                 kind, val = self._stream_q.get(timeout=timeout)
             except _queue.Empty:
-                raise TimeoutError(
-                    f"request {self.rid}: no token within {timeout}s") \
+                raise ResultTimeout(
+                    f"request {self.rid}: no token within {timeout}s "
+                    f"(the request is still running — not cancelled)") \
                     from None
             if kind == "tok":
                 yield val
@@ -306,7 +341,9 @@ class ServingFrontend:
 
     def __init__(self, engines, scheduler=None, router=None,
                  poll_wait_s=0.005, heartbeat_deadline_s=30.0,
-                 monitor_interval_s=None, start=True, warmup=None,
+                 monitor_interval_s=None, heartbeat_misses=3,
+                 brownout=None, breaker=None, engine_factory=None,
+                 start=True, warmup=None,
                  slo_monitor=None, statusz_port=None):
         # heartbeat_deadline_s must outlast the longest single engine call —
         # a first-compile prefill through a remote-compile tunnel can take
@@ -318,6 +355,10 @@ class ServingFrontend:
         self.router = router or Router()
         self.poll_wait_s = float(poll_wait_s)
         self.heartbeat_deadline_s = float(heartbeat_deadline_s)
+        # flap damping (ISSUE 12 satellite): LIVE->DEAD needs this many
+        # CONSECUTIVE stale-beat monitor checks — one slow heartbeat scrape
+        # is a counted flap (serving.replica_flaps), not a reroute storm
+        self.heartbeat_misses = max(1, int(heartbeat_misses))
         self.monitor_interval_s = (float(monitor_interval_s)
                                    if monitor_interval_s is not None
                                    else max(0.05, self.heartbeat_deadline_s / 4))
@@ -349,12 +390,33 @@ class ServingFrontend:
         # as the per-class histograms, read via serving_report()//statusz
         self.slo = slo_monitor or SLOMonitor(
             classes=self.scheduler.classes.values())
+        # overload brownout ladder (ISSUE 12): declared degradation steps
+        # driven by the monitor's fleet-pressure observations; level 0
+        # (no pressure ever observed) is a no-op on every submit path
+        self.brownout = brownout or BrownoutLadder()
+        # circuit breaker (ISSUE 12): per-replica error/latency scoring;
+        # verdicts become PROBATION/LIVE/DEAD transitions under self._lock.
+        # The router consults it for half-open probe placements.
+        self.breaker = breaker or CircuitBreaker()
+        self.router.breaker = self.breaker
+        # replica index allocator for add_replica (heartbeat-file rank
+        # namespace must never reuse a live index)
+        self._next_index = len(self.replicas)
         # live introspection (ISSUE 7): statusz_port=0 picks a free port
         self.statusz = None
         if statusz_port is not None:
             self.statusz = self.serve_statusz(statusz_port)
+        # replica lifecycle supervisor (ISSUE 12): attached by
+        # ReplicaSupervisor itself; None = nobody owns spawn/scale.
+        # ``engine_factory`` + PADDLE_SUPERVISOR=1 is the blessed opt-in —
+        # the env default-off keeps this constructor at zero extra threads
+        self.supervisor = None
         if start:
             self.start()
+        if engine_factory is not None:
+            from .supervisor import ReplicaSupervisor
+
+            ReplicaSupervisor.from_env(self, engine_factory)
 
     # ---- lifecycle --------------------------------------------------------
     def start(self):
@@ -388,6 +450,9 @@ class ServingFrontend:
     def shutdown(self, timeout=5.0):
         """Stop dispatchers and the monitor. In-flight work stops at the
         next block boundary; unfinished handles are failed (never lost)."""
+        if self.supervisor is not None:
+            self.supervisor.stop()
+            self.supervisor = None
         if self.statusz is not None:
             self.statusz.stop()
             self.statusz = None
@@ -417,16 +482,39 @@ class ServingFrontend:
     def submit(self, prompt, max_new_tokens, slo_class="interactive",
                deadline_s=None, eos_token_id=None, do_sample=False,
                temperature=1.0, top_k=0, top_p=1.0, seed=0,
-               timeout_s=None):
+               timeout_s=None, is_retry=False):
         """Enqueue one request; returns its RequestHandle immediately.
 
         Raises Overloaded (load shed — the request was never queued) when
-        the scheduler's queue bound is hit, or NoLiveReplicas when every
-        replica is draining/dead. ``deadline_s`` is relative to now: it
-        tightens the EDF priority and, if it expires before the request
-        starts, the request fails fast with DeadlineExceeded instead of
-        wasting decode slots."""
+        the scheduler's queue bound is hit or the brownout ladder sheds
+        this class (machine-readable ``retry_after_s``/``level``/``step``
+        fields), or NoLiveReplicas when every replica is draining/dead.
+        ``deadline_s`` is relative to now: it tightens the EDF priority
+        and, if it expires before the request starts, the request fails
+        fast with DeadlineExceeded instead of wasting decode slots.
+        ``is_retry=True`` declares a client re-submission of a rejected/
+        failed request: it must withdraw from the per-class retry budget
+        or is rejected immediately — the valve that keeps a retry storm
+        from re-saturating a recovering fleet (docs/SERVING.md)."""
         slo = self.scheduler.resolve(slo_class)
+        reserve = self.scheduler.reserve_class
+        # brownout ladder (ISSUE 12): the declared degradation steps run
+        # BEFORE the queue-bound check — they are cheaper (two int reads)
+        # and shedding at the rung is the point of having rungs at all
+        try:
+            self.brownout.check_admission(slo, reserve)
+            if is_retry:
+                self.brownout.check_retry(slo)
+        except Overloaded:
+            _M_SHED.inc()
+            raise
+        cap = self.brownout.token_cap(slo, reserve)
+        if cap is not None and max_new_tokens > cap:
+            max_new_tokens = cap  # clamp_tokens rung: bounded decode work
+            _M_CLAMPED.inc()
+        # shed_extras rung: optional work off — no per-request trace
+        # minting, no O(prompt-bytes) affinity probing in the router
+        extras = self.brownout.extras_enabled()
         sampling = canonical_sampling(do_sample, temperature, top_k, top_p)
         rid = next(self._rid_counter)  # atomic under the GIL
         req = EngineRequest(rid, prompt, max_new_tokens,
@@ -451,11 +539,13 @@ class ServingFrontend:
         # request-scoped trace (ISSUE 7): minted AFTER the advisory shed —
         # a shed storm must not mint contexts — and finished by the
         # handle's terminal transition, whichever path that is. None when
-        # telemetry is off (the zero-overhead contract).
+        # telemetry is off (the zero-overhead contract) or the brownout
+        # ladder shed extras.
         handle._trace = entry.trace = _rtrace.start(
             rid, slo=slo.name, prompt_len=len(req.prompt),
             max_new_tokens=req.max_new_tokens,
-            deadline_s=float(deadline_s) if deadline_s is not None else None)
+            deadline_s=float(deadline_s) if deadline_s is not None
+            else None) if extras else None
         exclude = set()
         try:
             while True:
@@ -466,7 +556,8 @@ class ServingFrontend:
                 # would stall all replicas behind each long-prompt submit.
                 # Everything place() reads is advisory; the append below
                 # re-checks the decisions that matter under the lock.
-                rep = self.router.place(entry, self.replicas, exclude=exclude)
+                rep = self.router.place(entry, self.replicas,
+                                        exclude=exclude, cheap=not extras)
                 # spans open BEFORE the entry becomes dispatcher-visible: a
                 # dispatcher that pops it the instant the append lands must
                 # find the queue span already open
@@ -486,7 +577,11 @@ class ServingFrontend:
                     except Overloaded:
                         _M_SHED.inc()
                         raise
-                    if rep.state == LIVE:  # can change between place() & here
+                    # state can change between place() and here; a probe
+                    # placement lands on its PROBATION target (that IS the
+                    # half-open recovery signal)
+                    if rep.state == LIVE or (entry.probe
+                                             and rep.state == PROBATION):
                         rep.pending.append(entry)
                         _M_SUBMITTED.inc()
                         _M_QUEUE.set(queued + 1)
@@ -502,13 +597,23 @@ class ServingFrontend:
                     error=f"{type(e).__name__}: {e}")
             raise
         self.router.committed(entry, rep)
-        self._wakes[rep.name].set()
+        # accepted: deposit into the class retry budget — accepted goodput
+        # is what funds future retries (the anti-retry-storm construction)
+        self.brownout.on_accepted(slo)
+        self._wake(rep.name)
         return handle
 
     def _make_on_token(self, handle, gen):
         def on_token(rid, tok):
             handle._push_token(tok, gen)
         return on_token
+
+    def _wake(self, name):
+        # .get, not []: a remove_replica can race a late wake from a
+        # request that finished on the removed replica
+        ev = self._wakes.get(name)
+        if ev is not None:
+            ev.set()
 
     def _cancel(self, handle):
         # flag first: if the scan below misses the request because its
@@ -526,7 +631,7 @@ class ServingFrontend:
                 e = rep.inflight.get(handle.rid)
                 if e is not None and e.handle is handle:
                     e.req.cancelled = True  # engine retires it next block
-                    self._wakes[rep.name].set()
+                    self._wake(rep.name)
                     return
         # already terminal or unknown: cancel() is idempotent
 
@@ -589,11 +694,18 @@ class ServingFrontend:
                 return
             progressed = False
             try:
-                if rep.state == LIVE:
+                if rep.state in ADMITTING:
                     progressed |= self._admit_pending(rep)
                 if not eng.idle():
+                    # chaos stall for a BUSY replica's dispatch: a delay
+                    # rule here inflates step_ewma until the breaker's
+                    # slow verdict trips — the deterministic "replica is
+                    # 5x slower than its peers" drill
+                    chaos.site("serving.replica_slow")
+                    t_step = time.monotonic()
                     for r in eng.step():
                         self._finish(rep, r)
+                    rep.note_step(time.monotonic() - t_step)
                     if getattr(eng, "prefill_chunk", 0):
                         # chunk-prefilling admissions observe TTFT lazily
                         # — their first token lands in a later step() than
@@ -608,7 +720,9 @@ class ServingFrontend:
                             self._observe_admission(e)
                     progressed = True
                 elif rep.state == DRAINING and not rep.inflight:
-                    self._drained[rep.name].set()
+                    drained = self._drained.get(rep.name)
+                    if drained is not None:  # vs a racing remove_replica
+                        drained.set()
             except BaseException as e:
                 # anything escaping the engine hooks is replica-fatal (the
                 # hooks isolate request-level failures internally).
@@ -637,7 +751,7 @@ class ServingFrontend:
 
     def _admit_pending(self, rep):
         eng, moved = rep.engine, False
-        while rep.state == LIVE and eng.has_free_slot():
+        while rep.state in ADMITTING and eng.has_free_slot():
             with self._lock:
                 i = self.scheduler.pick(rep.pending)
                 if i is None:
@@ -689,7 +803,7 @@ class ServingFrontend:
                 entry.queue_span = None
             if status == "deferred":
                 with self._lock:
-                    stranded = rep.state != LIVE
+                    stranded = rep.state not in ADMITTING
                     if not stranded:
                         rep.pending.append(entry)
                 if stranded:  # the sweep ran while we held the entry
@@ -726,8 +840,19 @@ class ServingFrontend:
                 self._observe_admission(entry)
                 self._finish(rep, entry.req, entry=entry)
             else:  # "failed"
-                _M_FAILED.inc()
-                entry.handle._fail(entry.req.error_message)
+                if entry.probe:
+                    # half-open probes are diagnostic traffic (breaker.py
+                    # contract): the breaker observed the failure; the
+                    # caller must not eat it — an unconsumed request
+                    # re-runs bit-identically on a healthy replica
+                    self._breaker_outcome(rep, entry, ok=False)
+                    self._relocate_inflight(
+                        entry, rep, f"probe failed on {rep.name}: "
+                                    f"{entry.req.error_message}")
+                else:
+                    _M_FAILED.inc()
+                    entry.handle._fail(entry.req.error_message)
+                    self._breaker_outcome(rep, entry, ok=False)
         return moved
 
     def _finish(self, rep, req, entry=None):
@@ -743,16 +868,28 @@ class ServingFrontend:
         self._observe_admission(entry)
         handle = entry.handle
         if req.error is not None:
+            if entry.probe:
+                # breaker.py contract: a failed probe is observed by the
+                # breaker (below may even fail the replica hard) but the
+                # CALLER does not eat it — unconsumed requests re-run
+                # bit-identically elsewhere, consumed streams fail cleanly
+                self._breaker_outcome(rep, entry, ok=False)
+                self._relocate_inflight(
+                    entry, rep,
+                    f"probe failed on {rep.name}: {req.error_message}")
+                return
             _M_FAILED.inc()
             handle._fail(req.error_message)
+            self._breaker_outcome(rep, entry, ok=False)
         elif req.cancelled:
             _M_CANCELLED.inc()
-            handle._cancelled_now()
+            handle._cancelled_now()  # caller's choice: no breaker signal
         else:
             _M_COMPLETED.inc()
             self._observe_completion(entry)
             self.slo.observe_event(entry.slo.name, "deadline_miss", False)
             handle._complete(req)
+            self._breaker_outcome(rep, entry, ok=True)
 
     # ---- replica death / drain -------------------------------------------
     def kill(self, replica, reason="killed by operator"):
@@ -777,21 +914,29 @@ class ServingFrontend:
             _M_DRAIN_REQUEUED.inc()
             self._requeue(entry, exclude={rep.name},
                           fail_reason=f"{rep.name} draining")
-        self._wakes[rep.name].set()
+        self._wake(rep.name)
         # the DRAINED signal comes from the dispatcher thread only: it is
         # the one thread that can hold an entry in transit between pending
         # and inflight, so its own idle check can never fire early
         return self._drained[rep.name].wait(timeout)
 
     def revive(self, replica):
-        """DRAINING -> LIVE (a drained replica rejoining the pool)."""
+        """DRAINING/PROBATION -> LIVE (a drained or circuit-broken replica
+        rejoining the pool by operator fiat)."""
         rep = self._resolve_replica(replica)
         with self._lock:
             if rep.state == DEAD:
-                raise ValueError(f"{rep.name} is DEAD; build a new engine "
-                                 f"and frontend instead of reviving")
+                raise ValueError(f"{rep.name} is DEAD; spawn a replacement "
+                                 f"(add_replica) instead of reviving")
+            was_probation = rep.state == PROBATION
             rep.state = LIVE
-        self._wakes[rep.name].set()
+        if was_probation:
+            # fresh slate: leaving the probing state without the breaker's
+            # own close verdict would otherwise leave its score stuck in
+            # half-open — record()/note_slow() no-op while probing, so the
+            # revived replica could never trip again
+            self.breaker.forget(rep.name)
+        self._wake(rep.name)
 
     def _resolve_replica(self, replica):
         if isinstance(replica, ReplicaHandle):
@@ -815,6 +960,7 @@ class ServingFrontend:
             inflight, rep.inflight = list(rep.inflight.values()), {}
         _M_REPLICA_DEAD.inc()
         self.router.forget_replica(rep.name)
+        self.breaker.forget(rep.name)
         reason = f"replica {rep.name} died: {rep.death_reason}"
         for entry in pending:
             self._requeue(entry, exclude={rep.name}, fail_reason=reason)
@@ -878,7 +1024,8 @@ class ServingFrontend:
                     shut_down = True
                 else:
                     shut_down = False
-                    if target.state == LIVE:
+                    if target.state == LIVE or (entry.probe
+                                                and target.state == PROBATION):
                         target.pending.append(entry)
                         break
             if shut_down:
@@ -892,25 +1039,37 @@ class ServingFrontend:
         self.router.committed(entry, target)
         if rerouted:
             _M_REROUTED.inc()
-        self._wakes[target.name].set()
+        self._wake(target.name)
 
     def _run_monitor(self):
         """Heartbeat watchdog over the dispatcher threads: a replica whose
         dispatcher stops beating (wedged in a jitted call, killed by a
         chaos fault that swallowed the thread) is declared DEAD so its
-        requests relocate instead of hanging their handles forever."""
+        requests relocate instead of hanging their handles forever. Also
+        the control cadence for the closed loops (ISSUE 12): per-replica
+        dispatch-pace verdicts feed the circuit breaker, and the fleet
+        pressure sample drives the brownout ladder."""
         while not self._stop.is_set():
             now = time.monotonic()
             for rep in self.replicas:
                 self._check_replica_liveness(rep, now)
+            self._check_replica_pace()
+            self.brownout.observe(self._pressure())
             self._stop.wait(self.monitor_interval_s)
 
     def _check_replica_liveness(self, rep, now):
         """One monitor verdict for one replica (factored out so tests can
-        drive it with crafted lock/beat states)."""
+        drive it with crafted lock/beat states). Flap damping (ISSUE 12
+        satellite): the DEAD verdict needs ``heartbeat_misses`` CONSECUTIVE
+        stale observations — a beat that recovers in between was a flap
+        (one slow scrape, a GC pause), counted on ``serving.replica_flaps``
+        instead of triggering a full reroute storm."""
         if rep.state == DEAD:
             return
         if now - rep.last_beat <= self.heartbeat_deadline_s:
+            if rep.missed_beats:
+                _M_FLAPS.inc()
+                rep.missed_beats = 0
             return
         # Lock decomposition (ISSUE 6): jitted execution serializes on the
         # replica's OWN engine lock; only first-compiles take the shared
@@ -935,9 +1094,162 @@ class ServingFrontend:
                 held = lock.held_since()
                 if held is None or now - held <= self.heartbeat_deadline_s:
                     return  # compiling, or queued behind a fresh hold
+        rep.missed_beats += 1
+        if rep.missed_beats < self.heartbeat_misses:
+            return  # damped: not dead until the miss budget runs out
         self._replica_died(rep, TimeoutError(
             f"dispatcher heartbeat stale {now - rep.last_beat:.1f}s "
-            f"(> {self.heartbeat_deadline_s}s)"))
+            f"(> {self.heartbeat_deadline_s}s) for {rep.missed_beats} "
+            f"consecutive monitor checks"))
+
+    def _check_replica_pace(self):
+        """Per-tick dispatch-latency verdicts for the circuit breaker: a
+        LIVE replica whose step EWMA exceeds ``slow_ratio`` x the
+        cross-replica median (the PR-11 compute-straggler classification
+        applied to serving dispatch) collects a slow strike; enough
+        consecutive strikes trip it into PROBATION."""
+        reps = [r for r in self.replicas
+                if r.state == LIVE and r.step_samples >= 3]
+        if len(reps) < 2:
+            return  # no peers to be slower than
+        ewmas = sorted(r.step_ewma for r in reps)
+        # LOWER median: with an even replica count the upper median IS the
+        # slowest minority member (2 replicas: the straggler itself, which
+        # can never exceed slow_ratio x its own pace) — the lower median
+        # stays anchored on the healthy majority
+        median = ewmas[(len(ewmas) - 1) // 2]
+        if median <= 0.0:
+            return
+        ratio = self.breaker.policy.slow_ratio
+        for r in reps:
+            if r.step_ewma > ratio * median:
+                if self.breaker.note_slow(r.name) == "trip":
+                    self._trip_replica(r)
+            else:
+                self.breaker.note_on_pace(r.name)
+
+    def _pressure(self):
+        """The brownout ladder's input: the fleet rollup's pressure blend
+        (mean LIVE occupancy vs queue/slots) without the report machinery
+        — cheap enough for every monitor tick."""
+        occs, slots, queued = [], 0, 0
+        for r in self.replicas:
+            queued += len(r.pending)
+            if r.state == LIVE:
+                occs.append(r.engine.active_count() / r.engine.max_seqs)
+                slots += r.engine.max_seqs
+        queue_pressure = (min(1.0, queued / slots) if slots
+                          else (1.0 if queued else 0.0))
+        occupancy = sum(occs) / len(occs) if occs else 0.0
+        return max(occupancy, queue_pressure)
+
+    # ---- circuit breaking (ISSUE 12) --------------------------------------
+    def _breaker_outcome(self, rep, entry, ok):
+        """One request outcome lands on the breaker; its verdicts become
+        replica state transitions (every state write under self._lock).
+        Probe outcomes drive the half-open ladder; normal outcomes feed
+        the windowed error score."""
+        if entry.probe:
+            verdict = self.breaker.probe_result(rep.name, ok)
+            if verdict == "close":
+                with self._lock:
+                    if rep.state == PROBATION:
+                        rep.state = LIVE
+                self._wake(rep.name)
+            elif verdict == "fail_hard":
+                self._replica_died(rep, RuntimeError(
+                    f"circuit breaker: "
+                    f"{self.breaker.policy.probation_failures} consecutive "
+                    f"probe failures after trip"))
+            return
+        if self.breaker.record(rep.name, ok) == "trip":
+            self._trip_replica(rep)
+
+    def _trip_replica(self, rep):
+        """LIVE -> PROBATION: normal routing stops (the router only sends
+        rate-limited probes), the pending queue re-routes to healthy
+        replicas NOW — in-flight work finishes where it is (retiring it
+        would waste the decode slots it already paid for)."""
+        with self._lock:
+            if rep.state != LIVE:
+                return
+            rep.state = PROBATION
+            pending, rep.pending = rep.pending, []
+        reason = (self.breaker.tripped_reason(rep.name)
+                  or "circuit breaker tripped")
+        for entry in pending:
+            self._requeue(entry, exclude={rep.name},
+                          fail_reason=f"{rep.name} tripped: {reason}")
+
+    # ---- fleet membership (ISSUE 12: the supervisor's spawn/retire) -------
+    def add_replica(self, engine, name=None, domain=None, fence=None):
+        """Grow the pool by one replica (the supervisor's spawn path; also
+        an ops hook). The dispatcher starts immediately when the frontend
+        is running. ``domain`` groups replicas into failure domains for
+        the supervisor's restart budgets; ``fence`` is the PR-9-contract
+        generation fence rejecting a superseded incarnation's telemetry
+        writes."""
+        with self._lock:
+            if self._stop.is_set():
+                raise RuntimeError("frontend is shut down")
+            idx = self._next_index
+            self._next_index += 1
+            rep = ReplicaHandle(name or f"replica{idx}", engine, index=idx)
+            if rep.name in self._by_name:
+                raise ValueError(f"replica name {rep.name!r} already exists")
+            rep.domain = domain or rep.name
+            rep.fence = fence
+            self._wakes[rep.name] = threading.Event()
+            self._drained[rep.name] = threading.Event()
+            # copy-on-write: unlocked readers iterate either the old or
+            # the new list, never a half-mutated one
+            self.replicas = self.replicas + [rep]
+            self._by_name[rep.name] = rep
+            started = self._started
+        if started:
+            # prune exited dispatchers (removed/replaced replicas) so a
+            # long-running supervisor's churn can't grow this list —
+            # shutdown() joins it in full
+            self._threads = [t for t in self._threads if t.is_alive()]
+            t = threading.Thread(target=self._run_replica, args=(rep,),
+                                 daemon=True,
+                                 name=f"paddle-serving-{rep.name}")
+            self._threads.append(t)
+            t.start()
+        return rep
+
+    def remove_replica(self, replica):
+        """Drop a DEAD (or drained DRAINING) replica from the pool and
+        retire its labeled gauges — the supervisor's cleanup after a
+        replacement or scale-down. Refuses replicas still holding work:
+        drain() first."""
+        rep = self._resolve_replica(replica)
+        with self._lock:
+            if rep.state not in (DEAD, DRAINING):
+                raise ValueError(f"{rep.name} is {rep.state}; drain() or "
+                                 f"kill() it before removing")
+            if rep.pending or rep.inflight:
+                raise ValueError(
+                    f"{rep.name} still holds work ({len(rep.pending)} "
+                    f"pending, {len(rep.inflight)} in flight) — drain() it")
+            rep.state = DEAD  # a DRAINING dispatcher exits on next wake
+            self.replicas = [r for r in self.replicas if r is not rep]
+            self._by_name.pop(rep.name, None)
+        self._wake(rep.name)
+        self._wakes.pop(rep.name, None)
+        self._drained.pop(rep.name, None)
+        self.router.forget_replica(rep.name)
+        self.breaker.forget(rep.name)
+        rep.retire_gauges()
+
+    def fleet_signal(self):
+        """The autoscaler's read: just the ``serving_report()["fleet"]``
+        rollup (pressure / scale_hint / worst burn) without the rest of
+        the report machinery — what the supervisor polls per tick."""
+        with self._lock:
+            replicas = {r.name: r.snapshot() for r in self.replicas}
+        return _fleet.serving_rollup(replicas, self.slo.report(),
+                                     _goodput.serving.report())
 
     # ---- request-scoped tracing (ISSUE 7) ---------------------------------
     def _trace_commit(self, entry, rep):
@@ -1041,7 +1353,7 @@ class ServingFrontend:
                     and not hasattr(_registry.get(n), "hwm")}
         slo_report = self.slo.report()
         goodput_report = _goodput.serving.report()
-        return {
+        out = {
             "replicas": replicas,
             "slo_classes": classes,
             "counters": {k: v for k, v in counters.items() if v},
@@ -1061,4 +1373,11 @@ class ServingFrontend:
             # churn alerts, and KV-pool/params bytes vs device capacity
             "compile": _compilemem.ledger.report(recent=8),
             "memory": _compilemem.memory.report(),
+            # closed-loop state (ISSUE 12): the brownout ladder's rung +
+            # history and the circuit breaker's per-replica scores
+            "brownout": self.brownout.report(),
+            "breaker": self.breaker.report(),
         }
+        if self.supervisor is not None:
+            out["supervisor"] = self.supervisor.report()
+        return out

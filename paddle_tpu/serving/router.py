@@ -19,18 +19,31 @@ second request of a new prefix usually arrives before the first finishes
 prefilling, so the index is still empty — the hint table remembers which
 replica the prefix was last routed to and keeps the session sticky.
 
-Health is a three-state ladder per replica — ``LIVE`` (routable),
-``DRAINING`` (finishes in-flight work, admits nothing, receives no new
-placements), ``DEAD`` (gone; its queue is rerouted) — driven by the PR-2
-watchdog heartbeat mechanism: every dispatcher loop stamps
-:meth:`ReplicaHandle.beat` (and, when ``PADDLE_TELEMETRY_DIR`` is set,
-launcher-format ``serving/heartbeat.<idx>.json`` files — namespaced so
-replica indexes never clobber training ranks' files), and the frontend's
-monitor declares a replica DEAD when its beat goes stale.
+Health is a four-state ladder per replica — ``LIVE`` (routable),
+``PROBATION`` (circuit-broken: only rate-limited probe traffic routes
+there — see serving/breaker.py), ``DRAINING`` (finishes in-flight work,
+admits nothing, receives no new placements), ``DEAD`` (gone; its queue is
+rerouted) — driven by the PR-2 watchdog heartbeat mechanism: every
+dispatcher loop stamps :meth:`ReplicaHandle.beat` (and, when
+``PADDLE_TELEMETRY_DIR`` is set, launcher-format
+``serving/heartbeat.<idx>.json`` files — namespaced so replica indexes
+never clobber training ranks' files), and the frontend's monitor declares
+a replica DEAD when its beat stays stale for ``heartbeat_misses``
+consecutive monitor checks (flap damping, ISSUE 12: ONE slow scrape used
+to trigger a full reroute storm — now it is a counted flap,
+``serving.replica_flaps``, not a death).
+
+When a :class:`ReplicaSupervisor` (serving/supervisor.py) manages the
+fleet, each handle carries a generation ``fence`` (the PR-9 elastic
+fencing contract): a superseded replica — one the supervisor already
+replaced — has its late heartbeat-file and fleet-snapshot writes
+rejected (``supervisor.fenced_writes``), so a zombie dispatcher can't
+masquerade as its own replacement in the telemetry dir.
 
 Chaos site ``serving.route`` fires on every placement decision so tests can
 inject routing outages; ``serving.replica_kill`` (in the frontend's
-dispatcher loop) kills a replica mid-flight.
+dispatcher loop) kills a replica mid-flight; ``serving.replica_slow`` (in
+the dispatcher's step path) stalls a busy replica's dispatch.
 """
 import os
 import threading
@@ -40,15 +53,23 @@ from ..observability.metrics import registry as _registry
 from ..testing import chaos
 from ..utils.envs import env_str
 
-__all__ = ["LIVE", "DRAINING", "DEAD", "NoLiveReplicas", "ReplicaHandle",
-           "Router"]
+__all__ = ["LIVE", "PROBATION", "DRAINING", "DEAD", "NoLiveReplicas",
+           "ReplicaHandle", "Router"]
 
 LIVE = "LIVE"
+PROBATION = "PROBATION"
 DRAINING = "DRAINING"
 DEAD = "DEAD"
 
+#: states a dispatcher admits work from its pending list in (PROBATION
+#: admits only what the breaker's probe budget routed there)
+ADMITTING = (LIVE, PROBATION)
+
 _M_ROUTED = _registry.counter("serving.routed")
 _M_AFFINITY_PLACED = _registry.counter("serving.routed_by_affinity")
+_M_FENCED = _registry.counter(
+    "supervisor.fenced_writes",
+    help="late heartbeat/snapshot writes rejected from superseded replicas")
 
 
 class NoLiveReplicas(RuntimeError):
@@ -72,6 +93,19 @@ class ReplicaHandle:
         self.last_beat = time.monotonic()
         self.thread_ident = None   # stamped by the dispatcher thread itself
         self.death_reason = None
+        # flap damping (ISSUE 12): consecutive monitor checks that found
+        # the beat stale; written only by the monitor thread
+        self.missed_beats = 0
+        # supervisor bookkeeping: failure domain (restart budgets/backoff
+        # are per-domain) and the generation fence a supervisor installs —
+        # a superseded incarnation's late telemetry writes are rejected
+        self.domain = None
+        self.fence = None
+        self.retired = False       # removed by scale-down, not a failure
+        # dispatch-latency EWMA (seconds per step() call, stamped by the
+        # dispatcher; read by the monitor's slow-replica classification)
+        self.step_ewma = 0.0
+        self.step_samples = 0
         # PR-2 integration: when the launcher exports PADDLE_TELEMETRY_DIR,
         # serving replicas publish launcher-format heartbeat files — in
         # their OWN serving/ subdirectory, NOT the telemetry root: replica
@@ -137,6 +171,8 @@ class ReplicaHandle:
         # watchdog samples at whole-second granularity anyway
         if self._wd_heartbeat is not None and now - self._wd_last_write >= 1.0:
             self._wd_last_write = now
+            if not self.fence_writable():
+                return  # superseded incarnation: no telemetry writes
             try:
                 self._wd_heartbeat.beat(step=step, role="serving")
             except OSError:
@@ -144,11 +180,49 @@ class ReplicaHandle:
             if self._fleet_pub is not None:
                 self._fleet_pub.maybe_publish(step=step)
 
+    def fence_writable(self):
+        """PR-9 fencing contract applied to serving telemetry: a replica
+        the supervisor already superseded must not publish heartbeat files
+        or fleet snapshots its replacement's aggregator would trust. The
+        in-memory ``last_beat`` stamp stays unfenced — liveness of the
+        thread is a fact either way."""
+        if self.fence is None:
+            return True
+        from ..distributed.fleet.elastic.fencing import StaleGenerationError
+
+        try:
+            self.fence.check(f"serving.heartbeat[{self.name}]")
+        except StaleGenerationError:
+            _M_FENCED.inc()
+            return False
+        except Exception:
+            return True  # fencing fails open, exactly like PR 9
+        return True
+
+    def note_step(self, wall_s):
+        """Dispatcher-side dispatch-latency sample (single writer: only
+        this replica's dispatcher calls it; the monitor only reads, and a
+        torn read costs one pace verdict, not correctness)."""
+        self.step_samples += 1  # lint: shared-mutation-without-lock-ok (single dispatcher writer; monitor reads are advisory)
+        if self.step_samples == 1:
+            self.step_ewma = wall_s  # lint: shared-mutation-without-lock-ok (same single-writer contract)
+        else:
+            self.step_ewma += 0.2 * (wall_s - self.step_ewma)  # lint: shared-mutation-without-lock-ok (same single-writer contract)
+
     def publish_gauges(self):
         eng = self.engine
         self._occ_gauge.set(eng.active_count() / eng.max_seqs)
         self._queue_gauge.set(len(self.pending))
         self._pages_gauge.set(eng.pages_in_use())
+
+    def retire_gauges(self):
+        """Remove this replica's labeled per-replica series (replacement /
+        scale-down): a removed name must stop exporting — a frozen stale
+        gauge reads as a live zero to a scraper."""
+        for fam in ("serving.replica.occupancy",
+                    "serving.replica.queue_depth",
+                    "serving.replica.pages_in_use"):
+            _registry.remove(fam, labels={"replica": self.name})
 
     def load(self):
         """0..~1 pressure blend: decode slots, pool pages, queue depth. Each
@@ -177,6 +251,9 @@ class ReplicaHandle:
             "pages_in_use": self.engine.pages_in_use(),
             "load": round(self.load(), 4),
             "death_reason": self.death_reason,
+            "missed_beats": self.missed_beats,
+            "domain": self.domain,
+            "step_ewma_s": round(self.step_ewma, 6),
         }
 
     def __repr__(self):
@@ -204,6 +281,9 @@ class Router:
         self.max_hints = int(max_hints)
         self._hints = {}   # prefix-head bytes -> replica name (insertion LRU)
         self._rr = 0
+        # circuit breaker (ISSUE 12): installed by the frontend; when set,
+        # PROBATION replicas receive rate-limited probe placements
+        self.breaker = None
         # place() is called from the submit path (under the frontend lock)
         # AND from reroute/monitor paths (not under it) — the hint table and
         # rr cursor need their own lock or a concurrent LRU-evict can pop
@@ -213,10 +293,12 @@ class Router:
     def _hint_key(self, prompt):
         return prompt[:self.HINT_TOKENS].tobytes()
 
-    def place(self, entry, replicas, exclude=()):
+    def place(self, entry, replicas, exclude=(), cheap=False):
         """Pick a LIVE replica for ``entry`` (an object with ``.req``).
         ``exclude`` names replicas the request must avoid (the one that just
         died under it). Raises NoLiveReplicas when nothing can take it.
+        ``cheap=True`` (brownout ``shed_extras``) skips the per-replica
+        affinity probe and session hints — pure least-loaded placement.
 
         Pure decision — no hint writes, no counters. The frontend calls
         :meth:`committed` once the entry actually lands in a pending list,
@@ -224,6 +306,18 @@ class Router:
         race) cannot re-home a live session's hint to a replica it never
         reached, and the routing counters count real placements only."""
         chaos.site("serving.route")
+        entry.probe = False
+        if self.breaker is not None:
+            # half-open probes win over normal scoring: a PROBATION
+            # replica only ever sees traffic through this rate-limited
+            # path, and without it there is no recovery signal at all
+            for r in replicas:
+                if r.state == PROBATION and r.name not in exclude \
+                        and self.breaker.allow_probe(r.name):
+                    entry.probe = True
+                    entry.route_affinity = False
+                    entry.route_score = 0.0
+                    return r
         live = [r for r in replicas
                 if r.state == LIVE and r.name not in exclude]
         if not live:
@@ -244,10 +338,11 @@ class Router:
                 entry.route_score = 0.0
                 return pick
             prompt = entry.req.prompt
-            hinted = self._hints.get(self._hint_key(prompt))
+            hinted = (None if cheap
+                      else self._hints.get(self._hint_key(prompt)))
         best, best_score, best_aff = None, None, 0.0
         for r in live:
-            if self.policy == "load":
+            if self.policy == "load" or cheap:
                 aff = hint = 0.0
             else:
                 aff = r.prefix_fraction(prompt)
@@ -270,6 +365,10 @@ class Router:
         _M_ROUTED.inc()
         if entry.route_affinity:
             _M_AFFINITY_PLACED.inc()
+        if getattr(entry, "probe", False):
+            # a half-open probe is diagnostic traffic: it must not re-home
+            # a live session's hint to a replica still under suspicion
+            return
         if self.policy != "prefix":
             return
         # remember the session: the NEXT request with this prefix head

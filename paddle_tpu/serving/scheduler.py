@@ -40,7 +40,23 @@ __all__ = ["Overloaded", "DeadlineExceeded", "SLOClass", "SLOScheduler",
 
 class Overloaded(RuntimeError):
     """Raised by submit(): the control plane is shedding load. Retry against
-    another cell / later — the request was never queued."""
+    another cell / later — the request was never queued.
+
+    Machine-readable (ISSUE 12): clients back off from the structured
+    fields instead of parsing the message — ``retry_after_s`` is the
+    server's backoff demand (honoring it is what keeps a retry storm from
+    re-saturating a recovering fleet; retries that ignore it burn the
+    per-class retry budget and get rejected harder), ``level``/``step``
+    identify the brownout rung that shed the request (``None``/"queue"
+    for a plain queue-bound shed), ``slo_class`` echoes the class."""
+
+    def __init__(self, msg, retry_after_s=None, level=None, step=None,
+                 slo_class=None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+        self.level = level
+        self.step = step
+        self.slo_class = slo_class
 
 
 class DeadlineExceeded(RuntimeError):
@@ -96,6 +112,12 @@ class SLOScheduler:
         self._reserve_class = min(self.classes.values(),
                                   key=lambda c: c.target_wait_s).name
 
+    @property
+    def reserve_class(self):
+        """Name of the class the admission reserve (and the brownout
+        ladder's shed_batch rung) protects — the lowest-target class."""
+        return self._reserve_class
+
     def resolve(self, slo_class):
         """Name or SLOClass -> SLOClass (unknown names raise)."""
         if isinstance(slo_class, SLOClass):
@@ -117,7 +139,8 @@ class SLOScheduler:
         if queued_count >= limit:
             raise Overloaded(
                 f"queue depth {queued_count} >= {limit} for SLO class "
-                f"{slo.name!r} (max_queue_depth={self.max_queue_depth})")
+                f"{slo.name!r} (max_queue_depth={self.max_queue_depth})",
+                step="queue", slo_class=slo.name)
 
     # ---- ordering ----------------------------------------------------------
     @staticmethod

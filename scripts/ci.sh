@@ -65,6 +65,7 @@ FAST_TESTS=(
   tests/test_nn.py
   tests/test_inference.py
   tests/test_serving_frontend.py
+  tests/test_supervisor.py
   tests/test_serving_perf.py
   tests/test_request_trace.py
   tests/test_compile_memory_obs.py

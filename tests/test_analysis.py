@@ -84,6 +84,30 @@ class TestServingSleep:
         }, ["serving-sleep"])
         assert [f.path for f in out] == ["paddle_tpu/serving/a.py"]
 
+    def test_supervisor_decision_loop_in_scope(self, tmp_path):
+        """ISSUE 12 satellite: the supervisor's control loop is serving
+        control plane — a polling time.sleep in a decision path is flagged
+        exactly like a dispatcher sleep; its event-driven cadence wait is
+        not."""
+        out = findings_for(tmp_path, {
+            "paddle_tpu/serving/supervisor.py":
+                "import time\n"
+                "def _run(self):\n"
+                "    while True:\n"
+                "        self.tick()\n"
+                "        time.sleep(0.25)\n",
+        }, ["serving-sleep"])
+        assert [(f.path, f.line) for f in out] == \
+            [("paddle_tpu/serving/supervisor.py", 5)]
+        out = findings_for(tmp_path, {
+            "paddle_tpu/serving/supervisor.py":
+                "def _run(self):\n"
+                "    while True:\n"
+                "        self.tick()\n"
+                "        self._wake.wait(0.25)\n",
+        }, ["serving-sleep"])
+        assert out == []
+
 
 class TestHostSyncInJit:
     def test_traced_lambda_violation(self, tmp_path):

@@ -651,7 +651,10 @@ class TestDrainAndFailover:
         the same staleness with the dispatcher uninvolved is fatal."""
         from paddle_tpu.inference.continuous import _COMPILE_LOCK
 
-        fe = ServingFrontend([FakeEngine(), FakeEngine()], start=False)
+        # heartbeat_misses=1: this unit isolates the LOCK deferral — the
+        # flap-damping miss budget (ISSUE 12) is tested on its own
+        fe = ServingFrontend([FakeEngine(), FakeEngine()], start=False,
+                             heartbeat_misses=1)
         rep = fe.replicas[0]
         rep.last_beat = time.monotonic() - 60  # long stale
         rep.thread_ident = threading.get_ident()
@@ -674,7 +677,7 @@ class TestDrainAndFailover:
         e0, e1 = FakeEngine(), FakeEngine()
         e0.dispatch_lock = _StampedRLock()
         e1.dispatch_lock = _StampedRLock()
-        fe = ServingFrontend([e0, e1], start=False)
+        fe = ServingFrontend([e0, e1], start=False, heartbeat_misses=1)
         rep = fe.replicas[0]
         rep.last_beat = time.monotonic() - 60  # long stale
         rep.thread_ident = threading.get_ident()

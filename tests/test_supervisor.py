@@ -1,0 +1,1066 @@
+"""Self-healing serving fleet (ISSUE 12): brownout ladder + retry budget,
+per-replica circuit breaking, router flap damping, ResultTimeout, and the
+ReplicaSupervisor's replace/scale/fence loops — capped by the E2E chaos
+drills the acceptance criteria name:
+
+- replica kill under mixed-SLO load -> automatic replacement within the
+  restart budget, zero lost/hung RequestHandles, burn back under the
+  alert threshold;
+- sustained overload -> the brownout rungs engage in their declared
+  order, interactive stays served while batch sheds, and the retry
+  budget keeps a client herd's re-submissions from re-saturating the
+  recovering fleet;
+- an error-spewing replica trips to PROBATION (pending rerouted), then
+  half-opens back to LIVE on probe successes — or fails hard to DEAD
+  and is replaced.
+
+Everything runs on the FakeEngine double from test_serving_frontend (the
+control plane never needs a model); clocks are injected wherever a policy
+has a time axis, so backoff/hysteresis/dwell are stepped, not slept.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+from test_serving_frontend import FakeEngine, _expected, _prompt
+
+from paddle_tpu.distributed.fleet.elastic.fencing import StaleGenerationError
+from paddle_tpu.observability.metrics import registry as _registry
+from paddle_tpu.observability.slo import SLOMonitor
+from paddle_tpu.serving import (
+    BATCH,
+    DEAD,
+    DRAINING,
+    INTERACTIVE,
+    LIVE,
+    PROBATION,
+    BreakerPolicy,
+    BrownoutLadder,
+    BrownoutStep,
+    CircuitBreaker,
+    Overloaded,
+    ReplicaFence,
+    ReplicaSupervisor,
+    RequestFailed,
+    ResultTimeout,
+    RetryBudget,
+    ServingFrontend,
+    SLOClass,
+    SLOScheduler,
+)
+from paddle_tpu.serving.brownout import (
+    CLAMP_TOKENS,
+    DEFAULT_STEPS,
+    REJECT,
+    SHED_BATCH,
+    SHED_EXTRAS,
+)
+from paddle_tpu.testing import chaos
+
+
+def _val(name, labels=None):
+    m = _registry.get(name, labels)
+    return getattr(m, "value", 0) if m is not None else 0
+
+
+class _Clock:
+    """Steppable monotonic clock for policy units."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _wait_until(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# brownout ladder policy units
+# ---------------------------------------------------------------------------
+class TestBrownoutLadder:
+    def _ladder(self, **kw):
+        kw.setdefault("clock", _Clock())
+        return BrownoutLadder(**kw)
+
+    def test_step_and_ladder_validation(self):
+        with pytest.raises(ValueError, match="release_at"):
+            BrownoutStep("x", engage_at=0.5, release_at=0.6)
+        with pytest.raises(ValueError, match="at least one"):
+            BrownoutLadder(steps=())
+        with pytest.raises(ValueError, match="duplicate"):
+            BrownoutLadder(steps=(BrownoutStep("a", 0.5, 0.4),
+                                  BrownoutStep("a", 0.7, 0.6)))
+        with pytest.raises(ValueError, match="engage_at order"):
+            BrownoutLadder(steps=(BrownoutStep("a", 0.9, 0.5),
+                                  BrownoutStep("b", 0.7, 0.6)))
+
+    def test_engages_in_declared_order_one_rung_per_observation(self):
+        lad = self._ladder()
+        names = [s.name for s in DEFAULT_STEPS]
+        seen = []
+        for _ in range(len(names)):
+            lad.observe(1.0)
+            seen.append(lad.step_name())
+        assert seen == names  # one rung per observation, declared order
+        assert lad.level == len(names)
+        lad.observe(1.0)
+        assert lad.level == len(names)  # saturates at the top rung
+        assert [kind for _, kind, _ in lad.history] == ["engage"] * len(names)
+
+    def test_release_requires_dwell_and_steps_one_rung(self):
+        clk = _Clock()
+        lad = self._ladder(clock=clk, dwell_s=2.0)
+        lad.observe(0.85)
+        assert lad.step_name() == CLAMP_TOKENS
+        lad.observe(0.1)           # below release_at, dwell starts
+        assert lad.level == 1      # not yet: dwell
+        clk.t += 1.0
+        lad.observe(0.1)
+        assert lad.level == 1      # still dwelling
+        clk.t += 1.5
+        lad.observe(0.1)
+        assert lad.level == 0      # dwell elapsed: one rung down
+        assert lad.history[-1][1:] == ("release", CLAMP_TOKENS)
+
+    def test_dwell_resets_when_pressure_returns(self):
+        clk = _Clock()
+        lad = self._ladder(clock=clk, dwell_s=2.0)
+        lad.observe(0.85)
+        lad.observe(0.1)     # dwell starts
+        clk.t += 1.5
+        lad.observe(0.75)    # back above release_at (0.6): dwell aborted
+        clk.t += 1.0
+        lad.observe(0.1)     # dwell restarts from here
+        assert lad.level == 1
+        clk.t += 2.5
+        lad.observe(0.1)
+        assert lad.level == 0
+
+    def test_token_cap_clamps_batch_not_reserve(self):
+        lad = self._ladder(batch_token_cap=8)
+        assert lad.token_cap(BATCH, "interactive") is None     # level 0
+        lad.observe(0.85)                                      # clamp_tokens
+        assert lad.token_cap(BATCH, "interactive") == 8
+        assert lad.token_cap(INTERACTIVE, "interactive") is None
+
+    def test_extras_disabled_from_shed_extras_up(self):
+        lad = self._ladder()
+        lad.observe(1.0)
+        assert lad.extras_enabled()      # level 1: clamp only
+        lad.observe(1.0)                 # level 2: shed_extras
+        assert not lad.extras_enabled()
+
+    def test_admission_sheds_batch_then_everything(self):
+        lad = self._ladder(retry_after_base_s=0.5)
+        for _ in range(3):               # -> shed_batch
+            lad.observe(1.0)
+        lad.check_admission(INTERACTIVE, "interactive")  # still served
+        with pytest.raises(Overloaded) as ei:
+            lad.check_admission(BATCH, "interactive")
+        # the machine-readable contract: clients back off from fields
+        assert ei.value.step == SHED_BATCH
+        assert ei.value.level == 3
+        assert ei.value.slo_class == "batch"
+        assert ei.value.retry_after_s == pytest.approx(0.5 * 4)
+        lad.observe(1.0)                 # -> reject
+        with pytest.raises(Overloaded) as ei:
+            lad.check_admission(INTERACTIVE, "interactive")
+        assert ei.value.step == REJECT
+
+    def test_retry_budget_denies_when_drained_and_refills_on_goodput(self):
+        budget = RetryBudget(ratio=0.5, burst=2.0)
+        lad = self._ladder(retry_budget=budget)
+        lad.check_retry(INTERACTIVE)     # burst token 1
+        lad.check_retry(INTERACTIVE)     # burst token 2
+        denied0 = _val("brownout.retry_denied",
+                       labels={"slo_class": "interactive"})
+        with pytest.raises(Overloaded) as ei:
+            lad.check_retry(INTERACTIVE)
+        assert ei.value.step == "retry_budget"
+        assert _val("brownout.retry_denied",
+                    labels={"slo_class": "interactive"}) == denied0 + 1
+        for _ in range(2):               # accepted goodput refills at ratio
+            lad.on_accepted(INTERACTIVE)
+        lad.check_retry(INTERACTIVE)     # one whole token again
+        # classes have separate buckets
+        lad.check_retry(BATCH)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker policy units
+# ---------------------------------------------------------------------------
+class TestCircuitBreaker:
+    def test_error_rate_trips_after_min_samples(self):
+        br = CircuitBreaker(BreakerPolicy(window=8, error_threshold=0.5,
+                                          min_samples=4))
+        assert br.record("r", False) is None   # 1/1 but < min_samples
+        assert br.record("r", True) is None
+        assert br.record("r", False) is None
+        assert br.record("r", False) == "trip"  # 3/4 >= 0.5
+        assert "error rate" in br.tripped_reason("r")
+        # tripped: further outcomes are probation-only business
+        assert br.record("r", False) is None
+
+    def test_ok_traffic_never_trips(self):
+        br = CircuitBreaker(BreakerPolicy(window=4, min_samples=2))
+        for _ in range(50):
+            assert br.record("r", True) is None
+
+    def test_slow_strikes_trip_and_on_pace_resets(self):
+        br = CircuitBreaker(BreakerPolicy(slow_strikes=3))
+        assert br.note_slow("r") is None
+        assert br.note_slow("r") is None
+        br.note_on_pace("r")                 # verdicts must be CONSECUTIVE
+        assert br.note_slow("r") is None
+        assert br.note_slow("r") is None
+        assert br.note_slow("r") == "trip"
+        assert "latency" in br.tripped_reason("r")
+
+    def test_probe_rate_limit_and_half_open_close(self):
+        clk = _Clock()
+        br = CircuitBreaker(BreakerPolicy(window=4, min_samples=2,
+                                          probe_interval_s=1.0,
+                                          probe_successes=2),
+                            clock=clk)
+        assert not br.allow_probe("r")       # not tripped: no probes
+        br.record("r", False)
+        br.record("r", False)
+        assert br.allow_probe("r")
+        assert not br.allow_probe("r")       # rate limited
+        clk.t += 1.5
+        assert br.allow_probe("r")
+        rec0 = _val("breaker.recoveries")
+        assert br.probe_result("r", True) is None
+        assert br.probe_result("r", True) == "close"
+        assert _val("breaker.recoveries") == rec0 + 1
+        assert br.tripped_reason("r") is None
+        assert not br.allow_probe("r")       # closed again
+
+    def test_probe_failures_fail_hard(self):
+        br = CircuitBreaker(BreakerPolicy(window=4, min_samples=2,
+                                          probation_failures=2))
+        br.record("r", False)
+        br.record("r", False)
+        hard0 = _val("breaker.failed_hard")
+        assert br.probe_result("r", True) is None
+        assert br.probe_result("r", False) is None
+        assert br.probe_result("r", False) == "fail_hard"
+        assert _val("breaker.failed_hard") == hard0 + 1
+
+    def test_forget_drops_score_and_gauge(self):
+        br = CircuitBreaker(BreakerPolicy(window=4, min_samples=2))
+        br.record("gone", False)
+        br.record("gone", False)
+        assert _registry.get("breaker.state",
+                             labels={"replica": "gone"}) is not None
+        br.forget("gone")
+        assert "gone" not in br.report()
+        assert _registry.get("breaker.state",
+                             labels={"replica": "gone"}) is None
+
+
+# ---------------------------------------------------------------------------
+# router flap damping (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+class TestFlapDamping:
+    def test_one_stale_scrape_is_a_flap_not_a_death(self):
+        fe = ServingFrontend([FakeEngine(), FakeEngine()], start=False,
+                             heartbeat_misses=3, heartbeat_deadline_s=1.0)
+        rep = fe.replicas[0]
+        rep.thread_ident = -1  # never a lock participant
+        flaps0 = _val("serving.replica_flaps")
+        rep.last_beat = time.monotonic() - 5
+        fe._check_replica_liveness(rep, time.monotonic())
+        fe._check_replica_liveness(rep, time.monotonic())
+        assert rep.state == LIVE and rep.missed_beats == 2
+        rep.last_beat = time.monotonic()   # the beat came back: a flap
+        fe._check_replica_liveness(rep, time.monotonic())
+        assert rep.state == LIVE
+        assert rep.missed_beats == 0
+        assert _val("serving.replica_flaps") == flaps0 + 1
+        fe.shutdown()
+
+    def test_k_consecutive_misses_still_kill(self):
+        fe = ServingFrontend([FakeEngine(), FakeEngine()], start=False,
+                             heartbeat_misses=3, heartbeat_deadline_s=1.0)
+        rep = fe.replicas[0]
+        rep.thread_ident = -1
+        rep.last_beat = time.monotonic() - 5
+        for _ in range(3):
+            assert rep.state == LIVE
+            fe._check_replica_liveness(rep, time.monotonic())
+        assert rep.state == DEAD
+        assert "3 consecutive" in rep.death_reason
+        fe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ResultTimeout (ISSUE 12 satellite)
+# ---------------------------------------------------------------------------
+class TestResultTimeout:
+    def test_result_timeout_is_typed_and_does_not_cancel(self):
+        barrier = threading.Event()
+        eng = FakeEngine(step_barrier=barrier)
+        with ServingFrontend([eng]) as fe:
+            p = _prompt(3, 4)
+            h = fe.submit(p, 5)
+            with pytest.raises(ResultTimeout):
+                h.result(timeout=0.05)
+            assert isinstance(ResultTimeout("x"), TimeoutError)  # drop-in
+            assert not h.done()            # NOT cancelled by the timeout
+            barrier.set()
+            np.testing.assert_array_equal(h.result(timeout=20),
+                                          _expected(p, 5))
+
+    def test_stream_per_token_timeout_resumable(self):
+        barrier = threading.Event()
+        eng = FakeEngine(step_barrier=barrier)
+        with ServingFrontend([eng]) as fe:
+            p = _prompt(5, 6)
+            h = fe.submit(p, 4)
+            it = h.stream(timeout=0.5)
+            tok0 = next(it)                # admission token arrives
+            assert tok0 == int(p[-1])
+            with pytest.raises(ResultTimeout):
+                next(it)                   # engine wedged: bounded wait
+            assert not h.done()
+            barrier.set()
+            rest = list(h.stream(timeout=10))   # resumes, nothing lost
+            assert [tok0] + rest == [int(p[-1])] * 4
+
+
+# ---------------------------------------------------------------------------
+# supervisor units (steppable clock, direct tick())
+# ---------------------------------------------------------------------------
+class _Factory:
+    """Counting engine factory."""
+
+    def __init__(self, **engine_kw):
+        self.engine_kw = engine_kw
+        self.spawned = 0
+
+    def __call__(self):
+        self.spawned += 1
+        return FakeEngine(**self.engine_kw)
+
+
+class TestSupervisorUnits:
+    def _fleet(self, n=2, start=True, **fe_kw):
+        fe_kw.setdefault("monitor_interval_s", 0.02)
+        fe_kw.setdefault("heartbeat_deadline_s", 5.0)
+        fe = ServingFrontend([FakeEngine() for _ in range(n)],
+                             start=start, **fe_kw)
+        return fe
+
+    def test_from_env_default_off_zero_threads(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_SUPERVISOR", raising=False)
+        fe = self._fleet()
+        before = threading.active_count()
+        assert ReplicaSupervisor.from_env(fe, _Factory()) is None
+        assert fe.supervisor is None
+        assert threading.active_count() == before
+        # the frontend-integrated path: engine_factory= + env off
+        fe2 = ServingFrontend([FakeEngine()], start=False,
+                              engine_factory=_Factory())
+        assert fe2.supervisor is None
+        assert not any("supervisor" in t.name for t in threading.enumerate())
+        fe.shutdown()
+        fe2.shutdown()
+
+    def test_from_env_armed_starts_and_attaches(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_SUPERVISOR", "1")
+        fe = self._fleet()
+        sup = ReplicaSupervisor.from_env(fe, _Factory())
+        try:
+            assert sup is not None and fe.supervisor is sup
+            assert any(t.name == "paddle-serving-supervisor"
+                       for t in threading.enumerate())
+            assert fe.serving_report()["supervisor"]["running"]
+        finally:
+            fe.shutdown()     # stops the supervisor too
+        assert not any(t.name == "paddle-serving-supervisor"
+                       for t in threading.enumerate())
+
+    def test_replace_dead_spawns_fenced_replacement(self):
+        fe = self._fleet()
+        clk = _Clock()
+        factory = _Factory()
+        sup = ReplicaSupervisor(fe, factory, clock=clk, start=False)
+        old = fe.replicas[0]
+        assert old.fence is not None        # adopted at generation 0
+        respawns0 = _val("supervisor.respawns")
+        fe.kill("replica0", reason="chaos")
+        sup.tick()
+        assert _val("supervisor.respawns") == respawns0 + 1
+        assert factory.spawned == 1
+        names = [r.name for r in fe.replicas]
+        assert "replica0-g1" in names and "replica0" not in names
+        new = fe._by_name["replica0-g1"]
+        assert new.state == LIVE and new.domain == "replica0"
+        # the PR-9 fencing contract: the superseded incarnation's late
+        # telemetry writes are rejected...
+        with pytest.raises(StaleGenerationError):
+            old.fence.check("late write")
+        fenced0 = _val("supervisor.fenced_writes")
+        assert old.fence_writable() is False
+        assert _val("supervisor.fenced_writes") == fenced0 + 1
+        # ...while the replacement's are not
+        new.fence.check("fresh write")
+        assert new.fence_writable() is True
+        # and the replacement actually serves
+        p = _prompt(9, 1)
+        np.testing.assert_array_equal(fe.submit(p, 3).result(timeout=10),
+                                      _expected(p, 3))
+        fe.shutdown()
+
+    def test_superseded_replica_stops_writing_heartbeat_files(
+            self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PADDLE_TELEMETRY_DIR", str(tmp_path))
+        fe = self._fleet()
+        clk = _Clock()
+        sup = ReplicaSupervisor(fe, _Factory(), clock=clk, start=False)
+        old = fe.replicas[0]
+        assert _wait_until(
+            lambda: (tmp_path / "serving" / "heartbeat.0.json").exists())
+        fe.kill("replica0", reason="chaos")
+        sup.tick()
+        hb = tmp_path / "serving" / "heartbeat.0.json"
+        stamp = hb.read_bytes()
+        old._wd_last_write = 0.0       # bypass the 1/s write rate limit
+        old.beat()                     # a zombie dispatcher's late beat
+        assert hb.read_bytes() == stamp    # fenced: no write happened
+        fe.shutdown()
+
+    def test_spawn_fail_backoff_and_restart_budget(self):
+        fe = self._fleet()
+        clk = _Clock()
+        factory = _Factory()
+        sup = ReplicaSupervisor(fe, factory, clock=clk, start=False,
+                                restart_budget=2, backoff_base_s=1.0,
+                                backoff_max_s=8.0)
+        fe.kill("replica0", reason="chaos")
+        fails0 = _val("supervisor.spawn_failures")
+        exhausted0 = _val("supervisor.budget_exhausted")
+        with chaos.FaultPlan().fail("serving.spawn_fail", times=None):
+            sup.tick()                       # attempt 1 fails
+            assert _val("supervisor.spawn_failures") == fails0 + 1
+            sup.tick()                       # inside backoff: no attempt
+            assert _val("supervisor.spawn_failures") == fails0 + 1
+            clk.t += 1.5                     # past the 1s backoff
+            sup.tick()                       # attempt 2 fails
+            assert _val("supervisor.spawn_failures") == fails0 + 2
+            clk.t += 10.0
+            sup.tick()                       # budget exhausted: no attempt
+            assert _val("supervisor.spawn_failures") == fails0 + 2
+            assert _val("supervisor.budget_exhausted") == exhausted0 + 1
+            sup.tick()                       # stays exhausted, stays quiet
+        assert factory.spawned == 0
+        dom = sup.report()["domains"]["replica0"]
+        assert dom["exhausted"] and dom["attempts"] == 2
+        # the dead replica is still there (nothing replaced it) and the
+        # fleet keeps serving on the survivor
+        assert fe._by_name["replica0"].state == DEAD
+        p = _prompt(2, 7)
+        np.testing.assert_array_equal(fe.submit(p, 2).result(timeout=10),
+                                      _expected(p, 2))
+        fe.shutdown()
+
+    def test_scale_up_needs_sustained_grow_hint(self):
+        fe = self._fleet()
+        clk = _Clock()
+        factory = _Factory()
+        sup = ReplicaSupervisor(fe, factory, clock=clk, start=False,
+                                max_replicas=3, grow_hold_s=5.0)
+        hints = {"scale_hint": "hold"}
+        fe.fleet_signal = lambda: dict(hints)
+        ups0 = _val("supervisor.scale_ups")
+        hints["scale_hint"] = "grow"
+        sup.tick()                    # hold starts now
+        assert len(fe.replicas) == 2
+        clk.t += 2.0
+        hints["scale_hint"] = "hold"  # pressure blipped away: hold resets
+        sup.tick()
+        clk.t += 1.0
+        hints["scale_hint"] = "grow"
+        sup.tick()
+        clk.t += 4.0
+        sup.tick()                    # only 4s of THIS streak: no spawn
+        assert len(fe.replicas) == 2
+        clk.t += 2.0
+        sup.tick()                    # 6s sustained: grow
+        assert len(fe.replicas) == 3
+        assert _val("supervisor.scale_ups") == ups0 + 1
+        assert factory.spawned == 1
+        new = fe.replicas[-1]
+        assert new.state == LIVE and new.fence is not None
+        # capped at max_replicas
+        clk.t += 10.0
+        sup.tick()
+        clk.t += 10.0
+        sup.tick()
+        assert len(fe.replicas) == 3
+        fe.shutdown()
+
+    def test_scale_down_drains_after_cooldown(self):
+        fe = self._fleet(n=3)
+        clk = _Clock()
+        sup = ReplicaSupervisor(fe, _Factory(), clock=clk, start=False,
+                                min_replicas=2, shrink_cooldown_s=5.0,
+                                drain_timeout_s=10.0)
+        downs0 = _val("supervisor.scale_downs")
+        fe.fleet_signal = lambda: {"scale_hint": "shrink"}
+        sup.tick()
+        assert len(fe.replicas) == 3    # cooldown running
+        clk.t += 6.0
+        sup.tick()                      # drained + removed
+        assert len(fe.replicas) == 2
+        assert _val("supervisor.scale_downs") == downs0 + 1
+        # min_replicas floor holds even under a sustained shrink hint
+        clk.t += 20.0
+        sup.tick()
+        assert len(fe.replicas) == 2
+        fe.shutdown()
+
+    def test_shrink_aborts_when_drain_times_out(self):
+        barrier = threading.Event()
+        wedged = FakeEngine(step_barrier=barrier)
+        fe = ServingFrontend([wedged, FakeEngine()], start=True,
+                             heartbeat_deadline_s=30.0)
+        clk = _Clock()
+        sup = ReplicaSupervisor(fe, _Factory(), clock=clk, start=False,
+                                min_replicas=1, shrink_cooldown_s=1.0,
+                                drain_timeout_s=0.2)
+        h = fe.submit(_prompt(1, 2), 5)   # wedges in replica0's step()
+        assert _wait_until(lambda: fe.replicas[0].inflight
+                           or fe.replicas[1].inflight)
+        victim = (fe.replicas[0] if fe.replicas[0].inflight
+                  else fe.replicas[1])
+        fe.fleet_signal = lambda: {"scale_hint": "shrink"}
+        # force the wedged replica to be the least-loaded victim by
+        # loading the OTHER one's queue
+        other = fe.replicas[1 - victim.index]
+        other.engine.admit_paused = True
+        for _ in range(6):
+            fe.submit(_prompt(3, 4), 2)
+        sup.tick()                         # registers the shrink streak
+        clk.t += 2.0
+        sup.tick()                         # past cooldown: drain attempted
+        assert victim.state == LIVE        # drain timed out -> revived
+        assert not victim.retired
+        assert len(fe.replicas) == 2
+        assert sup.report()["events"][-1][1] == "shrink_aborted"
+        barrier.set()
+        other.engine.admit_paused = False
+        np.testing.assert_array_equal(h.result(timeout=20),
+                                      _expected(_prompt(1, 2), 5))
+        fe.shutdown()
+
+    def test_decision_chaos_seam_is_armable_and_loop_survives(self):
+        fe = self._fleet()
+        sup = ReplicaSupervisor(fe, _Factory(), start=False)
+        with chaos.FaultPlan().fail("supervisor.decision", times=1):
+            with pytest.raises(chaos.FaultInjected):
+                sup.tick()               # direct drive: the seam fires
+        errs0 = _val("supervisor.decision_errors")
+        with chaos.FaultPlan().fail("supervisor.decision", times=1):
+            sup.interval_s = 0.01
+            sup.start()
+            assert _wait_until(
+                lambda: _val("supervisor.decision_errors") == errs0 + 1)
+            # the loop survived the failed decision pass and keeps ticking
+            t0 = _val("supervisor.ticks")
+            assert _wait_until(lambda: _val("supervisor.ticks") > t0)
+        sup.stop()
+        fe.shutdown()
+
+    def test_report_shape(self):
+        fe = self._fleet()
+        sup = ReplicaSupervisor(fe, _Factory(), start=False,
+                                min_replicas=1, max_replicas=4)
+        r = sup.report()
+        assert r["running"] is False and r["superseded"] is False
+        assert set(r["domains"]) == {"replica0", "replica1"}
+        assert r["domains"]["replica0"]["generation"] == 0
+        assert r["min_replicas"] == 1 and r["max_replicas"] == 4
+        fe.shutdown()
+
+    def test_sibling_fence_survives_domain_replacement(self):
+        """Fencing is per-INCARNATION: replacing one replica of a
+        multi-replica failure domain must not fence its healthy
+        siblings' telemetry writes."""
+        fe = self._fleet(n=1)
+        a1 = fe.add_replica(FakeEngine(), name="a1", domain="hostA")
+        a2 = fe.add_replica(FakeEngine(), name="a2", domain="hostA")
+        sup = ReplicaSupervisor(fe, _Factory(), clock=_Clock(),
+                                start=False)
+        assert a1.fence is not None and a2.fence is not None
+        fe.kill("a1", reason="chaos")
+        sup.tick()
+        assert "hostA-g1" in fe._by_name   # a1 replaced under the domain
+        # the dead incarnation is fenced...
+        with pytest.raises(StaleGenerationError):
+            a1.fence.check("late write")
+        assert a1.fence_writable() is False
+        # ...its healthy sibling is NOT (same domain, its own incarnation)
+        a2.fence.check("sibling write")
+        assert a2.fence_writable() is True
+        assert a2.state == LIVE
+        fe.shutdown()
+
+    def test_budget_is_windowed_restart_intensity_not_lifetime(self):
+        """Deaths separated by a healthy window are independent incidents:
+        only budget-many attempts WITHIN budget_window_s exhaust the
+        domain (a real crash loop still does)."""
+        fe = self._fleet()
+        clk = _Clock()
+        sup = ReplicaSupervisor(fe, _Factory(), clock=clk, start=False,
+                                restart_budget=2, budget_window_s=100.0,
+                                backoff_base_s=0.1)
+        exhausted0 = _val("supervisor.budget_exhausted")
+        # three deaths, each separated by > the window: every one replaced
+        name = "replica0"
+        for gen in (1, 2, 3):
+            fe.kill(name, reason="independent incident")
+            sup.tick()
+            name = f"replica0-g{gen}"
+            assert name in fe._by_name and fe._by_name[name].state == LIVE
+            clk.t += 150.0
+        assert _val("supervisor.budget_exhausted") == exhausted0
+        # now a genuine crash loop: deaths inside one window exhaust it
+        for gen in (4, 5):
+            fe.kill(name, reason="crash loop")
+            sup.tick()
+            name = f"replica0-g{gen}"
+            clk.t += 1.0
+        fe.kill(name, reason="crash loop")
+        sup.tick()                      # third in-window death: exhausted
+        assert _val("supervisor.budget_exhausted") == exhausted0 + 1
+        assert sup.report()["domains"]["replica0"]["exhausted"]
+        assert fe._by_name[name].state == DEAD   # left dead for a human
+        fe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# breaker integration: trip -> probation -> half-open recovery / fail-hard
+# ---------------------------------------------------------------------------
+class _FlakyEngine(FakeEngine):
+    """FakeEngine whose admissions fail while ``failing`` is set — the
+    error-spewing-replica drill."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.failing = False
+
+    def try_admit_one(self, req):
+        if self.failing:
+            req.error = RuntimeError("corrupted KV pool")
+            req.finished = True
+            req.t_done = time.monotonic()
+            return "failed"
+        return super().try_admit_one(req)
+
+
+class TestBreakerIntegration:
+    def _submit_wave(self, fe, n, head=5, max_new=2, **kw):
+        return [fe.submit(_prompt(head, i % 50), max_new, **kw)
+                for i in range(n)]
+
+    def test_error_storm_trips_then_half_opens_back(self):
+        from paddle_tpu.serving import Router
+
+        flaky = _FlakyEngine()
+        healthy = FakeEngine(max_seqs=4)
+        # pure least-loaded routing: the flaky replica (which never admits,
+        # so never accrues load) deterministically attracts the storm;
+        # probation_failures is high so the drill OBSERVES probation — the
+        # fail-hard path has its own test below
+        fe = ServingFrontend(
+            [flaky, healthy], router=Router(policy="load"),
+            breaker=CircuitBreaker(BreakerPolicy(
+                window=8, error_threshold=0.5, min_samples=4,
+                probe_interval_s=0.01, probe_successes=3,
+                probation_failures=1000)),
+            monitor_interval_s=0.02, heartbeat_deadline_s=30.0)
+        trips0 = _val("breaker.trips")
+        probes0 = _val("breaker.probes")
+        rec0 = _val("breaker.recoveries")
+        flaky.failing = True
+        rep0 = fe.replicas[0]
+        # pour traffic until the error window trips the breaker
+        failed = 0
+        deadline = time.monotonic() + 10
+        while rep0.state != PROBATION and time.monotonic() < deadline:
+            for h in self._submit_wave(fe, 4):
+                try:
+                    h.result(timeout=10)
+                except RequestFailed:
+                    failed += 1
+        assert rep0.state == PROBATION
+        assert _val("breaker.trips") == trips0 + 1
+        assert failed >= 4           # the storm was real
+        assert fe.serving_report()["breaker"]["replica0"]["probing"]
+        # PROBATION: normal traffic avoids it, probes still reach it —
+        # and once it heals, the probes close the circuit
+        flaky.failing = False
+        deadline = time.monotonic() + 10
+        while rep0.state == PROBATION and time.monotonic() < deadline:
+            for h in self._submit_wave(fe, 3):
+                h.result(timeout=10)
+        assert rep0.state == LIVE
+        assert _val("breaker.probes") > probes0
+        assert _val("breaker.recoveries") == rec0 + 1
+        # the healed replica serves normally again
+        p = _prompt(7, 3)
+        np.testing.assert_array_equal(fe.submit(p, 2).result(timeout=10),
+                                      _expected(p, 2))
+        fe.shutdown()
+
+    def test_probe_failures_fail_hard_and_supervisor_replaces(self):
+        from paddle_tpu.serving import Router
+
+        flaky = _FlakyEngine()
+        fe = ServingFrontend(
+            [flaky, FakeEngine(max_seqs=4)], router=Router(policy="load"),
+            breaker=CircuitBreaker(BreakerPolicy(
+                window=8, error_threshold=0.5, min_samples=4,
+                probe_interval_s=0.0, probation_failures=2)),
+            monitor_interval_s=0.02, heartbeat_deadline_s=30.0)
+        sup = ReplicaSupervisor(fe, _Factory(), start=False)
+        hard0 = _val("breaker.failed_hard")
+        flaky.failing = True          # and it never heals
+        rep0 = fe.replicas[0]
+        deadline = time.monotonic() + 10
+        while rep0.state != DEAD and time.monotonic() < deadline:
+            for h in self._submit_wave(fe, 4):
+                try:
+                    h.result(timeout=10)
+                except RequestFailed:
+                    pass
+        assert rep0.state == DEAD
+        assert "circuit breaker" in rep0.death_reason
+        assert _val("breaker.failed_hard") == hard0 + 1
+        sup.tick()                    # and the supervisor replaces it
+        assert "replica0-g1" in fe._by_name
+        fe.shutdown()
+
+    def test_slow_replica_trips_via_pace_verdict(self):
+        """The latency side of the breaker: a replica dispatching 5x
+        slower than the fleet median (chaos serving.replica_slow) collects
+        slow strikes from the monitor until it trips."""
+        engines = [FakeEngine(max_seqs=2) for _ in range(2)]
+        # the slow replica backs the queue up — pin a never-engaging
+        # ladder so the pressure spike can't shed the probe traffic this
+        # test needs to keep flowing
+        fe = ServingFrontend(
+            engines, start=False,
+            breaker=CircuitBreaker(BreakerPolicy(slow_ratio=4.0,
+                                                 slow_strikes=3)),
+            brownout=BrownoutLadder(
+                steps=(BrownoutStep(REJECT, 9.0, 8.0),)),
+            monitor_interval_s=0.02, heartbeat_deadline_s=30.0)
+        trips0 = _val("breaker.trips")
+        # chaos delay on replica0's step dispatch only: rule fires on the
+        # FIRST site hits, which are interleaved across replicas — use a
+        # per-site predicate via the step_delay knob instead for
+        # determinism
+        engines[0].step_delay = 0.05
+        fe.start()
+        done = []
+        deadline = time.monotonic() + 15
+        while (fe.replicas[0].state != PROBATION
+               and time.monotonic() < deadline):
+            hs = [fe.submit(_prompt(h, i), 3)
+                  for i, h in enumerate((11, 12, 13, 14))]
+            for h in hs:
+                try:
+                    h.result(timeout=10)
+                    done.append(h)
+                except RequestFailed:
+                    pass
+        assert fe.replicas[0].state == PROBATION
+        assert _val("breaker.trips") == trips0 + 1
+        assert "latency" in (fe.breaker.tripped_reason("replica0") or
+                             fe.serving_report()["breaker"]
+                             .get("replica0", {}).get("reason") or "")
+        fe.shutdown()
+
+    def test_replica_slow_chaos_seam_exists(self):
+        """The serving.replica_slow seam is armable: a delay rule stalls
+        a busy replica's dispatch (the deterministic straggler drill)."""
+        eng = FakeEngine()
+        fe = ServingFrontend([eng], monitor_interval_s=5.0,
+                             heartbeat_deadline_s=30.0)
+        with chaos.FaultPlan().delay("serving.replica_slow", 0.05, times=2):
+            p = _prompt(1, 9)
+            t0 = time.monotonic()
+            np.testing.assert_array_equal(
+                fe.submit(p, 3).result(timeout=10), _expected(p, 3))
+            assert time.monotonic() - t0 >= 0.05   # the stall happened
+        assert fe.replicas[0].step_ewma > 0        # and was measured
+        fe.shutdown()
+
+    def test_failed_probe_reroutes_caller_transparently(self):
+        """The breaker contract: a probe that fails on a PROBATION replica
+        is observed by the breaker but NOT eaten by the caller — the
+        unconsumed request re-runs bit-identically on a healthy replica."""
+        from paddle_tpu.serving import Router
+
+        flaky = _FlakyEngine()
+        fe = ServingFrontend(
+            [flaky, FakeEngine(max_seqs=4)], router=Router(policy="load"),
+            breaker=CircuitBreaker(BreakerPolicy(
+                window=8, error_threshold=0.5, min_samples=4,
+                probe_interval_s=0.0, probation_failures=10_000)),
+            monitor_interval_s=0.02, heartbeat_deadline_s=30.0)
+        flaky.failing = True
+        rep0 = fe.replicas[0]
+        deadline = time.monotonic() + 10
+        while rep0.state != PROBATION and time.monotonic() < deadline:
+            for h in self._submit_wave(fe, 4):
+                try:
+                    h.result(timeout=10)
+                except RequestFailed:
+                    pass
+        assert rep0.state == PROBATION
+        bad0 = fe.serving_report()["breaker"]["replica0"]["probe_bad"]
+        # still failing: every probe routed there errors — yet EVERY caller
+        # gets its (bit-exact) result off the healthy replica
+        for i in range(8):
+            p = _prompt(9, i)
+            np.testing.assert_array_equal(
+                fe.submit(p, 2).result(timeout=10), _expected(p, 2))
+        assert rep0.state == PROBATION   # still under suspicion
+        # and the breaker DID observe the probe failures (probe_interval 0:
+        # at least the first submit of the batch probed the flaky replica)
+        assert fe.serving_report()["breaker"]["replica0"]["probe_bad"] > bad0
+        fe.shutdown()
+
+    def test_revive_from_probation_resets_breaker_score(self):
+        """Operator revive() of a PROBATION replica must clear the
+        breaker's half-open state — a stuck 'probing' score would make the
+        revived replica untrippable forever."""
+        fe = ServingFrontend([FakeEngine(), FakeEngine(max_seqs=4)],
+                             monitor_interval_s=5.0,
+                             heartbeat_deadline_s=30.0)
+        rep0 = fe.replicas[0]
+        for _ in range(fe.breaker.policy.slow_strikes):
+            verdict = fe.breaker.note_slow("replica0")
+        assert verdict == "trip"
+        fe._trip_replica(rep0)
+        assert rep0.state == PROBATION
+        assert fe.serving_report()["breaker"]["replica0"]["probing"]
+        fe.revive("replica0")
+        assert rep0.state == LIVE
+        # fresh slate: no lingering half-open score...
+        assert "replica0" not in fe.serving_report()["breaker"]
+        # ...and the replica is trippable AGAIN (record() no-ops while a
+        # stale probing flag is set — the pre-fix failure mode)
+        p = fe.breaker.policy
+        verdict = None
+        for _ in range(max(p.min_samples, 4)):
+            verdict = fe.breaker.record("replica0", ok=False)
+        assert verdict == "trip"
+        fe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# E2E drill 1: overload storm -> ladder order, interactive SLO, retry storm
+# ---------------------------------------------------------------------------
+class TestOverloadBrownoutE2E:
+    def _overloaded_fleet(self, max_seqs=2):
+        # one replica, paused admissions: queue pressure is exact and
+        # controllable (pending / max_seqs, the PR-11 rollup formula)
+        eng = FakeEngine(max_seqs=max_seqs)
+        eng.admit_paused = True
+        ladder = BrownoutLadder(dwell_s=0.05, batch_token_cap=4,
+                                retry_after_base_s=0.25,
+                                retry_budget=RetryBudget(ratio=0.1,
+                                                         burst=3.0))
+        fe = ServingFrontend(
+            [eng], brownout=ladder,
+            scheduler=SLOScheduler(max_queue_depth=1000),
+            monitor_interval_s=0.01, heartbeat_deadline_s=30.0)
+        return fe, eng, ladder
+
+    def test_ladder_engages_in_order_batch_sheds_before_interactive(self):
+        fe, eng, ladder = self._overloaded_fleet()
+        # flood: pending >> slots pushes queue pressure to 1.0
+        handles = [fe.submit(_prompt(3, i % 40), 8, slo_class="batch")
+                   for i in range(8)]
+        assert _wait_until(lambda: ladder.level == len(ladder.steps), 10)
+        engaged = [name for _, kind, name in ladder.history
+                   if kind == "engage"]
+        assert engaged[:4] == [s.name for s in DEFAULT_STEPS]  # declared order
+        # full reject: even interactive sheds, machine-readably
+        with pytest.raises(Overloaded) as ei:
+            fe.submit(_prompt(5, 1), 2, slo_class="interactive")
+        assert ei.value.step == REJECT and ei.value.retry_after_s > 0
+        # drain the flood -> pressure 0 -> rungs release one at a time
+        # (shed_batch releases before reject... reverse order) until
+        # batch is served again
+        for h in handles:
+            h.cancel()
+        eng.admit_paused = False
+        assert _wait_until(lambda: ladder.level == 0, 15)
+        released = [name for _, kind, name in ladder.history
+                    if kind == "release"]
+        assert released[-4:] == [s.name for s in reversed(DEFAULT_STEPS)]
+        p = _prompt(6, 2)
+        np.testing.assert_array_equal(
+            fe.submit(p, 2, slo_class="batch").result(timeout=10),
+            _expected(p, 2))
+        fe.shutdown()
+
+    def test_shed_batch_keeps_interactive_served_and_clamps_tokens(self):
+        # 25 slots: a 25-deep flood saturates (pressure 1.0, all rungs
+        # engage), and cancelling down to 21 pending parks pressure at
+        # 0.84 — INSIDE the level-3 hysteresis band (<= the reject rung's
+        # release_at 0.86, > shed_batch's 0.78) so the ladder releases
+        # exactly one rung and then holds at shed_batch deterministically
+        fe, eng, ladder = self._overloaded_fleet(max_seqs=25)
+        handles = [fe.submit(_prompt(3, i % 40), 8, slo_class="batch")
+                   for i in range(25)]
+        assert _wait_until(lambda: ladder.level == 4, 10)
+        for h in handles[:4]:
+            h.cancel()
+        assert _wait_until(lambda: ladder.level == 3, 10)
+        clamp0 = _val("brownout.tokens_clamped")
+        with pytest.raises(Overloaded) as ei:
+            fe.submit(_prompt(5, 1), 2, slo_class="batch")
+        assert ei.value.step == SHED_BATCH
+        assert ei.value.slo_class == "batch"
+        # interactive still admitted while batch sheds — and NEVER clamps
+        h = fe.submit(_prompt(5, 2), 50, slo_class="interactive")
+        assert h is not None
+        assert h._req.max_new_tokens == 50
+        assert _val("brownout.tokens_clamped") == clamp0
+        assert ladder.level == 3   # held inside the hysteresis band
+        fe.shutdown()
+
+    def test_retry_budget_prevents_retry_storm(self):
+        """Acceptance: the per-class retry budget provably caps a client
+        herd's re-submissions — of a 30-retry storm against a browning
+        fleet, at most burst + ratio*accepted get through."""
+        fe, eng, ladder = self._overloaded_fleet()
+        denied0 = _val("brownout.retry_denied",
+                       labels={"slo_class": "interactive"})
+        admitted = 0
+        for i in range(30):
+            try:
+                fe.submit(_prompt(4, i % 40), 2, slo_class="interactive",
+                          is_retry=True)
+                admitted += 1
+            except Overloaded as e:
+                assert e.step == "retry_budget"
+                assert e.retry_after_s > 0
+        assert admitted <= 3         # the burst, nothing more
+        assert _val("brownout.retry_denied",
+                    labels={"slo_class": "interactive"}) \
+            == denied0 + (30 - admitted)
+        # accepted (non-retry) goodput refills the budget at ratio
+        for i in range(20):
+            fe.submit(_prompt(7, i % 40), 2, slo_class="interactive")
+        fe.submit(_prompt(4, 1), 2, slo_class="interactive", is_retry=True)
+        fe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# E2E drill 2: replica kill under mixed-SLO load -> replaced, nothing lost
+# ---------------------------------------------------------------------------
+class TestKillUnderLoadE2E:
+    def test_kill_midload_supervisor_replaces_no_lost_handles(self):
+        slo = SLOMonitor(classes=(INTERACTIVE, BATCH),
+                         fast_window_s=1.0, slow_window_s=3.0)
+        fe = ServingFrontend(
+            [FakeEngine(max_seqs=4), FakeEngine(max_seqs=4)],
+            slo_monitor=slo,
+            monitor_interval_s=0.02, heartbeat_deadline_s=5.0)
+        sup = ReplicaSupervisor(fe, _Factory(max_seqs=4), start=True,
+                                interval_s=0.02, restart_budget=3,
+                                backoff_base_s=0.05)
+        respawns0 = _val("supervisor.respawns")
+        results, errors = [], []
+        lock = threading.Lock()
+        stop_load = threading.Event()
+
+        def client(tid):
+            i = 0
+            while not stop_load.is_set():
+                i += 1
+                slo_class = "interactive" if i % 2 else "batch"
+                p = _prompt(3 + tid, i % 40)
+                try:
+                    h = fe.submit(p, 3, slo_class=slo_class)
+                    out = h.result(timeout=30)
+                    with lock:
+                        results.append((p, out))
+                except Overloaded:
+                    pass
+                except RequestFailed as e:
+                    with lock:
+                        errors.append(str(e))
+
+        threads = [threading.Thread(target=client, args=(t,), daemon=True)
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            assert _wait_until(lambda: len(results) >= 20, 20)
+            fe.kill("replica0", reason="chaos: host loss")   # mid-load
+            # the supervisor replaces it within the budget...
+            assert _wait_until(
+                lambda: _val("supervisor.respawns") == respawns0 + 1
+                and "replica0-g1" in fe._by_name
+                and fe._by_name["replica0-g1"].state == LIVE, 20)
+            before = len(results)
+            assert _wait_until(lambda: len(results) >= before + 20, 20)
+        finally:
+            stop_load.set()
+            for t in threads:
+                t.join(timeout=20)
+        # zero lost handles: every submit either completed bit-exactly,
+        # shed explicitly, or failed explicitly — nothing hung (the 30s
+        # result timeout above would have surfaced as a test failure)
+        assert not any(t.is_alive() for t in threads)
+        for p, out in results:
+            np.testing.assert_array_equal(out, _expected(p, 3))
+        # consumed-stream failures are the only legitimate errors for a
+        # mid-flight kill and these clients never stream: reroutes are
+        # transparent, so failures should be zero
+        assert errors == []
+        # burn-rate recovers: with the 1s/3s windows the kill's bad
+        # samples age out and the multi-window alert clears
+        assert _wait_until(
+            lambda: not fe.slo.alerts()
+            and fe.fleet_signal()["slo"]["alerting"] == [], 15)
+        # the supervisor's own view agrees
+        rep = fe.serving_report()
+        assert rep["supervisor"]["domains"]["replica0"]["generation"] == 1
+        fe.shutdown()
+
+    def test_chaos_replica_kill_under_supervisor(self):
+        """The same drill driven through the chaos seam instead of the
+        ops kill() — PR-1 FaultPlan integration."""
+        fe = ServingFrontend([FakeEngine(), FakeEngine()],
+                             monitor_interval_s=0.02,
+                             heartbeat_deadline_s=5.0, start=False)
+        sup = ReplicaSupervisor(fe, _Factory(), start=True,
+                                interval_s=0.02, backoff_base_s=0.05)
+        with chaos.FaultPlan().fail("serving.replica_kill", times=1):
+            fe.start()
+            assert _wait_until(
+                lambda: any(r.name.endswith("-g1") and r.state == LIVE
+                            for r in fe.replicas), 20)
+        p = _prompt(8, 8)
+        np.testing.assert_array_equal(fe.submit(p, 3).result(timeout=10),
+                                      _expected(p, 3))
+        fe.shutdown()
