@@ -138,6 +138,13 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
     if not on_tpu:  # CPU smoke profile
         hidden, layers, heads, inter, vocab, seq, batch, steps = 256, 2, 4, 512, 1024, 256, 2, 3
 
+    # training-dynamics telemetry rides every bench rung (ISSUE 13
+    # satellite): in-program, near-free, and the spill cadence (default 32)
+    # sits above the timed loop — extra.dynamics records grad norm /
+    # loss-z / non-finite evidence next to the perf number. Each rung is
+    # its own child process, so the env write is rung-scoped.
+    os.environ.setdefault("PADDLE_DYNAMICS", "1")
+
     paddle.seed(0)
     cfg = LlamaConfig(
         vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
@@ -223,6 +230,21 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
             f"compile(s) fired during the warm timed loop "
             f"(ledger: {_compilemem.ledger.report(recent=4)['recent']})")
 
+    # one forced spill AFTER the timed loop: the summary reflects the run
+    # without a mid-loop device sync perturbing the measurement
+    dyn_block = {"enabled": False}
+    if step._dynamics is not None:
+        s = step._dynamics.spill(step._dyn_state,
+                                 step=step.optimizer._global_step) or {}
+        dyn_block = {
+            "enabled": True,
+            "groups": len(step._dynamics.group_names),
+            "grad_norm": s.get("grad_norm"),
+            "loss_z": round(s.get("loss_z", 0.0), 4),
+            "nonfinite_steps": s.get("nonfinite_steps"),
+            "nonfinite_first": s.get("nonfinite_first"),
+        }
+
     from paddle_tpu.ops import flash_attention as fa
 
     tokens_per_sec = batch * seq / dt
@@ -254,6 +276,9 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
                 "churn_alerts": comp_end["churn_alerts"],
                 "warm_recompiles": warm_recompiles,
             },
+            # training-dynamics block (ISSUE 13 satellite): numerics
+            # evidence lands next to the perf number on every rung
+            "dynamics": dyn_block,
             **({} if scan_steps else
                {"bus": {k: round(v, 4) for k, v in bus.summary().items()}}),
         },
@@ -567,6 +592,91 @@ def _probe_backend():
 
 
 RUNGS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_rungs.jsonl")
+TRAJECTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_trajectory.jsonl")
+
+
+def _last_banked_headline():
+    """The newest BENCH_r<N>.json driver artifact (None when none exist) —
+    the perf-trajectory baseline this run's headline is compared against."""
+    import glob
+    import re
+
+    cands = []
+    here = os.path.dirname(os.path.abspath(__file__))
+    for p in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.match(r"BENCH_r(\d+)\.json$", os.path.basename(p))
+        if m:
+            cands.append((int(m.group(1)), p))
+    if not cands:
+        return None, None
+    _, path = max(cands)
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return None, None
+    # the driver artifact wraps the contract line under "parsed"
+    if isinstance(rec.get("parsed"), dict) and "metric" in rec["parsed"]:
+        rec = rec["parsed"]
+    if not isinstance(rec, dict) or "metric" not in rec:
+        return None, None
+    return os.path.basename(path), rec
+
+
+def _trajectory_guard(res):
+    """Perf-trajectory guard (ISSUE 13 satellite): compare this run's
+    headline tokens/s against the last banked BENCH_r*.json and flag >10%
+    regressions IN THE CONTRACT LINE (extra.trajectory + a note), then
+    append the datapoint to BENCH_trajectory.jsonl so the trajectory is a
+    recorded series, not an empty promise. Same-backend, same-metric
+    comparisons only — a CPU smoke run must never read as a regression
+    against a banked TPU number. Never raises: the contract line lands
+    regardless."""
+    try:
+        name, prev = _last_banked_headline()
+        traj = None
+        if (prev is not None and prev.get("value")
+                and prev.get("metric") == res.get("metric")
+                and (prev.get("extra") or {}).get("backend")
+                == (res.get("extra") or {}).get("backend")
+                and res.get("value")):
+            delta = res["value"] / prev["value"] - 1.0
+            # rung CONFIGS must match for the delta to mean anything: a
+            # smaller-config run is legitimately slower, not a
+            # regression — record the mismatch, never flag it
+            same_config = ((prev.get("extra") or {}).get("config")
+                           == (res.get("extra") or {}).get("config"))
+            traj = {
+                "baseline_file": name,
+                "baseline_value": prev["value"],
+                "baseline_config": (prev.get("extra") or {}).get("config"),
+                "delta": round(delta, 4),
+                "comparable": same_config,
+                "regression": same_config and delta < -0.10,
+            }
+            res.setdefault("extra", {})["trajectory"] = traj
+            if traj["regression"]:
+                note = (f"PERF REGRESSION: headline {res['value']} is "
+                        f"{-delta:.1%} below banked {name} "
+                        f"({prev['value']})")
+                prior = res["extra"].get("note")
+                res["extra"]["note"] = ((prior + "; " + note) if prior
+                                        else note)[:600]
+        rec = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "metric": res.get("metric"),
+            "value": res.get("value"),
+            "mfu": (res.get("extra") or {}).get("mfu"),
+            "config": (res.get("extra") or {}).get("config"),
+            "backend": (res.get("extra") or {}).get("backend"),
+            "baseline": traj,
+        }
+        with open(TRAJECTORY_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except Exception as e:  # noqa: BLE001 — the contract line must land
+        res.setdefault("extra", {})["trajectory"] = {
+            "error": f"{type(e).__name__}: {str(e)[:120]}"}
 
 # Smallest-compile-first harvest order (VERDICT r4 item 1a). The kernel rungs
 # that differentiate the framework (splash GQA, KV-cache decode, int8 decode)
@@ -812,6 +922,9 @@ def main():
     except Exception as e:  # noqa: BLE001 — the bench line must still land
         res.setdefault("extra", {})["fleet"] = {
             "error": f"{type(e).__name__}: {str(e)[:160]}"}
+    # perf-trajectory guard (ISSUE 13 satellite): flag >10% headline
+    # regressions vs the last banked BENCH_r*.json and record the series
+    _trajectory_guard(res)
     print(json.dumps(res), flush=True)
 
 

@@ -7,6 +7,7 @@ from . import (  # noqa: F401  (imported for registration side effects)
     hostsync,
     ledger,
     locks,
+    profiler_capture,
     registries,
     timing,
 )

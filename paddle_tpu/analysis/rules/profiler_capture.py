@@ -1,0 +1,48 @@
+"""``profiler-capture`` — every xprof capture goes through the flight
+recorder's capture registry (ISSUE 13).
+
+``observability/flightrec.py`` owns the process's ONE on-demand
+``jax.profiler`` capture: arming, step counting, the bounded completed-
+capture ledger, and the /profilez surface. A raw
+``jax.profiler.start_trace`` / ``stop_trace`` anywhere else in the package
+is an unledgered, unbounded profile artifact — invisible to /profilez,
+able to collide with an armed flight capture, and impossible to correlate
+with the anomaly that motivated it. ``profiler.start_xprof_trace`` /
+``stop_xprof_trace`` delegate to the registry and stay the public API.
+
+Deliberate exceptions carry ``# lint: profiler-capture-ok``.
+"""
+import ast
+
+from ..engine import Finding, rule
+from ..index import dotted
+
+#: the capture registry itself — the one blessed raw-call site
+ALLOWED = "paddle_tpu/observability/flightrec.py"
+
+_CAPTURE_ATTRS = ("start_trace", "stop_trace")
+
+
+@rule("profiler-capture",
+      markers=("profiler-capture-ok",),
+      description="raw jax.profiler.start_trace/stop_trace only inside "
+                  "observability/flightrec.py's capture registry")
+def profiler_capture(index):
+    findings = []
+    for fi in index.iter_files("paddle_tpu/"):
+        if fi.path == ALLOWED:
+            continue
+        for node in ast.walk(fi.tree):
+            if (not isinstance(node, ast.Attribute)
+                    or node.attr not in _CAPTURE_ATTRS):
+                continue
+            base = dotted(node.value)
+            if not base or not base.endswith("profiler"):
+                continue
+            findings.append(Finding(
+                fi.path, node.lineno, "profiler-capture",
+                f"raw {base}.{node.attr} bypasses the flight recorder's "
+                f"capture registry — use observability.flightrec."
+                f"arm_capture/start_capture (or justify with "
+                f" # lint: profiler-capture-ok)"))
+    return findings

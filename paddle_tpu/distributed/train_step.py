@@ -23,6 +23,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..jit_api import TrainStep
 from ..observability import compilemem as _compilemem
+from ..observability import flightrec as _flightrec
 from ..observability import goodput as _goodput
 from ..observability import tracing as _tracing
 from ..observability import watchdog as _watchdog
@@ -167,6 +168,14 @@ class DistributedTrainStep(TrainStep):
             return None
         return {k: self._ns(P()) for k in self._nf_state}
 
+    def _dyn_sharding(self):
+        """Replicated shardings for the dynamics stats carry (a handful of
+        scalars + f32[G] vectors — ISSUE 13), mirroring self._dyn_state's
+        pytree; None when dynamics is disabled."""
+        if self._dyn_state is None:
+            return None
+        return {k: self._ns(P()) for k in self._dyn_state}
+
     def _compile(self, step_fn):
         # deferred: in_shardings depend on batch shapes; compile lazily,
         # keyed by batch shape/dtype signature
@@ -291,11 +300,12 @@ class DistributedTrainStep(TrainStep):
                 shardings = self._sharding_trees(batch_datas)
                 params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, batch_sh = shardings
                 nf_sh = self._nf_sharding()
+                dyn_sh = self._dyn_sharding()
                 jitted = _compilemem.ledgered_jit(
                     self._step_fn, key="train.step",
-                    in_shardings=(params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, nf_sh, self._ns(P()), self._ns(P()), batch_sh),
-                    out_shardings=(self._ns(P()), params_sh, buffers_sh, opt_sh, scaler_sh, nf_sh),
-                    donate_argnums=(0, 1, 3, 4, 5),
+                    in_shardings=(params_sh, buffers_sh, frozen_sh, opt_sh, scaler_sh, nf_sh, dyn_sh, self._ns(P()), self._ns(P()), batch_sh),
+                    out_shardings=(self._ns(P()), params_sh, buffers_sh, opt_sh, scaler_sh, nf_sh, dyn_sh),
+                    donate_argnums=(0, 1, 3, 4, 5, 6),
                 )
                 self._jitted[sig] = jitted
                 _compilemem.ledger.note_cache_size(
@@ -315,10 +325,11 @@ class DistributedTrainStep(TrainStep):
                 try:
                     chaos.site("obs.oom")
                     (loss, new_params, new_buffers, self.opt_state,
-                     self._scaler_state, self._nf_state) = jitted(
+                     self._scaler_state, self._nf_state,
+                     self._dyn_state) = jitted(
                         params, buffers, frozen, self.opt_state,
-                        self._scaler_state, self._nf_state, lr,
-                        prandom.next_key(), batch_datas
+                        self._scaler_state, self._nf_state, self._dyn_state,
+                        lr, prandom.next_key(), batch_datas
                     )
                 except Exception as e:
                     _compilemem.maybe_oom_report(e, program="train.step")
@@ -340,6 +351,8 @@ class DistributedTrainStep(TrainStep):
             self._maybe_snapshot(self.optimizer._global_step)
         _watchdog.maybe_beat(self.optimizer._global_step)
         self._nf_check()
+        self._dyn_check()
+        _flightrec.maybe_capture_step(self.optimizer._global_step)
         if self.metrics_bus is not None:
             if self.metrics_bus.tokens_per_step is None and batch_datas:
                 import math
@@ -374,15 +387,16 @@ class DistributedTrainStep(TrainStep):
                 batch_sh = tuple(
                     self._ns(P(None, *tuple(self._batch_spec(b)))) for b in inner)
             nf_sh = self._nf_sharding()
+            dyn_sh = self._dyn_sharding()
             jitted = _compilemem.ledgered_jit(
                 self._multi_fn(n, stacked),
                 key=f"train.multi[n={n},stacked={stacked}]",
                 in_shardings=(params_sh, buffers_sh, frozen_sh, opt_sh,
-                              scaler_sh, nf_sh, self._ns(P()), self._ns(P()),
-                              batch_sh),
+                              scaler_sh, nf_sh, dyn_sh, self._ns(P()),
+                              self._ns(P()), batch_sh),
                 out_shardings=(self._ns(P()), params_sh, buffers_sh, opt_sh,
-                               scaler_sh, nf_sh),
-                donate_argnums=(0, 1, 3, 4, 5),
+                               scaler_sh, nf_sh, dyn_sh),
+                donate_argnums=(0, 1, 3, 4, 5, 6),
             )
             self._jitted[sig] = jitted
             _compilemem.ledger.note_cache_size(
@@ -399,10 +413,11 @@ class DistributedTrainStep(TrainStep):
                 try:
                     chaos.site("obs.oom")
                     (losses, new_params, new_buffers, self.opt_state,
-                     self._scaler_state, self._nf_state) = jitted(
+                     self._scaler_state, self._nf_state,
+                     self._dyn_state) = jitted(
                         params, buffers, frozen, self.opt_state,
-                        self._scaler_state, self._nf_state, lr,
-                        prandom.next_key(), batch_datas
+                        self._scaler_state, self._nf_state, self._dyn_state,
+                        lr, prandom.next_key(), batch_datas
                     )
                 except Exception as e:
                     _compilemem.maybe_oom_report(e, program="train.multi")

@@ -14,6 +14,8 @@ import jax.numpy as jnp
 from .framework import random as prandom
 from .framework.core import Tensor, _bump_mutation_version, to_tensor
 from .observability import compilemem as _compilemem
+from .observability import dynamics as _dynamics
+from .observability import flightrec as _flightrec
 from .observability import goodput as _goodput
 from .observability import tracing as _tracing
 from .observability import watchdog as _watchdog
@@ -218,6 +220,17 @@ class TrainStep:
                                                max(self._nf_tolerance, 16)))
         self._nf_reported = 0     # skips already counted to the registry
         self._nf_since_check = 0  # dispatches since the last host read
+        # training-dynamics telemetry (ISSUE 13): a second donated carry —
+        # per-layer-group grad/param/update norms, loss EWMA + spike
+        # z-score, and the non-finite PROVENANCE mask (which group went
+        # NaN/Inf first) — updated in-program every step and spilled to
+        # the host once per PADDLE_DYNAMICS_EVERY_STEPS window. Disabled
+        # (the default), _dynamics is None: the compiled program carries
+        # nothing and the epilogue pays one is-None check.
+        self._dynamics = _dynamics.DynamicsMonitor.from_env(self._trainable)
+        self._dyn_state = (self._dynamics.init_state()
+                           if self._dynamics is not None else None)
+        self._dyn_since_check = 0
         # first dispatch pays XLA compile: goodput attributes it to "init"
         self._dispatched = False
         # register with the hang watchdog BEFORE the first step: a rank that
@@ -228,6 +241,7 @@ class TrainStep:
         opt = optimizer
         n_lab = n_labels
         acc = self.accumulate_steps
+        dyn = self._dynamics
 
         def fwd_bwd(params, buffers, frozen, key, batch, scale):
             """One forward+tape-backward; returns (loss, grads, new_buffers).
@@ -259,7 +273,7 @@ class TrainStep:
             return loss._data, grads, new_buffers
 
         def step_fn(params, buffers, frozen, opt_state, scaler_state,
-                    nf_state, lr, key, batch):
+                    nf_state, dyn_state, lr, key, batch):
             scale = scaler_state["scale"] if scaler is not None else None
             if acc == 1:
                 loss_data, grads, new_buffers = fwd_bwd(params, buffers, frozen, key, batch, scale)
@@ -333,6 +347,10 @@ class TrainStep:
                     "total": nf_state["total"] + nf_skip.astype(jnp.int32),
                 }
 
+            # dynamics reads the UNSCALED pre-clip gradients (what the
+            # model actually produced); the update side brackets the
+            # optimizer below, so ||delta_w|| reflects clip/decay/skip
+            raw_grads = grads
             with jax.named_scope("optimizer"):
                 if opt._grad_clip is not None:
                     pg = [(Tensor(params[k]), Tensor(g)) for k, g in grads.items()]
@@ -340,8 +358,13 @@ class TrainStep:
                     grads = {k: t._data for (k, _), (_, t) in zip(grads.items(), pg)}
 
                 new_params, new_opt_state = opt.apply_gradients(params, grads, opt_state, lr, skip_update=skip)
+            new_dyn_state = dyn_state
+            if dyn_state is not None:
+                with jax.named_scope("dynamics"):
+                    new_dyn_state = dyn.update(dyn_state, loss_data,
+                                               raw_grads, params, new_params)
             return (loss_data, new_params, new_buffers, new_opt_state,
-                    new_scaler_state, new_nf_state)
+                    new_scaler_state, new_nf_state, new_dyn_state)
 
         self._step_fn = step_fn
         self._compiled = self._compile(step_fn)
@@ -368,7 +391,7 @@ class TrainStep:
         # ONE logical program: recompiles mean the input signature
         # drifted, which is exactly what the churn detector watches
         return _compilemem.ledgered_jit(
-            step_fn, key="train.step", donate_argnums=(0, 1, 3, 4, 5))
+            step_fn, key="train.step", donate_argnums=(0, 1, 3, 4, 5, 6))
 
     def _multi_fn(self, n, stacked):
         """Pure n-steps-in-one-program function (lax.scan over the step
@@ -381,20 +404,21 @@ class TrainStep:
         step_fn = self._step_fn
 
         def multi_fn(params, buffers, frozen, opt_state, scaler_state,
-                     nf_state, lr, key, batch):
+                     nf_state, dyn_state, lr, key, batch):
             def body(carry, x):
-                p, b, o, s, nf = carry
+                p, b, o, s, nf, dy = carry
                 k, step_batch = (x, batch) if not stacked else x
-                loss, p2, b2, o2, s2, nf2 = step_fn(
-                    p, b, frozen, o, s, nf, lr, k, step_batch)
-                return (p2, b2, o2, s2, nf2), loss
+                loss, p2, b2, o2, s2, nf2, dy2 = step_fn(
+                    p, b, frozen, o, s, nf, dy, lr, k, step_batch)
+                return (p2, b2, o2, s2, nf2, dy2), loss
 
             keys = jax.random.split(key, n)
             xs = (keys, batch) if stacked else keys
-            (p, b, o, s, nf), losses = jax.lax.scan(
-                body, (params, buffers, opt_state, scaler_state, nf_state), xs
+            (p, b, o, s, nf, dy), losses = jax.lax.scan(
+                body, (params, buffers, opt_state, scaler_state, nf_state,
+                       dyn_state), xs
             )
-            return losses, p, b, o, s, nf
+            return losses, p, b, o, s, nf, dy
 
         return multi_fn
 
@@ -404,7 +428,7 @@ class TrainStep:
         return _compilemem.ledgered_jit(
             self._multi_fn(n, stacked),
             key=f"train.multi[n={n},stacked={stacked}]",
-            donate_argnums=(0, 1, 3, 4, 5))
+            donate_argnums=(0, 1, 3, 4, 5, 6))
 
     def run_steps(self, *batch, n, stacked=False):
         """Run n optimizer steps in a single device dispatch. With
@@ -429,10 +453,11 @@ class TrainStep:
         try:
             chaos.site("obs.oom")
             (losses, new_params, new_buffers, self.opt_state,
-             self._scaler_state, self._nf_state) = (
+             self._scaler_state, self._nf_state, self._dyn_state) = (
                 self._compiled_multi[key](
                     params, buffers, frozen, self.opt_state, self._scaler_state,
-                    self._nf_state, lr, prandom.next_key(), batch_data,
+                    self._nf_state, self._dyn_state, lr, prandom.next_key(),
+                    batch_data,
                 )
             )
         except Exception as e:
@@ -459,6 +484,14 @@ class TrainStep:
         _watchdog.maybe_beat(self.optimizer._global_step)
         # one dispatch covered n steps — always worth the one host read
         self._nf_check(force=True)
+        # dynamics stays CADENCE-gated (counting the n covered steps):
+        # forcing a spill here would put a device sync inside every
+        # multi-step dispatch — exactly what bench.py's timed scan rungs
+        # must not pay (they force their own spill after timing)
+        self._dyn_check(n=n)
+        # one dispatch covered n TRAIN steps: the capture contract counts
+        # steps, so the tick burns n, not 1
+        _flightrec.maybe_capture_step(self.optimizer._global_step, n=n)
         return Tensor(losses)
 
     def _nf_check(self, force=False):
@@ -477,23 +510,74 @@ class TrainStep:
         if not force and self._nf_since_check < self._nf_check_every:
             return
         self._nf_since_check = 0
-        total = int(self._nf_state["total"])
-        consec = int(self._nf_state["consec"])
+        # the counter read synchronizes on the step: explicit goodput
+        # phase, never silently folded into step time (ISSUE 13 satellite)
+        with _goodput.account("telemetry"):
+            total = int(self._nf_state["total"])
+            consec = int(self._nf_state["consec"])
         if total > self._nf_reported:
             _registry.counter("train.nonfinite_skips").inc(
                 total - self._nf_reported)
             self._nf_reported = total
+            # non-finite provenance (ISSUE 13): the dynamics carry knows
+            # WHICH layer group went NaN/Inf first — attach it to the
+            # flight-record bundle (rate-limited: a skip storm commits one
+            # bundle per window, not one per read)
+            prov = self._nf_provenance()
+            _flightrec.record(
+                "nonfinite", step=self.optimizer._global_step,
+                payload={"skips_total": total, "consecutive": consec,
+                         "tolerance": self._nf_tolerance,
+                         "provenance": prov})
         if consec >= self._nf_tolerance:
             from .utils.metrics_bus import counters as _counters
 
             _counters.bump("fault.train.nonfinite_exhausted")
+            prov = self._nf_provenance()
+            prov_msg = ""
+            if prov:
+                prov_msg = (
+                    f"; first non-finite gradients in layer group(s) "
+                    f"{', '.join(prov['first_groups']) or '<loss only>'} "
+                    f"at update {prov['first_update']} "
+                    f"(currently non-finite: "
+                    f"{', '.join(prov['current_groups']) or '<loss only>'})")
             raise NonFiniteLossError(
                 f"loss/grads non-finite for {consec} consecutive steps "
                 f"(tolerance {self._nf_tolerance}, "
                 f"{total} skipped updates total, global step "
-                f"{self.optimizer._global_step}) — every skipped update "
-                f"left the weights uncorrupted; lower the LR / check the "
-                f"data, or raise {NONFINITE_TOLERANCE_ENV}")
+                f"{self.optimizer._global_step}){prov_msg} — every skipped "
+                f"update left the weights uncorrupted; lower the LR / "
+                f"check the data, or raise {NONFINITE_TOLERANCE_ENV}")
+
+    def _nf_provenance(self):
+        """The dynamics carry's latched which-group-went-non-finite-first
+        record (None when dynamics is off or everything stayed finite)."""
+        if self._dynamics is None:
+            return None
+        with _goodput.account("telemetry"):
+            return self._dynamics.provenance(self._dyn_state)
+
+    def _dyn_check(self, force=False, n=1):
+        """Host side of the dynamics telemetry: once per
+        ``PADDLE_DYNAMICS_EVERY_STEPS`` covered steps (the read
+        synchronizes on the step, so it is cadence-gated like the nf
+        counters; a run_steps dispatch counts its n steps), spill the
+        carry — publish the train.* gauges, extend the flight window,
+        fire the loss-spike trigger. Between spills this is one counter
+        increment; disabled it is the is-None check above."""
+        if self._dynamics is None:
+            return
+        self._dyn_since_check += n
+        if not force and self._dyn_since_check < self._dynamics.every:
+            return
+        self._dyn_since_check = 0
+        with _goodput.account("telemetry"):
+            self._dynamics.spill(self._dyn_state,
+                                 step=self.optimizer._global_step)
+            # re-arm the per-window max-z latch: each window reports its
+            # own worst spike
+            self._dyn_state = self._dynamics.reset_window(self._dyn_state)
 
     @staticmethod
     def _check_stacked(batch_data, n):
@@ -522,10 +606,11 @@ class TrainStep:
                 try:
                     chaos.site("obs.oom")
                     (loss, new_params, new_buffers, self.opt_state,
-                     self._scaler_state, self._nf_state) = self._compiled(
+                     self._scaler_state, self._nf_state,
+                     self._dyn_state) = self._compiled(
                         params, buffers, frozen, self.opt_state,
-                        self._scaler_state, self._nf_state, lr,
-                        prandom.next_key(), batch_data
+                        self._scaler_state, self._nf_state, self._dyn_state,
+                        lr, prandom.next_key(), batch_data
                     )
                 except Exception as e:
                     _compilemem.maybe_oom_report(e, program="train.step")
@@ -543,6 +628,8 @@ class TrainStep:
         self.optimizer._global_step += 1
         _watchdog.maybe_beat(self.optimizer._global_step)
         self._nf_check()
+        self._dyn_check()
+        _flightrec.maybe_capture_step(self.optimizer._global_step)
         if self.metrics_bus is not None:
             if self.metrics_bus.tokens_per_step is None and batch_data:
                 import math

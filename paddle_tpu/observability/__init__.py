@@ -20,7 +20,9 @@ it is the EventCounters cost model. Span tracing and goodput timers are
 docs/OBSERVABILITY.md for the metric/span taxonomy and env vars.
 """
 from . import compilemem  # noqa: F401
+from . import dynamics  # noqa: F401
 from . import fleet  # noqa: F401
+from . import flightrec  # noqa: F401
 from . import goodput  # noqa: F401
 from . import request_trace  # noqa: F401
 from . import slo  # noqa: F401
@@ -30,7 +32,9 @@ from .compilemem import (  # noqa: F401
     ledgered_jit,
     record_compile,
 )
+from .dynamics import DynamicsMonitor  # noqa: F401
 from .fleet import FleetAggregator, SnapshotPublisher  # noqa: F401
+from .flightrec import FlightRecorder  # noqa: F401
 from .goodput import GoodputAccountant  # noqa: F401
 from .metrics import (  # noqa: F401
     DEFAULT_BUCKETS,
@@ -61,4 +65,5 @@ __all__ = [
     "SLOMonitor", "SLOObjective", "StatusServer", "compilemem",
     "CompileLedger", "MemoryLedger", "ledgered_jit", "record_compile",
     "fleet", "FleetAggregator", "SnapshotPublisher",
+    "dynamics", "DynamicsMonitor", "flightrec", "FlightRecorder",
 ]

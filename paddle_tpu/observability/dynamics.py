@@ -1,0 +1,464 @@
+"""In-program training-dynamics telemetry (ISSUE 13 tentpole).
+
+Every telemetry layer before this one watches the HOST side — spans,
+compile events, HBM budgets, fleet skew. This module observes the model's
+own numerics INSIDE the compiled step: a small fixed-shape stats carry
+(donated, like the non-finite sentinel's counters) is updated by pure
+jit-side math every step and spilled to the host on a cadence, so a
+diverging run is visible — and attributable to a layer group — before the
+loss chart goes bad and the evidence is gone.
+
+What the carry holds, per step:
+
+- **global + per-layer-group gradient norms** (squared, f32) — the first
+  signal a desyncing rank or an exploding layer shows;
+- **per-group parameter norms and update norms** — ‖Δw‖/‖w‖ update ratios,
+  the classic "is the LR sane for THIS layer" diagnostic;
+- **loss EWMA + variance EWMA + spike z-score** — computed in-program so a
+  spike is stamped at the exact step it happened, not at the next log line;
+- **non-finite provenance**: a per-group mask of which groups' gradients
+  were NaN/Inf this step, and a LATCHED first-occurrence mask + step —
+  upgrading the PR-9 count-only sentinel to "group `layers.7` went
+  non-finite first, at update 412".
+
+Layer groups: parameter names are bucketed by :func:`group_of` —
+``model.layers.3.self_attn.q_proj.weight`` → ``layers.3``; non-stacked
+params group by their first dotted component. The group count is bounded
+(``PADDLE_DYNAMICS_MAX_GROUPS``; overflow collapses into ``other``), so
+the carry is a handful of ``f32[G]`` vectors — signature-stable, and the
+per-group sums are an O(params) fusion into the step program XLA
+schedules alongside the optimizer update.
+
+Cost contract (the PR-2 discipline, asserted in tests/test_dynamics.py):
+
+- **disabled** (``PADDLE_DYNAMICS`` unset): ``DynamicsMonitor.from_env``
+  returns None — the compiled program carries NOTHING and the host
+  epilogue pays one attribute-is-None check;
+- **enabled, between spills**: the host path is one counter increment;
+- **spill** (every ``PADDLE_DYNAMICS_EVERY_STEPS`` dispatches): ONE
+  device→host read of the small carry (the only added sync per window),
+  accounted to the explicit ``telemetry`` goodput phase — never silently
+  inflating ``step`` time.
+
+Spills publish ``train.grad_norm`` / ``train.param_norm`` /
+``train.update_ratio{group=}`` / ``train.loss_spike_z`` gauges, append to
+a bounded window ring (the flight recorder's "what led up to it" payload),
+and fire the ``loss_spike`` flight trigger past ``PADDLE_DYNAMICS_SPIKE_Z``.
+
+jax is imported lazily inside the jit-side helpers — the observability
+package stays stdlib-only at import time.
+"""
+import collections
+import math
+import re
+import threading
+import time
+import weakref
+
+from ..utils.envs import env_bool, env_float, env_int
+from .metrics import registry as _registry
+
+__all__ = ["DynamicsMonitor", "group_of", "monitors", "reports",
+           "flight_block", "fleet_block", "ENABLE_ENV", "EVERY_ENV",
+           "SPIKE_Z_ENV", "EWMA_ENV", "MAX_GROUPS_ENV", "WINDOW_ENV"]
+
+#: master switch — unset/false = the whole layer is one None check
+ENABLE_ENV = "PADDLE_DYNAMICS"
+#: host spill cadence in dispatches: at most one device sync per window
+EVERY_ENV = "PADDLE_DYNAMICS_EVERY_STEPS"
+#: EWMA decay for the loss mean/variance trackers
+EWMA_ENV = "PADDLE_DYNAMICS_EWMA"
+#: |z| past this fires the loss_spike flight trigger (<=0 disables)
+SPIKE_Z_ENV = "PADDLE_DYNAMICS_SPIKE_Z"
+#: layer-group cap — overflow groups collapse into 'other'
+MAX_GROUPS_ENV = "PADDLE_DYNAMICS_MAX_GROUPS"
+#: host-side summary ring length (the flight-record dynamics window)
+WINDOW_ENV = "PADDLE_DYNAMICS_WINDOW"
+
+#: repeated-block param names: the numbered block IS the layer group
+_LAYER_RE = re.compile(
+    r"(?:^|\.)((?:layers|layer|blocks|h|stages|encoder_layers|"
+    r"decoder_layers)\.\d+)(?=\.|$)")
+
+#: live monitors, for /dynamicsz and the fleet snapshot block — weak so a
+#: dropped TrainStep takes its monitor out of the listing
+_monitors = weakref.WeakValueDictionary()
+_monitors_lock = threading.Lock()
+_monitor_seq = 0
+
+
+def group_of(name):
+    """Layer group for a parameter name: the numbered transformer block
+    (``layers.3``) when one appears in the dotted path, else the first
+    dotted component (``embed_tokens``, ``lm_head``), else ``root``."""
+    m = _LAYER_RE.search(name)
+    if m:
+        return m.group(1)
+    head = name.split(".", 1)[0]
+    return head or "root"
+
+
+def monitors():
+    """Live monitors, oldest first (usually exactly one per process)."""
+    with _monitors_lock:
+        return [m for _, m in sorted(_monitors.items())]
+
+
+def reports():
+    """The /dynamicsz monitor payloads."""
+    return [m.report() for m in monitors()]
+
+
+def flight_block():
+    """The flight-record payload: per-monitor group list, last summary and
+    the recent spill window."""
+    out = []
+    for m in monitors():
+        out.append({
+            "groups": list(m.group_names),
+            "every": m.every,
+            "last": m.last,
+            "window": m.window_list(),
+        })
+    return out
+
+
+def fleet_block():
+    """The per-rank fleet-snapshot block (bounded: the newest monitor's
+    last spilled summary only) — what the aggregator reads to flag
+    cross-rank grad-norm skew. None when nothing has spilled."""
+    ms = monitors()
+    for m in reversed(ms):
+        if m.last is not None:
+            return dict(m.last)
+    return None
+
+
+class DynamicsMonitor:
+    """One TrainStep's dynamics instrumentation: the static group mapping,
+    the jit-side carry update, and the cadence-gated host spill."""
+
+    def __init__(self, named_params, every=None, ewma=None, spike_z=None,
+                 max_groups=None, window=None):
+        max_groups = (int(max_groups) if max_groups is not None
+                      else env_int(MAX_GROUPS_ENV, 64))
+        groups = {}
+        for name in named_params:
+            groups.setdefault(group_of(name), []).append(name)
+        names = sorted(groups)
+        if len(names) > max_groups:
+            kept, spill = names[:max_groups - 1], names[max_groups - 1:]
+            other = []
+            for g in spill:
+                other.extend(groups.pop(g))
+            groups["other"] = other
+            names = kept + ["other"]
+        #: group names, index-aligned with every f32[G] carry vector
+        self.group_names = tuple(names)
+        self._group_members = tuple(tuple(groups[g]) for g in names)
+        self.every = max(1, every if every is not None
+                         else env_int(EVERY_ENV, 32))
+        self.ewma = float(ewma if ewma is not None
+                          else env_float(EWMA_ENV, 0.1))
+        self.spike_z = float(spike_z if spike_z is not None
+                             else env_float(SPIKE_Z_ENV, 6.0))
+        window = (int(window) if window is not None
+                  else env_int(WINDOW_ENV, 32))
+        #: recent spill summaries — the flight recorder's dynamics window.
+        #: Appended by the training thread, read by statusz/flightrec
+        #: threads: all access goes through _win_lock (iterating a deque
+        #: mid-append raises RuntimeError, and that error would replace
+        #: the dynamics block of exactly the bundle that needed it).
+        self.window = collections.deque(maxlen=max(1, window))
+        self._win_lock = threading.Lock()
+        #: the newest spilled summary (None until the first spill)
+        self.last = None
+        global _monitor_seq
+        with _monitors_lock:
+            _monitor_seq += 1
+            _monitors[_monitor_seq] = self
+
+    @classmethod
+    def from_env(cls, named_params):
+        """The TrainStep hook: a monitor when ``PADDLE_DYNAMICS`` is
+        truthy, else None — and None means the step carries nothing."""
+        if not env_bool(ENABLE_ENV):
+            return None
+        return cls(named_params)
+
+    # ---- jit side ----------------------------------------------------------
+    def init_state(self):
+        """The donated stats carry: fixed-shape f32/i32 leaves only, so the
+        compiled signature is stable for the life of the step program."""
+        import jax.numpy as jnp
+
+        g = len(self.group_names)
+        # one DISTINCT array per leaf: the whole carry is donated, and
+        # donating one aliased buffer under two leaves is an XLA error
+        # ("attempt to donate the same buffer twice")
+        return {
+            "count": jnp.zeros((), jnp.int32),
+            "loss_ewma": jnp.zeros((), jnp.float32),
+            "loss_var": jnp.zeros((), jnp.float32),
+            "loss_z": jnp.zeros((), jnp.float32),
+            # max-z latch since the last spill window reset: a one-step
+            # spike that decays before the cadence read must still be
+            # caught (same latch idea as nf_first_mask)
+            "z_max": jnp.full((), -jnp.inf, jnp.float32),
+            "z_max_at": jnp.full((), -1, jnp.int32),
+            "last_loss": jnp.zeros((), jnp.float32),
+            "grad_sq": jnp.zeros((g,), jnp.float32),
+            "param_sq": jnp.zeros((g,), jnp.float32),
+            "upd_sq": jnp.zeros((g,), jnp.float32),
+            "nf_mask": jnp.zeros((g,), jnp.int32),
+            "nf_first_mask": jnp.zeros((g,), jnp.int32),
+            "nf_first_step": jnp.full((), -1, jnp.int32),
+            "nf_steps": jnp.zeros((), jnp.int32),
+        }
+
+    def update(self, st, loss, grads, params, new_params):
+        """Pure carry update, traced INTO the step program. ``grads`` are
+        the unscaled pre-clip gradients (what the model actually produced);
+        ``params``/``new_params`` bracket the optimizer update so
+        ‖Δw‖ reflects clipping, weight decay and any skip-gating."""
+        import jax.numpy as jnp
+
+        f32 = jnp.float32
+        gsq, psq, usq, gfin = [], [], [], []
+        for members in self._group_members:
+            g2 = jnp.zeros((), f32)
+            p2 = jnp.zeros((), f32)
+            u2 = jnp.zeros((), f32)
+            fin = jnp.asarray(True)
+            for n in members:
+                g = grads.get(n)
+                if g is not None:
+                    g32 = g.astype(f32)
+                    g2 = g2 + jnp.sum(g32 * g32)
+                    fin = fin & jnp.all(jnp.isfinite(g32))
+                p32 = params[n].astype(f32)
+                p2 = p2 + jnp.sum(p32 * p32)
+                d = new_params[n].astype(f32) - p32
+                u2 = u2 + jnp.sum(d * d)
+            gsq.append(g2)
+            psq.append(p2)
+            usq.append(u2)
+            gfin.append(fin)
+        grad_sq = jnp.stack(gsq)
+        param_sq = jnp.stack(psq)
+        upd_sq = jnp.stack(usq)
+        finite = jnp.stack(gfin)
+
+        loss32 = jnp.asarray(loss).astype(f32)
+        loss_ok = jnp.isfinite(loss32)
+        nf_mask = (~finite).astype(jnp.int32)
+        nf_any = (~loss_ok) | jnp.any(~finite)
+        newly = (st["nf_first_step"] < 0) & nf_any
+
+        count = st["count"]
+        a = f32(self.ewma)
+        prev_mean, prev_var = st["loss_ewma"], st["loss_var"]
+        delta = loss32 - prev_mean
+        # z of THIS step's loss against the pre-update trackers; 0 until
+        # the variance tracker has something to divide by, and a
+        # non-finite loss reports the sentinel value 0 (the nf fields
+        # carry that story — a NaN z would poison the spike gauge)
+        z = jnp.where((count > 0) & (prev_var > 0) & loss_ok,
+                      delta / jnp.sqrt(prev_var + f32(1e-12)), f32(0))
+        # non-finite losses never enter the trackers: one NaN would stick
+        # the EWMA at NaN forever and blind every later spike
+        new_mean = jnp.where(
+            loss_ok, jnp.where(count == 0, loss32, prev_mean + a * delta),
+            prev_mean)
+        new_var = jnp.where(loss_ok & (count > 0),
+                            (f32(1) - a) * (prev_var + a * delta * delta),
+                            prev_var)
+        z_hit = z > st["z_max"]
+        return {
+            "count": count + 1,
+            "loss_ewma": new_mean,
+            "loss_var": new_var,
+            "loss_z": z,
+            "z_max": jnp.maximum(z, st["z_max"]),
+            "z_max_at": jnp.where(z_hit, count,
+                                  st["z_max_at"]).astype(jnp.int32),
+            "last_loss": loss32,
+            "grad_sq": grad_sq,
+            "param_sq": param_sq,
+            "upd_sq": upd_sq,
+            "nf_mask": nf_mask,
+            "nf_first_mask": jnp.where(newly, nf_mask,
+                                       st["nf_first_mask"]),
+            "nf_first_step": jnp.where(newly, count,
+                                       st["nf_first_step"]).astype(jnp.int32),
+            "nf_steps": st["nf_steps"] + nf_any.astype(jnp.int32),
+        }
+
+    # ---- host side ---------------------------------------------------------
+    @staticmethod
+    def _get(state):
+        import jax
+
+        return jax.device_get(state)
+
+    def summarize(self, state, step=None):
+        """One host read of the carry (THE sync) distilled into a plain
+        dict. Does not publish or trigger — :meth:`spill` does."""
+        if state is None:
+            return None
+        st = self._get(state)
+        grad_sq = [float(v) for v in st["grad_sq"]]
+        param_sq = [float(v) for v in st["param_sq"]]
+        upd_sq = [float(v) for v in st["upd_sq"]]
+        eps = 1e-20
+        groups = {}
+        for i, name in enumerate(self.group_names):
+            groups[name] = {
+                "grad_norm": round(math.sqrt(max(grad_sq[i], 0.0)), 8),
+                "param_norm": round(math.sqrt(max(param_sq[i], 0.0)), 8),
+                "update_ratio": round(
+                    math.sqrt(max(upd_sq[i], 0.0)
+                              / max(param_sq[i], eps)), 10),
+            }
+        nf_first_step = int(st["nf_first_step"])
+        z_max = float(st["z_max"])
+        summary = {
+            "step": int(step) if step is not None else int(st["count"]),
+            "updates": int(st["count"]),
+            "time": time.time(),
+            "loss": float(st["last_loss"]),
+            "loss_ewma": float(st["loss_ewma"]),
+            "loss_z": float(st["loss_z"]),
+            "loss_z_max": z_max if math.isfinite(z_max) else None,
+            "loss_z_max_at": int(st["z_max_at"]),
+            "grad_norm": round(math.sqrt(max(sum(grad_sq), 0.0)), 8),
+            "groups": groups,
+            "nonfinite_steps": int(st["nf_steps"]),
+            "nonfinite_groups": [self.group_names[i]
+                                 for i, v in enumerate(st["nf_mask"]) if v],
+            "nonfinite_first": None if nf_first_step < 0 else {
+                "update": nf_first_step,
+                "groups": [self.group_names[i]
+                           for i, v in enumerate(st["nf_first_mask"]) if v],
+            },
+        }
+        return summary
+
+    def provenance(self, state):
+        """The latched first-non-finite record (None while everything has
+        stayed finite): which layer group(s) went NaN/Inf FIRST, at which
+        update, plus the current per-step mask — the payload
+        NonFiniteLossError and the nonfinite flight trigger attach."""
+        if state is None:
+            return None
+        st = self._get({k: state[k] for k in
+                        ("nf_first_mask", "nf_first_step", "nf_mask",
+                         "nf_steps")})
+        if int(st["nf_first_step"]) < 0:
+            return None
+        return {
+            "first_update": int(st["nf_first_step"]),
+            "first_groups": [self.group_names[i]
+                             for i, v in enumerate(st["nf_first_mask"])
+                             if v],
+            "current_groups": [self.group_names[i]
+                               for i, v in enumerate(st["nf_mask"]) if v],
+            "nonfinite_steps": int(st["nf_steps"]),
+        }
+
+    def spill(self, state, step=None):
+        """The cadence hook: read the carry once, publish the gauges,
+        append to the window ring, and fire the loss-spike flight trigger
+        when |z| crosses the threshold. Returns the summary (None when the
+        carry is None)."""
+        t0 = time.perf_counter()
+        summary = self.summarize(state, step=step)
+        if summary is None:
+            return None
+        _registry.gauge(
+            "train.grad_norm",
+            help="global gradient norm at the last dynamics spill"
+        ).set(summary["grad_norm"])
+        _registry.gauge(
+            "train.loss_spike_z",
+            help="loss z-score vs the in-program EWMA trackers"
+        ).set(round(summary["loss_z"], 6))
+        for name, g in summary["groups"].items():
+            labels = {"group": name}
+            _registry.gauge(
+                "train.grad_norm", labels=labels,
+                help="per-layer-group gradient norm at the last "
+                     "dynamics spill"
+            ).set(g["grad_norm"])
+            _registry.gauge(
+                "train.param_norm", labels=labels,
+                help="per-layer-group parameter norm"
+            ).set(g["param_norm"])
+            _registry.gauge(
+                "train.update_ratio", labels=labels,
+                help="per-layer-group ||delta_w|| / ||w|| at the last spill"
+            ).set(g["update_ratio"])
+        with self._win_lock:
+            self.window.append(summary)
+        self.last = summary
+        # one-sided: a SPIKE is the loss jumping UP. A healthy fast
+        # convergence drifts z persistently negative (the EWMA lags the
+        # drop) and must not page. The trigger reads the WINDOW MAX
+        # latch, not the spill-step z — a one-step spike that decayed
+        # before the cadence read still pages (reset_window() re-arms
+        # the latch after each spill).
+        z_trip = summary["loss_z_max"]
+        if (self.spike_z > 0 and z_trip is not None
+                and z_trip >= self.spike_z):
+            _registry.counter(
+                "train.loss_spikes",
+                help="dynamics spills whose loss z-score crossed the "
+                     "spike threshold").inc()
+            from . import flightrec
+
+            flightrec.record(
+                "loss_spike", step=summary["step"],
+                payload={"loss": summary["loss"],
+                         "loss_ewma": summary["loss_ewma"],
+                         "loss_z": summary["loss_z"],
+                         "loss_z_max": z_trip,
+                         "loss_z_max_at": summary["loss_z_max_at"],
+                         "threshold": self.spike_z})
+        _registry.histogram(
+            "dynamics.spill_s",
+            help="wall cost of one dynamics host spill (device read + "
+                 "gauge publish)").observe(time.perf_counter() - t0)
+        return summary
+
+    def reset_window(self, state):
+        """Re-arm the per-window latches after a spill (host side): a
+        fresh max-z latch so each cadence window reports ITS OWN worst
+        spike instead of the lifetime max shadowing later smaller ones.
+        Returns the carry with replaced latch leaves (distinct fresh
+        arrays — the carry is donated)."""
+        if state is None:
+            return None
+        import jax.numpy as jnp
+
+        st = dict(state)
+        st["z_max"] = jnp.full((), -jnp.inf, jnp.float32)
+        st["z_max_at"] = jnp.full((), -1, jnp.int32)
+        return st
+
+    def window_list(self):
+        """Snapshot of the spill window, safe from any thread."""
+        with self._win_lock:
+            return list(self.window)
+
+    def report(self):
+        """The /dynamicsz payload for this monitor."""
+        return {
+            "enabled": True,
+            "every": self.every,
+            "ewma": self.ewma,
+            "spike_z": self.spike_z,
+            "groups": list(self.group_names),
+            "group_sizes": [len(m) for m in self._group_members],
+            "last": self.last,
+            "window": self.window_list(),
+        }

@@ -358,6 +358,7 @@ class SnapshotPublisher:
             "serving_goodput": _goodput.serving.report(),
             "compile": _compilemem.ledger.counts(),
             "collectives": self.collectives.export(),
+            "dynamics": _dynamics_snapshot_block(),
         }
         if self.extra_provider is not None:
             try:
@@ -395,6 +396,24 @@ class SnapshotPublisher:
             return self.publish(step=step)
         except OSError:
             return None
+
+
+def _dynamics_snapshot_block():
+    """This process's last spilled dynamics summary (ISSUE 13), bounded to
+    the cross-rank-interesting scalars — the aggregator reads it to flag
+    grad-norm skew (a desyncing rank) before loss diverges. None when
+    dynamics is off or nothing has spilled yet."""
+    try:
+        from . import dynamics as _dyn
+
+        last = _dyn.fleet_block()
+    except Exception:
+        return None
+    if not last:
+        return None
+    return {k: last.get(k) for k in
+            ("step", "updates", "loss", "loss_ewma", "loss_z", "grad_norm",
+             "nonfinite_steps", "nonfinite_first")}
 
 
 #: cached process publisher: False = no telemetry dir (permanent no-op),
@@ -533,6 +552,7 @@ class FleetAggregator:
         self._history = {}          # rank -> deque of per-round verdicts
         self._prev_totals = {}      # rank -> last advancing-round totals
         self._persistent = set()
+        self._gn_flagged = set()    # ranks currently grad-norm-skew-flagged
         self._scored_ranks = set()  # ranks with a live score gauge
         self._skew_phases = set()   # phases with a live skew gauge
         self._rounds = 0
@@ -659,6 +679,7 @@ class FleetAggregator:
         phases = self._phase_stats(
             [s for s in sources if s.get("role", "rank") == "rank"])
         straggler = self._straggler(rank_snaps, advance=advance)
+        dynamics = self._dynamics_agg(rank_snaps, advance=advance)
         now = time.time()
         members = {}
         for (role, r), s in sorted(by_id.items()):
@@ -686,6 +707,7 @@ class FleetAggregator:
                        "missing": missing},
             "phases": phases,
             "straggler": straggler,
+            "dynamics": dynamics,
             "serving": self._serving_agg(replica_snaps),
             "errors": list(errors),
         }
@@ -800,6 +822,86 @@ class FleetAggregator:
                 self.registry.remove("fleet.phase_skew",
                                      labels={"phase": fam})
             self._skew_phases = set(out)
+        return out
+
+    # ---- cross-rank training dynamics (ISSUE 13) ---------------------------
+    def _dynamics_agg(self, rank_snaps, advance=True):
+        """Merge the per-rank dynamics blocks into the desync view: in
+        data-parallel training every rank consumes a different shard of
+        the SAME distribution, so a rank whose grad norm sits far off the
+        cross-rank median is desyncing (corrupt shard, diverging local
+        state) — visible here BEFORE the loss chart shows it. Ratios
+        against the median reuse the straggler threshold; transitions
+        count into ``fleet.dynamics.skew_alerts``."""
+        per_rank = {}
+        for r, s in rank_snaps.items():
+            d = s.get("dynamics")
+            if isinstance(d, dict) and d.get("grad_norm") is not None:
+                per_rank[r] = d
+        if not per_rank:
+            # dynamics went away (disabled on restart, no spill yet):
+            # retire the gauge and the flag state, like the straggler
+            # detector's vanished-rank retirement — a stale skew must
+            # not linger in the exposition, and a later re-flag must
+            # still count as an off -> on transition. ADVANCING rounds
+            # only: a /fleetz?refresh=1 scrape racing a re-forming world
+            # must not perturb alert-transition state (the straggler
+            # window keeps the same contract).
+            if advance:
+                with self._lock:
+                    self._gn_flagged = set()
+                self.registry.remove("fleet.grad_norm_skew")
+            return None
+        norms = {r: float(d["grad_norm"]) for r, d in per_rank.items()}
+        med = _median(list(norms.values()))
+        worst = max(norms, key=norms.get)
+        lo = min(norms.values())
+        skew = round(norms[worst] / med, 4) if med > 0 else 1.0
+        self.registry.gauge(
+            "fleet.grad_norm_skew",
+            help="max-rank grad norm / median-rank grad norm at the last "
+                 "merge (a desyncing rank shows here before loss "
+                 "diverges)").set(skew)
+        flagged = set()
+        if len(norms) >= 2 and med > 0:
+            # both tails: a rank desyncs by exploding (corrupt shard,
+            # diverged local state) OR by collapsing toward zero (dead
+            # shard, flat region) — the low outlier is the one a
+            # high-only ratio never sees
+            flagged = {r for r, v in norms.items()
+                       if v >= med * self.threshold
+                       or v <= med / self.threshold}
+        out = {
+            "ranks": {str(r): {
+                "grad_norm": norms[r],
+                "loss": d.get("loss"),
+                "loss_z": d.get("loss_z"),
+                "step": d.get("step"),
+                "nonfinite_steps": d.get("nonfinite_steps"),
+                "nonfinite_first": d.get("nonfinite_first"),
+            } for r, d in sorted(per_rank.items())},
+            "median_grad_norm": round(med, 8),
+            "max_rank": worst,
+            "skew": skew,
+            # the full max-min range over the median: catches the LOW
+            # outlier the max/median ratio cannot (same rationale as the
+            # phase-stats spread)
+            "spread": round((norms[worst] - lo) / med, 4) if med > 0
+            else 0.0,
+            "flagged": sorted(flagged),
+            "nonfinite_ranks": sorted(
+                r for r, d in per_rank.items()
+                if (d.get("nonfinite_steps") or 0) > 0),
+        }
+        if advance:
+            with self._lock:
+                newly = flagged - self._gn_flagged
+                if newly:
+                    self.registry.counter(
+                        "fleet.dynamics.skew_alerts",
+                        help="grad-norm-skew flag transitions (off -> on) "
+                             "per rank across merges").inc(len(newly))
+                self._gn_flagged = flagged
         return out
 
     # ---- straggler detection ----------------------------------------------
@@ -934,14 +1036,28 @@ class FleetAggregator:
                     result["ranks"][str(r)]["flagged_rounds"] = flagged
                 if len(hist) >= need and flagged >= need:
                     newly_persistent.add(r)
+            new_alerts = set()
             if advance:
-                for r in newly_persistent - self._persistent:
+                new_alerts = newly_persistent - self._persistent
+                for r in new_alerts:
                     self.registry.counter(
                         "fleet.straggler.alerts",
                         help="persistent-straggler transitions (off -> on) "
                              "over the sliding window").inc()
                 self._persistent = newly_persistent
             result["persistent"] = sorted(self._persistent)
+        if new_alerts:
+            # flight-record the alert (ISSUE 13): freeze the window
+            # verdicts + per-rank splits at the transition. Outside the
+            # lock — committing a bundle is file I/O.
+            from . import flightrec
+
+            flightrec.record(
+                "straggler",
+                payload={"new_persistent": sorted(new_alerts),
+                         "persistent": result["persistent"],
+                         "window": self.window,
+                         "ranks": dict(result["ranks"])})
         return result
 
     def straggler_advisory(self):

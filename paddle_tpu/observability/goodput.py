@@ -8,6 +8,11 @@ Attributes elapsed time to a small fixed taxonomy —
 - ``data_wait``  input pipeline starvation (host blocked on the loader)
 - ``checkpoint`` save/serialize stalls on the training thread
 - ``recovery``   resume loads, restart rendezvous, watchdog-diagnosed stalls
+- ``telemetry``  cadence-gated host reads of device-resident telemetry
+                 (the non-finite sentinel counters, the dynamics carry
+                 spill) — each read synchronizes on the step, and that
+                 wall must be attributed, not silently folded into step
+                 time (ISSUE 13 satellite)
 
 so the chaos layer's preemptions and the launcher's restarts show up as
 measured badput fractions, not vibes. ``report()`` divides by true wall
@@ -29,7 +34,8 @@ __all__ = ["GoodputAccountant", "accountant", "account", "note", "report",
            "reset", "CATEGORIES", "SERVING_CATEGORIES", "serving",
            "serving_note", "serving_report"]
 
-CATEGORIES = ("init", "step", "data_wait", "checkpoint", "recovery")
+CATEGORIES = ("init", "step", "data_wait", "checkpoint", "recovery",
+              "telemetry")
 
 #: serving-path taxonomy (ISSUE 7 satellite): engine wall clock classified
 #: into device-productive work (prefill, decode) vs host/emit, dispatcher

@@ -22,6 +22,13 @@ Routes (GET only):
 - ``/fleetz``   — the fleet view (ISSUE 11): merged per-rank/per-replica
   snapshots — members, quorum, phase skew, straggler verdicts, serving
   rollup (``?refresh=1`` forces a fresh merge).
+- ``/dynamicsz`` — training dynamics (ISSUE 13): per-monitor layer groups,
+  grad/param/update norms, loss spike z, non-finite provenance, the
+  recent spill window, and the flight recorder's bundle ledger.
+- ``/profilez`` — the on-demand xprof capture: ``?steps=K`` arms a
+  capture of the next K train steps via the flight recorder's capture
+  registry, ``?disarm=1`` cancels it; bare GET returns capture status +
+  history.
 - ``/healthz``  — liveness: 200 with per-replica / per-rank heartbeat ages,
   503 when nothing can serve (no LIVE replica) or every heartbeat is stale.
 
@@ -88,6 +95,10 @@ class StatusServer:
                 lambda q: (200, self.memz(analyze="analyze=1" in q))),
             "/fleetz": self._route_json(
                 lambda q: (200, self.fleetz(refresh="refresh=1" in q))),
+            "/dynamicsz": self._route_json(
+                lambda q: (200, self.dynamicsz())),
+            "/profilez": self._route_json(
+                lambda q: (200, self.profilez(q))),
             "/healthz": self._route_json(lambda q: self.healthz()),
         }
 
@@ -213,6 +224,38 @@ class StatusServer:
         if getattr(agg, "_thread", None) is None:
             refresh = True
         return agg.view(refresh=refresh)
+
+    def dynamicsz(self):
+        """The training-dynamics view (ISSUE 13): every live monitor's
+        layer groups, last spilled summary and recent window, plus the
+        flight recorder's committed-bundle ledger."""
+        from . import dynamics, flightrec
+
+        return {
+            "monitors": dynamics.reports(),
+            "flight": flightrec.report(),
+            "capture": flightrec.capture_status(),
+        }
+
+    def profilez(self, query):
+        """The on-demand xprof capture surface (ISSUE 13):
+        ``/profilez?steps=K`` arms a capture of the next K train steps
+        through the flight recorder's capture registry;
+        ``?disarm=1`` cancels/stops the armed capture (the remediation
+        for a capture armed on a process that never steps — without it
+        the one-capture slot would wedge until restart); a bare GET
+        returns the armed/active capture and the completed-capture
+        history."""
+        import re as _re
+
+        from . import flightrec
+
+        if _re.search(r"(?:^|&)disarm=1", query or ""):
+            return flightrec.disarm_capture()
+        m = _re.search(r"(?:^|&)steps=(\d+)", query or "")
+        if m:
+            return flightrec.arm_capture(int(m.group(1)), trigger="http")
+        return flightrec.capture_status()
 
     def _heartbeats(self):
         """{rank: age_s} from the PR-2 heartbeat files, when a telemetry

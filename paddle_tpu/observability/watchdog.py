@@ -381,6 +381,17 @@ class HangWatchdog:
         from .metrics import registry
 
         registry.counter("fault.watchdog.hang").inc()
+        # flight-record the hang (ISSUE 13): the bundle carries what the
+        # full report cannot — THIS process's dynamics window, span ring
+        # and compile tail — committed into the watched telemetry dir
+        from . import flightrec
+
+        flightrec.record(
+            "hang", payload={"stalled_ranks": sorted(stalled),
+                             "stalled_for_s": {str(r): round(s, 3)
+                                               for r, s in stalled.items()},
+                             "report": self.report_path},
+            directory=self.dir)
         if self.signal_stalled is not None:
             pids = []
             for r in stalled:

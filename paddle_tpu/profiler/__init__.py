@@ -212,12 +212,20 @@ class Profiler:
 
 
 def start_xprof_trace(log_dir="/tmp/xprof"):
-    """Start a device trace via jax.profiler (xprof) — the CUPTI equivalent."""
-    jax.profiler.start_trace(log_dir)
+    """Start a device trace via jax.profiler (xprof) — the CUPTI
+    equivalent. Routed through the flight recorder's capture registry
+    (ISSUE 13): every profile artifact is ledgered, bounded to one live
+    capture, and visible at /profilez — raw ``jax.profiler.start_trace``
+    anywhere else fails the ``profiler-capture`` analysis rule."""
+    from ..observability import flightrec
+
+    flightrec.start_capture(log_dir, trigger="profiler_api")
 
 
 def stop_xprof_trace():
-    jax.profiler.stop_trace()
+    from ..observability import flightrec
+
+    flightrec.stop_capture()
 
 
 @contextlib.contextmanager

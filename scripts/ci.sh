@@ -70,6 +70,7 @@ FAST_TESTS=(
   tests/test_request_trace.py
   tests/test_compile_memory_obs.py
   tests/test_fleet_obs.py
+  tests/test_dynamics.py
 )
 
 if [[ "${1:-}" == "--fast" ]]; then

@@ -162,6 +162,32 @@ class TestCompileLedger:
         assert len(out) == 1 and ".lower(...).compile()" in out[0].message
 
 
+class TestProfilerCapture:
+    def test_violation_clean_marker(self, tmp_path):
+        out = findings_for(tmp_path, {
+            "paddle_tpu/a.py": "import jax\n"
+                               "jax.profiler.start_trace('/tmp/x')\n",
+            # the capture registry itself is the blessed site
+            "paddle_tpu/observability/flightrec.py":
+                "import jax\njax.profiler.stop_trace()\n",
+            "paddle_tpu/b.py": "from paddle_tpu.observability import "
+                               "flightrec\n"
+                               "flightrec.arm_capture(8)\n",
+            "paddle_tpu/c.py": "import jax\n"
+                               "jax.profiler.start_trace(d)  "
+                               "# lint: profiler-capture-ok\n",
+        }, ["profiler-capture"])
+        assert [f.path for f in out] == ["paddle_tpu/a.py"]
+        assert "capture registry" in out[0].message
+
+    def test_stop_trace_and_module_alias(self, tmp_path):
+        out = findings_for(tmp_path, {
+            "paddle_tpu/a.py": "from jax import profiler\n"
+                               "profiler.stop_trace()\n"},
+            ["profiler-capture"])
+        assert len(out) == 1 and "stop_trace" in out[0].message
+
+
 class TestMetricDocDrift:
     DOC = ("| Name | Meaning |\n|---|---|\n"
            "| `good.metric` | fine |\n"
@@ -608,7 +634,7 @@ class TestEngine:
             "compile-ledger", "metric-doc-drift", "ckpt-atomic-write",
             "elastic-membership", "lock-order", "blocking-under-lock",
             "shared-mutation-without-lock", "env-registry",
-            "chaos-site-registry",
+            "chaos-site-registry", "profiler-capture",
         }
         assert tested == set(RULES)
 

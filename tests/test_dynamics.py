@@ -1,0 +1,684 @@
+"""Training-dynamics telemetry + anomaly flight recorder (ISSUE 13).
+
+The load-bearing guarantees:
+
+- a NaN injected into ONE layer's computation is attributed to THAT layer
+  group by the in-program provenance mask, named in
+  ``NonFiniteLossError`` and in exactly one ``nonfinite`` flight bundle;
+- enabled at the default cadence, the host-side per-step cost stays under
+  the PR-2 <1%-of-a-10ms-step bound, and warm steps record ZERO compile
+  events (the compile-ledger contract);
+- flight records dedup, rate-limit and cap; ``/dynamicsz`` and
+  ``/profilez`` serve live over HTTP;
+- disabled, the whole layer is one is-None / module-global check.
+"""
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.jit_api import NonFiniteLossError, TrainStep
+from paddle_tpu.observability import dynamics, flightrec, goodput, tracing
+from paddle_tpu.observability import watchdog
+from paddle_tpu.observability.metrics import registry
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Each test starts with dynamics/flightrec unarmed and a zeroed
+    registry, and leaves the process the same way."""
+    for var in ("PADDLE_TELEMETRY", "PADDLE_TELEMETRY_DIR",
+                "PADDLE_DYNAMICS", "PADDLE_DYNAMICS_EVERY_STEPS",
+                "PADDLE_DYNAMICS_SPIKE_Z", "PADDLE_NONFINITE_TOLERANCE",
+                "PADDLE_NONFINITE_CHECK_EVERY", "PADDLE_FLIGHTREC_MAX",
+                "PADDLE_FLIGHTREC_MIN_INTERVAL_S",
+                "PADDLE_FLIGHTREC_CAPTURE_STEPS"):
+        monkeypatch.delenv(var, raising=False)
+    tracing.disable()
+    registry.reset()
+    goodput.reset()
+    watchdog._reset_process_heartbeat()
+    flightrec._reset()
+    yield
+    tracing.disable()
+    watchdog._reset_process_heartbeat()
+    flightrec._reset()
+
+
+class TwoTower(nn.Layer):
+    """Two independent linear towers: separable losses, so poisoning one
+    tower's weights produces non-finite gradients in THAT tower only."""
+
+    def __init__(self, d=4):
+        super().__init__()
+        self.block_a = nn.Linear(d, d)
+        self.block_b = nn.Linear(d, d)
+
+    def forward(self, x):
+        return self.block_a(x), self.block_b(x)
+
+
+def _loss(a, b, y):
+    return ((a - y) ** 2).mean() + ((b - y) ** 2).mean()
+
+
+def _make_step(**kw):
+    paddle.seed(0)
+    m = TwoTower()
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=m.parameters())
+    return m, TrainStep(m, _loss, opt, n_labels=1, **kw)
+
+
+def _batch():
+    rng = np.random.RandomState(0)
+    return (paddle.to_tensor(rng.randn(8, 4).astype(np.float32)),
+            paddle.to_tensor(rng.randn(8, 4).astype(np.float32)))
+
+
+# ---------------------------------------------------------------------------
+# group mapping
+# ---------------------------------------------------------------------------
+class TestGroupOf:
+    def test_numbered_blocks_and_heads(self):
+        assert dynamics.group_of(
+            "model.layers.3.self_attn.q_proj.weight") == "layers.3"
+        assert dynamics.group_of("llama.layers.11.mlp.w1.bias") == "layers.11"
+        assert dynamics.group_of("transformer.h.0.attn.weight") == "h.0"
+        assert dynamics.group_of("embed_tokens.weight") == "embed_tokens"
+        assert dynamics.group_of("lm_head.weight") == "lm_head"
+
+    def test_group_cap_collapses_overflow(self):
+        names = {f"layers.{i}.w": None for i in range(10)}
+        mon = dynamics.DynamicsMonitor(names, max_groups=4)
+        assert len(mon.group_names) == 4
+        assert mon.group_names[-1] == "other"
+        # every param still lands in exactly one group
+        assert sum(len(m) for m in mon._group_members) == 10
+
+
+# ---------------------------------------------------------------------------
+# the chaos-NaN E2E: provenance, error message, exactly one bundle
+# ---------------------------------------------------------------------------
+class TestNonFiniteProvenance:
+    def _poison_block_b(self, m):
+        """Inject NaN into tower B's weights: its loss term and gradients
+        go NaN while block_a's stay finite (the losses are separable —
+        the add's backward passes the cotangent to each branch intact)."""
+        w = m.block_b.weight
+        w.set_value(np.full(w.shape, np.nan, np.float32))
+
+    def test_nan_attributed_to_injected_group(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PADDLE_DYNAMICS", "1")
+        monkeypatch.setenv("PADDLE_TELEMETRY_DIR", str(tmp_path))
+        monkeypatch.setenv("PADDLE_NONFINITE_TOLERANCE", "100")
+        monkeypatch.setenv("PADDLE_NONFINITE_CHECK_EVERY", "1")
+        m, step = _make_step()
+        assert step._dynamics is not None
+        assert step._dynamics.group_names == ("block_a", "block_b")
+        x, y = _batch()
+        step(x, y)  # one healthy step: provenance must stay None
+        assert step._dynamics.provenance(step._dyn_state) is None
+        self._poison_block_b(m)
+        for _ in range(3):
+            step(x, y)
+        prov = step._dynamics.provenance(step._dyn_state)
+        assert prov is not None
+        assert prov["first_groups"] == ["block_b"]
+        assert "block_a" not in prov["current_groups"]
+        assert prov["nonfinite_steps"] == 3
+        # ... and the E2E contract: exactly ONE nonfinite flight bundle
+        # (rate-limited), naming the injected group
+        flight_dir = tmp_path / "flight"
+        bundles = sorted(flight_dir.glob("nonfinite_*.json"))
+        assert len(bundles) == 1
+        rec = json.loads(bundles[0].read_text())
+        assert rec["trigger"] == "nonfinite"
+        assert rec["payload"]["provenance"]["first_groups"] == ["block_b"]
+        assert registry.get("flightrec.bundles").value == 1
+
+    def test_error_message_names_group(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PADDLE_DYNAMICS", "1")
+        monkeypatch.setenv("PADDLE_TELEMETRY_DIR", str(tmp_path))
+        monkeypatch.setenv("PADDLE_NONFINITE_TOLERANCE", "2")
+        monkeypatch.setenv("PADDLE_NONFINITE_CHECK_EVERY", "1")
+        m, step = _make_step()
+        x, y = _batch()
+        step(x, y)
+        self._poison_block_b(m)
+        with pytest.raises(NonFiniteLossError) as ei:
+            for _ in range(4):
+                step(x, y)
+        assert "block_b" in str(ei.value)
+        assert "block_a" not in str(ei.value)
+
+    def test_weights_uncorrupted_by_skips(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_DYNAMICS", "1")
+        monkeypatch.setenv("PADDLE_NONFINITE_TOLERANCE", "100")
+        m, step = _make_step()
+        x, y = _batch()
+        step(x, y)
+        self._poison_block_b(m)
+        before = np.asarray(m.block_a.weight.numpy()).copy()
+        step(x, y)  # skipped in-program
+        after = np.asarray(m.block_a.weight.numpy())
+        np.testing.assert_array_equal(before, after)
+
+
+# ---------------------------------------------------------------------------
+# dynamics spill: gauges, window, spike trigger, goodput phase
+# ---------------------------------------------------------------------------
+class TestSpill:
+    def test_gauges_and_window(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_DYNAMICS", "1")
+        monkeypatch.setenv("PADDLE_DYNAMICS_EVERY_STEPS", "2")
+        _, step = _make_step()
+        x, y = _batch()
+        for _ in range(4):
+            step(x, y)
+        mon = step._dynamics
+        assert mon.last is not None and len(mon.window) == 2
+        assert registry.get("train.grad_norm").value > 0
+        assert registry.get("train.update_ratio",
+                            labels={"group": "block_a"}).value > 0
+        assert registry.get("train.param_norm",
+                            labels={"group": "block_b"}).value > 0
+        assert registry.get("train.loss_spike_z") is not None
+        # groups in the summary mirror the gauge labels
+        assert set(mon.last["groups"]) == {"block_a", "block_b"}
+
+    def test_spill_lands_in_telemetry_goodput_phase(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_DYNAMICS", "1")
+        monkeypatch.setenv("PADDLE_DYNAMICS_EVERY_STEPS", "1")
+        tracing.enable()
+        _, step = _make_step()
+        x, y = _batch()
+        for _ in range(3):
+            step(x, y)
+        rep = goodput.report()
+        assert rep["categories"].get("telemetry", 0) > 0
+        assert "telemetry" in goodput.CATEGORIES
+        assert "telemetry" in rep["badput"]  # attributed, not goodput
+
+    def test_loss_spike_fires_flight_trigger(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PADDLE_TELEMETRY_DIR", str(tmp_path))
+        mon = dynamics.DynamicsMonitor({"w": None}, every=1, spike_z=2.0,
+                                       ewma=0.5)
+        st = mon.init_state()
+        import jax.numpy as jnp
+
+        params = {"w": jnp.ones((2,))}
+        grads = {"w": jnp.ones((2,))}
+        # settle the EWMA around 1.0, then spike to 100
+        for loss in (1.0, 1.1, 0.9, 1.0, 1.05):
+            st = mon.update(st, jnp.float32(loss), grads, params, params)
+        st = mon.update(st, jnp.float32(100.0), grads, params, params)
+        summary = mon.spill(st, step=6)
+        assert summary["loss_z"] >= 2.0
+        assert registry.get("train.loss_spikes").value == 1
+        assert list((tmp_path / "flight").glob("loss_spike_*.json"))
+
+    def test_mid_window_spike_is_latched(self, monkeypatch, tmp_path):
+        """A one-step spike that decays before the cadence read must
+        still page: the carry latches the window max z, and the spill
+        resets the latch so the NEXT window reports its own worst."""
+        monkeypatch.setenv("PADDLE_TELEMETRY_DIR", str(tmp_path))
+        mon = dynamics.DynamicsMonitor({"w": None}, every=8, spike_z=2.0,
+                                       ewma=0.5)
+        st = mon.init_state()
+        import jax.numpy as jnp
+
+        params = {"w": jnp.ones((2,))}
+        grads = {"w": jnp.ones((2,))}
+        for loss in (1.0, 1.1, 0.9, 1.0):
+            st = mon.update(st, jnp.float32(loss), grads, params, params)
+        st = mon.update(st, jnp.float32(100.0), grads, params, params)
+        for loss in (1.0, 1.05, 0.95):  # the spike decays away
+            st = mon.update(st, jnp.float32(loss), grads, params, params)
+        summary = mon.spill(st, step=8)
+        assert summary["loss_z"] < 2.0          # spill-step z is calm...
+        assert summary["loss_z_max"] >= 2.0     # ...but the latch caught it
+        assert registry.get("train.loss_spikes").value == 1
+        assert len(list((tmp_path / "flight").glob("loss_spike_*.json"))) == 1
+        # reset re-arms the latch: a calm next window does not re-page
+        st = mon.reset_window(st)
+        for loss in (1.0, 1.02, 0.98):
+            st = mon.update(st, jnp.float32(loss), grads, params, params)
+        mon.spill(st, step=16)
+        assert registry.get("train.loss_spikes").value == 1
+
+    def test_downward_drift_does_not_page(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PADDLE_TELEMETRY_DIR", str(tmp_path))
+        mon = dynamics.DynamicsMonitor({"w": None}, every=1, spike_z=2.0,
+                                       ewma=0.5)
+        st = mon.init_state()
+        import jax.numpy as jnp
+
+        params = {"w": jnp.ones((2,))}
+        grads = {"w": jnp.ones((2,))}
+        for loss in (10.0, 8.0, 5.0, 2.0, 0.5, 0.01):
+            st = mon.update(st, jnp.float32(loss), grads, params, params)
+        mon.spill(st, step=6)
+        spikes = registry.get("train.loss_spikes")
+        assert getattr(spikes, "value", 0) == 0
+        assert not list((tmp_path / "flight").glob("loss_spike_*.json"))
+
+    def test_run_steps_carries_dynamics(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_DYNAMICS", "1")
+        monkeypatch.setenv("PADDLE_DYNAMICS_EVERY_STEPS", "3")
+        _, step = _make_step()
+        rng = np.random.RandomState(1)
+        xs = paddle.to_tensor(rng.randn(3, 8, 4).astype(np.float32))
+        ys = paddle.to_tensor(rng.randn(3, 8, 4).astype(np.float32))
+        step.run_steps(xs, ys, n=3, stacked=True)
+        # the dispatch counted its n=3 steps toward the cadence -> spill
+        # saw all 3 scanned updates
+        assert step._dynamics.last["updates"] == 3
+
+    def test_run_steps_stays_cadence_gated(self, monkeypatch):
+        """A multi-step dispatch must NOT force a spill (that would put a
+        device sync inside bench's timed scan rungs) — it only counts its
+        n steps toward the cadence."""
+        monkeypatch.setenv("PADDLE_DYNAMICS", "1")  # default every=32
+        _, step = _make_step()
+        rng = np.random.RandomState(1)
+        xs = paddle.to_tensor(rng.randn(3, 8, 4).astype(np.float32))
+        ys = paddle.to_tensor(rng.randn(3, 8, 4).astype(np.float32))
+        step.run_steps(xs, ys, n=3, stacked=True)
+        assert step._dynamics.last is None  # 3 < 32: no spill yet
+        assert step._dyn_since_check == 3
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: dedup, rate limit, cap
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_dedup_and_rate_limit(self, tmp_path):
+        rec = flightrec.FlightRecorder(directory=str(tmp_path),
+                                       min_interval_s=1000.0)
+        p1 = rec.record("loss_spike", step=10, payload={"z": 7})
+        assert p1 and os.path.exists(p1)
+        # exact (trigger, step) repeat: dedup
+        assert rec.record("loss_spike", step=10) is None
+        # same trigger, new step, inside the rate window: suppressed
+        assert rec.record("loss_spike", step=11) is None
+        # a different trigger commits
+        assert rec.record("nonfinite", step=11) is not None
+        assert rec.suppressed == 2
+        assert registry.get("flightrec.suppressed").value == 2
+
+    def test_rate_limit_expires(self, tmp_path):
+        rec = flightrec.FlightRecorder(directory=str(tmp_path),
+                                       min_interval_s=0.05)
+        assert rec.record("t", step=1) is not None
+        assert rec.record("t", step=2) is None
+        time.sleep(0.06)
+        assert rec.record("t", step=3) is not None
+
+    def test_stepless_triggers_not_one_shot(self, tmp_path):
+        """A hang/slo_page/straggler record carries no step: after the
+        rate window it must stay eligible (dedup is step-keyed only) and
+        each commit gets its own file — a second hang an hour later must
+        not be suppressed forever or overwrite the first one's evidence."""
+        rec = flightrec.FlightRecorder(directory=str(tmp_path),
+                                       min_interval_s=0.05)
+        p1 = rec.record("hang", payload={"stalled_ranks": [0]})
+        assert p1 is not None
+        assert rec.record("hang") is None  # inside the rate window
+        time.sleep(0.06)
+        p2 = rec.record("hang", payload={"stalled_ranks": [1]})
+        assert p2 is not None and p2 != p1
+        assert os.path.exists(p1) and os.path.exists(p2)
+
+    def test_bundle_cap(self, tmp_path):
+        rec = flightrec.FlightRecorder(directory=str(tmp_path),
+                                       min_interval_s=0.0, max_bundles=2)
+        assert rec.record("a", step=1) is not None
+        assert rec.record("b", step=1) is not None
+        assert rec.record("c", step=1) is None  # capped
+        assert len(rec.status()["committed"]) == 2
+
+    def test_bundle_contents(self, tmp_path):
+        tracing.enable()
+        with tracing.span("some.phase"):
+            pass
+        rec = flightrec.FlightRecorder(directory=str(tmp_path))
+        path = rec.record("hang", payload={"stalled_ranks": [3]})
+        bundle = json.loads(open(path).read())
+        assert bundle["kind"] == "flight_record"
+        assert bundle["payload"]["stalled_ranks"] == [3]
+        for block in ("dynamics", "spans", "compile", "goodput", "metrics"):
+            assert block in bundle
+        assert any(s.get("name") == "some.phase" for s in bundle["spans"])
+
+    def test_failed_write_releases_the_slot(self, tmp_path, monkeypatch):
+        """A write that fails commits no evidence, so it must not consume
+        the dedup key or rate-limit stamp — the retrigger after the disk
+        recovers is the bundle that matters."""
+        rec = flightrec.FlightRecorder(directory=str(tmp_path),
+                                       min_interval_s=1000.0)
+        monkeypatch.setattr(rec, "_build",
+                            lambda *a: (_ for _ in ()).throw(OSError("disk")))
+        assert rec.record("nonfinite", step=7) is None
+        monkeypatch.undo()
+        assert rec.record("nonfinite", step=7) is not None
+
+    def test_record_never_raises(self, tmp_path):
+        # unwritable directory: suppressed, not raised
+        rec = flightrec.FlightRecorder(
+            directory=str(tmp_path / "f" / "\0bad" if os.name != "nt"
+                          else tmp_path))
+        assert rec.record("x", step=1) is None
+
+
+# ---------------------------------------------------------------------------
+# the capture registry
+# ---------------------------------------------------------------------------
+class TestCaptureRegistry:
+    @pytest.fixture(autouse=True)
+    def _fake_backend(self, monkeypatch):
+        calls = {"start": [], "stop": 0}
+        monkeypatch.setattr(flightrec, "_start_backend",
+                            lambda d: calls["start"].append(d))
+
+        def stop():
+            calls["stop"] += 1
+        monkeypatch.setattr(flightrec, "_stop_backend", stop)
+        self.calls = calls
+
+    def test_arm_counts_steps_then_stops(self):
+        out = flightrec.arm_capture(2, log_dir="/tmp/x", trigger="test")
+        assert out["armed"]
+        assert registry.get("flightrec.capture_active").value == 1
+        flightrec.maybe_capture_step(1)   # starts
+        assert self.calls["start"] == ["/tmp/x"]
+        flightrec.maybe_capture_step(2)   # step 1 of 2
+        assert self.calls["stop"] == 0
+        flightrec.maybe_capture_step(3)   # step 2 of 2 -> stop
+        assert self.calls["stop"] == 1
+        assert registry.get("flightrec.capture_active").value == 0
+        assert registry.get("flightrec.captures").value == 1
+        done = flightrec.capture_status()["completed"]
+        assert len(done) == 1 and done[0]["trigger"] == "test"
+
+    def test_single_capture_at_a_time(self):
+        assert flightrec.arm_capture(2)["armed"]
+        again = flightrec.arm_capture(2)
+        assert "error" in again
+        flightrec.disarm_capture()
+        assert flightrec.arm_capture(1)["armed"]
+
+    def test_run_steps_dispatch_burns_n_train_steps(self):
+        """The K-step contract counts TRAIN steps: a run_steps(n)
+        dispatch ticks the counter by n, not 1."""
+        flightrec.arm_capture(6, trigger="test")
+        flightrec.maybe_capture_step(0)        # starts
+        flightrec.maybe_capture_step(4, n=4)   # 4 of 6
+        assert self.calls["stop"] == 0
+        flightrec.maybe_capture_step(8, n=4)   # >= 6 -> stop
+        assert self.calls["stop"] == 1
+
+    def test_aborted_capture_not_counted_as_completed(self):
+        flightrec.arm_capture(1000, trigger="test")
+        flightrec.maybe_capture_step(1)  # starts
+        flightrec.disarm_capture()
+        assert self.calls["stop"] == 1  # backend stopped
+        assert getattr(registry.get("flightrec.captures"), "value", 0) == 0
+        done = flightrec.capture_status()["completed"]
+        assert done and done[-1].get("aborted") is True
+
+    def test_manual_capture_api(self):
+        from paddle_tpu import profiler
+
+        profiler.start_xprof_trace("/tmp/manual")
+        assert self.calls["start"] == ["/tmp/manual"]
+        # step hook must NOT advance/stop a manual capture
+        flightrec.maybe_capture_step(1)
+        flightrec.maybe_capture_step(2)
+        assert self.calls["stop"] == 0
+        profiler.stop_xprof_trace()
+        assert self.calls["stop"] == 1
+
+    def test_auto_capture_on_flight_trigger(self, tmp_path, monkeypatch):
+        rec = flightrec.FlightRecorder(directory=str(tmp_path),
+                                       capture_steps=3)
+        assert rec.record("loss_spike", step=5) is not None
+        status = flightrec.capture_status()
+        assert status["active"] is not None
+        assert status["active"]["steps"] == 3
+        assert status["active"]["trigger"] == "loss_spike"
+
+
+# ---------------------------------------------------------------------------
+# live HTTP: /dynamicsz + /profilez
+# ---------------------------------------------------------------------------
+class TestLiveRoutes:
+    def _get(self, port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, json.loads(r.read().decode())
+
+    def test_dynamicsz_and_profilez(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_DYNAMICS", "1")
+        monkeypatch.setenv("PADDLE_DYNAMICS_EVERY_STEPS", "1")
+        monkeypatch.setattr(flightrec, "_start_backend", lambda d: None)
+        monkeypatch.setattr(flightrec, "_stop_backend", lambda: None)
+        from paddle_tpu.observability.statusz import StatusServer
+
+        _, step = _make_step()
+        x, y = _batch()
+        step(x, y)
+        srv = StatusServer(port=0).start()
+        try:
+            code, dz = self._get(srv.port, "/dynamicsz")
+            assert code == 200
+            mons = dz["monitors"]
+            assert any(m["last"] is not None and "block_a" in m["groups"]
+                       for m in mons)
+            assert "flight" in dz and "capture" in dz
+            # arm a 1-step capture over HTTP, then drive it
+            code, armed = self._get(srv.port, "/profilez?steps=1")
+            assert code == 200 and armed["armed"]
+            step(x, y)  # starts
+            step(x, y)  # counts + stops
+            code, status = self._get(srv.port, "/profilez")
+            assert code == 200
+            assert status["active"] is None
+            assert len(status["completed"]) == 1
+            # ?disarm=1 frees a capture armed on a never-stepping process
+            code, armed = self._get(srv.port, "/profilez?steps=5")
+            assert code == 200 and armed["armed"]
+            code, out = self._get(srv.port, "/profilez?disarm=1")
+            assert code == 200 and out["disarmed"] is True
+            code, status = self._get(srv.port, "/profilez")
+            assert status["active"] is None
+            # both routes are in the dispatch-table listing
+            assert {"/dynamicsz", "/profilez"} <= set(srv.route_names())
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet: cross-rank grad-norm skew
+# ---------------------------------------------------------------------------
+class TestFleetGradNormSkew:
+    @staticmethod
+    def _snap(rank, grad_norm, t):
+        return {"kind": "fleet_snapshot", "version": 1, "role": "rank",
+                "rank": rank, "pid": 1000 + rank, "generation": 0,
+                "world": 2, "time": t, "seq": 1, "metrics": [],
+                "goodput": {}, "collectives": {},
+                "dynamics": {"step": 10, "grad_norm": grad_norm,
+                             "loss": 2.0, "loss_z": 0.1,
+                             "nonfinite_steps": 1 if rank == 1 else 0}}
+
+    def test_skew_flagged(self):
+        from paddle_tpu.observability.fleet import FleetAggregator
+        from paddle_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        agg = FleetAggregator([], registry=reg, threshold=1.5)
+        now = time.time()
+        view = agg.merge([self._snap(0, 1.0, now), self._snap(1, 1.0, now),
+                          self._snap(2, 5.0, now)])
+        dyn = view["dynamics"]
+        assert dyn["max_rank"] == 2
+        assert dyn["skew"] == 5.0
+        assert dyn["flagged"] == [2]
+        assert dyn["nonfinite_ranks"] == [1]
+        assert reg.get("fleet.grad_norm_skew").value == 5.0
+        assert reg.get("fleet.dynamics.skew_alerts").value == 1
+        # steady flag: no new transition on the next merge
+        agg.merge([self._snap(0, 1.0, now), self._snap(1, 1.0, now),
+                   self._snap(2, 5.0, now)])
+        assert reg.get("fleet.dynamics.skew_alerts").value == 1
+
+    def test_low_outlier_flagged(self):
+        """A rank whose gradients COLLAPSE (dead shard, flat region) is a
+        desync too — the high-only ratio would never see it."""
+        from paddle_tpu.observability.fleet import FleetAggregator
+        from paddle_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        agg = FleetAggregator([], registry=reg, threshold=1.5)
+        now = time.time()
+        view = agg.merge([self._snap(0, 1.0, now), self._snap(1, 1.0, now),
+                          self._snap(2, 0.01, now)])
+        dyn = view["dynamics"]
+        assert dyn["flagged"] == [2]
+        assert dyn["spread"] > 0.9
+        assert reg.get("fleet.dynamics.skew_alerts").value == 1
+
+    def test_vanished_dynamics_retires_state(self):
+        """Dynamics blocks disappearing (disabled on restart) must retire
+        the gauge and the flag memory, so a later re-flag is a counted
+        off -> on transition and no stale skew lingers in /varz."""
+        from paddle_tpu.observability.fleet import FleetAggregator
+        from paddle_tpu.observability.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        agg = FleetAggregator([], registry=reg, threshold=1.5)
+        now = time.time()
+        snaps = lambda: [self._snap(0, 1.0, now), self._snap(1, 1.0, now),
+                         self._snap(2, 5.0, now)]
+        agg.merge(snaps())
+        assert reg.get("fleet.dynamics.skew_alerts").value == 1
+        # dynamics gone: gauge retired, flags forgotten
+        bare = snaps()
+        for s in bare:
+            s.pop("dynamics")
+        view = agg.merge(bare)
+        assert view["dynamics"] is None
+        assert reg.get("fleet.grad_norm_skew") is None
+        # ... and the re-flag counts as a NEW transition
+        agg.merge(snaps())
+        assert reg.get("fleet.dynamics.skew_alerts").value == 2
+
+    def test_snapshot_publishes_dynamics_block(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PADDLE_DYNAMICS", "1")
+        monkeypatch.setenv("PADDLE_DYNAMICS_EVERY_STEPS", "1")
+        from paddle_tpu.observability.fleet import SnapshotPublisher
+
+        _, step = _make_step()
+        x, y = _batch()
+        step(x, y)
+        pub = SnapshotPublisher(str(tmp_path), rank=0, min_interval_s=0.0)
+        path = pub.publish(step=1)
+        snap = json.loads(open(path).read())
+        assert snap["dynamics"]["grad_norm"] > 0
+        assert "loss_z" in snap["dynamics"]
+
+
+# ---------------------------------------------------------------------------
+# cost contracts: disabled one-flag-check, enabled-at-cadence <1%
+# ---------------------------------------------------------------------------
+class TestCost:
+    @staticmethod
+    def _best_of(runs, fn):
+        return min(fn() for _ in range(runs))
+
+    def test_disabled_is_one_none_check(self):
+        _, step = _make_step()
+        assert step._dynamics is None
+        assert step._dyn_state is None
+        n = 100_000
+
+        def measure():
+            t0 = time.perf_counter()
+            for i in range(n):
+                step._dyn_check()
+                flightrec.maybe_capture_step(i)
+            return (time.perf_counter() - t0) / n
+
+        per_step = self._best_of(3, measure)
+        assert per_step < 2e-6, (
+            f"disabled dynamics epilogue costs {per_step * 1e9:.0f}ns")
+
+    def test_enabled_between_spills_under_one_percent(self, monkeypatch):
+        """The PR-2 bound, for the ENABLED path: between spills the host
+        epilogue (cadence counter + capture check) must stay <1% of a
+        10ms step. The spill itself is one small device read per
+        PADDLE_DYNAMICS_EVERY_STEPS window, measured separately below."""
+        monkeypatch.setenv("PADDLE_DYNAMICS", "1")
+        _, step = _make_step()
+        x, y = _batch()
+        step(x, y)
+        every = step._dynamics.every  # default 32
+        assert every == 32
+        n = 20_000
+
+        def measure():
+            # never let the counter reach the cadence: measure the
+            # between-spills path only
+            t0 = time.perf_counter()
+            for i in range(n):
+                step._dyn_since_check = 0
+                step._dyn_check()
+                flightrec.maybe_capture_step(i)
+            return (time.perf_counter() - t0) / n
+
+        per_step = self._best_of(3, measure)
+        assert per_step < 100e-6, (
+            f"enabled between-spill dynamics path costs "
+            f"{per_step * 1e6:.1f}µs/step (>1% of a 10ms step)")
+
+    def test_spill_amortized_under_one_percent(self, monkeypatch):
+        """At the default cadence the spill cost amortizes to <1% of a
+        10ms step: spill_wall / 32 < 100µs."""
+        monkeypatch.setenv("PADDLE_DYNAMICS", "1")
+        _, step = _make_step()
+        x, y = _batch()
+        step(x, y)
+        mon = step._dynamics
+        mon.spill(step._dyn_state, step=1)  # warm the gauge objects
+
+        def measure():
+            t0 = time.perf_counter()
+            mon.spill(step._dyn_state, step=2)
+            return time.perf_counter() - t0
+
+        per_window = self._best_of(5, measure)
+        assert per_window / mon.every < 100e-6, (
+            f"spill {per_window * 1e3:.2f}ms / {mon.every} steps "
+            f"amortizes above the 1% bound")
+
+    def test_zero_warm_recompiles_with_dynamics_on(self, monkeypatch):
+        """The compile-ledger contract: the dynamics carry is
+        signature-stable, so warm steps (and the cadence spill) record
+        zero compile events."""
+        monkeypatch.setenv("PADDLE_DYNAMICS", "1")
+        monkeypatch.setenv("PADDLE_DYNAMICS_EVERY_STEPS", "2")
+        from paddle_tpu.observability import compilemem
+
+        _, step = _make_step()
+        x, y = _batch()
+        step(x, y)  # cold compile
+        warm = compilemem.ledger.counts()["events"]
+        for _ in range(5):
+            step(x, y)
+        assert compilemem.ledger.counts()["events"] == warm, (
+            "dynamics carry caused warm recompiles")

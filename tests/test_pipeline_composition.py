@@ -205,7 +205,8 @@ class TestScheduled1F1BComposition:
             frozen = {k: p._data for k, p in step._frozen.items()}
             hlo = jitted.lower(
                 params, buffers, frozen, step.opt_state, step._scaler_state,
-                step._nf_state, step.optimizer.get_lr(), prandom.next_key(),
+                step._nf_state, step._dyn_state, step.optimizer.get_lr(),
+                prandom.next_key(),
                 tuple(paddle.to_tensor(b)._data for b in (x, y)),
             ).compile().as_text()
         assert "collective-permute" in hlo, "pp ring ppermute missing from HLO"
