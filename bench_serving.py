@@ -295,6 +295,53 @@ def _telemetry_snapshot(model, knobs, rng_seed, vocab):
     }
 
 
+def _disagg_block(model, knobs, rng_seed, vocab):
+    """ISSUE 16 extra: run the same short interactive load once through a
+    role-split frontend (one prefill replica, one decode replica) and
+    report the handoff counters plus client-observed TTFT. Informational
+    only — the headline contract numbers come from the blended phases
+    above, which are untouched by disaggregation (``PADDLE_SERVING_DISAGG``
+    gates the role-split path, and role-less frontends never enter it)."""
+    import numpy as np
+
+    from paddle_tpu.observability.metrics import registry as _registry
+    from paddle_tpu.serving import ServingFrontend
+
+    rng = np.random.RandomState(rng_seed + 29)
+    # generations must outlive several decode blocks or the request
+    # finishes on the prefill replica before a handoff can initiate
+    new = max(knobs["inter_new"], 4 * knobs["decode_block"] + 2)
+    shorts = [(rng.randint(1, vocab, (int(rng.randint(8, 24)),))
+               .astype(np.int32), new, "interactive")
+              for _ in range(4)]
+
+    def counts():
+        out = {}
+        for name in ("serving.handoff.published", "serving.handoff.adopted",
+                     "serving.handoff.corrupt", "serving.handoff.stale",
+                     "serving.handoff.initiated"):
+            out[name] = int(getattr(_registry.get(name), "value", 0) or 0)
+        return out
+
+    c0 = counts()
+    engines = _make_engines(model, "pipelined", 2, knobs)
+    for e in engines:
+        e.warmup(buckets=sorted({len(p) for p, _, _ in shorts}))
+    with ServingFrontend(engines, roles=["prefill", "decode"],
+                         heartbeat_deadline_s=600.0) as fe:
+        records, wall = _run_load(fe, shorts)
+    c1 = counts()
+    ttfts = [r["ttft"] for r in records if r["ttft"] is not None]
+    return {
+        "tokens": sum(r["n"] for r in records),
+        "errors": sum(1 for r in records if r["error"]),
+        "wall_s": round(wall, 4),
+        "ttft_p50_s": round(_percentile(ttfts, 0.5), 5) if ttfts else None,
+        "handoff": {k.split("serving.handoff.")[1]: c1[k] - c0[k]
+                    for k in c0},
+    }
+
+
 def _fleet_block():
     try:
         from paddle_tpu.observability import fleet as _fleet
@@ -327,6 +374,10 @@ def run_bench(quick=False, seed=0):
     base = _run_mode(model, "baseline", knobs, seed, vocab)
     pipe = _run_mode(model, "pipelined", knobs, seed, vocab)
     telemetry = _telemetry_snapshot(model, knobs, seed, vocab)
+    try:
+        disagg = _disagg_block(model, knobs, seed, vocab)
+    except Exception as e:  # noqa: BLE001 — informational block only
+        disagg = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
     speedup = pipe["tokens_per_sec"] / max(base["tokens_per_sec"], 1e-9)
     b_ttft = base.get("ttft_under_prefill_p50_s") or 0.0
     p_ttft = pipe.get("ttft_under_prefill_p50_s") or 0.0
@@ -363,6 +414,10 @@ def run_bench(quick=False, seed=0):
             # ISSUE 11 satellite: cluster health per run — snapshot
             # count, worst cross-rank phase skew, straggler verdicts
             "fleet": _fleet_block(),
+            # ISSUE 16 extra: one role-split (prefill/decode) pass with
+            # handoff counter deltas — informational; the headline
+            # numbers above stay on the blended path
+            "disagg": disagg,
         },
     }
 

@@ -17,6 +17,12 @@ from ..engine import Finding, rule
 
 PKG = "paddle_tpu/distributed/checkpoint/"
 
+#: files OUTSIDE the checkpoint package that carry the same torn-file
+#: obligation: a KV-page handoff bundle is adopted by another process's
+#: replica mid-request, so its writes need the identical temp+fsync+rename
+#: discipline (ISSUE 16)
+ATOMIC_WRITE_PATHS = (PKG, "paddle_tpu/serving/handoff.py")
+
 _MODE = re.compile(r"[rwaxbtU+]{1,4}\Z")
 
 
@@ -39,11 +45,12 @@ def _mode_of(call):
 
 @rule("ckpt-atomic-write",
       markers=("ckpt-atomic-ok",),
-      description="checkpoint-directory writes go through "
-                  "checkpoint/atomic.py (temp+fsync+rename)")
+      description="checkpoint-directory writes (and handoff bundle "
+                  "writes) go through checkpoint/atomic.py "
+                  "(temp+fsync+rename)")
 def ckpt_atomic_write(index):
     findings = []
-    for fi in index.iter_files(PKG):
+    for fi in index.iter_files(ATOMIC_WRITE_PATHS):
         for node in ast.walk(fi.tree):
             if not isinstance(node, ast.Call):
                 continue

@@ -26,6 +26,11 @@ DECODE_CRITICAL = {
     "paddle_tpu/inference/continuous.py": {
         "step", "_dispatch_decode", "_process_block", "_advance_prefill",
         "drain",
+        # disaggregation (ISSUE 16): adopting a handed-off request inserts
+        # pages on the decode replica's dispatch path — it must stay as
+        # host-sync-free as any other admission (jnp.asarray uploads only;
+        # the key_base rebuild is the one designated readback)
+        "adopt_request",
     },
 }
 

@@ -227,6 +227,17 @@ class CollectiveController:
             if (getattr(args, "hang_deadline", 0) or 0) > 0 \
                     or env_bool("PADDLE_TELEMETRY"):
                 env["PADDLE_TELEMETRY_DIR"] = self.telemetry_dir
+            # disaggregated serving plumbing (ISSUE 16): serving workers in
+            # a launched pod inherit the operator's disaggregation switch
+            # and handoff-transport knobs — the spool dir in particular
+            # must be SHARED across the pod's replicas or no bundle is
+            # ever adopted. Forwarded only when set: defaults stay defaults
+            for k in ("PADDLE_SERVING_DISAGG", "PADDLE_HANDOFF_DIR",
+                      "PADDLE_HANDOFF_DEADLINE_S", "PADDLE_HANDOFF_RETRIES",
+                      "PADDLE_HANDOFF_BACKOFF_S"):
+                v = os.environ.get(k)
+                if v is not None:
+                    env[k] = v
             if args.devices:
                 env["FLAGS_selected_devices"] = args.devices
             log = os.path.join(args.log_dir, f"workerlog.{rank}")

@@ -474,6 +474,10 @@ class ContinuousBatchingEngine:
         self._last_dispatch_cold = False  # last _locked_dispatch traced?
         self._prefilling = {}       # slot -> _PrefillState (chunked prefill)
         self._inflight = None       # the ONE in-flight _InflightBlock
+        # requests retired while an out-of-band caller (export_pages'
+        # _settle_inflight) processed the in-flight block: step() returns
+        # them on its next call so the frontend still finishes every handle
+        self._pending_retired = []
         self.enable_prefix_cache = bool(enable_prefix_cache)
         if self.enable_prefix_cache and kv_cache_dtype == "int8":
             # a shared prefix would be re-read through the lossy int8
@@ -1176,7 +1180,7 @@ class ContinuousBatchingEngine:
 
     def idle(self):
         return (not self._active and not self._prefilling
-                and self._inflight is None)
+                and self._inflight is None and not self._pending_retired)
 
     def active_count(self):
         # mid-chunked-prefill requests occupy slots too — the router's
@@ -1185,6 +1189,134 @@ class ContinuousBatchingEngine:
 
     def has_free_slot(self):
         return bool(self.free_slots)
+
+    def active_prefills(self):
+        """Mid-chunked-prefill slot count — the brownout ladder's
+        ``shed_prefill_depth`` rung caps this before shedding requests,
+        and the frontend's role-aware pressure split reads it."""
+        return len(self._prefilling)
+
+    # ---- disaggregated prefill/decode handoff hooks (ISSUE 16) ------------
+    # A prefill-role replica produces a request's first tokens, then the
+    # frontend exports its KV pages, publishes a handoff bundle
+    # (serving/handoff.py), detaches the request WITHOUT finishing its
+    # handle, and a decode-role replica adopts the pages into its own pool
+    # and continues bit-identically. All three hooks run on the owning
+    # dispatcher thread (the engine's single-threaded contract).
+
+    def _settle_inflight(self):
+        """Read back the in-flight decode block NOW (instead of at the next
+        step()) so every active request's emitted tokens equal its
+        dispatched tokens — the consistency an exported bundle needs.
+        Requests that retire during the readback are queued for the next
+        step() to return, so the frontend still sees them finish."""
+        rec = self._inflight
+        if rec is not None:
+            self._inflight = None
+            self._pending_retired.extend(self._process_block(rec))
+
+    def export_pages(self, slot):
+        """Gather ``slot``'s KV pages to the host for a handoff bundle:
+        ``{"n_pages", "ks", "vs"}`` with dense ``[L, n*bs, Hkv, D]``
+        arrays (the prefix-cache gather, reused — float pools only; int8
+        export raises and the caller degrades to blended). Returns None
+        when the request finished while the in-flight block settled —
+        nothing left to hand off. Prefill-side only: the host sync here is
+        deliberate and NOT part of any decode critical section."""
+        self._settle_inflight()
+        req = self._active.get(slot)
+        if req is None or req.finished:
+            return None
+        n = len(req.pages)
+        ks, vs = self._gather_prefix(n)(
+            tuple(self.pools), jnp.asarray(req.pages, jnp.int32))
+        return {"n_pages": n, "ks": np.asarray(ks), "vs": np.asarray(vs)}
+
+    def detach_request(self, slot):
+        """Release ``slot`` WITHOUT finishing the request's handle: the
+        request now lives in its published bundle and the adopting decode
+        replica continues it. Frees the slot and pages exactly like
+        _retire but leaves the EngineRequest unfinished (tokens,
+        dispatch count, and key stream intact for the adopter). Call only
+        after export_pages() in the same dispatcher turn — no step() may
+        run in between, or the detached bundle goes stale."""
+        req = self._active.pop(slot)
+        self._unref_pages(req.pages)
+        self.free_slots.append(slot)
+        self.page_table[slot] = 0
+        self.lengths[slot] = 0
+        req.pages = []
+        req.slot = None
+        if not self._active and not self._prefilling:
+            self._active_sampling = None
+        return req
+
+    def adopt_request(self, req, payloads):
+        """Admission twin for a handed-off request: scatter its exported
+        page payloads into this pool and register it mid-decode. ``req``
+        already carries the bundle's validated continuation state (tokens,
+        n_dispatched, last_token). Returns "admitted" / "deferred" /
+        "failed" with try_admit_one's exact semantics. Restores the decode
+        invariant ``lengths[slot] = len(prompt) + n_dispatched - 1`` so
+        the next decode block's positions — and with the replayed key
+        stream, its tokens — are bit-identical to never having moved.
+        Adopted pages are private (never prefix-indexed): their digests
+        were validated against the bundle, not against this pool's index."""
+        if not self.free_slots:
+            return "deferred"
+        if (self._active or self._prefilling) \
+                and self._active_sampling != req.sampling:
+            return "deferred"
+        n = int(payloads["n_pages"])
+        if n > self.pages_per_seq:
+            self._fail_request(req, ValueError(
+                f"request {req.rid}: handoff bundle spans {n} pages, "
+                f"page table holds {self.pages_per_seq}"))
+            return "failed"
+        if n > self._available_pages():
+            if not self._active and not self._prefilling:
+                self._fail_request(req, RuntimeError(
+                    f"request {req.rid}: handoff bundle needs {n} pages, "
+                    f"idle pool has {self._available_pages()}"))
+                return "failed"
+            self.stats["deferred_admissions"] += 1
+            return "deferred"
+        slot = self.free_slots.pop()
+        pages = self._alloc_pages(n)
+        self._ref_pages(pages)
+        self.stats["peak_pages"] = max(self.stats["peak_pages"],
+                                       self._pages_in_use)
+        bucket = n * self.page_size
+        try:
+            with self._locked_dispatch(("insert", bucket)), \
+                    _trace.span("serve.adopt"), self._xprof_annotation(req):
+                chaos.site("serve.prefill")
+                self.pools = list(self._insert(bucket)(
+                    tuple(self.pools), jnp.asarray(payloads["ks"]),
+                    jnp.asarray(payloads["vs"]),
+                    jnp.asarray(pages, jnp.int32)))
+        except Exception as e:  # fail THIS request alone, free everything
+            self._unref_pages(pages)
+            self.free_slots.append(slot)
+            self._fail_request(req, e)
+            return "failed"
+        if req.sampling[0] and req.key_base is None:
+            # same (seed, rid)-only stream root the prefill side used — an
+            # 8-byte pull at adoption time, before any decode dispatch
+            req.key_base = np.asarray(jax.random.fold_in(  # serve-readback-ok
+                jax.random.PRNGKey(req.seed), req.rid))
+        row = np.zeros(self.pages_per_seq, np.int32)
+        row[:n] = pages
+        self.page_table[slot] = row
+        self.lengths[slot] = len(req.prompt) + req.n_dispatched - 1
+        req.pages = pages
+        req.slot = slot
+        if req.t_admit is None:
+            req.t_admit = time.monotonic()
+        self._active[slot] = req
+        self._active_sampling = req.sampling
+        self._update_gauges()
+        return "admitted"
 
     def _refresh_cache_guard(self, state):
         """Cached prefix KV is only valid under the weights it was computed
@@ -1658,7 +1790,11 @@ class ContinuousBatchingEngine:
         the new tenant's prefill/decode before it is ever read). The sync
         path (``async_decode=False``) dispatches and reads back in one
         call — the pre-pipeline behavior, kept as the bench baseline."""
-        retired = []
+        # requests that retired under an out-of-band _settle_inflight
+        # readback surface here, so the frontend's step-driven finish path
+        # sees every terminal request exactly once
+        retired = self._pending_retired
+        self._pending_retired = []
         # cancellation sweep first: no decode/prefill compute for a dead
         # request
         for slot in list(self._active):
@@ -1885,7 +2021,8 @@ class ContinuousBatchingEngine:
         and the escape hatch before calling batch serve() on an engine that
         still has online work in flight."""
         out = []
-        while self._active or self._prefilling or self._inflight is not None:
+        while (self._active or self._prefilling
+               or self._inflight is not None or self._pending_retired):
             out.extend(self.step())
         return out
 

@@ -1292,6 +1292,51 @@ def serving_rollup(replica_snapshots, slo_report, goodput_report):
     _registry.gauge(
         "fleet.serving.pressure",
         help="blended autoscaling pressure signal (0..1)").set(pressure)
+    # per-role sub-rollup (ISSUE 16): a disaggregated fleet's prefill and
+    # decode pools saturate independently, so each role gets its own
+    # pressure + scale_hint — the supervisor scales the pools off these,
+    # and a blended mean can no longer hide one saturated pool behind the
+    # other's idle slots. Homogeneous fleets roll up as one "blended" role.
+    by_role = {}
+    for s in replica_snapshots.values():
+        role = s.get("role") or "blended"
+        r = by_role.setdefault(role, {"replicas": 0, "live": 0,
+                                      "queue_depth": 0, "occs": [],
+                                      "slots": 0})
+        r["replicas"] += 1
+        r["queue_depth"] += s.get("pending") or 0
+        max_seqs = s.get("max_seqs") or 0
+        if s.get("state") == "LIVE":
+            r["live"] += 1
+            if max_seqs:
+                r["occs"].append((s.get("active") or 0) / max_seqs)
+                r["slots"] += max_seqs
+    roles = {}
+    for role, r in sorted(by_role.items()):
+        occ = (round(sum(r["occs"]) / len(r["occs"]), 4)
+               if r["occs"] else 0.0)
+        qp = (min(1.0, r["queue_depth"] / r["slots"]) if r["slots"]
+              else (1.0 if r["queue_depth"] else 0.0))
+        p = round(max(occ, qp), 4)
+        if alerts or (r["live"] == 0 and r["replicas"]):
+            hint = "grow"
+        elif p > 0.85:
+            hint = "grow"
+        elif p < 0.15 and r["live"] > 1 and worst_burn < 1.0:
+            hint = "shrink"
+        else:
+            hint = "hold"
+        _registry.gauge(
+            "serving.role.pressure", labels={"role": role},
+            help="per-role autoscaling pressure (0..1) — prefill/decode "
+                 "pools saturate independently").set(p)
+        _registry.gauge(
+            "serving.role.live_replicas", labels={"role": role},
+            help="LIVE replicas per disaggregation role").set(r["live"])
+        roles[role] = {"replicas": r["replicas"], "live": r["live"],
+                       "queue_depth": r["queue_depth"],
+                       "occupancy_mean": occ, "pressure": p,
+                       "scale_hint": hint}
     return {
         "replicas": len(replica_snapshots),
         "live_replicas": live,
@@ -1306,4 +1351,5 @@ def serving_rollup(replica_snapshots, slo_report, goodput_report):
         },
         "pressure": pressure,
         "scale_hint": scale_hint,
+        "roles": roles,
     }

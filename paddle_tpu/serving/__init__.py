@@ -31,15 +31,22 @@ Layering (each file is one concern, unit-testable alone):
 - ``supervisor.py``— the self-healing actor (ISSUE 12 tentpole):
   replaces dead replicas (per-domain restart budget + backoff +
   generation fencing) and autoscales the fleet from the PR-11
-  pressure/scale_hint rollup, always via drain(). Default-off
-  (``PADDLE_SUPERVISOR``): zero threads unless armed.
+  pressure/scale_hint rollup — per disaggregation role (ISSUE 16) —
+  always via drain(). Default-off (``PADDLE_SUPERVISOR``): zero threads
+  unless armed.
+- ``handoff.py``   — disaggregated prefill/decode KV-page handoff
+  (ISSUE 16): atomic validated bundles, generation fencing, bounded
+  publish retry, and the blended degradation contract (a handoff failure
+  costs latency, never a wrong token and never availability).
 
 Chaos sites ``serving.route`` / ``serving.replica_kill`` /
 ``serving.replica_slow`` / ``serving.spawn_fail`` / ``supervisor.decision``
+/ ``serving.handoff.send`` / ``serving.handoff.adopt`` /
+``serving.handoff.corrupt`` / ``serving.decode_pool_empty``
 make the failure paths deterministically testable (tests/
-test_serving_frontend.py, tests/test_supervisor.py). docs/SERVING.md is
-the operator guide; every later serving PR (multi-model, disaggregated
-prefill) builds on this subsystem.
+test_serving_frontend.py, tests/test_supervisor.py, tests/test_disagg.py).
+docs/SERVING.md is the operator guide; every later serving PR
+(multi-model) builds on this subsystem.
 """
 from ..inference.continuous import EngineRequest, canonical_sampling  # noqa: F401
 from .breaker import BreakerPolicy, CircuitBreaker  # noqa: F401
@@ -59,6 +66,13 @@ from .frontend import (  # noqa: F401
     RequestHandle,
     ResultTimeout,
     ServingFrontend,
+)
+from .handoff import (  # noqa: F401
+    HandoffBundle,
+    HandoffCorruptError,
+    HandoffError,
+    HandoffManager,
+    StaleHandoffError,
 )
 from .router import (  # noqa: F401
     DEAD,
@@ -90,4 +104,6 @@ __all__ = [
     "BrownoutLadder", "BrownoutStep", "RetryBudget",
     "CircuitBreaker", "BreakerPolicy",
     "ReplicaSupervisor", "ReplicaFence",
+    "HandoffManager", "HandoffBundle", "HandoffError",
+    "HandoffCorruptError", "StaleHandoffError",
 ]

@@ -7,18 +7,24 @@ slope between those extremes — *declared* degradation steps that buy
 capacity back gradually, cheapest-first, and release in reverse order as
 pressure drains:
 
-    level 0  normal        full service
-    level 1  clamp_tokens  batch-class max_new_tokens clamped (bounded
-                           decode work per batch request)
-    level 2  shed_extras   optional work off: hedged/speculative extras
-                           are declared disabled (``extras_enabled()``),
-                           the router skips the O(prompt-bytes) prefix-
-                           affinity probe and places by load alone, and
-                           no per-request traces are minted
-    level 3  shed_batch    batch-class submits rejected with a
-                           machine-readable ``Overloaded(retry_after_s=)``;
-                           interactive still served
-    level 4  reject        everything rejected with ``Overloaded``
+    level 0  normal             full service
+    level 1  shed_prefill_depth concurrent chunked prefills capped
+                                (``prefill_depth_cap()``) — new prompt
+                                work queues a little so in-flight decode
+                                keeps its TPOT; nothing is rejected
+    level 2  clamp_tokens       batch-class max_new_tokens clamped
+                                (bounded decode work per batch request)
+    level 3  shed_extras        optional work off: hedged/speculative
+                                extras are declared disabled
+                                (``extras_enabled()``), the router skips
+                                the O(prompt-bytes) prefix-affinity probe
+                                and places by load alone, and no
+                                per-request traces are minted
+    level 4  shed_batch         batch-class submits rejected with a
+                                machine-readable
+                                ``Overloaded(retry_after_s=)``;
+                                interactive still served
+    level 5  reject             everything rejected with ``Overloaded``
 
 Engagement is pressure-driven with hysteresis: a step engages the moment
 pressure crosses its ``engage_at`` (climbing one rung per observation so
@@ -49,9 +55,10 @@ from ..observability.metrics import registry as _registry
 from .scheduler import Overloaded
 
 __all__ = ["BrownoutStep", "BrownoutLadder", "RetryBudget",
-           "DEFAULT_STEPS", "CLAMP_TOKENS", "SHED_EXTRAS", "SHED_BATCH",
-           "REJECT"]
+           "DEFAULT_STEPS", "SHED_PREFILL_DEPTH", "CLAMP_TOKENS",
+           "SHED_EXTRAS", "SHED_BATCH", "REJECT"]
 
+SHED_PREFILL_DEPTH = "shed_prefill_depth"
 CLAMP_TOKENS = "clamp_tokens"
 SHED_EXTRAS = "shed_extras"
 SHED_BATCH = "shed_batch"
@@ -83,6 +90,11 @@ class BrownoutStep:
 
 
 DEFAULT_STEPS = (
+    # cheapest rung first (ISSUE 16): capping concurrent chunked prefills
+    # costs only prompt-admission latency — decode TPOT and every already-
+    # admitted request are untouched — so it engages well before anything
+    # that clamps or rejects
+    BrownoutStep(SHED_PREFILL_DEPTH, engage_at=0.72, release_at=0.55),
     BrownoutStep(CLAMP_TOKENS, engage_at=0.80, release_at=0.60),
     BrownoutStep(SHED_EXTRAS, engage_at=0.88, release_at=0.70),
     BrownoutStep(SHED_BATCH, engage_at=0.94, release_at=0.78),
@@ -226,6 +238,20 @@ class BrownoutLadder:
         """False from ``shed_extras`` up: hedged/speculative extras,
         affinity probing, and per-request trace minting are off."""
         return not self._engaged_at_least(SHED_EXTRAS)
+
+    def prefill_depth_cap(self):
+        """Max concurrent chunked prefills per replica (None = uncapped):
+        from ``shed_prefill_depth`` up, a replica already advancing
+        ``prefill_depth_cap`` prompts defers admitting new prefill work,
+        so queued prompts trade a little admission latency for the
+        in-flight requests' decode cadence. The cap halves at each deeper
+        rung (floor 1) — deeper brownout serializes prefills entirely."""
+        if not self._engaged_at_least(SHED_PREFILL_DEPTH):
+            return None
+        for i, s in enumerate(self.steps):
+            if s.name == SHED_PREFILL_DEPTH:
+                return max(1, 2 >> (self._level - i - 1))
+        return None
 
     def check_admission(self, slo, reserve_class):
         """Raise the machine-readable Overloaded for classes the current

@@ -57,8 +57,16 @@ from ..observability import tracing as _tracing
 from ..observability.metrics import registry as _registry
 from ..observability.slo import SLOMonitor
 from ..testing import chaos
+from ..utils.envs import env_bool
 from .breaker import CircuitBreaker
 from .brownout import BrownoutLadder
+from .handoff import (
+    HandoffBundle,
+    HandoffError,
+    HandoffManager,
+    StaleHandoffError,
+    page_digests,
+)
 from .router import (
     ADMITTING,
     DEAD,
@@ -100,6 +108,19 @@ _M_CLAMPED = _registry.counter(
     "brownout.tokens_clamped",
     help="batch-class submits whose max_new_tokens the brownout ladder "
          "clamped")
+_M_HANDOFF_INITIATED = _registry.counter(
+    "serving.handoff.initiated",
+    help="prefill->decode KV-page handoffs initiated (bundle published and "
+         "the request detached from its prefill replica)")
+
+
+def _count_handoff_fallback(reason):
+    """One rung of the degradation ladder fired: the request completes in
+    blended mode instead of disaggregating (availability over perf)."""
+    _registry.counter(
+        "serving.handoff.fallback", labels={"reason": reason},
+        help="requests that fell back to blended completion instead of a "
+             "prefill->decode handoff, by reason").inc()
 
 
 class RequestFailed(RuntimeError):
@@ -124,7 +145,9 @@ class _Entry:
 
     __slots__ = ("req", "handle", "slo", "deadline_t", "virtual_deadline",
                  "observed", "route_affinity", "route_score", "probe",
-                 "trace", "attempt_span", "queue_span", "attempt_n")
+                 "trace", "attempt_span", "queue_span", "attempt_n",
+                 "target_role", "needs_handoff", "handoff_gen",
+                 "bundle_path", "bundle")
 
     def __init__(self, req, handle, slo, deadline_t, virtual_deadline):
         self.req = req
@@ -143,6 +166,15 @@ class _Entry:
         self.attempt_span = None
         self.queue_span = None
         self.attempt_n = 0
+        # disaggregated prefill/decode handoff state (ISSUE 16): the role the
+        # router should prefer, whether the prefill side still owes a KV-page
+        # handoff, the generation fence that drops superseded bundles, and
+        # the published bundle awaiting adoption (path on disk / loaded copy)
+        self.target_role = None
+        self.needs_handoff = False
+        self.handoff_gen = 0
+        self.bundle_path = None
+        self.bundle = None
 
 
 class RequestHandle:
@@ -344,7 +376,8 @@ class ServingFrontend:
                  monitor_interval_s=None, heartbeat_misses=3,
                  brownout=None, breaker=None, engine_factory=None,
                  start=True, warmup=None,
-                 slo_monitor=None, statusz_port=None):
+                 slo_monitor=None, statusz_port=None,
+                 roles=None, handoff=None):
         # heartbeat_deadline_s must outlast the longest single engine call —
         # a first-compile prefill through a remote-compile tunnel can take
         # tens of seconds (PROFILE.md), and a false DEAD verdict reroutes a
@@ -367,8 +400,22 @@ class ServingFrontend:
         # wake event, so the only reason to wake at all is the heartbeat;
         # capped well under the deadline so idleness never reads as death
         self.idle_wait_s = min(1.0, self.heartbeat_deadline_s / 4)
-        self.replicas = [ReplicaHandle(f"replica{i}", eng, index=i)
-                         for i, eng in enumerate(engines)]
+        # disaggregated prefill/decode (ISSUE 16): ``roles`` assigns each
+        # engine a pool ("prefill"/"decode"/"blended", default blended);
+        # PADDLE_SERVING_DISAGG=0 force-disables the handoff path so a
+        # roled fleet serves every request blended (byte-for-byte the
+        # pre-disaggregation behavior — the keystone degradation switch)
+        if roles is not None and len(roles) != len(engines):
+            raise ValueError(
+                f"roles has {len(roles)} entries for {len(engines)} engines")
+        self.replicas = [
+            ReplicaHandle(f"replica{i}", eng, index=i,
+                          role=(roles[i] if roles else "blended"))
+            for i, eng in enumerate(engines)]
+        self._disagg_enabled = env_bool("PADDLE_SERVING_DISAGG", True)
+        # KV-page handoff transport (spool dir + deadline/retry policy);
+        # injectable for tests, env-tuned by default (PADDLE_HANDOFF_*)
+        self.handoff = handoff or HandoffManager()
         self._by_name = {r.name: r for r in self.replicas}
         self._lock = threading.Lock()
         self._rid_counter = itertools.count()
@@ -469,6 +516,9 @@ class ServingFrontend:
                 rep.pending = []
                 rep.inflight = {}
         for e in orphans:
+            if e.bundle_path is not None:
+                self.handoff.discard(e.bundle_path)
+                e.bundle_path = None
             e.handle._fail("frontend shut down")
 
     def __enter__(self):
@@ -527,6 +577,21 @@ class ServingFrontend:
         entry = _Entry(req, handle, slo, deadline_t,
                        self.scheduler.virtual_deadline(
                            req.t_enqueue, slo, deadline_s))
+        # disaggregated placement (ISSUE 16): with a roled fleet and a live
+        # decode pool, the request targets the prefill pool and owes a
+        # KV-page handoff after its first token. Token delivery is
+        # suppressed until the decode side replays the bundle — satellite
+        # fix: TTFT must span prefill queue wait + handoff transfer, so the
+        # first client-visible token is stamped at decode-side delivery.
+        # An empty/all-PROBATION decode pool degrades to blended here and
+        # at every later checkpoint (availability over disaggregation).
+        if self._disagg_active():
+            if self._decode_pool_live():
+                entry.target_role = "prefill"
+                entry.needs_handoff = True
+                req.on_token = None
+            else:
+                _count_handoff_fallback("decode_pool_empty")
         # advisory fast-path shed (unlocked reads): overload traffic must
         # not pay the placement probe per rejected submit. The
         # authoritative check re-runs under the append lock below.
@@ -608,6 +673,213 @@ class ServingFrontend:
             handle._push_token(tok, gen)
         return on_token
 
+    # ---- disaggregated prefill/decode (ISSUE 16) --------------------------
+    def _disagg_active(self):
+        """Handoffs happen only when the operator both enabled them
+        (PADDLE_SERVING_DISAGG, default on) and gave the fleet a prefill
+        pool. With neither, every path below is dead code and blended
+        serving is byte-for-byte the pre-disaggregation behavior."""
+        return self._disagg_enabled and any(
+            r.role == "prefill" and r.state in ADMITTING
+            for r in self.replicas)
+
+    def _decode_pool_live(self):
+        """True when at least one decode-role replica is LIVE. The
+        ``serving.decode_pool_empty`` chaos seam sits on the check itself:
+        an injected fault here declares the pool empty, which is exactly
+        the degradation drill (blended completion, nothing lost)."""
+        try:
+            chaos.site("serving.decode_pool_empty")
+        except Exception:
+            return False
+        return any(r.role == "decode" and r.state == LIVE
+                   for r in self.replicas)
+
+    def _handoff_fallback(self, entry, reason):
+        """Blended completion for a request that was slated for handoff:
+        deliver the suppressed tokens to the handle (the client's first
+        token is NOW — TTFT is delivery-time, satellite 2) and stream
+        normally from here. The request just keeps decoding wherever it
+        already is; nothing was detached, so nothing can be lost."""
+        _count_handoff_fallback(reason)
+        req = entry.req
+        entry.needs_handoff = False
+        entry.target_role = None
+        req.on_token = self._make_on_token(entry.handle, entry.handle._gen)
+        if req.t_first_token is not None:
+            req.t_first_token = time.monotonic()
+        for tok in req.tokens[len(req.prompt):]:
+            req.on_token(req.rid, tok)
+        self._observe_admission(entry)
+
+    def _initiate_handoffs(self, rep):
+        """Prefill-side dispatcher hook: every in-flight request that has
+        its first token and still owes a handoff gets one initiated."""
+        with self._lock:
+            candidates = [e for e in rep.inflight.values()
+                          if e.needs_handoff
+                          and e.req.t_first_token is not None
+                          and not e.req.finished and not e.req.cancelled]
+        moved = False
+        for entry in candidates:
+            moved |= self._initiate_handoff(rep, entry)
+        return moved
+
+    def _initiate_handoff(self, rep, entry):
+        """Export the request's KV pages, publish the bundle, detach the
+        request from the prefill engine, and requeue it toward the decode
+        pool. Every failure BEFORE the detach degrades to blended (the
+        request keeps decoding right here — handoff is a perf win, never
+        an availability loss); after the detach the bundle on disk is the
+        request, and the adopt path owns every failure from there."""
+        eng, req = rep.engine, entry.req
+        if not self._decode_pool_live():
+            self._handoff_fallback(entry, "decode_pool_empty")
+            return False
+        span = None
+        if entry.attempt_span is not None:
+            span = entry.attempt_span.child("handoff", rid=req.rid,
+                                            generation=entry.handoff_gen)
+        try:
+            payloads = eng.export_pages(req.slot)
+        except Exception as e:
+            if span is not None:
+                span.end("error", error=f"{type(e).__name__}: {e}")
+            self._handoff_fallback(entry, "export_failed")
+            return False
+        if payloads is None:
+            # finished (or was retired) while settling the in-flight block:
+            # nothing to hand off — _finish delivers the suppressed tokens
+            if span is not None:
+                span.end("skipped", reason="request already finished")
+            return False
+        n_pages = payloads["n_pages"]
+        bundle = HandoffBundle(
+            rid=req.rid, seed=req.seed, sampling=req.sampling,
+            prompt=req.prompt, tokens=list(req.tokens[len(req.prompt):]),
+            n_generated=req.n_generated, n_dispatched=req.n_dispatched,
+            max_new_tokens=req.max_new_tokens,
+            eos_token_id=req.eos_token_id, timeout_s=req.timeout_s,
+            payloads=payloads,
+            digests=page_digests(req.prompt, eng.page_size,
+                                 min(n_pages, len(req.prompt)
+                                     // eng.page_size)),
+            page_size=eng.page_size, generation=entry.handoff_gen)
+        try:
+            path = self.handoff.publish(bundle)
+        except Exception as e:
+            # deadline/retries exhausted: nothing was detached, so the
+            # request simply keeps decoding here in blended mode
+            if span is not None:
+                span.end("error", error=f"{type(e).__name__}: {e}")
+            self._handoff_fallback(entry, "publish_failed")
+            return False
+        eng.detach_request(req.slot)
+        with self._lock:
+            rep.inflight.pop(req.rid, None)
+        entry.needs_handoff = False
+        entry.bundle_path = path
+        entry.target_role = "decode"
+        _M_HANDOFF_INITIATED.inc()
+        if span is not None:
+            span.end("ok", n_pages=n_pages,
+                     n_tokens=len(bundle.tokens))
+        # close the prefill attempt as handed off so _requeue's reroute
+        # edge (the satellite's "attempt edge") is the only event stamped
+        self._trace_attempt_end(entry, "handed_off",
+                                reason="kv pages published to decode pool")
+        self._requeue(entry, exclude=set(),
+                      fail_reason="handoff to decode pool",
+                      rerouted=False)
+        return True
+
+    def _adopt_one(self, rep, entry):
+        """Decode-side admission for a bundle-carrying entry. Returns the
+        try_admit_one status vocabulary ("admitted"/"deferred"/"failed")
+        plus "requeued" when a corrupt/stale bundle sent the request back
+        for a re-prefill. The spool file is consumed on first load; a
+        deferred adopt keeps the validated bundle in memory and retries
+        without re-reading."""
+        eng, req = rep.engine, entry.req
+        bundle = entry.bundle
+        if bundle is None:
+            try:
+                bundle = self.handoff.load(
+                    entry.bundle_path,
+                    expected_generation=entry.handoff_gen)
+            except StaleHandoffError as e:
+                # a superseded prefill's late bundle: drop it, re-prefill
+                entry.bundle_path = None
+                self._reprefill(entry, f"stale handoff bundle: {e}")
+                return "requeued"
+            except HandoffError as e:
+                # torn/corrupt (or unreadable) bundle: the typed-error
+                # contract — never adopt, never a wrong token; re-prefill
+                entry.bundle_path = None
+                self._reprefill(entry, f"handoff bundle rejected: {e}")
+                return "requeued"
+            entry.bundle = bundle
+            entry.bundle_path = None
+            # restore the continuation state from the VALIDATED bundle (not
+            # from whatever the prefill side last mutated in memory): the
+            # decode replica replays exactly what was committed to disk
+            req.tokens = list(req.prompt) + list(bundle.tokens)
+            req.n_generated = bundle.n_generated
+            req.n_dispatched = bundle.n_dispatched
+            if bundle.tokens:
+                req.last_token = bundle.tokens[-1]
+        status = eng.adopt_request(req, bundle.payloads)
+        if status == "admitted":
+            entry.bundle = None
+            # deliver the prefill-side tokens NOW: the client's first token
+            # lands here, so serving.ttft_s spans prefill queue wait +
+            # transfer + adopt (the satellite-2 histogram contract), and
+            # the stream continues seamlessly from the engine's next block
+            gen = entry.handle._gen
+            req.on_token = self._make_on_token(entry.handle, gen)
+            req.t_first_token = time.monotonic()
+            for tok in bundle.tokens:
+                req.on_token(req.rid, tok)
+        elif status == "failed":
+            entry.bundle = None
+        return status
+
+    def _reprefill(self, entry, reason):
+        """A handoff failed en route to (or at) the decode pool: clone the
+        request and run the prefill again — bit-identical output, because
+        the sampled key stream depends only on (seed, rid, index). The
+        generation fence bumps so any late bundle from the superseded
+        attempt is stale on arrival. After repeated handoff failures the
+        request stops disaggregating and completes blended."""
+        handle = entry.handle
+        if entry.req.cancelled or handle._cancel_requested:
+            _M_CANCELLED.inc()
+            handle._cancelled_now()
+            return
+        gen = handle._reset_for_reroute()
+        if gen is None:
+            # stream already consumed — a replayed stream would splice
+            _M_FAILED.inc()
+            handle._fail(reason)
+            return
+        entry.observed = False
+        entry.req = entry.req.clone_for_retry()
+        entry.handoff_gen += 1
+        entry.bundle = None
+        entry.bundle_path = None
+        if self._disagg_active() and entry.handoff_gen < 3 \
+                and self._decode_pool_live():
+            entry.needs_handoff = True
+            entry.target_role = "prefill"
+            entry.req.on_token = None
+        else:
+            _count_handoff_fallback("reprefill_blended")
+            entry.needs_handoff = False
+            entry.target_role = None
+            entry.req.on_token = self._make_on_token(handle, gen)
+        self._requeue(entry, exclude=set(), fail_reason=reason,
+                      rerouted=True)
+
     def _wake(self, name):
         # .get, not []: a remove_replica can race a late wake from a
         # request that finished on the removed replica
@@ -625,6 +897,9 @@ class ServingFrontend:
                 for i, e in enumerate(rep.pending):
                     if e.handle is handle:
                         rep.pending.pop(i)
+                        if e.bundle_path is not None:
+                            self.handoff.discard(e.bundle_path)
+                            e.bundle_path = None
                         _M_CANCELLED.inc()
                         handle._cancelled_now()
                         return
@@ -718,6 +993,10 @@ class ServingFrontend:
                                     if not e.observed]
                         for e in pend:
                             self._observe_admission(e)
+                    if rep.role == "prefill" and rep.inflight:
+                        # disaggregation (ISSUE 16): requests with a first
+                        # token owe their KV pages to the decode pool
+                        progressed |= self._initiate_handoffs(rep)
                     progressed = True
                 elif rep.state == DRAINING and not rep.inflight:
                     drained = self._drained.get(rep.name)
@@ -752,6 +1031,15 @@ class ServingFrontend:
     def _admit_pending(self, rep):
         eng, moved = rep.engine, False
         while rep.state in ADMITTING and eng.has_free_slot():
+            cap = self.brownout.prefill_depth_cap()
+            if cap is not None:
+                ap = getattr(eng, "active_prefills", None)
+                if ap is not None and ap() >= cap:
+                    # shed_prefill_depth rung (cheapest brownout step): a
+                    # replica already advancing `cap` chunked prefills
+                    # defers new admissions so in-flight decode keeps its
+                    # cadence; nothing is rejected, prompts just queue
+                    break
             with self._lock:
                 i = self.scheduler.pick(rep.pending)
                 if i is None:
@@ -766,6 +1054,9 @@ class ServingFrontend:
             if self.scheduler.expired(entry):
                 _M_EXPIRED.inc()
                 _M_FAILED.inc()
+                if entry.bundle_path is not None:
+                    self.handoff.discard(entry.bundle_path)
+                    entry.bundle_path = None
                 self.slo.observe_event(entry.slo.name, "deadline_miss", True)
                 entry.handle._fail(DeadlineExceeded(
                     f"request {entry.req.rid} ({entry.slo.name}) spent "
@@ -777,7 +1068,12 @@ class ServingFrontend:
             # somewhere sweepable (or hand it to the relocation path) before
             # giving up the thread, or its handle would hang forever
             try:
-                status = eng.try_admit_one(entry.req)
+                if entry.bundle_path is not None or entry.bundle is not None:
+                    # a handed-off request: adopt its KV-page bundle into
+                    # this replica's pool instead of prefilling from scratch
+                    status = self._adopt_one(rep, entry)
+                else:
+                    status = eng.try_admit_one(entry.req)
             except BaseException:
                 # the raise is about to reach _run_replica, whose handler
                 # calls _replica_died -> sweeps pending. That sweep is a
@@ -795,6 +1091,11 @@ class ServingFrontend:
                                               f"during admission: "
                                               f"{rep.death_reason}")
                 raise
+            if status == "requeued":
+                # corrupt/stale bundle: _adopt_one already sent the entry
+                # back through _requeue for a bit-identical re-prefill
+                moved = True
+                continue
             if status != "deferred" and entry.queue_span is not None:
                 # queueing ends the moment the engine resolved the
                 # admission (a deferred pick keeps waiting — span stays
@@ -866,6 +1167,14 @@ class ServingFrontend:
         # scan can see it — observe here (idempotent; skips entries that
         # never produced a first token)
         self._observe_admission(entry)
+        if entry.needs_handoff:
+            # finished before the handoff could initiate (short generation,
+            # eos at the first block): blended completion — deliver the
+            # suppressed tokens to the stream before the terminal transition
+            if req.error is None and not req.cancelled:
+                self._handoff_fallback(entry, "finished_on_prefill")
+            else:
+                entry.needs_handoff = False
         handle = entry.handle
         if req.error is not None:
             if entry.probe:
@@ -988,7 +1297,27 @@ class ServingFrontend:
         # (clone_for_retry's contract) — re-arm the once-only observation
         entry.observed = False
         entry.req = entry.req.clone_for_retry()
-        entry.req.on_token = self._make_on_token(entry.handle, gen)
+        # disaggregation (ISSUE 16): a dead replica invalidates whatever
+        # handoff state the entry carried — drop any unconsumed bundle and
+        # bump the generation fence so a superseded prefill's late bundle
+        # is stale on arrival, then re-arm the handoff if the fleet still
+        # disaggregates (else complete blended, tokens streaming normally)
+        if entry.bundle_path is not None:
+            self.handoff.discard(entry.bundle_path)
+        entry.bundle = None
+        entry.bundle_path = None
+        entry.handoff_gen += 1
+        if self._disagg_active() and entry.handoff_gen < 3 \
+                and self._decode_pool_live():
+            entry.needs_handoff = True
+            entry.target_role = "prefill"
+            entry.req.on_token = None
+        else:
+            if entry.needs_handoff or entry.target_role is not None:
+                _count_handoff_fallback("replica_died")
+            entry.needs_handoff = False
+            entry.target_role = None
+            entry.req.on_token = self._make_on_token(entry.handle, gen)
         self._requeue(entry, exclude={rep.name}, fail_reason=reason,
                       rerouted=True)
 
@@ -1131,17 +1460,32 @@ class ServingFrontend:
     def _pressure(self):
         """The brownout ladder's input: the fleet rollup's pressure blend
         (mean LIVE occupancy vs queue/slots) without the report machinery
-        — cheap enough for every monitor tick."""
-        occs, slots, queued = [], 0, 0
+        — cheap enough for every monitor tick. Computed PER ROLE and the
+        worst pool wins (ISSUE 16): a saturated prefill pool must engage
+        the shed rungs even when an idle decode pool would dilute a
+        fleet-wide mean to comfortable."""
+        worst = 0.0
+        for _, occs, slots, queued in self._pressure_by_role():
+            queue_pressure = (min(1.0, queued / slots) if slots
+                              else (1.0 if queued else 0.0))
+            occupancy = sum(occs) / len(occs) if occs else 0.0
+            worst = max(worst, occupancy, queue_pressure)
+        return worst
+
+    def _pressure_by_role(self):
+        """[(role, live_occupancies, live_slots, queued)] per replica role
+        — the shared accumulation under _pressure and the supervisor's
+        per-role scale pressure."""
+        by_role = {}
         for r in self.replicas:
+            occs, slots, queued = by_role.get(r.role, ([], 0, 0))
             queued += len(r.pending)
             if r.state == LIVE:
                 occs.append(r.engine.active_count() / r.engine.max_seqs)
                 slots += r.engine.max_seqs
-        queue_pressure = (min(1.0, queued / slots) if slots
-                          else (1.0 if queued else 0.0))
-        occupancy = sum(occs) / len(occs) if occs else 0.0
-        return max(occupancy, queue_pressure)
+            by_role[r.role] = (occs, slots, queued)
+        return [(role, occs, slots, queued)
+                for role, (occs, slots, queued) in by_role.items()]
 
     # ---- circuit breaking (ISSUE 12) --------------------------------------
     def _breaker_outcome(self, rep, entry, ok):
@@ -1182,19 +1526,22 @@ class ServingFrontend:
                           fail_reason=f"{rep.name} tripped: {reason}")
 
     # ---- fleet membership (ISSUE 12: the supervisor's spawn/retire) -------
-    def add_replica(self, engine, name=None, domain=None, fence=None):
+    def add_replica(self, engine, name=None, domain=None, fence=None,
+                    role="blended"):
         """Grow the pool by one replica (the supervisor's spawn path; also
         an ops hook). The dispatcher starts immediately when the frontend
         is running. ``domain`` groups replicas into failure domains for
         the supervisor's restart budgets; ``fence`` is the PR-9-contract
         generation fence rejecting a superseded incarnation's telemetry
-        writes."""
+        writes; ``role`` joins the replica to a disaggregation pool
+        ("prefill"/"decode"/"blended", ISSUE 16)."""
         with self._lock:
             if self._stop.is_set():
                 raise RuntimeError("frontend is shut down")
             idx = self._next_index
             self._next_index += 1
-            rep = ReplicaHandle(name or f"replica{idx}", engine, index=idx)
+            rep = ReplicaHandle(name or f"replica{idx}", engine, index=idx,
+                                role=role)
             if rep.name in self._by_name:
                 raise ValueError(f"replica name {rep.name!r} already exists")
             rep.domain = domain or rep.name
@@ -1315,6 +1662,11 @@ class ServingFrontend:
         if entry.observed:
             return  # once per admission (reroutes re-arm the flag so the
             # failover tail lands in the histograms)
+        if entry.needs_handoff or entry.bundle_path is not None \
+                or entry.bundle is not None:
+            return  # mid-handoff (satellite 2): the client has seen no
+            # token yet — TTFT is observed at decode-side delivery so the
+            # prefill queue wait AND the transfer land in the histogram
         if entry.req.t_first_token is None:
             return  # chunked prefill still streaming: no first token yet —
             # the dispatcher re-checks after every step()

@@ -83,10 +83,16 @@ class ReplicaHandle:
     ``last_beat`` (a monotonic float stamped only by the dispatcher and read
     by the monitor — a benign single-writer race)."""
 
-    def __init__(self, name, engine, index=0):
+    def __init__(self, name, engine, index=0, role="blended"):
         self.name = str(name)
         self.engine = engine
         self.index = int(index)
+        # disaggregated serving role (ISSUE 16): "prefill" replicas admit
+        # new prompts and hand finished prefills off, "decode" replicas
+        # adopt handed-off pages and stream tokens, "blended" replicas do
+        # both (the pre-disaggregation behavior, and the degradation
+        # target when a pool is sick)
+        self.role = str(role)
         self.state = LIVE
         self.pending = []          # routed Entry objects, scheduler-ordered
         self.inflight = {}         # rid -> Entry, admitted into the engine
@@ -234,6 +240,14 @@ class ReplicaHandle:
         queue = min(1.0, len(self.pending) / max(1, eng.max_seqs * 2))
         return (slots + pages + queue) / 3.0
 
+    def pool_headroom(self):
+        """Fraction of the KV pool still free (0..1) — the decode-pool
+        placement signal: an adopted request arrives with its full page
+        reservation already sized, so what matters is whether the pages
+        fit, not whether this replica has seen the prefix before."""
+        eng = self.engine
+        return 1.0 - eng.pages_in_use() / max(1, eng.num_pages - 1)
+
     def prefix_fraction(self, prompt):
         """Fraction of this prompt's full pages already indexed here.
         O(prompt bytes): the engine's prefix index is keyed by chained
@@ -244,6 +258,7 @@ class ReplicaHandle:
     def snapshot(self):
         return {
             "name": self.name,
+            "role": self.role,
             "state": self.state,
             "active": self.engine.active_count(),
             "max_seqs": self.engine.max_seqs,
@@ -271,13 +286,16 @@ class Router:
     HINT_TOKENS = 16
 
     def __init__(self, policy="prefix", affinity_weight=1.0, hint_weight=0.5,
-                 load_weight=1.0, max_hints=4096):
+                 load_weight=1.0, headroom_weight=1.0, max_hints=4096):
         if policy not in ("prefix", "round_robin", "load"):
             raise ValueError(f"unknown router policy {policy!r}")
         self.policy = policy
         self.affinity_weight = float(affinity_weight)
         self.hint_weight = float(hint_weight)
         self.load_weight = float(load_weight)
+        # decode-pool placement weight (ISSUE 16): free-page fraction of
+        # the candidate replica's KV pool — see place()'s role branch
+        self.headroom_weight = float(headroom_weight)
         self.max_hints = int(max_hints)
         self._hints = {}   # prefix-head bytes -> replica name (insertion LRU)
         self._rr = 0
@@ -307,19 +325,34 @@ class Router:
         reached, and the routing counters count real placements only."""
         chaos.site("serving.route")
         entry.probe = False
+        # role targeting (ISSUE 16): a disaggregated entry names the pool
+        # it needs ("prefill" before handoff, "decode" after); blended
+        # replicas serve either. The filter is a PREFERENCE, not a fence —
+        # when the targeted pool has no live replica the entry falls back
+        # to the whole live set (the frontend's degradation ladder already
+        # decided blended completion is acceptable before routing here).
+        role = getattr(entry, "target_role", None)
+
+        def _role_ok(r):
+            return role is None or r.role in (role, "blended")
+
         if self.breaker is not None:
             # half-open probes win over normal scoring: a PROBATION
             # replica only ever sees traffic through this rate-limited
             # path, and without it there is no recovery signal at all
             for r in replicas:
                 if r.state == PROBATION and r.name not in exclude \
-                        and self.breaker.allow_probe(r.name):
+                        and _role_ok(r) and self.breaker.allow_probe(r.name):
                     entry.probe = True
                     entry.route_affinity = False
                     entry.route_score = 0.0
                     return r
         live = [r for r in replicas
                 if r.state == LIVE and r.name not in exclude]
+        if role is not None:
+            in_role = [r for r in live if _role_ok(r)]
+            if in_role:
+                live = in_role
         if not live:
             raise NoLiveReplicas(
                 f"no LIVE replica for request {entry.req.rid} "
@@ -342,13 +375,22 @@ class Router:
                       else self._hints.get(self._hint_key(prompt)))
         best, best_score, best_aff = None, None, 0.0
         for r in live:
-            if self.policy == "load" or cheap:
+            if role == "decode":
+                # decode placement scores pool HEADROOM, not prefix
+                # affinity: the handed-off request brings its own KV —
+                # what matters is whether its page reservation fits
                 aff = hint = 0.0
+                score = (self.headroom_weight * r.pool_headroom()
+                         - self.load_weight * r.load())
             else:
-                aff = r.prefix_fraction(prompt)
-                hint = 1.0 if r.name == hinted else 0.0
-            score = (self.affinity_weight * aff + self.hint_weight * hint
-                     - self.load_weight * r.load())
+                if self.policy == "load" or cheap:
+                    aff = hint = 0.0
+                else:
+                    aff = r.prefix_fraction(prompt)
+                    hint = 1.0 if r.name == hinted else 0.0
+                score = (self.affinity_weight * aff
+                         + self.hint_weight * hint
+                         - self.load_weight * r.load())
             if best_score is None or score > best_score:
                 best, best_score, best_aff = r, score, aff
         entry.route_affinity = best_aff > 0.0 or hinted == best.name
@@ -368,6 +410,11 @@ class Router:
         if getattr(entry, "probe", False):
             # a half-open probe is diagnostic traffic: it must not re-home
             # a live session's hint to a replica still under suspicion
+            return
+        if getattr(entry, "target_role", None) == "decode":
+            # a decode-pool adoption placement carries its KV with it — it
+            # must not re-home the prefix session hint away from the
+            # prefill replica whose cache actually holds the prefix
             return
         if self.policy != "prefix":
             return

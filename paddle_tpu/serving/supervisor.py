@@ -158,7 +158,8 @@ class ReplicaSupervisor:
                  budget_window_s=None, backoff_base_s=None,
                  backoff_max_s=None, grow_hold_s=None,
                  shrink_cooldown_s=None, interval_s=None,
-                 drain_timeout_s=30.0, clock=time.monotonic, start=False):
+                 drain_timeout_s=30.0, clock=time.monotonic, start=False,
+                 min_replicas_by_role=None):
         if not callable(engine_factory):
             raise ValueError("engine_factory must be a zero-arg callable "
                              "returning a fresh engine replica")
@@ -195,10 +196,26 @@ class ReplicaSupervisor:
         self.interval_s = (env_float("PADDLE_SUPERVISOR_INTERVAL_S", 0.25)
                            if interval_s is None else float(interval_s))
         self.drain_timeout_s = float(drain_timeout_s)
+        # per-role floors (ISSUE 16): a disaggregated fleet's shrink path
+        # must respect each POOL's floor, not just the fleet total — a
+        # sustained lull on decode must never drain the last prefill
+        # replica (or vice versa). Unlisted roles fall back to the global
+        # min_replicas. env: PADDLE_SUPERVISOR_MIN_REPLICAS_<ROLE>
+        self.min_replicas_by_role = dict(min_replicas_by_role or {})
+        env_floors = {
+            "prefill": env_int("PADDLE_SUPERVISOR_MIN_REPLICAS_PREFILL", 0),
+            "decode": env_int("PADDLE_SUPERVISOR_MIN_REPLICAS_DECODE", 0),
+            "blended": env_int("PADDLE_SUPERVISOR_MIN_REPLICAS_BLENDED", 0),
+        }
+        for role, v in env_floors.items():
+            if v and role not in self.min_replicas_by_role:
+                self.min_replicas_by_role[role] = v
         self._clock = clock
         self._lock = threading.Lock()
         self._domains = {}
-        self._hint_since = {"grow": None, "shrink": None}
+        # hold/cooldown state PER (role, hint) — a prefill pool's grow
+        # pressure must not be masked (or reset) by the decode pool's
+        self._hint_since = {}
         self._scale_seq = 0
         self._events = deque(maxlen=64)
         self._wake = threading.Event()
@@ -349,7 +366,9 @@ class ReplicaSupervisor:
             if rep.fence is not None:
                 rep.fence.revoke()
             self._bump_generation(domain)
-            new = self._spawn(domain)
+            # the replacement inherits the dead incarnation's pool role —
+            # a prefill replica's successor serves prefill
+            new = self._spawn(domain, role=rep.role)
             if new is None:
                 backoff = min(self.backoff_max_s,
                               self.backoff_base_s
@@ -360,18 +379,24 @@ class ReplicaSupervisor:
             self._log("respawn", f"{rep.name} -> {new.name}")
             self.frontend.remove_replica(rep)
 
-    def _spawn(self, domain):
+    def _spawn(self, domain, role="blended"):
         """One engine spawn + pool join for ``domain``'s current
         generation. Returns the new ReplicaHandle, or None on failure
-        (counted; the caller schedules the backoff)."""
+        (counted; the caller schedules the backoff). ``role`` is offered
+        to the factory (disaggregated pools may build prefill and decode
+        replicas differently) and falls back to a zero-arg call for
+        factories that predate roles."""
         try:
             # the chaos seam: a FaultPlan arming serving.spawn_fail makes
             # this spawn fail deterministically (budget/backoff drills)
             chaos.site("serving.spawn_fail")
-            engine = self.engine_factory()
+            try:
+                engine = self.engine_factory(role=role)
+            except TypeError:
+                engine = self.engine_factory()
             return self.frontend.add_replica(
                 engine, name=f"{domain.name}-g{domain.generation}",
-                domain=domain.name,
+                domain=domain.name, role=role,
                 fence=ReplicaFence(self, domain.name, domain.generation))
         except Exception as e:
             _M_SPAWN_FAILURES.inc()
@@ -379,36 +404,57 @@ class ReplicaSupervisor:
                       f"{domain.name}: {type(e).__name__}: {e}")
             return None
 
+    def min_for(self, role):
+        """Shrink floor for one role pool."""
+        return self.min_replicas_by_role.get(role, self.min_replicas)
+
     def _autoscale(self, now):
+        """Per-role autoscaling (ISSUE 16): each pool's pressure drives
+        its own grow/shrink with its own hold/cooldown state, so a
+        saturated prefill pool grows even while the decode pool idles —
+        and a decode lull cannot mask a prefill grow hint (or vice
+        versa). A rollup without a roles block (homogeneous fleet, stub
+        signals) degrades to the single blended loop this method always
+        was."""
         sig = self.frontend.fleet_signal()
-        hint = sig.get("scale_hint")
+        roles = sig.get("roles") or None
+        if not roles:
+            roles = {"blended": {"scale_hint": sig.get("scale_hint")}}
+        for role in sorted(roles):
+            self._autoscale_role(now, role, roles[role].get("scale_hint"))
+
+    def _autoscale_role(self, now, role, hint):
         for h in ("grow", "shrink"):
+            key = (role, h)
             if hint != h:
-                self._hint_since[h] = None
-            elif self._hint_since[h] is None:
-                self._hint_since[h] = now
-        live = [r for r in self.frontend.replicas if r.state == LIVE]
-        if hint == "grow" and len(live) < self.max_replicas:
-            since = self._hint_since["grow"]
+                self._hint_since[key] = None
+            elif self._hint_since.get(key) is None:
+                self._hint_since[key] = now
+        live_all = [r for r in self.frontend.replicas if r.state == LIVE]
+        live = [r for r in live_all if r.role == role]
+        if hint == "grow" and len(live_all) < self.max_replicas:
+            since = self._hint_since[(role, "grow")]
             if now - since < self.grow_hold_s:
                 return  # hysteresis: pressure must SUSTAIN, not spike
             with self._lock:
                 self._scale_seq += 1
                 seq = self._scale_seq
-            domain = self._domain(f"scale{seq}")
+            # role-tagged scale domain: a crash-looping prefill spawn
+            # exhausts ITS domain's restart budget, never decode's
+            domain = self._domain(f"scale-{role}{seq}")
             self._bump_generation(domain)
-            new = self._spawn(domain)
+            new = self._spawn(domain, role=role)
             if new is not None:
                 _M_SCALE_UPS.inc()
-                self._log("scale_up", new.name)
-            self._hint_since["grow"] = None  # re-arm the hold either way
-        elif hint == "shrink" and len(live) > self.min_replicas:
-            since = self._hint_since["shrink"]
+                self._log("scale_up", f"{new.name} ({role})")
+            self._hint_since[(role, "grow")] = None  # re-arm either way
+        elif hint == "shrink" and len(live) > self.min_for(role):
+            since = self._hint_since[(role, "shrink")]
             if now - since < self.shrink_cooldown_s:
                 return  # cooldown: a lull is not a trend
             victim = min(live, key=lambda r: r.load())
             if self._shrink(victim):
-                self._hint_since["shrink"] = None
+                self._hint_since[(role, "shrink")] = None
 
     def _shrink(self, rep):
         """Retire one replica, always via drain() — the no-lost-requests
@@ -448,6 +494,7 @@ class ReplicaSupervisor:
             "running": self._thread is not None,
             "superseded": self.superseded,
             "min_replicas": self.min_replicas,
+            "min_replicas_by_role": dict(self.min_replicas_by_role),
             "max_replicas": self.max_replicas,
             "restart_budget": self.restart_budget,
             "budget_window_s": self.budget_window_s,

@@ -50,6 +50,17 @@ elastic.regrow            controller watch loop capacity-return probe:
 dataloader.worker         io/dataloader.py forked worker, per batch
 serve.prefill             inference/continuous.py per-request prefill
 serve.decode              inference/continuous.py per decode dispatch
+serving.handoff.send      serving/handoff.py per publish attempt — a fault
+                          here exercises the bounded-backoff retry and the
+                          deadline's blended fallback
+serving.handoff.adopt     serving/handoff.py per adopt attempt (a decode
+                          replica dying mid-adopt)
+serving.handoff.corrupt   serving/handoff.py between fsync and rename of a
+                          bundle — a ``truncate`` rule commits a torn file
+                          the digest gate must reject (HandoffCorruptError)
+serving.decode_pool_empty serving/frontend.py decode-pool liveness check:
+                          firing declares the decode pool empty, forcing
+                          the blended degradation path deterministically
 obs.oom                   the XLA dispatch seams (jit_api train-step
                           dispatch, continuous._locked_dispatch): inject a
                           synthetic RESOURCE_EXHAUSTED so OOM forensics

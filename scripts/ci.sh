@@ -71,6 +71,7 @@ FAST_TESTS=(
   tests/test_compile_memory_obs.py
   tests/test_fleet_obs.py
   tests/test_dynamics.py
+  tests/test_disagg.py
 )
 
 if [[ "${1:-}" == "--fast" ]]; then

@@ -1,0 +1,770 @@
+"""Disaggregated prefill/decode serving (ISSUE 16): KV-page handoff with
+fault-isolated degradation back to blended.
+
+The contract under test, end to end: a roled fleet hands each request's
+KV pages from a prefill replica to a decode replica through an atomic,
+digest-validated, generation-fenced bundle — and EVERY failure mode on
+that path (torn bundle at the ``serving.handoff.corrupt`` seam, a decode
+replica dying at the ``serving.handoff.adopt`` seam, publish exhaustion
+at ``serving.handoff.send``, an empty decode pool at
+``serving.decode_pool_empty``) ends in either a bit-identical re-prefill
+or a blended completion. Zero lost handles, zero hangs, zero wrong
+tokens; disaggregation is a perf win, never an availability loss.
+
+Tiers:
+
+- frame/manager units (bundle validation, retry/backoff/deadline with a
+  stepped clock, stale-generation fencing);
+- control-plane drills on the FakeEngine double (bit-exactness oracle,
+  chaos drills, TTFT-at-delivery, trace handoff span + attempt edge);
+- per-role autoscaling units (role-inheriting replacement, isolated
+  grow/shrink state, per-role floors, failure-domain isolation);
+- the brownout ladder's ``shed_prefill_depth`` rung;
+- one real-engine E2E: disaggregated output == blended output token for
+  token (the oracle that export/adopt restored the engine invariants).
+"""
+import itertools
+import threading
+import time
+
+import numpy as np
+import pytest
+from test_serving_frontend import FakeEngine, _expected, _prompt
+
+from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+from paddle_tpu.observability import fleet as _fleet
+from paddle_tpu.observability import request_trace as rtrace
+from paddle_tpu.observability import tracing
+from paddle_tpu.observability.metrics import registry as _registry
+from paddle_tpu.serving import (
+    DEAD,
+    LIVE,
+    BrownoutLadder,
+    HandoffBundle,
+    HandoffCorruptError,
+    HandoffError,
+    HandoffManager,
+    ReplicaSupervisor,
+    ServingFrontend,
+    StaleHandoffError,
+)
+from paddle_tpu.serving.handoff import page_digests
+from paddle_tpu.testing import chaos
+
+
+def _val(name, labels=None):
+    m = _registry.get(name, labels)
+    return getattr(m, "value", 0) if m is not None else 0
+
+
+def _hist_count(name, labels=None):
+    m = _registry.get(name, labels)
+    return getattr(m, "count", 0) if m is not None else 0
+
+
+class _Clock:
+    """Steppable monotonic clock for policy units."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _wait_until(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# FakeEngine + the disaggregation hook protocol
+# ---------------------------------------------------------------------------
+class DisaggEngine(FakeEngine):
+    """FakeEngine plus the handoff hooks (export_pages / detach_request /
+    adopt_request / active_prefills). Token emission stays replica-
+    independent — ``prompt + [prompt[-1]] * max_new_tokens`` wherever the
+    request runs — so an adopted continuation is bit-identical iff the
+    control plane moved the continuation state correctly and exactly once.
+    The exported payload carries the prompt bytes so adopt_request can
+    verify the payload itself survived the bundle round-trip."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._slot_counter = itertools.count()
+        self.exported = 0
+        self.detached = 0
+        self.adopted_reqs = 0
+        self.n_prefilling = 0   # settable: the shed_prefill_depth input
+
+    def active_prefills(self):
+        return self.n_prefilling
+
+    def try_admit_one(self, req):
+        status = super().try_admit_one(req)
+        if status in ("admitted", "done"):
+            req.n_dispatched = req.n_generated
+        if status == "admitted":
+            req.slot = next(self._slot_counter)
+        return status
+
+    def step(self):
+        retired = super().step()
+        for req in list(self._active.values()) + retired:
+            req.n_dispatched = req.n_generated
+        return retired
+
+    def export_pages(self, slot):
+        for req in self._active.values():
+            if req.slot == slot:
+                if req.finished:
+                    return None
+                self.exported += 1
+                return {"n_pages": max(1, len(req.prompt) // self.page_size),
+                        "prompt": np.asarray(req.prompt, np.int32),
+                        "n_generated": int(req.n_generated)}
+        return None
+
+    def detach_request(self, slot):
+        for rid, req in list(self._active.items()):
+            if req.slot == slot:
+                del self._active[rid]
+                self._pages -= self.pages_per_req
+                req.slot = None
+                self.detached += 1
+                return req
+        raise KeyError(f"no active request in slot {slot}")
+
+    def adopt_request(self, req, payloads):
+        if self.admit_paused or not self.has_free_slot():
+            return "deferred"
+        # the payload integrity oracle: the exported prompt bytes rode the
+        # bundle; a torn/corrupt bundle must never reach this comparison
+        np.testing.assert_array_equal(payloads["prompt"], req.prompt)
+        assert payloads["n_generated"] <= req.n_generated
+        req.slot = next(self._slot_counter)
+        if req.t_admit is None:
+            req.t_admit = time.monotonic()
+        self._active[req.rid] = req
+        self._pages += self.pages_per_req
+        self.adopted_reqs += 1
+        return "admitted"
+
+
+def _bundle(prompt=None, tokens=(7, 7), generation=0, page_size=8, **kw):
+    p = (np.asarray(prompt, np.int32) if prompt is not None
+         else _prompt(3, 7))
+    n = len(p) // page_size
+    fields = dict(
+        rid=5, seed=0, sampling=(False, 1.0, 0, 1.0), prompt=p,
+        tokens=list(tokens), n_generated=len(tokens),
+        n_dispatched=len(tokens), max_new_tokens=6, eos_token_id=None,
+        timeout_s=None, payloads={"n_pages": max(1, n), "prompt": p,
+                                  "n_generated": len(tokens)},
+        digests=page_digests(p, page_size, n), page_size=page_size,
+        generation=generation)
+    fields.update(kw)
+    return HandoffBundle(**fields)
+
+
+# ---------------------------------------------------------------------------
+# bundle frame units
+# ---------------------------------------------------------------------------
+class TestHandoffBundle:
+    def test_roundtrip_and_digest_chain(self):
+        b = _bundle(prompt=np.arange(1, 20, dtype=np.int32))
+        data = b.to_bytes()
+        back = HandoffBundle.from_bytes(data)
+        back.verify_prompt_digests()
+        assert back.rid == b.rid and back.generation == b.generation
+        assert back.tokens == b.tokens
+        assert back.n_dispatched == b.n_dispatched
+        np.testing.assert_array_equal(back.prompt, b.prompt)
+        np.testing.assert_array_equal(back.payloads["prompt"],
+                                      b.payloads["prompt"])
+
+    def test_torn_truncated_and_flipped_frames_are_typed_errors(self):
+        data = _bundle().to_bytes()
+        with pytest.raises(HandoffCorruptError):
+            HandoffBundle.from_bytes(b"not a bundle at all")
+        with pytest.raises(HandoffCorruptError, match="truncated"):
+            HandoffBundle.from_bytes(data[:-7])
+        flipped = bytearray(data)
+        flipped[-1] ^= 0xFF
+        with pytest.raises(HandoffCorruptError, match="digest mismatch"):
+            HandoffBundle.from_bytes(bytes(flipped))
+        # HandoffCorruptError (and Stale) ARE HandoffErrors: one except
+        # clause in the frontend covers the whole degradation family
+        assert issubclass(HandoffCorruptError, HandoffError)
+        assert issubclass(StaleHandoffError, HandoffError)
+
+    def test_prompt_digest_chain_lie_is_caught(self):
+        # digests computed for a DIFFERENT prompt: frame-level digest
+        # passes (the frame is self-consistent) but the chained prompt
+        # page-digest recomputation must expose the disagreement
+        p = np.arange(1, 25, dtype=np.int32)
+        other = p + 1
+        b = _bundle(prompt=p, page_size=8)
+        b.digests = page_digests(other, 8, len(p) // 8)
+        back = HandoffBundle.from_bytes(b.to_bytes())
+        with pytest.raises(HandoffCorruptError, match="page-digest chain"):
+            back.verify_prompt_digests()
+
+
+# ---------------------------------------------------------------------------
+# manager units: atomic publish, retry/backoff/deadline, consume-on-load
+# ---------------------------------------------------------------------------
+class TestHandoffManager:
+    def test_publish_load_consumes_spool_file(self, tmp_path):
+        mgr = HandoffManager(spool_dir=str(tmp_path))
+        pub0, ad0 = _val("serving.handoff.published"), _val(
+            "serving.handoff.adopted")
+        path = mgr.publish(_bundle(generation=2))
+        assert path.endswith("-g2.bin")
+        assert _val("serving.handoff.published") == pub0 + 1
+        b = mgr.load(path, expected_generation=2)
+        assert b.tokens == [7, 7]
+        assert _val("serving.handoff.adopted") == ad0 + 1
+        assert not list(tmp_path.iterdir())   # consumed
+        # a second load of the consumed path is a typed corrupt error,
+        # never a partial success
+        with pytest.raises(HandoffCorruptError, match="unreadable"):
+            mgr.load(path)
+
+    def test_stale_generation_is_fenced_and_consumed(self, tmp_path):
+        mgr = HandoffManager(spool_dir=str(tmp_path))
+        stale0 = _val("serving.handoff.stale")
+        path = mgr.publish(_bundle(generation=0))
+        with pytest.raises(StaleHandoffError, match="generation 0"):
+            mgr.load(path, expected_generation=1)
+        assert _val("serving.handoff.stale") == stale0 + 1
+        assert not list(tmp_path.iterdir())   # the late bundle is garbage
+
+    def test_chaos_corrupt_seam_commits_torn_file_digest_catches(
+            self, tmp_path):
+        mgr = HandoffManager(spool_dir=str(tmp_path))
+        corrupt0 = _val("serving.handoff.corrupt")
+        # the torn-bundle drill: truncate between fsync and rename — the
+        # short file is COMMITTED under the real name, exactly the state a
+        # preempted writer leaves, and the digest gate must refuse it
+        with chaos.FaultPlan().truncate("serving.handoff.corrupt",
+                                        keep_bytes=16):
+            path = mgr.publish(_bundle())
+        with pytest.raises(HandoffCorruptError):
+            mgr.load(path)
+        assert _val("serving.handoff.corrupt") == corrupt0 + 1
+        assert not list(tmp_path.iterdir())
+
+    def test_publish_retries_with_backoff_then_succeeds(self, tmp_path):
+        clk, sleeps = _Clock(), []
+        mgr = HandoffManager(spool_dir=str(tmp_path), retries=3,
+                             backoff_s=0.1, deadline_s=60.0, clock=clk,
+                             sleep=sleeps.append)
+        r0 = _val("serving.handoff.send_retries")
+        with chaos.FaultPlan().fail("serving.handoff.send", times=2):
+            path = mgr.publish(_bundle())
+        assert sleeps == [0.1, 0.2]   # exponential backoff, stepped
+        assert _val("serving.handoff.send_retries") == r0 + 2
+        mgr.load(path).verify_prompt_digests()
+
+    def test_publish_deadline_exhaustion_raises_handoff_error(
+            self, tmp_path):
+        clk = _Clock()
+
+        def sleep(s):
+            clk.t += s
+
+        mgr = HandoffManager(spool_dir=str(tmp_path), retries=10,
+                             backoff_s=0.3, deadline_s=0.5, clock=clk,
+                             sleep=sleep)
+        with chaos.FaultPlan().fail("serving.handoff.send", times=None):
+            with pytest.raises(HandoffError, match="publish failed"):
+                mgr.publish(_bundle())
+        assert not list(tmp_path.iterdir())   # nothing half-written
+
+
+# ---------------------------------------------------------------------------
+# control-plane drills on the FakeEngine double
+# ---------------------------------------------------------------------------
+class TestDisaggServing:
+    def _fleet(self, tmp_path, roles=("prefill", "decode"), n_eng=None,
+               **fe_kw):
+        engines = [DisaggEngine(max_seqs=4, num_pages=64)
+                   for _ in range(n_eng or len(roles))]
+        fe_kw.setdefault("heartbeat_deadline_s", 30.0)
+        fe = ServingFrontend(
+            engines, roles=list(roles),
+            handoff=HandoffManager(spool_dir=str(tmp_path)), **fe_kw)
+        return fe, engines
+
+    def test_bit_exact_handoff_single_delivery_and_ttft(self, tmp_path):
+        fe, (pre, dec) = self._fleet(tmp_path)
+        init0 = _val("serving.handoff.initiated")
+        ad0 = _val("serving.handoff.adopted")
+        ttft0 = _hist_count("serving.ttft_s",
+                            {"slo_class": "interactive"})
+        try:
+            prompts = [_prompt(h, t) for h, t in ((1, 5), (2, 6), (3, 9))]
+            handles = [fe.submit(p, 8) for p in prompts]
+            for h, p in zip(handles, prompts):
+                np.testing.assert_array_equal(h.result(timeout=30),
+                                              _expected(p, 8))
+                # single delivery: the replay at adopt plus the live
+                # stream, each generated token exactly once
+                assert h.tokens_so_far() == [int(p[-1])] * 8
+            assert _val("serving.handoff.initiated") == init0 + 3
+            assert _val("serving.handoff.adopted") == ad0 + 3
+            assert pre.admitted == 3 and pre.detached == 3
+            assert dec.adopted_reqs == 3
+            # satellite 2: ONE ttft observation per request, in the same
+            # per-class histogram as blended traffic, stamped at
+            # decode-side delivery (prefill queue wait + transfer inside)
+            assert _hist_count("serving.ttft_s",
+                               {"slo_class": "interactive"}) == ttft0 + 3
+            # the per-role fleet signal the supervisor scales from
+            roles = fe.fleet_signal()["roles"]
+            assert set(roles) == {"prefill", "decode"}
+        finally:
+            fe.shutdown()
+        assert not list(tmp_path.iterdir())   # spool drained
+
+    def test_short_generation_finishes_blended_on_prefill(self, tmp_path):
+        fe, (pre, dec) = self._fleet(tmp_path)
+        fb0 = _val("serving.handoff.fallback",
+                   {"reason": "finished_on_prefill"})
+        init0 = _val("serving.handoff.initiated")
+        try:
+            p = _prompt(4, 2)
+            h = fe.submit(p, 1)   # done at admission: nothing to hand off
+            np.testing.assert_array_equal(h.result(timeout=10),
+                                          _expected(p, 1))
+            assert h.tokens_so_far() == [int(p[-1])]
+            assert _val("serving.handoff.fallback",
+                        {"reason": "finished_on_prefill"}) == fb0 + 1
+            assert _val("serving.handoff.initiated") == init0
+            assert dec.adopted_reqs == 0
+        finally:
+            fe.shutdown()
+
+    def test_decode_pool_empty_chaos_degrades_blended(self, tmp_path):
+        fe, (pre, dec) = self._fleet(tmp_path)
+        fb0 = _val("serving.handoff.fallback",
+                   {"reason": "decode_pool_empty"})
+        init0 = _val("serving.handoff.initiated")
+        try:
+            # the decode-pool-empty drill: every liveness check reports
+            # the pool gone — requests must complete blended on prefill
+            with chaos.FaultPlan().fail("serving.decode_pool_empty",
+                                        times=None):
+                p = _prompt(5, 3)
+                h = fe.submit(p, 4)
+                np.testing.assert_array_equal(h.result(timeout=10),
+                                              _expected(p, 4))
+            assert _val("serving.handoff.fallback",
+                        {"reason": "decode_pool_empty"}) >= fb0 + 1
+            assert _val("serving.handoff.initiated") == init0
+            assert dec.adopted_reqs == 0 and pre.admitted == 1
+        finally:
+            fe.shutdown()
+
+    def test_no_decode_replicas_serves_blended(self, tmp_path):
+        # a prefill-only fleet (operator misconfiguration or a decode pool
+        # that never came up): availability wins, everything blended
+        fe, (pre,) = self._fleet(tmp_path, roles=("prefill",))
+        init0 = _val("serving.handoff.initiated")
+        try:
+            p = _prompt(6, 4)
+            np.testing.assert_array_equal(fe.submit(p, 4).result(timeout=10),
+                                          _expected(p, 4))
+            assert _val("serving.handoff.initiated") == init0
+        finally:
+            fe.shutdown()
+
+    def test_disagg_env_kill_switch(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_SERVING_DISAGG", "0")
+        fe, (pre, dec) = self._fleet(tmp_path)
+        pub0 = _val("serving.handoff.published")
+        try:
+            assert not fe._disagg_active()
+            p = _prompt(7, 5)
+            h = fe.submit(p, 4)
+            np.testing.assert_array_equal(h.result(timeout=10),
+                                          _expected(p, 4))
+            # byte-for-byte pre-disaggregation behavior: no bundle was
+            # ever built, no spool file ever touched
+            assert _val("serving.handoff.published") == pub0
+            assert pre.exported == 0 and dec.adopted_reqs == 0
+            assert not list(tmp_path.iterdir())
+        finally:
+            fe.shutdown()
+
+    def test_publish_exhaustion_degrades_blended(self, tmp_path):
+        fe, (pre, dec) = self._fleet(tmp_path)
+        fe.handoff = HandoffManager(spool_dir=str(tmp_path), retries=0,
+                                    backoff_s=0.0, deadline_s=0.2)
+        fb0 = _val("serving.handoff.fallback",
+                   {"reason": "publish_failed"})
+        try:
+            # every send attempt faults: publish exhausts its budget,
+            # nothing was detached, the prefill replica finishes blended
+            with chaos.FaultPlan().fail("serving.handoff.send",
+                                        times=None):
+                p = _prompt(8, 6)
+                np.testing.assert_array_equal(
+                    fe.submit(p, 5).result(timeout=10), _expected(p, 5))
+            assert _val("serving.handoff.fallback",
+                        {"reason": "publish_failed"}) >= fb0 + 1
+            assert dec.adopted_reqs == 0
+        finally:
+            fe.shutdown()
+
+    def test_corrupt_bundle_reprefills_bit_identical(self, tmp_path):
+        fe, (pre, dec) = self._fleet(tmp_path)
+        c0 = _val("serving.handoff.corrupt")
+        init0 = _val("serving.handoff.initiated")
+        try:
+            # torn-bundle drill: the first publish commits a truncated
+            # file; adopt must raise HandoffCorruptError (never a wrong
+            # token), the request re-prefills under a bumped generation,
+            # and the second handoff replays bit-identically
+            with chaos.FaultPlan().truncate("serving.handoff.corrupt",
+                                            keep_bytes=24, times=1):
+                p = _prompt(9, 8)
+                h = fe.submit(p, 6)
+                np.testing.assert_array_equal(h.result(timeout=30),
+                                              _expected(p, 6))
+            assert h.tokens_so_far() == [int(p[-1])] * 6
+            assert _val("serving.handoff.corrupt") == c0 + 1
+            assert _val("serving.handoff.initiated") == init0 + 2
+            assert pre.admitted == 2    # the re-prefill ran
+            assert dec.adopted_reqs == 1
+        finally:
+            fe.shutdown()
+        assert not list(tmp_path.iterdir())
+
+    def test_decode_replica_dies_mid_adopt_nothing_lost(self, tmp_path):
+        fe, (pre, dec) = self._fleet(tmp_path)
+        dead0 = _val("serving.replica_dead")
+        try:
+            # the decode-killed-mid-handoff drill: the fault at the adopt
+            # seam escapes as a replica-fatal error — the decode replica
+            # dies holding the request, which must relocate (bundle and
+            # all) and still finish with exact tokens
+            with chaos.FaultPlan().fail("serving.handoff.adopt", times=1):
+                p = _prompt(2, 9)
+                h = fe.submit(p, 6)
+                np.testing.assert_array_equal(h.result(timeout=30),
+                                              _expected(p, 6))
+            assert h.tokens_so_far() == [int(p[-1])] * 6
+            assert fe._by_name["replica1"].state == DEAD
+            assert _val("serving.replica_dead") == dead0 + 1
+        finally:
+            fe.shutdown()
+
+    def test_chaos_storm_zero_lost_zero_wrong(self, tmp_path):
+        # the keystone drill: torn bundle AND a decode death in one run —
+        # every handle must still reach DONE with exact tokens
+        fe, (pre, dec) = self._fleet(tmp_path)
+        try:
+            plan = (chaos.FaultPlan()
+                    .truncate("serving.handoff.corrupt", keep_bytes=20,
+                              times=1)
+                    .fail("serving.handoff.adopt", after=2, times=1))
+            with plan:
+                prompts = [_prompt(1 + i, 3 + i) for i in range(6)]
+                handles = [fe.submit(p, 6) for p in prompts]
+                for h, p in zip(handles, prompts):
+                    np.testing.assert_array_equal(h.result(timeout=60),
+                                                  _expected(p, 6))
+                    assert h.tokens_so_far() == [int(p[-1])] * 6
+            assert all(h.done() for h in handles)
+        finally:
+            fe.shutdown()
+        assert not list(tmp_path.iterdir())   # no leaked spool files
+
+    def test_trace_handoff_span_and_attempt_edge(self, tmp_path):
+        tracing.disable()
+        rtrace.clear()
+        fe, _ = self._fleet(tmp_path)
+        try:
+            tracing.enable()
+            p = _prompt(3, 4)
+            h = fe.submit(p, 6)
+            np.testing.assert_array_equal(h.result(timeout=30),
+                                          _expected(p, 6))
+            assert _wait_until(lambda: rtrace.recent())
+            [summary] = [s for s in rtrace.recent()
+                         if s["rid"] == h.rid]
+            recs = summary["records"]
+            by_name = {}
+            for r in recs:
+                by_name.setdefault(r["name"], []).append(r)
+            # the handoff span under the prefill attempt...
+            assert by_name["handoff"][0]["status"] == "ok"
+            # ...the prefill attempt closed as handed_off, and the
+            # reroute edge (satellite 2's "attempt edge") stamped the
+            # prefill -> decode movement on the root
+            statuses = {r["status"] for r in by_name["attempt"]}
+            assert "handed_off" in statuses and "ok" in statuses
+            assert len(by_name["attempt"]) == 2
+            edge = by_name["reroute"][0]
+            assert "handoff" in edge["attrs"]["reason"]
+        finally:
+            tracing.disable()
+            rtrace.clear()
+            fe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# per-role autoscaling / replacement (satellite 3)
+# ---------------------------------------------------------------------------
+class _RoleFactory:
+    """Counting engine factory that records the requested role."""
+
+    def __init__(self):
+        self.roles = []
+
+    def __call__(self, role="blended"):
+        self.roles.append(role)
+        return DisaggEngine()
+
+
+class TestPerRoleSupervisor:
+    def _fleet(self, tmp_path, roles=("prefill", "decode"), **fe_kw):
+        fe_kw.setdefault("monitor_interval_s", 0.02)
+        fe_kw.setdefault("heartbeat_deadline_s", 5.0)
+        return ServingFrontend(
+            [DisaggEngine() for _ in roles], roles=list(roles),
+            handoff=HandoffManager(spool_dir=str(tmp_path)), **fe_kw)
+
+    def test_replacement_inherits_role(self, tmp_path):
+        fe = self._fleet(tmp_path)
+        factory = _RoleFactory()
+        sup = ReplicaSupervisor(fe, factory, clock=_Clock(), start=False)
+        try:
+            fe.kill("replica0", reason="chaos")   # the prefill replica
+            sup.tick()
+            assert factory.roles == ["prefill"]
+            live = [r for r in fe.replicas if r.state == LIVE]
+            assert sorted(r.role for r in live) == ["decode", "prefill"]
+        finally:
+            fe.shutdown()
+
+    def test_grow_is_per_role_and_isolated(self, tmp_path):
+        fe = self._fleet(tmp_path)
+        clk = _Clock()
+        factory = _RoleFactory()
+        sup = ReplicaSupervisor(fe, factory, clock=clk, start=False,
+                                max_replicas=5, grow_hold_s=5.0)
+        hints = {"roles": {"prefill": {"scale_hint": "grow"},
+                           "decode": {"scale_hint": "hold"}}}
+        fe.fleet_signal = lambda: hints
+        try:
+            sup.tick()                 # prefill grow streak starts
+            clk.t += 2.0
+            # decode pool flapping its hint must NOT reset prefill's
+            # streak — the hold state is per (role, hint)
+            hints["roles"]["decode"]["scale_hint"] = "grow"
+            sup.tick()
+            hints["roles"]["decode"]["scale_hint"] = "hold"
+            clk.t += 4.0
+            sup.tick()                 # 6s sustained: prefill grows
+            assert factory.roles == ["prefill", "decode"] or \
+                factory.roles == ["prefill"]
+            prefills = [r for r in fe.replicas if r.role == "prefill"]
+            assert len(prefills) == 2
+            # the scale domain is role-tagged: a crash-looping prefill
+            # spawn exhausts ITS budget, never the decode pool's
+            assert any(d.startswith("scale-prefill")
+                       for d in sup.report()["domains"])
+        finally:
+            fe.shutdown()
+
+    def test_shrink_respects_per_role_floor(self, tmp_path):
+        fe = self._fleet(tmp_path, roles=("prefill", "decode", "decode"))
+        clk = _Clock()
+        sup = ReplicaSupervisor(fe, _RoleFactory(), clock=clk, start=False,
+                                min_replicas=1, shrink_cooldown_s=2.0,
+                                min_replicas_by_role={"decode": 2})
+        assert sup.min_for("decode") == 2 and sup.min_for("prefill") == 1
+        fe.fleet_signal = lambda: {
+            "roles": {"decode": {"scale_hint": "shrink"},
+                      "prefill": {"scale_hint": "hold"}}}
+        try:
+            sup.tick()
+            clk.t += 3.0
+            sup.tick()     # sustained shrink, but the decode floor holds
+            decodes = [r for r in fe.replicas if r.role == "decode"]
+            assert len(decodes) == 2
+            # lower the floor: the sustained hint may now retire one
+            sup.min_replicas_by_role["decode"] = 1
+            sup.tick()
+            decodes = [r for r in fe.replicas if r.role == "decode"]
+            assert len(decodes) == 1
+            # the prefill pool was never touched by decode's shrink
+            assert sum(r.role == "prefill" for r in fe.replicas) == 1
+        finally:
+            fe.shutdown()
+
+    def test_env_role_floor(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_SUPERVISOR_MIN_REPLICAS_DECODE", "3")
+        fe = self._fleet(tmp_path)
+        sup = ReplicaSupervisor(fe, _RoleFactory(), clock=_Clock(),
+                                start=False)
+        try:
+            assert sup.min_for("decode") == 3
+            assert sup.min_for("prefill") == sup.min_replicas
+            assert sup.report()["min_replicas_by_role"] == {"decode": 3}
+        finally:
+            fe.shutdown()
+
+    def test_crash_looping_prefill_domain_cannot_exhaust_decode(
+            self, tmp_path):
+        fe = self._fleet(tmp_path)
+        clk = _Clock()
+        factory = _RoleFactory()
+        sup = ReplicaSupervisor(fe, factory, clock=clk, start=False,
+                                restart_budget=1, backoff_base_s=0.5)
+        try:
+            fe.kill("replica0", reason="bad host")   # prefill
+            with chaos.FaultPlan().fail("serving.spawn_fail", times=None):
+                sup.tick()               # attempt 1 fails
+                clk.t += 5.0
+                sup.tick()               # budget exhausted for replica0
+            assert sup.report()["domains"]["replica0"]["exhausted"]
+            # the decode replica's failure domain is untouched: its death
+            # still gets a replacement from its OWN budget
+            fe.kill("replica1", reason="chaos")
+            clk.t += 5.0
+            sup.tick()
+            assert factory.roles[-1] == "decode"
+            live = [r for r in fe.replicas if r.state == LIVE]
+            assert [r.role for r in live] == ["decode"]
+        finally:
+            fe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# brownout: the shed_prefill_depth rung
+# ---------------------------------------------------------------------------
+class TestShedPrefillDepth:
+    def test_ladder_caps_then_halves_then_floors(self):
+        clk = _Clock()
+        lad = BrownoutLadder(clock=clk)
+        assert lad.prefill_depth_cap() is None
+        lad.observe(0.73)               # engage shed_prefill_depth
+        assert lad.step_name() == "shed_prefill_depth"
+        assert lad.prefill_depth_cap() == 2
+        lad.observe(0.81)               # clamp_tokens rung: cap halves
+        assert lad.prefill_depth_cap() == 1
+        lad.observe(0.89)               # deeper: floor at 1
+        assert lad.prefill_depth_cap() == 1
+
+    def test_frontend_defers_admission_at_the_cap(self, tmp_path):
+        lad = BrownoutLadder(clock=_Clock())
+        lad.observe(0.73)               # level 1: cap == 2
+        eng = DisaggEngine()
+        fe = ServingFrontend([eng], brownout=lad, start=False,
+                             handoff=HandoffManager(spool_dir=str(tmp_path)))
+        rep = fe.replicas[0]
+        try:
+            p = _prompt(1, 2)
+            h = fe.submit(p, 3)
+            eng.n_prefilling = 2        # replica already at the cap
+            assert fe._admit_pending(rep) is False
+            assert len(rep.pending) == 1    # deferred, NOT rejected
+            eng.n_prefilling = 1        # a prefill finished: under the cap
+            assert fe._admit_pending(rep) is True
+            while not h.done():
+                for r in eng.step():
+                    fe._finish(rep, r)
+            np.testing.assert_array_equal(h.result(timeout=5),
+                                          _expected(p, 3))
+        finally:
+            fe.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# per-role fleet rollup (the supervisor's signal)
+# ---------------------------------------------------------------------------
+class TestRoleRollup:
+    def test_saturated_prefill_not_masked_by_idle_decode(self):
+        snaps = {
+            "p0": {"name": "p0", "role": "prefill", "state": "LIVE",
+                   "active": 4, "max_seqs": 4, "pending": 6},
+            "p1": {"name": "p1", "role": "prefill", "state": "LIVE",
+                   "active": 4, "max_seqs": 4, "pending": 5},
+            "d0": {"name": "d0", "role": "decode", "state": "LIVE",
+                   "active": 0, "max_seqs": 4, "pending": 0},
+            "d1": {"name": "d1", "role": "decode", "state": "LIVE",
+                   "active": 0, "max_seqs": 4, "pending": 0},
+        }
+        out = _fleet.serving_rollup(snaps, {}, {})
+        roles = out["roles"]
+        # the blended mean sits mid-band ("hold") — the exact masking the
+        # per-role split exists to break
+        assert out["scale_hint"] == "hold"
+        assert roles["prefill"]["scale_hint"] == "grow"
+        assert roles["prefill"]["pressure"] == 1.0
+        assert roles["decode"]["scale_hint"] == "shrink"
+        assert _val("serving.role.pressure", {"role": "prefill"}) == 1.0
+        assert _val("serving.role.live_replicas", {"role": "decode"}) == 2
+
+    def test_homogeneous_fleet_rolls_up_as_blended(self):
+        snaps = {"r0": {"name": "r0", "state": "LIVE", "active": 1,
+                        "max_seqs": 4, "pending": 0}}
+        out = _fleet.serving_rollup(snaps, {}, {})
+        assert list(out["roles"]) == ["blended"]
+        assert out["roles"]["blended"]["live"] == 1
+
+
+# ---------------------------------------------------------------------------
+# real-engine E2E: the bit-exactness oracle
+# ---------------------------------------------------------------------------
+def _tiny_model(layers=1):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(31)
+    m = LlamaForCausalLM(llama_tiny(num_hidden_layers=layers))
+    m.eval()
+    return m
+
+
+class TestDisaggE2E:
+    def test_disaggregated_equals_blended_token_for_token(self, tmp_path):
+        """The oracle: the same prompts served through a prefill->decode
+        handoff produce byte-identical outputs to a single blended engine
+        — export/adopt restored ``lengths[slot] = len(prompt) +
+        n_dispatched - 1`` and the key stream exactly, or this diverges."""
+        model = _tiny_model()
+        rng = np.random.RandomState(7)
+        prompts = [rng.randint(1, 100, size=n).astype(np.int32)
+                   for n in (12, 17, 9)]
+        max_new = 10
+
+        def make_engine():
+            return ContinuousBatchingEngine(model, max_seqs=2, page_size=8,
+                                            max_len=64, decode_block=2)
+
+        baseline = make_engine().serve(prompts, max_new_tokens=max_new)
+        ad0 = _val("serving.handoff.adopted")
+        fe = ServingFrontend(
+            [make_engine(), make_engine()], roles=["prefill", "decode"],
+            handoff=HandoffManager(spool_dir=str(tmp_path)),
+            heartbeat_deadline_s=120.0)
+        try:
+            handles = [fe.submit(p, max_new) for p in prompts]
+            for h, want in zip(handles, baseline):
+                got = h.result(timeout=300)
+                np.testing.assert_array_equal(got, want)
+        finally:
+            fe.shutdown()
+        # the equality above must certify the HANDOFF path, not a silent
+        # all-blended fallback: every request was adopted by decode
+        assert _val("serving.handoff.adopted") == ad0 + len(prompts)
+        assert not list(tmp_path.iterdir())
