@@ -245,6 +245,33 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
             "nonfinite_first": s.get("nonfinite_first"),
         }
 
+    # device-time attribution (ISSUE 17): per-program roofline rows next
+    # to the perf number. Armed AFTER the timed loop — sample_every=1
+    # blocks on every dispatch, which would serialize exactly what the
+    # rungs measure — and the cost harvest is a suppressed re-lower, so
+    # neither the headline nor the compile contract sees it.
+    from paddle_tpu.observability import devprof as _devprof
+
+    dev_block = {}
+    try:
+        _devprof.enable(sample_every=1)
+        if scan_steps:
+            step.run_steps(xs, ys, n=steps, stacked=True).numpy()
+        else:
+            for _ in range(2):
+                float(step(x, y).numpy())
+        _compilemem.memory.analyze()
+        rep = _devprof.report()
+        dev_block = {k: {f: r[f] for f in
+                         ("device_s_mean", "device_s_per_token", "mfu",
+                          "arith_intensity", "verdict") if r.get(f)
+                         is not None}
+                     for k, r in rep.get("programs", {}).items()}
+    except Exception as e:  # noqa: BLE001 — profiling must not kill the rung
+        dev_block = {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+    finally:
+        _devprof.disable()
+
     from paddle_tpu.ops import flash_attention as fa
 
     tokens_per_sec = batch * seq / dt
@@ -279,6 +306,9 @@ def run(hidden=2048, layers=12, heads=16, inter=5504, vocab=32000, seq=2048, bat
             # training-dynamics block (ISSUE 13 satellite): numerics
             # evidence lands next to the perf number on every rung
             "dynamics": dyn_block,
+            # per-program device-time/roofline rows (ISSUE 17): the
+            # trajectory guard compares these key by key across rounds
+            "devprof": dev_block,
             **({} if scan_steps else
                {"bus": {k: round(v, 4) for k, v in bus.summary().items()}}),
         },
@@ -663,6 +693,33 @@ def _trajectory_guard(res):
                 prior = res["extra"].get("note")
                 res["extra"]["note"] = ((prior + "; " + note) if prior
                                         else note)[:600]
+            # per-program mode (ISSUE 17): name WHICH program regressed,
+            # not just that the headline moved. Device-time rows are only
+            # comparable between same-config runs — config changes move
+            # per-program time legitimately.
+            if same_config:
+                prev_prog = (prev.get("extra") or {}).get("devprof") or {}
+                cur_prog = (res.get("extra") or {}).get("devprof") or {}
+                regressed = []
+                for key, row in sorted(cur_prog.items()):
+                    base = prev_prog.get(key)
+                    if not (isinstance(row, dict) and isinstance(base, dict)):
+                        continue
+                    b = base.get("device_s_mean")
+                    c = row.get("device_s_mean")
+                    if b and c and c / b - 1.0 > 0.10:
+                        regressed.append(
+                            {"program": key, "delta": round(c / b - 1.0, 4),
+                             "device_s_mean": c,
+                             "baseline_device_s_mean": b})
+                if regressed:
+                    traj["program_regressions"] = regressed
+                    names = ", ".join(f"{r['program']} +{r['delta']:.1%}"
+                                      for r in regressed)
+                    note = f"PERF REGRESSION (device time): {names}"
+                    prior = res["extra"].get("note")
+                    res["extra"]["note"] = ((prior + "; " + note) if prior
+                                            else note)[:600]
         rec = {
             "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
             "metric": res.get("metric"),
@@ -670,6 +727,9 @@ def _trajectory_guard(res):
             "mfu": (res.get("extra") or {}).get("mfu"),
             "config": (res.get("extra") or {}).get("config"),
             "backend": (res.get("extra") or {}).get("backend"),
+            # per-program device-time rows so the NEXT round's guard has a
+            # baseline to compare key by key (ISSUE 17)
+            "programs": (res.get("extra") or {}).get("devprof") or None,
             "baseline": traj,
         }
         with open(TRAJECTORY_PATH, "a") as f:
@@ -773,6 +833,8 @@ def _bank(name, result):
 def main():
     errors = []
     banked = {}  # ladder idx -> successful result
+    substituted = None  # reason a banked prior rung replaced this run's
+    cpu_fallback_used = False
     ok, backend, probe_info = _probe_backend()
     wedged = not ok
     if wedged:
@@ -837,6 +899,7 @@ def main():
                 f"rung {prior['extra'].get('banked_rung')!r} from "
                 f"{prior['extra'].get('banked_ts')} — reporting the banked best")
             res = prior
+            substituted = "this run's best rung below the banked best"
     if res is not None and errors:
         res.setdefault("extra", {})["note"] = "; ".join(errors)[:400]
     if res is None:
@@ -847,6 +910,8 @@ def main():
         prior = _best_prior_tpu_rung()
         if prior is not None:
             res = prior
+            substituted = ("backend unhealthy at report time: "
+                           + "; ".join(errors)[:160])
             res.setdefault("extra", {})["note"] = (
                 f"backend unhealthy at report time ({'; '.join(errors)[:200]}); "
                 f"value is the banked real-TPU rung {prior.get('extra', {}).get('banked_rung')!r} "
@@ -859,6 +924,7 @@ def main():
         out, timed_out = _run_rung(len(LADDER) - 1, CPU_FALLBACK_TIMEOUT_S, force_cpu=True)
         if not timed_out and out is not None and "error" not in out:
             res = out
+            cpu_fallback_used = True
             res.setdefault("extra", {})["note"] = (
                 ("tpu backend wedged; " if wedged else "")
                 + f"cpu fallback after: {'; '.join(errors)}"
@@ -912,7 +978,27 @@ def main():
         }
     # which probe path ran (first_try / retry / wedged_after_retry /
     # failed_after_retry) — the BENCH_r05 postmortem's missing datum
-    res.setdefault("extra", {})["probe"] = probe_info
+    ex = res.setdefault("extra", {})
+    ex["probe"] = probe_info
+    # structured probe health (ISSUE 17 satellite): trajectory tooling can
+    # filter unhealthy rounds mechanically — the BENCH_r05 banked-rung
+    # substitution path carries (status, banked_ts, reason), not only a
+    # free-text note
+    if substituted is not None:
+        ex["probe_health"] = {"status": "banked_substitute",
+                              "banked_ts": ex.get("banked_ts"),
+                              "reason": substituted[:200]}
+    elif cpu_fallback_used:
+        ex["probe_health"] = {
+            "status": "cpu_fallback", "banked_ts": None,
+            "reason": ("tpu backend wedged; " if wedged else "")
+            + ("; ".join(errors)[:180] or "no tpu rung completed")}
+    elif "error" in res and not res.get("value"):
+        ex["probe_health"] = {"status": "no_result", "banked_ts": None,
+                              "reason": "; ".join(errors)[:200]}
+    else:
+        ex["probe_health"] = {"status": "ok", "banked_ts": None,
+                              "reason": f"probe {probe_info['path']}"}
     # cluster health per run (ISSUE 11 satellite): snapshot count, worst
     # cross-rank phase skew, straggler verdicts from the fleet plane
     try:

@@ -342,6 +342,39 @@ def _disagg_block(model, knobs, rng_seed, vocab):
     }
 
 
+def _devprof_block(model, knobs, rng_seed, vocab):
+    """ISSUE 17: per-program device-time / roofline rows for the serving
+    decode programs. Armed AFTER the timed phases — sample_every=1 blocks
+    on every decode dispatch, which would serialize exactly the pipelining
+    under comparison — and disabled before returning. The cost harvest is
+    a suppressed re-lower, so the compile contract never sees it."""
+    import numpy as np
+
+    from paddle_tpu.observability import compilemem as _compilemem
+    from paddle_tpu.observability import devprof as _devprof
+    from paddle_tpu.serving import ServingFrontend
+
+    rng = np.random.RandomState(rng_seed + 41)
+    shorts = [(rng.randint(1, vocab, (int(rng.randint(8, 24)),))
+               .astype(np.int32), knobs["inter_new"], "interactive")
+              for _ in range(4)]
+    try:
+        engines = _make_engines(model, "pipelined", 1, knobs)
+        for e in engines:
+            e.warmup(buckets=sorted({len(p) for p, _, _ in shorts}))
+        _devprof.enable(sample_every=1)
+        with ServingFrontend(engines, heartbeat_deadline_s=600.0) as fe:
+            _run_load(fe, shorts)
+        _compilemem.memory.analyze()
+        rep = _devprof.report()
+        return {k: {f: r[f] for f in
+                    ("device_s_mean", "device_s_per_token", "mfu",
+                     "arith_intensity", "verdict") if r.get(f) is not None}
+                for k, r in rep.get("programs", {}).items()}
+    finally:
+        _devprof.disable()
+
+
 def _fleet_block():
     try:
         from paddle_tpu.observability import fleet as _fleet
@@ -378,6 +411,10 @@ def run_bench(quick=False, seed=0):
         disagg = _disagg_block(model, knobs, seed, vocab)
     except Exception as e:  # noqa: BLE001 — informational block only
         disagg = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+    try:
+        devprof_rows = _devprof_block(model, knobs, seed, vocab)
+    except Exception as e:  # noqa: BLE001 — informational block only
+        devprof_rows = {"error": f"{type(e).__name__}: {str(e)[:160]}"}
     speedup = pipe["tokens_per_sec"] / max(base["tokens_per_sec"], 1e-9)
     b_ttft = base.get("ttft_under_prefill_p50_s") or 0.0
     p_ttft = pipe.get("ttft_under_prefill_p50_s") or 0.0
@@ -418,6 +455,9 @@ def run_bench(quick=False, seed=0):
             # handoff counter deltas — informational; the headline
             # numbers above stay on the blended path
             "disagg": disagg,
+            # ISSUE 17: per-program device-time / roofline rows for the
+            # decode programs, measured on a short post-timing pass
+            "devprof": devprof_rows,
         },
     }
 

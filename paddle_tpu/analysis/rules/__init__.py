@@ -3,6 +3,7 @@ engine registry (``paddle_tpu.analysis.engine.RULES``) — a new rule module
 just needs an import line here and a ``@rule(...)`` decorator there."""
 from . import (  # noqa: F401  (imported for registration side effects)
     checkpoint,
+    devprof_seam,
     docs_drift,
     hostsync,
     ledger,

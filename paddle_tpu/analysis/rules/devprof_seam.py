@@ -1,0 +1,44 @@
+"""``devprof-seam`` — timed-dispatch device syncs only inside the devprof
+sampling seam (ISSUE 17).
+
+``observability/devprof.py`` owns the process's timed-dispatch
+``block_until_ready`` sync: the sampling cadence guarantees at most one
+blocking wait per window and the measured wall lands in the per-program
+device-time table. A raw ``block_until_ready`` anywhere else in the
+package is an unattributed, unbounded stall — it serializes the dispatch
+pipeline (exactly what the async decode path exists to avoid), is
+invisible to /perfz, and the ``hostsync`` rule only guards the traced
+callables and the decode-critical methods, not the whole tree.
+
+Deliberate exceptions carry ``# lint: devprof-seam-ok`` (e.g. the
+user-facing ``Tensor.block_until_ready`` wait API in ``distributed/``,
+or the device warm-probe).
+"""
+import ast
+
+from ..engine import Finding, rule
+
+#: the sampling seam itself — the one blessed timed-sync site
+ALLOWED = "paddle_tpu/observability/devprof.py"
+
+
+@rule("devprof-seam",
+      markers=("devprof-seam-ok",),
+      description="block_until_ready timed-dispatch syncs only inside "
+                  "observability/devprof.py's sampling seam")
+def devprof_seam(index):
+    findings = []
+    for fi in index.iter_files("paddle_tpu/"):
+        if fi.path == ALLOWED:
+            continue
+        for node in ast.walk(fi.tree):
+            if (not isinstance(node, ast.Attribute)
+                    or node.attr != "block_until_ready"):
+                continue
+            findings.append(Finding(
+                fi.path, node.lineno, "devprof-seam",
+                "raw block_until_ready outside the devprof sampling seam "
+                "is an unattributed pipeline stall — route timed syncs "
+                "through observability.devprof (or justify with "
+                "# lint: devprof-seam-ok)"))
+    return findings

@@ -87,7 +87,7 @@ def local_device_count():
 def synchronize(device=None):
     for d in jax.local_devices():
         try:
-            jax.device_put(0, d).block_until_ready()
+            jax.device_put(0, d).block_until_ready()  # lint: devprof-seam-ok (the user-facing device.synchronize API)
         except Exception:
             pass
 

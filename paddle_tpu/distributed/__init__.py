@@ -82,7 +82,7 @@ def wait(tensor, group=None, use_calc_stream=True):
     ordered; block_until_ready gives the strong guarantee."""
     t = tensor
     if hasattr(t, "_data") and hasattr(t._data, "block_until_ready"):
-        t._data.block_until_ready()
+        t._data.block_until_ready()  # lint: devprof-seam-ok (the user-facing wait API — the caller ASKED for the sync)
     return t
 
 

@@ -65,6 +65,7 @@ from ..framework import core as _core
 from ..framework.core import Tensor
 from ..generation import _make_sampler, prompt_bucket
 from ..observability import compilemem as _compilemem
+from ..observability import devprof as _devprof
 from ..observability import goodput as _goodput
 from ..observability import tracing as _trace
 from ..observability.metrics import registry as _registry
@@ -391,6 +392,10 @@ class ContinuousBatchingEngine:
         cfg = model.config
         self.model = model
         model.eval()
+        # device-time profiling plane (ISSUE 17): PADDLE_DEVPROF=1 samples
+        # one timed decode dispatch per cadence window; disabled, the
+        # dispatch path pays one is-None check
+        _devprof.arm_from_env()
         self.max_seqs = max_seqs
         self.page_size = page_size
         self.max_len = max_len
@@ -1941,6 +1946,17 @@ class ContinuousBatchingEngine:
             # the block's readback skips its 'decode' note (the cold flag
             # rides the _InflightBlock) so the same wall isn't counted twice
             _goodput.serving_note("compile", time.monotonic() - t0)
+        _dp = _devprof._PLANE
+        if _dp is not None and not cold:
+            # device-time sampling (ISSUE 17): on cadence, ONE timed
+            # dispatch — block on the token buffer inside devprof (the
+            # devprof-seam) and bank device-seconds per emitted token
+            # under the program's ledger key. Off cadence this is a
+            # counter increment and the block stays fully async; cold
+            # dispatches (compile wall) never enter the table.
+            _dp.tick(f"serve.decode[s{sampling}]" if k == 1
+                     else f"serve.decode_block[k{k},s{sampling}]",
+                     t0, blk, tokens=k * len(rows), context="serve.decode")
         last = blk[k - 1][:, None]  # device row the NEXT block chains from
         if hasattr(blk, "copy_to_host_async"):
             blk.copy_to_host_async()  # transfer rides under the compute

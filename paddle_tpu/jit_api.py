@@ -7,6 +7,7 @@ optimizer into ONE XLA program. `TrainStep` is that wrapper; `jit`/`to_static`
 are the user-facing decorators.
 """
 import functools
+import time as _time
 
 import jax
 import jax.numpy as jnp
@@ -14,6 +15,7 @@ import jax.numpy as jnp
 from .framework import random as prandom
 from .framework.core import Tensor, _bump_mutation_version, to_tensor
 from .observability import compilemem as _compilemem
+from .observability import devprof as _devprof
 from .observability import dynamics as _dynamics
 from .observability import flightrec as _flightrec
 from .observability import goodput as _goodput
@@ -237,6 +239,10 @@ class TrainStep:
         # wedges in its first compile/collective must still be diagnosable
         # (the init beat gets the watchdog's longer startup deadline)
         _watchdog.arm_from_env()
+        # device-time profiling plane (ISSUE 17): PADDLE_DEVPROF=1 samples
+        # one timed dispatch per PADDLE_DEVPROF_SAMPLE_EVERY steps;
+        # disabled, the step epilogue pays one is-None check
+        _devprof.arm_from_env()
 
         opt = optimizer
         n_lab = n_labels
@@ -437,7 +443,8 @@ class TrainStep:
         micro-batch per step, real training in one dispatch. Returns the [n]
         per-step loss array (device-resident until read)."""
         key = (n, stacked)
-        if key not in self._compiled_multi:
+        cold = key not in self._compiled_multi
+        if cold:
             self._compiled_multi[key] = self._compile_multi(n, stacked)
             # the formerly-unbounded program cache (ISSUE 8 satellite):
             # size exported per cache, warn past the configured bound
@@ -450,6 +457,8 @@ class TrainStep:
         batch_data = tuple(to_tensor(b)._data for b in batch)
         if stacked:
             self._check_stacked(batch_data, n)
+        _dp = _devprof._PLANE
+        t0 = _time.monotonic() if _dp is not None else 0.0
         try:
             chaos.site("obs.oom")
             (losses, new_params, new_buffers, self.opt_state,
@@ -463,6 +472,12 @@ class TrainStep:
         except Exception as e:
             _compilemem.maybe_oom_report(e, program="train.multi")
             raise
+        if _dp is not None and not cold:
+            # cold dispatches include the compile and would poison the
+            # device-time table; the losses buffer completes with the
+            # program, so waiting on it times the whole n-step dispatch
+            _dp.tick(f"train.multi[n={n},stacked={stacked}]", t0, losses,
+                     context="train")
         return self._finish_run_steps(losses, new_params, new_buffers, n)
 
     def _finish_run_steps(self, losses, new_params, new_buffers, n):
@@ -603,6 +618,8 @@ class TrainStep:
                 # of the dispatch commits telemetry/oom_report.json before
                 # re-raising; the obs.oom chaos site injects one
                 # deterministically for tests
+                _dp = _devprof._PLANE
+                t0 = _time.monotonic() if _dp is not None else 0.0
                 try:
                     chaos.site("obs.oom")
                     (loss, new_params, new_buffers, self.opt_state,
@@ -615,6 +632,11 @@ class TrainStep:
                 except Exception as e:
                     _compilemem.maybe_oom_report(e, program="train.step")
                     raise
+                if _dp is not None and not first:
+                    # first dispatch includes the XLA compile; the loss
+                    # buffer completes with the fused program, so waiting
+                    # on it times the full step's device execution
+                    _dp.tick("train.step", t0, loss, context="train")
         self._dispatched = True
         # write state back into the dygraph objects
         for k, v in new_params.items():

@@ -20,6 +20,7 @@ it is the EventCounters cost model. Span tracing and goodput timers are
 docs/OBSERVABILITY.md for the metric/span taxonomy and env vars.
 """
 from . import compilemem  # noqa: F401
+from . import devprof  # noqa: F401
 from . import dynamics  # noqa: F401
 from . import fleet  # noqa: F401
 from . import flightrec  # noqa: F401
@@ -32,6 +33,7 @@ from .compilemem import (  # noqa: F401
     ledgered_jit,
     record_compile,
 )
+from .devprof import DevProfPlane  # noqa: F401
 from .dynamics import DynamicsMonitor  # noqa: F401
 from .fleet import FleetAggregator, SnapshotPublisher  # noqa: F401
 from .flightrec import FlightRecorder  # noqa: F401
@@ -66,4 +68,5 @@ __all__ = [
     "CompileLedger", "MemoryLedger", "ledgered_jit", "record_compile",
     "fleet", "FleetAggregator", "SnapshotPublisher",
     "dynamics", "DynamicsMonitor", "flightrec", "FlightRecorder",
+    "devprof", "DevProfPlane",
 ]

@@ -554,6 +554,32 @@ def _analysis_dict(ma):
     return out
 
 
+def _cost_dict(compiled):
+    """``compiled.cost_analysis()`` distilled to the devprof join keys
+    ({flops, bytes, transcendentals}, floats). Defensive on purpose: the
+    API has returned a dict, a list of dicts, and nothing at all across
+    jax versions/backends (CPU often omits byte counts) — a missing cost
+    row must degrade the roofline, never break the memory harvest."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    for src, short in (("flops", "flops"), ("bytes accessed", "bytes"),
+                       ("transcendentals", "transcendentals")):
+        try:
+            v = float(ca.get(src, 0) or 0)
+        except (TypeError, ValueError):
+            continue
+        if v > 0:
+            out[short] = v
+    return out or None
+
+
 class MemoryLedger:
     """HBM budget ledger + lazy per-program ``memory_analysis()`` harvest.
 
@@ -646,8 +672,8 @@ class MemoryLedger:
             ref = lambda j=jitted: j  # noqa: E731 — unweakrefable: pin it
         with self._lock:
             self._programs[str(key)] = {
-                "jitted": ref, "abstract": abstract,
-                "signature": signature, "analysis": None, "error": None,
+                "jitted": ref, "abstract": abstract, "signature": signature,
+                "analysis": None, "cost": None, "error": None,
             }
             self._programs.move_to_end(str(key))
             while len(self._programs) > self._max_programs:
@@ -677,8 +703,10 @@ class MemoryLedger:
                 with _compile_lock(), ledger.suppressed():
                     compiled = jitted.lower(*a, **kw).compile()  # compile-ledger-ok (the ledger's own suppressed analysis)
                     analysis = _analysis_dict(compiled.memory_analysis())
+                    cost = _cost_dict(compiled)
                 with self._lock:
                     v["analysis"] = analysis
+                    v["cost"] = cost
                     v["error"] = None
                 out[k] = analysis
             except Exception as e:
@@ -690,12 +718,31 @@ class MemoryLedger:
         return out
 
     def programs(self):
-        """{key: {signature, analysis|None, error|None}} — no analysis is
-        forced; un-analyzed programs show ``analysis: None``."""
+        """{key: {signature, analysis|None, cost|None, error|None}} — no
+        analysis is forced; un-analyzed programs show ``analysis: None``."""
         with self._lock:
             return {k: {"signature": v["signature"],
-                        "analysis": v["analysis"], "error": v["error"]}
+                        "analysis": v["analysis"],
+                        "cost": v.get("cost"), "error": v["error"]}
                     for k, v in self._programs.items()}
+
+    def program_cost(self, key):
+        """The devprof join hook: the cached cost_analysis row for one
+        program (flops + bytes), with byte counts backfilled from the
+        memory analysis when cost_analysis omitted them (CPU backends
+        report flops but not traffic). None until analyzed."""
+        with self._lock:
+            v = self._programs.get(str(key))
+            if v is None:
+                return None
+            cost = dict(v.get("cost") or {})
+            analysis = v["analysis"]
+        if "bytes" not in cost and analysis and "error" not in analysis:
+            nbytes = (analysis.get("argument_bytes", 0)
+                      + analysis.get("output_bytes", 0))
+            if nbytes > 0:
+                cost["bytes"] = float(nbytes)
+        return cost or None
 
     def top_programs_by_temp(self, n=5):
         """The analyzed programs ranked by temp bytes — the OOM report's

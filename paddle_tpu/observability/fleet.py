@@ -119,7 +119,7 @@ def _registry_token(registry):
 #: bites, the cross-rank-interesting families survive first
 _PRIORITY = ("span.", "collective.", "serving.", "serve.", "slo.",
              "train.", "data.", "fleet.", "elastic.", "goodput.",
-             "compile.", "device.")
+             "compile.", "device.", "devprof.")
 
 #: step-phase families the straggler detector reads, most specific first
 _STEP_FAMILIES = ("span.train.step.dispatch_s", "span.train.step_s",
@@ -359,6 +359,7 @@ class SnapshotPublisher:
             "compile": _compilemem.ledger.counts(),
             "collectives": self.collectives.export(),
             "dynamics": _dynamics_snapshot_block(),
+            "devprof": _devprof_snapshot_block(),
         }
         if self.extra_provider is not None:
             try:
@@ -414,6 +415,20 @@ def _dynamics_snapshot_block():
     return {k: last.get(k) for k in
             ("step", "updates", "loss", "loss_ewma", "loss_z", "grad_norm",
              "nonfinite_steps", "nonfinite_first")}
+
+
+def _devprof_snapshot_block():
+    """This process's per-program mean device-seconds (ISSUE 17), bounded
+    to the costliest programs — the aggregator medians these across ranks
+    to flag the rank whose CHIP is slow (the straggler detector can only
+    say a rank's step is slow; this says the same program takes longer on
+    this device). None when devprof is off or nothing has sampled."""
+    try:
+        from . import devprof as _dp
+
+        return _dp.fleet_block()
+    except Exception:
+        return None
 
 
 #: cached process publisher: False = no telemetry dir (permanent no-op),
@@ -553,6 +568,7 @@ class FleetAggregator:
         self._prev_totals = {}      # rank -> last advancing-round totals
         self._persistent = set()
         self._gn_flagged = set()    # ranks currently grad-norm-skew-flagged
+        self._dp_flagged = set()    # ranks currently device-time-flagged
         self._scored_ranks = set()  # ranks with a live score gauge
         self._skew_phases = set()   # phases with a live skew gauge
         self._rounds = 0
@@ -680,6 +696,7 @@ class FleetAggregator:
             [s for s in sources if s.get("role", "rank") == "rank"])
         straggler = self._straggler(rank_snaps, advance=advance)
         dynamics = self._dynamics_agg(rank_snaps, advance=advance)
+        devprof = self._devprof_agg(rank_snaps, advance=advance)
         now = time.time()
         members = {}
         for (role, r), s in sorted(by_id.items()):
@@ -708,6 +725,7 @@ class FleetAggregator:
             "phases": phases,
             "straggler": straggler,
             "dynamics": dynamics,
+            "devprof": devprof,
             "serving": self._serving_agg(replica_snaps),
             "errors": list(errors),
         }
@@ -902,6 +920,84 @@ class FleetAggregator:
                         help="grad-norm-skew flag transitions (off -> on) "
                              "per rank across merges").inc(len(newly))
                 self._gn_flagged = flagged
+        return out
+
+    def _devprof_agg(self, rank_snaps, advance=True):
+        """Merge the per-rank devprof blocks (ISSUE 17) into the sick-chip
+        view: every data-parallel rank runs the SAME compiled programs, so
+        per-program device time off the cross-rank median is a device
+        problem (thermal throttle, degraded HBM, bad chip), not a slow
+        host — the exact complement of the straggler detector's
+        compute-vs-wait split. A rank's score is the median over shared
+        programs of (rank device time / fleet-median device time); the
+        threshold reuses the straggler ratio and transitions count into
+        ``fleet.devprof.skew_alerts``."""
+        per_rank = {}
+        for r, s in rank_snaps.items():
+            d = s.get("devprof")
+            if isinstance(d, dict) and d.get("programs"):
+                per_rank[r] = {str(k): float(v)
+                               for k, v in d["programs"].items()
+                               if isinstance(v, (int, float)) and v > 0}
+        per_rank = {r: p for r, p in per_rank.items() if p}
+        if not per_rank:
+            # devprof went away (disabled on restart, nothing sampled):
+            # retire the gauge + flag state on ADVANCING rounds only —
+            # same contract as the dynamics retirement above
+            if advance:
+                with self._lock:
+                    self._dp_flagged = set()
+                self.registry.remove("fleet.devprof.skew")
+            return None
+        # fleet-median device time per program, over the ranks that ran it
+        medians = {}
+        for p in per_rank.values():
+            for k in p:
+                medians.setdefault(k, []).append(p[k])
+        medians = {k: _median(v) for k, v in medians.items()}
+        scores = {}
+        for r, p in per_rank.items():
+            ratios = sorted(p[k] / medians[k] for k in p if medians[k] > 0)
+            if ratios:
+                scores[r] = round(_median(ratios), 4)
+        if not scores:
+            return None
+        worst = max(scores, key=scores.get)
+        skew = scores[worst]
+        self.registry.gauge(
+            "fleet.devprof.skew",
+            help="max-rank per-program device time / fleet median at the "
+                 "last merge (a sick chip shows here; a slow host shows "
+                 "in the straggler split)").set(skew)
+        flagged = set()
+        if len(scores) >= 2:
+            # both tails: slow = the sick chip; implausibly FAST means
+            # the rank is not running the same work (sharding/config
+            # divergence) — the tail a slow-only ratio never sees
+            flagged = {r for r, v in scores.items()
+                       if v >= self.threshold or v <= 1.0 / self.threshold}
+        out = {
+            "ranks": {str(r): {
+                "score": scores.get(r),
+                "programs": {k: round(v, 9) for k, v in
+                             sorted(p.items())},
+            } for r, p in sorted(per_rank.items())},
+            "program_median_s": {k: round(v, 9)
+                                 for k, v in sorted(medians.items())},
+            "max_rank": worst,
+            "skew": skew,
+            "flagged": sorted(flagged),
+        }
+        if advance:
+            with self._lock:
+                newly = flagged - self._dp_flagged
+                if newly:
+                    self.registry.counter(
+                        "fleet.devprof.skew_alerts",
+                        help="per-program device-time skew flag "
+                             "transitions (off -> on) per rank across "
+                             "merges").inc(len(newly))
+                self._dp_flagged = flagged
         return out
 
     # ---- straggler detection ----------------------------------------------

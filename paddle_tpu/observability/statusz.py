@@ -29,6 +29,11 @@ Routes (GET only):
   capture of the next K train steps via the flight recorder's capture
   registry, ``?disarm=1`` cancels it; bare GET returns capture status +
   history.
+- ``/perfz``    — device-time profiling (ISSUE 17): per-program
+  device-seconds, achieved FLOP/s and bandwidth, MFU, roofline verdicts,
+  the serving decode-token budget and the training step split
+  (``?program=<key-prefix>`` filters, ``?analyze=1`` forces the cost
+  harvest).
 - ``/healthz``  — liveness: 200 with per-replica / per-rank heartbeat ages,
   503 when nothing can serve (no LIVE replica) or every heartbeat is stale.
 
@@ -99,6 +104,8 @@ class StatusServer:
                 lambda q: (200, self.dynamicsz())),
             "/profilez": self._route_json(
                 lambda q: (200, self.profilez(q))),
+            "/perfz": self._route_json(
+                lambda q: (200, self.perfz(q))),
             "/healthz": self._route_json(lambda q: self.healthz()),
         }
 
@@ -256,6 +263,24 @@ class StatusServer:
         if m:
             return flightrec.arm_capture(int(m.group(1)), trigger="http")
         return flightrec.capture_status()
+
+    def perfz(self, query):
+        """The device-time profiling surface (ISSUE 17): per-program
+        device-seconds, MFU, and roofline verdicts from the devprof
+        plane. ``?program=<key-prefix>`` filters rows (URL-encoded —
+        program keys contain brackets); ``?analyze=1`` forces the
+        compile-ledger cost harvest for not-yet-analyzed programs."""
+        import re as _re
+        import urllib.parse as _up
+
+        from . import devprof
+
+        program = None
+        m = _re.search(r"(?:^|&)program=([^&]*)", query or "")
+        if m and m.group(1):
+            program = _up.unquote(m.group(1))
+        return devprof.report(analyze="analyze=1" in (query or ""),
+                              program=program)
 
     def _heartbeats(self):
         """{rank: age_s} from the PR-2 heartbeat files, when a telemetry

@@ -50,6 +50,7 @@ from ..inference.continuous import (
     canonical_sampling,
 )
 from ..observability import compilemem as _compilemem
+from ..observability import devprof as _devprof
 from ..observability import fleet as _fleet
 from ..observability import goodput as _goodput
 from ..observability import request_trace as _rtrace
@@ -1729,6 +1730,11 @@ class ServingFrontend:
             # history and the circuit breaker's per-replica scores
             "brownout": self.brownout.report(),
             "breaker": self.breaker.report(),
+            # device-time attribution (ISSUE 17): per-program
+            # device-seconds / MFU / roofline verdicts and the decode
+            # device-s-per-token budget ({"enabled": False} while the
+            # devprof plane is disarmed)
+            "devprof": _devprof.serving_block(),
         }
         if self.supervisor is not None:
             out["supervisor"] = self.supervisor.report()

@@ -72,6 +72,7 @@ FAST_TESTS=(
   tests/test_fleet_obs.py
   tests/test_dynamics.py
   tests/test_disagg.py
+  tests/test_devprof.py
 )
 
 if [[ "${1:-}" == "--fast" ]]; then

@@ -188,6 +188,30 @@ class TestProfilerCapture:
         assert len(out) == 1 and "stop_trace" in out[0].message
 
 
+class TestDevprofSeam:
+    def test_violation_clean_marker(self, tmp_path):
+        out = findings_for(tmp_path, {
+            "paddle_tpu/a.py": "x = arr.block_until_ready()\n",
+            # the sampling seam itself is the blessed site
+            "paddle_tpu/observability/devprof.py":
+                "import jax\njax.block_until_ready(arrays)\n",
+            "paddle_tpu/b.py": "from paddle_tpu.observability import "
+                               "devprof\n"
+                               "devprof.plane().tick(k, t0, out)\n",
+            "paddle_tpu/c.py": "w = t.block_until_ready()  "
+                               "# lint: devprof-seam-ok (user wait API)\n",
+        }, ["devprof-seam"])
+        assert [f.path for f in out] == ["paddle_tpu/a.py"]
+        assert "sampling seam" in out[0].message
+
+    def test_module_call_form(self, tmp_path):
+        out = findings_for(tmp_path, {
+            "paddle_tpu/d.py": "import jax\n"
+                               "jax.block_until_ready(loss)\n"},
+            ["devprof-seam"])
+        assert [f.line for f in out] == [2]
+
+
 class TestMetricDocDrift:
     DOC = ("| Name | Meaning |\n|---|---|\n"
            "| `good.metric` | fine |\n"
@@ -634,7 +658,7 @@ class TestEngine:
             "compile-ledger", "metric-doc-drift", "ckpt-atomic-write",
             "elastic-membership", "lock-order", "blocking-under-lock",
             "shared-mutation-without-lock", "env-registry",
-            "chaos-site-registry", "profiler-capture",
+            "chaos-site-registry", "profiler-capture", "devprof-seam",
         }
         assert tested == set(RULES)
 
