@@ -20,8 +20,10 @@ PKG = "paddle_tpu/distributed/checkpoint/"
 #: files OUTSIDE the checkpoint package that carry the same torn-file
 #: obligation: a KV-page handoff bundle is adopted by another process's
 #: replica mid-request, so its writes need the identical temp+fsync+rename
-#: discipline (ISSUE 16)
-ATOMIC_WRITE_PATHS = (PKG, "paddle_tpu/serving/handoff.py")
+#: discipline (ISSUE 16); the wire transport (ISSUE 18) carries the same
+#: frames, so any file it writes is held to the same rule
+ATOMIC_WRITE_PATHS = (PKG, "paddle_tpu/serving/handoff.py",
+                      "paddle_tpu/serving/transport.py")
 
 _MODE = re.compile(r"[rwaxbtU+]{1,4}\Z")
 

@@ -33,6 +33,17 @@ _SCOPES = ("paddle_tpu/",)
 #: round-trip) — forbidden while holding a lock
 _BLOCKING_ATTRS = {"result", "block_until_ready", "device_get"}
 _STORE_CTORS = {"TCPStore"}
+#: socket DIALS (ISSUE 18): opening/accepting a connection blocks for a
+#: network round-trip (or the connect timeout) — the KV wire transport
+#: must never dial under the dispatch or router locks. Post-dial
+#: sendall/recv on an already-connected per-request socket is exempt
+#: here: the store client's request lock exists to serialize exactly
+#: that, and each RPC's socket is private to its call.
+_SOCKET_DIALS = {"connect", "accept", "create_connection"}
+#: digest validation (ISSUE 18): bundle/blob validation recomputes
+#: blake2b chains over megabytes of pages — CPU-bound work no lock
+#: holder should do
+_DIGEST_ATTRS = {"verify_prompt_digests", "unframe_blob"}
 
 
 def _model(index):
@@ -156,9 +167,19 @@ def blocking_under_lock(index):
                     hit = "store dial"
                 elif name == "np.asarray":
                     hit = "device sync (np.asarray)"
+                elif name is not None and \
+                        name.split(".")[-1] in ("create_connection",
+                                                "unframe_blob"):
+                    hit = f"{name.split('.')[-1]}"
                 elif isinstance(node.func, ast.Attribute):
                     a = node.func.attr
-                    if a in ("wait", "wait_for"):
+                    if a in _SOCKET_DIALS:
+                        hit = f"socket dial (.{a}())"
+                    elif a in _DIGEST_ATTRS:
+                        hit = f"digest validation (.{a}())"
+                    elif a == "from_bytes" and "Bundle" in (name or ""):
+                        hit = "bundle digest validation (.from_bytes())"
+                    elif a in ("wait", "wait_for"):
                         # Condition.wait on the HELD lock is the designed
                         # pattern; waiting on anything else while holding
                         # a lock starves the lock's other users

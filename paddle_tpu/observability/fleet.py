@@ -1351,6 +1351,11 @@ def serving_rollup(replica_snapshots, slo_report, goodput_report):
             occs.append((s.get("active") or 0) / max_seqs)
             slots += max_seqs
     occupancy_mean = round(sum(occs) / len(occs), 4) if occs else 0.0
+    # cluster KV fabric (ISSUE 18): advertised prefix residency summed
+    # across replicas — the router scores placement against this index,
+    # so the rollup is how an operator sees the cluster cache's size
+    kv_resident = sum(s.get("kv_resident") or 0
+                      for s in replica_snapshots.values())
     # the multi-window AND: an objective pages only when BOTH windows
     # burn, so min(fast, slow) is the page-relevant burn per objective
     worst_burn, worst_objective = 0.0, None
@@ -1388,6 +1393,10 @@ def serving_rollup(replica_snapshots, slo_report, goodput_report):
     _registry.gauge(
         "fleet.serving.pressure",
         help="blended autoscaling pressure signal (0..1)").set(pressure)
+    _registry.gauge(
+        "fleet.serving.kv_resident",
+        help="cluster KV-fabric prefix entries advertised across "
+             "replicas").set(kv_resident)
     # per-role sub-rollup (ISSUE 16): a disaggregated fleet's prefill and
     # decode pools saturate independently, so each role gets its own
     # pressure + scale_hint — the supervisor scales the pools off these,
@@ -1448,4 +1457,5 @@ def serving_rollup(replica_snapshots, slo_report, goodput_report):
         "pressure": pressure,
         "scale_hint": scale_hint,
         "roles": roles,
+        "kv_resident": kv_resident,
     }

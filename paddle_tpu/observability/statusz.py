@@ -106,6 +106,7 @@ class StatusServer:
                 lambda q: (200, self.profilez(q))),
             "/perfz": self._route_json(
                 lambda q: (200, self.perfz(q))),
+            "/kvz": self._route_json(lambda q: (200, self.kvz())),
             "/healthz": self._route_json(lambda q: self.healthz()),
         }
 
@@ -150,6 +151,21 @@ class StatusServer:
             except Exception as e:  # a shut-down frontend must not 500
                 out["serving"] = {"error": f"{type(e).__name__}: {e}"}
         return out
+
+    def kvz(self):
+        """Cluster KV fabric view (ISSUE 18): tier hit/fallthrough
+        counters, spill-ring occupancy, residency by owner — the
+        frontend fabric's ``report()``, armored like every other route
+        (a frontend-less or shut-down server answers shaped JSON)."""
+        fe = self.frontend
+        fab = getattr(fe, "kvfabric", None) if fe is not None else None
+        if fab is None:
+            return {"enabled": False,
+                    "error": "no serving frontend (or no KV fabric) bound"}
+        try:
+            return fab.report()
+        except Exception as e:
+            return {"enabled": False, "error": f"{type(e).__name__}: {e}"}
 
     def _elastic(self):
         """Elastic membership view: the configured provider (launcher), or

@@ -38,13 +38,25 @@ Layering (each file is one concern, unit-testable alone):
   (ISSUE 16): atomic validated bundles, generation fencing, bounded
   publish retry, and the blended degradation contract (a handoff failure
   costs latency, never a wrong token and never availability).
+- ``transport.py`` — the wire transport (ISSUE 18): the same validated
+  bundle frames over a TCPStore-style socket channel
+  (``PADDLE_KV_TRANSPORT=wire``; ``spool`` keeps the PR-16 directory
+  path byte-identical), plus the fabric's peer blob fetches — typed
+  KVFetchTimeout/KVPartitionError failures, bounded-backoff retries.
+- ``kvfabric.py``  — cluster tiered KV-prefix cache (ISSUE 18): device
+  pool → host spill ring → peer fetch → recompute, with residency
+  advertisements the router and fleet rollup score placement against;
+  every failure a typed ``kv.fallthrough{reason=}`` into recompute.
 
 Chaos sites ``serving.route`` / ``serving.replica_kill`` /
 ``serving.replica_slow`` / ``serving.spawn_fail`` / ``supervisor.decision``
 / ``serving.handoff.send`` / ``serving.handoff.adopt`` /
-``serving.handoff.corrupt`` / ``serving.decode_pool_empty``
+``serving.handoff.corrupt`` / ``serving.decode_pool_empty`` /
+``serving.kv.fetch`` / ``serving.kv.timeout`` / ``serving.kv.partition``
+/ ``serving.kv.corrupt``
 make the failure paths deterministically testable (tests/
-test_serving_frontend.py, tests/test_supervisor.py, tests/test_disagg.py).
+test_serving_frontend.py, tests/test_supervisor.py, tests/test_disagg.py,
+tests/test_kvfabric.py).
 docs/SERVING.md is the operator guide; every later serving PR
 (multi-model) builds on this subsystem.
 """
@@ -74,6 +86,7 @@ from .handoff import (  # noqa: F401
     HandoffManager,
     StaleHandoffError,
 )
+from .kvfabric import HostSpillRing, KVFabric  # noqa: F401
 from .router import (  # noqa: F401
     DEAD,
     DRAINING,
@@ -92,6 +105,14 @@ from .scheduler import (  # noqa: F401
     SLOScheduler,
 )
 from .supervisor import ReplicaFence, ReplicaSupervisor  # noqa: F401
+from .transport import (  # noqa: F401
+    KVFetchTimeout,
+    KVPageServer,
+    KVPartitionError,
+    KVTransportError,
+    WireTransport,
+    make_transport,
+)
 
 __all__ = [
     "ServingFrontend", "RequestHandle", "RequestFailed", "RequestCancelled",
@@ -106,4 +127,7 @@ __all__ = [
     "ReplicaSupervisor", "ReplicaFence",
     "HandoffManager", "HandoffBundle", "HandoffError",
     "HandoffCorruptError", "StaleHandoffError",
+    "KVFabric", "HostSpillRing",
+    "WireTransport", "KVPageServer", "make_transport",
+    "KVTransportError", "KVFetchTimeout", "KVPartitionError",
 ]

@@ -12,19 +12,25 @@ pressure drains:
                                 (``prefill_depth_cap()``) — new prompt
                                 work queues a little so in-flight decode
                                 keeps its TPOT; nothing is rejected
-    level 2  clamp_tokens       batch-class max_new_tokens clamped
+    level 2  shed_peer_fetch    cluster KV-fabric peer fetches off
+                                (``peer_fetch_enabled()``) — a fetch
+                                spends wire bandwidth and adopt work to
+                                SAVE compute, which is the wrong trade
+                                once the fleet is pressed; local
+                                recompute is bit-identical anyway
+    level 3  clamp_tokens       batch-class max_new_tokens clamped
                                 (bounded decode work per batch request)
-    level 3  shed_extras        optional work off: hedged/speculative
+    level 4  shed_extras        optional work off: hedged/speculative
                                 extras are declared disabled
                                 (``extras_enabled()``), the router skips
                                 the O(prompt-bytes) prefix-affinity probe
                                 and places by load alone, and no
                                 per-request traces are minted
-    level 4  shed_batch         batch-class submits rejected with a
+    level 5  shed_batch         batch-class submits rejected with a
                                 machine-readable
                                 ``Overloaded(retry_after_s=)``;
                                 interactive still served
-    level 5  reject             everything rejected with ``Overloaded``
+    level 6  reject             everything rejected with ``Overloaded``
 
 Engagement is pressure-driven with hysteresis: a step engages the moment
 pressure crosses its ``engage_at`` (climbing one rung per observation so
@@ -55,10 +61,11 @@ from ..observability.metrics import registry as _registry
 from .scheduler import Overloaded
 
 __all__ = ["BrownoutStep", "BrownoutLadder", "RetryBudget",
-           "DEFAULT_STEPS", "SHED_PREFILL_DEPTH", "CLAMP_TOKENS",
-           "SHED_EXTRAS", "SHED_BATCH", "REJECT"]
+           "DEFAULT_STEPS", "SHED_PREFILL_DEPTH", "SHED_PEER_FETCH",
+           "CLAMP_TOKENS", "SHED_EXTRAS", "SHED_BATCH", "REJECT"]
 
 SHED_PREFILL_DEPTH = "shed_prefill_depth"
+SHED_PEER_FETCH = "shed_peer_fetch"
 CLAMP_TOKENS = "clamp_tokens"
 SHED_EXTRAS = "shed_extras"
 SHED_BATCH = "shed_batch"
@@ -95,6 +102,11 @@ DEFAULT_STEPS = (
     # admitted request are untouched — so it engages well before anything
     # that clamps or rejects
     BrownoutStep(SHED_PREFILL_DEPTH, engage_at=0.72, release_at=0.55),
+    # peer KV fetches next (ISSUE 18): a fetch trades wire + adopt work
+    # for saved prefill compute — a good trade only while there is slack.
+    # Shedding it costs nothing but the cache win; recompute is
+    # bit-identical, so this rung is invisible to correctness.
+    BrownoutStep(SHED_PEER_FETCH, engage_at=0.76, release_at=0.58),
     BrownoutStep(CLAMP_TOKENS, engage_at=0.80, release_at=0.60),
     BrownoutStep(SHED_EXTRAS, engage_at=0.88, release_at=0.70),
     BrownoutStep(SHED_BATCH, engage_at=0.94, release_at=0.78),
@@ -238,6 +250,13 @@ class BrownoutLadder:
         """False from ``shed_extras`` up: hedged/speculative extras,
         affinity probing, and per-request trace minting are off."""
         return not self._engaged_at_least(SHED_EXTRAS)
+
+    def peer_fetch_enabled(self):
+        """False from ``shed_peer_fetch`` up: the KV fabric skips the
+        peer-fetch tier and falls straight through to local recompute
+        (counted ``kv.fallthrough{reason=peer_fetch_shed}`` when a
+        candidate actually existed)."""
+        return not self._engaged_at_least(SHED_PEER_FETCH)
 
     def prefill_depth_cap(self):
         """Max concurrent chunked prefills per replica (None = uncapped):

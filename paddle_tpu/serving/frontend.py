@@ -64,10 +64,11 @@ from .brownout import BrownoutLadder
 from .handoff import (
     HandoffBundle,
     HandoffError,
-    HandoffManager,
     StaleHandoffError,
     page_digests,
 )
+from .kvfabric import KVFabric
+from .transport import make_transport
 from .router import (
     ADMITTING,
     DEAD,
@@ -148,7 +149,7 @@ class _Entry:
                  "observed", "route_affinity", "route_score", "probe",
                  "trace", "attempt_span", "queue_span", "attempt_n",
                  "target_role", "needs_handoff", "handoff_gen",
-                 "bundle_path", "bundle")
+                 "bundle_path", "bundle", "kv_hint_deferred")
 
     def __init__(self, req, handle, slo, deadline_t, virtual_deadline):
         self.req = req
@@ -176,6 +177,9 @@ class _Entry:
         self.handoff_gen = 0
         self.bundle_path = None
         self.bundle = None
+        # cluster KV fabric (ISSUE 18): a peer-residency placement defers
+        # the router's session-hint write until the adoption lands
+        self.kv_hint_deferred = False
 
 
 class RequestHandle:
@@ -378,7 +382,7 @@ class ServingFrontend:
                  brownout=None, breaker=None, engine_factory=None,
                  start=True, warmup=None,
                  slo_monitor=None, statusz_port=None,
-                 roles=None, handoff=None):
+                 roles=None, handoff=None, kvfabric=None):
         # heartbeat_deadline_s must outlast the longest single engine call —
         # a first-compile prefill through a remote-compile tunnel can take
         # tens of seconds (PROFILE.md), and a false DEAD verdict reroutes a
@@ -414,9 +418,18 @@ class ServingFrontend:
                           role=(roles[i] if roles else "blended"))
             for i, eng in enumerate(engines)]
         self._disagg_enabled = env_bool("PADDLE_SERVING_DISAGG", True)
-        # KV-page handoff transport (spool dir + deadline/retry policy);
-        # injectable for tests, env-tuned by default (PADDLE_HANDOFF_*)
-        self.handoff = handoff or HandoffManager()
+        # KV-page handoff transport (ISSUE 18): PADDLE_KV_TRANSPORT picks
+        # spool (the PR 16 directory path, default, byte-identical) or
+        # wire (transport.WireTransport); injectable for tests
+        self.handoff = handoff or make_transport()
+        # cluster KV fabric (ISSUE 18): tiered prefix cache + residency
+        # map. Constructed even when PADDLE_KV_FABRIC=0 (it no-ops
+        # internally) so /kvz and serving_report stay shaped; the wire
+        # transport is shared with handoff when one is configured
+        self.kvfabric = kvfabric or KVFabric(
+            name="frontend",
+            transport=self.handoff if hasattr(self.handoff, "fetch_blob")
+            else None)
         self._by_name = {r.name: r for r in self.replicas}
         self._lock = threading.Lock()
         self._rid_counter = itertools.count()
@@ -447,6 +460,10 @@ class ServingFrontend:
         # The router consults it for half-open probe placements.
         self.breaker = breaker or CircuitBreaker()
         self.router.breaker = self.breaker
+        # the router scores placement against the CLUSTER-wide prefix
+        # index: peer-resident prefixes become transfer-discounted
+        # affinity (router.place reads fabric.resident_owners)
+        self.router.fabric = self.kvfabric
         # replica index allocator for add_replica (heartbeat-file rank
         # namespace must never reuse a live index)
         self._next_index = len(self.replicas)
@@ -521,6 +538,9 @@ class ServingFrontend:
                 self.handoff.discard(e.bundle_path)
                 e.bundle_path = None
             e.handle._fail("frontend shut down")
+        close = getattr(self.handoff, "close", None)
+        if close is not None:
+            close()
 
     def __enter__(self):
         return self
@@ -811,12 +831,19 @@ class ServingFrontend:
             except StaleHandoffError as e:
                 # a superseded prefill's late bundle: drop it, re-prefill
                 entry.bundle_path = None
+                self.kvfabric.count_fallthrough(
+                    getattr(e, "reason", None) or "stale")
                 self._reprefill(entry, f"stale handoff bundle: {e}")
                 return "requeued"
             except HandoffError as e:
                 # torn/corrupt (or unreadable) bundle: the typed-error
-                # contract — never adopt, never a wrong token; re-prefill
+                # contract — never adopt, never a wrong token; re-prefill.
+                # The wire transport's typed errors carry .reason
+                # (timeout/partition/transport); spool corruption is
+                # "corrupt" — either way the fallthrough is counted typed
                 entry.bundle_path = None
+                self.kvfabric.count_fallthrough(
+                    getattr(e, "reason", None) or "corrupt")
                 self._reprefill(entry, f"handoff bundle rejected: {e}")
                 return "requeued"
             entry.bundle = bundle
@@ -844,6 +871,57 @@ class ServingFrontend:
         elif status == "failed":
             entry.bundle = None
         return status
+
+    def _kv_acquire(self, rep, entry):
+        """Walk the fabric's tier ladder for a reusable prefix before the
+        engine prefills from scratch. Pages land via the engine's OPTIONAL
+        ``adopt_prefix(prompt, payload)`` seam (duck-typed — the stock
+        engine's own prefix index already covers the device tier, so only
+        engines that opt in adopt fabric entries). Every failure here is
+        either counted inside acquire() or swallowed into recompute — this
+        call can never fail an admission."""
+        fab = self.kvfabric
+        eng = rep.engine
+        adopt = getattr(eng, "adopt_prefix", None)
+        if fab is None or not fab.enabled or adopt is None:
+            return
+        try:
+            got = fab.acquire(entry.req.prompt, eng.page_size,
+                              allow_peer=self.brownout.peer_fetch_enabled())
+            if got is None:
+                return
+            kv_entry, _tier = got
+            adopt(kv_entry["prompt"], kv_entry["payload"])
+        except Exception:
+            # adoption is strictly best-effort; the prefill below is the
+            # unconditional, bit-identical floor
+            fab.count_fallthrough("adopt_failed")
+
+    def _kv_note_admitted(self, rep, entry):
+        """The entry's pages are resident on ``rep`` now: release the
+        router's deferred cluster hint (a peer-routed placement only
+        re-homes session stickiness once something actually landed) and
+        advertise the prompt's prefix residency into the fabric. The
+        engine may also export the prefix into the host spill ring via
+        the optional ``export_prefix(prompt)`` seam."""
+        fab = self.kvfabric
+        try:
+            self.router.adoption_landed(entry, rep)
+        except Exception:
+            pass
+        if fab is None or not fab.enabled:
+            return
+        eng = rep.engine
+        try:
+            fab.advertise_prompt(entry.req.prompt, eng.page_size, rep.name)
+            export = getattr(eng, "export_prefix", None)
+            if export is not None:
+                payload = export(entry.req.prompt)
+                if payload is not None:
+                    fab.spill_prefix(entry.req.prompt, eng.page_size,
+                                     payload, owner=rep.name)
+        except Exception:
+            pass        # residency is advisory; admission already happened
 
     def _reprefill(self, entry, reason):
         """A handoff failed en route to (or at) the decode pool: clone the
@@ -1074,6 +1152,11 @@ class ServingFrontend:
                     # this replica's pool instead of prefilling from scratch
                     status = self._adopt_one(rep, entry)
                 else:
+                    # cluster KV fabric (ISSUE 18): before prefilling from
+                    # scratch, try the tier ladder (host spill -> peer
+                    # fetch) for a reusable prefix; any failure falls
+                    # through to the recompute below, bit-identically
+                    self._kv_acquire(rep, entry)
                     status = eng.try_admit_one(entry.req)
             except BaseException:
                 # the raise is about to reach _run_replica, whose handler
@@ -1126,6 +1209,7 @@ class ServingFrontend:
                         rep.inflight[entry.req.rid] = entry
                 entry.handle._mark_running(rep.name)
                 self._observe_admission(entry)
+                self._kv_note_admitted(rep, entry)
                 if entry.handle._cancel_requested:
                     entry.req.cancelled = True  # retires at next block
                 if dead:  # death sweep missed the in-transit entry
@@ -1140,6 +1224,7 @@ class ServingFrontend:
             elif status == "done":
                 entry.handle._mark_running(rep.name)
                 self._observe_admission(entry)
+                self._kv_note_admitted(rep, entry)
                 self._finish(rep, entry.req, entry=entry)
             else:  # "failed"
                 if entry.probe:
@@ -1271,6 +1356,9 @@ class ServingFrontend:
         _M_REPLICA_DEAD.inc()
         self.router.forget_replica(rep.name)
         self.breaker.forget(rep.name)
+        # a corpse must neither attract fabric-aware placements nor be
+        # dialed for peer fetches: drop its residency advertisements
+        self.kvfabric.evict_replica(rep.name)
         reason = f"replica {rep.name} died: {rep.death_reason}"
         for entry in pending:
             self._requeue(entry, exclude={rep.name}, fail_reason=reason)
@@ -1383,6 +1471,11 @@ class ServingFrontend:
             now = time.monotonic()
             for rep in self.replicas:
                 self._check_replica_liveness(rep, now)
+                # fabric residency rollup feed (ISSUE 18): stamped here so
+                # the replica snapshot (and the fleet aggregator's
+                # fleet.serving.kv_resident sum) tracks the fabric map
+                # without a lock — single monitor writer, advisory reads
+                rep.kv_resident = self.kvfabric.residency_count(rep.name)
             self._check_replica_pace()
             self.brownout.observe(self._pressure())
             self._stop.wait(self.monitor_interval_s)
@@ -1588,6 +1681,7 @@ class ServingFrontend:
         self._drained.pop(rep.name, None)
         self.router.forget_replica(rep.name)
         self.breaker.forget(rep.name)
+        self.kvfabric.evict_replica(rep.name)
         rep.retire_gauges()
 
     def fleet_signal(self):
@@ -1735,6 +1829,9 @@ class ServingFrontend:
             # device-s-per-token budget ({"enabled": False} while the
             # devprof plane is disarmed)
             "devprof": _devprof.serving_block(),
+            # cluster KV fabric (ISSUE 18): tier hit/fallthrough counters,
+            # spill-ring occupancy, and the residency map (/kvz's payload)
+            "kv": self.kvfabric.report(),
         }
         if self.supervisor is not None:
             out["supervisor"] = self.supervisor.report()

@@ -112,6 +112,10 @@ class ReplicaHandle:
         # dispatcher; read by the monitor's slow-replica classification)
         self.step_ewma = 0.0
         self.step_samples = 0
+        # cluster KV fabric (ISSUE 18): advertised prefix entries owned by
+        # this replica — stamped by the monitor tick from the fabric's
+        # residency map, rolled up fleet-wide as fleet.serving.kv_resident
+        self.kv_resident = 0
         # PR-2 integration: when the launcher exports PADDLE_TELEMETRY_DIR,
         # serving replicas publish launcher-format heartbeat files — in
         # their OWN serving/ subdirectory, NOT the telemetry root: replica
@@ -269,6 +273,7 @@ class ReplicaHandle:
             "missed_beats": self.missed_beats,
             "domain": self.domain,
             "step_ewma_s": round(self.step_ewma, 6),
+            "kv_resident": self.kv_resident,
         }
 
     def __repr__(self):
@@ -286,13 +291,24 @@ class Router:
     HINT_TOKENS = 16
 
     def __init__(self, policy="prefix", affinity_weight=1.0, hint_weight=0.5,
-                 load_weight=1.0, headroom_weight=1.0, max_hints=4096):
+                 load_weight=1.0, headroom_weight=1.0, max_hints=4096,
+                 peer_affinity_discount=0.5):
         if policy not in ("prefix", "round_robin", "load"):
             raise ValueError(f"unknown router policy {policy!r}")
         self.policy = policy
         self.affinity_weight = float(affinity_weight)
         self.hint_weight = float(hint_weight)
         self.load_weight = float(load_weight)
+        # cluster KV fabric (ISSUE 18): a prefix resident on a PEER is
+        # worth something — the target can fetch instead of recompute —
+        # but strictly less than local residency, because the fetch costs
+        # a wire transfer and can fail. The discount scales the fabric's
+        # resident-fraction before it competes with the local index term.
+        self.peer_affinity_discount = float(peer_affinity_discount)
+        # installed by the frontend when the fabric is enabled; consulted
+        # read-only (one resident_owners() pass per placement, OUTSIDE
+        # self._lock — the digest chain walk must not serialize submits)
+        self.fabric = None
         # decode-pool placement weight (ISSUE 16): free-page fraction of
         # the candidate replica's KV pool — see place()'s role branch
         self.headroom_weight = float(headroom_weight)
@@ -373,27 +389,54 @@ class Router:
             prompt = entry.req.prompt
             hinted = (None if cheap
                       else self._hints.get(self._hint_key(prompt)))
+        # cluster-wide prefix residency (ISSUE 18): one digest pass per
+        # placement, outside self._lock. cheap=True (shed_extras) skips it
+        # with the other affinity probes.
+        peer_res = {}
+        if (self.fabric is not None and not cheap
+                and self.policy == "prefix" and role != "decode"):
+            try:
+                peer_res = self.fabric.resident_owners(
+                    prompt, getattr(live[0].engine, "page_size", 16))
+            except Exception:
+                peer_res = {}
         best, best_score, best_aff = None, None, 0.0
+        best_via_peer = False
         for r in live:
             if role == "decode":
                 # decode placement scores pool HEADROOM, not prefix
                 # affinity: the handed-off request brings its own KV —
                 # what matters is whether its page reservation fits
                 aff = hint = 0.0
+                via_peer = False
                 score = (self.headroom_weight * r.pool_headroom()
                          - self.load_weight * r.load())
             else:
                 if self.policy == "load" or cheap:
                     aff = hint = 0.0
+                    via_peer = False
                 else:
-                    aff = r.prefix_fraction(prompt)
+                    local = r.prefix_fraction(prompt)
+                    # peer-resident prefixes count as weaker, transfer-
+                    # discounted affinity: the replica can FETCH the
+                    # prefix over the fabric instead of recomputing it
+                    peer = (self.peer_affinity_discount
+                            * peer_res.get(r.name, 0.0))
+                    aff = max(local, peer)
+                    via_peer = peer > local
                     hint = 1.0 if r.name == hinted else 0.0
                 score = (self.affinity_weight * aff
                          + self.hint_weight * hint
                          - self.load_weight * r.load())
             if best_score is None or score > best_score:
                 best, best_score, best_aff = r, score, aff
+                best_via_peer = via_peer
         entry.route_affinity = best_aff > 0.0 or hinted == best.name
+        # a peer-residency placement is speculative until the fetch
+        # actually lands: committed() defers the session-hint write and
+        # adoption_landed() records it — a failed fetch (recompute
+        # fallthrough) must not re-home session stickiness
+        entry.kv_hint_deferred = best_via_peer
         # trace attribution (ISSUE 7): the request's trace records WHY it
         # landed where it did — the winning blended score and whether
         # affinity (index hit or session hint) carried the decision
@@ -418,12 +461,31 @@ class Router:
             return
         if self.policy != "prefix":
             return
+        if getattr(entry, "kv_hint_deferred", False):
+            # peer-residency placement: the prefix is not on rep yet, only
+            # fetchable. The hint write waits for adoption_landed() — a
+            # fetch that falls through to recompute still lands (and then
+            # records), but a shed/failed placement never re-homes the
+            # session to a replica whose cache stayed cold
+            return
+        self._record_hint(self._hint_key(entry.req.prompt), rep.name)
+
+    def adoption_landed(self, entry, rep):
+        """The deferred cluster-hint write: the peer-routed entry's pages
+        are actually resident on ``rep`` now (fetched and adopted, or
+        recomputed locally — either way the cache is warm THERE)."""
+        if not getattr(entry, "kv_hint_deferred", False):
+            return
+        entry.kv_hint_deferred = False
+        if self.policy == "prefix":
+            self._record_hint(self._hint_key(entry.req.prompt), rep.name)
+
+    def _record_hint(self, key, name):
         # remember the session: the NEXT request with this prefix head
         # goes to the same replica even before the index has its pages
-        key = self._hint_key(entry.req.prompt)
         with self._lock:
             self._hints.pop(key, None)
-            self._hints[key] = rep.name
+            self._hints[key] = name
             while len(self._hints) > self.max_hints:
                 self._hints.pop(next(iter(self._hints)))
 

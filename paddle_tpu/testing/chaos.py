@@ -61,6 +61,20 @@ serving.handoff.corrupt   serving/handoff.py between fsync and rename of a
 serving.decode_pool_empty serving/frontend.py decode-pool liveness check:
                           firing declares the decode pool empty, forcing
                           the blended degradation path deterministically
+serving.kv.fetch          serving/kvfabric.py per peer-fetch attempt — a
+                          fault here drills the fetch_failed fallthrough
+                          (the request recomputes, bit-identically)
+serving.kv.timeout        serving/transport.py between RPC send and
+                          receive — converted to the socket.timeout path a
+                          stuck peer takes (typed KVFetchTimeout, never
+                          retried)
+serving.kv.partition      serving/transport.py per RPC attempt, before
+                          the dial — exercises bounded-backoff retry and
+                          the KVPartitionError exhaustion path
+serving.kv.corrupt        serving/transport.py after RPC receive — the
+                          received bytes are truncated so the blob/bundle
+                          digest gate must refuse them
+                          (HandoffCorruptError, recompute fallthrough)
 obs.oom                   the XLA dispatch seams (jit_api train-step
                           dispatch, continuous._locked_dispatch): inject a
                           synthetic RESOURCE_EXHAUSTED so OOM forensics

@@ -52,7 +52,9 @@ ARGS=(-q -p no:cacheprovider)
 # fast tier: the seams where an untested change does the most damage —
 # chaos/recovery paths, launcher+store+dataloader, serving engine, layers,
 # checkpoints. Budget-enforced so it stays a per-commit habit; if this set
-# outgrows the budget, PRUNE IT, don't skip it.
+# outgrows the budget, PRUNE IT, don't skip it. (Pruned when the set hit
+# the wall: test_serving_perf.py — ~210s of bench smoke + bit-exactness
+# E2Es, by far the most expensive file — runs in the full default tier.)
 FAST_TESTS=(
   tests/test_analysis.py
   tests/test_chaos.py
@@ -66,13 +68,13 @@ FAST_TESTS=(
   tests/test_inference.py
   tests/test_serving_frontend.py
   tests/test_supervisor.py
-  tests/test_serving_perf.py
   tests/test_request_trace.py
   tests/test_compile_memory_obs.py
   tests/test_fleet_obs.py
   tests/test_dynamics.py
   tests/test_disagg.py
   tests/test_devprof.py
+  tests/test_kvfabric.py
 )
 
 if [[ "${1:-}" == "--fast" ]]; then

@@ -1,0 +1,805 @@
+"""Resilient cluster KV-page fabric (ISSUE 18): tiered prefix cache over
+a fault-tolerant wire transport, with recompute-on-failure degradation.
+
+The contract under test, end to end: KV-prefix entries move through a
+tier ladder — host spill ring, then a digest-validated peer fetch over
+the wire transport, then unconditional recompute — and EVERY failure on
+that path (torn frame, digest mismatch, fetch timeout, peer death
+mid-stream, partition, brownout shed) ends in a typed
+``kv.fallthrough{reason=}`` plus a bit-identical recompute. Zero wrong
+tokens, zero lost or hung handles; the fabric is a latency win, never a
+correctness risk.
+
+Tiers:
+
+- blob-frame + wire units (torn/truncated/flipped frames, the
+  KVPageServer RPC ops, retry/backoff/deadline with stepped clocks,
+  TAK's consumed-in-every-outcome discipline, transport selection);
+- host spill ring bounds (byte + entry caps, LRU order, oversize
+  refusal);
+- fabric units (residency advertise/retract/evict, partial-prefix
+  keying, the failure taxonomy per peer fetcher shape);
+- router peer-affinity + the deferred session-hint protocol;
+- frontend drills: each wire chaos seam armed while a real request runs
+  — output bit-exact vs the recompute oracle, failure typed;
+- two-frontend E2E over a real loopback wire: the hot prefix is served
+  from the peer (hit-rate strictly above the recompute baseline of 0).
+"""
+import json
+import pickle
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+from test_serving_frontend import FakeEngine, _expected, _prompt
+
+from paddle_tpu.inference.continuous import EngineRequest
+from paddle_tpu.observability.metrics import registry as _registry
+from paddle_tpu.observability.statusz import StatusServer
+from paddle_tpu.serving import (
+    HandoffCorruptError,
+    HandoffError,
+    HandoffManager,
+    HostSpillRing,
+    KVFabric,
+    KVFetchTimeout,
+    KVPageServer,
+    KVPartitionError,
+    KVTransportError,
+    Router,
+    ServingFrontend,
+    StaleHandoffError,
+    WireTransport,
+    make_transport,
+)
+from paddle_tpu.serving.handoff import HandoffBundle, page_digests
+from paddle_tpu.serving.kvfabric import prefix_key
+from paddle_tpu.serving.router import ReplicaHandle
+from paddle_tpu.serving.transport import frame_blob, unframe_blob
+from paddle_tpu.testing import chaos
+
+
+def _val(name, labels=None):
+    m = _registry.get(name, labels)
+    return getattr(m, "value", 0) if m is not None else 0
+
+
+def _hist_count(name, labels=None):
+    m = _registry.get(name, labels)
+    return getattr(m, "count", 0) if m is not None else 0
+
+
+class _Clock:
+    """Steppable monotonic clock for retry/deadline policy units."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _wait_until(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _bundle(prompt=None, tokens=(7, 7), generation=0, page_size=8, **kw):
+    p = (np.asarray(prompt, np.int32) if prompt is not None
+         else _prompt(3, 7))
+    n = len(p) // page_size
+    fields = dict(
+        rid=5, seed=0, sampling=(False, 1.0, 0, 1.0), prompt=p,
+        tokens=list(tokens), n_generated=len(tokens),
+        n_dispatched=len(tokens), max_new_tokens=6, eos_token_id=None,
+        timeout_s=None, payloads={"n_pages": max(1, n), "prompt": p,
+                                  "n_generated": len(tokens)},
+        digests=page_digests(p, page_size, n), page_size=page_size,
+        generation=generation)
+    fields.update(kw)
+    return HandoffBundle(**fields)
+
+
+def _pages_prompt(head, n_pages, tail=9, page=8):
+    """n_pages full pages of ``head`` + a distinguishing tail token."""
+    return np.asarray([head] * (page * n_pages) + [tail], np.int32)
+
+
+def _framed_entry(prompt, page_size=8, payload=b"kv-pages"):
+    """The exact framed spill-entry bytes :meth:`KVFabric.spill_prefix`
+    stores — built by hand so tests can seed rings and wire stores."""
+    p = np.asarray(prompt, np.int32).reshape(-1)
+    n = len(p) // page_size
+    entry = {"n_pages": n, "page_size": page_size,
+             "prompt": p[:n * page_size], "payload": payload}
+    return frame_blob(pickle.dumps(entry, protocol=4))
+
+
+def _entry_key(prompt, page_size=8):
+    p = np.asarray(prompt, np.int32).reshape(-1)
+    n = len(p) // page_size
+    return prefix_key(page_digests(p, page_size, n), n)
+
+
+class KVEngine(FakeEngine):
+    """FakeEngine plus the fabric's OPTIONAL engine seams. Token emission
+    stays replica-independent (``prompt + [prompt[-1]] * max_new``), so a
+    fabric-assisted admission is bit-identical iff the control plane is
+    correct — adopting pages can never change the token stream."""
+
+    def __init__(self, export_payload=None, **kw):
+        super().__init__(**kw)
+        self.export_payload = export_payload
+        self.adoptions = []
+
+    def adopt_prefix(self, prompt, payload):
+        self.adoptions.append(payload)
+
+    def export_prefix(self, prompt):
+        return self.export_payload
+
+
+@pytest.fixture
+def server():
+    srv = KVPageServer()
+    yield srv
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# blob frame units: the wire-side trust boundary
+# ---------------------------------------------------------------------------
+class TestBlobFrame:
+    def test_roundtrip(self):
+        payload = b"\x00\x01" * 500
+        assert unframe_blob(frame_blob(payload)) == payload
+        assert unframe_blob(frame_blob(b"")) == b""
+
+    def test_torn_truncated_and_flipped_are_typed_errors(self):
+        framed = frame_blob(b"hello kv pages")
+        with pytest.raises(HandoffCorruptError, match="torn or foreign"):
+            unframe_blob(b"garbage")
+        with pytest.raises(HandoffCorruptError, match="truncated"):
+            unframe_blob(framed[:-3])
+        flipped = bytearray(framed)
+        flipped[-1] ^= 0xFF
+        with pytest.raises(HandoffCorruptError, match="digest mismatch"):
+            unframe_blob(bytes(flipped))
+
+
+# ---------------------------------------------------------------------------
+# wire server + transport RPC units
+# ---------------------------------------------------------------------------
+class TestKVPageServer:
+    def test_put_get_tak_del(self, server):
+        wt = WireTransport(endpoint=server.endpoint)
+        wt.put_blob("k1", b"abc")
+        assert len(server) == 1
+        assert wt.fetch_blob(server.endpoint, "k1") == b"abc"
+        assert len(server) == 1                      # GET is non-consuming
+        assert wt.fetch_blob(server.endpoint, "missing") is None
+        assert wt._call(server.endpoint, b"TAK", "k1") == b"abc"
+        assert len(server) == 0                      # TAK consumed it
+        assert wt._call(server.endpoint, b"TAK", "k1") is None
+        wt.put_blob("k2", b"x")
+        wt.delete_blob("k2")
+        assert len(server) == 0
+
+    def test_unknown_op_is_typed_transport_error(self, server):
+        wt = WireTransport(endpoint=server.endpoint)
+        with pytest.raises(KVTransportError, match="unexpected status"):
+            wt._call(server.endpoint, b"XXX", "k")
+        assert KVTransportError.reason == "transport"
+
+
+class TestWireRetryAndDeadline:
+    def test_partition_exhaustion_with_exponential_backoff(self):
+        # port 1: every dial is refused — the retry loop must back off
+        # 2x per attempt and exhaust into a typed partition error
+        sleeps = []
+        wt = WireTransport(endpoint="127.0.0.1:1", deadline_s=60.0,
+                           retries=3, backoff_s=0.05,
+                           connect_timeout_s=0.05,
+                           clock=_Clock(), sleep=sleeps.append)
+        r0 = _val("serving.handoff.send_retries")
+        with pytest.raises(KVPartitionError, match="after 4 attempt"):
+            wt.fetch_blob("127.0.0.1:1", "k")
+        assert sleeps == [0.05, 0.1, 0.2]
+        assert _val("serving.handoff.send_retries") == r0 + 3
+        assert KVPartitionError.reason == "partition"
+
+    def test_deadline_beats_retry_budget(self):
+        # backoff > deadline: not a single retry sleep is allowed
+        sleeps = []
+        wt = WireTransport(endpoint="127.0.0.1:1", deadline_s=0.01,
+                           retries=5, backoff_s=1.0,
+                           connect_timeout_s=0.05,
+                           clock=_Clock(), sleep=sleeps.append)
+        with pytest.raises(KVPartitionError, match="after 1 attempt"):
+            wt.fetch_blob("127.0.0.1:1", "k")
+        assert sleeps == []
+
+    def test_timeout_seam_is_typed_and_never_retried(self, server):
+        # the peer accepts the dial, then goes silent: socket.timeout ->
+        # KVFetchTimeout, which must NOT be retried (a stuck peer is
+        # slower than recompute)
+        sleeps = []
+        wt = WireTransport(endpoint=server.endpoint, retries=3,
+                           backoff_s=0.01, connect_timeout_s=0.2,
+                           sleep=sleeps.append)
+        wt.put_blob("k", b"abc")
+        with chaos.FaultPlan().fail("serving.kv.timeout", times=None):
+            with pytest.raises(KVFetchTimeout, match="peer went silent"):
+                wt.fetch_blob(server.endpoint, "k")
+        assert sleeps == []                  # typed errors pass through
+        assert KVFetchTimeout.reason == "timeout"
+
+    def test_corrupt_seam_truncates_so_digest_gate_refuses(self, server):
+        wt = WireTransport(endpoint=server.endpoint)
+        framed = frame_blob(b"the kv payload bytes")
+        wt.put_blob("k", framed)
+        with chaos.FaultPlan().fail("serving.kv.corrupt", times=1):
+            got = wt.fetch_blob(server.endpoint, "k")
+        assert got == framed[:-7]
+        with pytest.raises(HandoffCorruptError):
+            unframe_blob(got)
+        # undamaged on the wire: the injection was receive-side only
+        assert unframe_blob(wt.fetch_blob(server.endpoint, "k")) \
+            == b"the kv payload bytes"
+
+
+class TestWireHandoffSurface:
+    """publish/load/discard — the HandoffManager contract over sockets."""
+
+    def test_publish_load_roundtrip_consumes(self, server):
+        wt = WireTransport(endpoint=server.endpoint)
+        pub0, ad0 = _val("serving.handoff.published"), _val(
+            "serving.handoff.adopted")
+        token = wt.publish(_bundle(generation=2))
+        assert token == "kv:handoff-5-g2"
+        assert len(server) == 1
+        assert _val("serving.handoff.published") == pub0 + 1
+        b = wt.load(token, expected_generation=2)
+        assert b.tokens == [7, 7]
+        np.testing.assert_array_equal(b.prompt, _prompt(3, 7))
+        assert _val("serving.handoff.adopted") == ad0 + 1
+        assert len(server) == 0              # consumed
+        with pytest.raises(HandoffCorruptError, match="not on wire"):
+            wt.load(token)
+
+    def test_stale_generation_is_fenced_and_consumed(self, server):
+        wt = WireTransport(endpoint=server.endpoint)
+        stale0 = _val("serving.handoff.stale")
+        token = wt.publish(_bundle(generation=0))
+        with pytest.raises(StaleHandoffError, match="generation 0"):
+            wt.load(token, expected_generation=1)
+        assert _val("serving.handoff.stale") == stale0 + 1
+        assert len(server) == 0              # the late bundle is garbage
+
+    def test_corrupt_wire_bytes_are_refused_and_consumed(self, server):
+        wt = WireTransport(endpoint=server.endpoint)
+        corrupt0 = _val("serving.handoff.corrupt")
+        token = wt.publish(_bundle())
+        with chaos.FaultPlan().fail("serving.kv.corrupt", times=1):
+            with pytest.raises(HandoffCorruptError):
+                wt.load(token)
+        assert _val("serving.handoff.corrupt") == corrupt0 + 1
+        assert len(server) == 0     # consumed in EVERY outcome
+
+    def test_publish_retries_then_succeeds(self, server):
+        sleeps = []
+        wt = WireTransport(endpoint=server.endpoint, retries=3,
+                           backoff_s=0.01, sleep=sleeps.append)
+        with chaos.FaultPlan().fail("serving.handoff.send", times=2):
+            token = wt.publish(_bundle())
+        assert len(sleeps) == 2
+        assert wt.load(token).rid == 5
+
+    def test_publish_exhaustion_is_typed(self, server):
+        wt = WireTransport(endpoint=server.endpoint, retries=1,
+                           backoff_s=0.001, sleep=lambda s: None)
+        with chaos.FaultPlan().fail("serving.handoff.send", times=None):
+            with pytest.raises(HandoffError, match="publish failed after"):
+                wt.publish(_bundle())
+        assert len(server) == 0
+
+    def test_discard_is_best_effort(self, server):
+        wt = WireTransport(endpoint=server.endpoint)
+        token = wt.publish(_bundle())
+        wt.discard(token)
+        assert len(server) == 0
+        wt.discard(token)                    # double-discard is silent
+
+    def test_owned_loopback_server_lazy_start_and_close(self):
+        wt = WireTransport()
+        assert wt._owned_server is None      # lazy: no thread yet
+        token = wt.publish(_bundle())
+        assert wt._owned_server is not None
+        assert wt.load(token).rid == 5
+        wt.close()
+        assert wt._owned_server is None
+
+
+class TestMakeTransport:
+    def test_default_is_the_pr16_spool_manager(self, tmp_path):
+        t = make_transport(spool_dir=str(tmp_path))
+        assert type(t) is HandoffManager
+
+    def test_wire_selected_by_arg_or_env(self, monkeypatch):
+        t = make_transport("wire")
+        assert type(t) is WireTransport
+        monkeypatch.setenv("PADDLE_KV_TRANSPORT", "wire")
+        assert type(make_transport()) is WireTransport
+        monkeypatch.setenv("PADDLE_KV_TRANSPORT", "spool")
+        assert type(make_transport()) is HandoffManager
+
+    def test_unknown_kind_rejected(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_KV_TRANSPORT", "carrier-pigeon")
+        with pytest.raises(ValueError, match="carrier-pigeon"):
+            make_transport()
+
+
+# ---------------------------------------------------------------------------
+# host spill ring bounds
+# ---------------------------------------------------------------------------
+class TestHostSpillRing:
+    def test_byte_bound_evicts_lru_first(self):
+        ring = HostSpillRing(max_bytes=100, max_entries=10)
+        assert ring.put("a", b"x" * 40) == []
+        assert ring.put("b", b"y" * 40) == []
+        assert ring.nbytes == 80
+        assert ring.put("c", b"z" * 40) == ["a"]     # oldest out
+        assert ring.get("a") is None
+        assert ring.get("b") == b"y" * 40
+        assert len(ring) == 2 and ring.nbytes == 80
+
+    def test_entry_bound_and_get_refreshes_recency(self):
+        ring = HostSpillRing(max_bytes=1 << 20, max_entries=2)
+        ring.put("a", b"1")
+        ring.put("b", b"2")
+        ring.get("a")                        # a is now most-recent
+        assert ring.put("c", b"3") == ["b"]  # so b is the victim
+        assert ring.get("a") == b"1"
+
+    def test_oversize_entry_refused_outright(self):
+        ring = HostSpillRing(max_bytes=10, max_entries=10)
+        ring.put("small", b"x" * 8)
+        assert ring.put("monster", b"y" * 11) == ["monster"]
+        assert len(ring) == 1                # the ring was NOT flushed
+        assert ring.get("small") == b"x" * 8
+
+    def test_reput_replaces_and_discard_releases(self):
+        ring = HostSpillRing(max_bytes=100, max_entries=10)
+        ring.put("a", b"x" * 30)
+        ring.put("a", b"y" * 10)             # replace, not accumulate
+        assert ring.nbytes == 10 and len(ring) == 1
+        ring.discard("a")
+        assert ring.nbytes == 0 and len(ring) == 0
+        ring.discard("a")                    # idempotent
+
+    def test_spill_bytes_gauge_tracks(self):
+        ring = HostSpillRing(max_bytes=100, max_entries=10)
+        ring.put("a", b"x" * 25)
+        assert _val("kv.spill_bytes") == 25
+        ring.discard("a")
+        assert _val("kv.spill_bytes") == 0
+
+
+# ---------------------------------------------------------------------------
+# fabric units: residency, keying, the tier ladder's failure taxonomy
+# ---------------------------------------------------------------------------
+class TestFabricResidency:
+    def test_advertise_prompt_covers_every_prefix(self):
+        fab = KVFabric(name="me")
+        fab.advertise_prompt(_pages_prompt(4, 3), 8, "rep0")
+        assert fab.residency_count("rep0") == 3
+        assert _val("kv.residency") == 3
+        owners = fab.resident_owners(_pages_prompt(4, 3), 8)
+        assert owners == {"rep0": 1.0}
+
+    def test_partial_prefix_fraction_via_chained_digests(self):
+        # a 2-page advertisement hits a 4-page prompt at 2/4: chained
+        # digests of shared prefixes are equal by construction
+        fab = KVFabric(name="me")
+        fab.advertise_prompt(_pages_prompt(4, 2, tail=7), 8, "rep0")
+        owners = fab.resident_owners(_pages_prompt(4, 4, tail=9), 8)
+        assert owners == {"rep0": pytest.approx(0.5)}
+        # an unrelated prompt shares nothing
+        assert fab.resident_owners(_pages_prompt(5, 4), 8) == {}
+
+    def test_evict_replica_drops_ads_and_peer(self):
+        fab = KVFabric(name="me")
+        fab.register_peer("rep0", lambda key: None)
+        fab.advertise_prompt(_pages_prompt(4, 2), 8, "rep0")
+        fab.advertise_prompt(_pages_prompt(4, 2), 8, "rep1")
+        assert fab.evict_replica("rep0") == 2
+        assert fab.residency_count("rep0") == 0
+        # rep1's ads survive the co-resident keys
+        assert fab.resident_owners(_pages_prompt(4, 2), 8) == {"rep1": 1.0}
+        assert "rep0" not in fab._peers
+        assert fab.evict_replica("rep0") == 0   # idempotent
+
+    def test_disabled_fabric_is_inert(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_KV_FABRIC", "0")
+        fab = KVFabric(name="me")
+        assert not fab.enabled
+        fab.advertise_prompt(_pages_prompt(4, 2), 8, "rep0")
+        assert fab.residency_count("rep0") == 0
+        assert fab.spill_prefix(_pages_prompt(4, 2), 8, b"p") is None
+        assert fab.acquire(_pages_prompt(4, 2), 8) is None
+        assert fab.report()["enabled"] is False
+
+
+class TestTierLadder:
+    def test_host_tier_hit_roundtrips_payload(self):
+        fab = KVFabric(name="me")
+        prompt = _pages_prompt(3, 2)
+        h0 = _val("kv.hits", {"tier": "host"})
+        key = fab.spill_prefix(prompt, 8, b"the-pages")
+        assert key == _entry_key(prompt)
+        got = fab.acquire(prompt, 8)
+        assert got is not None
+        entry, tier = got
+        assert tier == "host"
+        assert entry["payload"] == b"the-pages"
+        assert entry["n_pages"] == 2
+        assert _val("kv.hits", {"tier": "host"}) == h0 + 1
+
+    def test_partial_prefix_host_hit(self):
+        # spill 2 pages; a 3-page prompt sharing them hits at j=2
+        fab = KVFabric(name="me")
+        fab.spill_prefix(_pages_prompt(3, 2, tail=7), 8, b"p2")
+        got = fab.acquire(_pages_prompt(3, 3, tail=9), 8)
+        assert got is not None
+        entry, tier = got
+        assert tier == "host" and entry["n_pages"] == 2
+
+    def test_sub_page_prompt_is_a_plain_miss(self):
+        fab = KVFabric(name="me")
+        f0 = _val("kv.fallthroughs")
+        assert fab.acquire(np.asarray([1, 2, 3], np.int32), 8) is None
+        assert fab.spill_prefix(np.asarray([1, 2, 3], np.int32),
+                                8, b"p") is None
+        assert _val("kv.fallthroughs") == f0   # a miss is not a failure
+
+    def test_corrupt_ring_entry_discarded_counted_walk_continues(self):
+        fab = KVFabric(name="me")
+        prompt = _pages_prompt(3, 2)
+        key = _entry_key(prompt)
+        fab.spill.put(key, _framed_entry(prompt)[:-5])   # torn bytes
+        c0 = _val("kv.fallthrough", {"reason": "corrupt"})
+        assert fab.acquire(prompt, 8) is None
+        assert _val("kv.fallthrough", {"reason": "corrupt"}) == c0 + 1
+        assert fab.spill.get(key) is None    # poison evicted, not retried
+
+    def test_entry_for_wrong_prompt_is_a_digest_chain_lie(self):
+        # frame-valid bytes whose inner prompt does not chain to the
+        # requested key: the independent recomputation must refuse it
+        fab = KVFabric(name="me")
+        prompt = _pages_prompt(3, 2)
+        fab.spill.put(_entry_key(prompt), _framed_entry(_pages_prompt(5, 2)))
+        c0 = _val("kv.fallthrough", {"reason": "corrupt"})
+        assert fab.acquire(prompt, 8) is None
+        assert _val("kv.fallthrough", {"reason": "corrupt"}) == c0 + 1
+
+    def test_peer_tier_hit_caches_and_self_advertises(self):
+        fab = KVFabric(name="me")
+        prompt = _pages_prompt(3, 2)
+        blobs = {_entry_key(prompt): _framed_entry(prompt, payload=b"peer!")}
+        fab.register_peer("rep-far", blobs.get)
+        fab.advertise_prompt(prompt, 8, "rep-far")
+        p0 = _val("kv.hits", {"tier": "peer"})
+        n0 = _hist_count("kv.fetch_s")
+        got = fab.acquire(prompt, 8)
+        assert got is not None and got[1] == "peer"
+        assert got[0]["payload"] == b"peer!"
+        assert _val("kv.hits", {"tier": "peer"}) == p0 + 1
+        assert _hist_count("kv.fetch_s") == n0 + 1
+        # fetched entry is cached in the ring and advertised as ours:
+        # the SECOND acquire is a host hit, no peer dial
+        assert fab.acquire(prompt, 8)[1] == "host"
+        assert fab.residency_count("me") >= 1
+
+    def test_brownout_shed_counts_only_when_candidates_existed(self):
+        fab = KVFabric(name="me")
+        prompt = _pages_prompt(3, 2)
+        s0 = _val("kv.fallthrough", {"reason": "peer_fetch_shed"})
+        assert fab.acquire(prompt, 8, allow_peer=False) is None
+        # no candidates: a shed miss is still just a miss
+        assert _val("kv.fallthrough", {"reason": "peer_fetch_shed"}) == s0
+        fab.register_peer("rep-far", lambda key: None)
+        fab.advertise_prompt(prompt, 8, "rep-far")
+        assert fab.acquire(prompt, 8, allow_peer=False) is None
+        assert _val("kv.fallthrough",
+                    {"reason": "peer_fetch_shed"}) == s0 + 1
+
+    @pytest.mark.parametrize("fetcher,reason", [
+        (lambda key: None, "fetch_failed"),                # peer lost it
+        (lambda key: (_ for _ in ()).throw(
+            KVFetchTimeout("stuck peer")), "timeout"),
+        (lambda key: (_ for _ in ()).throw(
+            KVPartitionError("unreachable")), "partition"),
+        (lambda key: b"PTKV1\n torn garbage bytes", "corrupt"),
+    ], ids=["lost", "timeout", "partition", "corrupt"])
+    def test_peer_failure_taxonomy(self, fetcher, reason):
+        fab = KVFabric(name="me")
+        prompt = _pages_prompt(3, 2)
+        fab.register_peer("rep-far", fetcher)
+        fab.advertise_prompt(prompt, 8, "rep-far")
+        f0 = _val("kv.fallthroughs")
+        r0 = _val("kv.fallthrough", {"reason": reason})
+        assert fab.acquire(prompt, 8) is None
+        assert _val("kv.fallthrough", {"reason": reason}) > r0
+        assert _val("kv.fallthroughs") > f0
+
+    def test_chaos_fetch_seam_fires_per_attempt(self):
+        fab = KVFabric(name="me")
+        prompt = _pages_prompt(3, 2)
+        blobs = {_entry_key(prompt): _framed_entry(prompt)}
+        fab.register_peer("rep-far", blobs.get)
+        fab.advertise_prompt(prompt, 8, "rep-far")
+        r0 = _val("kv.fallthrough", {"reason": "fetch_failed"})
+        with chaos.FaultPlan().fail("serving.kv.fetch", times=None):
+            assert fab.acquire(prompt, 8) is None
+        assert _val("kv.fallthrough", {"reason": "fetch_failed"}) > r0
+        # seam disarmed: the same candidates now serve
+        assert fab.acquire(prompt, 8)[1] == "peer"
+
+    def test_one_dead_peer_does_not_mask_a_live_one(self):
+        fab = KVFabric(name="me")
+        prompt = _pages_prompt(3, 2)
+        blobs = {_entry_key(prompt): _framed_entry(prompt, payload=b"B")}
+
+        def dead(key):
+            raise KVPartitionError("rep-a is gone")
+
+        fab.register_peer("rep-a", dead)     # sorted first
+        fab.register_peer("rep-b", blobs.get)
+        fab.advertise_prompt(prompt, 8, "rep-a")
+        fab.advertise_prompt(prompt, 8, "rep-b")
+        p0 = _val("kv.fallthrough", {"reason": "partition"})
+        got = fab.acquire(prompt, 8)
+        assert got is not None and got[0]["payload"] == b"B"
+        assert _val("kv.fallthrough", {"reason": "partition"}) == p0 + 1
+
+    def test_spill_eviction_retracts_residency(self):
+        fab = KVFabric(name="me", spill=HostSpillRing(
+            max_bytes=1 << 20, max_entries=1))
+        p1, p2 = _pages_prompt(3, 2), _pages_prompt(4, 2)
+        fab.spill_prefix(p1, 8, b"one")
+        assert fab.residency_count("me") == 1
+        fab.spill_prefix(p2, 8, b"two")      # evicts p1's entry
+        assert fab.spill.get(_entry_key(p1)) is None
+        # p1's advertisement was retracted with it — no residency lie
+        assert fab.resident_owners(p1, 8) == {}
+        assert fab.resident_owners(p2, 8) == {"me": 1.0}
+
+    def test_report_shape(self):
+        fab = KVFabric(name="me")
+        fab.spill_prefix(_pages_prompt(3, 2), 8, b"p")
+        rep = fab.report()
+        assert rep["enabled"] is True
+        assert rep["spill"]["entries"] == 1
+        assert rep["residency"]["by_owner"] == {"me": 1}
+        assert any(k.startswith("kv.") for k in rep["metrics"])
+
+
+# ---------------------------------------------------------------------------
+# router: peer-resident prefixes as transfer-discounted affinity
+# ---------------------------------------------------------------------------
+class TestRouterPeerAffinity:
+    def _entry(self, prompt, rid=0):
+        from paddle_tpu.inference.continuous import EngineRequest
+
+        class E:
+            pass
+
+        e = E()
+        e.req = EngineRequest(rid, prompt, 4)
+        return e
+
+    def _replicas(self, n=2):
+        return [ReplicaHandle(f"replica{i}", FakeEngine(), index=i)
+                for i in range(n)]
+
+    def test_peer_residency_steers_placement_discounted(self):
+        fab = KVFabric(name="router-view")
+        prompt = _pages_prompt(3, 2)
+        fab.advertise_prompt(prompt, 8, "replica1")
+        fab.register_peer("replica1", lambda key: None)
+        router = Router(policy="prefix")
+        router.fabric = fab
+        reps = self._replicas(2)
+        entry = self._entry(prompt)
+        pick = router.place(entry, reps)
+        assert pick.name == "replica1"       # fetchable beats cold
+        assert entry.kv_hint_deferred is True
+        assert entry.route_affinity is True
+
+    def test_local_index_beats_discounted_peer(self):
+        # both replicas score the same prefix: full local residency must
+        # outrank the 0.5-discounted peer fraction
+        fab = KVFabric(name="router-view")
+        prompt = _pages_prompt(3, 2)
+        fab.advertise_prompt(prompt, 8, "replica1")
+        router = Router(policy="prefix")
+        router.fabric = fab
+        reps = self._replicas(2)
+        # warm replica0's own index with a same-prefix request
+        reps[0].engine.try_admit_one(
+            EngineRequest(99, _pages_prompt(3, 2, tail=5), 1))
+        entry = self._entry(prompt)
+        pick = router.place(entry, reps)
+        assert pick.name == "replica0"
+        assert entry.kv_hint_deferred is False
+
+    def test_hint_write_waits_for_adoption(self):
+        fab = KVFabric(name="router-view")
+        prompt = _pages_prompt(3, 2)
+        fab.advertise_prompt(prompt, 8, "replica1")
+        router = Router(policy="prefix")
+        router.fabric = fab
+        reps = self._replicas(2)
+        entry = self._entry(prompt)
+        rep = router.place(entry, reps)
+        assert entry.kv_hint_deferred
+        router.committed(entry, rep)
+        key = router._hint_key(prompt)
+        assert key not in router._hints      # deferred: nothing landed yet
+        router.adoption_landed(entry, rep)
+        assert router._hints[key] == rep.name
+        assert entry.kv_hint_deferred is False
+        # idempotent: a second landing is a no-op
+        router.adoption_landed(entry, rep)
+
+    def test_non_deferred_placement_records_hint_at_commit(self):
+        router = Router(policy="prefix")
+        reps = self._replicas(2)
+        entry = self._entry(_pages_prompt(3, 2))
+        rep = router.place(entry, reps)
+        assert entry.kv_hint_deferred is False   # no fabric at all
+        router.committed(entry, rep)
+        assert router._hints[router._hint_key(entry.req.prompt)] == rep.name
+
+
+# ---------------------------------------------------------------------------
+# frontend drills: every failure typed, every output bit-exact
+# ---------------------------------------------------------------------------
+class TestFrontendFabric:
+    def test_admission_advertises_exports_and_rolls_up(self):
+        eng = KVEngine(export_payload=b"hot-pages")
+        with ServingFrontend([eng]) as fe:
+            prompt = _pages_prompt(3, 2)
+            h = fe.submit(prompt, max_new_tokens=3)
+            np.testing.assert_array_equal(h.result(timeout=10),
+                                          _expected(prompt, 3))
+            fab = fe.kvfabric
+            assert _wait_until(lambda: fab.residency_count("replica0") >= 2)
+            # the engine's export landed in the host ring
+            assert len(fab.spill) == 1
+            got = fab.acquire(prompt, 8)
+            assert got is not None and got[0]["payload"] == b"hot-pages"
+            # residency -> snapshot -> fleet rollup -> cluster gauge
+            rep = fe.replicas[0]
+            rep.kv_resident = fab.residency_count(rep.name)
+            rollup = fe.fleet_signal()
+            assert rollup["kv_resident"] == rep.kv_resident >= 2
+            assert _val("fleet.serving.kv_resident") == rollup["kv_resident"]
+            assert fe.serving_report()["kv"]["residency"]["entries"] >= 2
+
+    def test_peer_hit_adopts_and_is_bit_exact(self):
+        eng = KVEngine()
+        with ServingFrontend([eng]) as fe:
+            prompt = _pages_prompt(6, 2)
+            blobs = {_entry_key(prompt): _framed_entry(
+                prompt, payload=b"fetched-pages")}
+            fe.kvfabric.register_peer("peer-x", blobs.get)
+            fe.kvfabric.advertise_prompt(prompt, 8, "peer-x")
+            p0 = _val("kv.hits", {"tier": "peer"})
+            h = fe.submit(prompt, max_new_tokens=3)
+            np.testing.assert_array_equal(h.result(timeout=10),
+                                          _expected(prompt, 3))
+            assert eng.adoptions == [b"fetched-pages"]
+            assert _val("kv.hits", {"tier": "peer"}) == p0 + 1
+
+    @pytest.mark.parametrize("site,reason", [
+        ("serving.kv.fetch", "fetch_failed"),
+        ("serving.kv.timeout", "timeout"),
+        ("serving.kv.partition", "partition"),
+        ("serving.kv.corrupt", "corrupt"),
+    ])
+    def test_every_wire_failure_recomputes_bit_identically(
+            self, server, site, reason):
+        """The drill matrix: a hot peer prefix on a REAL wire, each chaos
+        seam armed for the whole request — the fetch fails typed, the
+        request recomputes, and the tokens are bit-identical to the
+        no-fabric oracle. Zero wrong tokens, zero hung handles."""
+        wt = WireTransport(endpoint=server.endpoint, retries=1,
+                           backoff_s=0.001, deadline_s=2.0,
+                           connect_timeout_s=0.5)
+        eng = KVEngine()
+        prompt = _pages_prompt(8, 2)
+        wt.put_blob(_entry_key(prompt), _framed_entry(prompt))
+        with ServingFrontend([eng], handoff=wt) as fe:
+            fe.kvfabric.register_peer("peer-x", server.endpoint)
+            fe.kvfabric.advertise_prompt(prompt, 8, "peer-x")
+            r0 = _val("kv.fallthrough", {"reason": reason})
+            p0 = _val("kv.hits", {"tier": "peer"})
+            with chaos.FaultPlan().fail(site, times=None):
+                h = fe.submit(prompt, max_new_tokens=3)
+                out = h.result(timeout=10)
+            np.testing.assert_array_equal(out, _expected(prompt, 3))
+            assert h.error is None
+            assert _val("kv.fallthrough", {"reason": reason}) > r0
+            assert _val("kv.hits", {"tier": "peer"}) == p0
+            assert eng.adoptions == []       # nothing unvalidated adopted
+
+    def test_replica_death_evicts_residency(self):
+        with ServingFrontend([KVEngine(), KVEngine()]) as fe:
+            prompt = _pages_prompt(9, 2)
+            h = fe.submit(prompt, max_new_tokens=3)
+            h.result(timeout=10)
+            owner = h.replica
+            assert _wait_until(
+                lambda: fe.kvfabric.residency_count(owner) >= 2)
+            fe.kill(owner, reason="test kill")
+            assert _wait_until(
+                lambda: fe.kvfabric.residency_count(owner) == 0)
+            # a corpse must not attract placements
+            assert owner not in fe.kvfabric.resident_owners(prompt, 8)
+
+    def test_kvz_route_serves_the_fabric_report(self):
+        with ServingFrontend([KVEngine()]) as fe:
+            prompt = _pages_prompt(2, 2)
+            fe.submit(prompt, max_new_tokens=2).result(timeout=10)
+            srv = StatusServer(port=0, frontend=fe).start()
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/kvz",
+                        timeout=10) as resp:
+                    view = json.loads(resp.read().decode())
+            finally:
+                srv.stop()
+            assert view["enabled"] is True
+            assert view["residency"]["entries"] >= 1
+            assert "spill" in view and "metrics" in view
+
+    def test_two_frontends_share_the_hot_prefix_over_the_wire(self, server):
+        """The E2E headline: frontend A serves the hot prompt once and
+        spills it to the wire store; frontend B — told only that A's
+        replica holds the prefix — serves the SAME prompt from the peer
+        tier. Hit-rate strictly above the recompute baseline (0 hits),
+        output bit-identical."""
+        prompt = _pages_prompt(11, 2)
+        eng_a = KVEngine(export_payload=b"a-hot-pages")
+        with ServingFrontend(
+                [eng_a],
+                handoff=WireTransport(endpoint=server.endpoint)) as fe_a:
+            h = fe_a.submit(prompt, max_new_tokens=3)
+            np.testing.assert_array_equal(h.result(timeout=10),
+                                          _expected(prompt, 3))
+            assert _wait_until(
+                lambda: server._store.get(_entry_key(prompt)) is not None)
+
+        eng_b = KVEngine()
+        with ServingFrontend(
+                [eng_b],
+                handoff=WireTransport(endpoint=server.endpoint)) as fe_b:
+            fab_b = fe_b.kvfabric
+            fab_b.register_peer("a/replica0", server.endpoint)
+            fab_b.advertise_prompt(prompt, 8, "a/replica0")
+            p0 = _val("kv.hits", {"tier": "peer"})
+            h = fe_b.submit(prompt, max_new_tokens=3)
+            np.testing.assert_array_equal(h.result(timeout=10),
+                                          _expected(prompt, 3))
+            # the peer fetch landed: adopted payload is A's export, the
+            # hit-rate beat the recompute baseline of zero, and the
+            # entry is now cached in B's own ring for the next request
+            assert eng_b.adoptions == [b"a-hot-pages"]
+            assert _val("kv.hits", {"tier": "peer"}) == p0 + 1
+            assert fab_b.spill.get(_entry_key(prompt)) is not None

@@ -55,6 +55,7 @@ from paddle_tpu.serving.brownout import (
     REJECT,
     SHED_BATCH,
     SHED_EXTRAS,
+    SHED_PEER_FETCH,
 )
 from paddle_tpu.testing import chaos
 
@@ -120,16 +121,17 @@ class TestBrownoutLadder:
         clk = _Clock()
         lad = self._ladder(clock=clk, dwell_s=2.0)
         lad.observe(0.85)          # rung 1: shed_prefill_depth
-        lad.observe(0.85)          # rung 2: clamp_tokens
+        lad.observe(0.85)          # rung 2: shed_peer_fetch
+        lad.observe(0.85)          # rung 3: clamp_tokens
         assert lad.step_name() == CLAMP_TOKENS
         lad.observe(0.1)           # below release_at, dwell starts
-        assert lad.level == 2      # not yet: dwell
+        assert lad.level == 3      # not yet: dwell
         clk.t += 1.0
         lad.observe(0.1)
-        assert lad.level == 2      # still dwelling
+        assert lad.level == 3      # still dwelling
         clk.t += 1.5
         lad.observe(0.1)
-        assert lad.level == 1      # dwell elapsed: one rung down
+        assert lad.level == 2      # dwell elapsed: one rung down
         assert lad.history[-1][1:] == ("release", CLAMP_TOKENS)
 
     def test_dwell_resets_when_pressure_returns(self):
@@ -151,6 +153,8 @@ class TestBrownoutLadder:
         assert lad.token_cap(BATCH, "interactive") is None     # level 0
         lad.observe(0.85)                                # shed_prefill_depth
         assert lad.token_cap(BATCH, "interactive") is None
+        lad.observe(0.85)                                   # shed_peer_fetch
+        assert lad.token_cap(BATCH, "interactive") is None
         lad.observe(0.85)                                      # clamp_tokens
         assert lad.token_cap(BATCH, "interactive") == 8
         assert lad.token_cap(INTERACTIVE, "interactive") is None
@@ -159,23 +163,28 @@ class TestBrownoutLadder:
         lad = self._ladder()
         lad.observe(1.0)
         assert lad.extras_enabled()      # level 1: prefill-depth cap only
+        assert lad.peer_fetch_enabled()
+        lad.observe(1.0)                 # level 2: shed_peer_fetch
+        assert lad.extras_enabled()
+        assert not lad.peer_fetch_enabled()
         lad.observe(1.0)
-        assert lad.extras_enabled()      # level 2: clamp only
-        lad.observe(1.0)                 # level 3: shed_extras
+        assert lad.extras_enabled()      # level 3: clamp only
+        lad.observe(1.0)                 # level 4: shed_extras
         assert not lad.extras_enabled()
+        assert lad.step_name(2) == SHED_PEER_FETCH
 
     def test_admission_sheds_batch_then_everything(self):
         lad = self._ladder(retry_after_base_s=0.5)
-        for _ in range(4):               # -> shed_batch
+        for _ in range(5):               # -> shed_batch
             lad.observe(1.0)
         lad.check_admission(INTERACTIVE, "interactive")  # still served
         with pytest.raises(Overloaded) as ei:
             lad.check_admission(BATCH, "interactive")
         # the machine-readable contract: clients back off from fields
         assert ei.value.step == SHED_BATCH
-        assert ei.value.level == 4
+        assert ei.value.level == 5
         assert ei.value.slo_class == "batch"
-        assert ei.value.retry_after_s == pytest.approx(0.5 * 5)
+        assert ei.value.retry_after_s == pytest.approx(0.5 * 6)
         lad.observe(1.0)                 # -> reject
         with pytest.raises(Overloaded) as ei:
             lad.check_admission(INTERACTIVE, "interactive")
@@ -930,16 +939,16 @@ class TestOverloadBrownoutE2E:
     def test_shed_batch_keeps_interactive_served_and_clamps_tokens(self):
         # 25 slots: a 25-deep flood saturates (pressure 1.0, all rungs
         # engage), and cancelling down to 21 pending parks pressure at
-        # 0.84 — INSIDE the level-4 hysteresis band (<= the reject rung's
+        # 0.84 — INSIDE the level-5 hysteresis band (<= the reject rung's
         # release_at 0.86, > shed_batch's 0.78) so the ladder releases
         # exactly one rung and then holds at shed_batch deterministically
         fe, eng, ladder = self._overloaded_fleet(max_seqs=25)
         handles = [fe.submit(_prompt(3, i % 40), 8, slo_class="batch")
                    for i in range(25)]
-        assert _wait_until(lambda: ladder.level == 5, 10)
+        assert _wait_until(lambda: ladder.level == 6, 10)
         for h in handles[:4]:
             h.cancel()
-        assert _wait_until(lambda: ladder.level == 4, 10)
+        assert _wait_until(lambda: ladder.level == 5, 10)
         clamp0 = _val("brownout.tokens_clamped")
         with pytest.raises(Overloaded) as ei:
             fe.submit(_prompt(5, 1), 2, slo_class="batch")
@@ -950,7 +959,7 @@ class TestOverloadBrownoutE2E:
         assert h is not None
         assert h._req.max_new_tokens == 50
         assert _val("brownout.tokens_clamped") == clamp0
-        assert ladder.level == 4   # held inside the hysteresis band
+        assert ladder.level == 5   # held inside the hysteresis band
         fe.shutdown()
 
     def test_retry_budget_prevents_retry_storm(self):
