@@ -43,6 +43,10 @@ Layering (each file is one concern, unit-testable alone):
   (``PADDLE_KV_TRANSPORT=wire``; ``spool`` keeps the PR-16 directory
   path byte-identical), plus the fabric's peer blob fetches — typed
   KVFetchTimeout/KVPartitionError failures, bounded-backoff retries.
+- ``wireformat.py`` — the NON-EXECUTABLE encoding every wire-crossing
+  payload uses (JSON spec + dtype-allowlisted raw array heap): the
+  unauthenticated channel cannot be leveraged into code execution —
+  hostile bytes are a typed refusal, never an interpreter.
 - ``kvfabric.py``  — cluster tiered KV-prefix cache (ISSUE 18): device
   pool → host spill ring → peer fetch → recompute, with residency
   advertisements the router and fleet rollup score placement against;

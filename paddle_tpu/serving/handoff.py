@@ -21,8 +21,11 @@ Robustness contract (the reason this file exists, ISSUE 16):
    plus the prefill engine's chained per-page prompt digests (PR 6's
    prefix-index chain). A torn, truncated, or bit-flipped bundle raises
    a typed :class:`HandoffCorruptError` at adopt — the frontend answers
-   with a clean re-prefill. A corrupt bundle can cost latency, never a
-   wrong token.
+   with a clean re-prefill. The payload itself is the non-executable
+   :mod:`.wireformat` encoding (bundles cross an unauthenticated wire
+   under ``PADDLE_KV_TRANSPORT=wire``, so the decoder must not be able
+   to express code — see wireformat's trust-model notes). A corrupt or
+   hostile bundle can cost latency, never a wrong token.
 3. **Fenced.** Every (re-)prefill of a request bumps its handoff
    generation; the bundle stamps the generation it was built under, and
    the adopter rejects mismatches with :class:`StaleHandoffError` — a
@@ -40,7 +43,6 @@ the digest gate must catch). See docs/CHAOS.md.
 """
 import hashlib
 import os
-import pickle
 import struct
 import tempfile
 import time
@@ -49,12 +51,13 @@ from ..distributed.checkpoint.atomic import atomic_write
 from ..observability.metrics import registry as _registry
 from ..testing import chaos
 from ..utils.envs import env_float, env_int, env_str
+from . import wireformat
 
 __all__ = ["HandoffError", "HandoffCorruptError", "StaleHandoffError",
            "HandoffBundle", "HandoffManager", "page_digests"]
 
 #: frame magic ("paddle_tpu handoff v1") — a loader pointed at a foreign
-#: file fails the cheap prefix check before touching pickle
+#: file fails the cheap prefix check before touching the decoder
 _MAGIC = b"PTHO1\n"
 _LEN = struct.Struct(">Q")
 _DIGEST_SIZE = 16
@@ -135,16 +138,19 @@ class HandoffBundle:
         self.t_publish = None     # stamped by publish(); transfer_s metric
 
     def to_bytes(self):
-        payload = pickle.dumps(
-            {s: getattr(self, s) for s in self.__slots__}, protocol=4)
+        payload = wireformat.encode(
+            {s: getattr(self, s) for s in self.__slots__})
         digest = hashlib.blake2b(payload, digest_size=_DIGEST_SIZE).digest()
         return _MAGIC + _LEN.pack(len(payload)) + digest + payload
 
     @classmethod
     def from_bytes(cls, data):
         """Parse + validate a frame. Any structural defect — wrong magic,
-        short read, length mismatch, digest mismatch, unpicklable payload —
-        raises :class:`HandoffCorruptError`; there is no partial success."""
+        short read, length mismatch, digest mismatch, undecodable payload —
+        raises :class:`HandoffCorruptError`; there is no partial success.
+        The payload decoder is :mod:`.wireformat`: non-executable by
+        construction, so a frame from a hostile wire is refused, never
+        interpreted."""
         hdr = len(_MAGIC) + _LEN.size + _DIGEST_SIZE
         if len(data) < hdr or not data.startswith(_MAGIC):
             raise HandoffCorruptError("bundle frame torn or foreign")
@@ -157,14 +163,14 @@ class HandoffBundle:
         if hashlib.blake2b(payload, digest_size=_DIGEST_SIZE).digest() != digest:
             raise HandoffCorruptError("bundle payload digest mismatch")
         try:
-            fields = pickle.loads(payload)
+            fields = wireformat.decode(payload)
         except Exception as e:
             raise HandoffCorruptError(f"bundle payload unreadable: {e}")
         bundle = cls.__new__(cls)
         try:
             for s in cls.__slots__:
                 setattr(bundle, s, fields[s])
-        except KeyError as e:
+        except (KeyError, TypeError) as e:
             raise HandoffCorruptError(f"bundle missing field {e}")
         return bundle
 

@@ -40,7 +40,6 @@ Chaos seam: ``serving.kv.fetch`` fires per peer-fetch attempt; the
 transport adds ``serving.kv.{timeout,partition,corrupt}``. Together the
 four make every fallthrough row a deterministic drill (docs/CHAOS.md).
 """
-import pickle
 import threading
 import time
 from collections import OrderedDict
@@ -49,6 +48,7 @@ import numpy as np
 
 from ..observability.metrics import registry as _registry
 from ..testing import chaos
+from . import wireformat
 from .handoff import HandoffCorruptError, HandoffError, page_digests
 from .transport import frame_blob, unframe_blob
 from ..utils.envs import env_bool, env_int
@@ -273,7 +273,7 @@ class KVFabric:
         key = prefix_key(digs, n)
         entry = {"n_pages": n, "page_size": int(page_size),
                  "prompt": p[:n * int(page_size)], "payload": payload}
-        framed = frame_blob(pickle.dumps(entry, protocol=4))
+        framed = frame_blob(wireformat.encode(entry))
         evicted = self.spill.put(key, framed)
         for k in evicted:
             if k != key:
@@ -352,8 +352,17 @@ class KVFabric:
                     else "fetch_failed"))
                 continue
             _M_FETCH_S.observe(max(0.0, self.clock() - t0))
-            self.spill.put(key, framed)         # cache for the next request
-            self._advertise(key, self.name)
+            # cache for the next request — mirroring spill_prefix: retract
+            # whatever the insert evicted, and advertise only if the entry
+            # actually stayed resident (an oversize fetch is consumed here
+            # and held nowhere — advertising it would be a residency lie
+            # every peer dials into a guaranteed miss)
+            evicted = self.spill.put(key, framed)
+            for k in evicted:
+                if k != key:
+                    self._retract(k, self.name)
+            if key not in evicted:
+                self._advertise(key, self.name)
             _hit("peer")
             return entry, "peer"
         return None
@@ -377,13 +386,15 @@ class KVFabric:
     @staticmethod
     def _validate(framed, digs, n_pages, page_size):
         """The trust boundary for ring and wire entries alike: frame
-        digest, then an independent recomputation of the page-digest
-        chain from the entry's own prompt bytes against the REQUESTED
-        key's chain. Any disagreement is :class:`HandoffCorruptError` —
-        adopting would risk a wrong token."""
+        digest, a NON-EXECUTABLE decode (:mod:`.wireformat` — the wire
+        has no peer auth, so the decoder must not be an interpreter),
+        then an independent recomputation of the page-digest chain from
+        the entry's own prompt bytes against the REQUESTED key's chain.
+        Any disagreement is :class:`HandoffCorruptError` — adopting
+        would risk a wrong token."""
         payload = unframe_blob(framed)
         try:
-            entry = pickle.loads(payload)
+            entry = wireformat.decode(payload)
             n = int(entry["n_pages"])
             prompt = np.asarray(entry["prompt"], np.int32).reshape(-1)
         except HandoffError:

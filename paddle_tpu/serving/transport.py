@@ -13,17 +13,25 @@ Design rules, in priority order:
 1. **The digest gate is the only trust boundary.** The wire adds zero
    validation of its own and removes none: every byte string that
    crosses it is re-validated by :meth:`HandoffBundle.from_bytes` +
-   :meth:`verify_prompt_digests` (bundles) or the blob frame digest
-   (fabric entries) on the receiving side. A flaky or malicious wire
-   can cost latency, never a wrong token.
+   :meth:`verify_prompt_digests` (bundles) or the blob frame digest +
+   :meth:`KVFabric._validate` (fabric entries) on the receiving side —
+   and every wire payload is the NON-EXECUTABLE :mod:`.wireformat`
+   encoding, so the channel (which has no peer authentication) cannot
+   be leveraged into code execution; see wireformat's trust-model
+   notes. A flaky or malicious wire can cost latency, never a wrong
+   token.
 2. **One dial per op.** Like the native TCPStore client, each RPC opens
    a fresh connection, sends one request, reads one response, closes.
    No connection pool to leak, no half-open stream to reason about
    after a peer death — a dead peer is just a refused/timed-out dial.
 3. **Bounded everything.** Retries use the handoff manager's exact
-   bounded-backoff-inside-a-deadline loop; a socket timeout is typed
-   :class:`KVFetchTimeout` immediately (waiting longer on a stuck peer
-   is worse than recomputing), exhaustion is :class:`KVPartitionError`.
+   bounded-backoff-inside-a-deadline loop. A timeout while CONNECTING
+   is a dial failure like a refusal — retried, exhausting into
+   :class:`KVPartitionError` (a blackholed peer is a partition, not a
+   slow one). A timeout AFTER the dial was accepted — bounded by the op
+   deadline, not the connect timeout — is typed :class:`KVFetchTimeout`
+   immediately and never retried (waiting longer on a stuck peer is
+   worse than recomputing).
 4. **Consumed in every outcome.** Bundle adoption uses the server's
    ``TAK`` op (get+delete in one critical section), so a bundle is
    gone from the wire store whether adoption succeeds, finds it
@@ -42,7 +50,6 @@ the same ``socket.timeout`` path a stuck peer takes), ``serving.kv.corrupt``
 refuse them). See docs/CHAOS.md.
 """
 import hashlib
-import pickle
 import socket
 import struct
 import threading
@@ -92,8 +99,9 @@ class KVFetchTimeout(KVTransportError):
 
 class KVPartitionError(KVTransportError):
     """Every dial attempt inside the retry/deadline budget failed —
-    connection refused, reset, or unreachable. The peer (or the network
-    between us) is gone; the caller falls down the tier ladder."""
+    connection refused, reset, unreachable, or timed out CONNECTING (a
+    blackholed peer). The peer (or the network between us) is gone; the
+    caller falls down the tier ladder."""
 
     reason = "partition"
 
@@ -281,15 +289,27 @@ class WireTransport:
 
     # ---- raw RPC ----------------------------------------------------------
     def _rpc(self, endpoint, op, key, data=b""):
-        """One dial, one request, one response. ``socket.timeout``
-        surfaces as :class:`KVFetchTimeout`; a raw OSError propagates for
-        the caller's retry loop to classify."""
+        """One dial, one request, one response. A timeout in the CONNECT
+        phase is a dial failure — reraised as a plain ConnectionError so
+        _call's retry loop treats it like a refusal (exhausting into
+        :class:`KVPartitionError`); once the peer has accepted the dial,
+        the socket timeout is re-armed from the op deadline (the connect
+        timeout must not bound response reads) and a send/recv
+        ``socket.timeout`` surfaces as :class:`KVFetchTimeout`. Any other
+        raw OSError propagates for the retry loop to classify."""
         host, _, port = endpoint.rpartition(":")
         kb = key.encode("utf-8")
         try:
-            with socket.create_connection(
-                    (host, int(port)),
-                    timeout=self.connect_timeout_s) as sock:
+            sock = socket.create_connection(
+                (host, int(port)), timeout=self.connect_timeout_s)
+        except socket.timeout as e:
+            raise ConnectionError(
+                f"{op.decode().strip()} {key!r}: dial {endpoint} "
+                f"timed out: {e}")
+        try:
+            with sock:
+                sock.settimeout(max(self.deadline_s,
+                                    self.connect_timeout_s))
                 sock.sendall(op + _KLEN.pack(len(kb)) + kb
                              + _LEN.pack(len(data)) + data)
                 try:

@@ -25,8 +25,11 @@ Tiers:
 - two-frontend E2E over a real loopback wire: the hot prefix is served
   from the peer (hit-rate strictly above the recompute baseline of 0).
 """
+import hashlib
 import json
 import pickle
+import socket
+import struct
 import time
 import urllib.request
 
@@ -57,6 +60,11 @@ from paddle_tpu.serving.handoff import HandoffBundle, page_digests
 from paddle_tpu.serving.kvfabric import prefix_key
 from paddle_tpu.serving.router import ReplicaHandle
 from paddle_tpu.serving.transport import frame_blob, unframe_blob
+from paddle_tpu.serving.wireformat import (
+    WireFormatError,
+    decode as wire_decode,
+    encode as wire_encode,
+)
 from paddle_tpu.testing import chaos
 
 
@@ -117,7 +125,7 @@ def _framed_entry(prompt, page_size=8, payload=b"kv-pages"):
     n = len(p) // page_size
     entry = {"n_pages": n, "page_size": page_size,
              "prompt": p[:n * page_size], "payload": payload}
-    return frame_blob(pickle.dumps(entry, protocol=4))
+    return frame_blob(wire_encode(entry))
 
 
 def _entry_key(prompt, page_size=8):
@@ -170,6 +178,90 @@ class TestBlobFrame:
         flipped[-1] ^= 0xFF
         with pytest.raises(HandoffCorruptError, match="digest mismatch"):
             unframe_blob(bytes(flipped))
+
+
+# ---------------------------------------------------------------------------
+# wireformat: the NON-EXECUTABLE wire encoding (the pickle-RCE fix)
+# ---------------------------------------------------------------------------
+class TestWireFormat:
+    def test_roundtrip_preserves_the_closed_type_set(self):
+        tree = {
+            "none": None, "flag": True, "count": 7, "ratio": 0.25,
+            "name": "replica0", "blob": b"\x00\xffpages",
+            "sampling": (False, 1.0, 0, 1.0),
+            "tokens": [3, 9, 27],
+            "pages": {"ks": np.arange(12, dtype=np.float32).reshape(3, 4),
+                      "ids": np.asarray([5, 6], np.int32)},
+        }
+        back = wire_decode(wire_encode(tree))
+        assert back["none"] is None and back["flag"] is True
+        assert back["count"] == 7 and back["ratio"] == 0.25
+        assert back["blob"] == b"\x00\xffpages"
+        assert back["sampling"] == (False, 1.0, 0, 1.0)   # tuple, not list
+        assert isinstance(back["sampling"], tuple)
+        assert back["tokens"] == [3, 9, 27]
+        assert back["pages"]["ks"].dtype == np.float32
+        np.testing.assert_array_equal(back["pages"]["ks"],
+                                      tree["pages"]["ks"])
+        assert back["pages"]["ids"].dtype == np.int32
+
+    def test_encode_refuses_types_outside_the_set(self):
+        with pytest.raises(WireFormatError, match="not wire-encodable"):
+            wire_encode({"cb": lambda: None})
+        with pytest.raises(WireFormatError, match="not wire-encodable"):
+            wire_encode(object())
+        with pytest.raises(WireFormatError, match="dtype"):
+            wire_encode(np.asarray([object()]))      # object dtype
+        with pytest.raises(WireFormatError, match="not a str"):
+            wire_encode({3: "non-string key"})
+
+    def test_decode_refuses_malformed_bytes_typed(self):
+        good = wire_encode({"x": 1})
+        mangled = good.replace(b'"d"', b'",_')               # broken json
+        for bad in (b"", b"\x00" * 7,                        # short header
+                    b"\x00" * 7 + b"\xff",                   # truncated spec
+                    good[:-1], mangled):
+            with pytest.raises(WireFormatError):
+                wire_decode(bad)
+        # a spec that asks for an array outside the heap bounds
+        evil = (b'{"a":["int32",[1000000],0,4000000]}')
+        with pytest.raises(WireFormatError, match="malformed array"):
+            wire_decode(struct.pack(">Q", len(evil)) + evil)
+        # unknown markers never construct anything
+        evil = b'{"pickle":"gASV..."}'
+        with pytest.raises(WireFormatError, match="unknown spec node"):
+            wire_decode(struct.pack(">Q", len(evil)) + evil)
+
+    def test_a_crafted_pickle_cannot_execute_only_fall_through(self):
+        """The high-severity regression drill: a peer returns a frame
+        whose payload is a malicious pickle. The old decoder would have
+        executed it before any keyed digest ran; wireformat must refuse
+        it as a typed corrupt fallthrough with the side effect NOT
+        fired."""
+        fired = []
+
+        class Boom:
+            def __reduce__(self):
+                return (fired.append, ("pwned",))
+
+        prompt = _pages_prompt(3, 2)
+        evil = frame_blob(pickle.dumps(
+            {"n_pages": 2, "page_size": 8, "prompt": prompt[:16],
+             "payload": Boom()}, protocol=4))
+        fab = KVFabric(name="me")
+        fab.register_peer("evil-peer", lambda key: evil)
+        fab.advertise_prompt(prompt, 8, "evil-peer")
+        c0 = _val("kv.fallthrough", {"reason": "corrupt"})
+        assert fab.acquire(prompt, 8) is None        # refused -> recompute
+        assert fired == []                           # nothing executed
+        assert _val("kv.fallthrough", {"reason": "corrupt"}) > c0
+        # same property at the bundle gate
+        hdr = pickle.dumps({"rid": Boom()}, protocol=4)
+        framed = (b"PTHO1\n" + struct.pack(">Q", len(hdr))
+                  + hashlib.blake2b(hdr, digest_size=16).digest() + hdr)
+        with pytest.raises(HandoffCorruptError, match="unreadable"):
+            HandoffBundle.from_bytes(framed)
+        assert fired == []
 
 
 # ---------------------------------------------------------------------------
@@ -238,6 +330,44 @@ class TestWireRetryAndDeadline:
                 wt.fetch_blob(server.endpoint, "k")
         assert sleeps == []                  # typed errors pass through
         assert KVFetchTimeout.reason == "timeout"
+
+    def test_connect_timeout_is_a_dial_failure_not_a_fetch_timeout(
+            self, monkeypatch):
+        # a blackholed peer times out CONNECTING: that is a partition
+        # shape (retried, exhausting typed), NOT the never-retried
+        # accepted-then-silent KVFetchTimeout
+        def blackholed(addr, timeout=None):
+            raise socket.timeout("connect timed out")
+
+        monkeypatch.setattr(
+            "paddle_tpu.serving.transport.socket.create_connection",
+            blackholed)
+        sleeps = []
+        wt = WireTransport(endpoint="127.0.0.1:1", deadline_s=60.0,
+                           retries=2, backoff_s=0.05,
+                           connect_timeout_s=0.05,
+                           clock=_Clock(), sleep=sleeps.append)
+        with pytest.raises(KVPartitionError, match="after 3 attempt"):
+            wt.fetch_blob("127.0.0.1:1", "k")
+        assert sleeps == [0.05, 0.1]         # retried as a dial failure
+
+    def test_response_reads_bounded_by_deadline_not_connect_timeout(self):
+        # a peer that accepts the dial but never answers: the read must
+        # be allowed the op deadline, not the (much shorter) connect
+        # timeout the old code leaked onto the established socket
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+        try:
+            ep = f"127.0.0.1:{lsock.getsockname()[1]}"
+            wt = WireTransport(endpoint=ep, connect_timeout_s=0.05,
+                               deadline_s=0.5, retries=0)
+            t0 = time.monotonic()
+            with pytest.raises(KVFetchTimeout):
+                wt.fetch_blob(ep, "k")
+            assert time.monotonic() - t0 >= 0.3
+        finally:
+            lsock.close()
 
     def test_corrupt_seam_truncates_so_digest_gate_refuses(self, server):
         wt = WireTransport(endpoint=server.endpoint)
@@ -578,6 +708,39 @@ class TestTierLadder:
         # p1's advertisement was retracted with it — no residency lie
         assert fab.resident_owners(p1, 8) == {}
         assert fab.resident_owners(p2, 8) == {"me": 1.0}
+
+    def test_peer_hit_cache_eviction_retracts_residency(self):
+        # caching a peer fetch evicts the oldest spill entry: its
+        # advertisement must be retracted exactly like spill_prefix's —
+        # an unretracted lie is a partition drill on every placement
+        fab = KVFabric(name="me", spill=HostSpillRing(
+            max_bytes=1 << 20, max_entries=1))
+        p1, p2 = _pages_prompt(3, 2), _pages_prompt(4, 2)
+        fab.spill_prefix(p1, 8, b"local")
+        assert fab.resident_owners(p1, 8) == {"me": 1.0}
+        blobs = {_entry_key(p2): _framed_entry(p2, payload=b"peer")}
+        fab.register_peer("rep-far", blobs.get)
+        fab.advertise_prompt(p2, 8, "rep-far")
+        assert fab.acquire(p2, 8)[1] == "peer"
+        assert fab.spill.get(_entry_key(p1)) is None     # evicted...
+        assert fab.resident_owners(p1, 8) == {}          # ...and retracted
+        assert "me" in fab.resident_owners(p2, 8)
+
+    def test_oversize_peer_fetch_served_but_never_advertised(self):
+        # the fetched entry is larger than the whole ring: the request
+        # is still served from it, but it is held nowhere locally — so
+        # it must NOT be advertised (peers would dial a guaranteed miss)
+        fab = KVFabric(name="me", spill=HostSpillRing(
+            max_bytes=8, max_entries=4))
+        prompt = _pages_prompt(3, 2)
+        blobs = {_entry_key(prompt): _framed_entry(prompt, payload=b"big")}
+        fab.register_peer("rep-far", blobs.get)
+        fab.advertise_prompt(prompt, 8, "rep-far")
+        got = fab.acquire(prompt, 8)
+        assert got is not None and got[1] == "peer"
+        assert fab.spill.get(_entry_key(prompt)) is None
+        assert fab.residency_count("me") == 0
+        assert "me" not in fab.resident_owners(prompt, 8)
 
     def test_report_shape(self):
         fab = KVFabric(name="me")
