@@ -10,5 +10,6 @@ from . import (  # noqa: F401  (imported for registration side effects)
     locks,
     profiler_capture,
     registries,
+    tenancy,
     timing,
 )

@@ -1,0 +1,58 @@
+"""Tenant-label boundedness rule (ISSUE 19 satellite).
+
+* ``tenant-label-bounded`` — every ``tenant=`` metric label in
+  ``paddle_tpu/`` is populated from a DECLARED tenant's ``.name``
+  attribute (or a string literal), never from a request-supplied
+  variable. The tenant plane's whole label-cardinality contract rests
+  on one code shape: ``Tenant.__init__`` validates the name and the
+  registry bounds how many exist, so ``{"tenant": <something>.name}``
+  is bounded by construction — while ``{"tenant": user_string}`` mints
+  a new time series per attacker-chosen value until the metrics
+  registry is the outage. The rule pins the shape at the ``labels=`` /
+  ``gauge_labels=`` call sites, where the leak would actually happen.
+"""
+import ast
+
+from ..engine import Finding, rule
+
+#: keyword arguments that feed metric label dicts
+_LABEL_KWARGS = ("labels", "gauge_labels")
+
+
+def _bounded(value):
+    """True when the label value is bounded by construction: a string
+    literal, or an ``<expr>.name`` attribute read (the declared-Tenant
+    shape — ``Tenant.__init__`` validated it, the registry bounded it)."""
+    if isinstance(value, ast.Constant) and isinstance(value.value, str):
+        return True
+    return isinstance(value, ast.Attribute) and value.attr == "name"
+
+
+@rule("tenant-label-bounded",
+      description='a {"tenant": ...} metric label is populated from a '
+                  "declared Tenant's .name (or a literal), never a "
+                  "request-supplied variable")
+def tenant_label_bounded(index):
+    findings = []
+    for fi in index.iter_files("paddle_tpu/"):
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg not in _LABEL_KWARGS \
+                        or not isinstance(kw.value, ast.Dict):
+                    continue
+                for key, value in zip(kw.value.keys, kw.value.values):
+                    if not (isinstance(key, ast.Constant)
+                            and key.value == "tenant"):
+                        continue
+                    if _bounded(value):
+                        continue
+                    findings.append(Finding(
+                        fi.path, value.lineno, "tenant-label-bounded",
+                        f'{kw.arg}={{"tenant": '
+                        f"{ast.unparse(value)}}} — label values must be a "
+                        f"declared Tenant's .name (bounded by the "
+                        f"registry) or a literal; a request-supplied "
+                        f"string mints unbounded metric series"))
+    return findings

@@ -71,6 +71,7 @@ from ..observability import tracing as _trace
 from ..observability.metrics import registry as _registry
 from ..ops.paged_attention import PagedLayerCache
 from ..testing import chaos
+from ..utils.envs import env_int as _env_int
 from ..utils.metrics_bus import counters
 from ..utils.retry import RetryPolicy
 
@@ -261,15 +262,15 @@ class EngineRequest:
     """
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
-                 "sampling", "seed", "timeout_s", "on_token", "tokens",
-                 "n_generated", "n_dispatched", "last_token", "pages",
-                 "slot", "key_base", "t_enqueue", "t_admit",
+                 "sampling", "seed", "timeout_s", "on_token", "adapter",
+                 "tokens", "n_generated", "n_dispatched", "last_token",
+                 "pages", "slot", "key_base", "t_enqueue", "t_admit",
                  "t_first_token", "t_done", "error", "result", "finished",
                  "timed_out", "cancelled", "trace")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id=None,
                  sampling=GREEDY_SAMPLING, seed=0, timeout_s=None,
-                 on_token=None):
+                 on_token=None, adapter=None):
         self.rid = int(rid)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -286,6 +287,10 @@ class EngineRequest:
         self.seed = int(seed)
         self.timeout_s = timeout_s
         self.on_token = on_token
+        # resolved serving.adapters.LoRAAdapter (or None): the low-rank
+        # LM-head delta this request decodes under. An object, never a
+        # name — registry resolution/refcounting is the frontend's job
+        self.adapter = adapter
         self.tokens = []          # prompt + generated, filled at admission
         self.n_generated = 0
         # tokens DISPATCHED to the device (>= n_generated while a decode
@@ -332,7 +337,7 @@ class EngineRequest:
                               eos_token_id=self.eos_token_id,
                               sampling=self.sampling, seed=self.seed,
                               timeout_s=self.timeout_s,
-                              on_token=self.on_token)
+                              on_token=self.on_token, adapter=self.adapter)
         clone.t_enqueue = self.t_enqueue
         return clone
 
@@ -539,6 +544,24 @@ class ContinuousBatchingEngine:
         # a compile-time constant of the decode program); admission defers
         # requests whose sampling differs from the running group's
         self._active_sampling = None
+        # ---- per-request LoRA plane (ISSUE 19) ----------------------------
+        # The decode group's adapter RANK is a compile-time constant of the
+        # lora decode programs (like sampling); the adapter WEIGHTS are
+        # runtime operands — per-row indices gather stacked [slots+1, ...]
+        # A/B tensors inside the program, slot 0 all-zeros so no-adapter
+        # rows ride along bit-identically (+0.0 delta). None = base group:
+        # the untouched pre-LoRA programs, byte-for-byte.
+        self._active_lora_rank = None
+        self._slot_adapter = {}   # slot -> LoRAAdapter (adapter rows only)
+        self._lora_slots = _env_int("PADDLE_LORA_SLOTS", 4)
+        self._lora_device = OrderedDict()   # digest -> (a_dev, b_dev); LRU
+        self._lora_stack_cache = OrderedDict()  # (rank, digests) -> stacks
+        self._lora_prefill_fns = {}
+        self._lora_suffix_fns = {}
+        self._lora_decode_fns = {}
+        self._lora_block_fns = {}
+        self._lora_dims = (getattr(cfg, "hidden_size", None),
+                           getattr(cfg, "vocab_size", None))
         # O(1) maintained pages-in-use counter (satellite: replaces the
         # derived scan; tests assert it equals the scan at quiet points)
         self._pages_in_use = 0
@@ -988,9 +1011,284 @@ class ContinuousBatchingEngine:
                                            len(self._decode_block_fns))
         return fn
 
+    # ---- per-request LoRA programs (ISSUE 19) -----------------------------
+    # An adapter is a low-rank update to the LM-HEAD projection:
+    #
+    #     logits = base_head(h) + scale * (h @ A) @ B
+    #
+    # with A [hidden, r] / B [r, vocab] float32. The lora program variants
+    # run the INNER transformer (model.llama) through functional_call —
+    # exactly the ops the base programs run — then apply the same-ops base
+    # head plus the gathered per-row delta. The compile-time constants are
+    # (sampling, rank, block k); adapter WEIGHTS are runtime operands
+    # (decode: fixed-depth [_lora_slots+1, ...] stacks indexed per row,
+    # slot 0 all-zeros), so hot-swapping adapters within a warmed
+    # (rank, sampling) signature never recompiles. A batch with no
+    # adapters at all never enters these programs: the base path stays
+    # byte-for-byte the pre-LoRA engine.
+
+    @staticmethod
+    def _lora_inner_overrides(state):
+        """Full-model raw state -> inner-model functional_call overrides
+        ("llama."-prefix keys stripped; the head weight stays behind for
+        the explicit base-head matmul below)."""
+        return {k[len("llama."):]: Tensor(v, stop_gradient=True)
+                for k, v in state.items() if k.startswith("llama.")}
+
+    @staticmethod
+    def _lora_base_head(h, state, tied):
+        """The base LM-head projection with the SAME ops the model's own
+        forward uses (F.linear / matmul(transpose_y=True)) — a zero-delta
+        lora row must sample the bit-identical token the base program
+        would have."""
+        if tied:
+            return h @ jnp.swapaxes(state["llama.embed_tokens.weight"],
+                                    -1, -2)
+        return h @ state["lm_head.weight"]
+
+    def _lora_prefill(self, bucket, sampling, rank):
+        """Monolithic prefill + adapter head for the request's OWN A/B
+        (per-request operands — prefill is one request wide, no stacking
+        needed). Same return contract as _prefill."""
+        key3 = (bucket, sampling, rank)
+        fn = self._lora_prefill_fns.get(key3)
+        if fn is not None:
+            return fn
+        model = self.model
+        inner = model.llama
+        tied = model.lm_head is None
+        sampler = _row_sampler(*sampling)
+
+        def prefill(state, ids_p, true_len, key, a_w, b_w, scale):
+            overrides = self._lora_inner_overrides(state)
+            caches = model.init_cache(1, bucket)
+            wrapped = [(Tensor(kc), Tensor(vc)) for kc, vc in caches]
+            h, presents = inner.functional_call(
+                overrides, Tensor(ids_p), past_key_values=wrapped,
+                cache_position=Tensor(jnp.int32(0)), use_cache=True,
+                training=False,
+            )
+            h_last = jax.lax.dynamic_index_in_dim(h._data, true_len - 1,
+                                                  axis=1, keepdims=False)
+            base = self._lora_base_head(h_last, state, tied)  # [1, V]
+            delta = ((h_last.astype(jnp.float32) @ a_w) @ b_w) * scale
+            tok0 = sampler(base + delta, key[None])[0].astype(jnp.int32)
+            ks = jnp.stack([p[0]._data[0] for p in presents])
+            vs = jnp.stack([p[1]._data[0] for p in presents])
+            return tok0, ks, vs
+
+        fn = self._lora_prefill_fns[key3] = _compilemem.ledgered_jit(
+            prefill, key=f"serve.lora_prefill[r{rank},b{bucket},s{sampling}]")
+        _compilemem.ledger.note_cache_size("serve.lora_prefill",
+                                           len(self._lora_prefill_fns))
+        return fn
+
+    def _lora_prefill_suffix(self, n_prefix_pages, suffix_bucket, sampling,
+                             rank):
+        """Prefix-cache-hit suffix prefill + adapter head. Prefix KV is
+        HEAD-independent (the adapter only touches logits), so adapter
+        requests share cached prompt pages with everyone else."""
+        key4 = (n_prefix_pages, suffix_bucket, sampling, rank)
+        fn = self._lora_suffix_fns.get(key4)
+        if fn is not None:
+            return fn
+        model = self.model
+        inner = model.llama
+        tied = model.lm_head is None
+        sampler = _row_sampler(*sampling)
+        plen = n_prefix_pages * self.page_size
+
+        def prefill_suf(state, ks_pre, vs_pre, ids_suf, suf_len, key,
+                        a_w, b_w, scale):
+            overrides = self._lora_inner_overrides(state)
+            caches = model.init_cache(1, plen + suffix_bucket)
+            wrapped = []
+            for l, (kc, vc) in enumerate(caches):
+                kc = kc.at[0, :plen].set(ks_pre[l].astype(kc.dtype))
+                vc = vc.at[0, :plen].set(vs_pre[l].astype(vc.dtype))
+                wrapped.append((Tensor(kc), Tensor(vc)))
+            h, presents = inner.functional_call(
+                overrides, Tensor(ids_suf), past_key_values=wrapped,
+                cache_position=Tensor(jnp.int32(plen)), use_cache=True,
+                training=False,
+            )
+            h_last = jax.lax.dynamic_index_in_dim(h._data, suf_len - 1,
+                                                  axis=1, keepdims=False)
+            base = self._lora_base_head(h_last, state, tied)
+            delta = ((h_last.astype(jnp.float32) @ a_w) @ b_w) * scale
+            tok0 = sampler(base + delta, key[None])[0].astype(jnp.int32)
+            ks = jnp.stack([p[0]._data[0, plen:] for p in presents])
+            vs = jnp.stack([p[1]._data[0, plen:] for p in presents])
+            return tok0, ks, vs
+
+        fn = self._lora_suffix_fns[key4] = _compilemem.ledgered_jit(
+            prefill_suf,
+            key=f"serve.lora_suffix[r{rank},p{n_prefix_pages},"
+                f"b{suffix_bucket},s{sampling}]")
+        _compilemem.ledger.note_cache_size("serve.lora_suffix",
+                                           len(self._lora_suffix_fns))
+        return fn
+
+    def _lora_decode(self, sampling, rank):
+        """Single-step batched multi-adapter decode: per-row indices
+        gather each slot's A/B/scale from the fixed-depth stacks inside
+        the program. Row 0 of the stacks is zeros — no-adapter co-tenants
+        add an exact 0.0 delta and sample the base token bit-for-bit."""
+        key2 = (sampling, rank)
+        fn = self._lora_decode_fns.get(key2)
+        if fn is not None:
+            return fn
+        model = self.model
+        inner = model.llama
+        tied = model.lm_head is None
+        sampler = _row_sampler(*sampling)
+
+        def decode(state, toks, pools, page_table, lengths, caps, keys,
+                   a_stack, b_stack, scales, lora_idx):
+            overrides = self._lora_inner_overrides(state)
+            lengths_e = jnp.minimum(lengths, caps)
+            pkvs = [PagedLayerCache(kp, vp, page_table, lengths_e)
+                    for kp, vp in pools]
+            h, presents = inner.functional_call(
+                overrides, Tensor(toks),
+                position_ids=Tensor(lengths_e[:, None].astype(jnp.int32)),
+                past_key_values=pkvs, use_cache=True, training=False,
+            )
+            hd = h._data                       # [max_seqs, 1, hidden]
+            base = self._lora_base_head(hd, state, tied)
+            a_rows = a_stack[lora_idx]         # [max_seqs, hidden, r]
+            b_rows = b_stack[lora_idx]         # [max_seqs, r, vocab]
+            delta = jnp.einsum("bsh,bhr->bsr", hd.astype(jnp.float32),
+                               a_rows)
+            delta = jnp.einsum("bsr,brv->bsv", delta, b_rows)
+            logits = base + delta * scales[lora_idx][:, None, None]
+            nxt = sampler(logits[:, -1], keys).astype(jnp.int32)
+            return nxt, tuple((p.k_pages, p.v_pages) for p in presents)
+
+        fn = self._lora_decode_fns[key2] = _compilemem.ledgered_jit(
+            decode, key=f"serve.lora_decode[r{rank},s{sampling}]",
+            donate_argnums=(2,))
+        _compilemem.ledger.note_cache_size("serve.lora_decode",
+                                           len(self._lora_decode_fns))
+        return fn
+
+    def _lora_block_fn(self, sampling, rank, k):
+        """k lora decode steps fused into one dispatch — _decode_block_fn
+        with the adapter gather applied per scan step (the gathered rows
+        are loop-invariant, hoisted once outside the scan)."""
+        key3 = (sampling, rank, k)
+        fn = self._lora_block_fns.get(key3)
+        if fn is not None:
+            return fn
+        model = self.model
+        inner = model.llama
+        tied = model.lm_head is None
+        sampler = _row_sampler(*sampling)
+
+        def decode_block(state, toks, pools, page_table, lengths, caps,
+                         keys, a_stack, b_stack, scales, lora_idx):
+            overrides = self._lora_inner_overrides(state)
+            a_rows = a_stack[lora_idx]
+            b_rows = b_stack[lora_idx]
+            s_rows = scales[lora_idx][:, None, None]
+
+            def body(carry, step_keys):
+                toks_c, pools_c, lengths_c = carry
+                lengths_e = jnp.minimum(lengths_c, caps)
+                pkvs = [PagedLayerCache(kp, vp, page_table, lengths_e)
+                        for kp, vp in pools_c]
+                h, presents = inner.functional_call(
+                    overrides, Tensor(toks_c),
+                    position_ids=Tensor(
+                        lengths_e[:, None].astype(jnp.int32)),
+                    past_key_values=pkvs, use_cache=True, training=False,
+                )
+                hd = h._data
+                base = self._lora_base_head(hd, state, tied)
+                delta = jnp.einsum("bsh,bhr->bsr",
+                                   hd.astype(jnp.float32), a_rows)
+                delta = jnp.einsum("bsr,brv->bsv", delta, b_rows)
+                logits = base + delta * s_rows
+                nxt = sampler(logits[:, -1], step_keys).astype(jnp.int32)
+                new_pools = tuple((p.k_pages, p.v_pages) for p in presents)
+                return (nxt[:, None], new_pools, lengths_e + 1), nxt
+
+            (_, pools_out, _), toks_block = jax.lax.scan(
+                body, (toks, tuple(pools), lengths), keys)
+            return toks_block, pools_out
+
+        fn = self._lora_block_fns[key3] = _compilemem.ledgered_jit(
+            decode_block,
+            key=f"serve.lora_decode_block[r{rank},k{k},s{sampling}]",
+            donate_argnums=(2,))
+        _compilemem.ledger.note_cache_size("serve.lora_decode_block",
+                                           len(self._lora_block_fns))
+        return fn
+
+    # ---- LoRA weight residency --------------------------------------------
+    def _lora_dev(self, adapter):
+        """Host A/B -> device arrays, digest-keyed LRU (the hot working
+        set transfers once; re-registration under a new digest is a new
+        entry, so stale weights can never serve)."""
+        ent = self._lora_device.get(adapter.digest)
+        if ent is None:
+            ent = (jnp.asarray(adapter.a), jnp.asarray(adapter.b))
+            self._lora_device[adapter.digest] = ent
+            while len(self._lora_device) > 32:
+                self._lora_device.popitem(last=False)
+        else:
+            self._lora_device.move_to_end(adapter.digest)
+        return ent
+
+    def _lora_stack(self, rank, adapters):
+        """(a_stack, b_stack, scales, digest->index) for a digest-sorted
+        working set. Depth is FIXED at ``_lora_slots + 1`` (slot 0 =
+        zeros for no-adapter rows; tail slots zero-padded) so the decode
+        signature never varies with the working set — the zero-warm-
+        recompile contract. Keyed by (rank, digests), LRU-bounded."""
+        digs = tuple(ad.digest for ad in adapters)
+        cached = self._lora_stack_cache.get((rank, digs))
+        if cached is None:
+            hidden, vocab = self._lora_dims
+            za = jnp.zeros((hidden, rank), jnp.float32)
+            zb = jnp.zeros((rank, vocab), jnp.float32)
+            a_list, b_list, s_list = [za], [zb], [0.0]
+            for ad in adapters:
+                a_dev, b_dev = self._lora_dev(ad)
+                a_list.append(a_dev)
+                b_list.append(b_dev)
+                s_list.append(float(ad.scale))
+            while len(a_list) < self._lora_slots + 1:
+                a_list.append(za)
+                b_list.append(zb)
+                s_list.append(0.0)
+            cached = (jnp.stack(a_list), jnp.stack(b_list),
+                      jnp.asarray(s_list, jnp.float32))
+            self._lora_stack_cache[(rank, digs)] = cached
+            while len(self._lora_stack_cache) > 8:
+                self._lora_stack_cache.popitem(last=False)
+        else:
+            self._lora_stack_cache.move_to_end((rank, digs))
+        return cached + ({d: i + 1 for i, d in enumerate(digs)},)
+
+    def _lora_reject(self, ad):
+        """Why this adapter can never run on this engine (None = it can):
+        admission fails the request alone instead of deferring forever."""
+        hidden, vocab = self._lora_dims
+        if not hasattr(self.model, "llama") or hidden is None \
+                or vocab is None:
+            return ValueError(
+                "LoRA adapters need a LlamaForCausalLM-shaped model "
+                "(inner .llama + hidden_size/vocab_size config)")
+        if ad.a.shape[0] != hidden or ad.b.shape[1] != vocab:
+            return ValueError(
+                f"adapter {ad.name!r} shapes {ad.a.shape}/{ad.b.shape} "
+                f"do not match model hidden={hidden} vocab={vocab}")
+        return None
+
     def warmup(self, prompt_lens=None, do_sample=False, temperature=1.0,
                top_k=0, top_p=1.0, shared_prefix_lens=(), buckets=None,
-               sampling=None):
+               sampling=None, lora_ranks=()):
         """Compile every program serve() can hit for prompts of these
         lengths BEFORE latency-sensitive serving (reference:
         AnalysisPredictor warmup / TRT engine build-ahead): one dummy
@@ -1009,7 +1307,14 @@ class ContinuousBatchingEngine:
         call — each entry is a ``(do_sample, temperature, top_k, top_p)``
         tuple (or a single tuple) — since the sampler is a compile-time
         constant of every prefill/decode program. Wall time lands in the
-        ``serve.compile_warmup_s`` histogram."""
+        ``serve.compile_warmup_s`` histogram.
+
+        ``lora_ranks`` (ISSUE 19) additionally compiles the per-request
+        LoRA program set for each adapter rank — lora prefill per prompt
+        bucket plus the lora decode/block ladder — by serving a
+        zero-weight adapter of that rank (adapter weights are runtime
+        operands, so warming any adapter warms them all for the rank).
+        The prefix-cache lora_suffix programs compile on first hit."""
         if buckets is not None:
             prompt_lens = buckets
         if prompt_lens is None:
@@ -1028,6 +1333,9 @@ class ContinuousBatchingEngine:
             with _compilemem.ledger.trigger("warmup"):
                 for cfg in configs:
                     self._warmup_one(prompt_lens, shared_prefix_lens, *cfg)
+                for rank in lora_ranks:
+                    for cfg in configs:
+                        self._warmup_lora(prompt_lens, int(rank), *cfg)
         finally:
             _M_WARMUP.observe(time.monotonic() - t_warm0)
 
@@ -1155,6 +1463,46 @@ class ContinuousBatchingEngine:
                 continue  # the ladder serves above already compiled it
             self.serve([np.ones(rep[key], np.int32)], max_new_tokens=1, **kw)
 
+    def _warmup_lora(self, prompt_lens, rank, do_sample, temperature,
+                     top_k, top_p):
+        """Compile the rank's lora program set with a zero-weight dummy
+        adapter (delta == 0, so the dummy serves stay as harmless as the
+        base warmup's). Adapter requests always prefill monolithically,
+        so the bucket walk is mono-only regardless of prefill_chunk."""
+        from ..serving.adapters import LoRAAdapter
+
+        hidden, vocab = self._lora_dims
+        ad = LoRAAdapter(f"warmup-r{rank}",
+                         np.zeros((hidden, rank), np.float32),
+                         np.zeros((rank, vocab), np.float32))
+        kw = dict(do_sample=do_sample, temperature=temperature,
+                  top_k=top_k, top_p=top_p, adapters=ad)
+        stats_before = dict(self.stats)
+        pfx, self.enable_prefix_cache = self.enable_prefix_cache, False
+        try:
+            ladder_bucket = prompt_bucket(1)
+            fit = min(self.max_len - 1,
+                      self._available_pages() * self.page_size
+                      - ladder_bucket)
+            runs = [2]
+            if self.decode_block > 1:
+                runs.append(2 * self.decode_block - 1)
+            runs = sorted({min(n, fit) for n in runs if fit >= 2})
+            for n in runs:
+                self.serve([np.ones(1, np.int32)], max_new_tokens=n, **kw)
+            rep = {}
+            for l in prompt_lens:
+                b = prompt_bucket(int(l))
+                rep[b] = min(rep.get(b, int(l)), int(l))
+            for b in sorted(rep):
+                if b == ladder_bucket and runs:
+                    continue
+                self.serve([np.ones(rep[b], np.int32)],
+                           max_new_tokens=1, **kw)
+        finally:
+            self.enable_prefix_cache = pfx  # lint: shared-mutation-without-lock-ok (engine fields are dispatcher-owned — single-threaded by contract)
+            self.stats = stats_before  # lint: shared-mutation-without-lock-ok (same dispatcher-owned contract)
+
     # ---- scheduler --------------------------------------------------------
     def pool_bytes(self):
         import jax
@@ -1252,8 +1600,10 @@ class ContinuousBatchingEngine:
         self.lengths[slot] = 0
         req.pages = []
         req.slot = None
+        self._slot_adapter.pop(slot, None)
         if not self._active and not self._prefilling:
             self._active_sampling = None
+            self._active_lora_rank = None
         return req
 
     def adopt_request(self, req, payloads):
@@ -1364,8 +1714,10 @@ class ContinuousBatchingEngine:
         self.free_slots.append(slot)
         self.page_table[slot] = 0
         self.lengths[slot] = 0
+        self._slot_adapter.pop(slot, None)
         if not self._active and not self._prefilling:
             self._active_sampling = None
+            self._active_lora_rank = None
         return req
 
     def _abort_prefill(self, slot, timed_out=False):
@@ -1386,8 +1738,10 @@ class ContinuousBatchingEngine:
         # this path
         self.page_table[slot] = 0
         self.lengths[slot] = 0
+        self._slot_adapter.pop(slot, None)
         if not self._active and not self._prefilling:
             self._active_sampling = None
+            self._active_lora_rank = None
         return req
 
     def _update_gauges(self):
@@ -1415,12 +1769,27 @@ class ContinuousBatchingEngine:
         (the degradation contract's "fail alone, never wedge the queue")."""
         if not self.free_slots:
             return "deferred"
-        if (self._active or self._prefilling) \
-                and self._active_sampling != req.sampling:
-            # the sampler is a compile-time constant of the decode program:
-            # only requests sharing a sampling tuple can co-schedule (a
-            # mid-prefill request will join the decode group too)
-            return "deferred"
+        ad = req.adapter
+        if self._active or self._prefilling:
+            if self._active_sampling != req.sampling:
+                # the sampler is a compile-time constant of the decode
+                # program: only requests sharing a sampling tuple can
+                # co-schedule (a mid-prefill request will join the decode
+                # group too)
+                return "deferred"
+            if ad is not None:
+                if self._active_lora_rank is None:
+                    # base group running: its decode program has no adapter
+                    # plane, and converting mid-group would move plain
+                    # co-tenants off the byte-identical base path — wait
+                    return "deferred"
+                if ad.rank != self._active_lora_rank:
+                    # rank is a compile-time constant of the lora programs
+                    return "deferred"
+                digs = {a.digest for a in self._slot_adapter.values()}
+                if ad.digest not in digs and len(digs) >= self._lora_slots:
+                    # stacked-weights working set full (PADDLE_LORA_SLOTS)
+                    return "deferred"
         # past the deferral gates the request is popped by the caller on
         # every return below, so this counts each request exactly once —
         # on BOTH the batch serve() path and the frontend's online path
@@ -1439,6 +1808,15 @@ class ContinuousBatchingEngine:
             if adm is not None:
                 adm.end("error", error=req.error_message)
             return "failed"
+        if ad is not None:
+            err = self._lora_reject(ad)
+            if err is not None:
+                # wrong-model/wrong-shape adapter can NEVER run here —
+                # fail it alone instead of deferring forever
+                self._fail_request(req, err)
+                if adm is not None:
+                    adm.end("error", error=req.error_message)
+                return "failed"
         # reuse the version-checked capture across admissions AND decode
         # steps — the O(n_params) tree walk stays off the TTFT-critical path
         state = self._captured_state()
@@ -1508,10 +1886,14 @@ class ContinuousBatchingEngine:
         req.slot = slot
         req.t_admit = time.monotonic()
         sampling = req.sampling
-        if self.prefill_chunk and suffix_len > self.prefill_chunk:
+        if self.prefill_chunk and suffix_len > self.prefill_chunk \
+                and ad is None:
             # reserve-then-stream admission: the prompt lands chunk by
             # chunk in step(), interleaved with everyone else's decode
-            # blocks, instead of one monolithic bucketed dispatch
+            # blocks, instead of one monolithic bucketed dispatch.
+            # Adapter requests take the monolithic path below instead —
+            # a scoped degradation (one big dispatch, never wrong tokens)
+            # that keeps the chunk ladder free of lora program variants
             req.tokens = list(prompt)  # tok0 appended at graduation
             if n_pre:
                 self.stats["prefix_hit_pages"] += n_pre
@@ -1528,8 +1910,19 @@ class ContinuousBatchingEngine:
         sbucket = prompt_bucket(suffix_len)
         ids_p = np.zeros((1, sbucket), np.int32)
         ids_p[0, :suffix_len] = prompt[n_pre * bs_:]
-        progs = ([("gather", n_pre), ("suffix", n_pre, sbucket, sampling)]
-                 if n_pre else [("prefill", sbucket, sampling)])
+        if ad is None:
+            progs = ([("gather", n_pre),
+                      ("suffix", n_pre, sbucket, sampling)]
+                     if n_pre else [("prefill", sbucket, sampling)])
+        else:
+            progs = ([("gather", n_pre),
+                      ("lora_suffix", n_pre, sbucket, sampling, ad.rank)]
+                     if n_pre
+                     else [("lora_prefill", sbucket, sampling, ad.rank)])
+            # per-request adapter operands (digest-keyed device cache);
+            # eager transfers, hoisted outside the locked dispatch
+            a_dev, b_dev = self._lora_dev(ad)
+            scale_dev = jnp.float32(ad.scale)
         if sampling[0] and req.key_base is None:
             # key_base = fold_in(PRNGKey(seed), rid): the request's own
             # stream root, so its sampled tokens are independent of which
@@ -1553,13 +1946,25 @@ class ContinuousBatchingEngine:
                     _M_PREFIX_HIT.inc(n_pre)
                     ks_pre, vs_pre = self._gather_prefix(n_pre)(
                         tuple(self.pools), jnp.asarray(shared, jnp.int32))
-                    tok0, ks, vs = self._prefill_suffix(
-                        n_pre, sbucket, sampling)(
-                        state, ks_pre, vs_pre, jnp.asarray(ids_p),
-                        jnp.int32(suffix_len), k0)
-                else:
+                    if ad is None:
+                        tok0, ks, vs = self._prefill_suffix(
+                            n_pre, sbucket, sampling)(
+                            state, ks_pre, vs_pre, jnp.asarray(ids_p),
+                            jnp.int32(suffix_len), k0)
+                    else:
+                        tok0, ks, vs = self._lora_prefill_suffix(
+                            n_pre, sbucket, sampling, ad.rank)(
+                            state, ks_pre, vs_pre, jnp.asarray(ids_p),
+                            jnp.int32(suffix_len), k0, a_dev, b_dev,
+                            scale_dev)
+                elif ad is None:
                     tok0, ks, vs = self._prefill(sbucket, sampling)(
                         state, jnp.asarray(ids_p), jnp.int32(suffix_len), k0)
+                else:
+                    tok0, ks, vs = self._lora_prefill(
+                        sbucket, sampling, ad.rank)(
+                        state, jnp.asarray(ids_p), jnp.int32(suffix_len),
+                        k0, a_dev, b_dev, scale_dev)
                 page_ids = jnp.asarray(new_pages[:region], jnp.int32)
                 self.pools = list(self._insert(sbucket)(
                     tuple(self.pools), ks, vs, page_ids))
@@ -1622,6 +2027,12 @@ class ContinuousBatchingEngine:
         # must see this slot to free its pages
         self._active[slot] = req
         self._active_sampling = req.sampling
+        if req.adapter is not None:
+            # the group becomes (or stays) a lora group of this rank:
+            # decode dispatches switch to the lora programs, plain
+            # co-tenants ride the zero slot bit-identically
+            self._slot_adapter[slot] = req.adapter
+            self._active_lora_rank = req.adapter.rank
         if req.on_token is not None:
             req.on_token(req.rid, tok0)
         if (req.eos_token_id is not None and tok0 == req.eos_token_id) \
@@ -1718,6 +2129,7 @@ class ContinuousBatchingEngine:
             self.free_slots.append(slot)
             if not self._active and not self._prefilling:
                 self._active_sampling = None
+                self._active_lora_rank = None
             self._fail_request(req, e)
             if req.trace is not None:
                 req.trace.event("prefill_chunk_failed",
@@ -1869,6 +2281,7 @@ class ContinuousBatchingEngine:
         if remaining <= 0:
             return None  # every row fully dispatched: read back, retire
         sampling = self._active_sampling
+        lora_rank = self._active_lora_rank
         state = self._captured_state()
         k = min(self.decode_block, remaining)
         k = 1 << (k.bit_length() - 1)
@@ -1901,12 +2314,40 @@ class ContinuousBatchingEngine:
                              chain.last)
         else:
             feed = chain.last
+        if lora_rank is not None:
+            # lora group: fixed-depth stacked adapter operands + per-row
+            # gather indices (0 = the zero slot for plain co-tenants).
+            # Digest-sorted so the stack cache key — and row indexing —
+            # is deterministic for a given working set.
+            ads = sorted({a.digest: a for a
+                          in self._slot_adapter.values()}.values(),
+                         key=lambda a: a.digest)
+            a_stack, b_stack, l_scales, pos = self._lora_stack(lora_rank,
+                                                               ads)
+            l_idx = np.zeros(self.max_seqs, np.int32)
+            for slot, r in rows:
+                if r.adapter is not None:
+                    l_idx[slot] = pos[r.adapter.digest]
+            l_idx = jnp.asarray(l_idx)
         # the chaos site fires BEFORE the jitted call, so an injected
         # outage retries against intact pools; a real failure after the
         # dispatch donated them is not retriable (the retry would read
         # donated buffers) and raises out through the caller's cleanup
         def dispatch():
             chaos.site("serve.decode")
+            if lora_rank is not None:
+                if k == 1:
+                    nxt, pools = self._lora_decode(sampling, lora_rank)(
+                        state, feed, tuple(self.pools),
+                        jnp.asarray(self.page_table),
+                        jnp.asarray(self.lengths), jnp.asarray(caps),
+                        keys[0], a_stack, b_stack, l_scales, l_idx)
+                    return nxt[None], pools
+                return self._lora_block_fn(sampling, lora_rank, k)(
+                    state, feed, tuple(self.pools),
+                    jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+                    jnp.asarray(caps), keys, a_stack, b_stack, l_scales,
+                    l_idx)
             if k == 1:
                 nxt, pools = self._decode(sampling)(
                     state, feed, tuple(self.pools),
@@ -1918,7 +2359,12 @@ class ContinuousBatchingEngine:
                 jnp.asarray(self.page_table), jnp.asarray(self.lengths),
                 jnp.asarray(caps), keys)
 
-        progs = [("decode", sampling) if k == 1 else ("block", sampling, k)]
+        if lora_rank is not None:
+            progs = [("lora_decode", sampling, lora_rank) if k == 1
+                     else ("lora_block", sampling, lora_rank, k)]
+        else:
+            progs = [("decode", sampling) if k == 1
+                     else ("block", sampling, k)]
         if sampling[0]:
             progs.append(("keys", k))
         host = None
@@ -1954,9 +2400,16 @@ class ContinuousBatchingEngine:
             # under the program's ledger key. Off cadence this is a
             # counter increment and the block stays fully async; cold
             # dispatches (compile wall) never enter the table.
-            _dp.tick(f"serve.decode[s{sampling}]" if k == 1
-                     else f"serve.decode_block[k{k},s{sampling}]",
-                     t0, blk, tokens=k * len(rows), context="serve.decode")
+            if lora_rank is not None:
+                prog_key = (f"serve.lora_decode[r{lora_rank},s{sampling}]"
+                            if k == 1 else
+                            f"serve.lora_decode_block[r{lora_rank},k{k},"
+                            f"s{sampling}]")
+            else:
+                prog_key = (f"serve.decode[s{sampling}]" if k == 1
+                            else f"serve.decode_block[k{k},s{sampling}]")
+            _dp.tick(prog_key, t0, blk, tokens=k * len(rows),
+                     context="serve.decode")
         last = blk[k - 1][:, None]  # device row the NEXT block chains from
         if hasattr(blk, "copy_to_host_async"):
             blk.copy_to_host_async()  # transfer rides under the compute
@@ -2061,7 +2514,8 @@ class ContinuousBatchingEngine:
 
     def serve(self, prompts, max_new_tokens, eos_token_id=None,
               do_sample=False, temperature=1.0, top_k=0, top_p=1.0, seed=0,
-              on_token=None, request_timeout_s=None, sampling_overrides=None):
+              on_token=None, request_timeout_s=None, sampling_overrides=None,
+              adapters=None):
         """Serve a list of int32 prompt arrays; returns a list of
         [len(prompt) + n_generated] arrays (stops at eos or max_new_tokens).
         Requests beyond the pool/slot capacity queue and join as earlier
@@ -2097,7 +2551,15 @@ class ContinuousBatchingEngine:
 
         on_token(request_id, token_id) streams each generated token (incl.
         the prefill's first token) as soon as its decode step completes —
-        the serving-callback hook for SSE-style responses."""
+        the serving-callback hook for SSE-style responses.
+
+        ``adapters`` (ISSUE 19) attaches per-request LoRA adapters: a
+        single resolved ``serving.adapters.LoRAAdapter`` applied to every
+        request, a per-request list (None entries = base model), or a
+        sparse {rid: adapter} dict. Adapter requests co-schedule with
+        same-rank adapter requests and with base requests riding the zero
+        slot; a batch with NO adapters dispatches the untouched base
+        programs byte-for-byte."""
         if self._active or self._prefilling or self._inflight is not None:
             raise RuntimeError(
                 "serve() on an engine with active online requests — drain() "
@@ -2115,6 +2577,14 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"per-request sampling_overrides has "
                 f"{len(sampling_overrides)} entries for "
+                f"{len(prompts)} requests")
+        # adapters: one-for-all object, per-request list, or sparse dict —
+        # same shape rules as sampling_overrides (lists must cover every
+        # request; dicts may be sparse)
+        if (adapters is not None and isinstance(adapters, (list, tuple))
+                and len(adapters) != len(prompts)):
+            raise ValueError(
+                f"per-request adapters has {len(adapters)} entries for "
                 f"{len(prompts)} requests")
         # every serve() batch starts from a FRESH capture (old-code parity):
         # the version-keyed reuse below it only has to bridge admissions
@@ -2139,10 +2609,18 @@ class ContinuousBatchingEngine:
                         ov.get("do_sample", do_sample),
                         ov.get("temperature", temperature),
                         ov.get("top_k", top_k), ov.get("top_p", top_p))
+            if adapters is None:
+                ad = None
+            elif isinstance(adapters, dict):
+                ad = adapters.get(rid)
+            elif isinstance(adapters, (list, tuple)):
+                ad = adapters[rid]
+            else:
+                ad = adapters
             reqs.append(EngineRequest(
                 rid, p, per_new[rid], eos_token_id=eos_token_id,
                 sampling=samp, seed=seed, timeout_s=request_timeout_s,
-                on_token=on_token))
+                on_token=on_token, adapter=ad))
         # only after EVERY request constructed (construction validates and
         # can raise): escalating the error bound or counting requests first
         # would leak past the finally below, which only runs once the try

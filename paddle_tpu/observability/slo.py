@@ -142,11 +142,16 @@ class SLOMonitor:
     (default 14.4 — the SRE-workbook 5m/1h pairing: sustaining it exhausts
     a 30-day budget in ~2 days). ``observe``/``observe_event`` are the feed
     points; ``report()`` is the /statusz + serving_report() payload and
-    refreshes the ``slo.burn_rate`` gauges."""
+    refreshes the ``slo.burn_rate`` gauges.
+
+    ``gauge_labels`` (ISSUE 19) namespaces this monitor's gauge series —
+    the per-tenant monitors the frontend keeps would otherwise all write
+    the same ``slo.burn_rate{objective=,window=}`` series and clobber the
+    fleet monitor's."""
 
     def __init__(self, objectives=None, classes=None, fast_window_s=300.0,
                  slow_window_s=3600.0, alert_burn_rate=14.4,
-                 clock=time.monotonic):
+                 clock=time.monotonic, gauge_labels=None):
         if objectives is None:
             objectives = default_objectives(classes or ())
         self.objectives = list(objectives)
@@ -156,6 +161,7 @@ class SLOMonitor:
         self.fast_window_s = float(fast_window_s)
         self.slow_window_s = float(slow_window_s)
         self.alert_burn_rate = float(alert_burn_rate)
+        self.gauge_labels = dict(gauge_labels) if gauge_labels else {}
         self._clock = clock
         self._windows = {
             o.name: (_Window(self.fast_window_s), _Window(self.slow_window_s))
@@ -256,7 +262,8 @@ class SLOMonitor:
         for name, r in rates.items():
             for win in ("fast", "slow"):
                 _registry.gauge("slo.burn_rate",
-                                labels={"objective": name, "window": win},
+                                labels={"objective": name, "window": win,
+                                        **self.gauge_labels},
                                 help="SLO error-budget burn rate per window"
                                 ).set(r[win])
         alerts = self.alerts(rates=rates)

@@ -107,6 +107,7 @@ class StatusServer:
             "/perfz": self._route_json(
                 lambda q: (200, self.perfz(q))),
             "/kvz": self._route_json(lambda q: (200, self.kvz())),
+            "/tenantz": self._route_json(lambda q: (200, self.tenantz())),
             "/healthz": self._route_json(lambda q: self.healthz()),
         }
 
@@ -166,6 +167,25 @@ class StatusServer:
             return fab.report()
         except Exception as e:
             return {"enabled": False, "error": f"{type(e).__name__}: {e}"}
+
+    def tenantz(self):
+        """Multi-tenant serving view (ISSUE 19): per-tenant quota/bucket/
+        inflight state, private brownout rung, SLO burn rates, and
+        tenant-labeled latency summaries, plus the LoRA adapter cache —
+        the frontend's ``tenant_report()``, armored like /kvz (a
+        frontend-less or shut-down server answers shaped JSON)."""
+        fe = self.frontend
+        if fe is None or not hasattr(fe, "tenant_report"):
+            return {"error": "no serving frontend (or no tenant plane) "
+                             "bound"}
+        try:
+            out = {"tenants": fe.tenant_report()}
+            adapters = getattr(fe, "adapters", None)
+            if adapters is not None:
+                out["adapters"] = adapters.report()
+            return out
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
 
     def _elastic(self):
         """Elastic membership view: the configured provider (launcher), or

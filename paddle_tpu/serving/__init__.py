@@ -51,6 +51,16 @@ Layering (each file is one concern, unit-testable alone):
   pool → host spill ring → peer fetch → recompute, with residency
   advertisements the router and fleet rollup score placement against;
   every failure a typed ``kv.fallthrough{reason=}`` into recompute.
+- ``tenancy.py``   — multi-tenant plane (ISSUE 19): the bounded tenant
+  registry with token-bucket quota admission (typed
+  ``Overloaded(step="tenant_quota", tenant=, retry_after_s=<refill
+  deficit>)``), per-tenant inflight caps, and per-tenant isolation
+  (private brownout ladder + retry budget + SLO burn-rate monitor) —
+  layered ABOVE the EDF scheduler in ``submit(tenant=...)``.
+- ``adapters.py``  — per-request LoRA hot-swap (ISSUE 19): the
+  ref-counted LRU-bounded digest-keyed host cache of low-rank A/B
+  pairs; the engine batches mixed adapters per decode step with zero
+  recompiles across warmed signatures (``warmup(lora_ranks=...)``).
 
 Chaos sites ``serving.route`` / ``serving.replica_kill`` /
 ``serving.replica_slow`` / ``serving.spawn_fail`` / ``supervisor.decision``
@@ -65,6 +75,7 @@ docs/SERVING.md is the operator guide; every later serving PR
 (multi-model) builds on this subsystem.
 """
 from ..inference.continuous import EngineRequest, canonical_sampling  # noqa: F401
+from .adapters import AdapterRegistry, LoRAAdapter  # noqa: F401
 from .breaker import BreakerPolicy, CircuitBreaker  # noqa: F401
 from .brownout import (  # noqa: F401
     BrownoutLadder,
@@ -109,6 +120,7 @@ from .scheduler import (  # noqa: F401
     SLOScheduler,
 )
 from .supervisor import ReplicaFence, ReplicaSupervisor  # noqa: F401
+from .tenancy import DEFAULT_TENANT, Tenant, TenantRegistry  # noqa: F401
 from .transport import (  # noqa: F401
     KVFetchTimeout,
     KVPageServer,
@@ -134,4 +146,6 @@ __all__ = [
     "KVFabric", "HostSpillRing",
     "WireTransport", "KVPageServer", "make_transport",
     "KVTransportError", "KVFetchTimeout", "KVPartitionError",
+    "Tenant", "TenantRegistry", "DEFAULT_TENANT",
+    "LoRAAdapter", "AdapterRegistry",
 ]

@@ -1,0 +1,247 @@
+"""Per-request LoRA adapter registry (ISSUE 19 tentpole, part 3).
+
+The fine-tune -> serve loop (PAPERS.md: Gemma on Cloud TPU) needs one
+base model to serve MANY customers' low-rank deltas. This module owns
+the host side of that plane: a ref-counted, LRU-bounded, digest-keyed
+cache of rank-r A/B pairs. The device side lives in
+``inference/continuous.py`` — the engine gathers stacked adapter
+weights per batch row inside the decode program, so one batch serves
+mixed adapters with zero recompiles across warmed signatures.
+
+Adapter math (the engine's contract): the adapter is a low-rank update
+to the LM-head projection —
+
+    logits = base_head(h) + scale * (h @ A) @ B
+
+with ``A [hidden, r]`` and ``B [r, vocab]`` float32. No-adapter rows
+ride the zero slot of the stacked weights (a ``+ 0.0`` delta), and a
+batch with NO adapters at all dispatches the untouched base programs —
+byte-for-byte the pre-LoRA path.
+
+Trust & size limits (the operator boundary, docs/SERVING.md): adapter
+weights are tenant-supplied DATA, never code — plain float32 arrays,
+validated by shape/dtype at registration; anything else is a typed
+``ValueError``. ``PADDLE_LORA_MAX_MB`` bounds one adapter (a monster
+upload must not flush every co-tenant's adapters) and
+``PADDLE_LORA_CACHE_MB`` bounds the whole cache; eviction is LRU over
+refcount-0 entries only, so an adapter pinned by in-flight requests can
+never be evicted out from under them.
+
+Identity is the content digest (keyed blake2b over A, B, scale):
+re-registering identical weights under any name is idempotent, and the
+digest is what the engine's device cache, the router's affinity score,
+and the handoff/KV planes key on — names are a human alias.
+"""
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ..observability.metrics import registry as _registry
+from ..utils.envs import env_int
+
+__all__ = ["LoRAAdapter", "AdapterRegistry"]
+
+_G_CACHE_BYTES = _registry.gauge(
+    "lora.cache_bytes", help="host bytes resident in the adapter cache")
+_G_CACHE_ENTRIES = _registry.gauge(
+    "lora.cache_entries", help="adapters resident in the host cache")
+_M_REGISTERED = _registry.counter(
+    "lora.registered", help="adapter registrations accepted (idempotent "
+                            "re-registrations not counted)")
+_M_EVICTED = _registry.counter(
+    "lora.evicted", help="refcount-0 adapters LRU-evicted to make room")
+
+
+class LoRAAdapter:
+    """One immutable adapter: ``a [hidden, r]``, ``b [r, vocab]``
+    float32, a scalar ``scale``, and the content digest that names it
+    everywhere below the registry."""
+
+    __slots__ = ("name", "a", "b", "scale", "rank", "digest", "nbytes")
+
+    def __init__(self, name, a, b, scale=1.0):
+        a = np.asarray(a)
+        b = np.asarray(b)
+        if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+            raise ValueError(
+                f"adapter {name!r}: need a [hidden, r] and b [r, vocab] "
+                f"with matching r, got {a.shape} / {b.shape}")
+        if a.dtype != np.float32 or b.dtype != np.float32:
+            # the trust boundary: adapter weights are float32 DATA only —
+            # object/structured dtypes (or anything needing conversion
+            # tricks) are refused, not coerced
+            raise ValueError(
+                f"adapter {name!r}: weights must be float32, got "
+                f"{a.dtype} / {b.dtype}")
+        self.name = str(name)
+        self.a = np.ascontiguousarray(a)
+        self.b = np.ascontiguousarray(b)
+        self.scale = float(scale)
+        self.rank = int(a.shape[1])
+        if self.rank < 1:
+            raise ValueError(f"adapter {name!r}: rank must be >= 1")
+        self.nbytes = self.a.nbytes + self.b.nbytes
+        h = hashlib.blake2b(digest_size=16, key=b"paddle-lora-v1")
+        h.update(self.a.tobytes())
+        h.update(self.b.tobytes())
+        h.update(np.float64(self.scale).tobytes())
+        self.digest = h.hexdigest()
+
+    def __repr__(self):
+        return (f"LoRAAdapter({self.name!r}, rank={self.rank}, "
+                f"scale={self.scale}, digest={self.digest[:8]}...)")
+
+
+class AdapterRegistry:
+    """Ref-counted LRU host cache of :class:`LoRAAdapter`.
+
+    ``register`` validates and inserts; ``acquire``/``release`` bracket
+    a request's use (the frontend acquires at submit, releases at the
+    handle's terminal transition), and eviction only ever touches
+    refcount-0 entries. Lookup is by name OR digest.
+    """
+
+    def __init__(self, max_bytes=None, max_adapter_bytes=None):
+        self.max_bytes = (env_int("PADDLE_LORA_CACHE_MB", 256) * (1 << 20)
+                          if max_bytes is None else int(max_bytes))
+        self.max_adapter_bytes = (
+            env_int("PADDLE_LORA_MAX_MB", 64) * (1 << 20)
+            if max_adapter_bytes is None else int(max_adapter_bytes))
+        self._lock = threading.Lock()
+        self._by_name = OrderedDict()   # name -> LoRAAdapter (LRU order)
+        self._by_digest = {}            # digest -> LoRAAdapter
+        self._refs = {}                 # digest -> inflight refcount
+        self._nbytes = 0
+
+    def __len__(self):
+        with self._lock:
+            return len(self._by_name)
+
+    @property
+    def nbytes(self):
+        return self._nbytes
+
+    # ---- registration -----------------------------------------------------
+    def register(self, name, a, b, scale=1.0):
+        """Validate + insert; returns the LoRAAdapter. Idempotent for
+        identical content under the same name; replacing a name's weights
+        is allowed only while no request holds the old ones (an in-flight
+        request's adapter must stay exactly what it resolved). Raises
+        ``ValueError`` on malformed weights, an over-limit adapter, or a
+        cache that cannot fit it even after evicting every idle entry."""
+        adapter = LoRAAdapter(name, a, b, scale=scale)
+        if adapter.nbytes > self.max_adapter_bytes:
+            raise ValueError(
+                f"adapter {name!r} is {adapter.nbytes} bytes > "
+                f"max_adapter_bytes={self.max_adapter_bytes} "
+                f"(PADDLE_LORA_MAX_MB)")
+        with self._lock:
+            old = self._by_name.get(adapter.name)
+            if old is not None:
+                if old.digest == adapter.digest:
+                    self._by_name.move_to_end(adapter.name)
+                    return old          # identical content: idempotent
+                if self._refs.get(old.digest, 0) > 0:
+                    raise ValueError(
+                        f"adapter {name!r} is held by in-flight requests; "
+                        f"register the new weights under a new name")
+                self._drop_locked(old)
+            self._evict_for_locked(adapter.nbytes)
+            if self._nbytes + adapter.nbytes > self.max_bytes:
+                raise ValueError(
+                    f"adapter cache full: {self._nbytes} + {adapter.nbytes}"
+                    f" bytes > max_bytes={self.max_bytes} and every "
+                    f"resident adapter is held by in-flight requests")
+            self._by_name[adapter.name] = adapter
+            self._by_digest[adapter.digest] = adapter
+            self._nbytes += adapter.nbytes
+            _M_REGISTERED.inc()
+            self._set_gauges_locked()
+        return adapter
+
+    def _drop_locked(self, adapter):
+        self._by_name.pop(adapter.name, None)
+        self._by_digest.pop(adapter.digest, None)
+        self._refs.pop(adapter.digest, None)
+        self._nbytes -= adapter.nbytes
+        self._set_gauges_locked()
+
+    def _evict_for_locked(self, need):
+        # LRU over refcount-0 entries only: a pinned adapter is never
+        # evicted out from under the requests decoding with it
+        while self._nbytes + need > self.max_bytes:
+            victim = None
+            for ad in self._by_name.values():       # LRU order
+                if self._refs.get(ad.digest, 0) == 0:
+                    victim = ad
+                    break
+            if victim is None:
+                return
+            self._drop_locked(victim)
+            _M_EVICTED.inc()
+
+    def _set_gauges_locked(self):
+        _G_CACHE_BYTES.set(self._nbytes)
+        _G_CACHE_ENTRIES.set(len(self._by_name))
+
+    # ---- lookup / refcounting ---------------------------------------------
+    def _resolve_locked(self, ref):
+        if isinstance(ref, LoRAAdapter):
+            ref = ref.digest
+        ad = self._by_digest.get(ref)
+        if ad is None:
+            ad = self._by_name.get(ref)
+        return ad
+
+    def get(self, ref):
+        """Name | digest | LoRAAdapter -> LoRAAdapter | None (no ref)."""
+        with self._lock:
+            return self._resolve_locked(ref)
+
+    def acquire(self, ref):
+        """Resolve + pin for one in-flight request; raises ``ValueError``
+        for an unknown ref (requests must name REGISTERED adapters — the
+        bounded-vocabulary contract the metric labels also lean on)."""
+        with self._lock:
+            ad = self._resolve_locked(ref)
+            if ad is None:
+                raise ValueError(f"unknown LoRA adapter {ref!r}")
+            self._refs[ad.digest] = self._refs.get(ad.digest, 0) + 1
+            self._by_name.move_to_end(ad.name)
+            return ad
+
+    def release(self, ref):
+        """Unpin (idempotent past zero — a double release never
+        underflows into negative pins)."""
+        with self._lock:
+            ad = self._resolve_locked(ref)
+            if ad is None:
+                return
+            n = self._refs.get(ad.digest, 0)
+            if n <= 1:
+                self._refs.pop(ad.digest, None)
+            else:
+                self._refs[ad.digest] = n - 1
+
+    def refcount(self, ref):
+        with self._lock:
+            ad = self._resolve_locked(ref)
+            return 0 if ad is None else self._refs.get(ad.digest, 0)
+
+    # ---- introspection ----------------------------------------------------
+    def report(self):
+        with self._lock:
+            return {
+                "entries": len(self._by_name),
+                "bytes": self._nbytes,
+                "max_bytes": self.max_bytes,
+                "max_adapter_bytes": self.max_adapter_bytes,
+                "adapters": [
+                    {"name": ad.name, "digest": ad.digest,
+                     "rank": ad.rank, "scale": ad.scale,
+                     "nbytes": ad.nbytes,
+                     "inflight": self._refs.get(ad.digest, 0)}
+                    for ad in self._by_name.values()],
+            }

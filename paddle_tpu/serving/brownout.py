@@ -153,11 +153,18 @@ class BrownoutLadder:
     everything else is a read. All transitions land on the metrics
     registry (``brownout.level`` gauge, ``brownout.engaged`` /
     ``brownout.released`` counters labeled ``{step=}``) and in a bounded
-    ``history`` the supervisor/statusz report."""
+    ``history`` the supervisor/statusz report.
+
+    Per-tenant ladders (ISSUE 19): ``labels`` (e.g. the tenant label a
+    :class:`~paddle_tpu.serving.tenancy.Tenant` builds from its
+    registry-declared name) keeps a private ladder's gauge/counter series
+    distinct from the fleet ladder's, and ``tenant`` stamps the tenant
+    name into every ``Overloaded`` this ladder raises."""
 
     def __init__(self, steps=DEFAULT_STEPS, batch_token_cap=64,
                  dwell_s=2.0, retry_after_base_s=0.5,
-                 retry_budget=None, clock=time.monotonic):
+                 retry_budget=None, clock=time.monotonic,
+                 labels=None, tenant=None):
         self.steps = list(steps)
         if not self.steps:
             raise ValueError("need at least one brownout step")
@@ -171,6 +178,14 @@ class BrownoutLadder:
         self.dwell_s = float(dwell_s)
         self.retry_after_base_s = float(retry_after_base_s)
         self.retry_budget = retry_budget or RetryBudget()
+        self.labels = dict(labels) if labels else {}
+        self.tenant = tenant
+        # a labeled (per-tenant) ladder gets its own gauge series; the
+        # unlabeled fleet ladder keeps the module-level one so existing
+        # dashboards read byte-identically
+        self._g_level = (_M_LEVEL if not self.labels else _registry.gauge(
+            "brownout.level", labels=self.labels,
+            help="current brownout ladder level (0 = normal)"))
         self._clock = clock
         self._lock = threading.Lock()
         self._level = 0            # 0 = normal, i = steps[i-1] engaged
@@ -195,9 +210,10 @@ class BrownoutLadder:
                 self.history.append((now, "engage", step.name))
                 del self.history[:-64]
                 _registry.counter(
-                    "brownout.engaged", labels={"step": step.name},
+                    "brownout.engaged",
+                    labels={"step": step.name, **self.labels},
                     help="brownout rung engagements per declared step").inc()
-                _M_LEVEL.set(self._level)
+                self._g_level.set(self._level)
                 return self._level
             if lvl > 0 and pressure <= self.steps[lvl - 1].release_at:
                 if self._below_since is None:
@@ -209,9 +225,10 @@ class BrownoutLadder:
                     self.history.append((now, "release", step.name))
                     del self.history[:-64]
                     _registry.counter(
-                        "brownout.released", labels={"step": step.name},
+                        "brownout.released",
+                        labels={"step": step.name, **self.labels},
                         help="brownout rung releases per declared step").inc()
-                    _M_LEVEL.set(self._level)
+                    self._g_level.set(self._level)
             else:
                 self._below_since = None
         return self._level
@@ -287,7 +304,7 @@ class BrownoutLadder:
             f"{slo.name!r} traffic; retry after "
             f"{self.retry_after_s():.2f}s",
             retry_after_s=self.retry_after_s(), level=self._level,
-            step=shed_step, slo_class=slo.name)
+            step=shed_step, slo_class=slo.name, tenant=self.tenant)
 
     def check_retry(self, slo):
         """A retry must withdraw a whole token from its class budget or
@@ -296,14 +313,15 @@ class BrownoutLadder:
         if self.retry_budget.try_consume(slo.name):
             return
         _registry.counter(
-            "brownout.retry_denied", labels={"slo_class": slo.name},
+            "brownout.retry_denied",
+            labels={"slo_class": slo.name, **self.labels},
             help="retries rejected because the class retry budget was "
                  "exhausted").inc()
         raise Overloaded(
             f"retry budget exhausted for class {slo.name!r}; retry after "
             f"{self.retry_after_s():.2f}s",
             retry_after_s=self.retry_after_s(), level=self._level,
-            step="retry_budget", slo_class=slo.name)
+            step="retry_budget", slo_class=slo.name, tenant=self.tenant)
 
     def on_accepted(self, slo):
         self.retry_budget.on_accepted(slo.name)
@@ -313,6 +331,7 @@ class BrownoutLadder:
         with self._lock:
             return {
                 "level": self._level,
+                "tenant": self.tenant,
                 "step": self.step_name(),
                 "pressure": round(self.pressure, 4),
                 "steps": [{"name": s.name, "engage_at": s.engage_at,

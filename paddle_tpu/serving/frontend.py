@@ -59,8 +59,10 @@ from ..observability.metrics import registry as _registry
 from ..observability.slo import SLOMonitor
 from ..testing import chaos
 from ..utils.envs import env_bool
+from .adapters import AdapterRegistry
 from .breaker import CircuitBreaker
 from .brownout import BrownoutLadder
+from .tenancy import DEFAULT_TENANT, TenantRegistry
 from .handoff import (
     HandoffBundle,
     HandoffError,
@@ -125,6 +127,12 @@ def _count_handoff_fallback(reason):
              "prefill->decode handoff, by reason").inc()
 
 
+def _hist_summary(h):
+    """Compact histogram rollup for serving_report()/tenant_report()."""
+    return {"count": h.count, "mean": round(h.mean, 6),
+            "p50": h.quantile(0.5), "p99": h.quantile(0.99)}
+
+
 class RequestFailed(RuntimeError):
     """result()/stream(): the request reached FAILED; the message carries
     the per-request failure reason (satellite: rid -> exception string)."""
@@ -149,14 +157,18 @@ class _Entry:
                  "observed", "route_affinity", "route_score", "probe",
                  "trace", "attempt_span", "queue_span", "attempt_n",
                  "target_role", "needs_handoff", "handoff_gen",
-                 "bundle_path", "bundle", "kv_hint_deferred")
+                 "bundle_path", "bundle", "kv_hint_deferred", "tenant")
 
-    def __init__(self, req, handle, slo, deadline_t, virtual_deadline):
+    def __init__(self, req, handle, slo, deadline_t, virtual_deadline,
+                 tenant=None):
         self.req = req
         self.handle = handle
         self.slo = slo
         self.deadline_t = deadline_t
         self.virtual_deadline = virtual_deadline
+        # multi-tenant plane (ISSUE 19): the resolved Tenant this request
+        # was admitted under — per-tenant observation/report attribution
+        self.tenant = tenant
         self.observed = False   # queue_wait/ttft recorded (once per request)
         self.route_affinity = False  # last place(): won by affinity/hint?
         self.route_score = 0.0       # last place(): winning blended score
@@ -207,6 +219,10 @@ class RequestHandle:
         # in the admission transit window (in neither pending nor inflight)
         # still sees the cancel when the dispatcher re-examines it
         self._cancel_requested = False
+        # multi-tenant plane (ISSUE 19): fired exactly once at the terminal
+        # transition (whichever path wins) — releases the tenant's inflight
+        # slot and the request's LoRA adapter pin
+        self._on_terminal = None
 
     # ---- caller surface ---------------------------------------------------
     @property
@@ -338,6 +354,7 @@ class RequestHandle:
             self._status = DONE
             self._cond.notify_all()
         self._stream_q.put(("end", None))
+        self._fire_terminal()
         self._trace_finish("ok", n_generated=req.n_generated,
                            timed_out=req.timed_out)
 
@@ -349,6 +366,7 @@ class RequestHandle:
             self._status = FAILED
             self._cond.notify_all()
         self._stream_q.put(("err", str(reason)))
+        self._fire_terminal()
         self._trace_finish("error", error=str(reason))
 
     def _cancelled_now(self):
@@ -358,7 +376,17 @@ class RequestHandle:
             self._status = CANCELLED
             self._cond.notify_all()
         self._stream_q.put(("end", None))
+        self._fire_terminal()
         self._trace_finish("cancelled")
+
+    def _fire_terminal(self):
+        """Run the once-only terminal hook (tenant slot / adapter pin
+        release). Only the transition that WON calls this — the early
+        returns above never reach it — and the swap-to-None makes even a
+        double call release exactly once."""
+        cb, self._on_terminal = self._on_terminal, None
+        if cb is not None:
+            cb()
 
     def _trace_finish(self, status, **attrs):
         """Terminal trace transition, tied to the handle's own once-only
@@ -382,7 +410,8 @@ class ServingFrontend:
                  brownout=None, breaker=None, engine_factory=None,
                  start=True, warmup=None,
                  slo_monitor=None, statusz_port=None,
-                 roles=None, handoff=None, kvfabric=None):
+                 roles=None, handoff=None, kvfabric=None,
+                 tenants=None, adapters=None):
         # heartbeat_deadline_s must outlast the longest single engine call —
         # a first-compile prefill through a remote-compile tunnel can take
         # tens of seconds (PROFILE.md), and a false DEAD verdict reroutes a
@@ -455,6 +484,18 @@ class ServingFrontend:
         # driven by the monitor's fleet-pressure observations; level 0
         # (no pressure ever observed) is a no-op on every submit path
         self.brownout = brownout or BrownoutLadder()
+        # multi-tenant plane (ISSUE 19): the bounded tenant registry (a
+        # TenantRegistry, or an iterable of Tenant declarations) and the
+        # ref-counted LoRA adapter host cache. Untenanted submits resolve
+        # to the registry's default tenant — byte-compatible with the
+        # pre-tenancy API; per-tenant SLO burn-rate monitors are minted
+        # lazily on a tenant's first observation (never for "default",
+        # whose traffic stays on the fleet monitor alone)
+        self.tenants = (tenants if isinstance(tenants, TenantRegistry)
+                        else TenantRegistry(tenants or ()))
+        self.adapters = (adapters if isinstance(adapters, AdapterRegistry)
+                         else AdapterRegistry())
+        self._tenant_slo = {}   # tenant name -> SLOMonitor (under _lock)
         # circuit breaker (ISSUE 12): per-replica error/latency scoring;
         # verdicts become PROBATION/LIVE/DEAD transitions under self._lock.
         # The router consults it for half-open probe placements.
@@ -550,10 +591,10 @@ class ServingFrontend:
         return False
 
     # ---- submission -------------------------------------------------------
-    def submit(self, prompt, max_new_tokens, slo_class="interactive",
+    def submit(self, prompt, max_new_tokens, slo_class=None,
                deadline_s=None, eos_token_id=None, do_sample=False,
                temperature=1.0, top_k=0, top_p=1.0, seed=0,
-               timeout_s=None, is_retry=False):
+               timeout_s=None, is_retry=False, tenant=None, adapter=None):
         """Enqueue one request; returns its RequestHandle immediately.
 
         Raises Overloaded (load shed — the request was never queued) when
@@ -566,9 +607,81 @@ class ServingFrontend:
         ``is_retry=True`` declares a client re-submission of a rejected/
         failed request: it must withdraw from the per-class retry budget
         or is rejected immediately — the valve that keeps a retry storm
-        from re-saturating a recovering fleet (docs/SERVING.md)."""
-        slo = self.scheduler.resolve(slo_class)
+        from re-saturating a recovering fleet (docs/SERVING.md).
+
+        ``tenant`` (ISSUE 19) names a DECLARED tenant (or passes the
+        Tenant itself); None maps to the registry's default tenant —
+        byte-compatible with the pre-tenancy path. The tenant layer runs
+        ABOVE the fleet ladder and the EDF queue bound: the tenant's
+        private brownout ladder and retry budget, its token bucket
+        (``Overloaded(step="tenant_quota", tenant=..., retry_after_s=
+        <refill deficit>)``), and its inflight cap. ``slo_class=None``
+        defaults to the tenant's declared class (else "interactive").
+
+        ``adapter`` names a LoRA adapter registered in
+        ``frontend.adapters`` (name, digest, or the LoRAAdapter). It is
+        resolved + ref-pinned here and released at the handle's terminal
+        transition; the tenant's allowlist is enforced. Adapter requests
+        serve blended (never disaggregated) and co-batch with base
+        traffic inside the engine."""
+        t = self.tenants.resolve(tenant)   # unknown tenant -> ValueError
+        slo = self.scheduler.resolve(
+            slo_class or t.slo_class or "interactive")
         reserve = self.scheduler.reserve_class
+        # tenant isolation layer (ISSUE 19), ABOVE every fleet-wide check:
+        # the storming tenant must shed against ITS OWN ladder/bucket/cap —
+        # tenant-stamped, with retry_after_s from its bucket's refill
+        # deficit — before it can so much as read fleet state. The default
+        # tenant's private ladder is a pass-through: untenanted traffic is
+        # governed by the fleet ladder alone (running both would charge a
+        # retry against two budgets — not byte-compatible with pre-tenancy)
+        if t is not self.tenants.default:
+            try:
+                t.brownout.check_admission(slo, reserve)
+                if is_retry:
+                    t.brownout.check_retry(slo)
+            except Overloaded:
+                t.count_shed()
+                _M_SHED.inc()
+                raise
+        try:
+            t.admit()          # token bucket (counts its own shed)
+            t.acquire_slot()   # inflight cap (likewise)
+        except Overloaded:
+            _M_SHED.inc()
+            raise
+        ad = None
+        handle = None
+        try:
+            if adapter is not None:
+                if not t.allows_adapter(adapter):
+                    raise ValueError(
+                        f"tenant {t.name!r} is not allowed adapter "
+                        f"{getattr(adapter, 'name', adapter)!r}")
+                ad = self.adapters.acquire(adapter)
+            handle = self._submit_admitted(
+                t, ad, slo, reserve, prompt, max_new_tokens, deadline_s,
+                eos_token_id, do_sample, temperature, top_k, top_p, seed,
+                timeout_s, is_retry)
+            return handle
+        except BaseException:
+            # the slot/pin must not leak on ANY pre-queue failure; once a
+            # handle exists its once-only terminal hook owns the release
+            # (covers the window where the entry already became
+            # dispatcher-visible before the raise)
+            if handle is not None:
+                handle._fire_terminal()
+            else:
+                t.release_slot()
+                if ad is not None:
+                    self.adapters.release(ad)
+            raise
+
+    def _submit_admitted(self, t, ad, slo, reserve, prompt, max_new_tokens,
+                         deadline_s, eos_token_id, do_sample, temperature,
+                         top_k, top_p, seed, timeout_s, is_retry):
+        """submit() past the tenant layer: fleet brownout, queue bound,
+        placement. The caller owns tenant-slot/adapter release on raise."""
         # brownout ladder (ISSUE 12): the declared degradation steps run
         # BEFORE the queue-bound check — they are cheaper (two int reads)
         # and shedding at the rung is the point of having rungs at all
@@ -590,14 +703,25 @@ class ServingFrontend:
         rid = next(self._rid_counter)  # atomic under the GIL
         req = EngineRequest(rid, prompt, max_new_tokens,
                             eos_token_id=eos_token_id, sampling=sampling,
-                            seed=seed, timeout_s=timeout_s)
+                            seed=seed, timeout_s=timeout_s, adapter=ad)
         handle = RequestHandle(self, req, slo)
+
+        def _release_tenant():
+            t.release_slot()
+            if ad is not None:
+                self.adapters.release(ad)
+
+        # fired exactly once at whichever terminal transition wins (or by
+        # submit()'s failure path): the tenant slot and adapter pin follow
+        # the handle's lifetime, never a particular dispatcher's
+        handle._on_terminal = _release_tenant
         req.on_token = self._make_on_token(handle, gen=0)
         deadline_t = (req.t_enqueue + float(deadline_s)
                       if deadline_s is not None else None)
         entry = _Entry(req, handle, slo, deadline_t,
                        self.scheduler.virtual_deadline(
-                           req.t_enqueue, slo, deadline_s))
+                           req.t_enqueue, slo, deadline_s),
+                       tenant=t)
         # disaggregated placement (ISSUE 16): with a roled fleet and a live
         # decode pool, the request targets the prefill pool and owes a
         # KV-page handoff after its first token. Token delivery is
@@ -607,7 +731,12 @@ class ServingFrontend:
         # An empty/all-PROBATION decode pool degrades to blended here and
         # at every later checkpoint (availability over disaggregation).
         if self._disagg_active():
-            if self._decode_pool_live():
+            if ad is not None:
+                # LoRA requests complete blended (ISSUE 19): the adapter
+                # delta lives in the decode program's operands, not the KV
+                # bundle — a handoff would replay the prefix base-only
+                _count_handoff_fallback("lora_adapter")
+            elif self._decode_pool_live():
                 entry.target_role = "prefill"
                 entry.needs_handoff = True
                 req.on_token = None
@@ -686,6 +815,8 @@ class ServingFrontend:
         # accepted: deposit into the class retry budget — accepted goodput
         # is what funds future retries (the anti-retry-storm construction)
         self.brownout.on_accepted(slo)
+        t.brownout.on_accepted(slo)
+        t.count_admitted()
         self._wake(rep.name)
         return handle
 
@@ -1137,6 +1268,9 @@ class ServingFrontend:
                     self.handoff.discard(entry.bundle_path)
                     entry.bundle_path = None
                 self.slo.observe_event(entry.slo.name, "deadline_miss", True)
+                mon = self._tenant_monitor(entry.tenant)
+                if mon is not None:
+                    mon.observe_event(entry.slo.name, "deadline_miss", True)
                 entry.handle._fail(DeadlineExceeded(
                     f"request {entry.req.rid} ({entry.slo.name}) spent "
                     f"longer than its deadline queued"))
@@ -1283,6 +1417,9 @@ class ServingFrontend:
             _M_COMPLETED.inc()
             self._observe_completion(entry)
             self.slo.observe_event(entry.slo.name, "deadline_miss", False)
+            mon = self._tenant_monitor(entry.tenant)
+            if mon is not None:
+                mon.observe_event(entry.slo.name, "deadline_miss", False)
             handle._complete(req)
             self._breaker_outcome(rep, entry, ok=True)
 
@@ -1476,8 +1613,21 @@ class ServingFrontend:
                 # fleet.serving.kv_resident sum) tracks the fabric map
                 # without a lock — single monitor writer, advisory reads
                 rep.kv_resident = self.kvfabric.residency_count(rep.name)
+                # capacity advertisement (ISSUE 19 satellite): the fabric
+                # ranks peer fetches by this load signal and skips
+                # saturated peers entirely
+                try:
+                    self.kvfabric.set_peer_load(rep.name, rep.load())
+                except Exception:
+                    pass  # a mid-death replica must not wedge the monitor
             self._check_replica_pace()
             self.brownout.observe(self._pressure())
+            # per-tenant isolation (ISSUE 19): each tenant's private
+            # ladder follows its OWN pressure (bucket drain, inflight
+            # cap) — a storming tenant browns out alone while the fleet
+            # ladder, fed above, stays wherever fleet pressure puts it
+            for t in self.tenants.tenants():
+                t.brownout.observe(t.pressure())
             self._stop.wait(self.monitor_interval_s)
 
     def _check_replica_liveness(self, rep, now):
@@ -1739,19 +1889,43 @@ class ServingFrontend:
                                reason=str(reason))
 
     # ---- telemetry --------------------------------------------------------
-    def _class_hist(self, family, slo_name):
-        # short kind key for serving_report's per-class section
-        key = (family[len("serving."):], slo_name)
+    def _class_hist(self, family, slo_name, tenant=None):
+        # short kind key for serving_report's per-class section; the third
+        # key element is the tenant name (None = the fleet-wide series —
+        # byte-identical labels to the pre-tenancy plane)
+        key = (family[len("serving."):], slo_name,
+               tenant.name if tenant is not None else None)
         with self._lock:  # dispatchers insert, serving_report() iterates
             h = self._class_hists.get(key)
             if h is None:
                 # labeled series (ISSUE 7 satellite): one family per kind,
                 # {slo_class=...} per class — scrapers aggregate across
-                # classes, which per-class metric NAMES made impossible
+                # classes, which per-class metric NAMES made impossible.
+                # The tenant label (ISSUE 19) is BOUNDED by construction:
+                # only a declared Tenant's .name ever reaches a labels
+                # dict (the tenant-label-bounded analysis rule pins this)
+                if tenant is not None:
+                    labels = {"slo_class": slo_name, "tenant": tenant.name}
+                else:
+                    labels = {"slo_class": slo_name}
                 h = self._class_hists[key] = _registry.histogram(
-                    family, labels={"slo_class": slo_name},
+                    family, labels=labels,
                     help="per-SLO-class control-plane latency")
             return h
+
+    def _tenant_monitor(self, tenant):
+        """The tenant's lazily-minted SLO burn-rate monitor; None for the
+        default tenant (its traffic stays on the fleet monitor alone —
+        the pre-tenancy gauge series must not change shape)."""
+        if tenant is None or tenant.name == DEFAULT_TENANT:
+            return None
+        with self._lock:
+            mon = self._tenant_slo.get(tenant.name)
+            if mon is None:
+                mon = self._tenant_slo[tenant.name] = SLOMonitor(
+                    classes=self.scheduler.classes.values(),
+                    gauge_labels={"tenant": tenant.name})
+            return mon
 
     def _observe_admission(self, entry):
         if entry.observed:
@@ -1767,11 +1941,21 @@ class ServingFrontend:
             # the dispatcher re-checks after every step()
         entry.observed = True
         req, name = entry.req, entry.slo.name
-        self._class_hist("serving.queue_wait_s", name).observe(
-            req.t_admit - req.t_enqueue)
+        queue_wait = req.t_admit - req.t_enqueue
         ttft = req.t_first_token - req.t_enqueue
+        self._class_hist("serving.queue_wait_s", name).observe(queue_wait)
         self._class_hist("serving.ttft_s", name).observe(ttft)
         self.slo.observe(name, "ttft", ttft)
+        mon = self._tenant_monitor(entry.tenant)
+        if mon is not None:
+            # tenant-labeled twins of the fleet series (ISSUE 19): the
+            # fleet histograms above keep EVERY request, so aggregation
+            # never depends on summing tenant slices
+            self._class_hist("serving.queue_wait_s", name,
+                             tenant=entry.tenant).observe(queue_wait)
+            self._class_hist("serving.ttft_s", name,
+                             tenant=entry.tenant).observe(ttft)
+            mon.observe(name, "ttft", ttft)
 
     def _observe_completion(self, entry):
         req = entry.req
@@ -1779,22 +1963,29 @@ class ServingFrontend:
             tpot = (req.t_done - req.t_first_token) / (req.n_generated - 1)
             self._class_hist("serving.tpot_s", entry.slo.name).observe(tpot)
             self.slo.observe(entry.slo.name, "tpot", tpot)
+            mon = self._tenant_monitor(entry.tenant)
+            if mon is not None:
+                self._class_hist("serving.tpot_s", entry.slo.name,
+                                 tenant=entry.tenant).observe(tpot)
+                mon.observe(entry.slo.name, "tpot", tpot)
 
     def serving_report(self):
         """One structured snapshot of the whole control plane: per-replica
         health/occupancy, per-SLO-class latency summaries, and every
         serving.* counter — the operator's `kubectl describe` for the
         serving cell."""
-        def _summary(h):
-            return {"count": h.count, "mean": round(h.mean, 6),
-                    "p50": h.quantile(0.5), "p99": h.quantile(0.99)}
-
         with self._lock:
-            hists = sorted(self._class_hists.items())
+            hists = sorted(
+                self._class_hists.items(),
+                key=lambda kv: tuple(str(k) for k in kv[0]))
             replicas = {r.name: r.snapshot() for r in self.replicas}
+        # fleet-wide series only (tenant key None) — the tenant-labeled
+        # twins land in the "tenants" section below, so this block stays
+        # byte-compatible with the pre-tenancy report
         classes = {}
-        for (kind, name), h in hists:
-            classes.setdefault(name, {})[kind] = _summary(h)
+        for (kind, name, tname), h in hists:
+            if tname is None:
+                classes.setdefault(name, {})[kind] = _hist_summary(h)
         counters = {n: _registry.get(n).value for n in _registry.names("serving.")
                     if hasattr(_registry.get(n), "value")
                     and not hasattr(_registry.get(n), "hwm")}
@@ -1832,7 +2023,41 @@ class ServingFrontend:
             # cluster KV fabric (ISSUE 18): tier hit/fallthrough counters,
             # spill-ring occupancy, and the residency map (/kvz's payload)
             "kv": self.kvfabric.report(),
+            # multi-tenant plane (ISSUE 19): per-tenant quota/bucket/
+            # inflight state, private brownout rung, lazily-minted SLO
+            # burn rates, and tenant-labeled latency summaries — also
+            # served standalone at /tenantz
+            "tenants": self.tenant_report(),
+            # LoRA adapter host cache (ISSUE 19): residency, bytes, and
+            # per-adapter inflight pins
+            "adapters": self.adapters.report(),
         }
         if self.supervisor is not None:
             out["supervisor"] = self.supervisor.report()
+        return out
+
+    def tenant_report(self):
+        """Per-tenant rollup — ``serving_report()["tenants"]`` and the
+        ``/tenantz`` payload: each declared tenant's quota/bucket/inflight
+        state and private brownout ladder (``Tenant.report()``), plus its
+        SLO burn-rate monitor and tenant-labeled latency summaries when
+        the tenant has produced observations."""
+        with self._lock:
+            hists = list(self._class_hists.items())
+            mons = dict(self._tenant_slo)
+        latency = {}
+        for (kind, name, tname), h in hists:
+            if tname is not None:
+                latency.setdefault(tname, {}).setdefault(
+                    name, {})[kind] = _hist_summary(h)
+        out = {}
+        for t in self.tenants.tenants():
+            rep = t.report()
+            mon = mons.get(t.name)
+            if mon is not None:
+                rep["slo"] = mon.report()
+            lat = latency.get(t.name)
+            if lat:
+                rep["latency"] = lat
+            out[t.name] = rep
         return out

@@ -51,7 +51,7 @@ from ..testing import chaos
 from . import wireformat
 from .handoff import HandoffCorruptError, HandoffError, page_digests
 from .transport import frame_blob, unframe_blob
-from ..utils.envs import env_bool, env_int
+from ..utils.envs import env_bool, env_float, env_int
 
 __all__ = ["KVFabric", "HostSpillRing"]
 
@@ -175,6 +175,13 @@ class KVFabric:
         self._residency = {}                # key -> set of owner names
         self._by_owner = {}                 # owner -> set of keys
         self._peers = {}                    # owner -> endpoint str | callable
+        # capacity-aware peer selection (ISSUE 19 satellite): advisory
+        # 0..1 load per peer, stamped by the frontend monitor every tick.
+        # Candidates rank least-loaded-first and peers at/above the
+        # saturation threshold are skipped outright — fetching from a
+        # saturated peer steals exactly the capacity it is short of.
+        self._peer_load = {}                # owner -> advertised load
+        self.peer_saturation = env_float("PADDLE_KV_PEER_SATURATION", 0.95)
 
     # ---- residency --------------------------------------------------------
     def _advertise(self, key, owner):
@@ -222,6 +229,7 @@ class KVFabric:
                     if not owners:
                         self._residency.pop(key, None)
             self._peers.pop(owner, None)
+            self._peer_load.pop(owner, None)
             _G_RESIDENCY.set(len(self._residency))
         return len(keys)
 
@@ -335,7 +343,8 @@ class KVFabric:
             return None
 
         # peer tier: snapshot candidates under the lock, dial outside it
-        for key, j, owner, fetcher in self._peer_candidates(digs, n):
+        for key, j, owner, fetcher in self._peer_candidates(
+                digs, n, count_saturated=True):
             t0 = self.clock()
             try:
                 chaos.site("serving.kv.fetch")
@@ -367,21 +376,52 @@ class KVFabric:
             return entry, "peer"
         return None
 
-    def _peer_candidates(self, digs, n):
+    def _peer_candidates(self, digs, n, count_saturated=False):
         """[(key, n_pages, owner, fetcher)] longest-prefix-first, peers
         with a registered fetcher only, self excluded — gathered under
-        the lock so the dial loop runs lock-free."""
+        the lock so the dial loop runs lock-free.
+
+        Capacity-aware ordering (ISSUE 19 satellite): within one prefix
+        length, peers rank by advertised load ascending (name tiebreak —
+        deterministic under equal load), and a peer at/above
+        ``peer_saturation`` (PADDLE_KV_PEER_SATURATION) is skipped
+        entirely; ``count_saturated=True`` (the real fetch walk, not the
+        advisory probe) counts one ``peer_saturated`` fallthrough when
+        saturation removed at least one candidate."""
         out = []
+        skipped = 0
         with self._lock:
             for j in range(n, 0, -1):
                 key = prefix_key(digs, j)
-                for owner in sorted(self._residency.get(key, ())):
+                ranked = []
+                for owner in self._residency.get(key, ()):
                     if owner == self.name:
                         continue
                     fetcher = self._peers.get(owner)
-                    if fetcher is not None:
-                        out.append((key, j, owner, fetcher))
+                    if fetcher is None:
+                        continue
+                    load = self._peer_load.get(owner, 0.0)
+                    if load >= self.peer_saturation:
+                        skipped += 1
+                        continue
+                    ranked.append((load, owner, fetcher))
+                for load, owner, fetcher in sorted(
+                        ranked, key=lambda c: (c[0], c[1])):
+                    out.append((key, j, owner, fetcher))
+        if skipped and count_saturated:
+            self.count_fallthrough("peer_saturated")
         return out
+
+    def set_peer_load(self, owner, load):
+        """Advisory 0..1 load signal for ``owner`` (the frontend monitor
+        stamps every replica's blended load each tick; a cluster deploy
+        would gossip it). Unknown peers read as load 0 — fetchable."""
+        with self._lock:
+            self._peer_load[owner] = float(load)
+
+    def peer_load(self, owner):
+        with self._lock:
+            return self._peer_load.get(owner, 0.0)
 
     @staticmethod
     def _validate(framed, digs, n_pages, page_size):
@@ -423,6 +463,8 @@ class KVFabric:
             by_owner = {o: len(k) for o, k in self._by_owner.items() if k}
             entries = len(self._residency)
             peers = sorted(self._peers)
+            peer_load = {o: round(v, 4)
+                         for o, v in sorted(self._peer_load.items())}
         counters = {}
         for name in _registry.names(prefix="kv."):
             m = _registry.get(name)
@@ -439,5 +481,7 @@ class KVFabric:
                       "max_entries": self.spill.max_entries},
             "residency": {"entries": entries, "by_owner": by_owner},
             "peers": peers,
+            "peer_load": peer_load,
+            "peer_saturation": self.peer_saturation,
             "metrics": counters,
         }

@@ -292,13 +292,18 @@ class Router:
 
     def __init__(self, policy="prefix", affinity_weight=1.0, hint_weight=0.5,
                  load_weight=1.0, headroom_weight=1.0, max_hints=4096,
-                 peer_affinity_discount=0.5):
+                 peer_affinity_discount=0.5, adapter_affinity_weight=0.5):
         if policy not in ("prefix", "round_robin", "load"):
             raise ValueError(f"unknown router policy {policy!r}")
         self.policy = policy
         self.affinity_weight = float(affinity_weight)
         self.hint_weight = float(hint_weight)
         self.load_weight = float(load_weight)
+        # LoRA adapter affinity (ISSUE 19): a replica whose engine already
+        # holds the request's adapter on device (its per-digest cache)
+        # skips the host->device upload on admission — worth a bounded
+        # nudge, weaker than prefix affinity (pages dwarf adapter weights)
+        self.adapter_affinity_weight = float(adapter_affinity_weight)
         # cluster KV fabric (ISSUE 18): a prefix resident on a PEER is
         # worth something — the target can fetch instead of recompute —
         # but strictly less than local residency, because the fetch costs
@@ -400,6 +405,14 @@ class Router:
                     prompt, getattr(live[0].engine, "page_size", 16))
             except Exception:
                 peer_res = {}
+        # adapter-affinity probe (ISSUE 19): which replicas already hold
+        # this request's LoRA adapter on device. Advisory (the engine's
+        # digest-keyed device cache, read without its lock — a stale read
+        # costs one re-upload, never correctness); skipped under cheap
+        # like every other affinity probe.
+        req_ad = getattr(entry.req, "adapter", None)
+        if cheap or req_ad is None:
+            req_ad = None
         best, best_score, best_aff = None, None, 0.0
         best_via_peer = False
         for r in live:
@@ -425,8 +438,14 @@ class Router:
                     aff = max(local, peer)
                     via_peer = peer > local
                     hint = 1.0 if r.name == hinted else 0.0
+                lora = 0.0
+                if req_ad is not None:
+                    devs = getattr(r.engine, "_lora_device", None)
+                    if devs is not None and req_ad.digest in devs:
+                        lora = 1.0
                 score = (self.affinity_weight * aff
                          + self.hint_weight * hint
+                         + self.adapter_affinity_weight * lora
                          - self.load_weight * r.load())
             if best_score is None or score > best_score:
                 best, best_score, best_aff = r, score, aff

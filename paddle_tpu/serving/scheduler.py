@@ -48,15 +48,20 @@ class Overloaded(RuntimeError):
     re-saturating a recovering fleet; retries that ignore it burn the
     per-class retry budget and get rejected harder), ``level``/``step``
     identify the brownout rung that shed the request (``None``/"queue"
-    for a plain queue-bound shed), ``slo_class`` echoes the class."""
+    for a plain queue-bound shed), ``slo_class`` echoes the class, and
+    ``tenant`` names the tenant whose quota/inflight bound (or private
+    brownout ladder) shed it — ``step`` is ``"tenant_quota"`` /
+    ``"tenant_inflight"`` for those sheds (ISSUE 19), with
+    ``retry_after_s`` derived from the token bucket's refill deficit."""
 
     def __init__(self, msg, retry_after_s=None, level=None, step=None,
-                 slo_class=None):
+                 slo_class=None, tenant=None):
         super().__init__(msg)
         self.retry_after_s = retry_after_s
         self.level = level
         self.step = step
         self.slo_class = slo_class
+        self.tenant = tenant
 
 
 class DeadlineExceeded(RuntimeError):

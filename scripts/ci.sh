@@ -75,6 +75,7 @@ FAST_TESTS=(
   tests/test_disagg.py
   tests/test_devprof.py
   tests/test_kvfabric.py
+  tests/test_tenancy.py
 )
 
 if [[ "${1:-}" == "--fast" ]]; then

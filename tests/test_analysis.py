@@ -659,6 +659,7 @@ class TestEngine:
             "elastic-membership", "lock-order", "blocking-under-lock",
             "shared-mutation-without-lock", "env-registry",
             "chaos-site-registry", "profiler-capture", "devprof-seam",
+            "tenant-label-bounded",  # fixtures in tests/test_tenancy.py
         }
         assert tested == set(RULES)
 

@@ -753,6 +753,109 @@ class TestTierLadder:
 
 
 # ---------------------------------------------------------------------------
+# capacity-aware peer selection (ISSUE 19 satellite)
+# ---------------------------------------------------------------------------
+class TestPeerLoadAwareSelection:
+    def _two_peer_fab(self, loads, order=None):
+        """A fabric with two peers advertising the same prefix; ``order``
+        (when given) records each dial, and every fetcher answers 'lost'
+        so the walk visits every candidate."""
+        fab = KVFabric(name="me")
+        prompt = _pages_prompt(3, 2)
+        blobs = {_entry_key(prompt): _framed_entry(prompt, payload=b"ok")}
+
+        def mk(name):
+            def fetch(key):
+                if order is not None:
+                    order.append(name)
+                    return None
+                return blobs.get(key)
+            return fetch
+
+        for name, load in loads.items():
+            fab.register_peer(name, mk(name))
+            fab.advertise_prompt(prompt, 8, name)
+            fab.set_peer_load(name, load)
+        return fab, prompt
+
+    def test_saturated_peer_skipped_and_counted(self):
+        dialed = []
+        fab = KVFabric(name="me")
+        prompt = _pages_prompt(3, 2)
+        blobs = {_entry_key(prompt): _framed_entry(prompt, payload=b"cool")}
+
+        def hot(key):
+            dialed.append(key)
+            return _framed_entry(prompt, payload=b"HOT")
+
+        fab.register_peer("rep-hot", hot)
+        fab.register_peer("rep-cool", blobs.get)
+        fab.advertise_prompt(prompt, 8, "rep-hot")
+        fab.advertise_prompt(prompt, 8, "rep-cool")
+        fab.set_peer_load("rep-hot", 0.99)   # >= saturation: out of rotation
+        fab.set_peer_load("rep-cool", 0.10)
+        s0 = _val("kv.fallthrough", {"reason": "peer_saturated"})
+        got = fab.acquire(prompt, 8)
+        assert got is not None and got[0]["payload"] == b"cool"
+        assert dialed == []                  # the saturated peer never rang
+        assert _val("kv.fallthrough",
+                    {"reason": "peer_saturated"}) == s0 + 1
+
+    def test_lower_load_dialed_first(self):
+        order = []
+        fab, prompt = self._two_peer_fab(
+            {"rep-a": 0.8, "rep-b": 0.2}, order=order)
+        assert fab.acquire(prompt, 8) is None     # both 'lost' the entry
+        # registration/name order would say rep-a first; load says rep-b —
+        # at EVERY prefix length the walk tries (longest first)
+        assert order == ["rep-b", "rep-a", "rep-b", "rep-a"]
+
+    def test_unknown_load_reads_as_fetchable(self):
+        order = []
+        fab, prompt = self._two_peer_fab({"rep-a": 0.8}, order=order)
+        fab.register_peer("rep-new", lambda key: order.append("rep-new"))
+        fab.advertise_prompt(prompt, 8, "rep-new")   # never set_peer_load
+        assert fab.acquire(prompt, 8) is None
+        # implicit load 0 beats 0.8 at each prefix length
+        assert order == ["rep-new", "rep-a", "rep-new", "rep-a"]
+
+    def test_every_peer_saturated_falls_through_to_recompute(self):
+        order = []
+        fab, prompt = self._two_peer_fab(
+            {"rep-a": 0.99, "rep-b": 1.0}, order=order)
+        s0 = _val("kv.fallthrough", {"reason": "peer_saturated"})
+        assert fab.acquire(prompt, 8) is None
+        assert order == []                   # nobody was dialed at all
+        # ONE counted fallthrough per walk, not one per skipped peer
+        assert _val("kv.fallthrough",
+                    {"reason": "peer_saturated"}) == s0 + 1
+
+    def test_advisory_probe_does_not_count_saturation(self):
+        fab, prompt = self._two_peer_fab({"rep-a": 0.99})
+        s0 = _val("kv.fallthrough", {"reason": "peer_saturated"})
+        p0 = _val("kv.fallthrough", {"reason": "peer_fetch_shed"})
+        assert fab.acquire(prompt, 8, allow_peer=False) is None
+        # the allow_peer=False probe is not the fetch walk: no saturation
+        # count (and no candidates survived, so no shed count either)
+        assert _val("kv.fallthrough",
+                    {"reason": "peer_saturated"}) == s0
+        assert _val("kv.fallthrough",
+                    {"reason": "peer_fetch_shed"}) == p0
+
+    def test_replica_death_clears_the_load_entry(self):
+        fab, prompt = self._two_peer_fab({"rep-a": 0.7})
+        assert fab.peer_load("rep-a") == 0.7
+        fab.evict_replica("rep-a")
+        assert fab.peer_load("rep-a") == 0.0
+
+    def test_report_surfaces_loads_and_threshold(self):
+        fab, _ = self._two_peer_fab({"rep-a": 0.7, "rep-b": 0.25})
+        rep = fab.report()
+        assert rep["peer_load"] == {"rep-a": 0.7, "rep-b": 0.25}
+        assert rep["peer_saturation"] == pytest.approx(0.95)
+
+
+# ---------------------------------------------------------------------------
 # router: peer-resident prefixes as transfer-discounted affinity
 # ---------------------------------------------------------------------------
 class TestRouterPeerAffinity:

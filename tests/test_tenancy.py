@@ -1,0 +1,439 @@
+"""Multi-tenant serving plane (ISSUE 19): tenant registry, token-bucket
+quota admission, per-tenant SLO isolation, and the noisy-tenant drill.
+
+Tiers:
+
+- **Tenant units** — the token bucket against an injectable clock (the
+  typed shed's ``retry_after_s`` IS the refill-deficit arithmetic, not a
+  constant), the inflight cap, per-tenant pressure, and the adapter
+  allowlist;
+- **registry units** — declared-only resolution (unknown names raise,
+  nothing is minted per request string), duplicate/type/bound refusal,
+  and the auto-created unlimited default tenant;
+- **frontend integration** (FakeEngine) — ``submit(tenant=...)``
+  routing, tenant-stamped typed sheds, slot release at the handle's
+  terminal transition, default-tenant byte-compat (no tenant-labeled
+  series, no per-tenant monitor), ``serving_report()["tenants"]`` /
+  ``/tenantz``;
+- **analysis rule** — ``tenant-label-bounded`` pins the label-cardinality
+  code shape (violating / clean / marker-suppressed / out-of-package);
+- **the noisy-tenant drill** — tenant B storms at 10x its quota while a
+  chaos fault kills a replica mid-flight; every one of tenant A's
+  interactive requests completes bit-exact, A's SLO burn stays below
+  alert, B sheds typed tenant-stamped rejections, and zero handles are
+  lost or hung.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+from test_analysis import findings_for
+from test_serving_frontend import FakeEngine, _expected, _prompt
+
+from paddle_tpu.observability.statusz import StatusServer
+from paddle_tpu.serving import (
+    DEAD,
+    DEFAULT_TENANT,
+    LIVE,
+    Overloaded,
+    RequestFailed,
+    ServingFrontend,
+    Tenant,
+    TenantRegistry,
+)
+from paddle_tpu.testing import chaos
+
+
+class _Clock:
+    """Steppable clock: the bucket's refill math is tested exactly."""
+
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# tenant units: token bucket / inflight cap / pressure / allowlist
+# ---------------------------------------------------------------------------
+class TestTokenBucket:
+    def test_burst_then_shed_with_refill_deficit(self):
+        clk = _Clock()
+        t = Tenant("qa-bucket1", quota_rps=2.0, clock=clk)
+        assert t.burst == 2.0          # defaults to one steady-state second
+        t.admit()
+        t.admit()                      # the whole burst in one gulp is legal
+        with pytest.raises(Overloaded) as ei:
+            t.admit()
+        e = ei.value
+        assert e.step == "tenant_quota"
+        assert e.tenant == "qa-bucket1"
+        # the backoff demand is the server's arithmetic: (1 - tokens)/rps
+        assert e.retry_after_s == pytest.approx(0.5)
+        # partial refill shrinks the deficit by exactly the elapsed credit
+        clk.t += 0.25                  # 0.5 token back at 2 rps
+        with pytest.raises(Overloaded) as ei:
+            t.admit()
+        assert ei.value.retry_after_s == pytest.approx(0.25)
+        clk.t += 0.25                  # one whole token exists: admit now
+        t.admit()
+
+    def test_bucket_caps_at_burst(self):
+        clk = _Clock()
+        t = Tenant("qa-bucket2", quota_rps=1.0, burst=3, clock=clk)
+        clk.t += 1000.0                # idle forever: still only burst
+        assert t.tokens() == 3.0
+        for _ in range(3):
+            t.admit()
+        with pytest.raises(Overloaded):
+            t.admit()
+
+    def test_unlimited_never_sheds(self):
+        t = Tenant("qa-bucket3")       # quota_rps=0: no bucket accounting
+        for _ in range(100):
+            t.admit()
+        assert t.tokens() == t.burst
+
+    def test_declared_shape_validated(self):
+        with pytest.raises(ValueError, match="tenant name"):
+            Tenant("no spaces!")
+        with pytest.raises(ValueError, match="tenant name"):
+            Tenant("")
+        with pytest.raises(ValueError, match="tenant name"):
+            Tenant("x" * 80)           # it becomes a metric label: bounded
+        with pytest.raises(ValueError, match="burst"):
+            Tenant("qa-burst", quota_rps=1.0, burst=0.5)
+
+
+class TestInflightCap:
+    def test_cap_shed_typed_then_release_admits(self):
+        t = Tenant("qa-cap1", quota_rps=4.0, max_inflight=2)
+        t.acquire_slot()
+        t.acquire_slot()
+        with pytest.raises(Overloaded) as ei:
+            t.acquire_slot()
+        e = ei.value
+        assert e.step == "tenant_inflight"
+        assert e.tenant == "qa-cap1"
+        assert e.retry_after_s == pytest.approx(0.25)   # one arrival gap
+        t.release_slot()
+        t.acquire_slot()               # a freed slot admits again
+        assert t.inflight == 2
+
+    def test_release_never_underflows(self):
+        t = Tenant("qa-cap2", max_inflight=1)
+        for _ in range(3):
+            t.release_slot()
+        assert t.inflight == 0
+        t.acquire_slot()               # a stale double-release must not
+        assert t.inflight == 1         # have banked phantom capacity
+
+    def test_pressure_tracks_own_bounds_not_the_fleet(self):
+        clk = _Clock()
+        t = Tenant("qa-press", quota_rps=2.0, max_inflight=4, clock=clk)
+        assert t.pressure() == 0.0
+        t.admit()
+        t.admit()                      # bucket drained -> full pressure
+        assert t.pressure() == pytest.approx(1.0)
+        clk.t += 10.0                  # bucket refilled
+        assert t.pressure() == 0.0
+        t.acquire_slot()
+        t.acquire_slot()               # half the inflight cap
+        assert t.pressure() == pytest.approx(0.5)
+
+
+class TestAdapterAllowlist:
+    def test_empty_allowlist_allows_any(self):
+        assert Tenant("qa-allow1").allows_adapter("anything")
+
+    def test_allowlist_matches_name_or_digest(self):
+        t = Tenant("qa-allow2", adapters=("tone", "feedc0de"))
+        assert t.allows_adapter("tone")
+        assert not t.allows_adapter("other")
+
+        class _Ad:
+            name = "other"
+            digest = "feedc0de"
+
+        assert t.allows_adapter(_Ad())     # digest matches even if the
+        _Ad.digest = "beef"                # alias does not...
+        assert not t.allows_adapter(_Ad())
+
+
+# ---------------------------------------------------------------------------
+# registry units: declared-only, bounded
+# ---------------------------------------------------------------------------
+class TestTenantRegistry:
+    def test_default_auto_created_and_resolution(self):
+        reg = TenantRegistry()
+        assert DEFAULT_TENANT in reg
+        d = reg.resolve(None)
+        assert d is reg.default and d.name == DEFAULT_TENANT
+        assert d.quota_rps == 0.0      # unlimited: pre-tenancy byte-compat
+        t = reg.register(Tenant("qa-reg1"))
+        assert reg.resolve("qa-reg1") is t
+        assert reg.resolve(t) is t     # a Tenant resolves to itself
+
+    def test_unknown_raises_and_mints_nothing(self):
+        reg = TenantRegistry()
+        with pytest.raises(ValueError, match="unknown tenant"):
+            reg.resolve("qa-ghost")
+        assert len(reg) == 1           # the probe created no state
+
+    def test_duplicate_and_non_tenant_refused(self):
+        reg = TenantRegistry([Tenant("qa-reg2")])
+        with pytest.raises(ValueError, match="already declared"):
+            reg.register(Tenant("qa-reg2"))
+        with pytest.raises(TypeError):
+            reg.register("qa-reg2")
+
+    def test_registry_is_bounded(self):
+        reg = TenantRegistry(max_tenants=2)    # default occupies one
+        reg.register(Tenant("qa-reg3"))
+        with pytest.raises(ValueError, match="registry full"):
+            reg.register(Tenant("qa-reg4"))
+
+    def test_report_shape(self):
+        reg = TenantRegistry(
+            [Tenant("qa-reg5", quota_rps=3.0, max_inflight=7)])
+        rep = reg.report()["qa-reg5"]
+        assert rep["quota_rps"] == 3.0
+        assert rep["max_inflight"] == 7
+        assert "brownout" in rep and "pressure" in rep and "tokens" in rep
+
+
+# ---------------------------------------------------------------------------
+# frontend integration (FakeEngine)
+# ---------------------------------------------------------------------------
+class TestFrontendTenancy:
+    def test_untenanted_path_byte_compatible(self):
+        with ServingFrontend([FakeEngine()]) as fe:
+            p = _prompt(3, 5)
+            h = fe.submit(p, 4)
+            np.testing.assert_array_equal(h.result(timeout=10),
+                                          _expected(p, 4))
+            assert h.slo_class == "interactive"    # slo_class=None default
+            # default-tenant traffic mints NO tenant-labeled series and no
+            # per-tenant monitor: the pre-tenancy report shape is intact
+            with fe._lock:
+                assert all(k[2] is None for k in fe._class_hists)
+            assert fe._tenant_slo == {}
+            trep = fe.serving_report()["tenants"]
+            assert set(trep) == {DEFAULT_TENANT}
+            assert "slo" not in trep[DEFAULT_TENANT]
+
+    def test_tenant_routing_class_default_and_slot_release(self):
+        ten = Tenant("qa-fe1", slo_class="batch", quota_rps=100.0,
+                     max_inflight=2)
+        with ServingFrontend([FakeEngine()], tenants=[ten]) as fe:
+            p = _prompt(4, 6)
+            h = fe.submit(p, 3, tenant="qa-fe1")
+            assert h.slo_class == "batch"      # the tenant's declared class
+            np.testing.assert_array_equal(h.result(timeout=10),
+                                          _expected(p, 3))
+            deadline = time.monotonic() + 10
+            while ten.inflight and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert ten.inflight == 0           # released at terminal
+            h2 = fe.submit(p, 3, slo_class="interactive", tenant="qa-fe1")
+            assert h2.slo_class == "interactive"   # explicit class wins
+            h2.result(timeout=10)
+            trep = fe.serving_report()["tenants"]["qa-fe1"]
+            assert trep["admitted"] >= 2
+            # tenant-labeled twin histograms + the lazily-minted monitor
+            assert trep["latency"]["batch"]["ttft_s"]["count"] >= 1
+            assert "slo" in trep
+
+    def test_quota_shed_typed_stamped_and_counted(self):
+        clk = _Clock()
+        ten = Tenant("qa-fe2", quota_rps=1.0, clock=clk)
+        with ServingFrontend([FakeEngine()], tenants=[ten]) as fe:
+            p = _prompt(5, 7)
+            fe.submit(p, 2, tenant="qa-fe2").result(timeout=10)
+            with pytest.raises(Overloaded) as ei:
+                fe.submit(p, 2, tenant="qa-fe2")
+            e = ei.value
+            assert e.step == "tenant_quota"
+            assert e.tenant == "qa-fe2"
+            assert e.retry_after_s == pytest.approx(1.0)
+            trep = fe.serving_report()["tenants"]["qa-fe2"]
+            assert trep["shed"] >= 1 and trep["admitted"] >= 1
+
+    def test_inflight_cap_shed_and_recovery(self):
+        barrier = threading.Event()
+        ten = Tenant("qa-fe3", max_inflight=1)
+        with ServingFrontend([FakeEngine(step_barrier=barrier)],
+                             tenants=[ten]) as fe:
+            h = fe.submit(_prompt(6, 8), 4, tenant="qa-fe3")
+            with pytest.raises(Overloaded) as ei:
+                fe.submit(_prompt(6, 9), 4, tenant="qa-fe3")
+            assert ei.value.step == "tenant_inflight"
+            assert ei.value.tenant == "qa-fe3"
+            barrier.set()
+            h.result(timeout=10)
+            deadline = time.monotonic() + 10
+            while ten.inflight and time.monotonic() < deadline:
+                time.sleep(0.005)
+            fe.submit(_prompt(6, 9), 1, tenant="qa-fe3").result(timeout=10)
+
+    def test_unknown_tenant_raises_before_any_state(self):
+        with ServingFrontend([FakeEngine()]) as fe:
+            with pytest.raises(ValueError, match="unknown tenant"):
+                fe.submit(_prompt(7, 9), 2, tenant="qa-ghost")
+            assert len(fe.tenants) == 1
+
+    def test_tenantz_route_serves_the_tenant_report(self):
+        ten = Tenant("qa-fe4", quota_rps=50.0)
+        with ServingFrontend([FakeEngine()], tenants=[ten]) as fe:
+            fe.submit(_prompt(8, 9), 2, tenant="qa-fe4").result(timeout=10)
+            srv = StatusServer(port=0, frontend=fe).start()
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/tenantz",
+                        timeout=10) as resp:
+                    view = json.loads(resp.read().decode())
+            finally:
+                srv.stop()
+            assert set(view["tenants"]) >= {DEFAULT_TENANT, "qa-fe4"}
+            assert view["tenants"]["qa-fe4"]["admitted"] >= 1
+            assert "adapters" in view
+
+
+# ---------------------------------------------------------------------------
+# analysis rule: the tenant label stays bounded by construction
+# ---------------------------------------------------------------------------
+class TestTenantLabelBoundedRule:
+    RULES = ["tenant-label-bounded"]
+
+    def test_request_supplied_label_flagged(self, tmp_path):
+        out = findings_for(tmp_path, {
+            "paddle_tpu/x.py":
+                "def f(reg, user_string):\n"
+                "    reg.counter('tenant.shed',"
+                " labels={'tenant': user_string})\n"},
+            self.RULES)
+        assert [f.rule for f in out] == ["tenant-label-bounded"]
+        assert "unbounded" in out[0].message
+
+    def test_declared_name_and_literal_clean(self, tmp_path):
+        out = findings_for(tmp_path, {
+            "paddle_tpu/x.py":
+                "def f(reg, t, obj):\n"
+                "    reg.counter('a', labels={'tenant': t.name})\n"
+                "    reg.gauge('b',"
+                " gauge_labels={'tenant': obj.tenant.name})\n"
+                "    reg.gauge('c', labels={'tenant': 'literal'})\n"},
+            self.RULES)
+        assert out == []
+
+    def test_marker_suppressed(self, tmp_path):
+        out = findings_for(tmp_path, {
+            "paddle_tpu/x.py":
+                "def f(reg, u):\n"
+                "    reg.counter('a', labels={'tenant': u})"
+                "  # lint: tenant-label-bounded-ok\n"},
+            self.RULES)
+        assert out == []
+
+    def test_outside_package_exempt(self, tmp_path):
+        out = findings_for(tmp_path, {
+            "tests/x.py":
+                "def f(reg, u):\n"
+                "    reg.counter('a', labels={'tenant': u})\n"},
+            self.RULES)
+        assert out == []
+
+
+# ---------------------------------------------------------------------------
+# the noisy-tenant drill (acceptance criterion)
+# ---------------------------------------------------------------------------
+class TestNoisyTenantDrill:
+    def test_storming_tenant_cannot_starve_the_interactive_tenant(self):
+        """Tenant 'drill-bob' storms at ~10x its quota while a chaos fault
+        kills a replica mid-flight. Isolation contract: every one of
+        'drill-alice's interactive requests completes bit-exact, alice's
+        SLO burn stays below alert and her shed count is zero, bob's
+        overflow is shed with typed tenant-stamped rejections, and every
+        admitted handle — both tenants' — reaches a terminal state."""
+        alice = Tenant("drill-alice", slo_class="interactive")
+        bob = Tenant("drill-bob", slo_class="batch", quota_rps=5.0,
+                     burst=5, max_inflight=4)
+        engines = [FakeEngine(max_seqs=4), FakeEngine(max_seqs=4)]
+        fe = ServingFrontend(engines, tenants=[alice, bob],
+                             heartbeat_deadline_s=120.0)
+        try:
+            sheds, bob_handles = [], []
+            lock = threading.Lock()
+
+            def bob_storm():
+                r = np.random.RandomState(7)
+                for _ in range(60):            # ~60/s against a 5 rps bucket
+                    p = np.asarray([9] * 8 + [int(r.randint(1, 100))],
+                                   np.int32)
+                    try:
+                        h = fe.submit(p, 3, tenant="drill-bob")
+                        with lock:
+                            bob_handles.append(h)
+                    except Overloaded as e:
+                        with lock:
+                            sheds.append(e)
+                    time.sleep(0.015)
+
+            storm = threading.Thread(target=bob_storm)
+            storm.start()
+            for j in range(12):
+                p = np.asarray([4] * 8 + [50 + j], np.int32)
+                h = fe.submit(p, 3, tenant="drill-alice")
+                if j == 4:
+                    # kill one dispatcher mid-flight via the chaos site
+                    with chaos.FaultPlan().fail("serving.replica_kill",
+                                                times=1):
+                        deadline = time.monotonic() + 30
+                        while (not any(r.state == DEAD
+                                       for r in fe.replicas)
+                               and time.monotonic() < deadline):
+                            time.sleep(0.005)
+                # alice's requests ALL complete bit-exact — unconsumed
+                # in-flight work reroutes transparently across the death
+                np.testing.assert_array_equal(h.result(timeout=60),
+                                              _expected(p, 3))
+            storm.join(timeout=60)
+            assert not storm.is_alive()
+            assert any(r.state == DEAD for r in fe.replicas)
+            assert any(r.state == LIVE for r in fe.replicas)
+
+            # bob's overflow was shed, typed and tenant-stamped; the bucket
+            # (not just the inflight cap) did real work
+            assert sheds
+            assert all(e.tenant == "drill-bob" for e in sheds)
+            assert all(e.step in ("tenant_quota", "tenant_inflight")
+                       for e in sheds)
+            assert all(e.retry_after_s > 0 for e in sheds)
+            assert any(e.step == "tenant_quota" for e in sheds)
+
+            # zero lost/hung handles: every admitted request terminates —
+            # rerouted-and-done or cleanly failed with the death reason
+            done = failed = 0
+            for h in bob_handles:
+                try:
+                    h.result(timeout=60)
+                    done += 1
+                except RequestFailed:
+                    assert "died" in h.error or "re-route" in h.error
+                    failed += 1
+            assert done + failed == len(bob_handles) and done > 0
+
+            trep = fe.serving_report()["tenants"]
+            assert trep["drill-bob"]["shed"] >= len(sheds)
+            assert trep["drill-alice"]["shed"] == 0
+            # alice's burn-rate monitor exists (she is non-default and
+            # observed traffic) and is NOT alerting: isolation held
+            assert trep["drill-alice"]["slo"]["alerts"] == []
+            assert trep["drill-alice"]["latency"]["interactive"][
+                "ttft_s"]["count"] >= 1
+        finally:
+            fe.shutdown()
