@@ -15,23 +15,36 @@ interactive/batch SLO traffic and reports client-observed latency:
 
 Two configurations run back to back on the same model and load:
 
-- **baseline** — the pre-ISSUE-6 data plane: synchronous decode readback,
-  monolithic bucketed prefill, and ONE dispatch lock shared by every
-  replica (reproduced by injecting a shared ``dispatch_lock``), which is
-  exactly what the process-wide ``_DISPATCH_LOCK`` did;
+- **baseline** — the pre-ISSUE-6/pre-ISSUE-20 data plane: synchronous
+  decode readback, monolithic bucketed prefill, the legacy per-bucket
+  program ladder (``ragged=False``), and ONE dispatch lock shared by
+  every replica (reproduced by injecting a shared ``dispatch_lock``),
+  which is exactly what the process-wide ``_DISPATCH_LOCK`` did;
 - **pipelined** — chunked prefill + double-buffered async decode +
-  per-engine locks (the defaults).
+  per-engine locks + the ragged mixed-dispatch plane (the defaults;
+  ``PADDLE_SERVING_RAGGED=0`` drops the last one).
 
 ``vs_baseline`` is the pipelined/baseline aggregate tokens/s ratio. The
 acceptance bar (ISSUE 6): >= 1.5x tokens/s and >= 2x interactive TTFT p50
-under prefill on the CPU proxy.
+under prefill on the CPU proxy. ISSUE 20 adds
+``extra.compile.serving_programs`` — the count of distinct serve.*
+programs each mode compiled across warmup + run (the bucket-ladder
+collapse shows up as the pipelined count dropping >= 50% below
+baseline's) — and a perf-trajectory guard twin of bench.py's: every run
+appends its headline + per-program devprof rows to
+BENCH_trajectory.jsonl and flags >10% same-config regressions in the
+contract line.
 
 Usage: python bench_serving.py [--quick]   (--quick: tiny smoke load for
 tests; numbers are not meaningful at that scale)
 """
 import json
+import os
 import sys
 import time
+
+TRAJECTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_trajectory.jsonl")
 
 
 def _percentile(xs, q):
@@ -64,8 +77,9 @@ def _build_model():
 
 
 def _make_engines(model, mode, n_replicas, knobs):
-    """mode='baseline' reproduces the pre-ISSUE-6 data plane: sync decode,
-    monolithic prefill, one dispatch lock shared across all replicas."""
+    """mode='baseline' reproduces the pre-ISSUE-6/pre-ISSUE-20 data plane:
+    sync decode, monolithic prefill, the per-bucket program ladder, one
+    dispatch lock shared across all replicas."""
     from paddle_tpu.inference.continuous import (
         ContinuousBatchingEngine,
         _StampedRLock,
@@ -76,7 +90,8 @@ def _make_engines(model, mode, n_replicas, knobs):
         return [ContinuousBatchingEngine(
             model, max_seqs=knobs["max_seqs"], page_size=knobs["page_size"],
             max_len=knobs["max_len"], decode_block=knobs["decode_block"],
-            async_decode=False, prefill_chunk=None, dispatch_lock=shared)
+            async_decode=False, prefill_chunk=None, dispatch_lock=shared,
+            ragged=False)
             for _ in range(n_replicas)]
     return [ContinuousBatchingEngine(
         model, max_seqs=knobs["max_seqs"], page_size=knobs["page_size"],
@@ -172,6 +187,13 @@ def _run_mode(model, mode, knobs, rng_seed, vocab):
     chunks0 = int(getattr(_registry.get("serve.prefill_chunks"),
                           "value", 0) or 0)
     comp0 = _compilemem.ledger.counts()
+
+    def _serve_key_counts():
+        rep = _compilemem.ledger.report(recent=0)["by_key"]
+        return {k: v["count"] for k, v in rep.items()
+                if k.startswith("serve.")}
+
+    keys0 = _serve_key_counts()
     # ---- phase 1: mixed-SLO throughput over N replicas --------------------
     engines = _make_engines(model, mode, knobs["n_replicas"], knobs)
     load = _mixed_load(rng, vocab, knobs)
@@ -243,11 +265,18 @@ def _run_mode(model, mode, knobs, rng_seed, vocab):
     summary["ttft_under_prefill_p50_s"] = (
         round(min(probes), 5) if probes else None)
     comp1 = _compilemem.ledger.counts()
+    keys1 = _serve_key_counts()
     summary["compile"] = {
         "events": comp1["events"] - comp0["events"],
         "wall_s": round(comp1["total_wall_s"] - comp0["total_wall_s"], 3),
         "churn_alerts": comp1["churn_alerts"] - comp0["churn_alerts"],
         "warm_recompiles": warm_recompiles if knobs["repeats"] > 1 else None,
+        # ISSUE 20: DISTINCT serve.* program keys this mode compiled across
+        # warmup + both phases — the program-signature count the ragged
+        # plane exists to collapse (one mixed program per sampling config
+        # instead of the per-bucket prefill/insert + decode-k ladder)
+        "serving_programs": sum(
+            1 for k, c in keys1.items() if c > keys0.get(k, 0)),
     }
     return summary
 
@@ -375,6 +404,17 @@ def _devprof_block(model, knobs, rng_seed, vocab):
         _devprof.disable()
 
 
+def _program_rollup(base, pipe):
+    """Distinct serve.* programs compiled per mode + the reduction the
+    ragged plane bought (ISSUE 20 acceptance: >= 0.5)."""
+    b = (base.get("compile") or {}).get("serving_programs")
+    p = (pipe.get("compile") or {}).get("serving_programs")
+    out = {"baseline": b, "pipelined": p}
+    if b and p is not None:
+        out["reduction"] = round(1.0 - p / b, 4)
+    return out
+
+
 def _fleet_block():
     try:
         from paddle_tpu.observability import fleet as _fleet
@@ -384,8 +424,99 @@ def _fleet_block():
         return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
 
 
+def _trajectory_guard(res):
+    """bench.py's perf-trajectory guard (ISSUE 13), serving edition: the
+    baseline is the newest same-metric/same-backend datapoint already in
+    BENCH_trajectory.jsonl (serving runs have no BENCH_r*.json artifacts
+    of their own). Flags >10% same-config headline regressions and >10%
+    per-program device-time regressions in the contract line, then appends
+    this run's datapoint — headline + devprof rows — so the next run has a
+    baseline. Never raises: the contract line lands regardless."""
+    try:
+        prev = None
+        try:
+            with open(TRAJECTORY_PATH) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (rec.get("metric") == res.get("metric")
+                            and rec.get("backend")
+                            == (res.get("extra") or {}).get("backend")):
+                        prev = rec
+        except OSError:
+            prev = None
+        traj = None
+        if prev is not None and prev.get("value") and res.get("value"):
+            delta = res["value"] / prev["value"] - 1.0
+            # configs must match for the delta to mean anything: a
+            # smaller-config run is legitimately slower, not a regression
+            same_config = (prev.get("config")
+                           == (res.get("extra") or {}).get("config"))
+            traj = {
+                "baseline_value": prev["value"],
+                "baseline_config": prev.get("config"),
+                "baseline_ts": prev.get("ts"),
+                "delta": round(delta, 4),
+                "comparable": same_config,
+                "regression": same_config and delta < -0.10,
+            }
+            res.setdefault("extra", {})["trajectory"] = traj
+            if traj["regression"]:
+                note = (f"PERF REGRESSION: headline {res['value']} is "
+                        f"{-delta:.1%} below banked trajectory point "
+                        f"({prev['value']})")
+                prior = res["extra"].get("note")
+                res["extra"]["note"] = ((prior + "; " + note) if prior
+                                        else note)[:600]
+            # per-program mode (ISSUE 17): name WHICH serving program
+            # regressed, not just that the headline moved
+            if same_config:
+                prev_prog = prev.get("programs") or {}
+                cur_prog = (res.get("extra") or {}).get("devprof") or {}
+                regressed = []
+                for key, row in sorted(cur_prog.items()):
+                    base = prev_prog.get(key)
+                    if not (isinstance(row, dict) and isinstance(base, dict)):
+                        continue
+                    b = base.get("device_s_mean")
+                    c = row.get("device_s_mean")
+                    if b and c and c / b - 1.0 > 0.10:
+                        regressed.append(
+                            {"program": key, "delta": round(c / b - 1.0, 4),
+                             "device_s_mean": c,
+                             "baseline_device_s_mean": b})
+                if regressed:
+                    traj["program_regressions"] = regressed
+                    names = ", ".join(f"{r['program']} +{r['delta']:.1%}"
+                                      for r in regressed)
+                    note = f"PERF REGRESSION (device time): {names}"
+                    prior = res["extra"].get("note")
+                    res["extra"]["note"] = ((prior + "; " + note) if prior
+                                            else note)[:600]
+        rec = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "metric": res.get("metric"),
+            "value": res.get("value"),
+            "config": (res.get("extra") or {}).get("config"),
+            "backend": (res.get("extra") or {}).get("backend"),
+            "serving_programs": ((res.get("extra") or {}).get("compile")
+                                 or {}).get("serving_programs"),
+            "programs": (res.get("extra") or {}).get("devprof") or None,
+            "baseline": traj,
+        }
+        with open(TRAJECTORY_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except Exception as e:  # noqa: BLE001 — the contract line must land
+        res.setdefault("extra", {})["trajectory"] = {
+            "error": f"{type(e).__name__}: {str(e)[:120]}"}
+
+
 def run_bench(quick=False, seed=0):
     import jax
+
+    from paddle_tpu.utils.envs import env_bool
 
     model, on_tpu = _build_model()
     vocab = model.config.vocab_size
@@ -427,10 +558,13 @@ def run_bench(quick=False, seed=0):
         "extra": {
             "backend": jax.default_backend(),
             "seed": seed,
+            # ragged state is part of the config identity: a kill-switch
+            # run must not be trajectory-compared against a ragged one
             "config": (f"replicas{knobs['n_replicas']}-slots{knobs['max_seqs']}"
                        f"-page{knobs['page_size']}-blk{knobs['decode_block']}"
                        f"-chunk{knobs['prefill_chunk']}"
-                       f"-load{knobs['n_batch']}b/{knobs['n_interactive']}i"),
+                       f"-load{knobs['n_batch']}b/{knobs['n_interactive']}i"
+                       f"-ragged{int(env_bool('PADDLE_SERVING_RAGGED', True))}"),
             "pipelined": pipe,
             "baseline": base,
             "speedup_tokens_per_sec": round(speedup, 3),
@@ -447,6 +581,10 @@ def run_bench(quick=False, seed=0):
             "compile": {
                 "baseline": base.get("compile"),
                 "pipelined": pipe.get("compile"),
+                # ISSUE 20 headline: distinct serve.* programs per mode —
+                # the ragged plane's contract is the pipelined count
+                # landing >= 50% below the baseline ladder's
+                "serving_programs": _program_rollup(base, pipe),
             },
             # ISSUE 11 satellite: cluster health per run — snapshot
             # count, worst cross-rank phase skew, straggler verdicts
@@ -470,6 +608,7 @@ def main():
         res = {"metric": "serving_tokens_per_sec_per_chip", "value": 0.0,
                "unit": "tokens/s/chip", "vs_baseline": 0.0,
                "error": f"{type(e).__name__}: {str(e)[:300]}"}
+    _trajectory_guard(res)
     print(json.dumps(res), flush=True)
 
 

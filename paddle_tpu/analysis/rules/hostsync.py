@@ -31,6 +31,10 @@ DECODE_CRITICAL = {
         # host-sync-free as any other admission (jnp.asarray uploads only;
         # the key_base rebuild is the one designated readback)
         "adopt_request",
+        # ragged plane (ISSUE 20): the mixed prefill+decode dispatch IS the
+        # decode critical section now — same contract, same designated
+        # readbacks (the sync-path host copy and nothing else)
+        "_step_ragged", "_dispatch_ragged", "_dispatch_ragged_mixed",
     },
 }
 

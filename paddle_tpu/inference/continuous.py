@@ -70,7 +70,9 @@ from ..observability import goodput as _goodput
 from ..observability import tracing as _trace
 from ..observability.metrics import registry as _registry
 from ..ops.paged_attention import PagedLayerCache
+from ..ops.ragged_paged_attention import RaggedLayerCache
 from ..testing import chaos
+from ..utils.envs import env_bool as _env_bool
 from ..utils.envs import env_int as _env_int
 from ..utils.metrics_bus import counters
 from ..utils.retry import RetryPolicy
@@ -362,7 +364,8 @@ class _PrefillState:
     running between chunks write this slot's fed token to the scratch page
     instead of into half-built pages."""
 
-    __slots__ = ("req", "pages", "filled_pages", "n_pre0", "digests")
+    __slots__ = ("req", "pages", "filled_pages", "n_pre0", "digests",
+                 "consumed")
 
     def __init__(self, req, pages, n_pre, digests):
         self.req = req
@@ -370,6 +373,10 @@ class _PrefillState:
         self.filled_pages = n_pre   # pages holding valid KV (page-aligned)
         self.n_pre0 = n_pre         # prefix-cache hit width at admission
         self.digests = digests      # prompt-page digest chain (for indexing)
+        # ragged mode: prompt TOKENS already streamed into the pool
+        # (token-granular — ragged chunks need no page alignment); the
+        # legacy chunk path keeps its page-granular filled_pages instead
+        self.consumed = None
 
 
 class _InflightBlock:
@@ -393,7 +400,7 @@ class ContinuousBatchingEngine:
     def __init__(self, model, max_seqs=4, page_size=16, num_pages=None,
                  max_len=512, kv_cache_dtype=None, decode_block=8,
                  enable_prefix_cache=False, prefill_chunk=None,
-                 async_decode=True, dispatch_lock=None):
+                 async_decode=True, dispatch_lock=None, ragged=None):
         cfg = model.config
         self.model = model
         model.eval()
@@ -562,6 +569,25 @@ class ContinuousBatchingEngine:
         self._lora_block_fns = {}
         self._lora_dims = (getattr(cfg, "hidden_size", None),
                            getattr(cfg, "vocab_size", None))
+        # ---- ragged dispatch plane (ISSUE 20) -----------------------------
+        # One packed [T]-token forward carries every prefill chunk AND every
+        # decode row per step (ops/ragged_paged_attention.py), so the
+        # per-bucket program ladder (prefill[b]/suffix[p,b]/insert[b]/
+        # gather[p] × sampling × rank) collapses to ONE mixed program plus
+        # the fixed-k decode block per (sampling, kv-dtype, lora-rank).
+        # PADDLE_SERVING_RAGGED=0 is the kill switch: every legacy path is
+        # byte-for-byte untouched when off. Ragged needs the split
+        # trunk/head call (model.llama), so non-llama models fall back.
+        if ragged is None:
+            ragged = _env_bool("PADDLE_SERVING_RAGGED", True)
+        self._ragged = bool(ragged) and getattr(model, "llama", None) is not None
+        # token budget for prompt chunks per mixed dispatch (token-granular:
+        # ragged writes need no page alignment, unlike legacy prefill_chunk)
+        self._ragged_chunk = max(self.prefill_chunk or min(256, max_len), 1)
+        # packed token-stream width: chunk budget + one feed token per slot
+        self._ragged_tokens = self._ragged_chunk + max_seqs
+        self._ragged_fns = {}        # sampling -> mixed program
+        self._lora_ragged_fns = {}   # (sampling, rank) -> mixed lora program
         # O(1) maintained pages-in-use counter (satellite: replaces the
         # derived scan; tests assert it equals the scan at quiet points)
         self._pages_in_use = 0
@@ -1225,6 +1251,162 @@ class ContinuousBatchingEngine:
                                            len(self._lora_block_fns))
         return fn
 
+    # ---- ragged mixed programs (ISSUE 20) ---------------------------------
+    # ONE program per (sampling, kv-dtype[, lora-rank]) replaces the whole
+    # bucket ladder. The packed pass runs every prompt chunk and every
+    # decode feed token in a single [T]-token forward through the ragged
+    # paged cache (prompt length is a RUNTIME operand — cu_q_lens — not a
+    # compile-time bucket), samples each participant's boundary token, then
+    # scans the remaining k-1 fixed decode steps with the legacy block
+    # body. Mid-prefill rows are excluded from the scan by construction:
+    # their caps are 0 (write position frozen at 0) and their scan_table
+    # row is all-zeros, so their scan writes land in the scratch page.
+
+    def _ragged_fn(self, sampling):
+        fn = self._ragged_fns.get(sampling)
+        if fn is not None:
+            return fn
+        model = self.model
+        inner = model.llama
+        tied = model.lm_head is None
+        sampler = _row_sampler(*sampling)
+        T = self._ragged_tokens
+        k = self.decode_block
+
+        def ragged_step(state, tok_block, cu, row_of, token_pos, valid,
+                        use_last, last, pools, page_table, scan_table,
+                        lengths, caps, keys):
+            overrides = {kk: Tensor(v, stop_gradient=True)
+                         for kk, v in state.items()}
+            inner_ov = self._lora_inner_overrides(state)
+            q_lens = cu[1:] - cu[:-1]
+            # decode rows chained off an in-flight block feed on its device
+            # `last` tokens; each row's feed token sits at its span start.
+            # Rows with q_len == 0 alias position min(cu, T-1) — they write
+            # back the value already there, so duplicates are harmless.
+            first_idx = jnp.minimum(cu[:-1], T - 1)
+            upd = jnp.where(use_last[:, 0], last[:, 0], tok_block[first_idx])
+            toks_in = tok_block.at[first_idx].set(upd)
+            kv_lens = lengths + q_lens  # POST-write totals (ragged contract)
+            rcaches = [RaggedLayerCache(kp, vp, page_table, kv_lens, cu,
+                                        row_of, token_pos, valid)
+                       for kp, vp in pools]
+            h, presents = inner.functional_call(
+                inner_ov, Tensor(toks_in[None]),
+                position_ids=Tensor(token_pos[None].astype(jnp.int32)),
+                past_key_values=rcaches, use_cache=True, training=False,
+            )
+            # each participant samples from its LAST packed token (span end)
+            b_idx = jnp.clip(cu[1:] - 1, 0, T - 1)
+            h_b = h._data[0, b_idx]                         # [max_seqs, H]
+            base = self._lora_base_head(h_b, state, tied)   # [max_seqs, V]
+            tok0 = sampler(base, keys[0]).astype(jnp.int32)
+            pools1 = tuple((p.k_pages, p.v_pages) for p in presents)
+
+            def body(carry, step_keys):
+                toks_c, pools_c, lengths_c = carry
+                lengths_e = jnp.minimum(lengths_c, caps)
+                pkvs = [PagedLayerCache(kp, vp, scan_table, lengths_e)
+                        for kp, vp in pools_c]
+                logits, presents2 = model.functional_call(
+                    overrides, Tensor(toks_c),
+                    position_ids=Tensor(lengths_e[:, None].astype(jnp.int32)),
+                    past_key_values=pkvs, use_cache=True, training=False,
+                )
+                nxt = sampler(logits._data[:, -1], step_keys).astype(jnp.int32)
+                new_pools = tuple((p.k_pages, p.v_pages) for p in presents2)
+                return (nxt[:, None], new_pools, lengths_e + 1), nxt
+
+            (_, pools_out, _), toks_tail = jax.lax.scan(
+                body, (tok0[:, None], pools1, kv_lens), keys[1:])
+            blk = jnp.concatenate([tok0[None], toks_tail], axis=0)
+            return blk, pools_out
+
+        fn = self._ragged_fns[sampling] = _compilemem.ledgered_jit(
+            ragged_step, key=f"serve.ragged[k{k},s{sampling}]",
+            donate_argnums=(8,))
+        _compilemem.ledger.note_cache_size("serve.ragged",
+                                           len(self._ragged_fns))
+        return fn
+
+    def _lora_ragged_fn(self, sampling, rank):
+        """_ragged_fn with the fixed-depth adapter-stack gather on every
+        head projection (packed boundary rows AND scan steps) — slot 0 of
+        the stacks is zeros, so no-adapter rows add an exact +0.0 delta."""
+        key2 = (sampling, rank)
+        fn = self._lora_ragged_fns.get(key2)
+        if fn is not None:
+            return fn
+        model = self.model
+        inner = model.llama
+        tied = model.lm_head is None
+        sampler = _row_sampler(*sampling)
+        T = self._ragged_tokens
+        k = self.decode_block
+
+        def ragged_step(state, tok_block, cu, row_of, token_pos, valid,
+                        use_last, last, pools, page_table, scan_table,
+                        lengths, caps, keys, a_stack, b_stack, scales,
+                        lora_idx):
+            inner_ov = self._lora_inner_overrides(state)
+            a_rows = a_stack[lora_idx]
+            b_rows = b_stack[lora_idx]
+            s_rows = scales[lora_idx]
+            q_lens = cu[1:] - cu[:-1]
+            first_idx = jnp.minimum(cu[:-1], T - 1)
+            upd = jnp.where(use_last[:, 0], last[:, 0], tok_block[first_idx])
+            toks_in = tok_block.at[first_idx].set(upd)
+            kv_lens = lengths + q_lens
+            rcaches = [RaggedLayerCache(kp, vp, page_table, kv_lens, cu,
+                                        row_of, token_pos, valid)
+                       for kp, vp in pools]
+            h, presents = inner.functional_call(
+                inner_ov, Tensor(toks_in[None]),
+                position_ids=Tensor(token_pos[None].astype(jnp.int32)),
+                past_key_values=rcaches, use_cache=True, training=False,
+            )
+            b_idx = jnp.clip(cu[1:] - 1, 0, T - 1)
+            h_b = h._data[0, b_idx]
+            base = self._lora_base_head(h_b, state, tied)
+            delta = jnp.einsum("bh,bhr->br", h_b.astype(jnp.float32), a_rows)
+            delta = jnp.einsum("br,brv->bv", delta, b_rows)
+            tok0 = sampler(base + delta * s_rows[:, None],
+                           keys[0]).astype(jnp.int32)
+            pools1 = tuple((p.k_pages, p.v_pages) for p in presents)
+            s3 = s_rows[:, None, None]
+
+            def body(carry, step_keys):
+                toks_c, pools_c, lengths_c = carry
+                lengths_e = jnp.minimum(lengths_c, caps)
+                pkvs = [PagedLayerCache(kp, vp, scan_table, lengths_e)
+                        for kp, vp in pools_c]
+                h2, presents2 = inner.functional_call(
+                    inner_ov, Tensor(toks_c),
+                    position_ids=Tensor(lengths_e[:, None].astype(jnp.int32)),
+                    past_key_values=pkvs, use_cache=True, training=False,
+                )
+                hd = h2._data
+                base2 = self._lora_base_head(hd, state, tied)
+                d2 = jnp.einsum("bsh,bhr->bsr", hd.astype(jnp.float32),
+                                a_rows)
+                d2 = jnp.einsum("bsr,brv->bsv", d2, b_rows)
+                logits = base2 + d2 * s3
+                nxt = sampler(logits[:, -1], step_keys).astype(jnp.int32)
+                new_pools = tuple((p.k_pages, p.v_pages) for p in presents2)
+                return (nxt[:, None], new_pools, lengths_e + 1), nxt
+
+            (_, pools_out, _), toks_tail = jax.lax.scan(
+                body, (tok0[:, None], pools1, kv_lens), keys[1:])
+            blk = jnp.concatenate([tok0[None], toks_tail], axis=0)
+            return blk, pools_out
+
+        fn = self._lora_ragged_fns[key2] = _compilemem.ledgered_jit(
+            ragged_step, key=f"serve.lora_ragged[r{rank},k{k},s{sampling}]",
+            donate_argnums=(8,))
+        _compilemem.ledger.note_cache_size("serve.lora_ragged",
+                                           len(self._lora_ragged_fns))
+        return fn
+
     # ---- LoRA weight residency --------------------------------------------
     def _lora_dev(self, adapter):
         """Host A/B -> device arrays, digest-keyed LRU (the hot working
@@ -1422,6 +1604,19 @@ class ContinuousBatchingEngine:
                                 jnp.zeros((npg,), jnp.int32)))
 
     def _warmup_serves(self, prompt_lens, kw):
+        if self._ragged:
+            # ragged mode (ISSUE 20): prompt length is a RUNTIME operand of
+            # the mixed program, so the whole bucket/chunk ladder collapses
+            # to ONE dummy serve per sampling config. max_new=decode_block+1
+            # touches the mixed program (graduation step) AND the fixed-k
+            # decode-only block (the following step) — the full program set
+            # steady-state traffic dispatches; sampled configs build the
+            # key program inside those dispatches.
+            fit = min(self.max_len - 1,
+                      self._available_pages() * self.page_size - 1)
+            n = max(min(self.decode_block + 1, fit), 1)
+            self.serve([np.ones(1, np.int32)], max_new_tokens=n, **kw)
+            return
         # Decode-program ladder on a length-1 dummy prompt: the decode/block
         # programs don't depend on prompt length, and the shortest prompt
         # maximizes the admissible walk under both the max_len check and the
@@ -1480,6 +1675,14 @@ class ContinuousBatchingEngine:
         stats_before = dict(self.stats)
         pfx, self.enable_prefix_cache = self.enable_prefix_cache, False
         try:
+            if self._ragged:
+                # one dummy serve per rank covers the mixed lora program +
+                # the fixed-k lora block (same collapse as _warmup_serves)
+                fit = min(self.max_len - 1,
+                          self._available_pages() * self.page_size - 1)
+                n = max(min(self.decode_block + 1, fit), 1)
+                self.serve([np.ones(1, np.int32)], max_new_tokens=n, **kw)
+                return
             ladder_bucket = prompt_bucket(1)
             fit = min(self.max_len - 1,
                       self._available_pages() * self.page_size
@@ -1829,7 +2032,11 @@ class ContinuousBatchingEngine:
 
         def _region_for(suffix_len):
             # pages the PREFILL writes: the chunk ladder's exact page
-            # counts under chunking, the bucket-rounded region otherwise
+            # counts under chunking, the bucket-rounded region otherwise.
+            # Ragged prefill (ISSUE 20) writes token-exact — no bucket
+            # rounding, so reservations shrink to the true footprint.
+            if self._ragged:
+                return -(-suffix_len // bs_)
             if self.prefill_chunk and suffix_len > self.prefill_chunk:
                 return self._chunk_plan(suffix_len)[2]
             return self._pages_for_bucket(prompt_bucket(suffix_len), bs_)
@@ -1886,6 +2093,37 @@ class ContinuousBatchingEngine:
         req.slot = slot
         req.t_admit = time.monotonic()
         sampling = req.sampling
+        if self._ragged:
+            # ---- ragged admission (ISSUE 20): reserve pages and install
+            # the page-table row NOW; the prompt streams into the pool via
+            # step()'s MIXED ragged dispatches (prefill chunks co-scheduled
+            # with everyone's decode rows in one program) — admission does
+            # no device work at all, for any prompt length, adapter, or
+            # kv dtype. Prefix-cache hits seed `consumed` past the shared
+            # pages, exactly like the legacy chunk ladder's filled_pages.
+            req.tokens = list(prompt)  # tok0 appended at graduation
+            if n_pre:
+                self.stats["prefix_hit_pages"] += n_pre
+                _M_PREFIX_HIT.inc(n_pre)
+            if sampling[0] and req.key_base is None:
+                req.key_base = np.asarray(
+                    jax.random.fold_in(jax.random.PRNGKey(req.seed),
+                                       req.rid))
+            row = np.zeros(self.pages_per_seq, np.int32)
+            row[:len(pages)] = pages
+            self.page_table[slot] = row
+            self.lengths[slot] = n_pre * bs_
+            st = _PrefillState(req, pages, n_pre, digests)
+            st.consumed = n_pre * bs_
+            self._prefilling[slot] = st
+            self._active_sampling = sampling
+            if ad is not None:
+                self._slot_adapter[slot] = ad
+                self._active_lora_rank = ad.rank
+            if adm is not None:
+                adm.end("ok", slot=slot, pages=len(pages),
+                        prefix_hit_pages=n_pre, ragged=True)
+            return "admitted"
         if self.prefill_chunk and suffix_len > self.prefill_chunk \
                 and ad is None:
             # reserve-then-stream admission: the prompt lands chunk by
@@ -2207,6 +2445,8 @@ class ContinuousBatchingEngine:
         the new tenant's prefill/decode before it is ever read). The sync
         path (``async_decode=False``) dispatches and reads back in one
         call — the pre-pipeline behavior, kept as the bench baseline."""
+        if self._ragged:
+            return self._step_ragged()
         # requests that retired under an out-of-band _settle_inflight
         # readback surface here, so the frontend's step-driven finish path
         # sees every terminal request exactly once
@@ -2254,6 +2494,231 @@ class ContinuousBatchingEngine:
         self._update_gauges()
         return retired
 
+    def _step_ragged(self):
+        """step() twin for ragged mode (ISSUE 20): NO separate prefill
+        advancement — pending prompt chunks ride inside the decode
+        dispatch itself (_dispatch_ragged), so a step is one mixed
+        dispatch + one readback whatever the admission mix. Cancellation
+        and timeout sweeps are the legacy step()'s, verbatim."""
+        retired = self._pending_retired
+        self._pending_retired = []
+        for slot in list(self._active):
+            if self._active[slot].cancelled:
+                retired.append(self._retire(slot))
+        for slot in list(self._prefilling):
+            if self._prefilling[slot].req.cancelled:
+                retired.append(self._abort_prefill(slot))
+        if self.async_decode:
+            prev = self._inflight
+            if prev is not None:
+                self._inflight = self._dispatch_ragged(chain=prev)
+                retired.extend(self._process_block(prev))
+            if self._inflight is None and (self._active or self._prefilling):
+                self._inflight = self._dispatch_ragged()
+        elif self._active or self._prefilling:
+            rec = self._dispatch_ragged()
+            if rec is not None:
+                retired.extend(self._process_block(rec))
+        now = time.monotonic()
+        for slot in list(self._active):
+            r = self._active[slot]
+            if r.timeout_s is not None and now - r.t_admit > r.timeout_s:
+                self.stats["timed_out_requests"] += 1
+                counters.bump("fault.serve.request_timeout")
+                r.timed_out = True
+                retired.append(self._retire(slot))
+        for slot in list(self._prefilling):
+            r = self._prefilling[slot].req
+            if r.timeout_s is not None and now - r.t_admit > r.timeout_s:
+                self.stats["timed_out_requests"] += 1
+                counters.bump("fault.serve.request_timeout")
+                retired.append(self._abort_prefill(slot, timed_out=True))
+        self._update_gauges()
+        return retired
+
+    def _dispatch_ragged(self, chain=None):
+        """Dispatch one ragged step: when prompt chunks are pending, the
+        MIXED program carries them alongside every decode row; with no
+        prefill in flight the fixed-k decode block (via _dispatch_decode,
+        which pins k = decode_block in ragged mode) runs alone."""
+        if self._prefilling:
+            return self._dispatch_ragged_mixed(chain)
+        return self._dispatch_decode(chain=chain)
+
+    def _dispatch_ragged_mixed(self, chain):
+        """One mixed ragged dispatch: every decode row (one feed token
+        each) plus up to ``_ragged_chunk`` prompt tokens of mid-prefill
+        slots, packed into a single [T]-token program that then scans the
+        remaining k-1 decode steps. Prompts landing their LAST chunk
+        graduate here — the packed pass samples their first token and the
+        scan decodes them alongside everyone else, so TTFT never waits
+        for a separate prefill dispatch. Shortest-remaining-first chunk
+        scheduling drains near-done prompts into the decode group ASAP."""
+        sampling = self._active_sampling
+        lora_rank = self._active_lora_rank
+        state = self._captured_state()
+        k = self.decode_block
+        S = self.max_seqs
+        T = self._ragged_tokens
+        budget = self._ragged_chunk
+        sched = []
+        order = sorted(self._prefilling.items(),
+                       key=lambda kv: (len(kv[1].req.prompt)
+                                       - kv[1].consumed, kv[0]))
+        for slot, st in order:
+            if budget <= 0:
+                break
+            rem = len(st.req.prompt) - st.consumed
+            take = min(rem, budget)
+            budget -= take
+            sched.append((slot, st, take, take == rem))
+        covered = ({s for s, r in chain.rows
+                    if self._active.get(s) is r} if chain is not None
+                   else set())
+        chunk_rows = {slot: (st, take, final)
+                      for slot, st, take, final in sched}
+        tok_block = np.zeros(T, np.int32)
+        row_of = np.zeros(T, np.int32)
+        token_pos = np.zeros(T, np.int32)
+        valid = np.zeros(T, bool)
+        use_last = np.zeros((S, 1), bool)
+        q_lens = np.zeros(S, np.int32)
+        lengths_op = np.zeros(S, np.int32)
+        caps = np.zeros(S, np.int32)   # 0 = frozen/scratch-routed in scan
+        bases = np.zeros((S, 2), np.uint32)
+        idxs = np.zeros(S, np.int32)
+        part = []    # decode participants: active rows + graduating rows
+        grads = []   # (slot, st) graduating at THIS dispatch
+        pos = 0
+        for slot in range(S):
+            r = self._active.get(slot)
+            if r is not None:
+                caps[slot] = len(r.prompt) + r.max_new_tokens - 1
+                # host twin of the in-program freeze clamp: an over-budget
+                # row's feed position must not index past its reservation
+                base = min(int(self.lengths[slot]), int(caps[slot]))
+                q_lens[slot] = 1
+                lengths_op[slot] = base
+                row_of[pos] = slot
+                token_pos[pos] = base
+                valid[pos] = True
+                if slot in covered:
+                    use_last[slot, 0] = True
+                else:
+                    tok_block[pos] = r.last_token
+                if sampling[0]:
+                    bases[slot] = r.key_base
+                    idxs[slot] = r.n_dispatched
+                part.append((slot, r))
+                pos += 1
+            elif slot in chunk_rows:
+                st, take, final = chunk_rows[slot]
+                req = st.req
+                sl = slice(pos, pos + take)
+                tok_block[sl] = req.prompt[st.consumed:st.consumed + take]
+                row_of[sl] = slot
+                token_pos[sl] = int(self.lengths[slot]) + np.arange(take)
+                valid[sl] = True
+                q_lens[slot] = take
+                lengths_op[slot] = self.lengths[slot]
+                pos += take
+                if final:
+                    caps[slot] = len(req.prompt) + req.max_new_tokens - 1
+                    if sampling[0]:
+                        bases[slot] = req.key_base  # idx 0: first token
+                    part.append((slot, req))
+                    grads.append((slot, st))
+        cu = np.zeros(S + 1, np.int32)
+        cu[1:] = np.cumsum(q_lens)
+        # non-participant rows (empty slots + still-mid-prefill prompts)
+        # route their scan-step writes to the scratch page
+        scan_pt = np.where((caps > 0)[:, None], self.page_table, 0)
+        if chain is not None and use_last.any():
+            last_dev = chain.last
+        else:
+            last_dev = jnp.zeros((S, 1), jnp.int32)
+        if lora_rank is not None:
+            ads = sorted({a.digest: a for a
+                          in self._slot_adapter.values()}.values(),
+                         key=lambda a: a.digest)
+            a_stack, b_stack, l_scales, lpos = self._lora_stack(lora_rank,
+                                                                ads)
+            l_idx = np.zeros(S, np.int32)
+            for slot, r in part:
+                if r.adapter is not None:
+                    l_idx[slot] = lpos[r.adapter.digest]
+            l_idx = jnp.asarray(l_idx)
+
+        def dispatch():
+            chaos.site("serve.decode")
+            args = (state, jnp.asarray(tok_block), jnp.asarray(cu),
+                    jnp.asarray(row_of), jnp.asarray(token_pos),
+                    jnp.asarray(valid), jnp.asarray(use_last), last_dev,
+                    tuple(self.pools), jnp.asarray(self.page_table),
+                    jnp.asarray(scan_pt), jnp.asarray(lengths_op),
+                    jnp.asarray(caps), keys)
+            if lora_rank is not None:
+                return self._lora_ragged_fn(sampling, lora_rank)(
+                    *args, a_stack, b_stack, l_scales, l_idx)
+            return self._ragged_fn(sampling)(*args)
+
+        progs = [("ragged", sampling) if lora_rank is None
+                 else ("lora_ragged", sampling, lora_rank)]
+        if sampling[0]:
+            progs.append(("keys", k))
+        host = None
+        t0 = time.monotonic()
+        with self._locked_dispatch(*progs), _trace.span("serve.decode"):
+            if sampling[0]:
+                idx_mat = idxs[None, :] + np.arange(k, dtype=np.int32)[:, None]
+                keys = _KEYS_FROM_BASE(jnp.asarray(bases),
+                                       jnp.asarray(idx_mat))
+            else:
+                keys = jnp.zeros((k, S, 2), jnp.uint32)
+            blk, pools = self.retry_policy.run(dispatch, name="serve.decode")
+            if not self.async_decode:
+                host = np.asarray(blk)  # serve-readback-ok
+        self.pools = list(pools)  # lint: shared-mutation-without-lock-ok (engine fields are dispatcher-owned — single-threaded by contract)
+        cold = self._last_dispatch_cold
+        if _trace.enabled() and cold:
+            _goodput.serving_note("compile", time.monotonic() - t0)
+        n_chunk = sum(t for _, _, t, _ in sched)
+        _dp = _devprof._PLANE
+        if _dp is not None and not cold:
+            prog_key = (f"serve.ragged[k{k},s{sampling}]"
+                        if lora_rank is None else
+                        f"serve.lora_ragged[r{lora_rank},k{k},s{sampling}]")
+            _dp.tick(prog_key, t0, blk, tokens=k * len(part) + n_chunk,
+                     context="serve.decode")
+        last = blk[k - 1][:, None]
+        if hasattr(blk, "copy_to_host_async"):
+            blk.copy_to_host_async()
+        # ---- bookkeeping: chunks consumed, graduations, dispatch counts
+        for slot, st, take, final in sched:
+            _M_CHUNKS.inc()
+            st.consumed += take
+            self.lengths[slot] += take
+        for slot, st in grads:
+            # graduation at DISPATCH: the packed pass sampled tok0 and the
+            # scan is already decoding this row — it joins the group now;
+            # all k of its tokens arrive at this block's readback
+            del self._prefilling[slot]
+            if self.enable_prefix_cache:
+                self._index_prompt_pages(len(st.req.prompt), st.pages,
+                                         st.n_pre0, st.digests)
+            st.req.n_dispatched = 0
+            self._active[slot] = st.req
+        for slot, r in part:
+            r.n_dispatched += k
+            self.lengths[slot] += k
+        for slot, st in grads:
+            # decode invariant lengths = len(prompt) + n_dispatched - 1:
+            # the packed pass wrote the prompt's KV (lengths += take above)
+            # and each scan write lands one BEHIND its dispatch count (the
+            # boundary token fed at position true_len, not true_len+1)
+            self.lengths[slot] -= 1
+        return _InflightBlock(blk, last, k, part, t0, host=host, cold=cold)
+
     def _dispatch_decode(self, chain=None):
         """Dispatch ONE decode block over the current active set WITHOUT
         reading it back. ``chain`` is the still-in-flight previous block:
@@ -2283,8 +2748,14 @@ class ContinuousBatchingEngine:
         sampling = self._active_sampling
         lora_rank = self._active_lora_rank
         state = self._captured_state()
-        k = min(self.decode_block, remaining)
-        k = 1 << (k.bit_length() - 1)
+        if self._ragged:
+            # ragged mode (ISSUE 20): ONE fixed block size — the
+            # power-of-two k ladder is gone; short-budget rows ride under
+            # their in-program caps and overshoot is discarded at emit
+            k = self.decode_block
+        else:
+            k = min(self.decode_block, remaining)
+            k = 1 << (k.bit_length() - 1)
         rows = list(self._active.items())
         # a chained slot must still belong to the SAME request — a slot
         # retired and re-admitted while the block was in flight feeds its
@@ -2457,6 +2928,16 @@ class ContinuousBatchingEngine:
                     # retired while in flight (cancel/timeout/reroute):
                     # its overshoot tokens are discarded
                     continue
+                if r.t_first_token is None:
+                    # ragged graduation: the first token materializes at
+                    # THIS readback (legacy paths stamp in _activate, where
+                    # the prefill dispatch synced — never reached here)
+                    now_ft = time.monotonic()
+                    r.t_first_token = now_ft
+                    _M_TTFT.observe(now_ft - r.t_enqueue)
+                    if r.trace is not None:
+                        r.trace.event("first_token",
+                                      ttft_s=round(now_ft - r.t_enqueue, 6))
                 if r.trace is not None:
                     # the request's view of this fused decode dispatch
                     r.trace.span_at("decode_block", block_wall, block_wall,

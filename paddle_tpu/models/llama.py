@@ -166,23 +166,29 @@ class LlamaAttention(Layer):
           (page-pool scatter write + paged decode attention; kernel-backed
           on TPU — reference: PaddleNLP block-attention serving /
           PAPERS.md ragged-paged-attention). Decode-only (S == 1),
-          inference-only (no tape)."""
+          inference-only (no tape);
+        - ops.ragged_paged_attention.RaggedLayerCache: the ragged serving
+          cache — S is a PACKED mixed prefill+decode token stream (B == 1)
+          whose per-row spans/page tables ride in the cache entry; one
+          ragged kernel dispatch covers every row. Inference-only."""
         import jax
 
         from ..framework.core import apply
         from ..ops.paged_attention import PagedLayerCache
+        from ..ops.ragged_paged_attention import RaggedLayerCache
 
         B, S = hidden_states.shape[0], hidden_states.shape[1]
         q = manipulation.reshape(self.q_proj(hidden_states), [B, S, self.num_heads, self.head_dim])
         k = manipulation.reshape(self.k_proj(hidden_states), [B, S, self.num_kv_heads, self.head_dim])
         v = manipulation.reshape(self.v_proj(hidden_states), [B, S, self.num_kv_heads, self.head_dim])
         paged = isinstance(past_key_value, PagedLayerCache)
+        ragged = isinstance(past_key_value, RaggedLayerCache)
         if segment_ids is not None and (past_key_value is not None
                                         or cache_position is not None):
             raise ValueError("packed segment_ids do not compose with a "
                              "decode cache — packing is a training path")
         rope_kw = {}
-        if cache_position is not None or paged:
+        if cache_position is not None or paged or ragged:
             if position_ids is None and cache_position is not None:
                 pos0 = cache_position if hasattr(cache_position, "_data") else Tensor(jnp.asarray(cache_position))
                 position_ids = apply(
@@ -191,7 +197,7 @@ class LlamaAttention(Layer):
             # rope table must cover absolute positions up to the cache end
             # (the default table is sized to the CURRENT q length — one row
             # during decode)
-            if paged:
+            if paged or ragged:
                 S_tab = past_key_value.page_indices.shape[1] * past_key_value.page_size
             elif past_key_value is not None:
                 S_tab = past_key_value[0].shape[1]
@@ -221,6 +227,30 @@ class LlamaAttention(Layer):
             out = Tensor(out.reshape(B, 1, self.num_heads * self.head_dim),
                          stop_gradient=True)
             present = PagedLayerCache(k_pages, v_pages, pc.page_indices, pc.lengths)
+            return self.o_proj(out), present
+        if ragged:
+            from ..ops.ragged_paged_attention import (
+                ragged_paged_attention, write_ragged_kv,
+            )
+
+            if B != 1:
+                raise ValueError(
+                    "ragged cache packs every row into one stream: "
+                    "expected B == 1")
+            rc = past_key_value
+            k_pages = write_ragged_kv(rc.k_pages, rc.page_indices, rc.row_of,
+                                      rc.token_pos, rc.valid, k._data[0])
+            v_pages = write_ragged_kv(rc.v_pages, rc.page_indices, rc.row_of,
+                                      rc.token_pos, rc.valid, v._data[0])
+            out = ragged_paged_attention(
+                q._data[0], k_pages, v_pages, rc.kv_lens, rc.page_indices,
+                rc.cu_q_lens,
+            )
+            out = Tensor(out.reshape(B, S, self.num_heads * self.head_dim),
+                         stop_gradient=True)
+            present = RaggedLayerCache(
+                k_pages, v_pages, rc.page_indices, rc.kv_lens, rc.cu_q_lens,
+                rc.row_of, rc.token_pos, rc.valid)
             return self.o_proj(out), present
         if past_key_value is not None and cache_position is not None:
             k_cache, v_cache = past_key_value

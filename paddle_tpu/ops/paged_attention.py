@@ -11,9 +11,11 @@ B × max_len as the dense fixed-shape cache is.
 
 Two decode tiers, chosen at trace time like ops/flash_attention.py:
 - kernel: `jax.experimental.pallas.ops.tpu.paged_attention` on TPU;
-- math: a lax.scan over page columns with online-softmax accumulation —
-  peak temp is one [B, page_size] gather per step, never the
-  [B, max_len] dense cache view.
+- math: one vectorized page-table gather plus a masked dense softmax
+  (the old per-page sequential scan paid npages chained gather+dot
+  round-trips — it remains the bit-exactness reference only in spirit;
+  the gathered slab is B × max_len, the same footprint a dense cache
+  would hold).
 
 `PagedLayerCache` is the duck-typed per-layer cache entry the model's
 attention recognizes in `past_key_values` (models/llama.py) — the third
@@ -111,45 +113,42 @@ def write_token_kv(pages, page_indices, lengths, new):
 
 
 def _paged_math(q, k_pages, v_pages, lengths, page_indices, scale):
-    """Online-softmax over page columns; q: [B, Hq, D] (one decode token).
-    int8 pools dequantize per gathered page chunk — the full-precision pool
-    is never materialized."""
+    """Masked decode attention over the paged pool; q: [B, Hq, D] (one
+    decode token per row). ONE vectorized advanced-index gather pulls
+    every row's pages ([B, Hkv, npages*bs, D] slab) and a masked dense
+    softmax in f32 replaces the old per-page sequential scan — same math,
+    one batched dot instead of npages chained gather+dot steps. The slab
+    is bounded by B × pages_per_seq × page_size ≈ B × max_len, which is
+    exactly the dense-cache footprint serving configs already budget for;
+    int8 pools dequantize the gathered slab only."""
     B, Hq, D = q.shape
     kq, vq = is_quantized(k_pages), is_quantized(v_pages)
     Hkv, P, bs, _ = (k_pages.weight if kq else k_pages).shape
     npages = page_indices.shape[1]
     group = Hq // Hkv
+    M = npages * bs
 
-    qs = (q * scale).astype(jnp.float32).reshape(B, Hkv, group, D)
-    o0 = jnp.zeros((B, Hkv, group, D), jnp.float32)
-    l0 = jnp.zeros((B, Hkv, group), jnp.float32)
-    m0 = jnp.full((B, Hkv, group), -1e30, jnp.float32)
-
-    def gather(pages, quant, pid):
+    def gather(pages, quant):
         if quant:
-            return _dequantize(
-                jnp.swapaxes(pages.weight[:, pid], 0, 1),
-                jnp.swapaxes(pages.scales[:, pid], 0, 1),
-            )
-        return jnp.swapaxes(pages[:, pid], 0, 1).astype(jnp.float32)
+            full = _dequantize(
+                jnp.swapaxes(pages.weight[:, page_indices], 0, 1),
+                jnp.swapaxes(pages.scales[:, page_indices], 0, 1),
+            )  # [B, Hkv, npages, bs, D]
+        else:
+            full = jnp.swapaxes(
+                pages[:, page_indices], 0, 1).astype(jnp.float32)
+        return full.reshape(B, Hkv, M, D)
 
-    def body(carry, j):
-        o, l, m = carry
-        pid = page_indices[:, j]  # [B]
-        kb = gather(k_pages, kq, pid)  # [B,Hkv,bs,D]
-        vb = gather(v_pages, vq, pid)
-        s = jnp.einsum("bhgd,bhkd->bhgk", qs, kb)  # [B,Hkv,group,bs]
-        pos = j * bs + jnp.arange(bs)  # logical positions in this page
-        s = jnp.where(pos[None, None, None, :] < lengths[:, None, None, None], s, -1e30)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[..., None])
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1)
-        o = o * corr[..., None] + jnp.einsum("bhgk,bhkd->bhgd", p, vb)
-        return (o, l, m_new), None
-
-    (o, l, _), _ = jax.lax.scan(body, (o0, l0, m0), jnp.arange(npages))
-    out = o / jnp.maximum(l, 1e-30)[..., None]
+    ks = gather(k_pages, kq)
+    vs = gather(v_pages, vq)
+    qs = (q * scale).astype(jnp.float32).reshape(B, Hkv, group, D)
+    s = jnp.einsum("bhgd,bhkd->bhgk", qs, ks)  # [B, Hkv, group, M]
+    pos = jnp.arange(M)
+    s = jnp.where(pos[None, None, None, :] < lengths[:, None, None, None],
+                  s, -1e30)
+    p = jnp.exp(s - s.max(axis=-1, keepdims=True))
+    out = jnp.einsum("bhgk,bhkd->bhgd", p, vs)
+    out = out / jnp.maximum(p.sum(axis=-1), 1e-30)[..., None]
     return out.reshape(B, Hq, D).astype(q.dtype)
 
 

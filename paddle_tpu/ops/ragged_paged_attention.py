@@ -1,0 +1,339 @@
+"""Ragged paged attention — ONE dispatch for mixed prefill+decode rows
+(PAPERS.md: "Ragged Paged Attention: A High-Performance and Flexible LLM
+Inference Kernel for TPU").
+
+The paged decode kernel (ops/paged_attention.py) answers one query token
+per sequence; prompts had to be prefilled by a separate dense program per
+bucket, chunk-prefilled *between* decode blocks, and decode itself ran a
+program per (bucket, block) rung. This kernel removes the split: a batch
+step is a PACKED token stream `q: [T, Hq, D]` where row b owns the
+contiguous query span `cu_q_lens[b] : cu_q_lens[b+1]` — a 3-token decode
+row and a 900-token prefill chunk ride the same grid — attending over the
+shared page pool through per-row page tables. One program signature per
+(sampling, kv-dtype, lora-rank); the bucket ladder is gone.
+
+Causality is per row: query i of row b (q_len = cu[b+1]-cu[b]) sees kv
+positions `< kv_lens[b] - q_len + i + 1`, i.e. the row's full past plus
+its own packed prefix. `kv_lens` therefore counts tokens AFTER this
+step's writes (the query attends to itself), mirroring the `lengths + 1`
+convention of `paged_decode_attention`.
+
+Two tiers, same contract as the decode kernel:
+- `_ragged_pallas`: Pallas grid over (batch_row, kv_page); per-row scalar
+  prefetch (`cu_q_lens` / `kv_lens` / page table) drives the masked block
+  walk and the page-indirect BlockSpec index_map. `interpret=True` off-TPU
+  so CPU tier-1 exercises the real kernel math.
+- `_ragged_math`: lax.scan over page columns with a vectorized per-token
+  page gather and online-softmax accumulation — the XLA oracle/default.
+
+Both handle the f32 pool and the int8 QuantizedTensor pool (weight
+[Hkv, P, bs, D] int8 + per-row absmax scales).
+"""
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.envs import env_str as _env_str
+from .paged_attention import _dequantize, is_quantized
+
+LAST_IMPL = None  # "ragged-kernel" | "ragged-kernel-interpret" | "ragged-math"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class RaggedLayerCache:
+    """One layer's ragged paged cache view — the fourth cache protocol
+    models/llama.py recognizes in `past_key_values` (after growing-concat,
+    fixed-shape, and PagedLayerCache).
+
+    k_pages/v_pages: [num_kv_heads, num_pages, page_size, head_dim]
+                     (or QuantizedTensor pools)
+    page_indices:    [S, pages_per_seq] int32 rows into the pool
+    kv_lens:         [S] int32 — valid tokens per row AFTER this step's
+                     writes land (post-write totals; self-attention incl.)
+    cu_q_lens:       [S+1] int32 — packed query span boundaries
+    row_of:          [T] int32 — owning row per packed token (pad -> any)
+    token_pos:       [T] int32 — absolute kv position per packed token
+    valid:           [T] bool — False for pad tokens (writes -> scratch)
+    """
+
+    k_pages: jax.Array
+    v_pages: jax.Array
+    page_indices: jax.Array
+    kv_lens: jax.Array
+    cu_q_lens: jax.Array
+    row_of: jax.Array
+    token_pos: jax.Array
+    valid: jax.Array
+
+    def tree_flatten(self):
+        return (self.k_pages, self.v_pages, self.page_indices, self.kv_lens,
+                self.cu_q_lens, self.row_of, self.token_pos, self.valid), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def page_size(self):
+        k = self.k_pages
+        return (k.weight if is_quantized(k) else k).shape[2]
+
+
+def write_ragged_kv(pages, page_indices, row_of, token_pos, valid, new):
+    """Scatter a packed token stream's K or V rows into the pool.
+
+    new: [T, Hkv, D]. Token t lands at absolute position token_pos[t] of
+    row row_of[t] -> page page_indices[row_of[t], token_pos[t]//bs],
+    offset token_pos[t] % bs. Invalid (pad) tokens are routed to the
+    scratch page 0 offset 0; their duplicate scatter writes collide only
+    with each other, and the scratch page is never read."""
+    bs = (pages.weight if is_quantized(pages) else pages).shape[2]
+    page_of = jnp.where(
+        valid, page_indices[row_of, token_pos // bs], 0)  # [T]
+    off = jnp.where(valid, token_pos % bs, 0)             # [T]
+    new_ht = jnp.swapaxes(new, 0, 1)                      # [Hkv, T, D]
+    if is_quantized(pages):
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            quantization_utils as qu,
+        )
+
+        qt = qu.quantize_to_int8(new_ht.astype(jnp.float32))
+        return type(pages)(
+            weight=pages.weight.at[:, page_of, off, :].set(qt.weight),
+            scales=pages.scales.at[:, page_of, off, :].set(
+                qt.scales.astype(pages.scales.dtype)),
+        )
+    return pages.at[:, page_of, off, :].set(new_ht.astype(pages.dtype))
+
+
+def _ragged_meta(cu_q_lens, row_of, kv_lens):
+    """Per-token attention limit from the packed-span boundaries.
+
+    limit[t] = kv_lens[row] - q_len[row] + q_pos[t] + 1 — the ragged
+    causal rule; 0 for pad tokens so they attend nothing (their output is
+    discarded anyway, but a fully-masked softmax must stay finite)."""
+    q_lens = cu_q_lens[1:] - cu_q_lens[:-1]                      # [S]
+    t = jnp.arange(row_of.shape[0])
+    q_pos = t - cu_q_lens[row_of]                                # [T]
+    valid = t < cu_q_lens[-1]
+    limit = jnp.where(
+        valid, kv_lens[row_of] - q_lens[row_of] + q_pos + 1, 0)  # [T]
+    return limit
+
+
+def _ragged_math(q, k_pages, v_pages, kv_lens, page_indices, cu_q_lens,
+                 scale):
+    """Online-softmax over page columns for a packed ragged batch.
+
+    q: [T, Hq, D]. Each scan step gathers ONE page per packed token (a
+    [T, Hkv, bs, D] slab — bounded by T, never by S × pages_per_seq), so
+    peak temp matches `_paged_math`'s shape generalized from one decode
+    token per row to the packed stream."""
+    T, Hq, D = q.shape
+    kq, vq = is_quantized(k_pages), is_quantized(v_pages)
+    Hkv = (k_pages.weight if kq else k_pages).shape[0]
+    bs = (k_pages.weight if kq else k_pages).shape[2]
+    npages = page_indices.shape[1]
+    group = Hq // Hkv
+
+    row_of = jnp.clip(
+        jnp.searchsorted(cu_q_lens, jnp.arange(T), side="right") - 1,
+        0, cu_q_lens.shape[0] - 2)
+    limit = _ragged_meta(cu_q_lens, row_of, kv_lens)             # [T]
+
+    qs = (q * scale).astype(jnp.float32).reshape(T, Hkv, group, D)
+    o0 = jnp.zeros((T, Hkv, group, D), jnp.float32)
+    l0 = jnp.zeros((T, Hkv, group), jnp.float32)
+    m0 = jnp.full((T, Hkv, group), -1e30, jnp.float32)
+
+    def gather(pages, quant, pid):
+        if quant:
+            return _dequantize(
+                jnp.swapaxes(pages.weight[:, pid], 0, 1),
+                jnp.swapaxes(pages.scales[:, pid], 0, 1),
+            )
+        return jnp.swapaxes(pages[:, pid], 0, 1).astype(jnp.float32)
+
+    def body(j, carry):
+        o, l, m = carry
+        pid = page_indices[row_of, j]                            # [T]
+        kb = gather(k_pages, kq, pid)                            # [T,Hkv,bs,D]
+        vb = gather(v_pages, vq, pid)
+        s = jnp.einsum("thgd,thkd->thgk", qs, kb)                # [T,Hkv,g,bs]
+        pos = j * bs + jnp.arange(bs)
+        s = jnp.where(pos[None, None, None, :] < limit[:, None, None, None],
+                      s, -1e30)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum("thgk,thkd->thgd", p, vb)
+        return (o, l, m_new)
+
+    # dynamic trip count: pages past every live row's KV extent are fully
+    # masked (p underflows to exactly 0.0), so skipping them is
+    # bit-identical — and the serving page tables are max_len wide while
+    # typical live KV is a few pages. fori_loop keeps ONE program
+    # signature (the bound is an operand, not a shape); the TPU path never
+    # sees this loop (the Pallas kernel masks blocks in-grid).
+    q_lens = cu_q_lens[1:] - cu_q_lens[:-1]
+    n_live = jnp.max(jnp.where(q_lens > 0, (kv_lens + bs - 1) // bs, 0))
+    (o, l, _) = jax.lax.fori_loop(
+        0, jnp.minimum(n_live, npages), body, (o0, l0, m0))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(T, Hq, D).astype(q.dtype)
+
+
+def _ragged_kernel(S, npages, bs, group, quantized,
+                   # scalar prefetch (order fixed by PrefetchScalarGridSpec)
+                   cu_ref, kvl_ref, pt_ref,
+                   # blocked operands
+                   *refs):
+    """Grid (batch_row b, kv_page j). The whole packed q block stays
+    resident; each step streams ONE page of row b's KV (page-indirect
+    index_map off the prefetched page table) and folds it into the
+    online-softmax scratch of every query token — tokens outside row b or
+    past their causal limit are masked. Accumulators normalize into the
+    output on the final step."""
+    import jax.experimental.pallas as pl
+
+    if quantized:
+        q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref, acc, m, l = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc, m, l = refs
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    T = q_ref.shape[0]
+
+    @pl.when((b == 0) & (j == 0))
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m[...] = jnp.full_like(m, -1e30)
+        l[...] = jnp.zeros_like(l)
+
+    cu0 = cu_ref[b]
+    cu1 = cu_ref[b + 1]
+    kvl = kvl_ref[b]
+    q_len = cu1 - cu0
+    n_pages = (kvl + bs - 1) // bs
+
+    @pl.when((q_len > 0) & (j < n_pages))
+    def _accumulate():
+        k_blk = k_ref[:, 0].astype(jnp.float32)          # [Hkv, bs, D]
+        v_blk = v_ref[:, 0].astype(jnp.float32)
+        if quantized:
+            # from_int8: w * scales / 127.5 (per-row absmax)
+            k_blk = k_blk * ks_ref[:, 0].astype(jnp.float32) / 127.5
+            v_blk = v_blk * vs_ref[:, 0].astype(jnp.float32) / 127.5
+        Hkv = k_blk.shape[0]
+        qs = q_ref[...].astype(jnp.float32).reshape(T, Hkv, group, -1)
+        s = jnp.einsum("thgd,hkd->thgk", qs, k_blk,
+                       preferred_element_type=jnp.float32)  # [T,Hkv,g,bs]
+        t_ids = jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)
+        in_row = (t_ids >= cu0) & (t_ids < cu1)          # [T, 1]
+        lim = kvl - q_len + (t_ids - cu0) + 1            # [T, 1]
+        kv_pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        mask = in_row & (kv_pos < lim)                   # [T, bs]
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
+        m_prev = m[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(mask[:, None, None, :], p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        m[...] = m_new
+        l[...] = l[...] * corr + p.sum(axis=-1)
+        acc[...] = acc[...] * corr[..., None] + jnp.einsum(
+            "thgk,hkd->thgd", p, v_blk,
+            preferred_element_type=jnp.float32)
+
+    @pl.when((b == S - 1) & (j == npages - 1))
+    def _finalize():
+        out = acc[...] / jnp.maximum(l[...], 1e-30)[..., None]
+        o_ref[...] = out.reshape(o_ref.shape).astype(o_ref.dtype)
+
+
+def _ragged_pallas(q, k_pages, v_pages, kv_lens, page_indices, cu_q_lens,
+                   scale, interpret):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, Hq, D = q.shape
+    kq = is_quantized(k_pages)
+    kw = k_pages.weight if kq else k_pages
+    Hkv, _, bs, _ = kw.shape
+    S, npages = page_indices.shape
+    group = Hq // Hkv
+
+    def page_map(b, j, cu, kvl, pt):
+        return (0, pt[b, j], 0, 0)
+
+    def whole(b, j, cu, kvl, pt):
+        return (0, 0, 0)
+
+    page_spec = pl.BlockSpec((Hkv, 1, bs, D), page_map)
+    scale_spec = pl.BlockSpec((Hkv, 1, bs, 1), page_map)
+    q_spec = pl.BlockSpec((T, Hq, D), whole)
+
+    if kq:
+        in_specs = [q_spec, page_spec, scale_spec, page_spec, scale_spec]
+        operands = (q * scale, k_pages.weight, k_pages.scales,
+                    v_pages.weight, v_pages.scales)
+    else:
+        in_specs = [q_spec, page_spec, page_spec]
+        operands = (q * scale, k_pages, v_pages)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, npages),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((T, Hkv, group, D), jnp.float32),  # acc
+            pltpu.VMEM((T, Hkv, group), jnp.float32),     # running max
+            pltpu.VMEM((T, Hkv, group), jnp.float32),     # running sum
+        ],
+    )
+    kernel = functools.partial(_ragged_kernel, S, npages, bs, group, kq)
+    fn = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, Hq, D), q.dtype),
+        interpret=interpret,
+    )
+    return fn(cu_q_lens.astype(jnp.int32), kv_lens.astype(jnp.int32),
+              page_indices.astype(jnp.int32), *operands)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, kv_lens, page_indices,
+                           cu_q_lens, scale=None, impl=None):
+    """Mixed prefill+decode attention over the paged pool.
+
+    q: [T, Hq, D] packed token stream; returns [T, Hq, D]. kv_lens must
+    already include this step's tokens (post-write totals). Pad tokens
+    (beyond cu_q_lens[-1]) return zeros-ish garbage — callers discard
+    them. impl: None/"auto" (kernel on TPU, math elsewhere), "math",
+    "pallas" (interpret-mode off TPU — the CPU tier-1 path through the
+    real kernel body)."""
+    global LAST_IMPL
+    from .flash_attention import _FORCE_XLA, _on_tpu
+
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    impl = impl or _env_str("PADDLE_RAGGED_IMPL", "auto")
+    on_tpu = _on_tpu() and not _FORCE_XLA
+    if impl == "pallas" or (impl == "auto" and on_tpu):
+        try:
+            out = _ragged_pallas(q, k_pages, v_pages, kv_lens, page_indices,
+                                 cu_q_lens, scale, interpret=not on_tpu)
+            LAST_IMPL = ("ragged-kernel" if on_tpu
+                         else "ragged-kernel-interpret")
+            return out
+        except Exception:
+            if impl == "pallas":
+                raise
+    LAST_IMPL = "ragged-math"
+    return _ragged_math(q, k_pages, v_pages, kv_lens, page_indices,
+                        cu_q_lens, scale)

@@ -76,6 +76,7 @@ FAST_TESTS=(
   tests/test_devprof.py
   tests/test_kvfabric.py
   tests/test_tenancy.py
+  tests/test_ragged_attention.py
 )
 
 if [[ "${1:-}" == "--fast" ]]; then

@@ -489,7 +489,11 @@ def _engine(model, **kw):
 class TestServingFaults:
     def test_failed_prefill_retires_slot_not_batch(self, tiny_engine_setup):
         model, prompts = tiny_engine_setup
-        eng = _engine(model)
+        # ragged=False: the per-request prefill dispatch under fault
+        # injection is the LEGACY admission path — ragged admission does
+        # no device work (prompts stream inside shared mixed dispatches,
+        # where a failure is not attributable to one request)
+        eng = _engine(model, ragged=False)
         ref = eng.serve(prompts, max_new_tokens=4)
         counters.reset("fault.")
         with chaos.FaultPlan().fail("serve.prefill", times=1):
@@ -549,7 +553,11 @@ class TestServingFaults:
 
     def test_request_deadline_returns_partial(self, tiny_engine_setup):
         model, prompts = tiny_engine_setup
-        eng = _engine(model, max_seqs=1, decode_block=1)
+        # ragged=False: the "partial includes the first token" guarantee
+        # is the legacy admission's (tok0 sampled synchronously at admit);
+        # ragged first tokens arrive at the first block readback, so an
+        # instant deadline can return a prompt-only partial
+        eng = _engine(model, max_seqs=1, decode_block=1, ragged=False)
         outs = eng.serve([prompts[0]], max_new_tokens=30, request_timeout_s=0.0)
         assert eng.stats["timed_out_requests"] == 1
         # partial result: the prompt plus at least the prefill token

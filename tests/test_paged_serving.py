@@ -110,7 +110,7 @@ class TestContinuousBatching:
         new = 7
         mk = lambda: ContinuousBatchingEngine(  # noqa: E731
             m, max_seqs=2, page_size=16, num_pages=12, max_len=64,
-            decode_block=4)
+            decode_block=4, ragged=False)  # the LEGACY ladder under test
         warm, cold = mk(), mk()
         warm.warmup(lens)
         # every program the serve loop can hit is already compiled
@@ -403,9 +403,13 @@ class TestPrefixCache:
         base = ContinuousBatchingEngine(m, max_seqs=2, page_size=8,
                                         num_pages=32, max_len=96)
         want = base.serve(prompts, max_new_tokens=new)
+        # ragged=False: hit-count timing under test is the MONOLITHIC
+        # path's (pages index at admission, so co-admitted requests hit
+        # each other); ragged indexes at graduation like the chunk ladder
         eng = ContinuousBatchingEngine(m, max_seqs=2, page_size=8,
                                        num_pages=32, max_len=96,
-                                       enable_prefix_cache=True)
+                                       enable_prefix_cache=True,
+                                       ragged=False)
         got = eng.serve(prompts, max_new_tokens=new)
         for w, g in zip(want, got):
             np.testing.assert_array_equal(w, g)
@@ -513,7 +517,8 @@ class TestPrefixCache:
         m, cfg = self._model()
         eng = ContinuousBatchingEngine(m, max_seqs=1, page_size=8,
                                        num_pages=40, max_len=256,
-                                       enable_prefix_cache=True)
+                                       enable_prefix_cache=True,
+                                       ragged=False)  # legacy ladder programs
         eng.warmup([20, 70])
         from paddle_tpu.generation import prompt_bucket
 

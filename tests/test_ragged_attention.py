@@ -1,0 +1,317 @@
+"""Ragged paged attention (ISSUE 20): one program for mixed prefill+decode.
+
+Oracle strategy, two levels:
+- kernel: the packed ragged batch must reproduce a per-row dense masked
+  softmax over the page pool (mixed decode rows, mid-prompt chunks, fresh
+  prefills, empty rows in ONE call), with the interpret-mode Pallas tier
+  matching the math tier — CPU tier-1 exercises the real kernel body;
+- engine: a ragged-mode ContinuousBatchingEngine must emit bit-identical
+  tokens to the legacy bucket-ladder engine (the PR 6 oracle pattern) on
+  every path that composes — greedy/sampled, async/sync, EOS mid-block,
+  prefix cache, chunked long prompts, int8 pool, LoRA batches — while
+  compiling ONE mixed program per (sampling, rank) instead of the ladder.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.continuous import ContinuousBatchingEngine
+from paddle_tpu.ops import ragged_paged_attention as rpa
+
+import jax.numpy as jnp
+
+
+@pytest.fixture(scope="module")
+def model():
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(31)
+    m = LlamaForCausalLM(llama_tiny(num_hidden_layers=2))
+    m.eval()
+    return m
+
+
+def _mixed_case(seed=0, quantized=False):
+    """One packed batch exercising every row shape at once:
+    row 0 decode (q_len=1 over history), row 1 mid-prompt chunk,
+    row 2 fresh full prefill, row 3 empty; 2 pad tokens."""
+    rng = np.random.RandomState(seed)
+    S, P_seq, bs, Hq, Hkv, D = 4, 3, 4, 4, 2, 8
+    P = 1 + S * P_seq
+    kp = rng.randn(Hkv, P, bs, D).astype(np.float32)
+    vp = rng.randn(Hkv, P, bs, D).astype(np.float32)
+    page_indices = np.arange(1, P).reshape(S, P_seq).astype(np.int32)
+    q_lens = np.array([1, 6, 7, 0], np.int32)
+    kv_lens = np.array([9, 11, 7, 0], np.int32)
+    cu = np.zeros(S + 1, np.int32)
+    cu[1:] = np.cumsum(q_lens)
+    T = 16  # cu[-1] == 14 -> two pad tokens
+    q = rng.randn(T, Hq, D).astype(np.float32)
+    kpj, vpj = jnp.asarray(kp), jnp.asarray(vp)
+    if quantized:
+        from paddle_tpu.ops.paged_attention import quantize_pages
+
+        kpj, vpj = quantize_pages(kpj), quantize_pages(vpj)
+    return (jnp.asarray(q), kpj, vpj, jnp.asarray(kv_lens),
+            jnp.asarray(page_indices), jnp.asarray(cu)), (
+            kp, vp, page_indices, kv_lens, q_lens, cu, q, bs, Hq, Hkv, D)
+
+
+def _dense_oracle(kp, vp, page_indices, kv_lens, q_lens, cu, q, bs,
+                  Hq, Hkv, D):
+    """Per-row dense masked softmax; limit[t] = kv - q_len + q_pos + 1."""
+    T = q.shape[0]
+    out = np.zeros((T, Hq, D), np.float32)
+    g = Hq // Hkv
+    for b in range(len(kv_lens)):
+        if q_lens[b] == 0:
+            continue
+        kd = np.concatenate([kp[:, p] for p in page_indices[b]], axis=1)
+        vd = np.concatenate([vp[:, p] for p in page_indices[b]], axis=1)
+        for j in range(q_lens[b]):
+            t = cu[b] + j
+            limit = kv_lens[b] - q_lens[b] + j + 1
+            for h in range(Hq):
+                kh, vh = kd[h // g, :limit], vd[h // g, :limit]
+                s = (q[t, h] @ kh.T) / np.sqrt(D)
+                p_ = np.exp(s - s.max())
+                p_ /= p_.sum()
+                out[t, h] = p_ @ vh
+    return out
+
+
+class TestRaggedKernel:
+    def test_mixed_rows_match_dense_oracle(self):
+        args, raw = _mixed_case()
+        out = rpa.ragged_paged_attention(*args, impl="math")
+        ref = _dense_oracle(*raw)
+        cu = raw[5]
+        np.testing.assert_allclose(np.asarray(out)[:cu[-1]], ref[:cu[-1]],
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_interpret_pallas_matches_math(self):
+        """CPU tier-1 runs the REAL kernel body under interpret=True; it
+        must agree with the math tier on the same mixed batch."""
+        args, raw = _mixed_case(seed=3)
+        ref = rpa.ragged_paged_attention(*args, impl="math")
+        out = rpa.ragged_paged_attention(*args, impl="pallas")
+        assert rpa.LAST_IMPL == "ragged-kernel-interpret"
+        cu = raw[5]
+        np.testing.assert_allclose(np.asarray(out)[:cu[-1]],
+                                   np.asarray(ref)[:cu[-1]],
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_int8_pool_pallas_matches_math(self):
+        """Both tiers dequantize with the same from_int8 math — the int8
+        pool path must agree bit-for-bit between them."""
+        args, raw = _mixed_case(seed=5, quantized=True)
+        ref = rpa.ragged_paged_attention(*args, impl="math")
+        out = rpa.ragged_paged_attention(*args, impl="pallas")
+        cu = raw[5]
+        np.testing.assert_array_equal(np.asarray(out)[:cu[-1]],
+                                      np.asarray(ref)[:cu[-1]])
+
+    def test_write_ragged_kv_places_tokens_and_scratches_pads(self):
+        rng = np.random.RandomState(1)
+        S, P_seq, bs, Hkv, D = 2, 2, 4, 2, 3
+        P = 1 + S * P_seq
+        pages = jnp.zeros((Hkv, P, bs, D), jnp.float32)
+        page_indices = jnp.asarray(
+            np.arange(1, P).reshape(S, P_seq).astype(np.int32))
+        # row 0 tokens at positions 2,3,4 (page boundary crossing);
+        # row 1 token at position 0; one pad token
+        row_of = jnp.asarray(np.array([0, 0, 0, 1, 0], np.int32))
+        token_pos = jnp.asarray(np.array([2, 3, 4, 0, 0], np.int32))
+        valid = jnp.asarray(np.array([1, 1, 1, 1, 0], bool))
+        new = jnp.asarray(rng.randn(5, Hkv, D).astype(np.float32))
+        out = np.asarray(rpa.write_ragged_kv(pages, page_indices, row_of,
+                                             token_pos, valid, new))
+        new_h = np.swapaxes(np.asarray(new), 0, 1)
+        np.testing.assert_array_equal(out[:, 1, 2], new_h[:, 0])
+        np.testing.assert_array_equal(out[:, 1, 3], new_h[:, 1])
+        np.testing.assert_array_equal(out[:, 2, 0], new_h[:, 2])
+        np.testing.assert_array_equal(out[:, 3, 0], new_h[:, 3])
+        # the pad token landed in scratch page 0, nowhere else
+        assert np.any(out[:, 0] != 0)
+        written = {(1, 2), (1, 3), (2, 0), (3, 0)}
+        for pid in range(1, P):
+            for off in range(bs):
+                if (pid, off) not in written:
+                    assert not np.any(out[:, pid, off])
+
+
+def _prompts(rng, lens, vocab=100):
+    return [rng.randint(1, vocab, size=n).astype(np.int32) for n in lens]
+
+
+def _serve_pair(model, prompts, ragged_kw=None, legacy_kw=None, **serve_kw):
+    """(legacy tokens, ragged tokens) for the same workload."""
+    base = dict(max_seqs=4, page_size=16, max_len=160)
+    legacy = ContinuousBatchingEngine(model, ragged=False,
+                                      **{**base, **(legacy_kw or {})})
+    ragged = ContinuousBatchingEngine(model, ragged=True,
+                                      **{**base, **(ragged_kw or {})})
+    assert ragged._ragged and not legacy._ragged
+    return (legacy.serve(prompts, **serve_kw),
+            ragged.serve(prompts, **serve_kw))
+
+
+class TestRaggedEngine:
+    def test_bit_identical_greedy_async_and_sync(self, model):
+        rng = np.random.RandomState(7)
+        prompts = _prompts(rng, (3, 17, 41, 9, 28))
+        for mode in ({}, {"async_decode": False}):
+            want, got = _serve_pair(model, prompts, ragged_kw=mode,
+                                    legacy_kw=mode, max_new_tokens=12)
+            for w, g in zip(want, got):
+                np.testing.assert_array_equal(w, g)
+
+    def test_bit_identical_sampled(self, model):
+        rng = np.random.RandomState(11)
+        prompts = _prompts(rng, (5, 33, 12, 20))
+        want, got = _serve_pair(model, prompts, max_new_tokens=10,
+                                do_sample=True, temperature=0.8, top_k=20,
+                                seed=3)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+
+    def test_eos_mid_block_truncates_identically(self, model):
+        rng = np.random.RandomState(13)
+        prompts = _prompts(rng, (6, 25, 14))
+        ref, _ = _serve_pair(model, prompts, max_new_tokens=16)
+        # pick an eos that really fires mid-stream for some request
+        eos = int(np.asarray(ref[0])[len(prompts[0]) + 3])
+        want, got = _serve_pair(model, prompts, max_new_tokens=16,
+                                eos_token_id=eos)
+        assert any(len(np.asarray(w)) < len(p) + 16
+                   for w, p in zip(want, prompts))
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+
+    def test_bit_identical_prefix_cache_and_chunked(self, model):
+        rng = np.random.RandomState(17)
+        shared = rng.randint(1, 100, size=24).astype(np.int32)
+        prompts = [np.concatenate([shared, p])
+                   for p in _prompts(rng, (3, 17, 41, 9))]
+        kw = {"page_size": 8, "enable_prefix_cache": True}
+        legacy = ContinuousBatchingEngine(model, max_seqs=4, max_len=160,
+                                          ragged=False, **kw)
+        ragged = ContinuousBatchingEngine(model, max_seqs=4, max_len=160,
+                                          ragged=True, **kw)
+        for eng in (legacy, ragged):  # second serve hits the prefix cache
+            eng.r1 = eng.serve(prompts, max_new_tokens=6)
+            eng.r2 = eng.serve(prompts, max_new_tokens=6)
+        for w, g in zip(legacy.r1 + legacy.r2, ragged.r1 + ragged.r2):
+            np.testing.assert_array_equal(w, g)
+        assert ragged.stats["prefix_hit_pages"] > 0
+        # chunked long prompts against the legacy chunk ladder
+        long_prompts = _prompts(rng, (90, 130, 5))
+        ck = {"prefill_chunk": 32, "max_len": 256}
+        want, got = _serve_pair(model, long_prompts, ragged_kw=ck,
+                                legacy_kw=ck, max_new_tokens=10)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+
+    def test_int8_pool_matches_legacy(self, model):
+        rng = np.random.RandomState(19)
+        prompts = _prompts(rng, (3, 17, 41, 9, 28))
+        kw = {"kv_cache_dtype": "int8"}
+        want, got = _serve_pair(model, prompts, ragged_kw=kw, legacy_kw=kw,
+                                max_new_tokens=8)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+
+    def test_lora_batch_matches_legacy(self, model):
+        from paddle_tpu.serving.adapters import LoRAAdapter
+
+        rng = np.random.RandomState(23)
+        hidden = model.config.hidden_size
+        vocab = model.config.vocab_size
+        ad = LoRAAdapter("a1", rng.randn(hidden, 4).astype(np.float32) * .05,
+                         rng.randn(4, vocab).astype(np.float32) * .05)
+        zad = LoRAAdapter("z0", np.zeros((hidden, 4), np.float32),
+                          np.zeros((4, vocab), np.float32))
+        prompts = _prompts(rng, (3, 17, 41, 9))
+        want, got = _serve_pair(model, prompts, max_new_tokens=8,
+                                adapters=[ad, None, zad, ad])
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+
+    def test_kill_switch_env(self, model, monkeypatch):
+        monkeypatch.setenv("PADDLE_SERVING_RAGGED", "0")
+        eng = ContinuousBatchingEngine(model, max_seqs=2, page_size=16,
+                                       max_len=64)
+        assert not eng._ragged  # byte-for-byte the legacy engine paths
+        monkeypatch.setenv("PADDLE_SERVING_RAGGED", "1")
+        eng = ContinuousBatchingEngine(model, max_seqs=2, page_size=16,
+                                       max_len=64)
+        assert eng._ragged
+
+    def test_warmup_covers_ragged_programs(self, model):
+        """After warmup, a mixed serve (short + long prompts, two sampling
+        configs) must add NO program keys and NO serve.* compile-ledger
+        events — the steady-state zero-recompile contract, now with a
+        warmup that is one dummy serve per config instead of a ladder."""
+        from paddle_tpu.observability import compilemem
+
+        eng = ContinuousBatchingEngine(model, max_seqs=4, page_size=16,
+                                       max_len=160, ragged=True)
+        eng.warmup(prompt_lens=[3, 17, 41],
+                   sampling=[(False, 1.0, 0, 1.0), (True, 0.8, 20, 1.0)])
+        # collapsed program count: ONE mixed + one block program per
+        # sampling config (plus k=1 decode only when decode_block == 1)
+        assert len(eng._ragged_fns) == 2
+        assert not eng._prefill_fns and not eng._insert_fns
+        warm_before = set(eng._warm)
+
+        def _serve_counts():
+            rep = compilemem.ledger.report(recent=0)["by_key"]
+            return {k: v["count"] for k, v in rep.items()
+                    if k.startswith("serve.")}
+
+        before = _serve_counts()
+        rng = np.random.RandomState(29)
+        prompts = _prompts(rng, (3, 17, 41, 9, 28))
+        eng.serve(prompts, max_new_tokens=12)
+        eng.serve(prompts, max_new_tokens=12, do_sample=True,
+                  temperature=0.8, top_k=20, seed=5)
+        assert set(eng._warm) == warm_before
+        assert _serve_counts() == before
+
+    def test_devprof_ragged_row(self, model, monkeypatch):
+        """The mixed dispatch banks device-seconds per token under its
+        serve.ragged[...] program key (ISSUE 17 plane, new key family)."""
+        from paddle_tpu.observability import devprof
+
+        devprof._reset()
+        devprof.enable(sample_every=1)
+        try:
+            # small chunk budget -> several mixed dispatches per prompt, so
+            # warm (post-compile) dispatches exist for the cadence to time
+            eng = ContinuousBatchingEngine(model, max_seqs=2, page_size=16,
+                                           max_len=160, prefill_chunk=16,
+                                           ragged=True)
+            rng = np.random.RandomState(31)
+            eng.serve(_prompts(rng, (40, 55)), max_new_tokens=6)
+            table = devprof.plane()._table()
+            keys = [k for k in table if k.startswith("serve.ragged[")]
+            assert keys, sorted(table)
+            rec = table[keys[0]]
+            assert rec["device_s"] > 0 and rec["tokens"] > 0
+        finally:
+            devprof._reset()
+
+    def test_deadline_returns_partial_without_first_token(self, model):
+        """Ragged twin of the legacy deadline test: admission produces no
+        token, so an instant deadline may return a prompt-only partial —
+        but the request must still retire cleanly with its slot freed."""
+        rng = np.random.RandomState(37)
+        eng = ContinuousBatchingEngine(model, max_seqs=1, page_size=16,
+                                       max_len=64, decode_block=1,
+                                       ragged=True)
+        p = _prompts(rng, (5,))[0]
+        outs = eng.serve([p], max_new_tokens=30, request_timeout_s=0.0)
+        assert eng.stats["timed_out_requests"] == 1
+        assert outs[0] is not None
+        assert len(p) <= len(np.asarray(outs[0])) < len(p) + 30
+        assert eng.idle() and len(eng.free_slots) == 1

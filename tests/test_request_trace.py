@@ -440,7 +440,11 @@ class TestServingIntegration:
         sink = str(tmp_path / "spans.0.jsonl")
         tracing.enable(jsonl_path=sink)
         rng = np.random.RandomState(0)
-        with ServingFrontend(self._engines(model)) as fe:
+        # ragged=False: the span vocabulary under test is the LEGACY
+        # lifecycle's (prefill/prefill_chunk device spans at admission);
+        # ragged admission does no device work — its lifecycle is covered
+        # in tests/test_ragged_attention.py
+        with ServingFrontend(self._engines(model, ragged=False)) as fe:
             # two rounds of one short (monolithic prefill) + one long
             # (chunked prefill): the first round compiles (goodput
             # 'compile'), the second hits warm programs so the prefill/

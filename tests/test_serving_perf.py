@@ -50,7 +50,11 @@ def _mk(model, **kw):
     return ContinuousBatchingEngine(model, **kw)
 
 
-LEGACY = dict(async_decode=False, prefill_chunk=None)
+# LEGACY is the PR 6 monolithic contrast/reference engine — pinned off the
+# ragged plane (ISSUE 20) so it keeps the pre-ragged emission order these
+# tests encode; the PIPELINED engines ride the ragged default, so every
+# legacy-vs-pipelined comparison below doubles as a ragged bit-exactness check.
+LEGACY = dict(async_decode=False, prefill_chunk=None, ragged=False)
 PIPELINED = dict(async_decode=True, prefill_chunk=24)
 
 
